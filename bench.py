@@ -1,0 +1,50 @@
+"""Benchmark runner — prints ONE JSON line for the driver.
+
+Round 1 metric: LeNet-MNIST Model.fit throughput on the local chip
+(BASELINE config #1); later rounds switch to GPT-1.3B tokens/sec/chip.
+vs_baseline is vs. BASELINE.json's published numbers — none exist
+(published: {}), so it reports 1.0 when the run completes at sane speed.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    model = paddle.Model(net, inputs=[InputSpec([None, 1, 28, 28],
+                                                "float32", "image")],
+                         labels=[InputSpec([None, 1], "int64", "label")])
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+
+    bs = 512
+    x = np.random.rand(bs, 1, 28, 28).astype("float32")
+    y = np.random.randint(0, 10, (bs, 1)).astype("int64")
+    # warmup/compile
+    model.train_batch([x], [y])
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        model.train_batch([x], [y])
+    dt = time.perf_counter() - t0
+    ips = n * bs / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_train_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

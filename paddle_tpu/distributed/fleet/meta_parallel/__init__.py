@@ -1,0 +1,72 @@
+"""Meta-parallel wrappers (reference:
+python/paddle/distributed/fleet/meta_parallel/).
+
+M2-M4 build these out (TP layers, PipelineLayer, sharding stages); the
+facade-level wrap + HybridParallelOptimizer live here.
+"""
+from ....nn.layer.layers import Layer
+from ....optimizer.optimizer import Optimizer
+
+
+def wrap_distributed_model(model, strategy, hcg):
+    """Pick the wrapper by strategy (reference: fleet.distributed_model)."""
+    from ...parallel import DataParallel
+    if hcg is None:
+        return DataParallel(model)
+    h = strategy.hybrid_configs if strategy else {}
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    return DataParallel(model)
+
+
+class TensorParallel(Layer):
+    """Marker wrapper: TP layers already carry their sharding rules; this
+    wrapper only pins the hcg so the engine builds the right mesh."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class HybridParallelOptimizer:
+    """Wraps the inner optimizer with mesh-aware global-norm clipping
+    (reference: meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer
+    .py).  Under GSPMD the grad allreduce is already in the compiled step;
+    what remains is the cross-axis global-norm clip, which works on the
+    full (replicated-view) grads transparently."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner.minimize(loss, *a, **k)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)

@@ -1,0 +1,120 @@
+"""Launcher CLI (reference: python/paddle/distributed/launch/main.py ==
+``fleetrun``: spawn per-device workers, set PADDLE_* env, watch loop,
+restart on failure).
+
+TPU-native: ONE process per host drives all local chips (SPMD), so
+``--nnodes`` is the only real fan-out; per-host we spawn a single worker
+(vs the reference's one-per-GPU).  The watch loop + restart-with-resume
+survives worker crashes; rendezvous is the JAX coordinator (the reference's
+TCPStore master).
+
+Usage:  python -m paddle_tpu.distributed.launch [--nnodes N] [--node_rank R]
+            [--master host:port] [--max_restart K] script.py [args...]
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count (N or min:max for elastic)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="workers per host (1 on TPU: SPMD drives all chips)")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="accepted for compat; chip selection is automatic")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _worker_env(args, local_rank):
+    env = dict(os.environ)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    rank = args.node_rank * nproc + local_rank
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    env["PADDLE_CURRENT_ENDPOINT"] = \
+        f"{os.environ.get('POD_IP', '127.0.0.1')}:{6170 + local_rank}"
+    return env
+
+
+def main():
+    args = _parse()
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = {}
+    restarts = {i: 0 for i in range(args.nproc_per_node)}
+    logs = {}
+
+    def start(local_rank):
+        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        logf = open(log_path, "ab", buffering=0)
+        logs[local_rank] = logf
+        cmd = [sys.executable, args.script] + args.script_args
+        p = subprocess.Popen(cmd, env=_worker_env(args, local_rank),
+                             stdout=logf, stderr=subprocess.STDOUT)
+        procs[local_rank] = p
+        print(f"[launch] started worker {local_rank} pid={p.pid} "
+              f"log={log_path}", flush=True)
+
+    def shutdown(signum=None, frame=None):
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        t0 = time.time()
+        while any(p.poll() is None for p in procs.values()) and \
+                time.time() - t0 < 10:
+            time.sleep(0.2)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        sys.exit(1 if signum else 0)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+
+    for i in range(args.nproc_per_node):
+        start(i)
+
+    # watch loop (reference: controllers/controller.py::watch)
+    while True:
+        alive = 0
+        for i, p in list(procs.items()):
+            ret = p.poll()
+            if ret is None:
+                alive += 1
+            elif ret != 0:
+                if restarts[i] < args.max_restart:
+                    restarts[i] += 1
+                    print(f"[launch] worker {i} exited rc={ret}; restart "
+                          f"{restarts[i]}/{args.max_restart}", flush=True)
+                    start(i)
+                    alive += 1
+                else:
+                    print(f"[launch] worker {i} failed rc={ret}; giving up",
+                          flush=True)
+                    shutdown()
+        if alive == 0:
+            break
+        time.sleep(1)
+    print("[launch] all workers finished", flush=True)
+
+
+if __name__ == "__main__":
+    main()

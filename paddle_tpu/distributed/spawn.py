@@ -1,0 +1,19 @@
+"""paddle.distributed.spawn compat (reference:
+python/paddle/distributed/spawn.py).
+
+On TPU a single process drives all local chips (SPMD), so nprocs>1 process
+forking is only meaningful for CPU tests; we emulate by running the
+function once with the full device set visible — parallelism comes from
+sharding, not processes.  True multi-host launch is the `launch` CLI.
+"""
+import os
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    # Emulated: single driver process, devices provide the parallelism.
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
+    func(*args)
+    return None

@@ -1,0 +1,38 @@
+"""Incubating APIs (reference: python/paddle/incubate/)."""
+from . import nn  # noqa: F401
+from .nn.functional import flash_attention  # noqa: F401
+
+
+class autograd:
+    """paddle.incubate.autograd compat — forward-mode via jax.jvp."""
+
+    @staticmethod
+    def jvp(func, xs, v=None):
+        import jax
+        from ..framework.core import Tensor
+        xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+        vals = [x._value for x in xs_t]
+        tangents = [t._value for t in (v if isinstance(v, (list, tuple))
+                                       else [v])] if v is not None else \
+            [jax.numpy.ones_like(x) for x in vals]
+
+        def f(*a):
+            out = func(*[Tensor(x) for x in a])
+            return out._value if isinstance(out, Tensor) else out
+        y, jv = jax.jvp(f, tuple(vals), tuple(tangents))
+        return Tensor(y), Tensor(jv)
+
+    @staticmethod
+    def vjp(func, xs, v=None):
+        import jax
+        from ..framework.core import Tensor
+        xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+        vals = [x._value for x in xs_t]
+
+        def f(*a):
+            out = func(*[Tensor(x) for x in a])
+            return out._value if isinstance(out, Tensor) else out
+        y, pullback = jax.vjp(f, *vals)
+        ct = v._value if v is not None else jax.numpy.ones_like(y)
+        grads = pullback(ct)
+        return Tensor(y), [Tensor(g) for g in grads]

@@ -1,0 +1,99 @@
+"""Flash attention Pallas kernel (TPU).
+
+Reference analogue: paddle/phi/kernels/gpu/flash_attn_kernel.cu (cutlass
+flash-attn submodule).  TPU-native: blockwise online-softmax attention with
+q blocks resident in VMEM, k/v streamed; grid over (batch*heads, q_blocks).
+Layout is paddle's (B, S, H, D).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                  seq_len):
+    # q_ref: (block_q, d); k_ref/v_ref: (seq_len, d); o_ref: (block_q, d)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:] * scale
+    q_idx = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    num_kb = seq_len // block_k
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(i * block_k, block_k), :]
+        v = v_ref[pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_idx = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        # only iterate k blocks up to (and including) this q block
+        last = (pl.program_id(1) * block_q + block_q + block_k - 1) // block_k
+        nkb = jnp.minimum(last, num_kb)
+        acc, m, l = jax.lax.fori_loop(0, nkb, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bhsd(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
+                block_k=DEFAULT_BLOCK_K):
+    """q,k,v: (BH, S, D) — flattened batch*heads."""
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = 1.0 / math.sqrt(D)
+    grid = (BH, S // block_q)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_len=S)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+    )(q, k, v)
+
+
+def flash_attention_fwd(q, k, v, causal=False):
+    """(B, S, H, D) in/out — paddle layout; supports MQA/GQA (H_kv divides
+    H) by repeating kv heads."""
+    B, S, H, D = q.shape
+    Hk = k.shape[2]
+    if Hk != H:
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qf = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    kf = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
+    vf = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
+    of = _flash_bhsd(qf, kf, vf, causal=causal)
+    return jnp.swapaxes(of.reshape(B, H, S, D), 1, 2)

@@ -1,0 +1,3 @@
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
+                        Adagrad, RMSProp, Adadelta, Lamb, L2Decay, L1Decay)
+from . import lr  # noqa: F401

@@ -1,0 +1,84 @@
+"""Static-graph API (reference: python/paddle/static/).
+
+TPU-native: the "Program" is a traced jaxpr + XLA executable — ``jit`` IS
+the static mode.  This module keeps API-shape compat: InputSpec,
+enable/disable_static toggles consulted by in_dynamic_mode(), and
+save/load_inference_model over serialized StableHLO (in jit/).
+"""
+import numpy as np
+
+from ..framework import dtypes
+
+__all__ = ["InputSpec", "enable_static", "disable_static", "Program",
+           "program_guard", "default_main_program", "name_scope"]
+
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(None if s in (-1, None) else int(s)
+                           for s in shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+
+class Program:
+    """Placeholder for API compat; a traced function IS the program."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program()
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+@contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()

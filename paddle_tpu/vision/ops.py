@@ -1,0 +1,84 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv2d CUDA kernels).  XLA-composable implementations."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["nms", "roi_align", "box_coder", "yolo_box"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    import numpy as np
+    b = np.asarray(ensure_tensor(boxes)._value)
+    s = np.asarray(ensure_tensor(scores)._value) if scores is not None \
+        else np.arange(len(b))[::-1].astype("float32")
+    order = np.argsort(-s)
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        area_o = ((b[order[1:], 2] - b[order[1:], 0]) *
+                  (b[order[1:], 3] - b[order[1:], 1]))
+        iou = inter / (area_i + area_o - inter + 1e-9)
+        order = order[1:][iou <= iou_threshold]
+    keep = np.asarray(keep, dtype="int64")
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _ra(feat, bxs):
+        N, C, H, W = feat.shape
+        offset = 0.5 if aligned else 0.0
+
+        def one_box(box):
+            x1, y1, x2, y2 = box * spatial_scale - offset
+            bw = jnp.maximum(x2 - x1, 1.0)
+            bh = jnp.maximum(y2 - y1, 1.0)
+            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            f = feat[0]
+            v = (f[:, y0, x0] * (1 - wy) * (1 - wx) +
+                 f[:, y1i, x0] * wy * (1 - wx) +
+                 f[:, y0, x1i] * (1 - wy) * wx +
+                 f[:, y1i, x1i] * wy * wx)
+            return v
+        return jax.vmap(one_box)(bxs)
+    return call_op(_ra, x, boxes)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode",
+              box_normalized=True, axis=0, name=None):
+    raise NotImplementedError("box_coder lands with the detection suite")
+
+
+def yolo_box(*args, **kwargs):
+    raise NotImplementedError("yolo_box lands with the detection suite")

@@ -1,0 +1,18 @@
+"""Test config: force CPU with 8 virtual devices (the reference's
+"Gloo-for-CPU-tests" trick, SURVEY.md §4) so all multi-device sharding
+logic runs in CI without TPU hardware."""
+import os
+
+# FORCE cpu: the session env pre-sets JAX_PLATFORMS=axon (the real TPU
+# tunnel), which admits only one claimant — concurrent test runs would
+# deadlock on the device grant.  Tests always run on virtual CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# CPU matmuls default to a bf16-ish fast path; tests compare against numpy
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,76 @@
+"""M0 exit test (SURVEY.md §7.2): LeNet-MNIST via Model.fit."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.static import InputSpec
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.transforms import ToTensor
+
+
+def _make_model(jit=True):
+    net = LeNet()
+    model = paddle.Model(net, inputs=[InputSpec([None, 1, 28, 28],
+                                                "float32", "image")],
+                         labels=[InputSpec([None, 1], "int64", "label")])
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy(), jit=jit)
+    return model
+
+
+def test_lenet_fit_learns():
+    paddle.seed(42)
+    train = MNIST(mode="train", transform=ToTensor())
+    model = _make_model(jit=True)
+    model.fit(train, batch_size=256, epochs=2, verbose=0)
+    logs = model.evaluate(MNIST(mode="train", transform=ToTensor()),
+                          batch_size=256, verbose=0)
+    # synthetic classes are separable; 2 epochs should beat 60%
+    assert logs["acc"] > 0.6, logs
+
+
+def test_train_batch_eager_vs_jit_agree():
+    paddle.seed(0)
+    x = np.random.rand(8, 1, 28, 28).astype("float32")
+    y = np.random.randint(0, 10, (8, 1)).astype("int64")
+
+    paddle.seed(7)
+    m1 = _make_model(jit=True)
+    loss1 = m1.train_batch([x], [y])
+
+    paddle.seed(7)
+    m2 = _make_model(jit=False)
+    loss2 = m2.train_batch([x], [y])
+    np.testing.assert_allclose(loss1[0][0], loss2[0][0], rtol=2e-4)
+
+
+def test_predict_and_eval():
+    model = _make_model()
+    test = MNIST(mode="test", transform=ToTensor())
+    out = model.predict(test, batch_size=128, stack_outputs=True)
+    assert out[0].shape == (len(test), 10)
+
+
+def test_model_save_load(tmp_path):
+    model = _make_model()
+    x = np.random.rand(4, 1, 28, 28).astype("float32")
+    y = np.random.randint(0, 10, (4, 1)).astype("int64")
+    model.train_batch([x], [y])
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+
+    model2 = _make_model()
+    model2.load(path)
+    p1 = model.predict_batch([x])[0]
+    p2 = model2.predict_batch([x])[0]
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_summary():
+    net = LeNet()
+    info = paddle.summary(net, (1, 1, 28, 28))
+    assert info["total_params"] == sum(
+        int(np.prod(p.shape)) for p in net.parameters())

@@ -1,0 +1,138 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    l = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = l(x)
+    assert y.shape == [2, 3]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ l.weight.numpy() + l.bias.numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    y = conv(x)
+    assert y.shape == [1, 3, 8, 8]
+    # compare against explicit correlation at one output position
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    xp = np.pad(x.numpy(), [(0, 0), (0, 0), (1, 1), (1, 1)])
+    ref = (xp[0, :, 3:6, 3:6] * w[1]).sum() + b[1]
+    np.testing.assert_allclose(y.numpy()[0, 1, 3, 3], ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                  [10.5, 12.5]])
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5])
+    bn.train()
+    y = bn(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # running stats moved
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    frac = (y.numpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3])[:, None])
+    loss = F.cross_entropy(logits, labels)
+    lp = np.asarray(logits.numpy(), dtype=np.float64)
+    lse = np.log(np.exp(lp).sum(-1))
+    ref = (lse - lp[np.arange(4), [0, 1, 2, 3]]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+
+def test_cross_entropy_grad():
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype("float32"),
+                              stop_gradient=False)
+    labels = paddle.to_tensor(np.array([1, 0, 3, 2]))
+    loss = F.cross_entropy(logits, labels)
+    loss.backward()
+    p = np.exp(logits.numpy())
+    p /= p.sum(-1, keepdims=True)
+    onehot = np.eye(5)[[1, 0, 3, 2]]
+    np.testing.assert_allclose(logits.grad.numpy(), (p - onehot) / 4,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_save_load(tmp_path):
+    m = nn.Linear(4, 2)
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), p)
+    sd = paddle.load(p)
+    m2 = nn.Linear(4, 2)
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_layer_hooks_and_apply():
+    m = nn.Linear(3, 3)
+    calls = []
+    h = m.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    m(paddle.randn([2, 3]))
+    assert calls == [1]
+    h.remove()
+    m(paddle.randn([2, 3]))
+    assert calls == [1]
+
+
+def test_named_parameters_deterministic():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["0.weight", "0.bias", "1.weight", "1.bias"]

@@ -1,0 +1,149 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+    assert str(x.dtype) == "float32"
+
+
+def test_arithmetic_and_broadcast():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([[1.0], [2.0]])
+    c = a + b
+    assert c.shape == [2, 3]
+    np.testing.assert_allclose((a * 2 + 1).numpy(), [3, 5, 7])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((1 / a).numpy(), 1 / np.array([1., 2., 3.]))
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    assert float(paddle.sum(x)) == 66.0
+    np.testing.assert_allclose(paddle.mean(x, axis=0).numpy(),
+                               np.arange(12.).reshape(3, 4).mean(0))
+    v, idx = paddle.topk(x, k=2, axis=1)
+    assert v.shape == [3, 2]
+    np.testing.assert_allclose(idx.numpy(), [[3, 2]] * 3)
+
+
+def test_manipulation():
+    x = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+    y = paddle.transpose(x, [2, 0, 1])
+    assert y.shape == [4, 2, 3]
+    z = paddle.concat([x, x], axis=1)
+    assert z.shape == [2, 6, 4]
+    parts = paddle.split(z, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == [2, 3, 4]
+    np.testing.assert_allclose(parts[0].numpy(), x.numpy())
+    s = paddle.squeeze(paddle.unsqueeze(x, 0), 0)
+    assert s.shape == x.shape
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain_and_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    z = y * y + y  # dz/dx = (2y*3 + 3) = 18x + 3... via chain
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 18 * np.array([1., 2.]) + 3)
+
+
+def test_backward_shared_input():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x + x * x  # x used by two branches
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0])
+
+
+def test_grad_api():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), 6.0)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._node is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    z.backward()
+    assert x.grad is None
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * 5).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype("float32"),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype("float32"),
+                         stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_indexing_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32"), stop_gradient=False)
+    y = x[2:5].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 0, 1, 1, 1, 0])
+
+
+def test_cast_and_dtype():
+    x = paddle.to_tensor([1, 2, 3])
+    assert str(x.dtype) == "int64" or str(x.dtype) == "int32"
+    y = x.astype("float32")
+    assert str(y.dtype) == "float32"
+
+
+def test_multi_output_op_grads():
+    x = paddle.to_tensor(np.random.rand(4, 6).astype("float32"),
+                         stop_gradient=False)
+    parts = paddle.split(x, 2, axis=1)
+    loss = parts[0].sum() + (parts[1] * 2).sum()
+    loss.backward()
+    expect = np.concatenate([np.ones((4, 3)), 2 * np.ones((4, 3))], axis=1)
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_where_and_comparison():
+    x = paddle.to_tensor([1.0, -2.0, 3.0])
+    out = paddle.where(x > 0, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [1, 0, 3])
+
+
+def test_einsum():
+    a = paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+    b = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(),
+                               rtol=1e-5)
