@@ -9,6 +9,11 @@ from ._helpers import ensure_tensor
 from .math import matmul, mm, bmm, dot  # noqa: F401 (re-export)
 
 
+def mv(x, vec, name=None):
+    x, vec = ensure_tensor(x), ensure_tensor(vec)
+    return call_op(lambda a, b: a @ b, x, vec)
+
+
 def norm(x, p=None, axis=None, keepdim=False, name=None):
     x = ensure_tensor(x)
     if isinstance(axis, (list, tuple)):

@@ -6,6 +6,12 @@ import os
 # FORCE cpu: the session env pre-sets JAX_PLATFORMS=axon (the real TPU
 # tunnel), which admits only one claimant — concurrent test runs would
 # deadlock on the device grant.  Tests always run on virtual CPU devices.
+#
+# NOTE the env var alone is NOT enough: the axon sitecustomize hook runs
+# register() at interpreter start, which does
+# jax.config.update("jax_platforms", "axon,cpu") — clobbering the env.
+# We must re-update the config AFTER importing jax (backends are still
+# uninitialized at conftest time, so this cleanly prevents any TPU claim).
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
@@ -13,6 +19,8 @@ if "host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # CPU matmuls default to a bf16-ish fast path; tests compare against numpy
 jax.config.update("jax_default_matmul_precision", "highest")

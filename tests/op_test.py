@@ -1,0 +1,117 @@
+"""OpTest harness — the workhorse op-testing pattern of the reference
+(reference: test/legacy_test/op_test.py): each op test supplies numpy
+inputs and a numpy golden; ``check_output`` compares the eager op against
+the golden, and ``check_grad`` compares the autograd gradient against a
+numeric central-difference estimate.
+
+TPU-native twist: we additionally run every checked op under ``jax.jit``
+(the static path) so eager/compiled parity is covered by the same harness —
+the reference runs each op through both executors for the same reason.
+"""
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+
+
+class OpTest:
+    """Subclass and call ``check_output`` / ``check_grad``.
+
+    The op under test is a callable taking/returning paddle Tensors.
+    """
+
+    # per-dtype default tolerances (looser for half precisions, like the
+    # reference's OpTest)
+    TOLERANCES = {
+        "float64": dict(rtol=1e-7, atol=1e-7),
+        "float32": dict(rtol=1e-5, atol=1e-6),
+        "bfloat16": dict(rtol=2e-2, atol=2e-2),
+        "float16": dict(rtol=1e-3, atol=1e-3),
+    }
+
+    def _tol(self, arr, rtol, atol):
+        base = self.TOLERANCES.get(str(arr.dtype), dict(rtol=1e-5, atol=1e-6))
+        return dict(rtol=rtol if rtol is not None else base["rtol"],
+                    atol=atol if atol is not None else base["atol"])
+
+    def check_output(self, op, inputs, golden, rtol=None, atol=None,
+                     check_jit=True, **op_kwargs):
+        """Run ``op(*inputs, **op_kwargs)`` and compare to ``golden``.
+
+        inputs: list of numpy arrays (converted to Tensors).
+        golden: numpy array or list of arrays (expected outputs).
+        """
+        tensors = [paddle.to_tensor(a) for a in inputs]
+        out = op(*tensors, **op_kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        goldens = golden if isinstance(golden, (tuple, list)) else [golden]
+        assert len(outs) == len(goldens), \
+            f"op returned {len(outs)} outputs, golden has {len(goldens)}"
+        for o, g in zip(outs, goldens):
+            g = np.asarray(g)
+            tol = self._tol(g, rtol, atol)
+            np.testing.assert_allclose(o.numpy(), g, **tol)
+
+        if check_jit:
+            # static path: same op traced under jit over raw arrays
+            def raw(*vals):
+                ts = [paddle.Tensor(v) for v in vals]
+                r = op(*ts, **op_kwargs)
+                rs = r if isinstance(r, (tuple, list)) else [r]
+                return tuple(t._value for t in rs)
+
+            jitted = jax.jit(raw)(*[t._value for t in tensors])
+            for o, g in zip(jitted, goldens):
+                g = np.asarray(g)
+                tol = self._tol(g, rtol, atol)
+                np.testing.assert_allclose(np.asarray(o), g, **tol)
+        return outs
+
+    def check_grad(self, op, inputs, grad_inputs=None, eps=1e-3,
+                   rtol=1e-2, atol=1e-3, loss_fn=None, **op_kwargs):
+        """Numeric finite-difference gradient check.
+
+        inputs: list of float numpy arrays; grad_inputs: indices of inputs
+        to check (default all). The op's outputs are reduced to a scalar by
+        ``loss_fn`` (default: sum of all outputs).
+        """
+        inputs = [np.asarray(a, dtype="float64").astype("float32")
+                  for a in inputs]
+        if grad_inputs is None:
+            grad_inputs = list(range(len(inputs)))
+
+        def scalar_loss(arrs):
+            ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
+            out = op(*ts, **op_kwargs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            if loss_fn is not None:
+                return loss_fn(*outs), ts
+            total = None
+            for o in outs:
+                s = paddle.sum(o)
+                total = s if total is None else total + s
+            return total, ts
+
+        # analytic grads via the eager tape
+        loss, ts = scalar_loss(inputs)
+        loss.backward()
+        analytic = [ts[i].grad.numpy() if ts[i].grad is not None
+                    else np.zeros_like(inputs[i]) for i in grad_inputs]
+
+        # numeric central differences
+        for k, i in enumerate(grad_inputs):
+            num = np.zeros_like(inputs[i], dtype="float64")
+            flat = inputs[i].reshape(-1)
+            nflat = num.reshape(-1)
+            for j in range(flat.size):
+                orig = flat[j]
+                flat[j] = orig + eps
+                lp, _ = scalar_loss(inputs)
+                flat[j] = orig - eps
+                lm, _ = scalar_loss(inputs)
+                flat[j] = orig
+                nflat[j] = (float(lp) - float(lm)) / (2 * eps)
+            np.testing.assert_allclose(
+                analytic[k], num.astype("float32"), rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch for input {i}")
+        return analytic

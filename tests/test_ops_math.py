@@ -1,0 +1,233 @@
+"""numpy-golden op tests (math/linalg/reduction) via the OpTest harness."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestElementwise(OpTest):
+    def test_add(self):
+        a, b = rng.rand(3, 4).astype("f4"), rng.rand(3, 4).astype("f4")
+        self.check_output(paddle.add, [a, b], a + b)
+        self.check_grad(paddle.add, [a, b])
+
+    def test_subtract(self):
+        a, b = rng.rand(3, 4).astype("f4"), rng.rand(4).astype("f4")
+        self.check_output(paddle.subtract, [a, b], a - b)
+        self.check_grad(paddle.subtract, [a, b])
+
+    def test_multiply_broadcast(self):
+        a, b = rng.rand(2, 3, 4).astype("f4"), rng.rand(3, 1).astype("f4")
+        self.check_output(paddle.multiply, [a, b], a * b)
+        self.check_grad(paddle.multiply, [a, b])
+
+    def test_divide(self):
+        a = rng.rand(3, 4).astype("f4") + 0.5
+        b = rng.rand(3, 4).astype("f4") + 0.5
+        self.check_output(paddle.divide, [a, b], a / b)
+        self.check_grad(paddle.divide, [a, b])
+
+    def test_pow(self):
+        a = rng.rand(3, 4).astype("f4") + 0.5
+        self.check_output(paddle.pow, [a], a ** 2.5, y=2.5)
+        self.check_grad(paddle.pow, [a], y=2.5)
+
+    def test_maximum_minimum(self):
+        a, b = rng.randn(3, 4).astype("f4"), rng.randn(3, 4).astype("f4")
+        self.check_output(paddle.maximum, [a, b], np.maximum(a, b))
+        self.check_output(paddle.minimum, [a, b], np.minimum(a, b))
+
+    def test_unary_suite(self):
+        x = (rng.rand(3, 4).astype("f4") + 0.1)
+        for op, ref in [
+            (paddle.exp, np.exp), (paddle.log, np.log),
+            (paddle.sqrt, np.sqrt), (paddle.rsqrt, lambda v: 1/np.sqrt(v)),
+            (paddle.sin, np.sin), (paddle.cos, np.cos),
+            (paddle.tanh, np.tanh), (paddle.abs, np.abs),
+            (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+            (paddle.round, np.round), (paddle.square, np.square),
+            (paddle.sigmoid, lambda v: 1/(1+np.exp(-v))),
+            (paddle.reciprocal, lambda v: 1/v),
+            (paddle.erf, None), (paddle.expm1, np.expm1),
+            (paddle.log1p, np.log1p), (paddle.log2, np.log2),
+            (paddle.log10, np.log10),
+        ]:
+            if ref is None:
+                continue
+            self.check_output(op, [x], ref(x), rtol=2e-4, atol=1e-4)
+        # differentiable subset grad check on tiny input
+        t = rng.rand(2, 2).astype("f4") + 0.3
+        for op in [paddle.exp, paddle.log, paddle.sqrt, paddle.tanh,
+                   paddle.sigmoid, paddle.square]:
+            self.check_grad(op, [t])
+
+    def test_clip(self):
+        x = rng.randn(3, 4).astype("f4")
+        self.check_output(paddle.clip, [x], np.clip(x, -0.5, 0.5),
+                          min=-0.5, max=0.5)
+
+    def test_floor_divide_mod(self):
+        a = rng.randint(1, 20, (3, 4)).astype("f4")
+        b = rng.randint(1, 5, (3, 4)).astype("f4")
+        self.check_output(paddle.floor_divide, [a, b], np.floor_divide(a, b))
+        self.check_output(paddle.mod, [a, b], np.mod(a, b))
+
+
+class TestReductions(OpTest):
+    def test_sum_mean(self):
+        x = rng.rand(3, 4, 5).astype("f4")
+        self.check_output(paddle.sum, [x], x.sum())
+        self.check_output(paddle.sum, [x], x.sum(1), axis=1)
+        self.check_output(paddle.sum, [x], x.sum(axis=(0, 2), keepdims=True),
+                          axis=[0, 2], keepdim=True)
+        self.check_output(paddle.mean, [x], x.mean(2), axis=2)
+        self.check_grad(paddle.mean, [x[:2, :2, :2]], axis=1)
+
+    def test_max_min_prod(self):
+        x = rng.rand(3, 4).astype("f4")
+        self.check_output(paddle.max, [x], x.max(1), axis=1)
+        self.check_output(paddle.min, [x], x.min(0), axis=0)
+        self.check_output(paddle.prod, [x], x.prod(1), axis=1)
+
+    def test_logsumexp(self):
+        x = rng.randn(3, 4).astype("f4")
+        ref = np.log(np.exp(x).sum(axis=1))
+        self.check_output(paddle.logsumexp, [x], ref, axis=1,
+                          rtol=1e-4, atol=1e-5)
+
+    def test_cumsum_cumprod(self):
+        x = rng.rand(3, 4).astype("f4")
+        self.check_output(paddle.cumsum, [x], np.cumsum(x, 1), axis=1)
+        self.check_output(paddle.cumprod, [x], np.cumprod(x, 1), dim=1)
+
+    def test_norms(self):
+        x = rng.randn(3, 4).astype("f4")
+        self.check_output(paddle.norm, [x], np.linalg.norm(x))
+        self.check_output(paddle.norm, [x], np.linalg.norm(x, axis=1), axis=1)
+        self.check_output(paddle.norm, [x], np.abs(x).sum(1), p=1, axis=1)
+
+    def test_all_any(self):
+        x = rng.rand(3, 4) > 0.5
+        self.check_output(paddle.all, [x], x.all(1), axis=1)
+        self.check_output(paddle.any, [x], x.any(0), axis=0)
+
+
+class TestLinalg(OpTest):
+    def test_matmul(self):
+        a = rng.rand(3, 4).astype("f4")
+        b = rng.rand(4, 5).astype("f4")
+        self.check_output(paddle.matmul, [a, b], a @ b, rtol=1e-4)
+        self.check_grad(paddle.matmul, [a[:2, :2], b[:2, :2]])
+
+    def test_matmul_batched_transpose(self):
+        a = rng.rand(2, 3, 4).astype("f4")
+        b = rng.rand(2, 5, 4).astype("f4")
+        ref = a @ b.transpose(0, 2, 1)
+        self.check_output(paddle.matmul, [a, b], ref, transpose_y=True,
+                          rtol=1e-4)
+
+    def test_dot_t_mv(self):
+        a, b = rng.rand(5).astype("f4"), rng.rand(5).astype("f4")
+        self.check_output(paddle.dot, [a, b], a.dot(b), rtol=1e-4)
+        m = rng.rand(3, 4).astype("f4")
+        self.check_output(paddle.t, [m], m.T)
+        v = rng.rand(4).astype("f4")
+        self.check_output(paddle.mv, [m, v], m @ v, rtol=1e-4)
+
+    def test_bmm(self):
+        a = rng.rand(2, 3, 4).astype("f4")
+        b = rng.rand(2, 4, 5).astype("f4")
+        self.check_output(paddle.bmm, [a, b], a @ b, rtol=1e-4)
+
+    def test_einsum(self):
+        a = rng.rand(2, 3).astype("f4")
+        b = rng.rand(3, 4).astype("f4")
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_solve_inv(self):
+        a = rng.rand(3, 3).astype("f4") + 3 * np.eye(3, dtype="f4")
+        b = rng.rand(3, 2).astype("f4")
+        self.check_output(paddle.linalg.solve, [a, b],
+                          np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+        self.check_output(paddle.linalg.inv, [a], np.linalg.inv(a),
+                          rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = rng.rand(4, 3).astype("f4")
+        u, s, vh = np.linalg.svd(a, full_matrices=False)
+        _, ps, _ = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(ps.numpy(), s, rtol=1e-3, atol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype="f4")
+        c = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(c.numpy() @ c.numpy().T, spd,
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestComparisonLogic(OpTest):
+    def test_compare(self):
+        a = rng.randn(3, 4).astype("f4")
+        b = rng.randn(3, 4).astype("f4")
+        self.check_output(paddle.equal, [a, a], np.equal(a, a))
+        self.check_output(paddle.greater_than, [a, b], a > b)
+        self.check_output(paddle.less_equal, [a, b], a <= b)
+        self.check_output(paddle.not_equal, [a, b], a != b)
+
+    def test_logical(self):
+        a = rng.rand(3, 4) > 0.5
+        b = rng.rand(3, 4) > 0.5
+        self.check_output(paddle.logical_and, [a, b], a & b)
+        self.check_output(paddle.logical_or, [a, b], a | b)
+        self.check_output(paddle.logical_not, [a], ~a)
+        self.check_output(paddle.logical_xor, [a, b], a ^ b)
+
+    def test_isnan_isinf(self):
+        x = np.array([1.0, np.nan, np.inf, -np.inf], dtype="f4")
+        self.check_output(paddle.isnan, [x], np.isnan(x))
+        self.check_output(paddle.isinf, [x], np.isinf(x))
+        self.check_output(paddle.isfinite, [x], np.isfinite(x))
+
+
+class TestSearchSort(OpTest):
+    def test_argmax_argmin(self):
+        x = rng.randn(3, 4).astype("f4")
+        self.check_output(paddle.argmax, [x], x.argmax(1), axis=1)
+        self.check_output(paddle.argmin, [x], x.argmin(0), axis=0)
+
+    def test_sort_argsort(self):
+        x = rng.randn(3, 4).astype("f4")
+        self.check_output(paddle.sort, [x], np.sort(x, 1), axis=1)
+        self.check_output(paddle.argsort, [x], np.argsort(x, 1, kind="stable"),
+                          axis=1)
+
+    def test_topk(self):
+        x = rng.randn(3, 5).astype("f4")
+        v = paddle.topk(paddle.to_tensor(x), k=2, axis=1)[0]
+        np.testing.assert_allclose(v.numpy(), np.sort(x, 1)[:, ::-1][:, :2])
+
+    def test_where_masked_select(self):
+        x = rng.randn(3, 4).astype("f4")
+        y = rng.randn(3, 4).astype("f4")
+        cond = x > 0
+        self.check_output(paddle.where, [cond, x, y], np.where(cond, x, y))
+
+    def test_nonzero_unique(self):
+        x = np.array([0, 3, 0, 4], dtype="f4")
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_allclose(nz.numpy(), [[1], [3]])
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 3, 2])))
+        np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+
+    def test_gather_scatter_index_select(self):
+        x = rng.rand(5, 3).astype("f4")
+        idx = np.array([0, 2, 4])
+        self.check_output(paddle.gather, [x], x[idx],
+                          index=paddle.to_tensor(idx), axis=0)
+        self.check_output(paddle.index_select, [x], x[:, [0, 2]],
+                          index=paddle.to_tensor(np.array([0, 2])), axis=1)

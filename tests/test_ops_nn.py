@@ -1,0 +1,226 @@
+"""numpy-golden op tests for nn.functional (activation/loss/norm/conv/pool)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestActivations(OpTest):
+    def test_relu_family(self):
+        x = rng.randn(3, 4).astype("f4")
+        self.check_output(F.relu, [x], np.maximum(x, 0))
+        self.check_output(F.relu6, [x * 4], np.clip(x * 4, 0, 6))
+        self.check_output(F.leaky_relu, [x], np.where(x > 0, x, 0.01 * x))
+        self.check_output(F.elu, [x], np.where(x > 0, x, np.exp(x) - 1),
+                          rtol=1e-4)
+        self.check_output(F.hardtanh, [x], np.clip(x, -1, 1))
+        self.check_grad(F.relu, [rng.rand(2, 2).astype("f4") + 0.1])
+
+    def test_gelu(self):
+        x = rng.randn(3, 4).astype("f4")
+        from scipy.special import erf as serf
+        ref = 0.5 * x * (1 + serf(x / np.sqrt(2)))
+        self.check_output(F.gelu, [x], ref, rtol=1e-3, atol=1e-4)
+        tanh_ref = 0.5 * x * (1 + np.tanh(
+            np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3)))
+        self.check_output(F.gelu, [x], tanh_ref, approximate=True,
+                          rtol=1e-3, atol=1e-4)
+
+    def test_softmax_logsoftmax(self):
+        x = rng.randn(3, 5).astype("f4")
+        self.check_output(F.softmax, [x], _softmax_np(x), rtol=1e-5)
+        self.check_output(F.log_softmax, [x], np.log(_softmax_np(x)),
+                          rtol=1e-4, atol=1e-5)
+        self.check_output(F.softmax, [x], _softmax_np(x, 0), axis=0)
+        self.check_grad(F.softmax, [rng.randn(2, 3).astype("f4")])
+
+    def test_misc_acts(self):
+        x = rng.randn(3, 4).astype("f4")
+        self.check_output(F.silu, [x], x / (1 + np.exp(-x)), rtol=1e-4)
+        self.check_output(F.mish, [x],
+                          x * np.tanh(np.log1p(np.exp(x))), rtol=1e-4)
+        self.check_output(F.softplus, [x], np.log1p(np.exp(x)), rtol=1e-4)
+        self.check_output(F.hardswish, [x],
+                          x * np.clip(x + 3, 0, 6) / 6, rtol=1e-4)
+        self.check_output(F.hardsigmoid, [x],
+                          np.clip(x / 6 + 0.5, 0, 1), rtol=1e-4)
+        self.check_output(F.swish, [x], x / (1 + np.exp(-x)), rtol=1e-4)
+        self.check_output(F.tanhshrink, [x], x - np.tanh(x), rtol=1e-4,
+                          atol=1e-5)
+
+
+class TestLosses(OpTest):
+    def test_mse_l1(self):
+        x = rng.randn(4, 3).astype("f4")
+        y = rng.randn(4, 3).astype("f4")
+        self.check_output(F.mse_loss, [x, y], ((x - y) ** 2).mean())
+        self.check_output(F.l1_loss, [x, y], np.abs(x - y).mean())
+        self.check_output(F.mse_loss, [x, y], (x - y) ** 2,
+                          reduction="none")
+        self.check_grad(F.mse_loss, [x[:2, :2], y[:2, :2]], grad_inputs=[0])
+
+    def test_cross_entropy(self):
+        logits = rng.randn(4, 5).astype("f4")
+        labels = rng.randint(0, 5, (4,)).astype("i8")
+        p = _softmax_np(logits)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels))
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+        # soft labels
+        soft = _softmax_np(rng.randn(4, 5).astype("f4"))
+        ref2 = -(soft * np.log(p)).sum(1).mean()
+        out2 = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft), soft_label=True)
+        np.testing.assert_allclose(float(out2), ref2, rtol=1e-4)
+
+    def test_nll_bce(self):
+        logp = np.log(_softmax_np(rng.randn(4, 5).astype("f4")))
+        labels = rng.randint(0, 5, (4,)).astype("i8")
+        ref = -logp[np.arange(4), labels].mean()
+        out = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(labels))
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+        x = rng.rand(4, 3).astype("f4") * 0.8 + 0.1
+        y = (rng.rand(4, 3) > 0.5).astype("f4")
+        ref = -(y * np.log(x) + (1 - y) * np.log(1 - x)).mean()
+        self.check_output(F.binary_cross_entropy, [x, y], ref, rtol=1e-5)
+
+        logits = rng.randn(4, 3).astype("f4")
+        sp = 1 / (1 + np.exp(-logits))
+        refl = -(y * np.log(sp) + (1 - y) * np.log(1 - sp)).mean()
+        self.check_output(F.binary_cross_entropy_with_logits, [logits, y],
+                          refl, rtol=1e-4)
+
+    def test_smooth_l1_kldiv(self):
+        x = rng.randn(4, 3).astype("f4")
+        y = rng.randn(4, 3).astype("f4")
+        d = x - y
+        ref = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5).mean()
+        self.check_output(F.smooth_l1_loss, [x, y], ref, rtol=1e-5)
+
+        logp = np.log(_softmax_np(x))
+        q = _softmax_np(y)
+        ref_kl = (q * (np.log(q) - logp)).mean()
+        self.check_output(F.kl_div, [logp, q], ref_kl, rtol=1e-4)
+
+
+class TestNorms(OpTest):
+    def test_layer_norm(self):
+        x = rng.randn(2, 3, 8).astype("f4")
+        w = rng.rand(8).astype("f4")
+        b = rng.rand(8).astype("f4")
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * w + b
+        out = F.layer_norm(paddle.to_tensor(x), normalized_shape=[8],
+                           weight=paddle.to_tensor(w),
+                           bias=paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        x = rng.randn(2, 8).astype("f4")
+        w = rng.rand(8).astype("f4")
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        out = F.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                         epsilon=1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_infer(self):
+        x = rng.randn(4, 3, 5, 5).astype("f4")
+        rm = rng.rand(3).astype("f4")
+        rv = rng.rand(3).astype("f4") + 0.5
+        w = rng.rand(3).astype("f4")
+        b = rng.rand(3).astype("f4")
+        ref = ((x - rm[:, None, None]) / np.sqrt(rv[:, None, None] + 1e-5)
+               * w[:, None, None] + b[:, None, None])
+        out = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(rm),
+                           paddle.to_tensor(rv), weight=paddle.to_tensor(w),
+                           bias=paddle.to_tensor(b), training=False)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestConvPool(OpTest):
+    def test_conv2d_golden(self):
+        # golden via scipy correlate on a tiny case
+        x = rng.randn(1, 1, 5, 5).astype("f4")
+        w = rng.randn(2, 1, 3, 3).astype("f4")
+        from scipy.signal import correlate2d
+        ref = np.stack([correlate2d(x[0, 0], w[o, 0], mode="valid")
+                        for o in range(2)])[None]
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_stride_pad_group_dilation(self):
+        x = rng.randn(2, 4, 9, 9).astype("f4")
+        w = rng.randn(6, 2, 3, 3).astype("f4")
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=2,
+                       padding=1, groups=2)
+        assert out.shape == [2, 6, 5, 5]
+        out2 = F.conv2d(paddle.to_tensor(x),
+                        paddle.to_tensor(rng.randn(6, 4, 3, 3).astype("f4")),
+                        dilation=2)
+        assert out2.shape == [2, 6, 5, 5]
+
+    def test_conv_grad(self):
+        x = rng.randn(1, 1, 4, 4).astype("f4")
+        w = rng.randn(1, 1, 2, 2).astype("f4")
+        self.check_grad(lambda a, b: F.conv2d(a, b), [x, w], rtol=2e-2,
+                        atol=1e-2)
+
+    def test_pools(self):
+        x = rng.randn(1, 2, 4, 4).astype("f4")
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        out = F.max_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2)
+        np.testing.assert_allclose(out.numpy(), ref)
+        ref_avg = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        out = F.avg_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2)
+        np.testing.assert_allclose(out.numpy(), ref_avg, rtol=1e-6)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), output_size=1)
+        np.testing.assert_allclose(out.numpy(),
+                                   x.mean(axis=(2, 3), keepdims=True),
+                                   rtol=1e-6)
+
+    def test_embedding_linear(self):
+        table = rng.randn(10, 4).astype("f4")
+        ids = np.array([[1, 3], [5, 9]])
+        out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(table))
+        np.testing.assert_allclose(out.numpy(), table[ids])
+        x = rng.randn(3, 4).astype("f4")
+        wt = rng.randn(4, 5).astype("f4")
+        b = rng.randn(5).astype("f4")
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(wt),
+                       paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ wt + b, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_dropout_train_eval(self):
+        x = np.ones((100, 100), dtype="f4")
+        out = F.dropout(paddle.to_tensor(x), p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), x)
+        out = F.dropout(paddle.to_tensor(x), p=0.5, training=True)
+        kept = out.numpy() != 0
+        assert 0.3 < kept.mean() < 0.7
+        # upscale_in_train: kept values are x/(1-p)
+        vals = out.numpy()[kept]
+        np.testing.assert_allclose(vals, 2.0, rtol=1e-5)
+
+    def test_pad_interpolate(self):
+        x = rng.randn(1, 1, 3, 3).astype("f4")
+        out = F.pad(paddle.to_tensor(x), [1, 1, 1, 1])
+        assert out.shape == [1, 1, 5, 5]
+        np.testing.assert_allclose(out.numpy()[0, 0, 1:4, 1:4], x[0, 0])
+        up = F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                           mode="nearest")
+        assert up.shape == [1, 1, 6, 6]
+        np.testing.assert_allclose(up.numpy()[0, 0, ::2, ::2], x[0, 0])
