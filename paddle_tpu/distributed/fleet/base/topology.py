@@ -74,7 +74,7 @@ class HybridCommunicateGroup:
 
     def __init__(self, topology):
         self._topo = topology
-        from ..env import get_rank
+        from ...env import get_rank
         self.global_rank = get_rank()
         self._dp_degree = topology.get_dim("data")
         self._pp_degree = topology.get_dim("pipe")

@@ -13,23 +13,31 @@ def wrap_distributed_model(model, strategy, hcg):
     from ...parallel import DataParallel
     if hcg is None:
         return DataParallel(model)
-    h = strategy.hybrid_configs if strategy else {}
+    level = None
+    if strategy is not None and hcg.get_sharding_parallel_world_size() > 1:
+        stage = (strategy.sharding_configs or {}).get("stage", 1)
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os")
     if hcg.get_pipe_parallel_world_size() > 1:
         from .pipeline_parallel import PipelineParallel
         return PipelineParallel(model, hcg, strategy)
     if hcg.get_model_parallel_world_size() > 1:
-        return TensorParallel(model, hcg, strategy)
-    return DataParallel(model)
+        return TensorParallel(model, hcg, strategy, level=level)
+    wrapped = DataParallel(model)
+    from ...engine import plan_from_hcg
+    wrapped._placement_plan = plan_from_hcg(hcg, level=level)
+    return wrapped
 
 
 class TensorParallel(Layer):
     """Marker wrapper: TP layers already carry their sharding rules; this
     wrapper only pins the hcg so the engine builds the right mesh."""
 
-    def __init__(self, layers, hcg, strategy=None):
+    def __init__(self, layers, hcg, strategy=None, level=None):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
+        from ...engine import plan_from_hcg
+        self._placement_plan = plan_from_hcg(hcg, level=level)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

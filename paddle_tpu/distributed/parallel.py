@@ -28,6 +28,9 @@ class DataParallel(Layer):
         self.group = group
         self.find_unused_parameters = find_unused_parameters
         self.is_data_parallel = True
+        if jax.device_count() > 1:
+            from .engine import make_data_parallel_plan
+            self._placement_plan = make_data_parallel_plan()
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

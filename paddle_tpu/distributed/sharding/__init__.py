@@ -21,6 +21,10 @@ class _GroupShardedModel(Layer):
         self._layers = model
         self.sharding_level = level
         self.offload = offload
+        import jax
+        if jax.device_count() > 1:
+            from ..engine import make_data_parallel_plan
+            self._placement_plan = make_data_parallel_plan(level=level)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

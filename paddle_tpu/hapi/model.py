@@ -41,13 +41,22 @@ def _as_list(x):
 
 
 class _CompiledStepper:
-    """Builds & caches the jitted train/eval/predict steps."""
+    """Builds & caches the jitted train/eval/predict steps.
 
-    def __init__(self, network, loss_fn, optimizer, amp_level=None):
+    With a PlacementPlan (fleet/DataParallel/GroupSharded wrappers attach
+    one), state is device_put to its NamedSharding and the step is jitted
+    with in/out shardings — DP/ZeRO/TP become GSPMD placements of the same
+    executable (see distributed/engine.py).
+    """
+
+    def __init__(self, network, loss_fn, optimizer, amp_level=None,
+                 plan=None):
         self.network = network
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.amp_level = amp_level
+        self.plan = plan if plan is not None else getattr(
+            network, "_placement_plan", None)
         self._refresh_state_refs()
         self._train_cache = {}
         self._grad_cache = {}
@@ -56,6 +65,25 @@ class _CompiledStepper:
         self.opt_state = None
         self._accum_grads = None
         self._accum_count = 0
+        if self.plan is not None:
+            self._apply_plan()
+
+    def _apply_plan(self):
+        """device_put every param/buffer onto its planned sharding and
+        precompute the sharding trees the jit calls use."""
+        plan = self.plan
+        self._param_specs = [plan.param_pspec(p) for p in self.params]
+        self._param_shardings = [plan.sharding(s) for s in self._param_specs]
+        for p, s in zip(self.params, self._param_shardings):
+            p._value = jax.device_put(p._value, s)
+        self._buffer_shardings = [plan.replicated() for _ in self.buffers]
+        for b, s in zip(self.buffers, self._buffer_shardings):
+            b._value = jax.device_put(b._value, s)
+
+    def _opt_shardings_for(self, opt_state):
+        t_specs = [self._param_specs[i] for i in self.t_idx]
+        t_shapes = [tuple(self.params[i].shape) for i in self.t_idx]
+        return self.plan.opt_state_shardings(opt_state, t_specs, t_shapes)
 
     def _refresh_state_refs(self):
         self.params = [p for _, p in self.network.named_parameters()]
@@ -158,7 +186,20 @@ class _CompiledStepper:
                     train_vals, grads, opt_state, lr)
             return loss, out_vals, new_train, new_buf, new_opt
 
-        return jax.jit(step, donate_argnums=(0, 2, 3))
+        if self.plan is None:
+            return jax.jit(step, donate_argnums=(0, 2, 3))
+        plan = self.plan
+        t_sh = [self._param_shardings[i] for i in self.t_idx]
+        f_sh = [self._param_shardings[i] for i in range(len(self.params))
+                if i not in set(self.t_idx)]
+        b_sh = list(self._buffer_shardings)
+        o_sh = self._opt_shardings_for(self.opt_state)
+        rep = plan.replicated()
+        return jax.jit(
+            step, donate_argnums=(0, 2, 3),
+            in_shardings=(t_sh, f_sh, b_sh, o_sh, rep, rep,
+                          self._input_shardings, self._label_shardings),
+            out_shardings=(rep, None, t_sh, b_sh, o_sh))
 
     def _build_grad(self):
         """Gradient-only step (no optimizer apply) for accumulation."""
@@ -210,7 +251,12 @@ class _CompiledStepper:
             out_vals, _ = self._forward_pure(param_vals, buffer_vals, key,
                                              inputs, training=False)
             return out_vals
-        return jax.jit(step)
+        if self.plan is None:
+            return jax.jit(step)
+        rep = self.plan.replicated()
+        return jax.jit(step, in_shardings=(
+            list(self._param_shardings), list(self._buffer_shardings), rep,
+            self._input_shardings))
 
     def _shape_key(self, arrays):
         return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
@@ -218,6 +264,11 @@ class _CompiledStepper:
     def train_step(self, inputs, labels, update=True):
         inputs = [_to_jnp(x) for x in _as_list(inputs)]
         labels = [_to_jnp(x) for x in _as_list(labels)]
+        if self.plan is not None:
+            self._input_shardings = [self.plan.input_sharding(a.ndim)
+                                     for a in inputs]
+            self._label_shardings = [self.plan.input_sharding(a.ndim)
+                                     for a in labels]
         key = (self._shape_key(inputs), self._shape_key(labels))
         train_vals = [self.params[i]._value for i in self.t_idx]
         frozen_vals = [p._value for i, p in enumerate(self.params)
@@ -225,6 +276,11 @@ class _CompiledStepper:
         buffer_vals = [b._value for b in self.buffers]
         if self.opt_state is None:
             self.opt_state = self.optimizer.init_functional_state(train_vals)
+            if self.plan is not None:
+                o_sh = self._opt_shardings_for(self.opt_state)
+                self.opt_state = [
+                    {k: jax.device_put(v, s[k]) for k, v in st.items()}
+                    for st, s in zip(self.opt_state, o_sh)]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = next_key()
 
@@ -276,6 +332,9 @@ class _CompiledStepper:
 
     def eval_forward(self, inputs):
         inputs = [_to_jnp(x) for x in _as_list(inputs)]
+        if self.plan is not None:
+            self._input_shardings = [self.plan.input_sharding(a.ndim)
+                                     for a in inputs]
         key = self._shape_key(inputs)
         if key not in self._eval_cache:
             self._eval_cache[key] = self._build_eval(len(inputs))
