@@ -10,3 +10,4 @@ from .fleet import (Fleet, init, distributed_model,  # noqa: F401
                     distributed_optimizer, get_hybrid_communicate_group,
                     worker_num, worker_index, is_first_worker, barrier_worker)
 from . import utils  # noqa: F401
+from . import meta_parallel  # noqa: F401

@@ -6,6 +6,10 @@ facade-level wrap + HybridParallelOptimizer live here.
 """
 from ....nn.layer.layers import Layer
 from ....optimizer.optimizer import Optimizer
+from .parallel_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, get_rng_state_tracker, RNGStatesTracker,
+    model_parallel_random_seed)
 
 
 def wrap_distributed_model(model, strategy, hcg):

@@ -204,7 +204,10 @@ class Tensor:
         if tuple(value.shape) != tuple(self._value.shape):
             raise ValueError(
                 f"set_value shape mismatch {value.shape} vs {self._value.shape}")
-        self._value = value.astype(self._value.dtype)
+        # copy-in semantics: never alias the source's buffer (a shared
+        # buffer would be deleted under the other owner when a jitted step
+        # donates this parameter)
+        self._value = jnp.array(value, dtype=self._value.dtype, copy=True)
 
     def _replace(self, value):
         """Internal: rebind the raw array (optimizer updates)."""

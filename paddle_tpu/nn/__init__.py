@@ -7,6 +7,7 @@ from .layer.common import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403
 from .layer.activation import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue)
 

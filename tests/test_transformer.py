@@ -1,0 +1,96 @@
+"""Transformer + attention tests (reference:
+test/legacy_test/test_multihead_attention* / test_transformer_api.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np_attention(q, k, v, causal=False):
+    B, S, H, D = q.shape
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v).astype("f4")
+
+
+def test_sdpa_matches_numpy():
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 8, 2, 4).astype("f4")
+    k = rng.randn(2, 8, 2, 4).astype("f4")
+    v = rng.randn(2, 8, 2, 4).astype("f4")
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+    np.testing.assert_allclose(out.numpy(), _np_attention(q, k, v),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_causal_and_grad():
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(1, 6, 2, 4).astype("f4"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.randn(1, 6, 2, 4).astype("f4"),
+                         stop_gradient=False)
+    v = paddle.to_tensor(rng.randn(1, 6, 2, 4).astype("f4"),
+                         stop_gradient=False)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    ref = _np_attention(q.numpy(), k.numpy(), v.numpy(), causal=True)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+    # causal: grad of q at pos 0 depends only on k/v[0]
+    assert k.grad is not None
+
+
+def test_sdpa_gqa():
+    rng = np.random.RandomState(2)
+    q = rng.randn(2, 4, 8, 4).astype("f4")
+    k = rng.randn(2, 4, 2, 4).astype("f4")  # 2 kv heads, 8 q heads
+    v = rng.randn(2, 4, 2, 4).astype("f4")
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+    kr = np.repeat(k, 4, axis=2)
+    vr = np.repeat(v, 4, axis=2)
+    np.testing.assert_allclose(out.numpy(), _np_attention(q, kr, vr),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mha_cache_decoding():
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(16, 4)
+    mha.eval()
+    x = paddle.randn([1, 5, 16])
+    causal = nn.Transformer.generate_square_subsequent_mask(5)
+    full = mha(x, x, x, causal)
+    # incremental: feed tokens one at a time with Cache
+    cache = mha.gen_cache(paddle.randn([1, 0, 16]))
+    outs = []
+    for t in range(5):
+        step = x[:, t:t + 1, :]
+        o, cache = mha(step, step, step, None, cache)
+        outs.append(o.numpy())
+    inc = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(inc, full.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_encoder_decoder_shapes_and_grad():
+    paddle.seed(0)
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 7, 16])
+    tgt = paddle.randn([2, 5, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 5, 16]
+    out.mean().backward()
+    grads = [p.grad for p in model.parameters() if p.grad is not None]
+    assert len(grads) > 0
+
+
+def test_generate_square_subsequent_mask():
+    m = nn.Transformer.generate_square_subsequent_mask(4).numpy()
+    assert m[0, 1] < -1e29 and m[1, 0] == 0 and m[3, 3] == 0
