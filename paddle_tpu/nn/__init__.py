@@ -8,6 +8,7 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.activation import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue)
 

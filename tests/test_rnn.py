@@ -1,0 +1,63 @@
+"""RNN layers vs torch goldens (reference tests use numpy rnn_numpy.py
+goldens; torch-cpu is our independent implementation to check against)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _copy_lstm_weights(pt, our, layer=0):
+    our.weight_ih.set_value(pt.weight_ih_l0.detach().numpy())
+    our.weight_hh.set_value(pt.weight_hh_l0.detach().numpy())
+    our.bias_ih.set_value(pt.bias_ih_l0.detach().numpy())
+    our.bias_hh.set_value(pt.bias_hh_l0.detach().numpy())
+
+
+def test_lstm_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 7, 8).astype("f4")
+    pt = torch.nn.LSTM(8, 16, batch_first=True)
+    our = nn.LSTM(8, 16)
+    _copy_lstm_weights(pt, our.cells_fw[0])
+    with torch.no_grad():
+        ref, (h_ref, c_ref) = pt(torch.tensor(x))
+    out, (h, c) = our(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), h_ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), c_ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 4).astype("f4")
+    pt = torch.nn.GRU(4, 6, batch_first=True)
+    our = nn.GRU(4, 6)
+    c = our.cells_fw[0]
+    c.weight_ih.set_value(pt.weight_ih_l0.detach().numpy())
+    c.weight_hh.set_value(pt.weight_hh_l0.detach().numpy())
+    c.bias_ih.set_value(pt.bias_ih_l0.detach().numpy())
+    c.bias_hh.set_value(pt.bias_hh_l0.detach().numpy())
+    with torch.no_grad():
+        ref, h_ref = pt(torch.tensor(x))
+    out, h = our(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bidirectional_and_grad():
+    paddle.seed(0)
+    net = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.rand(4, 5, 8).astype("f4"),
+                         stop_gradient=False)
+    y, (h, c) = net(x)
+    assert y.shape == [4, 5, 32]
+    assert h.shape == [4, 4, 16]  # num_layers * num_directions
+    y.mean().backward()
+    assert x.grad is not None
+    assert net.cells_fw[0].weight_ih.grad is not None
