@@ -1,0 +1,90 @@
+"""SPMD pipeline parity: pipelined stacked blocks == sequential run
+(reference pattern: hybrid_parallel_pp_alexnet.py — PP run equals single
+ -process golden)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.pipeline import (
+    spmd_pipeline, stack_block_params, PipelineStagedModule)
+
+
+def _mesh_pipe(S=4):
+    devs = np.asarray(jax.devices()[:S])
+    return Mesh(devs, ("pipe",))
+
+
+def test_spmd_pipeline_matches_sequential():
+    rng = np.random.RandomState(0)
+    L, M, mb, H = 8, 4, 2, 16   # 8 blocks, 4 stages, 4 microbatches
+    Ws = [rng.randn(H, H).astype("f4") * 0.3 for _ in range(L)]
+    bs = [rng.randn(H).astype("f4") * 0.1 for _ in range(L)]
+    x = rng.randn(M, mb, H).astype("f4")
+
+    def block_apply(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    stacked = stack_block_params([[W, b] for W, b in zip(Ws, bs)])
+    mesh = _mesh_pipe(4)
+    out = spmd_pipeline(block_apply, stacked, jnp.asarray(x), mesh)
+
+    ref = x.copy()
+    for W, b in zip(Ws, bs):
+        ref = np.tanh(ref @ W + b)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_spmd_pipeline_grad_flows():
+    rng = np.random.RandomState(1)
+    L, M, mb, H = 4, 2, 2, 8
+    Ws = [rng.randn(H, H).astype("f4") * 0.3 for _ in range(L)]
+    x = jnp.asarray(rng.randn(M, mb, H).astype("f4"))
+
+    def block_apply(params, h):
+        (W,) = params
+        return jnp.tanh(h @ W)
+
+    stacked = stack_block_params([[W] for W in Ws])
+    mesh = _mesh_pipe(2)
+
+    def loss_fn(stacked_, x_):
+        out = spmd_pipeline(block_apply, stacked_, x_, mesh)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss_fn)(stacked, x)
+    # reference grad via plain sequential computation
+    def ref_loss(stacked_, x_):
+        h = x_
+        for i in range(L):
+            h = jnp.tanh(h @ stacked_[0][i])
+        return jnp.sum(h ** 2)
+    g_ref = jax.grad(ref_loss)(stacked, x)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(g_ref[0]),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_staged_module_gpt_blocks():
+    from paddle_tpu.models.gpt import gpt3_tiny, GPTDecoderLayer
+    paddle.seed(0)
+    cfg = gpt3_tiny()
+    blocks = [GPTDecoderLayer(cfg) for _ in range(4)]
+    for b in blocks:
+        b.eval()
+    mesh = _mesh_pipe(2)
+    staged = PipelineStagedModule(blocks, mesh, remat=False)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 1, 8, cfg.hidden_size).astype("f4")  # (M, mb, S, H)
+    out = staged.apply(staged.stacked, jnp.asarray(x))
+
+    ref = paddle.to_tensor(x.reshape(2, 8, cfg.hidden_size))
+    with paddle.no_grad():
+        for b in blocks:
+            ref = b(ref)
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(2, 8, cfg.hidden_size), ref.numpy(),
+        rtol=1e-4, atol=1e-4)
