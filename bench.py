@@ -1,48 +1,114 @@
 """Benchmark runner — prints ONE JSON line for the driver.
 
-Round 1 metric: LeNet-MNIST Model.fit throughput on the local chip
-(BASELINE config #1); later rounds switch to GPT-1.3B tokens/sec/chip.
-vs_baseline is vs. BASELINE.json's published numbers — none exist
-(published: {}), so it reports 1.0 when the run completes at sane speed.
+Metric: GPT (125M-class) training throughput in tokens/sec/chip on the
+local device — fused fwd+bwd+AdamW in one jitted executable, bf16 compute
+with fp32 master params (the BASELINE GPT workload scaled to one chip;
+later rounds add the 1.3B multi-chip config).  vs_baseline is 1.0 when the
+run completes (BASELINE.json publishes no reference numbers).
 """
 import json
+import math
 import time
 
 import numpy as np
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
-    from paddle_tpu.metric import Accuracy
-    from paddle_tpu.static import InputSpec
-    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.framework import autograd as _ag
+    from paddle_tpu.framework.random import rng_scope
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
 
     paddle.seed(0)
-    net = LeNet()
-    model = paddle.Model(net, inputs=[InputSpec([None, 1, 28, 28],
-                                                "float32", "image")],
-                         labels=[InputSpec([None, 1], "int64", "label")])
-    opt = paddle.optimizer.Adam(learning_rate=1e-3,
-                                parameters=net.parameters())
-    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    on_tpu = jax.default_backend() not in ("cpu",)
+    # 125M-class on the chip; tiny proxy on CPU so the bench always runs
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                        num_hidden_layers=12, num_attention_heads=12,
+                        max_position_embeddings=1024)
+        B, S, iters = 8, 1024, 20
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=256)
+        B, S, iters = 2, 128, 5
 
-    bs = 512
-    x = np.random.rand(bs, 1, 28, 28).astype("float32")
-    y = np.random.randint(0, 10, (bs, 1)).astype("int64")
-    # warmup/compile
-    model.train_batch([x], [y])
-    n = 30
-    t0 = time.perf_counter()
-    for _ in range(n):
-        model.train_batch([x], [y])
-    dt = time.perf_counter() - t0
-    ips = n * bs / dt
+    net = GPTForPretraining(cfg)
+    net.eval()  # dropout off (probs are 0.0 anyway)
+    params = [p for _, p in net.named_parameters()]
+    pvals = [p._value for p in params]
+
+    def forward_pure(pv, ids):
+        olds = [p._value for p in params]
+        for p, v in zip(params, pv):
+            p._value = v
+        try:
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                return net(paddle.Tensor(ids))._value
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+
+    def loss_fn(pv, ids, labels):
+        compute = [v.astype(jnp.bfloat16)
+                   if jnp.issubdtype(v.dtype, jnp.floating) else v
+                   for v in pv]
+        logits = forward_pure(compute, ids).astype(jnp.float32)
+        V = logits.shape[-1]
+        lg = logits[:, :-1, :].reshape(-1, V)
+        lb = labels[:, 1:].reshape(-1)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.take_along_axis(logp, lb[:, None], 1).mean()
+
+    b1, b2, eps, lr, wd = 0.9, 0.95, 1e-8, 1e-4, 0.01
+
+    def step(pv, m, v, t, ids, labels):
+        loss, g = jax.value_and_grad(loss_fn)(pv, ids, labels)
+        t = t + 1
+        new_p, new_m, new_v = [], [], []
+        for p, gi, mi, vi in zip(pv, g, m, v):
+            nmi = b1 * mi + (1 - b1) * gi
+            nvi = b2 * vi + (1 - b2) * gi * gi
+            mhat = nmi / (1 - b1 ** t)
+            vhat = nvi / (1 - b2 ** t)
+            np_ = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p)
+            new_p.append(np_)
+            new_m.append(nmi)
+            new_v.append(nvi)
+        return loss, new_p, new_m, new_v, t
+
+    step_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+    m0 = [jnp.zeros_like(v) for v in pvals]
+    v0 = [jnp.zeros_like(v) for v in pvals]
+    t0 = jnp.zeros((), jnp.int32)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
+
+    loss, pvals, m0, v0, t0 = step_jit(pvals, m0, v0, t0, ids, ids)
+    loss.block_until_ready()  # compile + warmup
+    t_start = time.perf_counter()
+    for _ in range(iters):
+        loss, pvals, m0, v0, t0 = step_jit(pvals, m0, v0, t0, ids, ids)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t_start
+    tokens_per_sec = iters * B * S / dt
+
+    n_params = sum(int(np.prod(v.shape)) for v in pvals)
+    flops_per_tok = 6 * n_params
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = tokens_per_sec * flops_per_tok / peak
+
     print(json.dumps({
-        "metric": "lenet_mnist_train_images_per_sec",
-        "value": round(ips, 1),
-        "unit": "images/sec",
+        "metric": "gpt125m_train_tokens_per_sec_per_chip" if on_tpu
+                  else "gpt_tiny_cpu_proxy_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
         "vs_baseline": 1.0,
+        "extra": {"loss": round(float(loss), 4), "mfu": round(mfu, 4),
+                  "params": n_params, "batch": B, "seq": S},
     }))
 
 

@@ -10,6 +10,7 @@ from .parallel_layers import (  # noqa: F401
     VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
     ParallelCrossEntropy, get_rng_state_tracker, RNGStatesTracker,
     model_parallel_random_seed)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
 
 
 def wrap_distributed_model(model, strategy, hcg):

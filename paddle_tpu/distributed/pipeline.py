@@ -24,11 +24,14 @@ from jax.sharding import PartitionSpec as P
 __all__ = ["spmd_pipeline", "stack_block_params", "PipelineStagedModule"]
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _shard_map(fn, mesh, in_specs, out_specs, axis):
     try:
         from jax import shard_map  # jax >= 0.6 style
+        # manual only over the pipe axis: other mesh axes (data/model/...)
+        # stay under GSPMD so dp/tp compose with the pipeline
         return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+                         out_specs=out_specs, check_vma=False,
+                         axis_names=frozenset({axis}))
     except (ImportError, TypeError):
         from jax.experimental.shard_map import shard_map as sm
         return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -101,7 +104,7 @@ def spmd_pipeline(block_apply, stacked_params, x, mesh, axis="pipe",
         return lax.psum(outputs, axis)
 
     fn = _shard_map(run, mesh, in_specs=(p_specs, x_spec),
-                    out_specs=x_spec)
+                    out_specs=x_spec, axis=axis)
     return fn(params_s, x)
 
 

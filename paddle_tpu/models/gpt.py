@@ -196,6 +196,25 @@ def _remat_block(blk, x):
     return call_op(jax.checkpoint(run), x, *params)
 
 
+def _init_gpt_weights(root, std):
+    """normal(0, initializer_range) for matmul/embedding weights, zero
+    biases, ones for norm scales — the GPT init scheme."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    for name, p in root.named_parameters():
+        shape = tuple(p.shape)
+        if name.endswith("bias") or len(shape) == 0:
+            p._value = jnp.zeros(shape, p.dtype)
+        elif len(shape) == 1:
+            # norm weight
+            if "norm" in name or name.endswith(".weight") and \
+                    "embedding" not in name:
+                p._value = jnp.ones(shape, p.dtype)
+        else:
+            p._value = jnp.asarray(
+                rng.normal(0.0, std, shape).astype("float32"))
+
+
 class GPTForPretraining(nn.Layer):
     """LM head tied to the input embedding (reference: shared weights via
     SharedLayerDesc in PP; here the tie is literal reuse)."""
@@ -204,6 +223,7 @@ class GPTForPretraining(nn.Layer):
         super().__init__()
         self.gpt = GPTModel(config)
         self.config = config
+        _init_gpt_weights(self, config.initializer_range)
 
     def forward(self, input_ids, position_ids=None):
         x = self.gpt(input_ids, position_ids)

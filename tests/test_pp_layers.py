@@ -1,0 +1,78 @@
+"""PipelineLayer/LayerDesc API + PipelineParallel train_batch
+(reference: test/collective/fleet/hybrid_parallel_pp_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, SharedLayerDesc, PipelineLayer)
+
+
+class Block(nn.Layer):
+    def __init__(self, h=16):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return F.relu(self.fc(x))
+
+
+def test_layer_desc_build_and_forward():
+    pl = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16)] +
+               [LayerDesc(Block, 16) for _ in range(4)] +
+               [LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2)
+    x = paddle.randn([2, 8])
+    out = pl(x)
+    assert out.shape == [2, 4]
+    cuts = pl.segment()
+    assert cuts[0] == 0 and cuts[-1] == 6 and len(cuts) == 3
+
+
+def test_homogeneous_run_detection():
+    pl = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16)] +
+               [LayerDesc(Block, 16) for _ in range(4)] +
+               [LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2)
+    head, mid, tail = pl.homogeneous_run()
+    assert len(mid) == 4
+    assert len(head) == 1 and len(tail) == 1
+
+
+def test_shared_layer_desc_ties_weights():
+    pl = PipelineLayer(layers=[
+        SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+        LayerDesc(Block, 8),
+        SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+    ], num_stages=1)
+    layers = [l for l, _ in pl.run_function]
+    assert layers[0] is layers[2], "shared descs must reuse the layer"
+
+
+def test_pipeline_parallel_train_batch():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = fleet.distributed_model(net)
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallel
+    assert isinstance(model, PipelineParallel)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    x = np.random.RandomState(0).rand(8, 8).astype("f4")
+    y = np.random.RandomState(1).rand(8, 4).astype("f4")
+    loss_fn = nn.MSELoss()
+    w_before = net[0].weight.numpy().copy()
+    loss = model.train_batch([x, y], opt, loss_fn=loss_fn)
+    assert np.isfinite(float(loss))
+    assert not np.allclose(net[0].weight.numpy(), w_before), \
+        "optimizer must have stepped"
