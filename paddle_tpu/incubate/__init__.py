@@ -1,5 +1,6 @@
 """Incubating APIs (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
 from .nn.functional import flash_attention  # noqa: F401
 
 

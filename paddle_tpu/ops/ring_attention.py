@@ -54,7 +54,6 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None):
     B, Sl, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
-    k, v = _repeat_kv(q, k, v)
 
     qf = (q.astype(jnp.float32) * scale)
     perm = [(j, (j + 1) % size) for j in range(size)]
@@ -64,7 +63,10 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None):
     def step(i, carry):
         kc, vc, acc, m, l = carry
         src = (rank - i) % size  # origin rank of the KV chunk held now
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32))
+        # GQA/MQA heads repeat LOCALLY per step: the ring carries the
+        # narrow (H_kv) chunks so each ICI hop moves H_kv/H of the bytes
+        kr, vr = _repeat_kv(q, kc, vc)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr.astype(jnp.float32))
         if causal:
             # global positions: q at rank*Sl + qi, k at src*Sl + ki
             keep = (rank * Sl + qi) >= (src * Sl + ki)
@@ -75,7 +77,7 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None):
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+            "bhqk,bkhd->bhqd", p, vr.astype(jnp.float32))
         # rotate KV one hop around the ring for the next step
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
@@ -118,9 +120,12 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
 
     q, k, v = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     if attention_fn is None:
-        from ..nn.functional.attention import _xla_attention
-        attention_fn = lambda a, b, c: _xla_attention(
-            a, b, c, causal=causal, scale=scale)
+        # flash-capable core: Pallas blockwise kernel on TPU for long S
+        # (which is exactly the regime sep parallelism serves), XLA path
+        # elsewhere, with the recompute-based backward
+        from ..nn.functional.attention import _attention_core
+        attention_fn = lambda a, b, c: _attention_core(
+            a, b, c, bool(causal), scale)
     o = attention_fn(q, k, v)
     # (B, S, H/sep, D) -> (B, S/sep, H, D)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
