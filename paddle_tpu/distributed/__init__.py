@@ -16,6 +16,7 @@ from .collective import (all_reduce, all_gather, all_gather_object,  # noqa: F40
 from .parallel import DataParallel  # noqa: F401
 from .mesh import (ProcessMesh, get_mesh, set_mesh, auto_mesh,  # noqa: F401
                    shard_tensor, shard_op, Shard, Replicate, Partial)
+from .store import TCPStore, MasterStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -275,6 +275,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -319,6 +322,27 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
+        # Map-style datasets go through the multiprocess path: fork'd
+        # workers -> collector thread -> native C++ blocking queue
+        # (csrc/blocking_queue.cc) -> here.  Iterable datasets (stateful
+        # iterators don't split across processes) use threaded prefetch.
+        if not self._iterable_mode and self.batch_sampler is not None:
+            from .worker import MultiProcessIter
+            batches = list(self.batch_sampler)  # sampler errors propagate
+            try:
+                it = MultiProcessIter(
+                    self.dataset, batches, self.collate_fn,
+                    self.num_workers, prefetch_factor=self.prefetch_factor,
+                    timeout=self.timeout,
+                    worker_init_fn=self.worker_init_fn)
+            except OSError:  # fork unavailable on this platform
+                it = None
+            if it is not None:
+                try:
+                    yield from it
+                finally:
+                    it._shutdown()  # consumer may abandon the loop early
+                return
         # threaded prefetch: producer threads pull batch indices, push
         # collated batches into a bounded queue
         q = _queue.Queue(maxsize=max(2, self.prefetch_factor *

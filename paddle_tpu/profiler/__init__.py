@@ -62,16 +62,50 @@ _HOST_EVENTS = []
 _COLLECTING = [False]
 
 
+def _native_tracer():
+    from ..framework import native
+    return native.get_lib()
+
+
+def _collect_events():
+    """Merged host spans: native C++ tracer dump + Python fallback list."""
+    events = list(_HOST_EVENTS)
+    lib = _native_tracer()
+    if lib is not None:
+        import ctypes
+        import struct
+        from ..framework import native
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = lib.pt_tracer_dump(ctypes.byref(out))
+        blob = native.take_buffer(lib, out, n)
+        off = 0
+        while off < len(blob):
+            (nl,) = struct.unpack_from("<I", blob, off); off += 4
+            name = blob[off:off + nl].decode(); off += nl
+            (cl,) = struct.unpack_from("<I", blob, off); off += 4
+            cat = blob[off:off + cl].decode(); off += cl
+            t0, t1, _tid = struct.unpack_from("<qqq", blob, off); off += 24
+            events.append(_HostEvent(name, t0, t1, cat))
+    return events
+
+
 class RecordEvent:
-    """Host-span annotation; shows up in jax profiler traces too."""
+    """Host-span annotation (reference: platform/profiler RecordEvent).
+    Collected by the native C++ tracer (csrc/host_tracer.cc) when built,
+    and mirrored into jax profiler traces via TraceAnnotation."""
 
     def __init__(self, name, event_type="UserDefined"):
         self.name = name
         self.event_type = event_type
         self._ann = None
         self._t0 = None
+        self._native_h = 0
 
     def begin(self):
+        lib = _native_tracer()
+        if lib is not None:
+            self._native_h = lib.pt_tracer_span_begin(
+                self.name.encode(), str(self.event_type).encode())
         self._t0 = time.perf_counter_ns()
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
@@ -80,7 +114,10 @@ class RecordEvent:
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
-        if _COLLECTING[0] and self._t0 is not None:
+        if self._native_h:
+            _native_tracer().pt_tracer_span_end(self._native_h)
+            self._native_h = 0
+        elif _COLLECTING[0] and self._t0 is not None:
             _HOST_EVENTS.append(_HostEvent(
                 self.name, self._t0, time.perf_counter_ns(),
                 self.event_type))
@@ -113,6 +150,10 @@ class Profiler:
     def start(self):
         _COLLECTING[0] = True
         _HOST_EVENTS.clear()
+        lib = _native_tracer()
+        if lib is not None:
+            lib.pt_tracer_clear()
+            lib.pt_tracer_enable(1)
         if not self._timer_only:
             try:
                 jax.profiler.start_trace(self._log_dir)
@@ -123,6 +164,9 @@ class Profiler:
 
     def stop(self):
         _COLLECTING[0] = False
+        lib = _native_tracer()
+        if lib is not None:
+            lib.pt_tracer_enable(0)
         if self._running:
             try:
                 jax.profiler.stop_trace()
@@ -151,7 +195,7 @@ class Profiler:
                 time_unit="ms"):
         lines = ["------------------- Profiler Summary -------------------"]
         by_name = {}
-        for e in _HOST_EVENTS:
+        for e in _collect_events():
             d = by_name.setdefault(e.name, [0, 0.0])
             d[0] += 1
             d[1] += (e.end - e.start) / 1e6
@@ -164,7 +208,22 @@ class Profiler:
         return out
 
     def export(self, path=None, format="json"):
-        pass
+        """Write host spans as a chrome://tracing JSON (reference:
+        chrometracinglogger.cc; device-side traces live in the jax
+        profiler log_dir)."""
+        import json as _json
+        import os as _os
+        path = path or _os.path.join(self._log_dir, "host_trace.json")
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        # Always merge via _collect_events: on Linux both clock bases
+        # (perf_counter_ns and C++ steady_clock) are CLOCK_MONOTONIC, so
+        # native and fallback spans align on one timeline.
+        events = [{"name": e.name, "cat": str(e.event_type), "ph": "X",
+                   "ts": e.start / 1e3, "dur": (e.end - e.start) / 1e3,
+                   "pid": 0, "tid": 0} for e in _collect_events()]
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": events}, f)
+        return path
 
     def __enter__(self):
         self.start()
@@ -177,7 +236,9 @@ class Profiler:
 
 def export_chrome_tracing(dir_name, worker_name=None):
     def handler(prof):
-        prof.summary()
+        import os as _os
+        name = worker_name or f"worker_{_os.getpid()}"
+        prof.export(_os.path.join(dir_name, f"{name}.json"))
     return handler
 
 

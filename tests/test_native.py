@@ -1,0 +1,214 @@
+"""Native C++ runtime layer tests: TCPStore, BlockingQueue, host tracer,
+multiprocess DataLoader (paddle_tpu/csrc/; reference:
+paddle/fluid/distributed/store/tcp_store.cc, operators/reader/,
+platform/profiler/host_tracer.cc)."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.io.blocking_queue import BlockingQueue
+
+
+def test_native_library_builds():
+    assert native.available(), "native .so should build with baked-in g++"
+
+
+class TestTCPStore:
+    def test_set_get_roundtrip(self):
+        master = TCPStore(is_master=True, world_size=1)
+        try:
+            master.set("alpha", b"\x00\x01binary")
+            assert master.get("alpha") == b"\x00\x01binary"
+            master.set("s", "text")
+            assert master.get("s") == b"text"
+        finally:
+            master.close()
+
+    def test_get_missing_times_out(self):
+        master = TCPStore(is_master=True, world_size=1)
+        try:
+            with pytest.raises(KeyError):
+                master.get("nope", timeout=0.2)
+        finally:
+            master.close()
+
+    def test_add_counter_and_num_keys(self):
+        master = TCPStore(is_master=True, world_size=1)
+        try:
+            assert master.add("cnt", 1) == 1
+            assert master.add("cnt", 5) == 6
+            assert master.add("cnt", -2) == 4
+            master.set("other", b"x")
+            assert master.num_keys() == 2
+            assert master.delete_key("other")
+            assert not master.delete_key("other")
+        finally:
+            master.close()
+
+    def test_blocking_get_across_clients(self):
+        master = TCPStore(is_master=True, world_size=2)
+        client = TCPStore(host="127.0.0.1", port=master.port,
+                          world_size=2)
+        got = {}
+
+        def getter():
+            got["v"] = client.get("late-key", timeout=5.0)
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.15)  # getter should be blocked server-side
+        master.set("late-key", b"released")
+        t.join(timeout=5)
+        assert got.get("v") == b"released"
+        client.close()
+        master.close()
+
+    def test_barrier(self):
+        master = TCPStore(is_master=True, world_size=3)
+        clients = [TCPStore(port=master.port, world_size=3)
+                   for _ in range(2)]
+        order = []
+
+        def arrive(store, idx, delay):
+            time.sleep(delay)
+            store.barrier("b0", timeout=10.0)
+            order.append(idx)
+
+        threads = [threading.Thread(target=arrive, args=args) for args in
+                   [(master, 0, 0.0), (clients[0], 1, 0.1),
+                    (clients[1], 2, 0.2)]]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(order) == [0, 1, 2]
+        for c in clients:
+            c.close()
+        master.close()
+
+
+class TestBlockingQueue:
+    def test_fifo_and_capacity_backpressure(self):
+        q = BlockingQueue(capacity=2)
+        assert q.push(b"a") and q.push(b"b")
+        assert not q.push(b"c", timeout=0.1)  # full -> timeout
+        assert q.pop() == b"a"
+        assert q.push(b"c")
+        assert q.pop() == b"b" and q.pop() == b"c"
+        q.destroy()
+
+    def test_pop_timeout(self):
+        q = BlockingQueue(capacity=1)
+        with pytest.raises(TimeoutError):
+            q.pop(timeout=0.1)
+        q.destroy()
+
+    def test_close_drains_then_ends(self):
+        q = BlockingQueue(capacity=4)
+        q.push(b"x")
+        q.close()
+        assert q.pop() == b"x"
+        assert q.pop() is None
+        assert not q.push(b"y")
+        q.destroy()
+
+    def test_producer_consumer_threads(self):
+        q = BlockingQueue(capacity=3)
+        n = 50
+        out = []
+
+        def produce():
+            for i in range(n):
+                assert q.push(str(i).encode())
+            q.close()
+
+        t = threading.Thread(target=produce)
+        t.start()
+        while True:
+            item = q.pop(timeout=5.0)
+            if item is None:
+                break
+            out.append(int(item))
+        t.join()
+        assert out == list(range(n))
+        q.destroy()
+
+
+class TestHostTracer:
+    def test_spans_and_chrome_export(self, tmp_path):
+        import paddle_tpu.profiler as profiler
+        prof = profiler.Profiler()
+        with prof:
+            with profiler.RecordEvent("outer_span"):
+                time.sleep(0.01)
+                with profiler.RecordEvent("inner_span"):
+                    time.sleep(0.005)
+        path = prof.export(str(tmp_path / "trace.json"))
+        data = json.loads(open(path).read())
+        names = {e["name"] for e in data["traceEvents"]}
+        assert {"outer_span", "inner_span"} <= names
+        outer = next(e for e in data["traceEvents"]
+                     if e["name"] == "outer_span")
+        assert outer["dur"] >= 10_000 * 0.9  # us
+        summary = prof.summary()
+        assert "outer_span" in summary
+
+
+class _SquareDataset:
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        return np.asarray([i * i], dtype=np.float32), np.asarray(
+            i, dtype=np.int64)
+
+
+class TestMultiProcessDataLoader:
+    def test_parity_with_single_process(self):
+        from paddle_tpu.io import DataLoader
+        ds = _SquareDataset()
+        golden = [tuple(np.asarray(t._value) for t in batch)
+                  for batch in DataLoader(ds, batch_size=5, num_workers=0)]
+        got = [tuple(np.asarray(t._value) for t in batch)
+               for batch in DataLoader(ds, batch_size=5, num_workers=2)]
+        assert len(golden) == len(got) == 8
+        for (gx, gy), (x, y) in zip(golden, got):
+            np.testing.assert_array_equal(gx, x)
+            np.testing.assert_array_equal(gy, y)
+
+    def test_early_break_shuts_down_cleanly(self):
+        from paddle_tpu.io import DataLoader
+        import threading as _threading
+        before = _threading.active_count()
+        for rep in range(3):
+            loader = DataLoader(_SquareDataset(), batch_size=2,
+                                num_workers=2)
+            for i, _ in enumerate(loader):
+                if i == 1:
+                    break
+        import gc
+        gc.collect()
+        deadline = time.monotonic() + 5.0
+        # collector threads must not accumulate across abandoned epochs
+        while (_threading.active_count() > before + 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert _threading.active_count() <= before + 1
+
+    def test_worker_exception_surfaces(self):
+        from paddle_tpu.io import DataLoader
+
+        class Bad(_SquareDataset):
+            def __getitem__(self, i):
+                if i == 11:
+                    raise ValueError("boom at 11")
+                return super().__getitem__(i)
+
+        with pytest.raises(RuntimeError, match="boom at 11"):
+            for _ in DataLoader(Bad(), batch_size=4, num_workers=2):
+                pass
