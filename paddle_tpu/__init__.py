@@ -35,6 +35,9 @@ from . import static  # noqa: F401
 from . import device  # noqa: F401
 from . import profiler  # noqa: F401
 from . import distribution  # noqa: F401
+from . import autograd  # noqa: F401
+from .autograd import PyLayer  # noqa: F401
+from . import fft  # noqa: F401
 from . import incubate  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401

@@ -82,7 +82,7 @@ def suspend_tape():
 class Node:
     """One recorded op: holds the vjp closure and graph edges."""
     __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "single_out",
-                 "__weakref__")
+                 "materialize_grads", "__weakref__")
 
     def __init__(self, vjp, inputs, outputs, single_out):
         self.vjp = vjp
@@ -90,6 +90,10 @@ class Node:
         self.out_refs = [weakref.ref(o) for o in outputs]
         self.out_avals = [(o._value.shape, o._value.dtype) for o in outputs]
         self.single_out = single_out
+        # PyLayer nodes may opt out of zero-materialization for unused
+        # outputs (ctx.set_materialize_grads(False)); jax.vjp closures
+        # always need dense cotangents.
+        self.materialize_grads = True
 
     def release(self):
         self.vjp = None
@@ -211,7 +215,8 @@ def _run_backward(seeds, retain_graph, sink_map):
             c = cots[id(n)][i]
             t = ref()
             if c is None:
-                c = jnp.zeros(aval[0], aval[1])
+                if n.materialize_grads:
+                    c = jnp.zeros(aval[0], aval[1])
             elif t is not None:
                 for h in t._hooks:
                     new = h(t._wrap_grad(c))

@@ -5,35 +5,44 @@ from .nn.functional import flash_attention  # noqa: F401
 
 
 class autograd:
-    """paddle.incubate.autograd compat — forward-mode via jax.jvp."""
+    """paddle.incubate.autograd compat (reference:
+    python/paddle/incubate/autograd/) — functional transforms over the
+    framework's Tensor facade, delegating to paddle_tpu.autograd."""
 
     @staticmethod
     def jvp(func, xs, v=None):
-        import jax
-        from ..framework.core import Tensor
-        xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
-        vals = [x._value for x in xs_t]
-        tangents = [t._value for t in (v if isinstance(v, (list, tuple))
-                                       else [v])] if v is not None else \
-            [jax.numpy.ones_like(x) for x in vals]
-
-        def f(*a):
-            out = func(*[Tensor(x) for x in a])
-            return out._value if isinstance(out, Tensor) else out
-        y, jv = jax.jvp(f, tuple(vals), tuple(tangents))
-        return Tensor(y), Tensor(jv)
+        from ..autograd import jvp as _jvp
+        return _jvp(func, xs, v)
 
     @staticmethod
     def vjp(func, xs, v=None):
-        import jax
-        from ..framework.core import Tensor
-        xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
-        vals = [x._value for x in xs_t]
+        from ..autograd import vjp as _vjp
+        return _vjp(func, xs, v)
 
-        def f(*a):
-            out = func(*[Tensor(x) for x in a])
-            return out._value if isinstance(out, Tensor) else out
-        y, pullback = jax.vjp(f, *vals)
-        ct = v._value if v is not None else jax.numpy.ones_like(y)
-        grads = pullback(ct)
-        return Tensor(y), [Tensor(g) for g in grads]
+    @staticmethod
+    def Jacobian(func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not supported; vmap the per-sample "
+                "jacobian instead (jax.vmap(jax.jacrev(f)))")
+        from ..autograd import jacobian as _jac
+        return _jac(func, xs)
+
+    @staticmethod
+    def jacobian(func, xs, create_graph=False, allow_unused=False):
+        from ..autograd import jacobian as _jac
+        return _jac(func, xs, create_graph, allow_unused)
+
+    @staticmethod
+    def Hessian(func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not supported; vmap the per-sample "
+                "hessian instead (jax.vmap(jax.hessian(f)))")
+        from ..autograd import hessian as _hes
+        return _hes(func, xs)
+
+    @staticmethod
+    def hessian(func, xs, create_graph=False, allow_unused=False):
+        from ..autograd import hessian as _hes
+        return _hes(func, xs, create_graph, allow_unused)

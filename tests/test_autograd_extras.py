@@ -1,0 +1,308 @@
+"""PyLayer custom functions, paddle.fft, LBFGS, functional jacobian/hessian.
+
+Reference test analogues: test/legacy_test/test_pylayer_op.py,
+test_fft.py, test_lbfgs.py, test_autograd_functional.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestPyLayer:
+    def test_forward_backward(self):
+        class CusTanh(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.tanh(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                y, = ctx.saved_tensor()
+                return dy * (1 - paddle.square(y))
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(3, 4).astype("float32"))
+        x.stop_gradient = False
+        y = CusTanh.apply(x)
+        loss = paddle.sum(y)
+        loss.backward()
+        ref = 1 - np.tanh(np.asarray(x.numpy())) ** 2
+        np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_matches_builtin_grad(self):
+        class Square(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor()
+                return 2.0 * dy * x
+
+        x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        x.stop_gradient = False
+        z = paddle.sum(Square.apply(x) * 3.0)
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 6.0 * x.numpy(), rtol=1e-6)
+
+    def test_multiple_inputs_and_none_grad(self):
+        class MulAdd(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, da_out, db_out):
+                a, b = ctx.saved_tensor()
+                return da_out * b + db_out, None
+
+        a = paddle.to_tensor(np.ones((2, 2), "float32") * 2)
+        b = paddle.to_tensor(np.ones((2, 2), "float32") * 5)
+        a.stop_gradient = False
+        b.stop_gradient = False
+        y1, y2 = MulAdd.apply(a, b)
+        loss = paddle.sum(y1) + paddle.sum(y2)
+        loss.backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.full((2, 2), 6.0))
+        np.testing.assert_allclose(b.grad.numpy(), np.zeros((2, 2)))
+
+    def test_identity_forward_no_self_cycle(self):
+        # forward returning its input unchanged must not self-cycle the tape
+        class GradReverse(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x
+
+            @staticmethod
+            def backward(ctx, dy):
+                return -dy
+
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        x.stop_gradient = False
+        y = GradReverse.apply(x)
+        paddle.sum(y).backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(), -np.ones(3))
+
+    def test_traced_custom_vjp(self):
+        # straight-through estimator must survive jit/to_static tracing
+        class RoundSTE(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return paddle.round(x)
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy
+
+        import jax
+        from paddle_tpu.framework import autograd as _ag
+        from paddle_tpu.framework.core import Tensor
+
+        def vf(v):
+            with _ag.suspend_tape():
+                out = RoundSTE.apply(Tensor(v))
+            return jax.numpy.sum(out._value)
+
+        g = jax.grad(vf)(np.array([0.4, 1.6], "float32"))
+        np.testing.assert_allclose(np.asarray(g), [1.0, 1.0])
+
+    def test_set_materialize_grads_false(self):
+        seen = {}
+
+        class TwoOut(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.set_materialize_grads(False)
+                return x * 2.0, x * 3.0
+
+            @staticmethod
+            def backward(ctx, d1, d2):
+                seen["d2"] = d2
+                g = d1 * 2.0
+                if d2 is not None:
+                    g = g + d2 * 3.0
+                return g
+
+        x = paddle.to_tensor(np.ones(2, "float32"))
+        x.stop_gradient = False
+        y1, _y2 = TwoOut.apply(x)
+        paddle.sum(y1).backward()  # only y1 used → d2 should arrive as None
+        assert seen["d2"] is None
+        np.testing.assert_allclose(x.grad.numpy(), np.full(2, 2.0))
+
+    def test_no_grad_path(self):
+        class Id(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 1.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy
+
+        x = paddle.to_tensor([1.0, 2.0])
+        y = Id.apply(x)  # stop_gradient input → no node
+        assert y.stop_gradient
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(1).randn(8, 16).astype("float32")
+        t = paddle.to_tensor(x)
+        out = paddle.fft.ifft(paddle.fft.fft(t)).numpy()
+        np.testing.assert_allclose(out.real, x, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_vs_numpy(self, norm):
+        x = np.random.RandomState(2).randn(4, 8).astype("float32")
+        got = paddle.fft.fft(paddle.to_tensor(x), norm=norm).numpy()
+        ref = np.fft.fft(x, norm=norm)
+        np.testing.assert_allclose(got, ref.astype(got.dtype), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(3).randn(6, 10).astype("float32")
+        f = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(f.numpy(), np.fft.rfft(x).astype("complex64"),
+                                   rtol=1e-4, atol=1e-5)
+        back = paddle.fft.irfft(f, n=10).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_fftn(self):
+        x = np.random.RandomState(4).randn(3, 8, 8).astype("float32")
+        got2 = paddle.fft.fft2(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got2, np.fft.fft2(x).astype("complex64"),
+                                   rtol=1e-4, atol=1e-4)
+        gotn = paddle.fft.fftn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(gotn, np.fft.fftn(x).astype("complex64"),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_hfft_ihfft(self):
+        x = np.random.RandomState(5).randn(9).astype("float32")
+        spec = np.fft.ihfft(x)
+        got = paddle.fft.ihfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, spec.astype("complex64"), rtol=1e-4,
+                                   atol=1e-5)
+        back = paddle.fft.hfft(paddle.to_tensor(got), n=9).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    def test_ihfftn_s_shorter_than_ndim(self):
+        # axes=None + s=[n] must transform only the LAST len(s) axes
+        x = np.random.RandomState(8).randn(4, 6).astype("float32")
+        got = paddle.fft.ihfftn(paddle.to_tensor(x), s=[6]).numpy()
+        ref = np.fft.ihfft(x, n=6, axis=-1)
+        np.testing.assert_allclose(got, ref.astype("complex64"), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fftshift_fftfreq(self):
+        f = paddle.fft.fftfreq(8, d=0.5).numpy()
+        np.testing.assert_allclose(f, np.fft.fftfreq(8, d=0.5).astype(f.dtype))
+        x = np.arange(8, dtype="float32")
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+            np.fft.fftshift(x))
+
+    def test_fft_grad(self):
+        x = np.random.RandomState(6).randn(8).astype("float32")
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        y = paddle.fft.rfft(t)
+        loss = paddle.sum(paddle.abs(y) ** 2)
+        loss.backward()
+        # Parseval: d/dx sum|rfft(x)|^2 — finite-difference check
+        g = t.grad.numpy()
+        eps = 1e-3
+        num = np.zeros_like(x)
+        for i in range(x.size):
+            xp = x.copy(); xp[i] += eps
+            xm = x.copy(); xm[i] -= eps
+            fp = np.sum(np.abs(np.fft.rfft(xp)) ** 2)
+            fm = np.sum(np.abs(np.fft.rfft(xm)) ** 2)
+            num[i] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-2)
+
+
+class TestLBFGS:
+    @pytest.mark.parametrize("line_search", [None, "strong_wolfe"])
+    def test_quadratic_convergence(self, line_search):
+        # minimize ||A w - b||^2 — LBFGS should reach the lstsq solution
+        rng = np.random.RandomState(7)
+        A = rng.randn(12, 4).astype("float32")
+        b = rng.randn(12).astype("float32")
+        w = paddle.to_tensor(np.zeros(4, "float32"))
+        w.stop_gradient = False
+        At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                     parameters=[w],
+                                     line_search_fn=line_search)
+
+        def closure():
+            opt.clear_grad()
+            r = paddle.matmul(At, w) - bt
+            loss = paddle.sum(r * r)
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            opt.step(closure)
+        ref = np.linalg.lstsq(A, b, rcond=None)[0]
+        np.testing.assert_allclose(w.numpy(), ref, rtol=1e-3, atol=1e-3)
+
+
+class TestFunctionalAutograd:
+    def test_jacobian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+
+        def f(x):
+            return paddle.sum(x * x)
+
+        j = paddle.autograd.jacobian(f, x)
+        np.testing.assert_allclose(j.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+
+        def f(x):
+            return paddle.sum(x * x * x)
+
+        h = paddle.autograd.hessian(f, x)
+        np.testing.assert_allclose(h.numpy(), np.diag(6 * x.numpy()),
+                                   rtol=1e-5)
+
+    def test_jacobian_tuple_output(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+
+        def f(x):
+            return x * x, x + 1.0
+
+        j1, j2 = paddle.autograd.jacobian(f, x)
+        np.testing.assert_allclose(j1.numpy(), np.diag(2 * x.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(j2.numpy(), np.eye(2), rtol=1e-5)
+
+    def test_jvp_vjp(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        v = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+
+        def f(x):
+            return x * x
+
+        _, jv = paddle.autograd.jvp(f, x, v)
+        np.testing.assert_allclose(jv.numpy(), [2.0, 0.0], rtol=1e-5)
+        _, gx = paddle.autograd.vjp(f, x, v)
+        np.testing.assert_allclose(gx.numpy(), [2.0, 0.0], rtol=1e-5)
+
+    def test_backward_multi_root(self):
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        x.stop_gradient = False
+        y1 = paddle.sum(x * 2.0)
+        y2 = paddle.sum(x * 3.0)
+        paddle.autograd.backward([y1, y2])
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 5.0))
