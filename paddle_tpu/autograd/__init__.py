@@ -233,6 +233,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     grad_tensors = (grad_tensors if isinstance(grad_tensors, (list, tuple))
                     else [grad_tensors])
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"grad_tensors has {len(grad_tensors)} entries but tensors has "
+            f"{len(tensors)}; they must match one-to-one")
     seeds = {}
     for t, g in zip(tensors, grad_tensors):
         gv = jnp.ones_like(t._value) if g is None else g._value

@@ -63,16 +63,8 @@ fft2 = _2d(jnp.fft.fft2)
 ifft2 = _2d(jnp.fft.ifft2)
 
 
-def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    norm = _check_norm(norm)
-    return call_op(lambda v: jnp.fft.rfft2(v, s=s, axes=tuple(axes), norm=norm),
-                   ensure_tensor(x))
-
-
-def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    norm = _check_norm(norm)
-    return call_op(lambda v: jnp.fft.irfft2(v, s=s, axes=tuple(axes), norm=norm),
-                   ensure_tensor(x))
+rfft2 = _2d(jnp.fft.rfft2)
+irfft2 = _2d(jnp.fft.irfft2)
 
 
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
@@ -128,7 +120,11 @@ def _ihfftn_impl(v, s, axes, norm):
     lead_axes, last_axis = axes[:-1], axes[-1]
     out = jnp.fft.ihfft(v, n=s[-1], axis=last_axis, norm=norm)
     if lead_axes:
-        out = jnp.fft.ifftn(out, axes=lead_axes, norm=norm)
+        lead_s = s[:-1]
+        if any(n is not None for n in lead_s):
+            out = jnp.fft.ifftn(out, s=lead_s, axes=lead_axes, norm=norm)
+        else:
+            out = jnp.fft.ifftn(out, axes=lead_axes, norm=norm)
     return out
 
 

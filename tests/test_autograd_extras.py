@@ -306,3 +306,20 @@ class TestFunctionalAutograd:
         y2 = paddle.sum(x * 3.0)
         paddle.autograd.backward([y1, y2])
         np.testing.assert_allclose(x.grad.numpy(), np.full(3, 5.0))
+
+    def test_backward_mismatched_grad_tensors_raises(self):
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        x.stop_gradient = False
+        y1 = paddle.sum(x * 2.0)
+        y2 = paddle.sum(x * 3.0)
+        g = paddle.to_tensor(np.float32(1.0))
+        with pytest.raises(ValueError):
+            paddle.autograd.backward([y1, y2], g)
+
+    def test_ihfftn_leading_s_crop(self):
+        x = np.random.RandomState(9).randn(8, 8).astype("float32")
+        got = paddle.fft.ihfftn(paddle.to_tensor(x), s=[4, 6]).numpy()
+        ref = np.fft.ifftn(np.fft.ihfft(x, n=6, axis=-1), s=[4], axes=[0])
+        assert got.shape == (4, 4)
+        np.testing.assert_allclose(got, ref.astype("complex64"), rtol=1e-4,
+                                   atol=1e-5)

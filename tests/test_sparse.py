@@ -1,0 +1,333 @@
+"""paddle.sparse: COO/CSR tensors, ops, and sparse nn layers.
+
+Reference analogues: test/legacy_test/test_sparse_*_op.py
+(utils/conv/norm/matmul/softmax...).  Goldens are dense numpy computations
+masked to the sparsity pattern.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_coo(shape, nnz, seed=0, dense_dims=0):
+    rng = np.random.RandomState(seed)
+    sparse_shape = shape[:len(shape) - dense_dims]
+    flat = rng.choice(int(np.prod(sparse_shape)), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, sparse_shape)).astype("int32")
+    vals = rng.randn(nnz, *shape[len(sparse_shape):]).astype("float32")
+    return idx, vals
+
+
+class TestCreationConversion:
+    def test_coo_roundtrip(self):
+        idx, vals = _random_coo((4, 5), 6)
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+        dense = np.zeros((4, 5), "float32")
+        dense[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+        assert st.nnz() == 6 and st.is_sparse_coo()
+
+    def test_coo_duplicate_coalesce(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]], "int32")
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (2, 3)).coalesce()
+        dense = st.to_dense().numpy()
+        assert dense[0, 1] == pytest.approx(3.0)
+        assert dense[1, 2] == pytest.approx(3.0)
+
+    def test_csr_roundtrip(self):
+        crows = np.array([0, 2, 3, 3], "int32")
+        cols = np.array([0, 2, 1], "int32")
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        st = sparse.sparse_csr_tensor(crows, cols, vals, (3, 3))
+        ref = np.array([[1, 0, 2], [0, 3, 0], [0, 0, 0]], "float32")
+        np.testing.assert_allclose(st.to_dense().numpy(), ref)
+
+    def test_coo_csr_conversions(self):
+        idx, vals = _random_coo((5, 7), 9, seed=1)
+        coo = sparse.sparse_coo_tensor(idx, vals, (5, 7))
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(),
+                                   coo.to_dense().numpy())
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(),
+                                   coo.to_dense().numpy())
+
+    def test_dense_values_dims(self):
+        idx, vals = _random_coo((3, 4, 2), 5, seed=2, dense_dims=1)
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 4, 2))
+        assert st.sparse_dim() == 2 and st.dense_dim() == 1
+        dense = np.zeros((3, 4, 2), "float32")
+        dense[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+
+
+class TestElementwise:
+    def test_unary_ops(self):
+        idx, vals = _random_coo((4, 4), 5, seed=3)
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 4))
+        got = sparse.tanh(st)
+        np.testing.assert_allclose(got.values().numpy(), np.tanh(vals),
+                                   rtol=1e-6)
+        got2 = sparse.scale(st, 2.0, 1.0)
+        np.testing.assert_allclose(got2.values().numpy(), vals * 2 + 1,
+                                   rtol=1e-6)
+
+    def test_binary_same_pattern(self):
+        idx, vals = _random_coo((4, 4), 5, seed=4)
+        a = sparse.sparse_coo_tensor(idx, vals, (4, 4))
+        b = sparse.sparse_coo_tensor(idx, vals * 2, (4, 4))
+        got = sparse.add(a, b)
+        np.testing.assert_allclose(got.to_dense().numpy(),
+                                   a.to_dense().numpy() * 3, rtol=1e-6)
+
+    def test_binary_mismatched_pattern_falls_back_dense(self):
+        x = sparse.sparse_coo_tensor(np.array([[0], [0]], "int32"),
+                                     np.array([1.0], "float32"), (2, 2))
+        y = sparse.sparse_coo_tensor(np.array([[1], [1]], "int32"),
+                                     np.array([2.0], "float32"), (2, 2))
+        got = sparse.add(x, y)
+        got_dense = got.numpy() if hasattr(got, "is_sparse_coo") \
+            else np.asarray(got._value)
+        np.testing.assert_allclose(got_dense,
+                                   np.array([[1, 0], [0, 2]], "float32"))
+
+    def test_grad_flows_to_values(self):
+        idx, vals = _random_coo((3, 3), 4, seed=5)
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 3),
+                                      stop_gradient=False)
+        out = paddle.sum(sparse.square(st).to_dense())
+        out.backward()
+        np.testing.assert_allclose(st.grad.numpy(), 2 * vals, rtol=1e-5)
+
+
+class TestMatmul:
+    def test_coo_matmul_dense(self):
+        idx, vals = _random_coo((4, 6), 8, seed=6)
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 6))
+        y = np.random.RandomState(7).randn(6, 3).astype("float32")
+        got = sparse.matmul(st, paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(got, st.to_dense().numpy() @ y, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_csr_matmul_grad(self):
+        crows = np.array([0, 1, 3], "int32")
+        cols = np.array([1, 0, 2], "int32")
+        vals = np.array([2.0, 1.0, -1.0], "float32")
+        st = sparse.sparse_csr_tensor(crows, cols, vals, (2, 3),
+                                      stop_gradient=False)
+        y = paddle.to_tensor(np.ones((3, 2), "float32"))
+        y.stop_gradient = False
+        out = sparse.matmul(st, y)
+        paddle.sum(out).backward()
+        # d(sum)/d(vals[e]) = sum_j y[col_e, j] = 2 for all-ones y
+        np.testing.assert_allclose(st.grad.numpy(), np.full(3, 2.0))
+        ref_dy = st.to_dense().numpy().T @ np.ones((2, 2), "float32")
+        np.testing.assert_allclose(y.grad.numpy(), ref_dy)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(4, 5).astype("float32")
+        y = rng.randn(5, 4).astype("float32")
+        idx, _ = _random_coo((4, 4), 6, seed=9)
+        mask = sparse.sparse_coo_tensor(idx, np.ones(6, "float32"), (4, 4))
+        got = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        full = x @ y
+        np.testing.assert_allclose(got.values().numpy(),
+                                   full[idx[0], idx[1]], rtol=1e-5)
+
+    def test_mv_addmm(self):
+        idx, vals = _random_coo((3, 4), 5, seed=10)
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+        v = np.random.RandomState(11).randn(4).astype("float32")
+        np.testing.assert_allclose(sparse.mv(st, paddle.to_tensor(v)).numpy(),
+                                   st.to_dense().numpy() @ v, rtol=1e-5)
+        inp = np.ones((3, 2), "float32")
+        y = np.random.RandomState(12).randn(4, 2).astype("float32")
+        got = sparse.addmm(paddle.to_tensor(inp), st, paddle.to_tensor(y),
+                           beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(
+            got, 0.5 * inp + 2.0 * (st.to_dense().numpy() @ y), rtol=1e-5)
+
+
+class TestSoftmax:
+    def test_csr_softmax_matches_dense(self):
+        crows = np.array([0, 2, 4], "int32")
+        cols = np.array([0, 2, 1, 3], "int32")
+        vals = np.array([1.0, 2.0, -1.0, 0.5], "float32")
+        st = sparse.sparse_csr_tensor(crows, cols, vals, (2, 4))
+        got = sparse.softmax(st)
+        # dense ref: softmax over the nonzeros of each row
+        r0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+        r1 = np.exp([-1.0, 0.5]) / np.exp([-1.0, 0.5]).sum()
+        np.testing.assert_allclose(got.values().numpy(),
+                                   np.concatenate([r0, r1]).astype("float32"),
+                                   rtol=1e-6)
+
+
+class TestSparseNN:
+    def test_subm_conv3d_matches_masked_dense(self):
+        import paddle_tpu.sparse.nn as spnn
+        rng = np.random.RandomState(13)
+        shape = (1, 4, 4, 4, 2)   # NDHWC
+        idx, vals = _random_coo(shape, 6, seed=13, dense_dims=1)
+        st = sparse.sparse_coo_tensor(idx, vals, shape)
+        conv = spnn.SubmConv3D(2, 3, kernel_size=3)
+        out = conv(st)
+        assert out.shape == [1, 4, 4, 4, 3]
+        # submanifold: output sites == input sites
+        np.testing.assert_array_equal(np.asarray(out._indices),
+                                      np.asarray(st._indices))
+        # values equal dense conv (stride1 same-pad) gathered at sites
+        import jax, jax.numpy as jnp
+        dense = st.to_dense().numpy()
+        ref_full = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight._value, (1, 1, 1),
+            [(1, 1)] * 3,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                dense.shape, conv.weight._value.shape,
+                ("NDHWC", "DHWIO", "NDHWC")))
+        ref_vals = np.asarray(ref_full)[tuple(np.asarray(st._indices))] + \
+            np.asarray(conv.bias._value)
+        np.testing.assert_allclose(out.values().numpy(), ref_vals, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conv3d_output_sites_and_grad(self):
+        import paddle_tpu.sparse.nn as spnn
+        shape = (1, 4, 4, 4, 1)
+        idx, vals = _random_coo(shape, 4, seed=14, dense_dims=1)
+        st = sparse.sparse_coo_tensor(idx, vals, shape, stop_gradient=False)
+        conv = spnn.Conv3D(1, 2, kernel_size=2, stride=2)
+        out = conv(st)
+        assert out.shape == [1, 2, 2, 2, 2]
+        loss = paddle.sum(out.values())
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert st.grad is not None
+
+    def test_sparse_batchnorm(self):
+        import paddle_tpu.sparse.nn as spnn
+        shape = (2, 3, 3, 3, 4)
+        idx, vals = _random_coo(shape, 10, seed=15, dense_dims=1)
+        st = sparse.sparse_coo_tensor(idx, vals, shape)
+        bn = spnn.BatchNorm(4)
+        bn.train()
+        out = bn(st)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(0), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(v.std(0), np.ones(4), atol=1e-2)
+
+    def test_batchnorm_train_grad_through_stats(self):
+        # sum(BN(v)) has ~zero gradient wrt v (mean subtraction cancels);
+        # stats must be differentiated through, not treated as constants
+        import paddle_tpu.sparse.nn as spnn
+        shape = (1, 3, 3, 3, 2)
+        idx, vals = _random_coo(shape, 8, seed=22, dense_dims=1)
+        st = sparse.sparse_coo_tensor(idx, vals, shape, stop_gradient=False)
+        bn = spnn.BatchNorm(2)
+        bn.train()
+        out = bn(st)
+        paddle.sum(out.values()).backward()
+        np.testing.assert_allclose(st.grad.numpy(), np.zeros_like(vals),
+                                   atol=1e-4)
+
+    def test_subm_conv2d_even_kernel_boundary(self):
+        # even kernel: output grid must still equal input grid (asymmetric
+        # same-padding); a site at the far corner must see its own window
+        import paddle_tpu.sparse.nn as spnn
+        import jax, jax.numpy as jnp
+        idx = np.array([[0], [3], [3]], "int32")  # N,H,W site at (3,3)
+        vals = np.ones((1, 2), "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 2))
+        conv = spnn.SubmConv2D(2, 3, kernel_size=2, bias_attr=False)
+        out = conv(st)
+        w = np.asarray(conv.weight._value)  # [2,2,in,out]
+        # with pad (0,1) both dims, output[3,3] window covers only (3,3)
+        # through w[0,0]
+        ref = vals[0] @ w[0, 0]
+        np.testing.assert_allclose(out.values().numpy()[0], ref, rtol=1e-5)
+
+    def test_maxpool_overlapping_windows(self):
+        # stride < kernel: one active voxel feeds several output windows
+        import paddle_tpu.sparse.nn as spnn
+        idx = np.array([[0], [2], [1], [1]], "int32")
+        vals = np.ones((1, 1), "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (1, 5, 3, 3, 1))
+        pool = spnn.MaxPool3D(kernel_size=3, stride=1)
+        out = pool(st)
+        # output spatial (3,1,1); windows d=0,1,2 all cover input d=2
+        assert out.nnz() == 3
+        np.testing.assert_allclose(out.values().numpy(),
+                                   np.ones((3, 1), "float32"))
+
+    def test_relu_layer(self):
+        import paddle_tpu.sparse.nn as spnn
+        idx, vals = _random_coo((3, 3), 4, seed=16)
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 3))
+        out = spnn.ReLU()(st)
+        np.testing.assert_allclose(out.values().numpy(),
+                                   np.maximum(vals, 0))
+
+    def test_maxpool3d(self):
+        import paddle_tpu.sparse.nn as spnn
+        shape = (1, 4, 4, 4, 1)
+        idx, vals = _random_coo(shape, 5, seed=17, dense_dims=1)
+        vals = np.abs(vals) + 0.1  # positive so max over window is a site
+        st = sparse.sparse_coo_tensor(idx, vals, shape)
+        pool = spnn.MaxPool3D(kernel_size=2, stride=2)
+        out = pool(st)
+        assert out.shape == [1, 2, 2, 2, 1]
+        dense_ref = st.to_dense().numpy().reshape(1, 2, 2, 2, 2, 2, 2, 1)
+        # windows with a site must match dense pooling at those coords
+        got_dense = np.zeros((1, 2, 2, 2, 1), "float32")
+        oc = np.asarray(out._indices)
+        got_dense[oc[0], oc[1], oc[2], oc[3]] = out.values().numpy()
+        ref = st.to_dense().numpy()
+        for b, d, h, w in zip(*[oc[i] for i in range(4)]):
+            win = ref[b, 2*d:2*d+2, 2*h:2*h+2, 2*w:2*w+2, 0]
+            assert got_dense[b, d, h, w, 0] == pytest.approx(win.max())
+
+
+class TestTransforms:
+    def test_transpose(self):
+        idx, vals = _random_coo((3, 5), 6, seed=18)
+        st = sparse.sparse_coo_tensor(idx, vals, (3, 5))
+        got = sparse.transpose(st, [1, 0])
+        np.testing.assert_allclose(got.to_dense().numpy(),
+                                   st.to_dense().numpy().T)
+
+    def test_reshape(self):
+        idx, vals = _random_coo((4, 6), 7, seed=19)
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 6))
+        got = sparse.reshape(st, [2, -1])
+        np.testing.assert_allclose(got.to_dense().numpy(),
+                                   st.to_dense().numpy().reshape(2, 12))
+
+    def test_sum(self):
+        idx, vals = _random_coo((4, 6), 7, seed=20)
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 6))
+        np.testing.assert_allclose(sparse.sum(st).numpy(), vals.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(sparse.sum(st, axis=1).numpy(),
+                                   st.to_dense().numpy().sum(1), rtol=1e-5)
+
+    def test_attention(self):
+        import paddle_tpu.sparse.nn as spnn
+        rng = np.random.RandomState(21)
+        q = rng.randn(4, 8).astype("float32")
+        k = rng.randn(4, 8).astype("float32")
+        v = rng.randn(4, 8).astype("float32")
+        # full mask → must equal dense attention
+        ii, jj = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
+        idx = np.stack([ii.ravel(), jj.ravel()]).astype("int32")
+        mask = sparse.sparse_coo_tensor(idx, np.ones(16, "float32"), (4, 4))
+        got = spnn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask).numpy()
+        scores = (q @ k.T) / np.sqrt(8)
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, probs @ v, rtol=1e-4, atol=1e-5)
