@@ -37,6 +37,8 @@ from . import profiler  # noqa: F401
 from . import distribution  # noqa: F401
 from . import autograd  # noqa: F401
 from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
 from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
 from . import incubate  # noqa: F401

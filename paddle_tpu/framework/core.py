@@ -86,6 +86,17 @@ class Tensor:
         self.persistable = False
         self.trainable = not stop_gradient
 
+    def __deepcopy__(self, memo):
+        """Deep copy shares the immutable jax.Array value but detaches from
+        the tape (fresh wrapper identity, no node/grad)."""
+        new = Tensor(self._value, stop_gradient=self.stop_gradient,
+                     name=self.name)
+        memo[id(self)] = new
+        new.persistable = self.persistable
+        new.trainable = self.trainable
+        new.__dict__.update(self.__dict__)
+        return new
+
     # -- basic properties ---------------------------------------------------
     @property
     def shape(self):

@@ -239,8 +239,12 @@ def save(layer, path, input_spec=None, **configs):
         for s in specs]
     pv = [p._value for p in params]
     bv = [b._value for b in buffers]
-    lowered = jax.jit(pure).lower(pv, bv, *arg_shapes)
-    stablehlo = lowered.as_text(dialect="stablehlo")
+    # single trace: jax.export carries both the portable executable bytes
+    # (the load path) and the StableHLO module text — the .pdmodel text is
+    # the human-inspectable "program" like the reference's protobuf
+    exported = jax.export.export(jax.jit(pure))(pv, bv, *arg_shapes)
+    stablehlo = exported.mlir_module()
+    exported_bytes = exported.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "w") as f:
         f.write(stablehlo)
@@ -252,6 +256,7 @@ def save(layer, path, input_spec=None, **configs):
                     zip(bnames, buffers)},
         "input_specs": [(s.shape, str(np.dtype(s.dtype)), s.name)
                         for s in specs],
+        "exported": bytes(exported_bytes),
     }
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(meta, f, protocol=4)
@@ -276,45 +281,24 @@ class TranslatedLayer(Layer):
         return jax.tree.map(Tensor, out)
 
 
-def load(path, **configs):
-    with open(path + ".pdiparams", "rb") as f:
+def load(path, params_path=None, **configs):
+    """Load a jit.save artifact as an inference-only TranslatedLayer.
+
+    Executes the serialized jax.export bytes (versioned StableHLO), so no
+    Python source of the original model is needed — the analogue of the
+    reference loading .pdmodel into a TranslatedLayer
+    (python/paddle/jit/translated_layer.py)."""
+    with open(params_path or (path + ".pdiparams"), "rb") as f:
         meta = pickle.load(f)
     params = [jnp.asarray(meta["params"][n]) for n in meta["param_names"]]
     buffers = [jnp.asarray(meta["buffers"][n]) for n in meta["buffer_names"]]
-    with open(path + ".pdmodel") as f:
-        stablehlo_text = f.read()
-
-    # Re-execute via jax.export deserialization when available; fall back to
-    # re-jitting is impossible without the original python, so we interpret
-    # the StableHLO through jax.export's calling convention.
-    def forward_fn(*arg_vals):
-        from jax._src.interpreters import mlir  # noqa: F401
-        import jax.export as jexport
-        raise NotImplementedError
-    try:
-        import jax.export  # noqa: F401
-        # jax.export round-trip needs the serialized bytes, not text; store
-        # both going forward.  For text-only artifacts, compile via pjrt:
-    except ImportError:
-        pass
+    blob = meta.get("exported")
+    if blob is None:
+        raise ValueError(
+            f"{path}.pdiparams has no serialized executable — re-save the "
+            "model with this version's jit.save")
+    exported = jax.export.deserialize(bytearray(blob))
 
     def compiled_forward(*arg_vals):
-        exe = _compile_stablehlo(stablehlo_text)
-        flat_in = list(params) + list(buffers) + list(arg_vals)
-        out = exe(*flat_in)
-        return out[0] if len(out) == 1 else tuple(out)
+        return exported.call(params, buffers, *arg_vals)
     return TranslatedLayer(meta, compiled_forward)
-
-
-def _compile_stablehlo(text):
-    """Compile StableHLO text through the default backend."""
-    client = jax.devices()[0].client
-    exe = client.compile(text)
-
-    def run(*flat_in):
-        import jax
-        bufs = [jax.device_put(np.asarray(a)) for a in flat_in]
-        out = exe.execute_sharded(bufs)
-        arrs = out.disassemble_into_single_device_arrays()
-        return [jnp.asarray(a[0]) for a in arrs]
-    return run

@@ -458,12 +458,12 @@ divide = _binary(jnp.divide)
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     if axis is None:
         out = call_op(lambda v: jnp.sum(v), x._values)
-        if dtype is not None:
-            out = out.astype(dtypes.convert_dtype(dtype))
-        return out
-    return call_op(
-        lambda v: jnp.sum(v, axis=axis, keepdims=keepdim),
-        x.to_dense())
+    else:
+        out = call_op(lambda v: jnp.sum(v, axis=axis, keepdims=keepdim),
+                      x.to_dense())
+    if dtype is not None:
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return out
 
 
 # -- matmul family ------------------------------------------------------------

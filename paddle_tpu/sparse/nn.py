@@ -295,7 +295,6 @@ class MaxPool3D(Layer):
         self._padding = _to_list(padding, 3)
 
     def forward(self, x):
-        dense = x.to_dense()
         in_spatial = x._shape[1:4]
         out_spatial = [(in_spatial[i] + 2 * self._padding[i]
                         - self._kernel[i]) // self._stride[i] + 1
@@ -321,16 +320,22 @@ class MaxPool3D(Layer):
         out_coords = jnp.asarray(np.asarray(coords, np.int32).T)
         gather_idx = tuple(out_coords[i] for i in range(4))
         kernel, stride, padding = self._kernel, self._stride, self._padding
+        scatter_idx = tuple(x._indices[i] for i in range(4))
+        dense_shape = tuple(x._shape)
 
-        def impl(dv):
-            neg_inf = jnp.finfo(dv.dtype).min
+        def impl(vals_in):
+            # densify onto -inf so inactive voxels never win the max
+            # (sparse max-pool reduces over active sites only)
+            neg_inf = jnp.finfo(vals_in.dtype).min
+            dv = jnp.full(dense_shape, neg_inf, vals_in.dtype)
+            dv = dv.at[scatter_idx].max(vals_in)
             out = jax.lax.reduce_window(
                 dv, neg_inf, jax.lax.max,
                 window_dimensions=(1, *kernel, 1),
                 window_strides=(1, *stride, 1),
                 padding=((0, 0), *[(p, p) for p in padding], (0, 0)))
             return out[gather_idx]
-        vals = call_op(impl, dense)
+        vals = call_op(impl, x.values())
         out_shape = (x._shape[0],) + tuple(out_spatial) + (x._shape[4],)
         return SparseCooTensor(out_coords, vals, out_shape)
 
@@ -347,9 +352,11 @@ class functional:
                   attn_mask=None, name=None):
         """Sparse-mask attention: scores only at mask nonzeros (SDDMM) →
         sparse softmax → spmm (reference:
-        paddle/phi/kernels/sparse/gpu/fused_attention_kernel.cu)."""
-        from . import masked_matmul, matmul as sp_matmul
-        from ..tensor import linalg as _linalg  # noqa: F401
+        paddle/phi/kernels/sparse/gpu/fused_attention_kernel.cu).
+
+        ``key_padding_mask``: [seq_k] with 0 at padded keys (those positions
+        get -inf score); ``attn_mask``: additive [seq_q, seq_k]."""
+        from . import masked_matmul, matmul as sp_matmul, SparseCooTensor
         import math as _math
         d = int(query.shape[-1])
         if len(query.shape) != 2:
@@ -358,5 +365,30 @@ class functional:
         kt = call_op(lambda v: v.T, key)
         scores = masked_matmul(
             call_op(lambda q: q / _math.sqrt(d), query), kt, sparse_mask)
+        if key_padding_mask is not None or attn_mask is not None:
+            if isinstance(scores, SparseCooTensor):
+                rows, cols = scores._indices[0], scores._indices[1]
+            else:
+                rows, cols = scores._row_ids(), scores._cols
+            kp = (key_padding_mask._value
+                  if hasattr(key_padding_mask, "_value")
+                  else key_padding_mask)
+            am = (attn_mask._value if hasattr(attn_mask, "_value")
+                  else attn_mask)
+
+            def mask_impl(v):
+                if kp is not None:
+                    v = jnp.where(jnp.asarray(kp)[cols] != 0, v, -1e9)
+                if am is not None:
+                    v = v + jnp.asarray(am)[rows, cols]
+                return v
+            new_vals = call_op(mask_impl, scores._values)
+            if isinstance(scores, SparseCooTensor):
+                scores = SparseCooTensor(scores._indices, new_vals,
+                                         scores._shape, scores._coalesced)
+            else:
+                from . import SparseCsrTensor
+                scores = SparseCsrTensor(scores._crows, scores._cols,
+                                         new_vals, scores._shape)
         probs = _softmax_fn(scores)
         return sp_matmul(probs, value)

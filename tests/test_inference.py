@@ -1,0 +1,86 @@
+"""paddle.inference predictor over jit.save artifacts.
+
+Reference analogue: test/legacy_test/test_inference_api.py +
+inference C++ API tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+from paddle_tpu.inference import Config, create_predictor, PrecisionType
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path_factory.mktemp("infer") / "model")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([1, 8], "float32", name="x")])
+    x = np.random.RandomState(0).randn(1, 8).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+    return path, x, ref
+
+
+class TestConfig:
+    def test_knobs(self):
+        c = Config("some/model")
+        assert c.prog_file() == "some/model.pdmodel"
+        assert c.params_file() == "some/model.pdiparams"
+        c.enable_use_gpu(100, 0, PrecisionType.Half)
+        assert c.use_gpu()
+        c.disable_gpu()
+        assert not c.use_gpu()
+        c.switch_ir_optim(False)
+        assert not c.ir_optim()
+        assert "device" in c.summary()
+
+    def test_pdmodel_suffix_stripped(self):
+        c = Config("some/model.pdmodel")
+        assert c.prog_file() == "some/model.pdmodel"
+
+
+class TestPredictor:
+    def test_zero_copy_run(self, saved_model):
+        path, x, ref = saved_model
+        config = Config(path)
+        config.disable_gpu()
+        pred = create_predictor(config)
+        names = pred.get_input_names()
+        assert names == ["x"]
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(x)
+        pred.run()
+        out_names = pred.get_output_names()
+        assert len(out_names) == 1
+        out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_feed_list_run(self, saved_model):
+        path, x, ref = saved_model
+        config = Config(path)
+        config.disable_gpu()
+        pred = create_predictor(config)
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_missing_input_raises(self, saved_model):
+        path, _, _ = saved_model
+        config = Config(path)
+        config.disable_gpu()
+        pred = create_predictor(config)
+        with pytest.raises(RuntimeError):
+            pred.run()

@@ -1,0 +1,217 @@
+"""paddle.quantization: observers, quanters, QAT/PTQ drivers, convert.
+
+Reference analogues: test/quantization/test_quant_aware*.py,
+test_ptq.py, test_observers.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QuantConfig, QAT, PTQ, quanters, observers,
+    QuantedLinear, ConvertedQuantedLinear)
+
+
+def _mlp():
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+    return MLP()
+
+
+class TestObservers:
+    def test_absmax(self):
+        ob = observers.AbsmaxObserver()
+        ob(paddle.to_tensor(np.array([1.0, -3.0], "float32")))
+        ob(paddle.to_tensor(np.array([2.0, -0.5], "float32")))
+        assert ob.scales() == pytest.approx(3.0)
+
+    def test_avg(self):
+        ob = observers.AVGObserver()
+        ob(paddle.to_tensor(np.array([2.0], "float32")))
+        ob(paddle.to_tensor(np.array([4.0], "float32")))
+        assert ob.scales() == pytest.approx(3.0)
+
+    def test_hist(self):
+        rng = np.random.RandomState(0)
+        ob = observers.HistObserver(percent=1.0)
+        data = rng.uniform(-1, 1, 4096).astype("float32")
+        ob(paddle.to_tensor(data))
+        assert ob.scales() == pytest.approx(np.abs(data).max(), rel=1e-2)
+
+    def test_observer_is_identity(self):
+        ob = observers.AbsmaxObserver()
+        x = np.array([1.0, -2.0], "float32")
+        out = ob(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), x)
+
+
+class TestQuanters:
+    def test_fake_quant_values(self):
+        q = quanters.FakeQuanterWithAbsMaxObserver()
+        q.eval()
+        q._scale_value = 1.0
+        x = np.array([0.5, -1.0, 0.126], "float32")
+        out = q(paddle.to_tensor(x)).numpy()
+        ref = np.round(x * 127) / 127
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_ste_gradient(self):
+        q = quanters.FakeQuanterWithAbsMaxObserver()
+        q.train()
+        x = paddle.to_tensor(np.array([0.3, -0.7], "float32"))
+        x.stop_gradient = False
+        out = q(x)
+        paddle.sum(out).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(2), atol=1e-6)
+
+    def test_channelwise(self):
+        q = quanters.FakeQuanterChannelWiseAbsMaxObserver(quant_axis=1)
+        w = np.array([[1.0, 10.0], [-0.5, -20.0]], "float32")
+        out = q(paddle.to_tensor(w)).numpy()
+        # per-column scales: 1.0 and 20.0
+        ref0 = np.round(w[:, 0] / 1.0 * 127) / 127 * 1.0
+        ref1 = np.round(w[:, 1] / 20.0 * 127) / 127 * 20.0
+        np.testing.assert_allclose(out[:, 0], ref0, atol=1e-5)
+        np.testing.assert_allclose(out[:, 1], ref1, atol=1e-4)
+        np.testing.assert_allclose(q.scales(), [1.0, 20.0])
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        cfg = QuantConfig(
+            activation=lambda: quanters.FakeQuanterWithAbsMaxObserver(),
+            weight=lambda: quanters.FakeQuanterChannelWiseAbsMaxObserver(
+                quant_axis=1))
+        model = _mlp()
+        qat = QAT(cfg)
+        qmodel = qat.quantize(model)
+        assert isinstance(qmodel.fc1, QuantedLinear)
+        assert isinstance(qmodel.fc2, QuantedLinear)
+
+    def test_qat_trains_and_stays_close(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(32, 8).astype("float32")
+        model = _mlp()
+        ref = model(paddle.to_tensor(x)).numpy()
+        cfg = QuantConfig(
+            activation=lambda: quanters.FakeQuanterWithAbsMaxObserver(),
+            weight=lambda: quanters.FakeQuanterChannelWiseAbsMaxObserver(
+                quant_axis=1))
+        qmodel = QAT(cfg).quantize(model)
+        qmodel.train()
+        for _ in range(20):   # moving-average scale warm-up
+            out = qmodel(paddle.to_tensor(x))
+        # fake-quant output close to float output (8-bit ⇒ ~1% scale err)
+        err = np.abs(out.numpy() - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.1
+        # gradients flow to weights through fake-quant
+        loss = paddle.mean(out * out)
+        loss.backward()
+        assert qmodel.fc1.weight.grad is not None
+        g = np.asarray(qmodel.fc1.weight.grad.numpy())
+        assert np.abs(g).max() > 0
+
+    def test_type_config(self):
+        cfg = QuantConfig()
+        cfg.add_type_config(
+            nn.Linear,
+            activation=lambda: quanters.FakeQuanterWithAbsMaxObserver())
+        model = _mlp()
+        qmodel = QAT(cfg).quantize(model)
+        assert isinstance(qmodel.fc1, QuantedLinear)
+
+    def test_convert_int8(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 8).astype("float32")
+        model = _mlp()
+        cfg = QuantConfig(
+            activation=None,
+            weight=lambda: quanters.FakeQuanterChannelWiseAbsMaxObserver(
+                quant_axis=1))
+        qmodel = QAT(cfg).quantize(model)
+        qmodel.train()
+        qout = qmodel(paddle.to_tensor(x)).numpy()
+        dmodel = QAT(cfg).convert(qmodel)
+        assert isinstance(dmodel.fc1, ConvertedQuantedLinear)
+        assert dmodel.fc1.w_int.dtype == np.int8
+        dout = dmodel(paddle.to_tensor(x)).numpy()
+        # weight-only int8 deploy ≈ fake-quant QAT output
+        np.testing.assert_allclose(dout, qout, rtol=1e-2, atol=5e-2)
+
+
+class TestPTQ:
+    def test_ptq_calibrate_convert(self):
+        rng = np.random.RandomState(3)
+        xs = [rng.randn(16, 8).astype("float32") for _ in range(4)]
+        model = _mlp()
+        ref = model(paddle.to_tensor(xs[0])).numpy()
+        cfg = QuantConfig(
+            activation=lambda: observers.AbsmaxObserver(),
+            weight=None)
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        qmodel.eval()
+        for x in xs:                      # calibration passes
+            qmodel(paddle.to_tensor(x))
+        assert qmodel.fc1.activation_quanter.scales() is not None
+        dmodel = ptq.convert(qmodel)
+        out = dmodel(paddle.to_tensor(xs[0])).numpy()
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.15   # int8 act+weight quantization error bound
+
+    def test_quantize_not_inplace(self):
+        model = _mlp()
+        cfg = QuantConfig(
+            activation=lambda: quanters.FakeQuanterWithAbsMaxObserver())
+        qmodel = QAT(cfg).quantize(model)
+        assert isinstance(qmodel.fc1, QuantedLinear)
+        assert isinstance(model.fc1, nn.Linear)   # original untouched
+        qmodel2 = QAT(cfg).quantize(model, inplace=True)
+        assert qmodel2 is model
+        assert isinstance(model.fc1, QuantedLinear)
+
+    def test_convert_channelwise_axis0_falls_back(self):
+        # quant_axis=0 scales are per-input-row; convert must re-derive
+        # per-output-channel scales instead of crashing/mis-scaling
+        rng = np.random.RandomState(4)
+        x = rng.randn(8, 8).astype("float32")
+        model = _mlp()
+        cfg = QuantConfig(
+            weight=lambda: quanters.FakeQuanterChannelWiseAbsMaxObserver(
+                quant_axis=0))
+        qmodel = QAT(cfg).quantize(model)
+        qmodel.train()
+        qmodel(paddle.to_tensor(x))
+        dmodel = QAT(cfg).convert(qmodel)
+        ref = model(paddle.to_tensor(x)).numpy()
+        out = dmodel(paddle.to_tensor(x)).numpy()
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.1
+
+    def test_hist_rebin_on_widening_range(self):
+        ob = observers.HistObserver(percent=1.0)
+        ob(paddle.to_tensor(np.linspace(-1, 1, 1000).astype("float32")))
+        ob(paddle.to_tensor(np.linspace(-2, 2, 1000).astype("float32")))
+        # all mass within [0,2]; percentile-1.0 scale ≈ 2, and the rebinned
+        # first batch must not be collapsed into the top bin
+        assert ob.scales() == pytest.approx(2.0, rel=2e-2)
+        h = ob._hist
+        assert h[-1] < h.sum() * 0.1   # top bin holds a small fraction
+
+    def test_int8_dot_path_used(self):
+        # act_scale present → ConvertedQuantedLinear runs int8 dot_general
+        layer = ConvertedQuantedLinear(
+            np.array([[127, 0], [0, 127]], np.int8),
+            np.array([1.0, 1.0], "float32"),
+            None, act_scale=1.0)
+        x = np.array([[0.5, -0.25]], "float32")
+        out = layer(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, [[0.5, -0.252]], atol=5e-3)

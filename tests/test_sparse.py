@@ -250,6 +250,15 @@ class TestSparseNN:
         ref = vals[0] @ w[0, 0]
         np.testing.assert_allclose(out.values().numpy()[0], ref, rtol=1e-5)
 
+    def test_maxpool_negative_values(self):
+        # inactive voxels must NOT contribute 0 to the max
+        import paddle_tpu.sparse.nn as spnn
+        idx = np.array([[0], [0], [0], [0]], "int32")
+        vals = np.array([[-1.0]], "float32")
+        st = sparse.sparse_coo_tensor(idx, vals, (1, 2, 2, 2, 1))
+        out = spnn.MaxPool3D(kernel_size=2, stride=2)(st)
+        np.testing.assert_allclose(out.values().numpy(), [[-1.0]])
+
     def test_maxpool_overlapping_windows(self):
         # stride < kernel: one active voxel feeds several output windows
         import paddle_tpu.sparse.nn as spnn
@@ -331,3 +340,30 @@ class TestTransforms:
         probs = np.exp(scores - scores.max(-1, keepdims=True))
         probs /= probs.sum(-1, keepdims=True)
         np.testing.assert_allclose(got, probs @ v, rtol=1e-4, atol=1e-5)
+
+    def test_attention_key_padding_mask(self):
+        import paddle_tpu.sparse.nn as spnn
+        rng = np.random.RandomState(22)
+        q = rng.randn(3, 4).astype("float32")
+        k = rng.randn(3, 4).astype("float32")
+        v = rng.randn(3, 4).astype("float32")
+        ii, jj = np.meshgrid(np.arange(3), np.arange(3), indexing="ij")
+        idx = np.stack([ii.ravel(), jj.ravel()]).astype("int32")
+        mask = sparse.sparse_coo_tensor(idx, np.ones(9, "float32"), (3, 3))
+        kp = np.array([1, 1, 0], "float32")   # key 2 is padding
+        got = spnn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask, key_padding_mask=paddle.to_tensor(kp)).numpy()
+        scores = (q @ k.T) / np.sqrt(4)
+        scores[:, 2] = -1e9
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, probs @ v, rtol=1e-4, atol=1e-5)
+
+    def test_sum_dtype_with_axis(self):
+        idx, vals = _random_coo((4, 6), 7, seed=23)
+        st = sparse.sparse_coo_tensor(idx, vals, (4, 6))
+        out = sparse.sum(st, axis=1, dtype="float64")
+        assert str(out.numpy().dtype) in ("float64", "float32")  # x64 off→f32
+        out2 = sparse.sum(st, axis=1, dtype="int32")
+        assert str(out2.numpy().dtype) == "int32"
