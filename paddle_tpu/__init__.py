@@ -39,6 +39,8 @@ from . import autograd  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
 from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
 from . import incubate  # noqa: F401

@@ -19,6 +19,7 @@ from .mesh import (ProcessMesh, get_mesh, set_mesh, auto_mesh,  # noqa: F401
 from .store import TCPStore, MasterStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 

@@ -11,3 +11,4 @@ from .fleet import (Fleet, init, distributed_model,  # noqa: F401
                     worker_num, worker_index, is_first_worker, barrier_worker)
 from . import utils  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import elastic  # noqa: F401

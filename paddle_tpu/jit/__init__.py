@@ -241,8 +241,12 @@ def save(layer, path, input_spec=None, **configs):
     bv = [b._value for b in buffers]
     # single trace: jax.export carries both the portable executable bytes
     # (the load path) and the StableHLO module text — the .pdmodel text is
-    # the human-inspectable "program" like the reference's protobuf
-    exported = jax.export.export(jax.jit(pure))(pv, bv, *arg_shapes)
+    # the human-inspectable "program" like the reference's protobuf.
+    # platforms: lower for both so a TPU-saved artifact loads on CPU hosts
+    # (dev/CI) and vice versa.
+    exported = jax.export.export(jax.jit(pure),
+                                 platforms=("cpu", "tpu"))(
+        pv, bv, *arg_shapes)
     stablehlo = exported.mlir_module()
     exported_bytes = exported.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -364,6 +364,23 @@ class LinearQuanterDequanter(Layer):
         return call_op(lambda v: _fake_quant(v, s, b), x)
 
 
+class ConvertedQuantedConv2D(Layer):
+    """Deploy-form conv: weight fake-quant baked into static values and a
+    frozen activation quant-dequant stub — no live observers, deterministic
+    inference."""
+
+    def __init__(self, inner, act_scale=None, bit_length=8):
+        super().__init__()
+        self._inner = inner
+        self._act = (LinearQuanterDequanter(act_scale, bit_length)
+                     if act_scale is not None else None)
+
+    def forward(self, x):
+        if self._act is not None:
+            x = self._act(x)
+        return self._inner(x)
+
+
 class ConvertedQuantedLinear(Layer):
     """Deploy-form linear: int8 weights + per-channel scales; matmul runs
     on the MXU's int8 path via dot_general(int8, int8)→int32 when the
@@ -459,7 +476,8 @@ class QAT:
                     if scales.ndim == 0 or scales.size == 1:
                         s = np.broadcast_to(np.reshape(scales, (1,)),
                                             (w.shape[1],)).copy()
-                    elif scales.size == w.shape[1]:
+                    elif wq.quant_axis() == 1 and \
+                            scales.size == w.shape[1]:
                         s = scales.reshape(-1)
                     else:
                         # quanter axis is not the output dim ([in, out]
@@ -475,6 +493,17 @@ class QAT:
                 act_scale = aq.scales() if aq is not None else None
                 return ConvertedQuantedLinear(w_int, s.astype(np.float32),
                                               layer.bias, act_scale, bits)
+            if isinstance(layer, QuantedConv2D):
+                inner = layer._layer
+                wq = layer.weight_quanter
+                bits = wq.bit_length() if wq is not None else 8
+                if wq is not None:
+                    # bake the weight fake-quant statically (frozen scales)
+                    inner.weight = Tensor(
+                        wq(inner.weight)._value, stop_gradient=True)
+                aq = layer.activation_quanter
+                act_scale = aq.scales() if aq is not None else None
+                return ConvertedQuantedConv2D(inner, act_scale, bits)
             return None
         return _swap_layers(model, self._config, wrap)
 

@@ -1,0 +1,269 @@
+"""Elastic manager, distributed RPC, text datasets, viterbi, onnx stub.
+
+Reference analogues: test/collective/fleet/test_fleet_elastic_manager.py
+(mocked etcd), test/legacy_test/test_rpc.py, test_viterbi_decode_op.py,
+text dataset tests.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, ElasticLevel)
+from paddle_tpu.distributed import rpc
+from paddle_tpu import text
+
+
+class TestElastic:
+    def _store(self):
+        return TCPStore("127.0.0.1", 0, is_master=True)
+
+    def test_register_and_members(self):
+        store = self._store()
+        m1 = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                            job_id="j1")
+        m2 = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                            job_id="j1")
+        assert m1.elastic_level == ElasticLevel.ELASTIC
+        id1 = m1.start("host1:6170")
+        id2 = m2.start("host2:6170")
+        assert {id1, id2} == {0, 1}
+        eps = m1.endpoints()
+        assert eps == ["host1:6170", "host2:6170"]
+        m1.stop(); m2.stop(); store.close()
+
+    def test_watch_restart_on_scale_out(self):
+        store = self._store()
+        m1 = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                            job_id="j2")
+        m1.start("host1:6170")
+        assert m1.watch() == ElasticStatus.NORMAL
+        m2 = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                            job_id="j2")
+        m2.start("host2:6170")
+        assert m1.watch() == ElasticStatus.RESTART
+        assert m1.watch() == ElasticStatus.NORMAL  # stable after change
+        m1.stop(); m2.stop(); store.close()
+
+    def test_watch_detects_dead_node(self):
+        store = self._store()
+        m1 = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                            job_id="j3")
+        m2 = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                            job_id="j3")
+        m1.start("host1:6170")
+        m2.start("host2:6170")
+        m1.watch()
+        m2.stop()              # graceful leave marks alive=False
+        assert m1.watch() == ElasticStatus.RESTART
+        m1.stop(); store.close()
+
+    def test_hold_below_min(self):
+        store = self._store()
+        m = ElasticManager(np="2:4", store=store, heartbeat_interval=0.2,
+                           job_id="j4")
+        m.start("host1:6170")
+        assert m.watch() == ElasticStatus.HOLD
+        assert not m.wait_for_np(timeout=0.5)
+        m.stop(); store.close()
+
+    def test_fault_tolerance_level(self):
+        store = self._store()
+        m = ElasticManager(np="2", store=store, job_id="j5")
+        assert m.elastic_level == ElasticLevel.FAULT_TOLERANCE
+        assert m.min_np == m.max_np == 2
+        store.close()
+
+    def test_exit(self):
+        store = self._store()
+        m = ElasticManager(np="1", store=store, job_id="j6")
+        m.start("h:1")
+        assert m.exit(completed=True) == ElasticStatus.COMPLETED
+        store.close()
+
+
+def _double(x):
+    return x * 2
+
+
+def _add(a, b=0):
+    return a + b
+
+
+class TestRPC:
+    def test_single_worker_loopback(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        info = rpc.init_rpc(
+            "worker0", rank=0, world_size=1,
+            master_endpoint=f"127.0.0.1:{store.port}")
+        try:
+            assert info.name == "worker0"
+            assert rpc.get_worker_info("worker0").rank == 0
+            assert rpc.get_current_worker_info().name == "worker0"
+            assert len(rpc.get_all_worker_infos()) == 1
+            # sync by name / by rank
+            assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+            assert rpc.rpc_sync(0, _add, args=(1,), kwargs={"b": 2}) == 3
+            # async future
+            fut = rpc.rpc_async("worker0", _double, args=(5,))
+            assert fut.result(timeout=10) == 10
+            # remote exception propagates
+            with pytest.raises(ZeroDivisionError):
+                rpc.rpc_sync("worker0", _divzero)
+        finally:
+            rpc.shutdown()
+            store.close()
+
+    def test_requires_init(self):
+        with pytest.raises(RuntimeError):
+            rpc.rpc_sync("nope", _double, args=(1,))
+
+    def test_unpicklable_result_surfaces_error(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        rpc.init_rpc("w0", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{store.port}")
+        try:
+            with pytest.raises(RuntimeError, match="not picklable"):
+                rpc.rpc_sync("w0", _return_lock)
+        finally:
+            rpc.shutdown()
+            store.close()
+
+
+def _divzero():
+    return 1 / 0
+
+
+def _return_lock():
+    return threading.Lock()
+
+
+class TestTextDatasets:
+    def test_imdb(self):
+        ds = text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert len(ds) > 0
+
+    def test_imikolov(self):
+        ds = text.Imikolov(window_size=5)
+        gram = ds[0]
+        assert len(gram) == 5
+
+    def test_movielens(self):
+        ds = text.Movielens(mode="test")
+        rec = ds[0]
+        assert len(rec) == 8
+        assert 1.0 <= float(rec[-1]) <= 5.0
+
+    def test_uci_housing(self):
+        ds = text.UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_wmt(self):
+        for cls in (text.WMT14, text.WMT16):
+            ds = cls(mode="train")
+            src, trg_in, trg_out = ds[0]
+            assert trg_in[0] == 0          # BOS
+            assert trg_out[-1] == 1        # EOS
+            np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+
+    def test_conll05(self):
+        ds = text.Conll05st()
+        rec = ds[0]
+        assert len(rec) == 9
+        assert len(rec[0]) == len(rec[-1])
+
+    def test_dataloader_integration(self):
+        from paddle_tpu.io import DataLoader
+        ds = text.UCIHousing(mode="train")
+        loader = DataLoader(ds, batch_size=32, shuffle=False)
+        xb, yb = next(iter(loader))
+        assert list(xb.shape) == [32, 13]
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 5, 4
+        pots = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lens = np.array([5, 3, 4], "int64")
+        score, path = text.viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        score, path = score.numpy(), path.numpy()
+        import itertools
+        for b in range(B):
+            L = int(lens[b])
+            best, best_path = -1e30, None
+            for seq in itertools.product(range(N), repeat=L):
+                s = pots[b, 0, seq[0]]
+                for t in range(1, L):
+                    s += trans[seq[t - 1], seq[t]] + pots[b, t, seq[t]]
+                if s > best:
+                    best, best_path = s, seq
+            np.testing.assert_allclose(score[b], best, rtol=1e-4)
+            np.testing.assert_array_equal(path[b, :L], best_path)
+            np.testing.assert_array_equal(path[b, L:], 0)
+
+    def test_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        pots = paddle.to_tensor(rng.randn(2, 4, 5).astype("float32"))
+        trans = paddle.to_tensor(rng.randn(5, 5).astype("float32"))
+        lens = paddle.to_tensor(np.array([4, 4], "int64"))
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=True)
+        score, path = dec(pots, lens)
+        assert list(path.shape) == [2, 4]
+        # bos/eos convention: decoded tags avoid the reserved last two only
+        # when it is score-optimal; just check dtype/shape and finite score
+        assert np.isfinite(score.numpy()).all()
+
+
+class TestVisionDatasetAdditions:
+    def test_flowers_voc(self):
+        from paddle_tpu.vision.datasets import Flowers, VOC2012
+        f = Flowers(mode="train")
+        img, label = f[0]
+        assert img.shape[-1] == 3 or img.shape[0] == 3
+        v = VOC2012(mode="test")
+        img, mask = v[0]
+        assert mask.dtype == np.int64
+
+    def test_folder_datasets(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+        for cls_name in ("cat", "dog"):
+            d = tmp_path / cls_name
+            d.mkdir()
+            for i in range(3):
+                np.save(d / f"{i}.npy",
+                        np.zeros((3, 8, 8), "float32"))
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        sample, target = ds[0]
+        assert sample.shape == (3, 8, 8) and target == 0
+        flat = tmp_path / "flat"
+        flat.mkdir()
+        np.save(flat / "a.npy", np.ones((2, 2), "float32"))
+        imgs = ImageFolder(str(flat))
+        assert len(imgs) == 1
+
+
+class TestOnnxStub:
+    def test_export_writes_stablehlo(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+        net = nn.Linear(4, 2)
+        with pytest.warns(UserWarning):
+            out = paddle.onnx.export(
+                net, str(tmp_path / "m.onnx"),
+                input_spec=[InputSpec([1, 4], "float32", name="x")])
+        assert out.endswith(".pdmodel")
+        import os
+        assert os.path.exists(out)

@@ -196,6 +196,61 @@ class TestPTQ:
         err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
         assert err < 0.1
 
+    def test_convert_square_matrix_axis0(self):
+        # square weight + quant_axis=0: size alone can't disambiguate the
+        # axis; convert must consult quant_axis() and re-derive
+        rng = np.random.RandomState(5)
+        x = rng.randn(4, 16).astype("float32")
+
+        class Sq(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        model = Sq()
+        ref = model(paddle.to_tensor(x)).numpy()
+        cfg = QuantConfig(
+            weight=lambda: quanters.FakeQuanterChannelWiseAbsMaxObserver(
+                quant_axis=0))
+        qmodel = QAT(cfg).quantize(model)
+        qmodel.train()
+        qmodel(paddle.to_tensor(x))
+        dmodel = QAT(cfg).convert(qmodel)
+        out = dmodel(paddle.to_tensor(x)).numpy()
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.1
+
+    def test_ptq_conv_convert_deterministic(self):
+        # converted conv layers must hold frozen scales (no live observers)
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        rng = np.random.RandomState(6)
+        model = ConvNet()
+        cfg = QuantConfig(
+            activation=lambda: observers.AbsmaxObserver())
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        qmodel.eval()
+        qmodel(paddle.to_tensor(rng.randn(1, 3, 8, 8).astype("float32")))
+        dmodel = ptq.convert(qmodel)
+        from paddle_tpu.quantization import ConvertedQuantedConv2D
+        assert isinstance(dmodel.conv, ConvertedQuantedConv2D)
+        # outputs identical across calls even with larger-range inputs
+        x1 = rng.randn(1, 3, 8, 8).astype("float32") * 10
+        o1 = dmodel(paddle.to_tensor(x1)).numpy()
+        dmodel(paddle.to_tensor(x1 * 5))
+        o2 = dmodel(paddle.to_tensor(x1)).numpy()
+        np.testing.assert_array_equal(o1, o2)
+
     def test_hist_rebin_on_widening_range(self):
         ob = observers.HistObserver(percent=1.0)
         ob(paddle.to_tensor(np.linspace(-1, 1, 1000).astype("float32")))
