@@ -29,7 +29,8 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=768,
                         num_hidden_layers=12, num_attention_heads=12,
                         max_position_embeddings=1024)
-        B, S, iters = 8, 1024, 20
+        # B=16 is the measured v5e sweet spot (B=8: 31%, B=16: 36.5% MFU)
+        B, S, iters = 16, 1024, 20
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128,
                         num_hidden_layers=2, num_attention_heads=4,
@@ -88,11 +89,15 @@ def main():
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
 
     loss, pvals, m0, v0, t0 = step_jit(pvals, m0, v0, t0, ids, ids)
-    loss.block_until_ready()  # compile + warmup
+    # IMPORTANT: sync via host readback — through the axon PJRT tunnel,
+    # block_until_ready() returns before execution finishes, inflating
+    # throughput ~70x; float() forces a D2H of the final value, which is a
+    # true completion barrier on the whole dependency chain.
+    float(loss)  # compile + warmup
     t_start = time.perf_counter()
     for _ in range(iters):
         loss, pvals, m0, v0, t0 = step_jit(pvals, m0, v0, t0, ids, ids)
-    loss.block_until_ready()
+    final_loss = float(loss)
     dt = time.perf_counter() - t_start
     tokens_per_sec = iters * B * S / dt
 
@@ -107,7 +112,7 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
-        "extra": {"loss": round(float(loss), 4), "mfu": round(mfu, 4),
+        "extra": {"loss": round(final_loss, 4), "mfu": round(mfu, 4),
                   "params": n_params, "batch": B, "seq": S},
     }))
 

@@ -54,23 +54,36 @@ def _xla_attention(q, k, v, mask=None, causal=False, scale=None,
     return o.astype(q.dtype)
 
 
+def _use_pallas(S, scale):
+    # pallas kernel path: default scale only (it bakes 1/sqrt(D))
+    return (scale is None and S >= _PALLAS_MIN_SEQ and S % 512 == 0 and
+            jax.default_backend() == "tpu")
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _attention_core(q, k, v, causal, scale):
     from ...ops.pallas.flash_attention import flash_attention_fwd
-    S = q.shape[1]
-    use_pallas = (S >= _PALLAS_MIN_SEQ and S % 512 == 0 and
-                  jax.default_backend() == "tpu")
-    if use_pallas:
+    if _use_pallas(q.shape[1], scale):
         return flash_attention_fwd(q, k, v, causal=causal)
     return _xla_attention(q, k, v, causal=causal, scale=scale)
 
 
 def _attn_fwd(q, k, v, causal, scale):
-    return _attention_core(q, k, v, causal, scale), (q, k, v)
+    from ...ops.pallas.flash_attention import flash_attention_fwd_lse
+    if _use_pallas(q.shape[1], scale):
+        o, lse = flash_attention_fwd_lse(q, k, v, causal=causal)
+        return o, (q, k, v, o, lse)
+    return _xla_attention(q, k, v, causal=causal, scale=scale), \
+        (q, k, v, None, None)
 
 
 def _attn_bwd(causal, scale, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if o is not None:
+        # pallas flash backward: recompute P blockwise from saved lse —
+        # no S×S materialization (the reference's flash_attn_bwd)
+        from ...ops.pallas.flash_attention import flash_attention_bwd
+        return flash_attention_bwd(q, k, v, o, lse, g, causal=causal)
     # recompute-based pullback at the XLA level (flash-bwd strategy)
     _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
         q_, k_, v_, causal=causal, scale=scale), q, k, v)
