@@ -53,8 +53,7 @@ from .framework.flags import set_flags, get_flags  # noqa: F401
 
 # paddle API aliases
 create_parameter = _creation.create_parameter
-disable_static = lambda *a, **k: None  # dygraph is the only eager mode
-enable_static = None  # set below by paddle_tpu.static
+from .static import enable_static, disable_static  # noqa: F401,E402
 
 CPUPlace = lambda: "cpu"
 CUDAPlace = lambda idx=0: f"tpu:{idx}"  # no GPUs; map onto TPU

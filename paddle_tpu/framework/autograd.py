@@ -100,6 +100,13 @@ class Node:
         self.inputs = ()
 
 
+# paddle_tpu.static installs a Program recorder here while static-graph
+# mode is building a program (define-and-run); every call_op appends its
+# primal fn + tensor wiring so Executor.run can replay the graph as a pure
+# jit-compiled function of the feeds.
+_STATIC_RECORDER = [None]
+
+
 def call_op(fn, *tensors, **kwargs):
     """Run ``fn(*values, **kwargs)`` eagerly, recording the tape if needed.
 
@@ -114,8 +121,16 @@ def call_op(fn, *tensors, **kwargs):
     if not record:
         out = f(*vals)
         if isinstance(out, (tuple, list)):
-            return tuple(Tensor(o, stop_gradient=True) for o in out)
-        return Tensor(out, stop_gradient=True)
+            result = tuple(Tensor(o, stop_gradient=True) for o in out)
+        else:
+            result = Tensor(out, stop_gradient=True)
+        if _STATIC_RECORDER[0] is not None and not _TAPE_SUSPENDED[0]:
+            # suspend_tape (jit/to_static tracing) must silence program
+            # recording too, or tracer values leak into the Program
+            _STATIC_RECORDER[0].record(
+                f, tensors,
+                result if isinstance(result, tuple) else (result,))
+        return result
 
     out_vals, vjp_fn = jax.vjp(f, *vals)
     single = not isinstance(out_vals, (tuple, list))
@@ -125,6 +140,8 @@ def call_op(fn, *tensors, **kwargs):
     for i, o in enumerate(out_tensors):
         o._node = node
         o._out_idx = i
+    if _STATIC_RECORDER[0] is not None and not _TAPE_SUSPENDED[0]:
+        _STATIC_RECORDER[0].record(f, tensors, tuple(out_tensors))
     return out_tensors[0] if single else tuple(out_tensors)
 
 
