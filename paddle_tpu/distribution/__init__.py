@@ -419,6 +419,23 @@ class TransformedDistribution(Distribution):
             x = t.forward(x)
         return x
 
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        """Change of variables: log p(y) = log p_base(x) + Σ ildj."""
+        x = value
+        total = None
+        for t in reversed(self.transforms):
+            ildj = t.inverse_log_det_jacobian(x)
+            x = t.inverse(x)
+            total = ildj if total is None else total + ildj
+        lp = self.base.log_prob(x)
+        return lp if total is None else lp + total
+
 
 # -- KL registry -------------------------------------------------------------
 _KL_REGISTRY = {}
@@ -439,3 +456,10 @@ def kl_divergence(p, q):
         return p.kl_divergence(q)
     raise NotImplementedError(
         f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)

@@ -5,8 +5,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "ReduceLROnPlateau", "config_callbacks",
-           "CallbackList"]
+           "LRScheduler", "ReduceLROnPlateau", "VisualDL",
+           "config_callbacks", "CallbackList"]
 
 
 class Callback:
@@ -274,6 +274,58 @@ class ReduceLROnPlateau(Callback):
                 opt.set_lr(new_lr)
                 self.wait = 0
                 self.cooldown_counter = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference: python/paddle/hapi/callbacks.py VisualDL,
+    which writes VisualDL event files).  No visualdl package is bundled, so
+    this writes the same scalars as JSON-lines under ``log_dir`` — one file
+    per phase, trivially plottable; if a ``visualdl`` package is importable
+    it is used instead."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._files = {}
+        self._steps = {}
+        try:
+            from visualdl import LogWriter  # pragma: no cover
+            self._writer = LogWriter(log_dir)
+        except ImportError:
+            self._writer = None
+
+    def _log(self, phase, logs):
+        import json
+        import os
+        logs = logs or {}
+        step = self._steps.get(phase, 0)
+        self._steps[phase] = step + 1
+        scalars = {k: float(v) for k, v in logs.items()
+                   if isinstance(v, (int, float)) or (
+                       hasattr(v, "ndim") and getattr(v, "ndim", 1) == 0)}
+        if not scalars:
+            return
+        if self._writer is not None:  # pragma: no cover
+            for k, v in scalars.items():
+                self._writer.add_scalar(f"{phase}/{k}", v, step)
+            return
+        f = self._files.get(phase)
+        if f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            f = open(os.path.join(self.log_dir, f"{phase}.jsonl"), "a")
+            self._files[phase] = f
+        f.write(json.dumps({"step": step, **scalars}) + "\n")
+        f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None,

@@ -187,7 +187,7 @@ def enable_static():
     _set_recording(_default_main[0])
 
 
-def disable_static():
+def disable_static(place=None):
     _static_mode[0] = False
     _set_recording(None)
 

@@ -123,7 +123,6 @@ class TestReviewRegressions:
                 return paddle.exp(Tensor(v))._value
         jax.jit(vf)(np.ones(3, "float32"))
         assert main.num_ops == n_before
-        assert static.default_main_program().num_ops == 0 or True
         exe = static.Executor()
         (got,) = exe.run(main, feed={"x": np.zeros((2, 4), "float32")},
                          fetch_list=[out])

@@ -1,0 +1,173 @@
+"""Extended vision transforms, distribution transforms, VisualDL callback.
+
+Reference analogues: test/legacy_test/test_transforms.py,
+test/distribution/test_distribution_transform.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.distribution import (
+    Normal, TransformedDistribution, ExpTransform, AffineTransform,
+    SigmoidTransform, TanhTransform, ChainTransform, StickBreakingTransform,
+    PowerTransform, ReshapeTransform, IndependentTransform)
+
+
+def _img(h=32, w=32, c=3, seed=0):
+    return (np.random.RandomState(seed).rand(h, w, c) * 255).astype(
+        "float32")
+
+
+class TestVisionTransforms:
+    def test_adjusts_match_identity_at_factor_one(self):
+        img = _img()
+        np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img,
+                                   atol=1e-3)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   atol=1e-3)
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                                   atol=1e-3)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=0.5)
+
+    def test_hue_full_rotation_identity(self):
+        img = _img(8, 8)
+        out = T.adjust_hue(T.adjust_hue(img, 0.5), 0.5)
+        np.testing.assert_allclose(out, img, atol=0.5)
+
+    def test_grayscale(self):
+        img = _img()
+        g1 = T.to_grayscale(img)
+        assert g1.shape == (32, 32, 1)
+        g3 = T.Grayscale(3)(img)
+        assert g3.shape == (32, 32, 3)
+        np.testing.assert_allclose(g3[..., 0], g3[..., 1])
+
+    def test_center_crop_and_crop(self):
+        img = _img(10, 10, 1)
+        cc = T.center_crop(img, 4)
+        np.testing.assert_allclose(cc, img[3:7, 3:7])
+        c = T.crop(img, 1, 2, 3, 4)
+        np.testing.assert_allclose(c, img[1:4, 2:6])
+
+    def test_random_resized_crop(self):
+        out = T.RandomResizedCrop(16)(_img())
+        assert out.shape[:2] == (16, 16)
+
+    def test_random_erasing(self):
+        img = np.ones((8, 8, 1), "float32")
+        out = T.RandomErasing(prob=1.0, value=0)(img)
+        assert out.min() == 0.0 and out.max() == 1.0
+
+    def test_color_jitter_runs(self):
+        out = T.ColorJitter(0.2, 0.2, 0.2, 0.1)(_img())
+        assert out.shape == (32, 32, 3)
+
+    def test_erase(self):
+        img = np.zeros((6, 6, 1), "float32")
+        out = T.erase(img, 1, 2, 2, 3, 7.0)
+        assert out[1:3, 2:5].min() == 7.0
+        assert out[0].max() == 0.0
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([T.RandomResizedCrop(16), T.ColorJitter(0.1),
+                          T.ToTensor()])
+        out = pipe(_img())
+        assert tuple(out.shape) == (3, 16, 16)
+
+
+class TestDistributionTransforms:
+    def test_exp_lognormal_parity(self):
+        from scipy.stats import lognorm
+        base = Normal(loc=paddle.to_tensor(0.0), scale=paddle.to_tensor(1.0))
+        d = TransformedDistribution(base, [ExpTransform()])
+        y = np.array([0.5, 1.0, 2.0], "float32")
+        got = d.log_prob(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(got, lognorm.logpdf(y, 1.0), rtol=1e-5)
+
+    def test_affine_forward_inverse(self):
+        t = AffineTransform(paddle.to_tensor(2.0), paddle.to_tensor(3.0))
+        x = paddle.to_tensor(np.array([1.0, -1.0], "float32"))
+        y = t.forward(x)
+        np.testing.assert_allclose(y.numpy(), [5.0, -1.0])
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+        ldj = t.forward_log_det_jacobian(x)
+        np.testing.assert_allclose(ldj.numpy(), np.log(3.0) * np.ones(2),
+                                   rtol=1e-6)
+
+    @pytest.mark.parametrize("t", [SigmoidTransform(), TanhTransform(),
+                                   ExpTransform(), PowerTransform(2.0)])
+    def test_fldj_matches_autodiff(self, t):
+        import jax
+        x = np.array([0.3, 0.9, 1.7], "float32")
+        got = t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy()
+        ref = np.log(np.abs(jax.vmap(jax.grad(
+            lambda v: t._forward(v)))(x)))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_chain(self):
+        t = ChainTransform([AffineTransform(0.0, 2.0), ExpTransform()])
+        x = paddle.to_tensor(np.array([0.1, 0.5], "float32"))
+        y = t.forward(x)
+        np.testing.assert_allclose(y.numpy(), np.exp(2 * x.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-5)
+
+    def test_stickbreaking(self):
+        t = StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.2, -0.4, 0.1], "float32"))
+        y = t.forward(x)
+        assert y.shape == [4]
+        np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-5)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        # fldj vs autodiff jacobian determinant of first K components
+        import jax
+        import jax.numpy as jnp
+        J = jax.jacfwd(lambda v: t._forward(v)[:-1])(x.numpy())
+        ref = np.log(np.abs(np.linalg.det(np.asarray(J))))
+        got = float(t.forward_log_det_jacobian(x).numpy())
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_chain_log_prob(self):
+        # composite transforms must support inverse_log_det_jacobian
+        d = TransformedDistribution(
+            Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)),
+            [ChainTransform([ExpTransform(), AffineTransform(1.0, 2.0)])])
+        lp = d.log_prob(paddle.to_tensor(np.array([3.0], "float32")))
+        x = np.log((3.0 - 1) / 2)
+        ref = -0.5 * np.log(2 * np.pi) - x ** 2 / 2 - np.log(3.0 - 1.0)
+        np.testing.assert_allclose(lp.numpy(), [ref], rtol=1e-5)
+
+    def test_uint8_near_black_brightness(self):
+        img = np.zeros((4, 4, 3), np.uint8)
+        img[0, 0] = 1
+        out = T.adjust_brightness(img, 100.0)
+        assert out[0, 0, 0] == 100.0   # not clipped to a [0,1] range
+
+    def test_reshape_independent(self):
+        t = ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(np.arange(4, dtype="float32"))
+        y = t.forward(x)
+        assert y.shape == [2, 2]
+        it = IndependentTransform(AffineTransform(0.0, 2.0), 1)
+        x2 = paddle.to_tensor(np.ones((3, 4), "float32"))
+        ldj = it.forward_log_det_jacobian(x2)
+        np.testing.assert_allclose(ldj.numpy(),
+                                   np.full(3, 4 * np.log(2.0)), rtol=1e-5)
+
+
+class TestVisualDL:
+    def test_scalar_logging(self, tmp_path):
+        import json
+        from paddle_tpu.hapi.callbacks import VisualDL
+        cb = VisualDL(log_dir=str(tmp_path))
+        cb.on_train_batch_end(0, {"loss": 1.5, "acc": 0.5})
+        cb.on_train_batch_end(1, {"loss": 1.2, "acc": 0.6})
+        cb.on_train_end()
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "train.jsonl").read().splitlines()]
+        assert lines[0]["loss"] == 1.5 and lines[1]["step"] == 1
