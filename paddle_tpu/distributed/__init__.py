@@ -20,6 +20,7 @@ from .store import TCPStore, MasterStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import rpc  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 

@@ -262,11 +262,17 @@ def center_crop(img, output_size):
     return arr[top:top + th, left:left + tw]
 
 
+def _restore_dtype(out, arr0):
+    if np.issubdtype(np.asarray(arr0).dtype, np.integer):
+        return np.round(out).astype(np.asarray(arr0).dtype)
+    return out
+
+
 def adjust_brightness(img, brightness_factor):
     arr0 = _np_img(img)
     vr = _value_range(arr0)
     arr = arr0.astype("float32")
-    return np.clip(arr * brightness_factor, 0, vr)
+    return _restore_dtype(np.clip(arr * brightness_factor, 0, vr), arr0)
 
 
 def adjust_contrast(img, contrast_factor):
@@ -274,7 +280,8 @@ def adjust_contrast(img, contrast_factor):
     vr = _value_range(arr0)
     arr = arr0.astype("float32")
     mean = arr.mean()
-    return np.clip(mean + (arr - mean) * contrast_factor, 0, vr)
+    return _restore_dtype(
+        np.clip(mean + (arr - mean) * contrast_factor, 0, vr), arr0)
 
 
 def adjust_saturation(img, saturation_factor):
@@ -282,7 +289,8 @@ def adjust_saturation(img, saturation_factor):
     vr = _value_range(arr0)
     arr = arr0.astype("float32")
     gray = arr.mean(axis=-1, keepdims=True) if arr.ndim == 3 else arr
-    return np.clip(gray + (arr - gray) * saturation_factor, 0, vr)
+    return _restore_dtype(
+        np.clip(gray + (arr - gray) * saturation_factor, 0, vr), arr0)
 
 
 def adjust_hue(img, hue_factor):
@@ -318,7 +326,8 @@ def adjust_hue(img, hue_factor):
     r2 = np.select(conds, [v, q, p, p, t, v])
     g2 = np.select(conds, [t, v, v, q, p, p])
     b2 = np.select(conds, [p, p, t, v, v, q])
-    return np.clip(np.stack([r2, g2, b2], axis=-1) * scale, 0, scale)
+    return _restore_dtype(
+        np.clip(np.stack([r2, g2, b2], axis=-1) * scale, 0, scale), arr0)
 
 
 def to_grayscale(img, num_output_channels=1):
@@ -343,9 +352,26 @@ def erase(img, i, j, h, w, v, inplace=False):
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
+    """Arbitrary-angle rotation via inverse-mapped nearest-neighbor
+    sampling (90-degree multiples take the exact np.rot90 path)."""
     arr = _np_img(img)
-    k = int(round(angle / 90.0)) % 4
-    return np.rot90(arr, k, axes=(0, 1)).copy()
+    if angle % 90 == 0:
+        return np.rot90(arr, int(angle // 90) % 4, axes=(0, 1)).copy()
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    theta = np.deg2rad(angle)
+    cos_t, sin_t = np.cos(theta), np.sin(theta)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # inverse map: source coords that land on each destination pixel
+    ys = cy + (yy - cy) * cos_t + (xx - cx) * sin_t
+    xs = cx - (yy - cy) * sin_t + (xx - cx) * cos_t
+    yi = np.round(ys).astype(np.int64)
+    xi = np.round(xs).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
 
 
 class ContrastTransform(BaseTransform):

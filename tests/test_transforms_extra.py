@@ -146,7 +146,27 @@ class TestDistributionTransforms:
         img = np.zeros((4, 4, 3), np.uint8)
         img[0, 0] = 1
         out = T.adjust_brightness(img, 100.0)
-        assert out[0, 0, 0] == 100.0   # not clipped to a [0,1] range
+        assert out[0, 0, 0] == 100   # not clipped to a [0,1] range
+        assert out.dtype == np.uint8  # dtype evidence survives chaining
+
+    def test_uint8_chained_jitter_keeps_scale(self):
+        img = np.zeros((4, 4, 3), np.uint8)
+        img[0, 0] = 1
+        out = T.adjust_contrast(T.adjust_brightness(img, 100.0), 1.0)
+        assert out.dtype == np.uint8
+        assert out[0, 0, 0] >= 99    # second op must not clip to [0,1]
+
+    def test_rotate_arbitrary_angle(self):
+        img = np.zeros((11, 11, 1), "float32")
+        img[5, 8] = 1.0   # point 3 px right of center
+        out = T.rotate(img, 45.0)
+        # destination of (dy=0,dx=3) under +45° ≈ (dy≈-2.1, dx≈2.1)
+        ys, xs = np.nonzero(out[..., 0])
+        assert len(ys) >= 1
+        assert abs(int(ys[0]) - 3) <= 1 and abs(int(xs[0]) - 7) <= 1
+        # 90° multiples stay exact
+        np.testing.assert_array_equal(T.rotate(img, 90),
+                                      np.rot90(img, 1, axes=(0, 1)))
 
     def test_reshape_independent(self):
         t = ReshapeTransform((4,), (2, 2))
