@@ -43,17 +43,6 @@ def _flatten(d, prefix=""):
     return out
 
 
-def _unflatten(flat):
-    root = {}
-    for key, v in flat.items():
-        parts = key.split(".")
-        d = root
-        for p in parts[:-1]:
-            d = d.setdefault(p, {})
-        d[parts[-1]] = v
-    return root
-
-
 def _safe(key):
     return re.sub(r"[^A-Za-z0-9_.\-]", "_", key)
 
@@ -118,11 +107,16 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False):
             arr = jnp.asarray(arr)
         entry = {"global_shape": list(arr.shape), "dtype": str(arr.dtype),
                  "shards": []}
+        is_bf16 = arr.dtype == jnp.bfloat16
         seen_starts = set()
         for shard in arr.addressable_shards:
+            # replicated copies: exactly ONE owner writes (replica 0),
+            # keeping multi-host file sets disjoint
+            if shard.replica_id != 0:
+                continue
             idx = shard.index   # tuple of slices into the global array
             starts = tuple((s.start or 0) for s in idx)
-            if starts in seen_starts:   # replicated copy — write once
+            if starts in seen_starts:
                 continue
             seen_starts.add(starts)
             sizes = [
@@ -132,8 +126,12 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False):
                      "_".join(str(s) for s in starts) + ".npy")
             entry["shards"].append({"starts": list(starts), "sizes": sizes,
                                     "file": fname})
-            # D2H snapshot now; disk write possibly async
-            jobs.append((os.path.join(path, fname), np.asarray(shard.data)))
+            # D2H snapshot now; disk write possibly async.  bf16 has no
+            # stable npy representation — store the uint16 bit pattern.
+            data = np.asarray(shard.data)
+            if is_bf16:
+                data = data.view(np.uint16)
+            jobs.append((os.path.join(path, fname), data))
         meta["arrays"][key] = entry
 
     meta_path = os.path.join(path, f"checkpoint.metadata.rank"
@@ -142,7 +140,10 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False):
     def write_all():
         for fpath, data in jobs:
             os.makedirs(os.path.dirname(fpath), exist_ok=True)
-            np.save(fpath, data)
+            tmp_f = f"{fpath}.tmp.{process_index}"
+            with open(tmp_f, "wb") as f:   # file-object save: no .npy suffix
+                np.save(f, data)
+            os.replace(tmp_f, fpath)
         # commit: metadata appears only after every shard is on disk
         tmp = meta_path + ".tmp"
         with open(tmp, "w") as f:
@@ -155,7 +156,7 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False):
     return None
 
 
-def _read_region(path, shard_rec, region):
+def _read_region(path, shard_rec, region, is_bf16=False):
     """Read the intersection of one saved shard with a target region.
 
     region: list of (start, stop) in global coords.  Returns (slab_slices,
@@ -171,14 +172,19 @@ def _read_region(path, shard_rec, region):
         inter_src.append(slice(lo - s0, hi - s0))
         inter_dst.append(slice(lo - rs, hi - rs))
     data = np.load(path, mmap_mode="r")[tuple(inter_src)]
-    return tuple(inter_dst), np.ascontiguousarray(data)
+    data = np.ascontiguousarray(data)
+    if is_bf16:   # stored as uint16 bit pattern (see save_state_dict)
+        data = data.view(jnp.bfloat16)
+    return tuple(inter_dst), data
 
 
 def _assemble_region(ckpt_path, entry, region, dtype):
+    is_bf16 = entry["dtype"] == "bfloat16"
     slab = np.zeros([hi - lo for lo, hi in region], dtype)
     for shard_rec in entry["shards"]:
         dst, data = _read_region(
-            os.path.join(ckpt_path, shard_rec["file"]), shard_rec, region)
+            os.path.join(ckpt_path, shard_rec["file"]), shard_rec, region,
+            is_bf16)
         if dst is not None:
             slab[dst] = np.asarray(data).reshape(slab[dst].shape)
     return slab

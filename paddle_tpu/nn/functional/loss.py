@@ -337,3 +337,53 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return call_op(_ctc, log_probs, labels, input_lengths.detach(),
                    label_lengths.detach())
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label·input)) (reference: nn/functional/loss.py)."""
+    def _sm(x, y):
+        # stable softplus form: log(1+exp(-yx)) == -log_sigmoid(yx)
+        return _reduce(-jax.nn.log_sigmoid(y * x), reduction)
+    return call_op(_sm, ensure_tensor(input), ensure_tensor(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Mean over classes of BCE-with-logits against multi-hot labels."""
+    w = ensure_tensor(weight)._value if weight is not None else None
+
+    def _ml(x, y):
+        per = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w is not None:
+            per = per * w
+        return _reduce(-per.mean(-1), reduction)
+    return call_op(_ml, ensure_tensor(input), ensure_tensor(label))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Poisson negative log likelihood (reference: PoissonNLLLoss)."""
+    def _pn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(y!) where y > 1
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return call_op(_pn, ensure_tensor(input), ensure_tensor(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Gaussian negative log likelihood with predicted variance."""
+    def _gn(x, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(x - y) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, var.dtype))
+        return _reduce(loss, reduction)
+    return call_op(_gn, ensure_tensor(input), ensure_tensor(label),
+                  ensure_tensor(variance))

@@ -118,6 +118,16 @@ class TestSaveLoad:
         net2.set_state_dict(loaded)
         np.testing.assert_array_equal(np.asarray(net2.weight._value), orig)
 
+    def test_bfloat16_roundtrip(self, state):
+        tmp, w1, w2, step = state
+        arr = jnp.asarray(w1, jnp.bfloat16)
+        ckpt.save_state_dict({"w": arr}, str(tmp / "cbf16"))
+        out = ckpt.load_state_dict(str(tmp / "cbf16"))
+        assert out["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(out["w"], dtype=np.float32),
+            np.asarray(arr, dtype=np.float32))
+
     def test_aborted_save_fails_loudly(self, state):
         tmp, w1, w2, step = state
         import os

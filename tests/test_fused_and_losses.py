@@ -1,0 +1,143 @@
+"""incubate.nn Fused* layers and the extended loss set.
+
+Reference analogues: test/legacy_test/test_fused_attention_op.py,
+test_fused_feedforward_op.py, test_soft_margin_loss.py, etc.  Fused layers
+are checked against the equivalent unfused composition; losses against
+numpy formulas.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import (
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer, FusedLinear)
+
+
+class TestFusedLayers:
+    def test_fused_mha_matches_manual(self):
+        rng = np.random.RandomState(0)
+        B, S, C, H = 2, 6, 16, 4
+        layer = FusedMultiHeadAttention(C, H, normalize_before=False)
+        layer.eval()   # parity check without dropout
+        x = rng.randn(B, S, C).astype("float32")
+        out = layer(paddle.to_tensor(x)).numpy()
+        # manual composition with the same weights
+        qkv = x @ np.asarray(layer.qkv_weight._value) + \
+            np.asarray(layer.qkv_bias._value)
+        q, k, v = np.split(qkv.reshape(B, S, 3, H, C // H), 3, axis=2)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(C // H)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, S, C)
+        o = o @ np.asarray(layer.linear_weight._value) + \
+            np.asarray(layer.linear_bias._value)
+        res = x + o
+        mu = res.mean(-1, keepdims=True)
+        var = ((res - mu) ** 2).mean(-1, keepdims=True)
+        ref = (res - mu) / np.sqrt(var + 1e-5) * \
+            np.asarray(layer.ln_scale._value) + \
+            np.asarray(layer.ln_bias._value)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_ffn_and_encoder_grads(self):
+        rng = np.random.RandomState(1)
+        enc = FusedTransformerEncoderLayer(16, 4, 32,
+                                           normalize_before=True)
+        x = paddle.to_tensor(rng.randn(2, 5, 16).astype("float32"))
+        x.stop_gradient = False
+        out = enc(x)
+        assert list(out.shape) == [2, 5, 16]
+        paddle.sum(out * out).backward()
+        assert enc.fused_attn.qkv_weight.grad is not None
+        assert enc.ffn.linear1_weight.grad is not None
+        assert x.grad is not None
+
+    def test_fused_dropout_active_in_train(self):
+        rng = np.random.RandomState(4)
+        layer = FusedFeedForward(16, 32, dropout_rate=0.9)
+        x = paddle.to_tensor(rng.randn(2, 5, 16).astype("float32"))
+        layer.train()
+        out_train = layer(x).numpy()
+        layer.eval()
+        out_eval = layer(x).numpy()
+        # train-mode dropout (p=0.9) must change the output
+        assert np.abs(out_train - out_eval).max() > 1e-3
+
+    def test_attn_dropout_zero_not_overridden(self):
+        enc = FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.3,
+                                           attn_dropout_rate=0.0)
+        assert enc.fused_attn._attn_dropout == 0.0
+
+    def test_fused_linear(self):
+        rng = np.random.RandomState(2)
+        lin = FusedLinear(8, 4)
+        x = rng.randn(3, 8).astype("float32")
+        out = lin(paddle.to_tensor(x)).numpy()
+        ref = x @ np.asarray(lin.weight._value) + \
+            np.asarray(lin.bias._value)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        lin_t = FusedLinear(8, 4, transpose_weight=True)
+        assert list(lin_t.weight.shape) == [4, 8]
+        out_t = lin_t(paddle.to_tensor(x)).numpy()
+        ref_t = x @ np.asarray(lin_t.weight._value).T + \
+            np.asarray(lin_t.bias._value)
+        np.testing.assert_allclose(out_t, ref_t, rtol=1e-4, atol=1e-5)
+
+
+class TestExtendedLosses:
+    def test_soft_margin(self):
+        x = np.array([0.5, -1.0, 2.0], "float32")
+        y = np.array([1.0, -1.0, -1.0], "float32")
+        got = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 reduction="none").numpy()
+        ref = np.log1p(np.exp(-y * x))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        layer = nn.SoftMarginLoss()
+        np.testing.assert_allclose(
+            layer(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+            ref.mean(), rtol=1e-5)
+
+    def test_multi_label_soft_margin(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 5).astype("float32")
+        y = (rng.rand(4, 5) > 0.5).astype("float32")
+        got = nn.MultiLabelSoftMarginLoss()(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        sig = 1 / (1 + np.exp(-x))
+        per = -(y * np.log(sig) + (1 - y) * np.log(1 - sig)).mean(-1)
+        np.testing.assert_allclose(got, per.mean(), rtol=1e-4)
+
+    def test_poisson_nll(self):
+        x = np.array([0.1, 0.5, 1.0], "float32")
+        y = np.array([1.0, 2.0, 3.0], "float32")
+        got = nn.PoissonNLLLoss(reduction="none")(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(got, np.exp(x) - y * x, rtol=1e-5)
+        got_full = F.poisson_nll_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y), full=True,
+            reduction="none").numpy()
+        stirling = y * np.log(y) - y + 0.5 * np.log(2 * np.pi * y)
+        ref = np.exp(x) - y * x + np.where(y > 1, stirling, 0.0)
+        np.testing.assert_allclose(got_full, ref, rtol=1e-5)
+
+    def test_gaussian_nll(self):
+        x = np.array([0.0, 1.0], "float32")
+        y = np.array([0.5, 0.5], "float32")
+        var = np.array([1.0, 4.0], "float32")
+        got = nn.GaussianNLLLoss(reduction="none")(
+            paddle.to_tensor(x), paddle.to_tensor(y),
+            paddle.to_tensor(var)).numpy()
+        ref = 0.5 * (np.log(var) + (x - y) ** 2 / var)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_losses_differentiable(self):
+        x = paddle.to_tensor(np.array([0.5, -0.5], "float32"))
+        x.stop_gradient = False
+        loss = F.soft_margin_loss(x, paddle.to_tensor(
+            np.array([1.0, -1.0], "float32")))
+        loss.backward()
+        assert x.grad is not None
