@@ -130,6 +130,8 @@ class FusedFeedForward(Layer):
         self.normalize_before = normalize_before
         self._epsilon = epsilon
         self._dropout = dropout_rate
+        self._act_dropout = (dropout_rate if act_dropout_rate is None
+                             else act_dropout_rate)
         self._act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
         self.linear1_weight = self.create_parameter(
             [d_model, dim_feedforward], attr=linear1_weight_attr,
@@ -162,15 +164,22 @@ class FusedFeedForward(Layer):
         pre = self.normalize_before
         act = self._act
         drop_p = self._dropout if self.training else 0.0
-        rng = next_key() if drop_p > 0.0 else None
+        act_p = self._act_dropout if self.training else 0.0
+        rng = next_key() if (drop_p > 0.0 or act_p > 0.0) else None
 
         def impl(x, w1, b1, w2, b2, s1, bb1, s2, bb2):
             residual = x
             if pre:
                 x = _ln(x, s1, bb1, eps)
-            h = act(x @ w1 + b1) @ w2 + b2
+            h = act(x @ w1 + b1)
+            if act_p > 0.0:
+                ka = jax.random.fold_in(rng, 0)
+                keep = jax.random.bernoulli(ka, 1.0 - act_p, h.shape)
+                h = jnp.where(keep, h / (1.0 - act_p), 0.0)
+            h = h @ w2 + b2
             if drop_p > 0.0:
-                keep = jax.random.bernoulli(rng, 1.0 - drop_p, h.shape)
+                kb = jax.random.fold_in(rng, 1)
+                keep = jax.random.bernoulli(kb, 1.0 - drop_p, h.shape)
                 h = jnp.where(keep, h / (1.0 - drop_p), 0.0)
             out = residual + h
             if not pre:
@@ -197,7 +206,8 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
         self.ffn = FusedFeedForward(
             d_model, dim_feedforward, dropout_rate=dropout_rate,
-            activation=activation, normalize_before=normalize_before)
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)

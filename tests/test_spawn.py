@@ -1,0 +1,42 @@
+"""paddle.distributed.spawn: real multi-process fork with rank env.
+
+Reference analogue: test/legacy_test/test_spawn_and_init_parallel_env.py.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.distributed import spawn
+
+
+def _write_rank(out_dir):
+    # runs in the child: rank env must be set before user code
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    world = os.environ["PADDLE_TRAINERS_NUM"]
+    with open(os.path.join(out_dir, f"rank{rank}.txt"), "w") as f:
+        f.write(f"{rank}/{world}/{os.environ['PADDLE_MASTER']}")
+
+
+def _fail():
+    raise SystemExit(3)
+
+
+class TestSpawn:
+    def test_inline_single(self, tmp_path):
+        os.environ["PADDLE_TRAINER_ID"] = "0"
+        spawn(_write_rank, args=(str(tmp_path),), nprocs=1)
+        assert (tmp_path / "rank0.txt").exists()
+
+    def test_two_workers(self, tmp_path):
+        ctx = spawn(_write_rank, args=(str(tmp_path),), nprocs=2)
+        files = sorted(os.listdir(tmp_path))
+        assert files == ["rank0.txt", "rank1.txt"]
+        r0 = (tmp_path / "rank0.txt").read_text()
+        r1 = (tmp_path / "rank1.txt").read_text()
+        assert r0.startswith("0/2/") and r1.startswith("1/2/")
+        # both ranks saw the same master endpoint
+        assert r0.split("/")[2:] == r1.split("/")[2:]
+
+    def test_failure_propagates(self):
+        with pytest.raises(RuntimeError, match="exited with code 3"):
+            spawn(_fail, nprocs=2)

@@ -1,0 +1,111 @@
+"""deform_conv2d / roi_pool / psroi_pool (reference:
+test/legacy_test/test_deform_conv2d.py, test_roi_pool_op.py)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import (deform_conv2d, roi_pool, psroi_pool,
+                                   DeformConv2D)
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32")
+        off = np.zeros((2, 2 * 9, 6, 6), "float32")
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w)).numpy()
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_integer_shift_offset(self):
+        # offset (+1,+1) on a 1x1 kernel == shifted image sample
+        x = np.arange(25, dtype="float32").reshape(1, 1, 5, 5)
+        w = np.ones((1, 1, 1, 1), "float32")
+        off = np.ones((1, 2, 5, 5), "float32")
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w)).numpy()
+        # sample at (i+1, j+1), zero outside
+        ref = np.zeros((1, 1, 5, 5), "float32")
+        ref[0, 0, :4, :4] = x[0, 0, 1:, 1:]
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_mask_v2_and_grads(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype("float32"))
+        w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype("float32"))
+        off = paddle.to_tensor(
+            0.1 * rng.randn(1, 18, 4, 4).astype("float32"))
+        m = paddle.to_tensor(rng.rand(1, 9, 4, 4).astype("float32"))
+        for t in (x, w, off):
+            t.stop_gradient = False
+        out = deform_conv2d(x, off, w, mask=m)
+        assert list(out.shape) == [1, 3, 4, 4]
+        paddle.sum(out * out).backward()
+        assert x.grad is not None and w.grad is not None \
+            and off.grad is not None
+
+    def test_layer(self):
+        layer = DeformConv2D(2, 4, 3, padding=1)
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(1, 2, 5, 5).astype("float32"))
+        off = paddle.to_tensor(np.zeros((1, 18, 5, 5), "float32"))
+        out = layer(x, off)
+        assert list(out.shape) == [1, 4, 5, 5]
+
+
+class TestRoiPool:
+    def test_roi_pool_values(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+        got = roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([1], "int32")),
+                       output_size=2).numpy()
+        # 2x2 max pooling over the full 4x4 box
+        ref = np.array([[[[5.0, 7.0], [13.0, 15.0]]]], "float32")
+        np.testing.assert_allclose(got, ref)
+
+    def test_psroi_pool_shape_and_mean(self):
+        # C = out_c * ph * pw = 2*2*2 = 8
+        x = np.ones((1, 8, 4, 4), "float32")
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], "float32")
+        got = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], "int32")),
+                         output_size=2).numpy()
+        assert got.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(got, np.ones((1, 2, 2, 2)), rtol=1e-6)
+
+    def test_psroi_pool_channel_major_order(self):
+        # channel k filled with value k: out channel c bin (i,j) must read
+        # input channel c*ph*pw + i*pw + j (R-FCN channel-major layout)
+        C, ph, pw = 8, 2, 2
+        x = np.zeros((1, C, 4, 4), "float32")
+        for k in range(C):
+            x[0, k] = k
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], "float32")
+        got = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], "int32")),
+                         output_size=2).numpy()
+        ref = np.zeros((1, 2, ph, pw), "float32")
+        for c in range(2):
+            for i in range(ph):
+                for j in range(pw):
+                    ref[0, c, i, j] = c * ph * pw + i * pw + j
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_batched_input_raises(self):
+        x = np.ones((2, 8, 4, 4), "float32")
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], "float32")
+        with pytest.raises(NotImplementedError):
+            psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([1, 0], "int32")), 2)
+        with pytest.raises(NotImplementedError):
+            roi_pool(paddle.to_tensor(np.ones((2, 1, 4, 4), "float32")),
+                     paddle.to_tensor(boxes),
+                     paddle.to_tensor(np.array([1, 0], "int32")), 2)
