@@ -39,10 +39,14 @@ class MultiprocessContext:
         for p in self.processes:
             p.join(timeout)
         for rank, p in enumerate(self.processes):
-            if p.exitcode not in (0, None):
+            if p.is_alive():
+                raise TimeoutError(
+                    f"spawned worker {rank} still running after join("
+                    f"timeout={timeout}) — terminate() it or wait longer")
+            if p.exitcode != 0:
                 raise RuntimeError(
                     f"spawned worker {rank} exited with code {p.exitcode}")
-        return all(p.exitcode == 0 for p in self.processes)
+        return True
 
     def terminate(self):
         for p in self.processes:
@@ -64,9 +68,10 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     ctx = mp.get_context("spawn")
     master = f"127.0.0.1:{_free_port()}"
     env_extra = dict(options.get("env", {}))
-    # children must not grab the TPU tunnel the parent may hold
-    env_extra.setdefault("JAX_PLATFORMS",
-                         os.environ.get("JAX_PLATFORMS", "cpu") or "cpu")
+    # children must not grab the single-client TPU tunnel the parent may
+    # hold: force CPU regardless of the parent's JAX_PLATFORMS; callers
+    # can override via options={"env": {"JAX_PLATFORMS": ...}}
+    env_extra.setdefault("JAX_PLATFORMS", "cpu")
     procs = []
     for rank in range(nprocs):
         # set env in the PARENT around start(): spawn children inherit it

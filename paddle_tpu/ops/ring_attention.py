@@ -83,17 +83,13 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None):
         vc = lax.ppermute(vc, axis_name, perm)
         return kc, vc, acc, m_new, l
 
-    def _vary(x):
-        # mark constants as device-varying over the sep axis so the scan
-        # carry types match (shard_map's varying-manual-axes check)
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except AttributeError:  # older jax spells it pvary
-            return lax.pvary(x, (axis_name,))
-
-    acc0 = _vary(jnp.zeros((B, H, Sl, D), jnp.float32))
-    m0 = _vary(jnp.full((B, H, Sl, 1), _NEG_INF, jnp.float32))
-    l0 = _vary(jnp.zeros((B, H, Sl, 1), jnp.float32))
+    # carry inits derive from qf so they inherit ALL of q's device-varying
+    # mesh axes (not just the sep axis) — on a 2-D dp×sep mesh a bare
+    # jnp.zeros carry fails shard_map's varying-manual-axes check
+    q_bhsd = jnp.swapaxes(qf, 1, 2)                 # (B,H,Sl,D)
+    acc0 = q_bhsd * 0.0
+    m0 = q_bhsd[..., :1] * 0.0 + _NEG_INF
+    l0 = q_bhsd[..., :1] * 0.0
     _, _, acc, _, l = lax.fori_loop(
         0, size, step, (k, v, acc0, m0, l0), unroll=True)
     o = acc / jnp.maximum(l, 1e-30)

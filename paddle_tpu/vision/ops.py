@@ -180,7 +180,14 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
              name=None):
-    """Max ROI pooling (reference: ops.roi_pool).  boxes: [R, 4] xyxy."""
+    """Max ROI pooling (reference: ops.roi_pool).  boxes: [R, 4] xyxy.
+
+    Implementation note: each output bin reduces a full-map mask, costing
+    ph·pw full passes per ROI.  This preserves the reference's
+    floor/ceil OVERLAPPING bin boundaries exactly; a single-pass
+    segment-reduce would be ~ph·pw× cheaper but assigns boundary pixels
+    to one bin only, silently diverging from the reference at bin edges.
+    ROI ops are not on this framework's hot path, so exactness wins."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
@@ -235,6 +242,10 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             raise NotImplementedError(
                 "psroi_pool currently supports a single image per call; "
                 "split the batch and concatenate results")
+        if C % (ph * pw) != 0 or C < ph * pw:
+            raise ValueError(
+                f"psroi_pool needs channels divisible by output h*w "
+                f"({ph}*{pw}); got C={C}")
         out_c = C // (ph * pw)
 
         def one_box(box):

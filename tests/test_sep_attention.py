@@ -27,6 +27,40 @@ def _mesh(n=4):
     return Mesh(np.asarray(jax.devices()[:n]), ("sep",))
 
 
+def test_ring_attention_2d_mesh_dp_sep():
+    """dp×sep mesh: the production layout — carry vma must track both
+    axes (regression for the shard_map varying-manual-axes check)."""
+    import math
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.ops.ring_attention import ring_flash_attention
+    mesh2 = Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2),
+                 ("data", "sep"))
+    rng = np.random.RandomState(0)
+    B, S, H, D = 8, 16, 2, 8
+    q = jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+    sh = NamedSharding(mesh2, P("data", "sep"))
+    qd, kd, vd = (jax.device_put(t, sh) for t in (q, k, v))
+
+    @jax.jit
+    def run(q, k, v):
+        return _shard_map(
+            lambda a, b, c: ring_flash_attention(a, b, c, "sep",
+                                                 causal=True),
+            mesh2, (P("data", "sep"),) * 3, P("data", "sep"))(q, k, v)
+
+    out = np.asarray(run(qd, kd, vd))
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+    mask = np.tril(np.ones((S, S), bool))
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
 def _qkv(B=2, S=32, H=4, D=8, Hk=None, seed=0):
     rng = np.random.RandomState(seed)
     Hk = Hk or H
