@@ -6,10 +6,11 @@ TPU-native: ONE process per host drives all local chips (SPMD), so
 ``--nnodes`` is the only real fan-out; per-host we spawn a single worker
 (vs the reference's one-per-GPU).  The watch loop + restart-with-resume
 survives worker crashes; rendezvous is the JAX coordinator (the reference's
-TCPStore master).
-
-Usage:  python -m paddle_tpu.distributed.launch [--nnodes N] [--node_rank R]
-            [--master host:port] [--max_restart K] script.py [args...]
+TCPStore master).  With ``--nnodes min:max`` the launcher also runs the
+elastic membership watch: the registry store listens on master_port+1 (the
+master port itself belongs to the workers' rendezvous), and on membership
+change workers are relaunched with rank/world recomputed from the live
+member set.
 """
 import argparse
 import os
@@ -17,6 +18,8 @@ import signal
 import subprocess
 import sys
 import time
+
+from ..fleet.elastic import ElasticManager, ElasticStatus
 
 
 def _parse():
@@ -39,20 +42,62 @@ def _parse():
     return p.parse_args()
 
 
-def _worker_env(args, local_rank):
+def _worker_env(args, local_rank, membership):
+    """membership: {"node_index": i, "n_nodes": n, "endpoints": [...]}
+    — static from --node_rank/--nnodes, or live from the elastic store."""
     env = dict(os.environ)
-    nnodes = int(str(args.nnodes).split(":")[0])
     nproc = args.nproc_per_node
-    world = nnodes * nproc
-    rank = args.node_rank * nproc + local_rank
+    world = membership["n_nodes"] * nproc
+    rank = membership["node_index"] * nproc + local_rank
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(world)
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
     if args.master:
         env["PADDLE_MASTER"] = args.master
+    if membership.get("endpoints"):
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(membership["endpoints"])
     env["PADDLE_CURRENT_ENDPOINT"] = \
         f"{os.environ.get('POD_IP', '127.0.0.1')}:{6170 + local_rank}"
     return env
+
+
+def _elastic_registry_endpoint(master):
+    """Elastic store rides master_port+1: the master port itself is the
+    workers' rendezvous (jax coordinator / MasterStore) and must stay
+    free for them."""
+    host, _, port = master.partition(":")
+    return host or "127.0.0.1", int(port or 6768) + 1
+
+
+def _setup_elastic(args):
+    """min:max nnodes + a master endpoint → store-backed ElasticManager
+    (node 0 hosts the registry store, mirroring the reference's ETCD)."""
+    if ":" not in str(args.nnodes) or not args.master:
+        return None
+    from ..store import TCPStore
+    host, port = _elastic_registry_endpoint(args.master)
+    store = None
+    if args.node_rank == 0:
+        store = TCPStore(host, port, is_master=True)
+    mgr = ElasticManager(np=args.nnodes, store=store,
+                         master=f"{host}:{port}" if store is None else None)
+    mgr.start(endpoint=f"{os.environ.get('POD_IP', '127.0.0.1')}:6170")
+    mgr._registry_store = store          # keep the server alive
+    print(f"[launch] elastic: np={args.nnodes} registered as node "
+          f"{mgr._node_id}", flush=True)
+    return mgr
+
+
+def _elastic_membership(elastic, args):
+    """Live rank/world from the member set (node order = node-id order)."""
+    members = elastic._members()
+    ids = sorted(members)
+    try:
+        idx = ids.index(elastic._node_id)
+    except ValueError:
+        idx = args.node_rank
+    return {"node_index": idx, "n_nodes": max(len(ids), 1),
+            "endpoints": [members[i] for i in ids]}
 
 
 def main():
@@ -61,19 +106,28 @@ def main():
     procs = {}
     restarts = {i: 0 for i in range(args.nproc_per_node)}
     logs = {}
+    elastic = _setup_elastic(args)
+    membership = {"node_index": args.node_rank,
+                  "n_nodes": int(str(args.nnodes).split(":")[0]),
+                  "endpoints": []}
+    if elastic is not None:
+        membership = _elastic_membership(elastic, args)
 
     def start(local_rank):
         log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
         logf = open(log_path, "ab", buffering=0)
         logs[local_rank] = logf
         cmd = [sys.executable, args.script] + args.script_args
-        p = subprocess.Popen(cmd, env=_worker_env(args, local_rank),
+        p = subprocess.Popen(cmd, env=_worker_env(args, local_rank,
+                                                  membership),
                              stdout=logf, stderr=subprocess.STDOUT)
         procs[local_rank] = p
         print(f"[launch] started worker {local_rank} pid={p.pid} "
+              f"rank={membership['node_index'] * args.nproc_per_node + local_rank} "
+              f"world={membership['n_nodes'] * args.nproc_per_node} "
               f"log={log_path}", flush=True)
 
-    def shutdown(signum=None, frame=None):
+    def stop_workers():
         for p in procs.values():
             if p.poll() is None:
                 p.terminate()
@@ -84,6 +138,12 @@ def main():
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+                p.wait()                 # reap — no zombies
+
+    def shutdown(signum=None, frame=None):
+        if elastic is not None:
+            elastic.stop()               # mark this node dead immediately
+        stop_workers()
         sys.exit(1 if signum else 0)
 
     signal.signal(signal.SIGINT, shutdown)
@@ -92,8 +152,34 @@ def main():
     for i in range(args.nproc_per_node):
         start(i)
 
-    # watch loop (reference: controllers/controller.py::watch)
+    # watch loop (reference: controllers/controller.py::watch +
+    # elastic/manager.py membership watch)
+    holding = False
     while True:
+        status = elastic.watch() if elastic is not None else None
+        if status == ElasticStatus.HOLD:
+            # below min nodes: pause failure accounting — crashed workers
+            # stay down (their restart budget untouched) until membership
+            # recovers, which arrives as RESTART
+            if not holding:
+                print("[launch] elastic: below min nodes, holding",
+                      flush=True)
+                holding = True
+            time.sleep(1)
+            continue
+        if status == ElasticStatus.RESTART or \
+                (holding and status == ElasticStatus.NORMAL):
+            holding = False
+            membership = _elastic_membership(elastic, args)
+            print(f"[launch] elastic membership changed → relaunch as "
+                  f"node {membership['node_index']} of "
+                  f"{membership['n_nodes']}: {membership['endpoints']}",
+                  flush=True)
+            stop_workers()
+            for i in range(args.nproc_per_node):
+                restarts[i] = 0          # fresh budget for the new epoch
+                start(i)
+
         alive = 0
         for i, p in list(procs.items()):
             ret = p.poll()
@@ -113,6 +199,8 @@ def main():
         if alive == 0:
             break
         time.sleep(1)
+    if elastic is not None:
+        elastic.stop()
     print("[launch] all workers finished", flush=True)
 
 
