@@ -41,6 +41,7 @@ from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
+from . import regularizer  # noqa: F401
 from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
 from . import incubate  # noqa: F401

@@ -73,6 +73,8 @@ class ElasticManager:
         self._hb_thread = None
         self._stopped = threading.Event()
         self._last_members = None
+        self._dead_ids = set()      # ids that never/no longer heartbeat
+        self._miss_counts = {}
         self.enabled = self.elastic_level != ElasticLevel.NONE
 
     # -- keys ---------------------------------------------------------------
@@ -118,25 +120,43 @@ class ElasticManager:
     # -- membership ---------------------------------------------------------
     def _members(self):
         """Fresh member records {node_id: endpoint} (heartbeat within the
-        lease window)."""
+        lease window), capped at max_np (lowest ids win, matching the
+        reference's membership cap).  This node is always included from
+        local knowledge, so a transient store hiccup can never hand our
+        rank to someone else.  Ids that repeatedly have no record (died
+        between registration and first heartbeat) are remembered as dead
+        and skipped, keeping watch() latency flat."""
         try:
             seq = self._store.add(self._k("seq"), 0)
         except Exception:
-            return {}
+            seq = 0
         now = time.time()
         lease = max(self.heartbeat_interval * 3, 6.0)
         members = {}
         for nid in range(seq):
-            try:
-                raw = self._store.get(self._k("node", str(nid)), timeout=2.0)
-            except Exception:
+            if nid in self._dead_ids:
                 continue
+            try:
+                raw = self._store.get(self._k("node", str(nid)),
+                                      timeout=0.5)
+            except Exception:
+                self._miss_counts[nid] = self._miss_counts.get(nid, 0) + 1
+                if self._miss_counts[nid] >= 3:
+                    self._dead_ids.add(nid)
+                continue
+            self._miss_counts.pop(nid, None)
             try:
                 rec = json.loads(raw.decode())
             except Exception:
                 continue
             if rec.get("alive") and now - rec["ts"] <= lease:
                 members[nid] = rec["endpoint"]
+        if self._node_id is not None and not self._stopped.is_set():
+            members.setdefault(self._node_id, getattr(self, "_endpoint",
+                                                      f"{self.host}:0"))
+        if len(members) > self.max_np:
+            keep = sorted(members)[:self.max_np]
+            members = {k: members[k] for k in keep}
         return members
 
     def endpoints(self):

@@ -55,7 +55,14 @@ def _worker_env(args, local_rank, membership):
     if args.master:
         env["PADDLE_MASTER"] = args.master
     if membership.get("endpoints"):
-        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(membership["endpoints"])
+        # one endpoint per TRAINER: expand each node's base port by
+        # local_rank so len(endpoints) == world size
+        expanded = []
+        for ep in membership["endpoints"]:
+            h, _, prt = ep.rpartition(":")
+            for lr in range(nproc):
+                expanded.append(f"{h or ep}:{int(prt or 6170) + lr}")
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(expanded)
     env["PADDLE_CURRENT_ENDPOINT"] = \
         f"{os.environ.get('POD_IP', '127.0.0.1')}:{6170 + local_rank}"
     return env
@@ -82,9 +89,15 @@ def _setup_elastic(args):
     mgr = ElasticManager(np=args.nnodes, store=store,
                          master=f"{host}:{port}" if store is None else None)
     mgr.start(endpoint=f"{os.environ.get('POD_IP', '127.0.0.1')}:6170")
-    mgr._registry_store = store          # keep the server alive
     print(f"[launch] elastic: np={args.nnodes} registered as node "
           f"{mgr._node_id}", flush=True)
+    # gate the first launch on quorum: starting below min_np would train
+    # with the wrong world size
+    if not mgr.wait_for_np():
+        print(f"[launch] elastic: quorum of {mgr.min_np} nodes not reached "
+              f"within {mgr.elastic_timeout}s; aborting", flush=True)
+        mgr.stop()
+        sys.exit(1)
     return mgr
 
 
@@ -140,11 +153,11 @@ def main():
                 p.kill()
                 p.wait()                 # reap — no zombies
 
-    def shutdown(signum=None, frame=None):
+    def shutdown(signum=None, frame=None, code=None):
         if elastic is not None:
             elastic.stop()               # mark this node dead immediately
         stop_workers()
-        sys.exit(1 if signum else 0)
+        sys.exit(code if code is not None else (1 if signum else 0))
 
     signal.signal(signal.SIGINT, shutdown)
     signal.signal(signal.SIGTERM, shutdown)
@@ -155,16 +168,25 @@ def main():
     # watch loop (reference: controllers/controller.py::watch +
     # elastic/manager.py membership watch)
     holding = False
+    hold_since = None
     while True:
         status = elastic.watch() if elastic is not None else None
         if status == ElasticStatus.HOLD:
             # below min nodes: pause failure accounting — crashed workers
             # stay down (their restart budget untouched) until membership
-            # recovers, which arrives as RESTART
+            # recovers (RESTART) or the elastic timeout expires
             if not holding:
                 print("[launch] elastic: below min nodes, holding",
                       flush=True)
                 holding = True
+                hold_since = time.time()
+            if time.time() - hold_since > elastic.elastic_timeout * 4:
+                print("[launch] elastic: membership never recovered; "
+                      "giving up", flush=True)
+                shutdown(code=1)
+            # still reap finished workers so a completed job can exit
+            if all(p.poll() is not None for p in procs.values()):
+                break
             time.sleep(1)
             continue
         if status == ElasticStatus.RESTART or \
@@ -195,7 +217,7 @@ def main():
                 else:
                     print(f"[launch] worker {i} failed rc={ret}; giving up",
                           flush=True)
-                    shutdown()
+                    shutdown(code=1)
         if alive == 0:
             break
         time.sleep(1)
