@@ -73,7 +73,10 @@ class ElasticManager:
         self._hb_thread = None
         self._stopped = threading.Event()
         self._last_members = None
-        self._dead_ids = set()      # ids that never/no longer heartbeat
+        # ids with no readable record get backoff deadlines instead of a
+        # permanent blacklist: transient store slowness must not evict a
+        # live peer (they are re-probed after the backoff lapses)
+        self._dead_until = {}
         self._miss_counts = {}
         self.enabled = self.elastic_level != ElasticLevel.NONE
 
@@ -134,17 +137,18 @@ class ElasticManager:
         lease = max(self.heartbeat_interval * 3, 6.0)
         members = {}
         for nid in range(seq):
-            if nid in self._dead_ids:
+            if self._dead_until.get(nid, 0) > now:
                 continue
             try:
                 raw = self._store.get(self._k("node", str(nid)),
-                                      timeout=0.5)
+                                      timeout=1.0)
             except Exception:
                 self._miss_counts[nid] = self._miss_counts.get(nid, 0) + 1
                 if self._miss_counts[nid] >= 3:
-                    self._dead_ids.add(nid)
+                    self._dead_until[nid] = now + 10 * lease
                 continue
             self._miss_counts.pop(nid, None)
+            self._dead_until.pop(nid, None)
             try:
                 rec = json.loads(raw.decode())
             except Exception:

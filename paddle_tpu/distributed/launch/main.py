@@ -59,9 +59,13 @@ def _worker_env(args, local_rank, membership):
         # local_rank so len(endpoints) == world size
         expanded = []
         for ep in membership["endpoints"]:
-            h, _, prt = ep.rpartition(":")
+            if ":" in ep:
+                h, prt = ep.rsplit(":", 1)
+                base = int(prt) if prt else 6170
+            else:
+                h, base = ep, 6170
             for lr in range(nproc):
-                expanded.append(f"{h or ep}:{int(prt or 6170) + lr}")
+                expanded.append(f"{h}:{base + lr}")
         env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(expanded)
     env["PADDLE_CURRENT_ENDPOINT"] = \
         f"{os.environ.get('POD_IP', '127.0.0.1')}:{6170 + local_rank}"
@@ -102,13 +106,15 @@ def _setup_elastic(args):
 
 
 def _elastic_membership(elastic, args):
-    """Live rank/world from the member set (node order = node-id order)."""
+    """Live rank/world from the member set (node order = node-id order).
+    node_index is None when this node was capped out by max_np — it must
+    stand by, not train with a colliding rank."""
     members = elastic._members()
     ids = sorted(members)
     try:
         idx = ids.index(elastic._node_id)
     except ValueError:
-        idx = args.node_rank
+        idx = None
     return {"node_index": idx, "n_nodes": max(len(ids), 1),
             "endpoints": [members[i] for i in ids]}
 
@@ -125,6 +131,11 @@ def main():
                   "endpoints": []}
     if elastic is not None:
         membership = _elastic_membership(elastic, args)
+        if membership["node_index"] is None:
+            print("[launch] elastic: this node is beyond max_np; exiting",
+                  flush=True)
+            elastic.stop()
+            sys.exit(1)
 
     def start(local_rank):
         log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
@@ -186,13 +197,26 @@ def main():
                 shutdown(code=1)
             # still reap finished workers so a completed job can exit
             if all(p.poll() is not None for p in procs.values()):
-                break
+                rcs = [p.returncode for p in procs.values()]
+                code = 0 if all(r == 0 for r in rcs) else 1
+                print(f"[launch] workers done during hold rcs={rcs}",
+                      flush=True)
+                shutdown(code=code)
             time.sleep(1)
             continue
         if status == ElasticStatus.RESTART or \
                 (holding and status == ElasticStatus.NORMAL):
             holding = False
             membership = _elastic_membership(elastic, args)
+            if membership["node_index"] is None:
+                # capped out by max_np: stand by until a slot opens
+                print("[launch] elastic: beyond max_np, standing by",
+                      flush=True)
+                stop_workers()
+                holding = True
+                hold_since = time.time()
+                time.sleep(1)
+                continue
             print(f"[launch] elastic membership changed → relaunch as "
                   f"node {membership['node_index']} of "
                   f"{membership['n_nodes']}: {membership['endpoints']}",

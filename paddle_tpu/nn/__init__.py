@@ -1,6 +1,7 @@
 """paddle_tpu.nn — layers + functional (reference: python/paddle/nn)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .layer.layers import (Layer, LayerList, Sequential, ParameterList,  # noqa: F401
                            LayerDict)
 from .layer.common import *  # noqa: F401,F403

@@ -1,0 +1,90 @@
+"""paddle.nn.utils: weight_norm / spectral_norm / clip_grad_norm_ /
+parameter vectorization.
+
+Reference analogues: test/legacy_test/test_weight_norm_hook.py,
+test_spectral_norm_op.py, test_clip_grad_norm_.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.utils import (
+    weight_norm, remove_weight_norm, spectral_norm, clip_grad_norm_,
+    parameters_to_vector, vector_to_parameters)
+
+
+class TestWeightNorm:
+    def test_forward_preserved_and_factors_train(self):
+        rng = np.random.RandomState(0)
+        lin = nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight._value).copy()
+        x = rng.randn(2, 4).astype("float32")
+        ref = lin(paddle.to_tensor(x)).numpy()
+        weight_norm(lin, dim=0)
+        assert "weight_v" in lin._parameters
+        assert "weight" not in lin._parameters
+        out = lin(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # gradients flow to g and v
+        y = paddle.sum(lin(paddle.to_tensor(x)))
+        y.backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+
+    def test_remove_restores_weight(self):
+        lin = nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight._value).copy()
+        weight_norm(lin, dim=0)
+        remove_weight_norm(lin)
+        assert "weight" in lin._parameters
+        np.testing.assert_allclose(np.asarray(lin.weight._value), w0,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSpectralNorm:
+    def test_unit_spectral_norm(self):
+        rng = np.random.RandomState(1)
+        lin = nn.Linear(8, 6)
+        spectral_norm(lin, n_power_iterations=20)
+        lin.train()
+        x = rng.randn(2, 8).astype("float32")
+        lin(paddle.to_tensor(x))   # run hooks/power iterations
+        lin(paddle.to_tensor(x))
+        w_eff = np.asarray(lin.weight._value)
+        sigma = np.linalg.svd(w_eff, compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
+
+    def test_grad_flows_to_orig(self):
+        lin = nn.Linear(4, 4)
+        spectral_norm(lin)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        paddle.sum(lin(x)).backward()
+        assert lin.weight_orig.grad is not None
+
+
+class TestGradUtilities:
+    def test_clip_grad_norm(self):
+        a = paddle.to_tensor(np.ones(3, "float32"))
+        b = paddle.to_tensor(np.ones(4, "float32"))
+        a.stop_gradient = b.stop_gradient = False
+        loss = 3.0 * paddle.sum(a) + 4.0 * paddle.sum(b)
+        loss.backward()
+        total = clip_grad_norm_([a, b], max_norm=1.0)
+        expected_norm = np.sqrt(3 * 9.0 + 4 * 16.0)
+        np.testing.assert_allclose(float(total.numpy()), expected_norm,
+                                   rtol=1e-5)
+        new_norm = np.sqrt(np.sum(a.grad.numpy() ** 2) +
+                           np.sum(b.grad.numpy() ** 2))
+        np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
+
+    def test_vector_roundtrip(self):
+        rng = np.random.RandomState(2)
+        ps = [paddle.to_tensor(rng.randn(2, 3).astype("float32")),
+              paddle.to_tensor(rng.randn(4).astype("float32"))]
+        vec = parameters_to_vector(ps)
+        assert vec.shape == [10]
+        vector_to_parameters(vec * 2.0, ps)
+        np.testing.assert_allclose(ps[0].numpy(),
+                                   vec.numpy()[:6].reshape(2, 3) * 2,
+                                   rtol=1e-6)
