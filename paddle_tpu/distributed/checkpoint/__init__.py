@@ -20,6 +20,8 @@ import json
 import os
 import re
 import threading
+import time
+import uuid
 
 import numpy as np
 import jax
@@ -79,7 +81,26 @@ class AsyncSaveHandle:
         return not self._thread.is_alive()
 
 
-def save_state_dict(state_dict, path, process_index=None, async_save=False):
+def _default_generation():
+    """A save-generation id every process of one save agrees on.
+
+    Saving into a directory that already holds rank metadata from a prior
+    save with a DIFFERENT world size leaves stale rank files behind; the
+    loader must not merge shard records across save generations (elastic
+    resume across mesh changes would silently mix tensor data).  Single
+    process: a fresh uuid.  Multi process: rank 0's uuid broadcast to all,
+    so every rank stamps the same id.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        seed = np.frombuffer(uuid.uuid4().bytes[:8], dtype=np.int64)
+        seed = multihost_utils.broadcast_one_to_all(seed)
+        return f"{int(seed[0]) & (2**63 - 1):016x}"
+    return uuid.uuid4().hex
+
+
+def save_state_dict(state_dict, path, process_index=None, async_save=False,
+                    generation=None):
     """Write this process's addressable shards of every array leaf.
 
     Layout::
@@ -94,13 +115,31 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False):
     ``Layer.state_dict()``; ``load_state_dict`` returns the same flat keys.
     Every process records its OWN shards in its own metadata file; the
     loader merges all rank files, so multi-host saves need no gather.
+
+    Each save is stamped with a ``generation`` id shared by all of its
+    ranks (see :func:`_default_generation`); the loader merges only the
+    newest generation, so re-saving into a directory that still holds rank
+    files from a larger world size cannot mix checkpoints.  Pass an
+    explicit ``generation`` (e.g. the global step as a string) to override
+    — all ranks must pass the same value.
     """
+    if generation is None:
+        if process_index is None:
+            # auto mode: we know how to mint an id all ranks share
+            generation = _default_generation()
+        # else: explicit process_index (rank-by-rank simulation / tests)
+        # with no shared id available — leave the save unstamped so the
+        # per-rank files merge as one legacy generation, exactly the
+        # pre-generation behavior.  Pass generation= (e.g. the step) to
+        # opt into stale-file protection on this path.
     process_index = (jax.process_index() if process_index is None
                      else process_index)
     flat = {k: _as_array(v) for k, v in _flatten(state_dict).items()}
     os.makedirs(path, exist_ok=True)
 
-    meta = {"arrays": {}, "format": 2}
+    meta = {"arrays": {}, "format": 3, "saved_at_ns": time.time_ns()}
+    if generation is not None:
+        meta["generation"] = str(generation)
     jobs = []   # (filepath, host numpy array)
     for key, arr in flat.items():
         if not isinstance(arr, jax.Array):
@@ -191,7 +230,16 @@ def _assemble_region(ckpt_path, entry, region, dtype):
 
 
 def _merged_meta(path):
-    """Union of every rank's metadata (multi-host saves write one each)."""
+    """Union of the NEWEST save generation's rank metadata.
+
+    Multi-host saves write one rank file each, all stamped with a shared
+    generation id.  A directory can legitimately hold stale rank files
+    from an earlier save with a larger world size (elastic resume across
+    mesh changes); merging across generations would silently mix tensor
+    data, so only files whose generation matches the most recently written
+    one are merged.  Pre-generation (format<=2) files have no stamp and
+    are treated as one legacy generation.
+    """
     import glob
     files = sorted(glob.glob(os.path.join(
         path, "checkpoint.metadata.rank*.json")))
@@ -202,10 +250,21 @@ def _merged_meta(path):
         raise FileNotFoundError(
             f"no checkpoint metadata under {path} — incomplete/aborted "
             "save, or wrong directory")
-    merged = {"arrays": {}}
+    metas = []
     for fp in files:
         with open(fp) as f:
             meta = json.load(f)
+        m = re.search(r"rank(\d+)", os.path.basename(fp))
+        rank = int(m.group(1)) if m else 0
+        metas.append((meta.get("generation"), rank, meta))
+    # The current generation is whatever the LOWEST-rank file carries:
+    # every save includes process 0, so a re-save always rewrites the
+    # lowest rank file, while wallclock stamps are cross-host clocks and
+    # can make a stale higher-rank file look newest.
+    newest_gen = min(metas, key=lambda m: m[1])[0]
+    selected = [m for gen, _, m in metas if gen == newest_gen]
+    merged = {"arrays": {}}
+    for meta in selected:
         for key, entry in meta["arrays"].items():
             cur = merged["arrays"].get(key)
             if cur is None:

@@ -61,7 +61,9 @@ def _worker_env(args, local_rank, membership):
         for ep in membership["endpoints"]:
             if ":" in ep:
                 h, prt = ep.rsplit(":", 1)
-                base = int(prt) if prt else 6170
+                # ':0' is ElasticManager.start()'s "no port" placeholder,
+                # not a real base — fall back like the empty case
+                base = int(prt) if prt and int(prt) != 0 else 6170
             else:
                 h, base = ep, 6170
             for lr in range(nproc):

@@ -20,12 +20,12 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _worker_entry(rank, nprocs, master, env_extra, func, args):
+def _worker_entry(rank, nprocs, master, base_port, env_extra, func, args):
     os.environ["PADDLE_TRAINER_ID"] = str(rank)
     os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
     os.environ["PADDLE_LOCAL_RANK"] = str(rank)
     os.environ["PADDLE_MASTER"] = master
-    os.environ["PADDLE_CURRENT_ENDPOINT"] = f"127.0.0.1:{6170 + rank}"
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = f"127.0.0.1:{base_port + rank}"
     for k, v in (env_extra or {}).items():
         os.environ[k] = str(v)
     func(*args)
@@ -67,6 +67,10 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     import multiprocessing as mp
     ctx = mp.get_context("spawn")
     master = f"127.0.0.1:{_free_port()}"
+    # per-run trainer base port (like the master port): fixed 6170+rank
+    # endpoints collide when two spawn() runs share the machine (e.g.
+    # parallel test workers)
+    base_port = _free_port()
     env_extra = dict(options.get("env", {}))
     # children must not grab the single-client TPU tunnel the parent may
     # hold: force CPU regardless of the parent's JAX_PLATFORMS; callers
@@ -83,7 +87,7 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
             "PADDLE_TRAINERS_NUM": str(nprocs),
             "PADDLE_LOCAL_RANK": str(rank),
             "PADDLE_MASTER": master,
-            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
             **{k: str(v) for k, v in env_extra.items()},
         }
         for k, v in child_env.items():
@@ -92,7 +96,8 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         try:
             p = ctx.Process(
                 target=_worker_entry,
-                args=(rank, nprocs, master, env_extra, func, tuple(args)),
+                args=(rank, nprocs, master, base_port, env_extra, func,
+                      tuple(args)),
                 daemon=daemon)
             p.start()
         finally:

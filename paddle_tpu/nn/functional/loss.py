@@ -369,8 +369,12 @@ def poisson_nll_loss(input, label, log_input=True, full=False,
         else:
             loss = x - y * jnp.log(x + epsilon)
         if full:
-            # Stirling approximation for log(y!) where y > 1
-            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            # Stirling approximation for log(y!) where y > 1.  Evaluate on
+            # a safe value so y==0 does not produce NaN in the unselected
+            # branch (jnp.where propagates NaN through the gradient).
+            ys = jnp.where(y > 1, y, 2.0)
+            stirling = (ys * jnp.log(ys) - ys
+                        + 0.5 * jnp.log(2 * jnp.pi * ys))
             loss = loss + jnp.where(y > 1, stirling, 0.0)
         return _reduce(loss, reduction)
     return call_op(_pn, ensure_tensor(input), ensure_tensor(label))

@@ -218,8 +218,10 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     row_m = (ys >= hs) & (ys < he)
                     col_m = (xs >= ws) & (xs < we)
                     m = row_m[:, None] & col_m[None, :]
-                    cell = jnp.where(m[None], xv[0],
-                                     jnp.finfo(xv.dtype).min)
+                    lowest = (jnp.finfo(xv.dtype).min
+                              if jnp.issubdtype(xv.dtype, jnp.floating)
+                              else jnp.iinfo(xv.dtype).min)
+                    cell = jnp.where(m[None], xv[0], lowest)
                     val = cell.max(axis=(1, 2))
                     val = jnp.where(m.any(), val, 0.0)
                     out = out.at[:, i, j].set(val)

@@ -134,3 +134,40 @@ class TestSaveLoad:
         os.makedirs(tmp / "c8", exist_ok=True)  # shards but no metadata
         with pytest.raises(FileNotFoundError, match="metadata"):
             ckpt.load_state_dict(str(tmp / "c8"))
+
+    def test_stale_rank_metadata_not_merged(self, state):
+        # Elastic resume across mesh changes: a re-save into a directory
+        # still holding rank files from a larger prior world must not mix
+        # generations — the stale rank's shard records are ignored.
+        tmp, w1, w2, step = state
+        import json
+        import os
+        d = tmp / "c9"
+        ckpt.save_state_dict({"w": jnp.zeros(8, jnp.float32)}, str(d))
+        # forge a stale rank-1 metadata file (prior 2-host save) whose
+        # shard would overwrite w[4:8] with ones if merged
+        os.makedirs(d / "w", exist_ok=True)
+        with open(d / "w" / "stale.npy", "wb") as f:
+            np.save(f, np.ones(4, np.float32))
+        stale = {"arrays": {"w": {"global_shape": [8], "dtype": "float32",
+                                  "shards": [{"starts": [4], "sizes": [4],
+                                              "file": "w/stale.npy"}]}},
+                 "format": 3, "generation": "dead-beef", "saved_at_ns": 1}
+        with open(d / "checkpoint.metadata.rank1.json", "w") as f:
+            json.dump(stale, f)
+        out = ckpt.load_state_dict(str(d))
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.zeros(8, np.float32))
+
+    def test_same_generation_rank_files_merge(self, state):
+        # Multi-host save: every rank writes its own metadata stamped with
+        # one shared generation id; the loader unions them.
+        tmp, w1, w2, step = state
+        d = str(tmp / "c10")
+        ckpt.save_state_dict({"a": jnp.asarray(w1)}, d,
+                             process_index=0, generation="step-7")
+        ckpt.save_state_dict({"b": jnp.asarray(w2)}, d,
+                             process_index=1, generation="step-7")
+        out = ckpt.load_state_dict(d)
+        np.testing.assert_array_equal(np.asarray(out["a"]), w1)
+        np.testing.assert_array_equal(np.asarray(out["b"]), w2)

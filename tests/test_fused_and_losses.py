@@ -124,6 +124,18 @@ class TestExtendedLosses:
         ref = np.exp(x) - y * x + np.where(y > 1, stirling, 0.0)
         np.testing.assert_allclose(got_full, ref, rtol=1e-5)
 
+    def test_poisson_nll_full_zero_counts_grad(self):
+        # y==0 must not poison the gradient: the Stirling term is only
+        # selected for y>1, but NaN from log(0) in the unselected branch
+        # would propagate through jnp.where's vjp
+        x = paddle.to_tensor(np.array([0.3, 0.7], "float32"))
+        x.stop_gradient = False
+        y = paddle.to_tensor(np.array([0.0, 5.0], "float32"))
+        loss = F.poisson_nll_loss(x, y, full=True)
+        loss.backward()
+        assert np.isfinite(loss.numpy()).all()
+        assert np.isfinite(x.grad.numpy()).all()
+
     def test_gaussian_nll(self):
         x = np.array([0.0, 1.0], "float32")
         y = np.array([0.5, 0.5], "float32")
