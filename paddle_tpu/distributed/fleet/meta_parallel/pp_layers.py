@@ -62,6 +62,7 @@ class PipelineLayer(Layer):
             topology.get_dim("pipe") if topology else 1)
         self._seg_method = seg_method
         self._recompute_interval = recompute_interval
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         self._shared = {}
 
         built = []
@@ -93,10 +94,35 @@ class PipelineLayer(Layer):
         return self._num_stages
 
     def segment(self):
-        """Uniform cut points over the layer list (reference seg_method
-        'uniform' / 'layer:<Class>')."""
+        """Stage cut points over the layer list.  seg_method:
+        - "uniform": equal-count split of all items;
+        - "layer:<Class>": boundaries fall only at instances of <Class>,
+          distributing those instances evenly — items before the first
+          instance join stage 0, trailing items join the last stage
+          (reference segment_by_layer semantics)."""
         n = len(self.run_function)
         S = self._num_stages
+        if isinstance(self._seg_method, str) and \
+                self._seg_method.startswith("layer:"):
+            cls_name = self._seg_method.split(":", 1)[1]
+            idxs = [i for i, (l, _) in enumerate(self.run_function)
+                    if type(l).__name__ == cls_name]
+            if not idxs:
+                raise ValueError(
+                    f"seg_method {self._seg_method!r}: no layer of class "
+                    f"{cls_name!r} in the pipeline")
+            if len(idxs) < S:
+                raise ValueError(
+                    f"seg_method {self._seg_method!r}: {len(idxs)} "
+                    f"{cls_name} layers < {S} stages")
+            counts = [len(idxs) // S + (1 if k < len(idxs) % S else 0)
+                      for k in range(S)]
+            cuts, acc = [0], 0
+            for k in range(S - 1):
+                acc += counts[k]
+                cuts.append(idxs[acc])
+            cuts.append(n)
+            return cuts
         per = int(math.ceil(n / S))
         cuts = [min(i * per, n) for i in range(S + 1)]
         cuts[-1] = n
@@ -115,19 +141,18 @@ class PipelineLayer(Layer):
     def _shared_for(self, layer):
         return layer
 
-    def homogeneous_run(self):
-        """(head_layers, middle_blocks, tail_layers) where middle_blocks
-        are structurally identical (the pipelineable run)."""
-        items = [l for l, _ in self.run_function]
+    def _homogeneous_span(self):
+        """(start, end) of the longest run of structurally identical
+        parameterized layers in run_function (the pipelineable middle);
+        (0, 0) when none."""
         sigs = []
-        for l in items:
+        for l, _ in self.run_function:
             if isinstance(l, Layer):
                 sigs.append((type(l).__name__, tuple(
                     tuple(p.shape) for _, p in l.named_parameters())))
             else:
                 sigs.append(("func", None))
-        # longest run of identical signatures
-        best, cur, start, bstart = 0, 1, 0, 0
+        best, cur, bstart = 0, 1, 0
         for i in range(1, len(sigs)):
             if sigs[i] == sigs[i - 1] and sigs[i][1]:
                 cur += 1
@@ -136,9 +161,17 @@ class PipelineLayer(Layer):
             else:
                 cur = 1
         if best < 2:
+            return 0, 0
+        return bstart, bstart + best
+
+    def homogeneous_run(self):
+        """(head_layers, middle_blocks, tail_layers) where middle_blocks
+        are structurally identical (the pipelineable run)."""
+        items = [l for l, _ in self.run_function]
+        start, end = self._homogeneous_span()
+        if start == end:
             return items, [], []
-        return (items[:bstart], items[bstart:bstart + best],
-                items[bstart + best:])
+        return items[:start], items[start:end], items[end:]
 
     def staged_module(self, mesh, axis="pipe", remat=None):
         from ...pipeline import PipelineStagedModule
@@ -147,4 +180,5 @@ class PipelineLayer(Layer):
             raise ValueError("no homogeneous block run to pipeline")
         if remat is None:
             remat = self._recompute_interval > 0
-        return PipelineStagedModule(mid, mesh, axis=axis, remat=remat)
+        return PipelineStagedModule(mid, mesh, axis=axis, remat=remat,
+                                    n_virtual=self._num_virtual_stages)

@@ -47,20 +47,39 @@ def stack_block_params(param_lists):
 
 
 def spmd_pipeline(block_apply, stacked_params, x, mesh, axis="pipe",
-                  remat=True):
+                  remat=True, n_virtual=1):
     """Run L stacked blocks as an S-stage pipeline over micro-batches.
 
     block_apply(params_list, h) -> h'  — one block, pure.
-    stacked_params: list of arrays with leading dim L (L % S == 0).
+    stacked_params: list of arrays with leading dim L (L % (S*V) == 0).
     x: (M, mb, ...) micro-batched activations, replicated on `axis`.
     Returns (M, mb, ...) outputs.
+
+    ``n_virtual`` > 1 is the interleaved virtual-pipeline schedule
+    (reference: PipelineParallelWithInterleave): physical stage s hosts
+    the V non-contiguous logical stages {s, s+S, ..., s+(V-1)S}, and each
+    activation makes V trips around the ppermute ring (a v counter rides
+    the rotation).  Injection is continuous: micro-batch m enters stage 0
+    at tick (m//S)·SV + (m%S) — exactly the slot where an activation that
+    finished its last trip leaves the ring — so consecutive waves overlap
+    with no inter-ring drain.  Per tick a stage runs L/(SV) layers, and
+    the whole schedule takes ((M-1)//S)·SV + (M-1)%S + SV ticks: for
+    M ≤ S that is (S-1) idle ticks spread over M·V+S-1 — the reference
+    interleave's bubble shrink — without a hand-written scheduler.  The
+    V=1 case reduces to the plain GPipe wavefront (M+S-1 ticks).
     """
     S = mesh.shape[axis]
     M = x.shape[0]
+    V = int(n_virtual or 1)
     L = stacked_params[0].shape[0]
-    assert L % S == 0, f"layers {L} not divisible by stages {S}"
-    per = L // S
-    params_s = [p.reshape(S, per, *p.shape[1:]) for p in stacked_params]
+    assert L % (S * V) == 0, \
+        f"layers {L} not divisible by stages*virtual {S}*{V}"
+    per = L // (S * V)
+    SV = S * V
+    # logical stage l = v*S + s owns layers [l*per, (l+1)*per): reshape to
+    # (V, S, per, ...) then put the physical-stage dim first for sharding
+    params_s = [jnp.moveaxis(p.reshape(V, S, per, *p.shape[1:]), 1, 0)
+                for p in stacked_params]
 
     if remat:
         block_apply = jax.checkpoint(block_apply)
@@ -70,35 +89,56 @@ def spmd_pipeline(block_apply, stacked_params, x, mesh, axis="pipe",
 
     def run(params_l, xl):
         s_idx = lax.axis_index(axis)
-        my_params = [p[0] for p in params_l]   # (per, ...)
+        my_params = [p[0] for p in params_l]   # (V, per, ...)
+        perm = [(i, (i + 1) % S) for i in range(S)]
 
-        def stage_compute(h):
+        def stage_compute(h, v):
+            chunk = [lax.dynamic_index_in_dim(p, jnp.clip(v, 0, V - 1), 0,
+                                              keepdims=False)
+                     for p in my_params]        # (per, ...)
+
             def body(carry, blk):
                 return block_apply(blk, carry), None
-            h, _ = lax.scan(body, h, my_params)
+            h, _ = lax.scan(body, h, chunk)
             return h
 
         state0 = jnp.zeros_like(xl[0])
         out0 = jnp.zeros_like(xl)
-        perm = [(i, (i + 1) % S) for i in range(S)]
+        v0 = jnp.zeros((), jnp.int32)
 
         def tick(carry, t):
-            state, outputs = carry
-            mb_in = lax.dynamic_index_in_dim(xl, jnp.clip(t, 0, M - 1), 0,
-                                             keepdims=False)
-            inp = jnp.where(s_idx == 0, mb_in, state)
-            out = stage_compute(inp)
-            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
-            write = jnp.logical_and(s_idx == S - 1, t >= S - 1)
+            state, v, outputs = carry
+            # stage 0 injects micro-batch m at tick (m//S)*SV + (m%S);
+            # live wrap-arounds land on phases >= S, dead ones (v == V)
+            # land exactly on the injection phases and are replaced
+            phase = t % SV
+            m_in = (t // SV) * S + phase
+            inject = (s_idx == 0) & (phase < S) & (m_in < M)
+            mb_in = lax.dynamic_index_in_dim(
+                xl, jnp.clip(m_in, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(inject, mb_in, state)
+            v_cur = jnp.where(inject, 0, v)
+            out = stage_compute(inp, v_cur)
+            # micro-batch m completes at its inject tick + SV - 1
+            u = t - (SV - 1)
+            uphase = u % SV
+            m_out = (u // SV) * S + uphase
+            write = (s_idx == S - 1) & (v_cur == V - 1) & (u >= 0) \
+                & (uphase < S) & (m_out < M)
+            out_idx = jnp.clip(m_out, 0, M - 1)
             cur = lax.dynamic_index_in_dim(outputs, out_idx, 0,
                                            keepdims=False)
             outputs = lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(write, out, cur), out_idx, 0)
             state = lax.ppermute(out, axis, perm)
-            return (state, outputs), None
+            # the v counter rides the ring; +1 on the S-1 → 0 wrap
+            v = lax.ppermute(
+                v_cur + (s_idx == S - 1).astype(jnp.int32), axis, perm)
+            return (state, v, outputs), None
 
-        (_, outputs), _ = lax.scan(tick, (state0, out0),
-                                   jnp.arange(M + S - 1))
+        n_ticks = ((M - 1) // S) * SV + (M - 1) % S + SV
+        (_, _, outputs), _ = lax.scan(tick, (state0, v0, out0),
+                                      jnp.arange(n_ticks))
         # only the last stage holds real outputs; replicate via psum
         outputs = jnp.where(s_idx == S - 1, outputs, 0)
         return lax.psum(outputs, axis)
@@ -115,13 +155,14 @@ class PipelineStagedModule:
     exposes ``apply(stacked_values, x_microbatches)``.
     """
 
-    def __init__(self, blocks, mesh, axis="pipe", remat=True):
+    def __init__(self, blocks, mesh, axis="pipe", remat=True, n_virtual=1):
         from ..framework.core import Tensor
         from ..framework import autograd as _ag
         self.blocks = list(blocks)
         self.mesh = mesh
         self.axis = axis
         self.remat = remat
+        self.n_virtual = int(n_virtual or 1)
         self.template = self.blocks[0]
         self.t_params = [p for _, p in self.template.named_parameters()]
         self.param_lists = [[p._value for _, p in b.named_parameters()]
@@ -144,4 +185,5 @@ class PipelineStagedModule:
 
     def apply(self, stacked_values, x_mb):
         return spmd_pipeline(self.block_apply, stacked_values, x_mb,
-                             self.mesh, self.axis, remat=self.remat)
+                             self.mesh, self.axis, remat=self.remat,
+                             n_virtual=self.n_virtual)

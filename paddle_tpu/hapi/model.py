@@ -21,7 +21,7 @@ from ..framework.random import rng_scope, next_key
 from ..framework.io import save as _save, load as _load
 from ..metric import Metric
 from ..optimizer.lr import LRScheduler
-from ..optimizer.optimizer import AdamW
+from ..optimizer.optimizer import apply_functional_with_clip
 from ..io import DataLoader, Dataset, DistributedBatchSampler
 from . import callbacks as cbks_mod
 
@@ -175,15 +175,8 @@ class _CompiledStepper:
 
             (loss, (out_vals, new_buf)), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(train_vals)
-            if opt._grad_clip is not None:
-                clipped = opt._grad_clip(list(zip(train_vals, grads)))
-                grads = [g for _, g in clipped]
-            if isinstance(opt, AdamW):
-                new_train, new_opt = opt.apply_functional(
-                    train_vals, grads, opt_state, lr, param_names=pnames)
-            else:
-                new_train, new_opt = opt.apply_functional(
-                    train_vals, grads, opt_state, lr)
+            new_train, new_opt = apply_functional_with_clip(
+                opt, train_vals, grads, opt_state, lr, param_names=pnames)
             return loss, out_vals, new_train, new_buf, new_opt
 
         if self.plan is None:
@@ -235,15 +228,8 @@ class _CompiledStepper:
         pnames = [self.param_names[i] for i in self.t_idx]
 
         def astep(train_vals, grads, opt_state, lr):
-            if opt._grad_clip is not None:
-                clipped = opt._grad_clip(list(zip(train_vals, grads)))
-                grads_ = [g for _, g in clipped]
-            else:
-                grads_ = grads
-            if isinstance(opt, AdamW):
-                return opt.apply_functional(train_vals, grads_, opt_state,
-                                            lr, param_names=pnames)
-            return opt.apply_functional(train_vals, grads_, opt_state, lr)
+            return apply_functional_with_clip(
+                opt, train_vals, grads, opt_state, lr, param_names=pnames)
         return jax.jit(astep, donate_argnums=(0, 2))
 
     def _build_eval(self, n_in):

@@ -17,7 +17,22 @@ from ..framework.autograd import no_grad
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "RMSProp", "Adadelta", "Lamb"]
+           "Adagrad", "RMSProp", "Adadelta", "Lamb",
+           "apply_functional_with_clip"]
+
+
+def apply_functional_with_clip(opt, train_vals, grads, opt_state, lr,
+                               param_names=None):
+    """Jit-side optimizer dispatch shared by every compiled stepper
+    (hapi, fleet PP): grad clip on (value, grad) pairs, then
+    apply_functional — name-aware for AdamW's decoupled decay."""
+    if opt._grad_clip is not None:
+        clipped = opt._grad_clip(list(zip(train_vals, grads)))
+        grads = [g for _, g in clipped]
+    if isinstance(opt, AdamW):
+        return opt.apply_functional(train_vals, grads, opt_state, lr,
+                                    param_names=param_names)
+    return opt.apply_functional(train_vals, grads, opt_state, lr)
 
 
 class L2Decay:

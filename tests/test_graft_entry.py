@@ -41,4 +41,4 @@ def test_dryrun_multichip_reexecs_when_backend_claimed():
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-4000:]
     assert "REEXEC-PATH-OK" in r.stdout
-    assert "mesh dp=" in r.stdout  # the dryrun body itself really ran
+    assert "fleet dp=" in r.stdout  # the dryrun body itself really ran
