@@ -1,44 +1,70 @@
 """Benchmark runner — prints ONE JSON line for the driver.
 
-Metric: GPT (125M-class) training throughput in tokens/sec/chip on the
-local device — fused fwd+bwd+AdamW in one jitted executable, bf16 compute
-with fp32 master params (the BASELINE GPT workload scaled to one chip;
-later rounds add the 1.3B multi-chip config).  vs_baseline is 1.0 when the
-run completes (BASELINE.json publishes no reference numbers).
+Primary metric: GPT (125M-class) training throughput in tokens/sec/chip —
+fused fwd+bwd+AdamW in one jitted executable, bf16 compute with fp32
+master params (the BASELINE GPT workload scaled to one chip).  The
+``extra.configs`` map carries the other BASELINE workloads measured on the
+same chip: GPT-350M (larger single-chip config so the headline MFU is not
+a 125M proxy), ResNet-50 images/sec, and BERT-base AMP tokens/sec.
+
+MFU accounting: model FLOPs per token = 6·N_params (fwd 2N + bwd 4N; the
+tied LM head matmul is covered by counting the embedding table once, the
+input lookup is gather-only) + 6·L·S·H for causal attention scores/values
+(QKᵀ and AV are real executed matmuls; the causal flash kernel computes
+half the S² square, hence 6 not 12 per layer-token).  Dividing by the
+chip's peak bf16 FLOPs gives MFU.
+
+Timing: through the axon PJRT tunnel block_until_ready() returns BEFORE
+execution finishes (~70x inflation) — every loop ends with a host
+readback (float of a value data-dependent on the whole step chain), which
+is a true completion barrier.  tests/test_bench_timing.py guards this.
+
+Dropout note: all benched models run with dropout probability 0.0 (the
+perf-relevant configs train without dropout); nets are put in eval() mode
+purely so no dropout mask ops enter the graph — the math equals train()
+at p=0.
 """
 import json
-import math
+import os
 import time
 
 import numpy as np
 
 
-def main():
+def _readback_sync(x):
+    """True device-completion barrier: D2H of a dependent value."""
+    return float(x)
+
+
+def _timeit(step, iters, *state):
+    """Run ``state = step(*state)`` iters times; the caller's step returns
+    (loss_like_scalar, *new_state).  Returns (seconds, final_loss)."""
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        out = step(*state)
+        loss, state = out[0], out[1:]
+    final = _readback_sync(loss)
+    dt = time.perf_counter() - t0
+    return dt, final, state
+
+
+# ---------------------------------------------------------------------------
+# GPT (125M / 350M): fused fwd+bwd+AdamW, bf16 compute fp32 master
+# ---------------------------------------------------------------------------
+
+def bench_gpt(cfg, B, S, iters, peak):
     import jax
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
     from paddle_tpu.framework import autograd as _ag
     from paddle_tpu.framework.random import rng_scope
-    from paddle_tpu.models import GPTConfig, GPTForPretraining
+    from paddle_tpu.models import GPTForPretraining
 
     paddle.seed(0)
-    on_tpu = jax.default_backend() not in ("cpu",)
-    # 125M-class on the chip; tiny proxy on CPU so the bench always runs
-    if on_tpu:
-        cfg = GPTConfig(vocab_size=50304, hidden_size=768,
-                        num_hidden_layers=12, num_attention_heads=12,
-                        max_position_embeddings=1024)
-        # B=16 is the measured v5e sweet spot (B=8: 31%, B=16: 36.5% MFU)
-        B, S, iters = 16, 1024, 20
-    else:
-        cfg = GPTConfig(vocab_size=1024, hidden_size=128,
-                        num_hidden_layers=2, num_attention_heads=4,
-                        max_position_embeddings=256)
-        B, S, iters = 2, 128, 5
-
     net = GPTForPretraining(cfg)
-    net.eval()  # dropout off (probs are 0.0 anyway)
+    net.eval()  # dropout-mask-free graph; p=0.0 so math == train()
     params = [p for _, p in net.named_parameters()]
     pvals = [p._value for p in params]
 
@@ -86,34 +112,238 @@ def main():
     v0 = [jnp.zeros_like(v) for v in pvals]
     t0 = jnp.zeros((), jnp.int32)
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                  (B, S)).astype("int32"))
 
-    loss, pvals, m0, v0, t0 = step_jit(pvals, m0, v0, t0, ids, ids)
-    # IMPORTANT: sync via host readback — through the axon PJRT tunnel,
-    # block_until_ready() returns before execution finishes, inflating
-    # throughput ~70x; float() forces a D2H of the final value, which is a
-    # true completion barrier on the whole dependency chain.
-    float(loss)  # compile + warmup
-    t_start = time.perf_counter()
-    for _ in range(iters):
-        loss, pvals, m0, v0, t0 = step_jit(pvals, m0, v0, t0, ids, ids)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t_start
+    def run(pv, m, v, t):
+        loss, pv, m, v, t = step_jit(pv, m, v, t, ids, ids)
+        return loss, pv, m, v, t
+
+    loss, pvals, m0, v0, t0 = run(pvals, m0, v0, t0)
+    _readback_sync(loss)  # compile + warmup
+    dt, final_loss, _ = _timeit(run, iters, pvals, m0, v0, t0)
     tokens_per_sec = iters * B * S / dt
 
-    n_params = sum(int(np.prod(v.shape)) for v in pvals)
-    flops_per_tok = 6 * n_params
-    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    n_params = sum(int(np.prod(p.shape)) for p in params)
+    flops_per_tok = 6 * n_params \
+        + 6 * cfg.num_hidden_layers * S * cfg.hidden_size  # causal attn
     mfu = tokens_per_sec * flops_per_tok / peak
+    return {"tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4), "loss": round(final_loss, 4),
+            "params": n_params, "batch": B, "seq": S}
 
+
+# ---------------------------------------------------------------------------
+# ResNet-50: fwd+bwd+SGD-momentum, bf16 compute (BASELINE "ResNet-50 DP")
+# ---------------------------------------------------------------------------
+
+def bench_resnet50(B, iters):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import autograd as _ag
+    from paddle_tpu.framework.random import rng_scope
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    net.train()
+    params = [p for _, p in net.named_parameters()]
+    buffers = [b for _, b in net.named_buffers()]
+    pvals = [p._value for p in params]
+    bvals = [b._value for b in buffers]
+
+    def loss_fn(pv, bv, x, y):
+        olds = [t._value for t in params + buffers]
+        compute = [v.astype(jnp.bfloat16)
+                   if jnp.issubdtype(v.dtype, jnp.floating) else v
+                   for v in pv]
+        for t, v in zip(params, compute):
+            t._value = v
+        for t, v in zip(buffers, bv):
+            t._value = v
+        try:
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                logits = net(paddle.Tensor(x))._value.astype(jnp.float32)
+            new_bv = [t._value for t in buffers]
+            logp = jax.nn.log_softmax(logits, -1)
+            nll = -jnp.take_along_axis(logp, y[:, None], 1).mean()
+            return nll, new_bv
+        finally:
+            for t, v in zip(params + buffers, olds):
+                t._value = v
+
+    lr, mom = 0.1, 0.9
+
+    def step(pv, bv, vel, x, y):
+        (loss, new_bv), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            pv, bv, x, y)
+        new_p, new_vel = [], []
+        for p, gi, vi in zip(pv, g, vel):
+            nv = mom * vi + gi
+            new_p.append(p - lr * nv)
+            new_vel.append(nv)
+        return loss, new_p, new_bv, new_vel
+
+    step_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+    vel0 = [jnp.zeros_like(v) for v in pvals]
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(B, 3, 224, 224).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 1000, (B,)).astype("int32"))
+
+    def run(pv, bv, vel):
+        loss, pv, bv, vel = step_jit(pv, bv, vel, x, y)
+        return loss, pv, bv, vel
+
+    loss, pvals, bvals, vel0 = run(pvals, bvals, vel0)
+    _readback_sync(loss)
+    dt, final_loss, _ = _timeit(run, iters, pvals, bvals, vel0)
+    return {"images_per_sec": round(iters * B / dt, 1),
+            "loss": round(final_loss, 4), "batch": B}
+
+
+# ---------------------------------------------------------------------------
+# BERT-base: MLM-style train step with AMP O2 semantics (bf16 compute,
+# fp32 master) — BASELINE "BERT-base DP+AMP"
+# ---------------------------------------------------------------------------
+
+def bench_bert(B, S, iters, peak):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import autograd as _ag
+    from paddle_tpu.framework.random import rng_scope
+    from paddle_tpu.models import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    cfg = BertConfig()
+    net = BertForPretraining(cfg)
+    net.eval()  # p=0.0 dropout
+    params = [p for _, p in net.named_parameters()]
+    pvals = [p._value for p in params]
+
+    def loss_fn(pv, ids, labels):
+        olds = [p._value for p in params]
+        compute = [v.astype(jnp.bfloat16)
+                   if jnp.issubdtype(v.dtype, jnp.floating) else v
+                   for v in pv]
+        for p, v in zip(params, compute):
+            p._value = v
+        try:
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                out = net(paddle.Tensor(ids))
+            logits = (out[0] if isinstance(out, (tuple, list))
+                      else out)._value.astype(jnp.float32)
+            V = logits.shape[-1]
+            logp = jax.nn.log_softmax(logits.reshape(-1, V), -1)
+            return -jnp.take_along_axis(
+                logp, labels.reshape(-1)[:, None], 1).mean()
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+
+    lr = 1e-4
+
+    def step(pv, ids, labels):
+        loss, g = jax.value_and_grad(loss_fn)(pv, ids, labels)
+        return loss, [p - lr * gi for p, gi in zip(pv, g)]
+
+    step_jit = jax.jit(step, donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                  (B, S)).astype("int32"))
+
+    def run(pv):
+        loss, pv = step_jit(pv, ids, ids)
+        return loss, pv
+
+    loss, pvals = run(pvals)
+    _readback_sync(loss)
+    dt, final_loss, _ = _timeit(run, iters, pvals)
+    tokens_per_sec = iters * B * S / dt
+    n_params = sum(int(np.prod(p.shape)) for p in params)
+    flops_per_tok = 6 * n_params \
+        + 12 * cfg.num_hidden_layers * S * cfg.hidden_size  # bidirectional
+    return {"tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(tokens_per_sec * flops_per_tok / peak, 4),
+            "loss": round(final_loss, 4), "params": n_params,
+            "batch": B, "seq": S}
+
+
+# ---------------------------------------------------------------------------
+
+def main():
+    import jax
+
+    from paddle_tpu.models import GPTConfig
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    which = os.environ.get("BENCH_CONFIGS", "").split(",") \
+        if os.environ.get("BENCH_CONFIGS") else None
+
+    def want(name):
+        return which is None or name in which
+
+    configs = {}
+    primary = None
+    metric = "gpt125m_train_tokens_per_sec_per_chip"
+    if on_tpu:
+        gpt125 = GPTConfig(vocab_size=50304, hidden_size=768,
+                           num_hidden_layers=12, num_attention_heads=12,
+                           max_position_embeddings=1024)
+        # B=24: best measured single-chip throughput (B=8: 31%, B=16:
+        # 36.5%, B=24 fills the MXU further without spilling)
+        if want("gpt125m"):
+            primary = bench_gpt(gpt125, B=24, S=1024, iters=20, peak=peak)
+        if want("gpt350m"):
+            try:
+                gpt350 = GPTConfig(
+                    vocab_size=50304, hidden_size=1024,
+                    num_hidden_layers=24, num_attention_heads=16,
+                    max_position_embeddings=1024)
+                configs["gpt350m"] = bench_gpt(gpt350, B=8, S=1024,
+                                               iters=10, peak=peak)
+            except Exception as e:
+                configs["gpt350m"] = {"error": repr(e)[:200]}
+        if want("resnet50"):
+            try:
+                configs["resnet50"] = bench_resnet50(B=64, iters=10)
+            except Exception as e:
+                configs["resnet50"] = {"error": repr(e)[:200]}
+        if want("bert"):
+            try:
+                configs["bert_base_amp"] = bench_bert(B=16, S=512,
+                                                      iters=10, peak=peak)
+            except Exception as e:
+                configs["bert_base_amp"] = {"error": repr(e)[:200]}
+    else:
+        tiny = GPTConfig(vocab_size=1024, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         max_position_embeddings=256)
+        primary = bench_gpt(tiny, B=2, S=128, iters=5, peak=peak)
+        metric = "gpt_tiny_cpu_proxy_tokens_per_sec"
+
+    if primary is None:
+        # BENCH_CONFIGS excluded gpt125m: promote the first config that
+        # produced a throughput number, labeled by its own name
+        for name, cfg in configs.items():
+            rate = cfg.get("tokens_per_sec") or cfg.get("images_per_sec")
+            if rate:
+                metric = f"{name}_{'tokens' if 'tokens_per_sec' in cfg else 'images'}_per_sec"
+                primary = dict(cfg, tokens_per_sec=rate)
+                break
+        else:
+            raise SystemExit("no benchmark config produced a number: "
+                             + json.dumps(configs))
     print(json.dumps({
-        "metric": "gpt125m_train_tokens_per_sec_per_chip" if on_tpu
-                  else "gpt_tiny_cpu_proxy_tokens_per_sec",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec",
+        "metric": metric,
+        "value": primary["tokens_per_sec"],
+        "unit": "tokens/sec" if "tokens" in metric else "images/sec",
         "vs_baseline": 1.0,
-        "extra": {"loss": round(final_loss, 4), "mfu": round(mfu, 4),
-                  "params": n_params, "batch": B, "seq": S},
+        "extra": {**primary, "configs": configs},
     }))
 
 

@@ -1,0 +1,128 @@
+"""Fused norm / AdamW kernels: CPU-fallback numerics vs plain
+implementations, grads via custom VJP (reference pattern: OpTest numeric
+checks for fused kernels, test/legacy_test/test_fused_*).  The Pallas TPU
+path shares this code; tests exercise the fallback numerics + vjp."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.fused_norm import (
+    fused_layer_norm, fused_rms_norm)
+from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+
+
+class TestFusedNorm:
+    def test_layer_norm_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6, 32).astype("f4")
+        g = rng.randn(32).astype("f4")
+        b = rng.randn(32).astype("f4")
+        y = fused_layer_norm(jnp.asarray(x), jnp.asarray(g),
+                             jnp.asarray(b))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * g + b
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_layer_norm_grads(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(3, 16).astype("f4"))
+        g = jnp.asarray(rng.randn(16).astype("f4"))
+        b = jnp.asarray(rng.randn(16).astype("f4"))
+
+        def f(x, g, b):
+            return jnp.sum(fused_layer_norm(x, g, b) ** 2)
+
+        def ref(x, g, b):
+            mu = jnp.mean(x, -1, keepdims=True)
+            var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+            return jnp.sum(((x - mu) / jnp.sqrt(var + 1e-5) * g + b) ** 2)
+
+        got = jax.grad(f, argnums=(0, 1, 2))(x, g, b)
+        want = jax.grad(ref, argnums=(0, 1, 2))(x, g, b)
+        for a, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_rms_norm_matches_numpy_and_grads(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(5, 24).astype("f4"))
+        g = jnp.asarray(rng.randn(24).astype("f4"))
+        y = fused_rms_norm(x, g)
+        xf = np.asarray(x)
+        ref = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) \
+            * np.asarray(g)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+        def f(x, g):
+            return jnp.sum(fused_rms_norm(x, g) ** 2)
+
+        def fr(x, g):
+            ms = jnp.mean(x * x, -1, keepdims=True)
+            return jnp.sum((x * jax.lax.rsqrt(ms + 1e-6) * g) ** 2)
+
+        got = jax.grad(f, argnums=(0, 1))(x, g)
+        want = jax.grad(fr, argnums=(0, 1))(x, g)
+        for a, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_bf16_input_fp32_stats(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(4, 32).astype("f4") * 100,
+                        jnp.bfloat16)
+        g = jnp.ones(32, jnp.bfloat16)
+        b = jnp.zeros(32, jnp.bfloat16)
+        y = fused_layer_norm(x, g, b)
+        assert y.dtype == jnp.bfloat16
+        yf = np.asarray(y, np.float32)
+        assert np.abs(yf.mean(-1)).max() < 0.05   # normalized in fp32
+
+
+class TestFusedAdamW:
+    def test_matches_reference_update(self):
+        rng = np.random.RandomState(0)
+        shapes = [(8, 16), (16,), (3, 5, 7)]
+        ps = [jnp.asarray(rng.randn(*s).astype("f4")) for s in shapes]
+        gs = [jnp.asarray(rng.randn(*s).astype("f4")) for s in shapes]
+        ms = [jnp.zeros(s, jnp.float32) for s in shapes]
+        vs = [jnp.zeros(s, jnp.float32) for s in shapes]
+        lr, b1, b2, eps, wd = 1e-2, 0.9, 0.95, 1e-8, 0.1
+        mask = [1.0, 0.0, 1.0]   # no decay on the bias-shaped param
+
+        np_, nm, nv = fused_adamw(ps, gs, ms, vs, lr, b1, b2, eps, wd,
+                                  step=1, decay_mask=mask)
+        for p, g, m, v, dm, pn, mn, vn in zip(ps, gs, ms, vs, mask,
+                                              np_, nm, nv):
+            em = (1 - b1) * np.asarray(g)
+            ev = (1 - b2) * np.asarray(g) ** 2
+            mhat = em / (1 - b1)
+            vhat = ev / (1 - b2)
+            upd = mhat / (np.sqrt(vhat) + eps) + wd * dm * np.asarray(p)
+            np.testing.assert_allclose(np.asarray(pn),
+                                       np.asarray(p) - lr * upd,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(mn), em, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(vn), ev, rtol=1e-6)
+
+    def test_multi_step_bias_correction(self):
+        rng = np.random.RandomState(1)
+        p = [jnp.asarray(rng.randn(32).astype("f4"))]
+        g = [jnp.asarray(rng.randn(32).astype("f4"))]
+        m = [jnp.zeros(32, jnp.float32)]
+        v = [jnp.zeros(32, jnp.float32)]
+        # two fused steps == two hand-rolled steps
+        ref_p, ref_m, ref_v = np.asarray(p[0]), np.zeros(32), np.zeros(32)
+        for t in (1, 2):
+            p, m, v = fused_adamw(p, g, m, v, 1e-3, 0.9, 0.999, 1e-8,
+                                  0.01, step=t)
+            ref_m = 0.9 * ref_m + 0.1 * np.asarray(g[0])
+            ref_v = 0.999 * ref_v + 0.001 * np.asarray(g[0]) ** 2
+            mh = ref_m / (1 - 0.9 ** t)
+            vh = ref_v / (1 - 0.999 ** t)
+            ref_p = ref_p - 1e-3 * (mh / (np.sqrt(vh) + 1e-8)
+                                    + 0.01 * ref_p)
+        np.testing.assert_allclose(np.asarray(p[0]), ref_p, rtol=1e-5,
+                                   atol=1e-6)
