@@ -17,12 +17,17 @@ from ...... import nn
 __all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
 
 
-def _top_k_routing(logits, top_k, capacity, jitter_key=None):
-    """Dense GShard routing on raw jnp arrays.
+def _top_k_sparse_routing(logits, top_k, capacity):
+    """Sparse (capacity-bucketed) GShard routing on raw jnp arrays.
 
-    logits: (T, E) fp32. Returns (combine (T,E,C), dispatch bool (T,E,C),
-    aux_loss scalar).  Position-in-expert assigned by cumsum in token
-    order; tokens beyond capacity are dropped (contribute zero).
+    logits: (T, E) fp32. Returns ``(eidx, pos, weight, keep, aux)`` with
+    eidx/pos int32 (T, K) — the chosen expert and its capacity slot for
+    each of a token's K choices — weight fp32 (T, K) the renormalized
+    combine weight (already zeroed for dropped assignments), and keep
+    bool (T, K).  Position-in-expert is assigned by cumsum in token
+    order; tokens beyond capacity are dropped.  This is the O(T*K)
+    routing record that the scatter/gather dispatch consumes; the dense
+    (T, E, C) tensors of :func:`_top_k_routing` are derived from it.
     """
     T, E = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -35,29 +40,56 @@ def _top_k_routing(logits, top_k, capacity, jitter_key=None):
     aux = jnp.sum(me * ce) * E
 
     remaining = gates
-    combine = jnp.zeros((T, E, capacity), jnp.float32)
     # per-expert fill count carried across the k choices so 2nd choices
     # take positions after 1st choices
     fill = jnp.zeros((E,), jnp.int32)
     denom = jnp.zeros((T,), jnp.float32)
-    picks = []
+    eidxs, poss, keeps, probs = [], [], [], []
     for _ in range(top_k):
         idx = jnp.argmax(remaining, axis=-1)            # (T,)
         mask = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # (T, E)
-        pos = jnp.cumsum(mask, axis=0) - 1 + fill[None, :]   # (T, E)
-        keep = (pos < capacity) & (mask > 0)
+        pos_te = jnp.cumsum(mask, axis=0) - 1 + fill[None, :]  # (T, E)
+        pos = jnp.sum(pos_te * mask, axis=-1)           # (T,)
+        keep = pos < capacity
         pos = jnp.clip(pos, 0, capacity - 1)
-        onehot_c = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
-        sel = keep.astype(jnp.float32)[..., None] * onehot_c  # (T,E,C)
         prob = jnp.sum(gates * mask, axis=-1)           # (T,)
-        picks.append((sel, prob, keep))
-        denom = denom + prob * jnp.any(keep, axis=-1)
-        fill = fill + jnp.sum(keep.astype(jnp.int32), axis=0)
+        eidxs.append(idx.astype(jnp.int32))
+        poss.append(pos.astype(jnp.int32))
+        keeps.append(keep)
+        probs.append(prob)
+        denom = denom + prob * keep
+        fill = fill + jnp.sum(mask * keep[:, None].astype(jnp.int32),
+                              axis=0)
         remaining = remaining * (1 - mask)
     denom = jnp.maximum(denom, 1e-9)
-    for sel, prob, keep in picks:
-        combine = combine + sel * (prob / denom)[:, None, None]
-    dispatch = combine > 0
+    eidx = jnp.stack(eidxs, axis=1)
+    pos = jnp.stack(poss, axis=1)
+    keep = jnp.stack(keeps, axis=1)
+    weight = jnp.stack(probs, axis=1) / denom[:, None] \
+        * keep.astype(jnp.float32)
+    return eidx, pos, weight, keep, aux
+
+
+def _densify_routing(eidx, pos, weight, capacity, num_expert):
+    """Sparse routing record -> dense (combine (T,E,C), dispatch bool)."""
+    oh_e = jax.nn.one_hot(eidx, num_expert, dtype=jnp.float32)  # (T,K,E)
+    oh_c = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)     # (T,K,C)
+    combine = jnp.einsum("tke,tkc,tk->tec", oh_e, oh_c, weight)
+    return combine, combine > 0
+
+
+def _top_k_routing(logits, top_k, capacity, jitter_key=None):
+    """Dense GShard routing on raw jnp arrays.
+
+    logits: (T, E) fp32. Returns (combine (T,E,C), dispatch bool (T,E,C),
+    aux_loss scalar).  Derived from the sparse routing record so the
+    dense-einsum and scatter/gather dispatch paths agree bit-for-bit on
+    the routing decision.
+    """
+    E = logits.shape[1]
+    eidx, pos, weight, _, aux = _top_k_sparse_routing(
+        logits, top_k, capacity)
+    combine, dispatch = _densify_routing(eidx, pos, weight, capacity, E)
     return combine, dispatch, aux
 
 
@@ -92,6 +124,17 @@ class BaseGate(nn.Layer):
         return _top_k_routing(logits, self.top_k,
                               self.capacity(num_tokens))
 
+    def route_sparse(self, logits, num_tokens):
+        """raw (T, E) logits -> (eidx, pos, weight, keep, aux, capacity)
+        — the O(T*K) routing record consumed by MoELayer's scatter/gather
+        dispatch (reference global_scatter/global_gather semantics).
+        Subclasses with a custom dense ``route`` policy need not override
+        this; MoELayer falls back to the dense path for them."""
+        cap = self.capacity(num_tokens)
+        eidx, pos, weight, keep, aux = _top_k_sparse_routing(
+            logits, self.top_k, cap)
+        return eidx, pos, weight, keep, aux, cap
+
     def routing(self, x_value):
         """Standalone raw (T, M) -> routing (eager use)."""
         return self.route(x_value @ self.weight._value, x_value.shape[0])
@@ -109,6 +152,11 @@ class NaiveGate(BaseGate):
     def route(self, logits, num_tokens):
         c, d, _ = super().route(logits, num_tokens)
         return c, d, jnp.zeros((), jnp.float32)
+
+    def route_sparse(self, logits, num_tokens):
+        eidx, pos, weight, keep, _, cap = super().route_sparse(
+            logits, num_tokens)
+        return eidx, pos, weight, keep, jnp.zeros((), jnp.float32), cap
 
 
 class GShardGate(BaseGate):
@@ -133,7 +181,7 @@ class SwitchGate(BaseGate):
                          else capacity)
         self.switch_eps = switch_eps
 
-    def route(self, logits, num_tokens):
+    def _jitter(self, logits):
         # Switch jitters logits multiplicatively during training for
         # exploration (reference: switch_gate.py uniform(1-eps, 1+eps));
         # folded in via the framework RNG so routing stays reproducible
@@ -146,4 +194,14 @@ class SwitchGate(BaseGate):
                     key, logits.shape, jnp.float32,
                     1.0 - self.switch_eps, 1.0 + self.switch_eps)
                 logits = logits * noise
-        return _top_k_routing(logits, 1, self.capacity(num_tokens))
+        return logits
+
+    def route(self, logits, num_tokens):
+        return _top_k_routing(self._jitter(logits), 1,
+                              self.capacity(num_tokens))
+
+    def route_sparse(self, logits, num_tokens):
+        cap = self.capacity(num_tokens)
+        eidx, pos, weight, keep, aux = _top_k_sparse_routing(
+            self._jitter(logits), 1, cap)
+        return eidx, pos, weight, keep, aux, cap

@@ -3,14 +3,19 @@ python/paddle/incubate/distributed/models/moe/moe_layer.py — MoELayer over
 global_scatter/global_gather all-to-all dispatch CUDA ops,
 paddle/fluid/operators/collective/global_scatter_op.cu).
 
-TPU-native design: dispatch/combine are DENSE one-hot einsums (the GShard
-formulation) instead of index scatter ops — static shapes, MXU-friendly,
-and differentiable by construction.  Expert parallelism is a *sharding*:
-expert-stacked weights (E, ...) and the dispatched activations (E, C, M)
-carry a PartitionSpec on the expert mesh axis, and XLA's partitioner
-inserts exactly the all-to-all wire pattern of the reference's
-global_scatter/global_gather.  No per-rank expert slicing, no manual
-alltoall.
+TPU-native design: two dispatch modes, both static-shaped and
+differentiable by construction.  The default *sparse* mode is
+capacity-bucketed scatter/gather — each of a token's K choices lands in
+its (expert, slot) row of the (E*C, M) dispatch buffer via one
+scatter-add (O(T*K*M) work, the reference's global_scatter semantics)
+and combines back with one gather — so dispatch cost no longer scales
+with the expert count.  The *dense* mode keeps the GShard one-hot-einsum
+formulation (O(T*E*C*M), MXU-friendly) as the small-E fallback and for
+custom gates that only define a dense routing policy.  Expert
+parallelism is a *sharding* in either mode: expert-stacked weights
+(E, ...) and the dispatched activations (E, C, M) carry a PartitionSpec
+on the expert mesh axis, and XLA's partitioner inserts the all-to-all
+wire pattern of the reference's global_scatter/global_gather.
 """
 import numpy as np
 import jax
@@ -79,11 +84,14 @@ class MoELayer(nn.Layer):
 
     def __init__(self, d_model, experts, gate=None, moe_group=None,
                  mp_group=None, recompute_interval=0, recompute_ctx=None,
-                 expert_axis="model"):
+                 expert_axis="model", dispatch_mode="auto"):
         super().__init__()
+        if dispatch_mode not in ("auto", "dense", "sparse"):
+            raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
         self.d_model = d_model
         self.num_expert = len(experts)
         self.expert_axis = expert_axis
+        self.dispatch_mode = dispatch_mode
         self.gate = _make_gate(gate, d_model, self.num_expert)
         # exact-type check: an ExpertLayer SUBCLASS may override forward,
         # which the stacked einsum fast path would silently ignore
@@ -106,23 +114,85 @@ class MoELayer(nn.Layer):
         else:
             self.experts = nn.LayerList(experts)
 
+    def _use_sparse(self):
+        """Sparse dispatch needs the gate's route_sparse to reflect its
+        routing policy: a subclass that overrides ``route`` (a custom
+        dense policy) without also overriding ``route_sparse`` must take
+        the dense path."""
+        if self.dispatch_mode == "dense":
+            return False
+        if not self._stacked:
+            if self.dispatch_mode == "sparse":
+                raise ValueError(
+                    "dispatch_mode='sparse' needs homogeneous ExpertLayer "
+                    "experts (the stacked fast path); heterogeneous or "
+                    "subclassed experts run the dense generic path")
+            return False
+        cls = type(self.gate)
+        mro = cls.__mro__
+        route_owner = next(i for i, c in enumerate(mro)
+                           if "route" in c.__dict__)
+        sparse_owner = next((i for i, c in enumerate(mro)
+                             if "route_sparse" in c.__dict__), None)
+        supported = sparse_owner is not None and sparse_owner <= route_owner
+        if self.dispatch_mode == "sparse":
+            if not supported:
+                raise ValueError(
+                    f"gate {cls.__name__} overrides route() without a "
+                    "matching route_sparse(); use dispatch_mode='dense'")
+            return True
+        # auto: dense einsum only wins at tiny expert counts
+        return supported and self.num_expert > 4
+
+    def _expert_ffn(self, ein, w1, b1, w2, b2):
+        """(E, C, M) dispatched tokens -> (E, C, M) expert outputs."""
+        h = jnp.einsum("ecm,emh->ech", ein, w1) + b1[:, None, :]
+        h = jax.nn.gelu(h, approximate=False) if self._act == "gelu" \
+            else jax.nn.relu(h)
+        return jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+
     # -- dense dispatch core (raw jnp) --------------------------------------
     def _moe_fn_stacked(self, xv, gw, w1, b1, w2, b2):
         T, M = xv.shape[0], xv.shape[1]
         logits = xv @ gw
         combine, dispatch, aux = self.gate.route(logits, T)
-        E = self.num_expert
         # (T,E,C) x (T,M) -> (E,C,M), sharded on the expert axis so the
         # partitioner emits the global_scatter all-to-all
         ein = jnp.einsum("tec,tm->ecm", dispatch.astype(xv.dtype), xv)
         ein = _constraint(ein, (self.expert_axis, None, None))
-        h = jnp.einsum("ecm,emh->ech", ein, w1) + b1[:, None, :]
-        h = jax.nn.gelu(h, approximate=False) if self._act == "gelu" \
-            else jax.nn.relu(h)
-        eo = jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+        eo = self._expert_ffn(ein, w1, b1, w2, b2)
         eo = _constraint(eo, (self.expert_axis, None, None))
         # combine (global_gather): (T,E,C) x (E,C,M) -> (T,M)
         out = jnp.einsum("tec,ecm->tm", combine.astype(xv.dtype), eo)
+        return out, aux
+
+    # -- sparse (scatter/gather) dispatch core ------------------------------
+    def _moe_fn_stacked_sparse(self, xv, gw, w1, b1, w2, b2):
+        """Capacity-bucketed scatter/gather dispatch: O(T*K*M) instead of
+        the dense einsum's O(T*E*C*M) (reference global_scatter /
+        global_gather semantics, global_scatter_op.cu)."""
+        T, M = xv.shape[0], xv.shape[1]
+        E = self.num_expert
+        logits = xv @ gw
+        eidx, pos, weight, keep, aux, C = self.gate.route_sparse(logits, T)
+        K = eidx.shape[1]
+        flat = (eidx * C + pos).reshape(-1)              # (T*K,) slot ids
+        # global_scatter: each kept (token, choice) row lands in its
+        # (expert, slot) row.  Slots are unique per expert by cumsum
+        # construction, so the scatter-add never sums two nonzero rows;
+        # dropped assignments contribute an all-zero update.
+        upd = (xv[:, None, :] * keep[..., None].astype(xv.dtype)
+               ).reshape(T * K, M)
+        buf = jnp.zeros((E * C, M), xv.dtype).at[flat].add(upd)
+        ein = _constraint(buf.reshape(E, C, M),
+                          (self.expert_axis, None, None))
+        eo = self._expert_ffn(ein, w1, b1, w2, b2)
+        eo = _constraint(eo, (self.expert_axis, None, None))
+        # global_gather: pull each assignment's expert-output row back
+        # and reduce over the K choices with the renormalized weights
+        # (already zero for dropped assignments)
+        rows = eo.reshape(E * C, M)[flat].reshape(T, K, M)
+        out = jnp.einsum("tkm,tk->tm", rows, weight.astype(xv.dtype))
         return out, aux
 
     def _moe_fn_generic(self, xv, param_tensors, param_vals):
@@ -145,8 +215,10 @@ class MoELayer(nn.Layer):
         shape = x.shape
         flat = call_op(lambda v: v.reshape(-1, shape[-1]), x)
         if self._stacked:
+            fn = self._moe_fn_stacked_sparse if self._use_sparse() \
+                else self._moe_fn_stacked
             out, aux = call_op(
-                self._moe_fn_stacked, flat, self.gate.weight,
+                fn, flat, self.gate.weight,
                 self.expert_w1, self.expert_b1, self.expert_w2,
                 self.expert_b2)
         else:

@@ -17,8 +17,6 @@ corrections) need no SMEM plumbing.  Weight-decay masking: pass
 
 Falls back to plain jnp math off-TPU (same numerics, CPU-testable).
 """
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -53,7 +51,7 @@ def _flatten_concat(arrs, dtype=jnp.float32):
     total = sum(sizes)
     pad = (-total) % _ROW
     cat = jnp.concatenate(flats + ([jnp.zeros(pad, dtype)] if pad else []))
-    return cat.reshape(-1, _ROW), sizes
+    return cat.reshape(-1, _ROW), sizes, pad
 
 
 def _split_back(flat2, sizes, shapes, dtypes):
@@ -93,11 +91,10 @@ def fused_adamw(params, grads, ms, vs, lr, beta1=0.9, beta2=0.999,
             new_v.append(nv)
         return new_p, new_m, new_v
 
-    p2, sizes = _flatten_concat(params)
-    g2, _ = _flatten_concat(grads)
-    m2, _ = _flatten_concat(ms)
-    v2, _ = _flatten_concat(vs)
-    pad = (-sum(sizes)) % _ROW
+    p2, sizes, pad = _flatten_concat(params)
+    g2, _, _ = _flatten_concat(grads)
+    m2, _, _ = _flatten_concat(ms)
+    v2, _, _ = _flatten_concat(vs)
     wd_vec = jnp.concatenate(
         [jnp.full(n, float(dm), jnp.float32)
          for n, dm in zip(sizes, mask)] +
