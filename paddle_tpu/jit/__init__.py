@@ -1,13 +1,16 @@
 """dygraph→static (reference: python/paddle/jit/ — AST transpiler +
 ProgramTranslator + SOT bytecode capture).
 
-TPU-native: JAX traces Python directly, so there is no AST rewriting.
-``to_static`` wraps a Layer/function in a ``StaticFunction`` that traces the
-forward as a pure function of (params, buffers, inputs) through the
-functional seam and compiles it with ``jax.jit`` — the jaxpr is the
-"Program", the XLA executable is the "CompiledProgram".  Gradients flow
-through the compiled call via the eager tape (the whole jitted forward
-becomes ONE tape node), mirroring PartialProgramLayer's run-program op.
+TPU-native: JAX traces Python directly, so most functions need no AST
+rewriting.  ``to_static`` wraps a Layer/function in a ``StaticFunction``
+that traces the forward as a pure function of (params, buffers, inputs)
+through the functional seam and compiles it with ``jax.jit`` — the jaxpr
+is the "Program", the XLA executable is the "CompiledProgram".  Gradients
+flow through the compiled call via the eager tape (the whole jitted
+forward becomes ONE tape node), mirroring PartialProgramLayer's
+run-program op.  Data-dependent Python ``if``/``while`` is handled by a
+single AST pass (``jit.dy2static``) that lowers tensor-predicated control
+flow to ``lax.cond``/``lax.while_loop`` at runtime.
 
 ``paddle.jit.save``/``load`` serialize StableHLO + weights — the
 ``.pdmodel``/``.pdiparams`` equivalent.
@@ -60,8 +63,16 @@ def _spec_key(args):
 
 class StaticFunction:
     def __init__(self, function, input_spec=None, build_strategy=None,
-                 layer=None, full_graph=True):
+                 layer=None, full_graph=True, _transformed=None):
         self._function = function
+        if _transformed is None and not getattr(function, "_not_to_static",
+                                                False):
+            from .dy2static import transform_function
+            try:
+                _transformed, _ = transform_function(function)
+            except Exception:
+                _transformed = function  # keep plain tracing semantics
+        self._transformed = _transformed or function
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
@@ -71,7 +82,8 @@ class StaticFunction:
         if instance is None:
             return self
         return StaticFunction(self._function, self._input_spec,
-                              layer=instance)
+                              layer=instance,
+                              _transformed=self._transformed)
 
     @property
     def _bound_layer(self):
@@ -88,7 +100,7 @@ class StaticFunction:
     def _compile(self, key, template_args, training):
         params, buffers = self._params_buffers()
         n_args = len(template_args)
-        fn = self._function
+        fn = self._transformed
         layer = self._layer
 
         def pure(key_arr, param_vals, buffer_vals, *arg_vals):

@@ -362,3 +362,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
             return [np.asarray(o) for o in outs]
 
     return _LoadedProgram(), feed_names, list(range(meta["n_fetch"]))
+
+
+# imported last: static.nn pulls in jit.dy2static, which imports back into
+# this (by then fully-populated) module for InputSpec
+from . import nn  # noqa: E402

@@ -7,6 +7,7 @@ kernel library, so there is no per-backend registry — one definition serves
 CPU and TPU, eager and traced.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
@@ -20,6 +21,10 @@ def ensure_tensor(x, ref_dtype=None):
     if isinstance(x, (int, float, bool, complex)):
         # keep python scalars weakly typed via closure-free asarray
         return Tensor(jnp.asarray(x))
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        # raw jax values (incl. tracers inside lax control flow, which
+        # np.asarray would try to concretize) wrap directly
+        return Tensor(x)
     arr = np.asarray(x)
     if arr.dtype == np.float64:
         arr = arr.astype(dtypes.get_default_dtype())
