@@ -59,17 +59,32 @@ class HybridParallelOptimizer:
     (reference: meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer
     .py).  Under GSPMD the grad allreduce is already in the compiled step;
     what remains is the cross-axis global-norm clip, which works on the
-    full (replicated-view) grads transparently."""
+    full (replicated-view) grads transparently.  Strategy-driven
+    meta-optimizers (lars/dgc swap, localsgd wrap, gradient_merge
+    accumulation) are applied here, mirroring fleet's meta-optimizer
+    pass."""
 
     def __init__(self, optimizer, hcg=None, strategy=None):
-        self._inner = optimizer
+        from ..meta_optimizers import (apply_meta_optimizers,
+                                       GradientMergeHelper)
+        self._inner = apply_meta_optimizers(optimizer, strategy)
         self._hcg = hcg
         self._strategy = strategy
+        self._gm = None
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            cfg = strategy.gradient_merge_configs or {}
+            self._gm = GradientMergeHelper(cfg.get("k_steps", 1),
+                                           cfg.get("avg", True))
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
     def step(self):
+        if self._gm is not None:
+            params = self._inner._parameter_list or []
+            if self._gm.accumulate(params):
+                return  # still accumulating: no apply this micro-step
         self._inner.step()
 
     def clear_grad(self, *a, **k):
