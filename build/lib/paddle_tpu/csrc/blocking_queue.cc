@@ -1,0 +1,95 @@
+// Bounded blocking byte-buffer queue: the C++ core of the DataLoader
+// prefetch pipeline (reference: the reader blocking queue under
+// paddle/fluid/operators/reader/ + LoDTensorBlockingQueueHolder that the
+// Python DataLoader feeds).  Worker processes produce batches; a
+// collector pushes them here; the training loop pops.  The bounded
+// capacity is the `prefetch_factor` backpressure.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+#include "common.h"
+
+namespace {
+
+struct BlockingQueue {
+  size_t capacity;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<std::string> items;
+  bool closed = false;
+
+  explicit BlockingQueue(size_t cap) : capacity(cap ? cap : 1) {}
+};
+
+}  // namespace
+
+PT_EXPORT int64_t pt_queue_create(int capacity) {
+  return reinterpret_cast<int64_t>(new BlockingQueue(
+      static_cast<size_t>(capacity > 0 ? capacity : 1)));
+}
+
+// 0 ok; -1 timeout; -2 closed.
+PT_EXPORT int pt_queue_push(int64_t h, const uint8_t* data, int64_t len,
+                            int64_t timeout_ms) {
+  auto* q = reinterpret_cast<BlockingQueue*>(h);
+  std::unique_lock<std::mutex> g(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(g, pred);
+  } else if (!q->not_full.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return -1;
+  }
+  if (q->closed) return -2;
+  if (data == nullptr || len <= 0)
+    q->items.emplace_back();
+  else
+    q->items.emplace_back(reinterpret_cast<const char*>(data),
+                          static_cast<size_t>(len));
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// Returns length (>=0) with *out malloc'd; -1 timeout; -2 closed+drained.
+PT_EXPORT int64_t pt_queue_pop(int64_t h, int64_t timeout_ms, uint8_t** out) {
+  auto* q = reinterpret_cast<BlockingQueue*>(h);
+  std::unique_lock<std::mutex> g(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(g, pred);
+  } else if (!q->not_empty.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed and drained
+  std::string item = std::move(q->items.front());
+  q->items.pop_front();
+  q->not_full.notify_one();
+  g.unlock();
+  *out = static_cast<uint8_t*>(pt::copy_out(item.data(), item.size()));
+  return static_cast<int64_t>(item.size());
+}
+
+PT_EXPORT int pt_queue_size(int64_t h) {
+  auto* q = reinterpret_cast<BlockingQueue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+// Close wakes all waiters; pending items remain poppable (drain-then-end).
+PT_EXPORT void pt_queue_close(int64_t h) {
+  auto* q = reinterpret_cast<BlockingQueue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+PT_EXPORT void pt_queue_destroy(int64_t h) {
+  delete reinterpret_cast<BlockingQueue*>(h);
+}

@@ -1,0 +1,30 @@
+// Shared helpers for the paddle_tpu native runtime layer.
+//
+// The reference framework's runtime around the compute path is C++
+// (allocators, stores, readers, tracers).  On TPU, XLA/PJRT own device
+// memory and scheduling, so the native layer here covers the host-side
+// runtime the compiler does NOT provide: rendezvous store, bounded
+// prefetch queues for the data pipeline, and a low-overhead host tracer.
+// Exposed as a C ABI consumed from Python via ctypes (no pybind11 in the
+// image).
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+// All buffers returned to the caller are malloc'd; release with
+// pt_buffer_free.
+PT_EXPORT void pt_buffer_free(void* p);
+
+namespace pt {
+
+inline void* copy_out(const void* src, size_t n) {
+  void* p = ::malloc(n ? n : 1);
+  if (p && n) ::memcpy(p, src, n);
+  return p;
+}
+
+}  // namespace pt

@@ -1,0 +1,168 @@
+// Host tracer: low-overhead span collection + chrome-trace export
+// (reference: paddle/fluid/platform/profiler/host_tracer.cc +
+// chrometracinglogger.cc).  Device-side tracing on TPU comes from
+// jax.profiler/XLA; this collector provides the RecordEvent host spans
+// and the summary statistics source, without Python-side allocation in
+// the hot path.
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+struct Span {
+  std::string name;
+  std::string cat;
+  int64_t t0_ns;
+  int64_t t1_ns;
+  int64_t tid;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<Span> spans;
+  bool enabled = false;
+};
+
+Tracer g_tracer;
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t tid() { return static_cast<int64_t>(::syscall(SYS_gettid)); }
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          ::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+PT_EXPORT void pt_tracer_enable(int on) {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.enabled = (on != 0);
+}
+
+PT_EXPORT int pt_tracer_enabled() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  return g_tracer.enabled ? 1 : 0;
+}
+
+// Begin a span: returns an opaque handle (0 when disabled).
+PT_EXPORT int64_t pt_tracer_span_begin(const char* name, const char* cat) {
+  {
+    std::lock_guard<std::mutex> g(g_tracer.mu);
+    if (!g_tracer.enabled) return 0;
+  }
+  auto* s = new Span{name ? name : "", cat ? cat : "UserDefined", now_ns(), 0,
+                     tid()};
+  return reinterpret_cast<int64_t>(s);
+}
+
+PT_EXPORT void pt_tracer_span_end(int64_t h) {
+  if (!h) return;
+  auto* s = reinterpret_cast<Span*>(h);
+  s->t1_ns = now_ns();
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.spans.emplace_back(std::move(*s));
+  delete s;
+}
+
+// Record a complete span with caller-supplied timestamps (ns).
+PT_EXPORT void pt_tracer_record(const char* name, const char* cat,
+                                int64_t t0_ns, int64_t t1_ns) {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  if (!g_tracer.enabled) return;
+  g_tracer.spans.push_back(
+      Span{name ? name : "", cat ? cat : "UserDefined", t0_ns, t1_ns, tid()});
+}
+
+PT_EXPORT int64_t pt_tracer_num_spans() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  return static_cast<int64_t>(g_tracer.spans.size());
+}
+
+PT_EXPORT void pt_tracer_clear() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.spans.clear();
+}
+
+// Chrome trace-event JSON ("X" complete events, ts/dur in microseconds).
+// Returns malloc'd UTF-8 and its length via *out.
+PT_EXPORT int64_t pt_tracer_export_chrome(uint8_t** out) {
+  std::vector<Span> spans;
+  {
+    std::lock_guard<std::mutex> g(g_tracer.mu);
+    spans = g_tracer.spans;
+  }
+  std::string j = "{\"traceEvents\":[";
+  char buf[256];
+  for (size_t i = 0; i < spans.size(); ++i) {
+    const Span& s = spans[i];
+    if (i) j += ',';
+    j += "{\"name\":\"" + json_escape(s.name) + "\",\"cat\":\"" +
+         json_escape(s.cat) + "\",\"ph\":\"X\"";
+    ::snprintf(buf, sizeof(buf),
+               ",\"ts\":%.3f,\"dur\":%.3f,\"pid\":%d,\"tid\":%lld}",
+               s.t0_ns / 1e3, (s.t1_ns - s.t0_ns) / 1e3,
+               static_cast<int>(::getpid()),
+               static_cast<long long>(s.tid));
+    j += buf;
+  }
+  j += "]}";
+  *out = static_cast<uint8_t*>(pt::copy_out(j.data(), j.size()));
+  return static_cast<int64_t>(j.size());
+}
+
+// Packed binary dump for Python-side statistics:
+// repeated records of [u32 namelen][name][u32 catlen][cat][i64 t0][i64 t1][i64 tid]
+PT_EXPORT int64_t pt_tracer_dump(uint8_t** out) {
+  std::vector<Span> spans;
+  {
+    std::lock_guard<std::mutex> g(g_tracer.mu);
+    spans = g_tracer.spans;
+  }
+  std::string blob;
+  for (const Span& s : spans) {
+    uint32_t nl = static_cast<uint32_t>(s.name.size());
+    uint32_t cl = static_cast<uint32_t>(s.cat.size());
+    blob.append(reinterpret_cast<const char*>(&nl), 4);
+    blob.append(s.name);
+    blob.append(reinterpret_cast<const char*>(&cl), 4);
+    blob.append(s.cat);
+    blob.append(reinterpret_cast<const char*>(&s.t0_ns), 8);
+    blob.append(reinterpret_cast<const char*>(&s.t1_ns), 8);
+    blob.append(reinterpret_cast<const char*>(&s.tid), 8);
+  }
+  *out = static_cast<uint8_t*>(pt::copy_out(blob.data(), blob.size()));
+  return static_cast<int64_t>(blob.size());
+}

@@ -1,0 +1,346 @@
+// TCPStore: rank-0-hosted key-value store used for multi-process
+// rendezvous and small control-plane exchange (reference:
+// paddle/fluid/distributed/store/tcp_store.cc — there it exchanges NCCL
+// unique ids; here it bootstraps process groups / barriers around
+// jax.distributed, which handles the PJRT coordination itself).
+//
+// Wire protocol (all little-endian, same-arch cluster assumption):
+//   request : u8 op | u32 keylen | key bytes | u64 payloadlen | payload
+//   response: u8 status (0 ok, 1 not-found/timeout) | u64 len | bytes
+// Ops: SET=1 (payload = value), GET=2 (payload = i64 timeout_ms; blocks
+// server-side until key exists), ADD=3 (payload = i64 delta; value kept
+// as i64 LE; returns new value), WAIT=4 (payload = i64 timeout_ms),
+// DEL=5, NUMKEYS=6.
+//
+// Server: one acceptor thread + one thread per connection (connections
+// are few — one per worker process).  Blocking GET/WAIT sit on a
+// condition_variable keyed by the shared map, exactly the reference's
+// design.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+enum Op : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, DEL = 5, NUMKEYS = 6 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct StoreServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::mutex conn_mu;
+  std::vector<std::thread> handlers;
+  std::vector<int> conn_fds;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> kv;
+
+  ~StoreServer() { shutdown(); }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    cv.notify_all();
+    if (acceptor.joinable()) acceptor.join();
+    std::lock_guard<std::mutex> g(conn_mu);
+    // Wake handlers parked in recv() on live client connections.
+    for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : handlers)
+      if (t.joinable()) t.join();
+  }
+
+  void handle(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      uint32_t keylen;
+      uint64_t paylen;
+      if (!read_full(fd, &op, 1) || !read_full(fd, &keylen, 4)) break;
+      std::string key(keylen, '\0');
+      if (keylen && !read_full(fd, &key[0], keylen)) break;
+      if (!read_full(fd, &paylen, 8)) break;
+      std::string payload(paylen, '\0');
+      if (paylen && !read_full(fd, &payload[0], paylen)) break;
+
+      uint8_t status = 0;
+      std::string out;
+      switch (op) {
+        case SET: {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = payload;
+          cv.notify_all();
+          break;
+        }
+        case GET:
+        case WAIT: {
+          if (payload.size() < sizeof(int64_t)) {
+            status = 1;
+            break;
+          }
+          int64_t timeout_ms;
+          ::memcpy(&timeout_ms, payload.data(), sizeof(timeout_ms));
+          std::unique_lock<std::mutex> g(mu);
+          auto pred = [&] { return stop.load() || kv.count(key) > 0; };
+          bool ok;
+          if (timeout_ms < 0) {
+            cv.wait(g, pred);
+            ok = kv.count(key) > 0;
+          } else {
+            ok = cv.wait_for(g, std::chrono::milliseconds(timeout_ms), pred) &&
+                 kv.count(key) > 0;
+          }
+          if (!ok) {
+            status = 1;
+          } else if (op == GET) {
+            out = kv[key];
+          }
+          break;
+        }
+        case ADD: {
+          if (payload.size() < sizeof(int64_t)) {
+            status = 1;
+            break;
+          }
+          int64_t delta;
+          ::memcpy(&delta, payload.data(), sizeof(delta));
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == sizeof(int64_t))
+            ::memcpy(&cur, it->second.data(), sizeof(cur));
+          cur += delta;
+          kv[key].assign(reinterpret_cast<const char*>(&cur), sizeof(cur));
+          out.assign(reinterpret_cast<const char*>(&cur), sizeof(cur));
+          cv.notify_all();
+          break;
+        }
+        case DEL: {
+          std::lock_guard<std::mutex> g(mu);
+          status = kv.erase(key) ? 0 : 1;
+          break;
+        }
+        case NUMKEYS: {
+          std::lock_guard<std::mutex> g(mu);
+          int64_t n = static_cast<int64_t>(kv.size());
+          out.assign(reinterpret_cast<const char*>(&n), sizeof(n));
+          break;
+        }
+        default:
+          status = 1;
+      }
+      uint64_t outlen = out.size();
+      if (!write_full(fd, &status, 1) || !write_full(fd, &outlen, 8) ||
+          (outlen && !write_full(fd, out.data(), outlen)))
+        break;
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.push_back(fd);
+      handlers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // serialize request/response pairs
+
+  ~StoreClient() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  // status 0 ok; 1 miss/timeout; -1 transport error
+  int request(uint8_t op, const char* key, const void* payload,
+              uint64_t paylen, std::string* out) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t keylen = static_cast<uint32_t>(::strlen(key));
+    if (!write_full(fd, &op, 1) || !write_full(fd, &keylen, 4) ||
+        !write_full(fd, key, keylen) || !write_full(fd, &paylen, 8) ||
+        (paylen && !write_full(fd, payload, paylen)))
+      return -1;
+    uint8_t status;
+    uint64_t outlen;
+    if (!read_full(fd, &status, 1) || !read_full(fd, &outlen, 8)) return -1;
+    out->resize(outlen);
+    if (outlen && !read_full(fd, &(*out)[0], outlen)) return -1;
+    return status;
+  }
+};
+
+}  // namespace
+
+PT_EXPORT void pt_buffer_free(void* p) { ::free(p); }
+
+PT_EXPORT int64_t pt_store_server_start(int port) {
+  auto* s = new StoreServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return 0;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    delete s;
+    return 0;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->acceptor = std::thread([s] { s->accept_loop(); });
+  return reinterpret_cast<int64_t>(s);
+}
+
+PT_EXPORT int pt_store_server_port(int64_t h) {
+  return reinterpret_cast<StoreServer*>(h)->port;
+}
+
+PT_EXPORT void pt_store_server_stop(int64_t h) {
+  auto* s = reinterpret_cast<StoreServer*>(h);
+  s->shutdown();
+  delete s;
+}
+
+PT_EXPORT int64_t pt_store_client_connect(const char* host, int port,
+                                          int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    char portstr[16];
+    ::snprintf(portstr, sizeof(portstr), "%d", port);
+    if (::getaddrinfo(host, portstr, &hints, &res) == 0 && res) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        ::freeaddrinfo(res);
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto* c = new StoreClient();
+        c->fd = fd;
+        return reinterpret_cast<int64_t>(c);
+      }
+      if (fd >= 0) ::close(fd);
+      ::freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return 0;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+PT_EXPORT void pt_store_client_close(int64_t h) {
+  delete reinterpret_cast<StoreClient*>(h);
+}
+
+PT_EXPORT int pt_store_set(int64_t h, const char* key, const uint8_t* data,
+                           int64_t len) {
+  std::string out;
+  return reinterpret_cast<StoreClient*>(h)->request(SET, key, data,
+                                                    static_cast<uint64_t>(len),
+                                                    &out);
+}
+
+// Returns value length (>=0) and sets *out (malloc'd); -1 on
+// miss/timeout, -2 on transport error.
+PT_EXPORT int64_t pt_store_get(int64_t h, const char* key, int64_t timeout_ms,
+                               uint8_t** out) {
+  std::string v;
+  int st = reinterpret_cast<StoreClient*>(h)->request(
+      GET, key, &timeout_ms, sizeof(timeout_ms), &v);
+  if (st != 0) return st == 1 ? -1 : -2;
+  *out = static_cast<uint8_t*>(pt::copy_out(v.data(), v.size()));
+  return static_cast<int64_t>(v.size());
+}
+
+// Returns the post-add counter value; INT64_MIN on error.
+PT_EXPORT int64_t pt_store_add(int64_t h, const char* key, int64_t delta) {
+  std::string v;
+  int st = reinterpret_cast<StoreClient*>(h)->request(ADD, key, &delta,
+                                                      sizeof(delta), &v);
+  if (st != 0 || v.size() != sizeof(int64_t)) return INT64_MIN;
+  int64_t r;
+  ::memcpy(&r, v.data(), sizeof(r));
+  return r;
+}
+
+PT_EXPORT int pt_store_wait(int64_t h, const char* key, int64_t timeout_ms) {
+  std::string v;
+  return reinterpret_cast<StoreClient*>(h)->request(WAIT, key, &timeout_ms,
+                                                    sizeof(timeout_ms), &v);
+}
+
+PT_EXPORT int pt_store_delete(int64_t h, const char* key) {
+  std::string v;
+  return reinterpret_cast<StoreClient*>(h)->request(DEL, key, nullptr, 0, &v);
+}
+
+PT_EXPORT int64_t pt_store_num_keys(int64_t h) {
+  std::string v;
+  int st = reinterpret_cast<StoreClient*>(h)->request(NUMKEYS, "", nullptr, 0,
+                                                      &v);
+  if (st != 0 || v.size() != sizeof(int64_t)) return -1;
+  int64_t r;
+  ::memcpy(&r, v.data(), sizeof(r));
+  return r;
+}

@@ -1,0 +1,99 @@
+"""Device API (reference: python/paddle/device/)."""
+import jax
+
+from ..framework.core import set_device, get_device  # noqa: F401
+
+__all__ = ["set_device", "get_device", "get_available_device",
+           "get_available_custom_device", "is_compiled_with_cuda", "cuda"]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def device_count():
+    return len(jax.devices())
+
+
+class _CudaNamespace:
+    """paddle.device.cuda compat — mapped onto the TPU device."""
+
+    @staticmethod
+    def device_count():
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            dev = jax.devices()[0]
+            stats = dev.memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            dev = jax.devices()[0]
+            stats = dev.memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _CudaNamespace.memory_allocated(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _CudaNamespace.max_memory_allocated(device)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        for d in jax.live_arrays():
+            d.block_until_ready()
+            break
+
+    class Event:
+        def __init__(self, *a, **k):
+            pass
+
+        def record(self, *a):
+            pass
+
+        def synchronize(self):
+            pass
+
+    class Stream:
+        def __init__(self, *a, **k):
+            pass
+
+        def synchronize(self):
+            pass
+
+
+cuda = _CudaNamespace()
+
+
+def synchronize(device=None):
+    cuda.synchronize()
+
+
+class tpu:
+    """paddle.device.tpu — first-class device namespace."""
+    device_count = staticmethod(_CudaNamespace.device_count)
+    memory_allocated = staticmethod(_CudaNamespace.memory_allocated)
+    max_memory_allocated = staticmethod(_CudaNamespace.max_memory_allocated)
+    synchronize = staticmethod(_CudaNamespace.synchronize)

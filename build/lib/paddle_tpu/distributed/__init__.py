@@ -1,0 +1,29 @@
+"""Distributed API (reference: python/paddle/distributed/).
+
+M2 fills this out (mesh topology, comm API over shard_map, DataParallel,
+sharding); this module provides the env/bootstrap layer used everywhere.
+"""
+import os
+
+from . import env as _env
+from .env import (get_rank, get_world_size, init_parallel_env,  # noqa: F401
+                  ParallelEnv, is_initialized, parallel_device_count)
+from .collective import (all_reduce, all_gather, all_gather_object,  # noqa: F401
+                         reduce_scatter, alltoall, alltoall_single,
+                         broadcast, reduce, scatter, send, recv, barrier,
+                         new_group, wait, get_group, destroy_process_group,
+                         ReduceOp, stream)
+from .parallel import DataParallel  # noqa: F401
+from .mesh import (ProcessMesh, get_mesh, set_mesh, auto_mesh,  # noqa: F401
+                   shard_tensor, shard_op, Shard, Replicate, Partial)
+from .store import TCPStore, MasterStore  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import rpc  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .spawn import spawn  # noqa: F401
+
+
+def launch():
+    from .launch.main import main
+    main()

@@ -1,0 +1,306 @@
+"""Communication API (reference: python/paddle/distributed/communication/
+over ProcessGroupNCCL — paddle/fluid/distributed/collective/).
+
+TPU-native: the transport is XLA collectives over ICI/DCN.  Inside a
+``shard_map``/``pjit`` trace these functions lower to ``lax.psum`` /
+``all_gather`` / ``all_to_all`` / ``ppermute`` on the named mesh axis; in
+eager single-process mode they are the world-size-1 identity (matching the
+reference's behavior when nranks==1).  Async ``Task`` semantics come free
+from XLA's async collectives, so ``wait`` is a barrier on the value.
+
+Groups name mesh axes rather than holding NCCL communicators: ``new_group``
+returns a ``Group`` carrying the axis name(s) the collective should ride.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from .env import get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group ≙ one or more mesh axis names."""
+
+    def __init__(self, axis_name=None, ranks=None, group_id=0):
+        self.axis_name = axis_name
+        self.ranks = ranks or []
+        self.id = group_id
+        self.nranks = len(self.ranks) if self.ranks else None
+
+    @property
+    def world_size(self):
+        if self.nranks:
+            return self.nranks
+        return get_world_size()
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        if self.ranks:
+            return self.ranks.index(rank) if rank in self.ranks else -1
+        return rank
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_GROUPS = {}
+_GROUP_COUNTER = [0]
+_WORLD = Group(axis_name=None, group_id=0)
+
+
+def _in_named_trace(axis):
+    """True if `axis` is a bound mapped axis (inside shard_map/pmap)."""
+    if axis is None:
+        return False
+    try:
+        lax.axis_index(axis)  # raises NameError outside a binding context
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    _GROUP_COUNTER[0] += 1
+    g = Group(axis_name=axis_name, ranks=ranks,
+              group_id=_GROUP_COUNTER[0])
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _WORLD
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def _axis_of(group):
+    if group is None:
+        return None
+    return group.axis_name
+
+
+def _apply(x, fn):
+    """Run fn over a Tensor through the tape (collectives are
+    autograd-aware: psum's transpose is psum etc., handled by jax)."""
+    if isinstance(x, Tensor):
+        return call_op(fn, x)
+    return Tensor(fn(jnp.asarray(x)))
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis):
+        red = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+               ReduceOp.MIN: lax.pmin,
+               ReduceOp.AVG: lambda v, a: lax.pmean(v, a)}[op]
+        out = _apply(tensor, lambda v: red(v, axis))
+    else:
+        out = tensor  # world of 1 (or replicated eager value): identity
+    if isinstance(tensor, Tensor) and isinstance(out, Tensor) \
+            and out is not tensor:
+        tensor._value = out._value
+        tensor._node = out._node
+        tensor._out_idx = out._out_idx
+        tensor.stop_gradient = out.stop_gradient
+    return _Task(tensor)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On an SPMD mesh every shard computes the reduction (XLA has no
+    # rooted reduce); semantically equivalent for the framework's uses.
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis):
+        out = _apply(tensor, lambda v: lax.all_gather(v, axis))
+        n = out.shape[0]
+        parts = [out[i] for i in range(n)]
+    else:
+        parts = [tensor]
+    tensor_list.clear()
+    tensor_list.extend(parts)
+    return _Task(tensor_list)
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.clear()
+    object_list.append(obj)
+    return _Task(object_list)
+
+
+def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True,
+                           concat_axis=0):
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis):
+        out = _apply(tensor, lambda v: lax.all_gather(
+            v, axis, tiled=True, axis=concat_axis))
+    else:
+        out = tensor
+    out_tensor._value = out._value
+    out_tensor._node = out._node
+    out_tensor._out_idx = out._out_idx
+    out_tensor.stop_gradient = out.stop_gradient
+    return _Task(out_tensor)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    axis = _axis_of(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+        src = concat(list(src), axis=0)
+    if axis is not None and _in_named_trace(axis):
+        out = _apply(src, lambda v: lax.psum_scatter(
+            v, axis, scatter_dimension=0, tiled=True))
+    else:
+        out = src
+    tensor._value = out._value
+    tensor._node = out._node
+    tensor._out_idx = out._out_idx
+    tensor.stop_gradient = out.stop_gradient
+    return _Task(tensor)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis_of(group)
+    from ..tensor.manipulation import stack
+    x = stack(list(in_tensor_list), axis=0)
+    if axis is not None and _in_named_trace(axis):
+        out = _apply(x, lambda v: lax.all_to_all(
+            v, axis, split_axis=0, concat_axis=0, tiled=False))
+        parts = [out[i] for i in range(out.shape[0])]
+    else:
+        parts = list(in_tensor_list)
+    out_tensor_list.clear()
+    out_tensor_list.extend(parts)
+    return _Task(out_tensor_list)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis):
+        out = _apply(in_tensor, lambda v: lax.all_to_all(
+            v, axis, split_axis=0, concat_axis=0, tiled=True))
+    else:
+        out = in_tensor
+    out_tensor._value = out._value
+    out_tensor._node = out._node
+    out_tensor._out_idx = out._out_idx
+    out_tensor.stop_gradient = out.stop_gradient
+    return _Task(out_tensor)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis):
+        # select src rank's shard everywhere via all_gather + index
+        out = _apply(tensor, lambda v: lax.all_gather(v, axis)[src])
+        tensor._value = out._value
+        tensor._node = out._node
+        tensor._out_idx = out._out_idx
+        tensor.stop_gradient = out.stop_gradient
+    return _Task(tensor)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis) and tensor_list:
+        from ..tensor.manipulation import stack
+        stacked = stack(list(tensor_list), axis=0)
+        idx = lax.axis_index(axis)
+        out = _apply(stacked, lambda v: v[idx])
+        tensor._value = out._value
+        tensor._node = out._node
+        tensor._out_idx = out._out_idx
+        tensor.stop_gradient = out.stop_gradient
+    elif tensor_list:
+        tensor._value = tensor_list[src]._value
+    return _Task(tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv are not exposed eagerly on TPU; use "
+        "paddle_tpu.distributed.p2p.ppermute inside a shard_map (the "
+        "pipeline runtime does this), or batch_isend_irecv")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv are not exposed eagerly on TPU; use "
+        "paddle_tpu.distributed.p2p.ppermute inside a shard_map")
+
+
+def ppermute(tensor, perm, group=None):
+    """P2P as collective-permute (TPU's native send/recv). perm: list of
+    (src, dst) pairs; must run inside shard_map on the group's axis."""
+    axis = _axis_of(group)
+    return _apply(tensor, lambda v: lax.ppermute(v, axis, perm))
+
+
+def barrier(group=None):
+    # XLA programs are bulk-synchronous; an explicit barrier is only
+    # meaningful across processes.
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        try:
+            tensor._value.block_until_ready()
+        except Exception:
+            pass
+
+
+class _Task:
+    def __init__(self, result):
+        self._result = result
+
+    def wait(self):
+        if isinstance(self._result, Tensor):
+            wait(self._result)
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+class stream:
+    """paddle.distributed.stream.* compat namespace."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    reduce = staticmethod(reduce)

@@ -1,0 +1,85 @@
+"""Parallel environment bootstrap (reference:
+python/paddle/distributed/parallel.py — init_parallel_env/ParallelEnv;
+the TCPStore+NCCL rendezvous becomes ``jax.distributed.initialize``).
+
+Two regimes:
+- single-process multi-device (one host driving N TPU chips, or N forced
+  CPU devices in tests): world is jax.device_count(), no rendezvous needed.
+- multi-process/multi-host: PADDLE_* env (set by the launcher) maps onto
+  jax.distributed.initialize(coordinator, num_processes, process_id).
+"""
+import os
+
+import jax
+
+_STATE = {"initialized": False, "mesh": None}
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = _env_int("PADDLE_TRAINER_ID", 0)
+        self.world_size = _env_int("PADDLE_TRAINERS_NUM", 1)
+        self.device_id = _env_int("FLAGS_selected_tpus",
+                                  _env_int("FLAGS_selected_gpus", 0))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+def init_parallel_env():
+    """Bootstrap multi-process JAX if PADDLE_* env says so; no-op extra
+    calls.  Returns a ParallelEnv."""
+    env = ParallelEnv()
+    if _STATE["initialized"]:
+        return env
+    nproc = _env_int("PADDLE_TRAINERS_NUM", 1)
+    if nproc > 1 and os.environ.get("PADDLE_MASTER"):
+        coordinator = os.environ["PADDLE_MASTER"]
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=nproc,
+            process_id=env.rank)
+    _STATE["initialized"] = True
+    return env
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(jax.process_index()) \
+            if hasattr(group, "get_group_rank") else jax.process_index()
+    return _env_int("PADDLE_TRAINER_ID", jax.process_index())
+
+
+def get_world_size(group=None):
+    if group is not None and hasattr(group, "world_size"):
+        return group.world_size
+    n = _env_int("PADDLE_TRAINERS_NUM", 0)
+    return n if n > 0 else jax.process_count()
+
+
+def parallel_device_count():
+    """Devices visible to this process (the SPMD width for shard_map)."""
+    return jax.local_device_count()

@@ -1,0 +1,14 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/).
+
+M2/M4 fill the full hybrid-parallel stack; the facade object and
+DistributedStrategy live here.
+"""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (CommunicateTopology,  # noqa: F401
+                            HybridCommunicateGroup)
+from .fleet import (Fleet, init, distributed_model,  # noqa: F401
+                    distributed_optimizer, get_hybrid_communicate_group,
+                    worker_num, worker_index, is_first_worker, barrier_worker)
+from . import utils  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import elastic  # noqa: F401

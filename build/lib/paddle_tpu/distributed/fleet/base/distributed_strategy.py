@@ -1,0 +1,82 @@
+"""DistributedStrategy (reference: paddle/fluid/framework/
+distributed_strategy.proto + python/paddle/distributed/fleet/base/
+distributed_strategy.py — a protobuf-backed ~60-field strategy object).
+
+TPU-native: a plain dataclass-style config tree, serializable to dict/json.
+``fleet.distributed_model`` compiles it into a Mesh + sharding rules.
+"""
+import copy
+import json
+
+__all__ = ["DistributedStrategy"]
+
+_DEFAULT_HYBRID = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                   "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # mirrors the reference's field set (subset that is meaningful on TPU)
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16":
+                            False, "use_fp16_guard": False,
+                            "custom_white_list": [], "custom_black_list": [],
+                            "dtype": "bfloat16", "level": "O1"}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "sharding_degree": 1,
+                                 "segment_broadcast_MB": 32,
+                                 "offload": False}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1,
+                                        "tensor_init_seed": -1}
+        self.hybrid_configs = dict(_DEFAULT_HYBRID)
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lamb_configs = {}
+        self.lars = False
+        self.lars_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.heter_ccl_mode = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1  # accepted, unused (XLA owns comms)
+        self.sync_nccl_allreduce = False
+        self.fp16_allreduce = False
+        self.without_graph_optimization = False
+        self.asp = False
+        self.qat = False
+        self.qat_configs = {}
+
+    def to_dict(self):
+        return {k: copy.deepcopy(v) for k, v in self.__dict__.items()}
+
+    def from_dict(self, d):
+        for k, v in d.items():
+            setattr(self, k, copy.deepcopy(v))
+        return self
+
+    def save_to_prototxt(self, output):
+        with open(output, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    def load_from_prototxt(self, pb_file):
+        with open(pb_file) as f:
+            self.from_dict(json.load(f))
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return (f"DistributedStrategy(enabled={on}, "
+                f"hybrid={self.hybrid_configs})")

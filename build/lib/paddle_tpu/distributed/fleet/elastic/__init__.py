@@ -1,0 +1,194 @@
+"""Elastic training (reference:
+python/paddle/distributed/fleet/elastic/manager.py — ETCD-based node
+membership with lease+heartbeat, scale-in/out watch, relaunch with new
+ranks within an ``--np min:max`` range).
+
+TPU-native: the membership registry is the framework's own TCPStore (the
+same rendezvous store used for comm bootstrap) instead of an external ETCD
+cluster; semantics are identical — register with a heartbeat lease, watch
+the member set, and report RESTART/HOLD/NORMAL to the launcher, which
+tears down workers and relaunches with recomputed
+``PADDLE_TRAINER_ENDPOINTS``.  Multi-host TPU jobs pair this with fast
+sharded-checkpoint resume (SURVEY §5.3).
+"""
+import json
+import os
+import threading
+import time
+
+from ...store import TCPStore
+
+__all__ = ["ElasticStatus", "ElasticLevel", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"          # below min nodes: wait
+    RESTART = "restart"    # membership changed: relaunch with new ranks
+    NORMAL = "normal"
+    EXIT = "exit"
+
+
+class ElasticLevel:
+    NONE = 0
+    FAULT_TOLERANCE = 1    # fixed np, survive restarts
+    ELASTIC = 2            # np range, scale in/out
+
+
+class ElasticManager:
+    """Store-backed membership manager.
+
+    Parameters mirror the reference manager: ``np`` is "N" or "min:max",
+    ``host``/``curr_port`` identify this node, ``scale``/``force`` knobs
+    kept for CLI compat.
+    """
+
+    _PREFIX = "elastic"
+
+    def __init__(self, np="1", host=None, store=None, master=None,
+                 heartbeat_interval=2.0, elastic_timeout=30.0,
+                 job_id="default"):
+        np = str(np)
+        if ":" in np:
+            lo, hi = np.split(":")
+            self.min_np, self.max_np = int(lo), int(hi)
+        else:
+            self.min_np = self.max_np = int(np)
+        self.elastic_level = (ElasticLevel.ELASTIC
+                              if self.max_np > self.min_np
+                              else ElasticLevel.FAULT_TOLERANCE)
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.job_id = job_id
+        self.heartbeat_interval = heartbeat_interval
+        self.elastic_timeout = elastic_timeout
+        if store is not None:
+            self._store = store
+        else:
+            master = master or os.environ.get("PADDLE_MASTER",
+                                              "127.0.0.1:6768")
+            h, p = master.rsplit(":", 1)
+            self._store = TCPStore(h, int(p), is_master=False)
+        self._node_id = None
+        self._hb_thread = None
+        self._stopped = threading.Event()
+        self._last_members = None
+        # ids with no readable record get backoff deadlines instead of a
+        # permanent blacklist: transient store slowness must not evict a
+        # live peer (they are re-probed after the backoff lapses)
+        self._dead_until = {}
+        self._miss_counts = {}
+        self.enabled = self.elastic_level != ElasticLevel.NONE
+
+    # -- keys ---------------------------------------------------------------
+    def _k(self, *parts):
+        return "/".join((self._PREFIX, self.job_id) + parts)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, endpoint=None):
+        """Register this node and start the heartbeat lease."""
+        self._node_id = self._store.add(self._k("seq"), 1) - 1
+        self._endpoint = endpoint or f"{self.host}:0"
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+        return self._node_id
+
+    def _beat(self):
+        rec = {"endpoint": self._endpoint, "ts": time.time(), "alive": True}
+        self._store.set(self._k("node", str(self._node_id)),
+                        json.dumps(rec).encode())
+
+    def _hb_loop(self):
+        while not self._stopped.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    def stop(self):
+        self._stopped.set()
+        if self._node_id is not None:
+            try:
+                rec = {"endpoint": self._endpoint, "ts": 0, "alive": False}
+                self._store.set(self._k("node", str(self._node_id)),
+                                json.dumps(rec).encode())
+            except Exception:
+                pass
+
+    def exit(self, completed=True):
+        self.stop()
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+
+    # -- membership ---------------------------------------------------------
+    def _members(self):
+        """Fresh member records {node_id: endpoint} (heartbeat within the
+        lease window), capped at max_np (lowest ids win, matching the
+        reference's membership cap).  This node is always included from
+        local knowledge, so a transient store hiccup can never hand our
+        rank to someone else.  Ids that repeatedly have no record (died
+        between registration and first heartbeat) are remembered as dead
+        and skipped, keeping watch() latency flat."""
+        try:
+            seq = self._store.add(self._k("seq"), 0)
+        except Exception:
+            seq = 0
+        now = time.time()
+        lease = max(self.heartbeat_interval * 3, 6.0)
+        members = {}
+        for nid in range(seq):
+            if self._dead_until.get(nid, 0) > now:
+                continue
+            try:
+                raw = self._store.get(self._k("node", str(nid)),
+                                      timeout=1.0)
+            except Exception:
+                self._miss_counts[nid] = self._miss_counts.get(nid, 0) + 1
+                if self._miss_counts[nid] >= 3:
+                    self._dead_until[nid] = now + 10 * lease
+                continue
+            self._miss_counts.pop(nid, None)
+            self._dead_until.pop(nid, None)
+            try:
+                rec = json.loads(raw.decode())
+            except Exception:
+                continue
+            if rec.get("alive") and now - rec["ts"] <= lease:
+                members[nid] = rec["endpoint"]
+        if self._node_id is not None and not self._stopped.is_set():
+            members.setdefault(self._node_id, getattr(self, "_endpoint",
+                                                      f"{self.host}:0"))
+        if len(members) > self.max_np:
+            keep = sorted(members)[:self.max_np]
+            members = {k: members[k] for k in keep}
+        return members
+
+    def endpoints(self):
+        """Ordered endpoint list of the current membership (rank order =
+        node-id order, the reference's sorted-hosts rule)."""
+        m = self._members()
+        return [m[k] for k in sorted(m)]
+
+    def watch(self):
+        """One membership poll → status for the launcher loop."""
+        members = self._members()
+        n = len(members)
+        if self._last_members is None:
+            self._last_members = members
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        if members != self._last_members:
+            self._last_members = members
+            return ElasticStatus.RESTART
+        return ElasticStatus.NORMAL
+
+    def wait_for_np(self, timeout=None):
+        """Block until member count is within [min_np, max_np]."""
+        timeout = timeout if timeout is not None else self.elastic_timeout
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            n = len(self._members())
+            if self.min_np <= n <= self.max_np:
+                return True
+            time.sleep(self.heartbeat_interval / 2)
+        return False

@@ -1,0 +1,103 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py).
+
+``fleet.init(strategy)`` builds the hybrid topology;
+``distributed_model``/``distributed_optimizer`` wrap by parallel mode —
+here they compile the DistributedStrategy into mesh-axis sharding rules
+(M2/M4 wire DP/sharding/TP/PP wrappers in meta_parallel/).
+"""
+import numpy as np
+import jax
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from ..env import init_parallel_env, get_rank, get_world_size
+
+_FLEET = {"strategy": None, "hcg": None, "initialized": False}
+
+
+class Fleet:
+    def __init__(self):
+        pass
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        if strategy is None:
+            strategy = DistributedStrategy()
+        _FLEET["strategy"] = strategy
+        init_parallel_env()
+        h = strategy.hybrid_configs
+        n_dev = jax.device_count()
+        degrees = {"data": h.get("dp_degree", 1),
+                   "pipe": h.get("pp_degree", 1),
+                   "sharding": h.get("sharding_degree", 1),
+                   "sep": h.get("sep_degree", 1),
+                   "model": h.get("mp_degree", 1)}
+        specified = int(np.prod(list(degrees.values())))
+        if degrees["data"] == 1 and specified < n_dev and \
+                n_dev % max(specified, 1) == 0:
+            # reference behavior: dp fills the remainder
+            degrees["data"] = n_dev // specified
+        topo = CommunicateTopology(list(degrees.keys()),
+                                   list(degrees.values()))
+        _FLEET["hcg"] = HybridCommunicateGroup(topo)
+        _FLEET["initialized"] = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return _FLEET["initialized"]
+
+    def distributed_model(self, model):
+        from .meta_parallel import wrap_distributed_model
+        return wrap_distributed_model(model, _FLEET["strategy"],
+                                      _FLEET["hcg"])
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_parallel import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer,
+                                       _FLEET["hcg"],
+                                       strategy or _FLEET["strategy"])
+
+    def worker_num(self):
+        return get_world_size()
+
+    def worker_index(self):
+        return get_rank()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return _FLEET["hcg"]
+
+    @property
+    def strategy(self):
+        return _FLEET["strategy"]
+
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

@@ -1,0 +1,147 @@
+"""Fleet meta-optimizers (reference:
+python/paddle/distributed/fleet/meta_optimizers/ — strategy-driven
+optimizer rewrites applied by fleet.distributed_optimizer: LarsOptimizer,
+DGCOptimizer, LocalSGDOptimizer, GradientMergeOptimizer, ...).
+
+TPU-native: AMP/recompute/sharding/TP/PP strategies are placements (the
+engine compiles them into the step); what remains as genuine *optimizer*
+rewrites is this module: Lars/DGC swap a Momentum inner optimizer for
+the adaptive/compressed variant, GradientMerge accumulates k micro-grads
+before one apply, LocalSGD syncs params periodically instead of grads
+every step.
+"""
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import (Momentum, LarsMomentum, DGCMomentum)
+
+__all__ = ["apply_meta_optimizers", "GradientMergeHelper",
+           "LocalSGDOptimizer"]
+
+
+def apply_meta_optimizers(optimizer, strategy):
+    """Strategy-driven inner-optimizer replacement (reference:
+    fleet._final_strategy meta-optimizer pass).  Returns the (possibly
+    replaced/wrapped) optimizer."""
+    if strategy is None:
+        return optimizer
+    if getattr(strategy, "lars", False) and type(optimizer) is Momentum:
+        cfg = getattr(strategy, "lars_configs", None) or {}
+        optimizer = LarsMomentum(
+            learning_rate=optimizer._learning_rate,
+            momentum=optimizer._momentum,
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip,
+            exclude_from_weight_decay=cfg.get(
+                "exclude_from_weight_decay", []),
+            epsilon=cfg.get("epsilon", 1e-9))
+    elif getattr(strategy, "dgc", False) and type(optimizer) is Momentum:
+        cfg = getattr(strategy, "dgc_configs", None) or {}
+        optimizer = DGCMomentum(
+            learning_rate=optimizer._learning_rate,
+            momentum=optimizer._momentum,
+            parameters=optimizer._parameter_list,
+            sparsity=cfg.get("sparsity", [0.999])[-1]
+            if isinstance(cfg.get("sparsity"), (list, tuple))
+            else cfg.get("sparsity", 0.999),
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            grad_clip=optimizer._grad_clip)
+    if getattr(strategy, "localsgd", False):
+        cfg = getattr(strategy, "localsgd_configs", None) or {}
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      k_steps=cfg.get("k_steps", 1))
+    return optimizer
+
+
+class GradientMergeHelper:
+    """Accumulate k_steps of grads before one optimizer apply
+    (reference: meta_optimizers/gradient_merge_optimizer.py — the
+    GradientMerge pass adds gradient-accumulate blocks to the program).
+
+    Usage (inside HybridParallelOptimizer.step): ``if helper.accumulate(
+    params): return`` — returns True while still accumulating; on the
+    k-th call it installs the merged (optionally averaged) grads on the
+    params and returns False so the caller applies the inner step.
+    """
+
+    def __init__(self, k_steps, avg=True):
+        self.k_steps = max(int(k_steps), 1)
+        self.avg = bool(avg)
+        self._count = 0
+        self._buf = {}
+
+    def accumulate(self, params):
+        if self.k_steps <= 1:
+            return False
+        self._count += 1
+        for p in params:
+            g = p._grad
+            if g is None:
+                continue
+            acc = self._buf.get(id(p))
+            self._buf[id(p)] = g if acc is None else acc + g
+        if self._count % self.k_steps != 0:
+            return True
+        for p in params:
+            acc = self._buf.pop(id(p), None)
+            if acc is not None:
+                p._grad = acc / self.k_steps if self.avg else acc
+        return False
+
+
+class LocalSGDOptimizer:
+    """Periodic parameter averaging (reference:
+    meta_optimizers/localsgd_optimizer.py — train k local steps, then
+    allreduce-average the params instead of averaging grads each step).
+
+    The inner optimizer steps on purely local grads; every ``k_steps``
+    the params are averaged across the data-parallel group.  Inside a
+    shard_map over the dp axis (per-device param copies) ``sync()`` is a
+    real ``pmean``; in the replicated-GSPMD eager world it is an
+    identity (grads are already averaged, i.e. sync is trivially true
+    every step).  ``sync_values`` is the pure functional piece for
+    compiled per-device training loops.
+    """
+
+    def __init__(self, inner, k_steps=1, group=None):
+        self._inner = inner
+        self.k_steps = max(int(k_steps), 1)
+        self._group = group
+        self._local_steps = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        self._local_steps += 1
+        if self._local_steps % self.k_steps == 0:
+            self.sync()
+
+    def sync(self):
+        from ...collective import all_reduce, ReduceOp
+        params = self._inner._parameter_list or []
+        for p in params:
+            all_reduce(p, op=ReduceOp.AVG, group=self._group)
+
+    @staticmethod
+    def sync_values(param_values, axis_name):
+        """Pure pmean over the dp axis for shard_map training loops."""
+        from jax import lax
+        return [lax.pmean(v, axis_name) for v in param_values]
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)

@@ -1,0 +1,100 @@
+"""Meta-parallel wrappers (reference:
+python/paddle/distributed/fleet/meta_parallel/).
+
+M2-M4 build these out (TP layers, PipelineLayer, sharding stages); the
+facade-level wrap + HybridParallelOptimizer live here.
+"""
+from ....nn.layer.layers import Layer
+from ....optimizer.optimizer import Optimizer
+from .parallel_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, get_rng_state_tracker, RNGStatesTracker,
+    model_parallel_random_seed)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+
+
+def wrap_distributed_model(model, strategy, hcg):
+    """Pick the wrapper by strategy (reference: fleet.distributed_model)."""
+    from ...parallel import DataParallel
+    if hcg is None:
+        return DataParallel(model)
+    level = None
+    if strategy is not None and hcg.get_sharding_parallel_world_size() > 1:
+        stage = (strategy.sharding_configs or {}).get("stage", 1)
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os")
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy, level=level)
+    wrapped = DataParallel(model)
+    from ...engine import plan_from_hcg
+    wrapped._placement_plan = plan_from_hcg(hcg, level=level)
+    return wrapped
+
+
+class TensorParallel(Layer):
+    """Marker wrapper: TP layers already carry their sharding rules; this
+    wrapper only pins the hcg so the engine builds the right mesh."""
+
+    def __init__(self, layers, hcg, strategy=None, level=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        from ...engine import plan_from_hcg
+        self._placement_plan = plan_from_hcg(hcg, level=level)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class HybridParallelOptimizer:
+    """Wraps the inner optimizer with mesh-aware global-norm clipping
+    (reference: meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer
+    .py).  Under GSPMD the grad allreduce is already in the compiled step;
+    what remains is the cross-axis global-norm clip, which works on the
+    full (replicated-view) grads transparently.  Strategy-driven
+    meta-optimizers (lars/dgc swap, localsgd wrap, gradient_merge
+    accumulation) are applied here, mirroring fleet's meta-optimizer
+    pass."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        from ..meta_optimizers import (apply_meta_optimizers,
+                                       GradientMergeHelper)
+        self._inner = apply_meta_optimizers(optimizer, strategy)
+        self._hcg = hcg
+        self._strategy = strategy
+        self._gm = None
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            cfg = strategy.gradient_merge_configs or {}
+            self._gm = GradientMergeHelper(cfg.get("k_steps", 1),
+                                           cfg.get("avg", True))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        if self._gm is not None:
+            params = self._inner._parameter_list or []
+            if self._gm.accumulate(params):
+                return  # still accumulating: no apply this micro-step
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner.minimize(loss, *a, **k)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)

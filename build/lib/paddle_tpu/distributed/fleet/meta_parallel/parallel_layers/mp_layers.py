@@ -1,0 +1,146 @@
+"""Megatron-style tensor-parallel layers (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py
+— Column/RowParallelLinear, VocabParallelEmbedding, ParallelCrossEntropy
+built on c_identity/c_allreduce/c_concat comm ops + per-rank weight slices).
+
+TPU-native design: no per-rank slices and no hand-inserted collectives.
+Each layer holds the FULL logical weight annotated with a ``pspec`` over
+the "model" mesh axis; the PlacementPlan device_puts it sharded, and XLA's
+SPMD partitioner inserts exactly the Megatron communication pattern:
+
+- ColumnParallelLinear  W:(in, out) sharded (None, "model") → local matmul,
+  activations sharded on the feature dim (the c_identity fwd is free).
+- RowParallelLinear     W:(in, out) sharded ("model", None) → local matmul
+  + psum of partial sums (the reference's mp_allreduce).
+- VocabParallelEmbedding weight (vocab, hidden) sharded ("model", None) →
+  partitioned gather + psum of masked lookups.
+- ParallelCrossEntropy: softmax-CE over logits sharded on the class dim —
+  XLA lowers max/sum reductions to the per-shard + psum pattern of the
+  reference's c_softmax_with_cross_entropy CUDA kernel.
+
+``gather_output`` / ``input_is_parallel`` control activation shardings via
+with_sharding_constraint, mirroring the reference's flags.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor
+from .....framework.autograd import call_op
+from ..... import nn
+from .....nn import functional as F
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_axis(mp_group=None):
+    """The mesh axis name TP rides on."""
+    return "model"
+
+
+def _constraint(value, spec):
+    """Apply with_sharding_constraint if a mesh is active (inside pjit with
+    a plan mesh); otherwise a no-op (eager single-device)."""
+    try:
+        from jax.sharding import PartitionSpec as P
+        return jax.lax.with_sharding_constraint(value, P(*spec))
+    except Exception:
+        return value
+
+
+class ColumnParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self._axis = _mp_axis(mp_group)
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            dtype=None, is_bias=False)
+        self.weight.pspec = (None, self._axis)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+            self.bias.pspec = (self._axis,)
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            # reference: c_concat across mp group → replicated activation
+            spec = [None] * len(out.shape)
+            out = call_op(lambda v: _constraint(v, spec), out)
+        else:
+            spec = [None] * (len(out.shape) - 1) + [self._axis]
+            out = call_op(lambda v: _constraint(v, spec), out)
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self._axis = _mp_axis(mp_group)
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            is_bias=False)
+        self.weight.pspec = (self._axis, None)
+        self.weight.is_distributed = True
+        if has_bias:
+            # bias applies AFTER the psum → replicated
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * (len(x.shape) - 1) + [self._axis]
+            x = call_op(lambda v: _constraint(v, spec), x)
+        out = F.linear(x, self.weight)   # XLA: local matmul + psum
+        spec = [None] * len(out.shape)
+        out = call_op(lambda v: _constraint(v, spec), out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            is_bias=False)
+        self.weight.pspec = (self._axis, None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-parallel softmax cross-entropy (reference:
+    c_softmax_with_cross_entropy op).  Computed directly on class-dim
+    sharded logits; the partitioner emits per-shard max/sum + psum."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,72 @@
+"""TP RNG state tracker (reference: fleet/meta_parallel/parallel_layers/
+random.py — get_rng_state_tracker with model-parallel vs global seeds so
+dropout inside TP regions differs per rank while replicated regions agree).
+
+TPU-native: JAX keys are functional, so "states" are named base keys;
+``rng_state(name)`` folds the named key into the active rng scope.  Under
+GSPMD there is one program, so per-shard decorrelation of sharded dropout
+masks happens by construction (each device generates its slice of the same
+logical mask); the tracker's job reduces to deterministic, name-keyed
+streams — kept API-compatible.
+"""
+from contextlib import contextmanager
+
+import jax
+
+from .....framework import random as _random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            # auto-register with a name-derived seed (reference raises; we
+            # are permissive because there's no cross-rank state to desync)
+            self.add(name, abs(hash(name)) % (2 ** 31))
+        key = self.states_[name]
+        with _random.rng_scope(key):
+            yield
+        # advance the named stream so successive uses differ
+        self.states_[name] = jax.random.fold_in(key, 1)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import paddle_tpu as paddle
+    global_seed = seed if seed is not None else 0
+    _TRACKER.reset()
+    paddle.seed(global_seed)
+    _TRACKER.add(MODEL_PARALLEL_RNG, global_seed + 1024)

@@ -1,0 +1,184 @@
+"""PipelineLayer API (reference: fleet/meta_parallel/parallel_layers/
+pp_layers.py — LayerDesc/SharedLayerDesc declarative stage spec,
+segmentation by layer count / "uniform" / custom cut, per-stage
+materialization).
+
+TPU-native: the declarative spec is kept verbatim; "segmentation" maps the
+homogeneous middle run onto the stacked SPMD pipeline
+(distributed/pipeline.py), with the in-homogeneous head/tail run outside
+the rotation loop.  There is no per-rank materialization — every process
+holds the full logical model; the pipe mesh axis holds the *shards*.
+"""
+import math
+
+from ....nn.layer.layers import Layer, LayerList, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input of LayerDesc should be Layer")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer across stages (e.g. tied embedding/LM head).
+    In the single-program design sharing is literal object reuse."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Reference API: PipelineLayer(layers=[descs...], num_stages=...,
+    loss_fn=..., seg_method="uniform").  forward runs the full model (one
+    SPMD program); ``segment`` exposes the stage cut points;
+    ``staged_module(mesh)`` builds the stacked SPMD pipeline over the
+    homogeneous middle segment when one exists.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layer_descs = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
+        self._shared = {}
+
+        built = []
+        for d in self._layer_descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, "func"))
+            else:
+                raise TypeError(f"bad pipeline item {d}")
+        self.run_function = built
+        self._layers_list = LayerList(
+            [l for l, tag in built if isinstance(l, Layer)])
+
+    @property
+    def parameters_list(self):
+        return self._layers_list
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def segment(self):
+        """Stage cut points over the layer list.  seg_method:
+        - "uniform": equal-count split of all items;
+        - "layer:<Class>": boundaries fall only at instances of <Class>,
+          distributing those instances evenly — items before the first
+          instance join stage 0, trailing items join the last stage
+          (reference segment_by_layer semantics)."""
+        n = len(self.run_function)
+        S = self._num_stages
+        if isinstance(self._seg_method, str) and \
+                self._seg_method.startswith("layer:"):
+            cls_name = self._seg_method.split(":", 1)[1]
+            idxs = [i for i, (l, _) in enumerate(self.run_function)
+                    if type(l).__name__ == cls_name]
+            if not idxs:
+                raise ValueError(
+                    f"seg_method {self._seg_method!r}: no layer of class "
+                    f"{cls_name!r} in the pipeline")
+            if len(idxs) < S:
+                raise ValueError(
+                    f"seg_method {self._seg_method!r}: {len(idxs)} "
+                    f"{cls_name} layers < {S} stages")
+            counts = [len(idxs) // S + (1 if k < len(idxs) % S else 0)
+                      for k in range(S)]
+            cuts, acc = [0], 0
+            for k in range(S - 1):
+                acc += counts[k]
+                cuts.append(idxs[acc])
+            cuts.append(n)
+            return cuts
+        per = int(math.ceil(n / S))
+        cuts = [min(i * per, n) for i in range(S + 1)]
+        cuts[-1] = n
+        return cuts
+
+    def forward(self, x, *args, **kwargs):
+        for layer, tag in self.run_function:
+            if tag == "func":
+                x = layer(x)
+            elif tag is not None and tag != "func" and callable(tag):
+                x = tag(self._shared_for(layer), x)
+            else:
+                x = layer(x)
+        return x
+
+    def _shared_for(self, layer):
+        return layer
+
+    def _homogeneous_span(self):
+        """(start, end) of the longest run of structurally identical
+        parameterized layers in run_function (the pipelineable middle);
+        (0, 0) when none."""
+        sigs = []
+        for l, _ in self.run_function:
+            if isinstance(l, Layer):
+                sigs.append((type(l).__name__, tuple(
+                    tuple(p.shape) for _, p in l.named_parameters())))
+            else:
+                sigs.append(("func", None))
+        best, cur, bstart = 0, 1, 0
+        for i in range(1, len(sigs)):
+            if sigs[i] == sigs[i - 1] and sigs[i][1]:
+                cur += 1
+                if cur > best:
+                    best, bstart = cur, i - cur + 1
+            else:
+                cur = 1
+        if best < 2:
+            return 0, 0
+        return bstart, bstart + best
+
+    def homogeneous_run(self):
+        """(head_layers, middle_blocks, tail_layers) where middle_blocks
+        are structurally identical (the pipelineable run)."""
+        items = [l for l, _ in self.run_function]
+        start, end = self._homogeneous_span()
+        if start == end:
+            return items, [], []
+        return items[:start], items[start:end], items[end:]
+
+    def staged_module(self, mesh, axis="pipe", remat=None):
+        from ...pipeline import PipelineStagedModule
+        _, mid, _ = self.homogeneous_run()
+        if not mid:
+            raise ValueError("no homogeneous block run to pipeline")
+        if remat is None:
+            remat = self._recompute_interval > 0
+        return PipelineStagedModule(mid, mesh, axis=axis, remat=remat,
+                                    n_virtual=self._num_virtual_stages)

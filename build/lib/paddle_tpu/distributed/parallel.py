@@ -1,0 +1,72 @@
+"""DataParallel (reference: python/paddle/fluid/dygraph/parallel.py +
+C++ imperative::Reducer gradient bucketing).
+
+TPU-native: data parallelism is a sharding, not a wrapper protocol — the
+compiled train step sees batch-sharded inputs and replicated params, and
+XLA inserts the gradient all-reduce (bucketing/overlap done by the
+latency-hiding scheduler, which is the Reducer's job in the reference).
+This class keeps the reference's wrapper API: under a jitted step it simply
+marks the model so hapi/engine shard the batch axis; in eager multi-process
+mode it averages grads across processes after backward (no_sync supported).
+"""
+from contextlib import contextmanager
+
+import jax
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from .env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._sync = True
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self.is_data_parallel = True
+        if jax.device_count() > 1:
+            from .engine import make_data_parallel_plan
+            self._placement_plan = make_data_parallel_plan()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextmanager
+    def no_sync(self):
+        self._sync = False
+        try:
+            yield
+        finally:
+            self._sync = True
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Average grads across processes (multi-host eager path).  In the
+        compiled/pjit path this is a no-op — GSPMD already reduced."""
+        if not self._sync or get_world_size() <= 1:
+            return
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            for p in self._layers.parameters():
+                if p._grad is not None:
+                    g = multihost_utils.process_allgather(p._grad)
+                    p._grad = g.mean(axis=0)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    # delegate attribute access to the wrapped module (paddle behavior)
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

@@ -1,0 +1,418 @@
+"""Parameter-server mode for sparse/recsys models (reference:
+paddle/fluid/distributed/ps/{table,service}/ — brpc services over
+MemorySparseTable/dense tables with accessors, plus the Python runtime
+python/paddle/distributed/fleet/runtime/the_one_ps.py).
+
+TPU-native re-design, not a port: the dense training path on TPU is the
+compiled SPMD program (no PS involved); what the PS class of models needs
+is the *sparse* side — embedding tables far larger than HBM, touched by a
+few thousand rows per step.  So this module is a lean CPU-side key-value
+parameter service:
+
+- ``SparseTable``: hash-map id → row (created on first touch by an
+  initializer), updated server-side by an accessor rule (sgd / adagrad /
+  "sum" for geo-async deltas) — the MemorySparseTable + accessor pair.
+- ``DenseTable``: a flat array with the same push/pull protocol.
+- ``PSServer``: threaded TCP service hosting tables; length-prefixed
+  pickled frames (the in-repo store/rpc wire pattern; brpc's role).
+- ``PSClient``: shards keys across N servers by ``id % n`` (the
+  reference's key-shard layout), gathers pulls / scatters pushes.
+- ``GeoSparseTable`` (client-side): local cache + accumulated deltas,
+  flushed every ``geo_step`` pushes — geo-async SGD semantics.
+
+Workers pull rows into the jax program's inputs, compute grads under the
+normal autograd, and push sparse grads back; the TPU never holds the full
+table.
+"""
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "GeoSparseTable"]
+
+
+# ---------------------------------------------------------------------------
+# tables (server side)
+# ---------------------------------------------------------------------------
+
+class _Accessor:
+    """Server-side update rule (reference: accessors, e.g. sparse SGD /
+    adagrad rules in paddle/fluid/distributed/ps/table/)."""
+
+    def __init__(self, rule="sgd", lr=0.01, eps=1e-8):
+        if rule not in ("sgd", "adagrad", "sum"):
+            raise ValueError(f"unknown accessor rule {rule!r}")
+        self.rule = rule
+        self.lr = lr
+        self.eps = eps
+
+    def init_state(self, dim):
+        return np.zeros(dim, np.float32) if self.rule == "adagrad" else None
+
+    def apply(self, row, grad, state):
+        if self.rule == "sgd":
+            row -= self.lr * grad
+        elif self.rule == "adagrad":
+            state += grad * grad
+            row -= self.lr * grad / (np.sqrt(state) + self.eps)
+        else:                     # "sum": geo-async delta accumulation
+            row += grad
+        return row, state
+
+
+class SparseTable:
+    """id → row table; rows materialize on first pull (initializer)."""
+
+    def __init__(self, dim, initializer=None, rule="sgd", lr=0.01,
+                 seed=0):
+        self.dim = dim
+        self.rows = {}
+        self.states = {}
+        self.accessor = _Accessor(rule, lr)
+        self._rng = np.random.RandomState(seed)
+        self._init = initializer or (
+            lambda rng, dim: (rng.uniform(-0.05, 0.05, dim)
+                              .astype(np.float32)))
+        self.lock = threading.Lock()
+
+    def _row(self, i):
+        i = int(i)
+        r = self.rows.get(i)
+        if r is None:
+            r = self._init(self._rng, self.dim)
+            self.rows[i] = r
+            self.states[i] = self.accessor.init_state(self.dim)
+        return r
+
+    def pull(self, ids):
+        with self.lock:
+            return np.stack([self._row(i) for i in ids]) if len(ids) \
+                else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids, grads):
+        with self.lock:
+            for i, g in zip(ids, np.asarray(grads, np.float32)):
+                i = int(i)
+                row = self._row(i)
+                new_row, st = self.accessor.apply(row, g,
+                                                  self.states.get(i))
+                self.rows[i] = new_row
+                self.states[i] = st
+
+    def state(self):
+        with self.lock:
+            return {"dim": self.dim, "rows": dict(self.rows)}
+
+    def load(self, snap):
+        with self.lock:
+            self.rows = {int(k): np.asarray(v, np.float32)
+                         for k, v in snap["rows"].items()}
+
+
+class DenseTable:
+    """Flat parameter block with the same push/pull protocol."""
+
+    def __init__(self, shape, init=None, rule="sgd", lr=0.01):
+        self.value = (np.zeros(shape, np.float32) if init is None
+                      else np.asarray(init, np.float32).copy())
+        self.accessor = _Accessor(rule, lr)
+        self._state = self.accessor.init_state(self.value.shape)
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            return self.value.copy()
+
+    def push(self, grad):
+        with self.lock:
+            self.value, self._state = self.accessor.apply(
+                self.value, np.asarray(grad, np.float32), self._state)
+
+    def state(self):
+        with self.lock:
+            return {"value": self.value.copy()}
+
+    def load(self, snap):
+        with self.lock:
+            self.value = np.asarray(snap["value"], np.float32).copy()
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_frame(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class PSServer:
+    """One PS shard: hosts tables, serves pull/push/save/load/stop."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self.tables = {}
+        srv_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv_frame(self.request)
+                        _send_frame(self.request, srv_self._dispatch(req))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    # table management happens locally (the launcher creates tables on
+    # every shard with the same spec) or via the "create" op
+    def create_sparse_table(self, name, dim, **kw):
+        self.tables[name] = SparseTable(dim, **kw)
+
+    def create_dense_table(self, name, shape, **kw):
+        self.tables[name] = DenseTable(shape, **kw)
+
+    def _dispatch(self, req):
+        try:
+            op = req["op"]
+            if op == "create_sparse":
+                self.create_sparse_table(req["name"], req["dim"],
+                                         **req.get("kw", {}))
+                return {"ok": True}
+            if op == "create_dense":
+                self.create_dense_table(req["name"], req["shape"],
+                                        **req.get("kw", {}))
+                return {"ok": True}
+            if op == "pull_sparse":
+                return {"ok": True,
+                        "rows": self.tables[req["name"]].pull(req["ids"])}
+            if op == "push_sparse":
+                self.tables[req["name"]].push(req["ids"], req["grads"])
+                return {"ok": True}
+            if op == "pull_dense":
+                return {"ok": True,
+                        "value": self.tables[req["name"]].pull()}
+            if op == "push_dense":
+                self.tables[req["name"]].push(req["grad"])
+                return {"ok": True}
+            if op == "save":
+                return {"ok": True,
+                        "state": {n: t.state()
+                                  for n, t in self.tables.items()}}
+            if op == "load":
+                for n, snap in req["state"].items():
+                    self.tables[n].load(snap)
+                return {"ok": True}
+            if op == "ping":
+                return {"ok": True}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except Exception as e:   # surface to the client, keep serving
+            return {"ok": False, "error": repr(e)}
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class _Conn:
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=60)
+        self.lock = threading.Lock()
+
+    def call(self, req):
+        with self.lock:
+            _send_frame(self.sock, req)
+            resp = _recv_frame(self.sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"PS error: {resp.get('error')}")
+        return resp
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Worker-side handle; keys shard across servers by ``id % n``."""
+
+    def __init__(self, endpoints):
+        self.conns = []
+        for ep in endpoints:
+            host, _, port = ep.partition(":")
+            self.conns.append(_Conn(host or "127.0.0.1", int(port)))
+        self.n = len(self.conns)
+
+    # -- table creation (broadcast to every shard) --------------------------
+    def create_sparse_table(self, name, dim, **kw):
+        for c in self.conns:
+            c.call({"op": "create_sparse", "name": name, "dim": dim,
+                    "kw": kw})
+
+    def create_dense_table(self, name, shape, **kw):
+        # dense lives on shard 0 only (small); sparse is what scales
+        self.conns[0].call({"op": "create_dense", "name": name,
+                            "shape": shape, "kw": kw})
+
+    # -- sparse -------------------------------------------------------------
+    def _shard_ids(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        shard = ids % self.n
+        order = []
+        per = []
+        for s in range(self.n):
+            idx = np.nonzero(shard == s)[0]
+            order.append(idx)
+            per.append(ids[idx])
+        return ids, order, per
+
+    def pull_sparse(self, name, ids):
+        ids_flat, order, per = self._shard_ids(ids)
+        dim = None
+        out = None
+        for s, (idx, sid) in enumerate(zip(order, per)):
+            if len(sid) == 0:
+                continue
+            rows = self.conns[s].call(
+                {"op": "pull_sparse", "name": name,
+                 "ids": sid.tolist()})["rows"]
+            if out is None:
+                dim = rows.shape[1] if rows.ndim == 2 else 0
+                out = np.zeros((len(ids_flat), dim), np.float32)
+            out[idx] = rows
+        if out is None:
+            raise ValueError("pull_sparse with no ids")
+        return out.reshape(*np.shape(ids), dim)
+
+    def push_sparse(self, name, ids, grads):
+        ids_flat, order, per = self._shard_ids(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids_flat), -1)
+        for s, (idx, sid) in enumerate(zip(order, per)):
+            if len(sid) == 0:
+                continue
+            self.conns[s].call(
+                {"op": "push_sparse", "name": name, "ids": sid.tolist(),
+                 "grads": grads[idx]})
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, name):
+        return self.conns[0].call({"op": "pull_dense",
+                                   "name": name})["value"]
+
+    def push_dense(self, name, grad):
+        self.conns[0].call({"op": "push_dense", "name": name,
+                            "grad": np.asarray(grad, np.float32)})
+
+    # -- persistence ---------------------------------------------------------
+    def save_persistables(self, path):
+        """Snapshot every shard's tables to ``path`` (one file per shard)."""
+        import os
+        os.makedirs(path, exist_ok=True)
+        for s, c in enumerate(self.conns):
+            state = c.call({"op": "save"})["state"]
+            with open(os.path.join(path, f"ps_shard_{s}.pkl"), "wb") as f:
+                pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def load_persistables(self, path):
+        import os
+        for s, c in enumerate(self.conns):
+            fp = os.path.join(path, f"ps_shard_{s}.pkl")
+            with open(fp, "rb") as f:
+                state = pickle.load(f)
+            c.call({"op": "load", "state": state})
+
+    def close(self):
+        for c in self.conns:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# geo-async (client-side cache + delta accumulation)
+# ---------------------------------------------------------------------------
+
+class GeoSparseTable:
+    """Geo-async SGD view of a sparse table (reference: geo-async mode —
+    workers train on a local replica and ship accumulated DELTAS every
+    ``geo_step`` updates; the server's accessor rule for the table must
+    be "sum" so deltas add).
+
+    Local updates apply immediately (plain SGD on the cache) so the
+    worker trains on fresh values; ``flush()``/auto-flush pushes the
+    accumulated difference and re-pulls the merged rows.
+    """
+
+    def __init__(self, client, name, lr=0.01, geo_step=8):
+        self.client = client
+        self.name = name
+        self.lr = lr
+        self.geo_step = geo_step
+        self.cache = {}
+        self.delta = {}
+        self._pushes = 0
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        missing = [i for i in ids.tolist() if i not in self.cache]
+        if missing:
+            rows = self.client.pull_sparse(self.name, missing)
+            for i, r in zip(missing, rows):
+                self.cache[int(i)] = r.astype(np.float32).copy()
+        return np.stack([self.cache[int(i)] for i in ids])
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        for i, g in zip(ids.tolist(), grads):
+            upd = -self.lr * g
+            self.cache[i] = self.cache[i] + upd
+            self.delta[i] = self.delta.get(i, 0.0) + upd
+        self._pushes += 1
+        if self._pushes >= self.geo_step:
+            self.flush()
+
+    def flush(self):
+        if self.delta:
+            ids = list(self.delta.keys())
+            deltas = np.stack([self.delta[i] for i in ids])
+            # server table rule must be "sum": the delta adds into the row
+            self.client.push_sparse(self.name, ids, deltas)
+            rows = self.client.pull_sparse(self.name, ids)
+            for i, r in zip(ids, rows):
+                self.cache[int(i)] = r.astype(np.float32).copy()
+            self.delta.clear()
+        self._pushes = 0

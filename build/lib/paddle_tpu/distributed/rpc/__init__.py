@@ -1,0 +1,176 @@
+"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/rpc.py
+over the C++ brpc agent in paddle/fluid/distributed/rpc/).
+
+TPU-native: control-plane RPC stays host-side Python — a threaded TCP
+server per worker executing pickled callables, with worker discovery
+through the framework TCPStore (the reference exchanges WorkerInfo through
+its master the same way).  Trust model matches the reference (pickled
+payloads on a private cluster network); tensor traffic belongs on the XLA
+collective path, not here.
+"""
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "get_current_worker_info"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = {}
+
+
+def _recv_full(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            (size,) = struct.unpack("!Q", _recv_full(self.request, 8))
+            fn, args, kwargs = pickle.loads(_recv_full(self.request, size))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:          # ship the exception back
+                result = (False, e)
+            try:
+                payload = pickle.dumps(result, protocol=4)
+            except Exception as e:          # unpicklable result/exception
+                payload = pickle.dumps(
+                    (False, RuntimeError(
+                        f"rpc result not picklable: {e!r}; original: "
+                        f"{result[1]!r}")), protocol=4)
+            self.request.sendall(struct.pack("!Q", len(payload)) + payload)
+        except ConnectionError:
+            pass
+
+
+class _RpcServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC agent and exchange WorkerInfo via the store
+    (reference: paddle.distributed.rpc.init_rpc)."""
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8765")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = _RpcServer(("0.0.0.0", 0), _RpcHandler)
+    sport = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    # reuse an already-running store at the endpoint (e.g. launcher-hosted);
+    # otherwise rank 0 hosts it and the rest retry until it is up
+    store = None
+    deadline = time.time() + 60.0
+    while store is None:
+        try:
+            store = TCPStore(host, int(port), is_master=False,
+                             world_size=world_size, timeout=2.0)
+        except Exception:
+            if rank == 0:
+                store = TCPStore(host, int(port), is_master=True,
+                                 world_size=world_size)
+            elif time.time() > deadline:
+                raise TimeoutError(
+                    f"rpc master store at {master_endpoint} never came up")
+            else:
+                time.sleep(0.5)
+    my_ip = os.environ.get("POD_IP", "127.0.0.1")
+    store.set(f"rpc/worker/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, my_ip, sport)))
+    infos = {}
+    for r in range(world_size):
+        infos[r] = pickle.loads(store.get(f"rpc/worker/{r}", timeout=60.0))
+    by_name = {w.name: w for w in infos.values()}
+
+    _state.update(dict(server=server, thread=thread, store=store,
+                       rank=rank, world_size=world_size, name=name,
+                       infos=infos, by_name=by_name,
+                       pool=ThreadPoolExecutor(max_workers=8)))
+    # everybody present before returning (reference barriers in init_rpc)
+    store.barrier("rpc/init", world_size=world_size)
+    return infos[rank]
+
+
+def _resolve(to):
+    if isinstance(to, WorkerInfo):
+        return to
+    if isinstance(to, int):
+        return _state["infos"][to]
+    return _state["by_name"][to]
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    w = _resolve(to)
+    payload = pickle.dumps((fn, args or (), kwargs or {}), protocol=4)
+    with socket.create_connection((w.ip, w.port),
+                                  timeout=None if timeout in (-1, None)
+                                  else timeout) as s:
+        s.sendall(struct.pack("!Q", len(payload)) + payload)
+        (size,) = struct.unpack("!Q", _recv_full(s, 8))
+        ok, result = pickle.loads(_recv_full(s, size))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
+    if "server" not in _state:
+        raise RuntimeError("call init_rpc first")
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
+    if "server" not in _state:
+        raise RuntimeError("call init_rpc first")
+    return _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name):
+    return _state["by_name"][name]
+
+
+def get_all_worker_infos():
+    return [w for _, w in sorted(_state["infos"].items())]
+
+
+def get_current_worker_info():
+    return _state["infos"][_state["rank"]]
+
+
+def shutdown():
+    if "server" not in _state:
+        return
+    # drain own outgoing calls first, THEN barrier so no peer is mid-call
+    # against our server when we close it
+    _state["pool"].shutdown(wait=True)
+    try:
+        _state["store"].barrier("rpc/shutdown",
+                                world_size=_state["world_size"])
+    except Exception:
+        pass
+    _state["server"].shutdown()
+    _state["server"].server_close()
+    _state.clear()

@@ -1,0 +1,313 @@
+"""TCPStore — rank-0-hosted key-value rendezvous store (reference:
+paddle/fluid/distributed/store/tcp_store.cc, exposed to Python as
+``paddle.distributed.TCPStore``-alike via pybind).
+
+Backed by the native C++ server/client in paddle_tpu/csrc/tcp_store.cc
+(one connection-handler thread per worker, condition-variable-blocked
+GET/WAIT).  A pure-Python implementation of the same wire protocol is the
+fallback so behavior is identical without the toolchain.
+
+On TPU the PJRT coordination service (jax.distributed) replaces NCCL
+unique-id exchange; the store remains the framework's control plane for
+barriers, elastic membership, and launcher rendezvous.
+"""
+import ctypes
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+from ..framework import native
+
+__all__ = ["TCPStore", "MasterStore"]
+
+_SET, _GET, _ADD, _WAIT, _DEL, _NUMKEYS = 1, 2, 3, 4, 5, 6
+
+
+class _PyStoreServer:
+    """Python fallback server speaking the native wire protocol."""
+
+    def __init__(self, port=0):
+        kv = {}
+        cond = threading.Condition()
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    hdr = _recv_full(sock, 5)
+                    if hdr is None:
+                        return
+                    op, keylen = struct.unpack("<BI", hdr)
+                    key = _recv_full(sock, keylen) if keylen else b""
+                    if key is None:
+                        return
+                    lenbuf = _recv_full(sock, 8)
+                    if lenbuf is None:
+                        return
+                    (paylen,) = struct.unpack("<Q", lenbuf)
+                    payload = _recv_full(sock, paylen) if paylen else b""
+                    if payload is None:
+                        return
+                    status, out = 0, b""
+                    if op == _SET:
+                        with cond:
+                            kv[key] = payload
+                            cond.notify_all()
+                    elif op in (_GET, _WAIT):
+                        (timeout_ms,) = struct.unpack("<q", payload)
+                        deadline = (None if timeout_ms < 0
+                                    else time.monotonic() + timeout_ms / 1e3)
+                        with cond:
+                            while key not in kv and not outer._stopped:
+                                rem = (None if deadline is None
+                                       else deadline - time.monotonic())
+                                if rem is not None and rem <= 0:
+                                    break
+                                cond.wait(rem)
+                            if key in kv:
+                                out = kv[key] if op == _GET else b""
+                            else:
+                                status = 1
+                    elif op == _ADD:
+                        (delta,) = struct.unpack("<q", payload)
+                        with cond:
+                            prev = kv.get(key, b"")
+                            cur = (struct.unpack("<q", prev)[0]
+                                   if len(prev) == 8 else 0) + delta
+                            kv[key] = struct.pack("<q", cur)
+                            out = kv[key]
+                            cond.notify_all()
+                    elif op == _DEL:
+                        with cond:
+                            status = 0 if kv.pop(key, None) is not None else 1
+                    elif op == _NUMKEYS:
+                        with cond:
+                            out = struct.pack("<q", len(kv))
+                    else:
+                        status = 1
+                    try:
+                        sock.sendall(struct.pack("<BQ", status, len(out)) + out)
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._stopped = False
+        self._cond = cond
+        self._server = Server(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stopped = True
+        with self._cond:  # wake handlers parked in infinite GET/WAIT
+            self._cond.notify_all()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _recv_full(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _PyStoreClient:
+    def __init__(self, host, port, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1e3
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.settimeout(None)
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"TCPStore: cannot reach {host}:{port}")
+                time.sleep(0.05)
+        self._mu = threading.Lock()
+
+    def request(self, op, key, payload):
+        with self._mu:
+            msg = struct.pack("<BI", op, len(key)) + key + \
+                struct.pack("<Q", len(payload)) + payload
+            self._sock.sendall(msg)
+            hdr = _recv_full(self._sock, 9)
+            if hdr is None:
+                raise ConnectionError("TCPStore connection lost")
+            status, outlen = struct.unpack("<BQ", hdr)
+            out = _recv_full(self._sock, outlen) if outlen else b""
+            return status, out
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Distributed KV store.  ``is_master=True`` also hosts the server.
+
+    API mirrors the reference: set/get/add/wait/delete_key, plus a
+    counter-based ``barrier``.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30.0):
+        self._lib = native.get_lib()
+        self._server = None
+        self._server_h = None
+        self.world_size = world_size
+        timeout_ms = int(timeout * 1000)
+        if is_master:
+            if self._lib is not None:
+                self._server_h = self._lib.pt_store_server_start(port)
+                if not self._server_h:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                port = self._lib.pt_store_server_port(self._server_h)
+            else:
+                self._server = _PyStoreServer(port)
+                port = self._server.port
+            host = "127.0.0.1" if host in ("", "0.0.0.0") else host
+        self.host, self.port = host, port
+        if self._lib is not None:
+            self._client = self._lib.pt_store_client_connect(
+                host.encode(), port, timeout_ms)
+            if not self._client:
+                raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
+        else:
+            self._client = _PyStoreClient(host, port, timeout_ms)
+
+    # -- core ops ---------------------------------------------------
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        if self._lib is not None:
+            buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) \
+                if value else None
+            rc = self._lib.pt_store_set(self._client, key.encode(), buf,
+                                        len(value))
+            if rc != 0:
+                raise ConnectionError("TCPStore set failed")
+        else:
+            self._client.request(_SET, key.encode(), value)
+
+    def get(self, key, timeout=30.0):
+        tmo = int(timeout * 1000) if timeout is not None else -1
+        if self._lib is not None:
+            import ctypes
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.pt_store_get(self._client, key.encode(), tmo,
+                                       ctypes.byref(out))
+            if n == -1:
+                raise KeyError(key)
+            if n < 0:
+                raise ConnectionError("TCPStore get failed")
+            return native.take_buffer(self._lib, out, n)
+        status, out = self._client.request(
+            _GET, key.encode(), struct.pack("<q", tmo))
+        if status != 0:
+            raise KeyError(key)
+        return out
+
+    def add(self, key, delta=1):
+        if self._lib is not None:
+            v = self._lib.pt_store_add(self._client, key.encode(), delta)
+            if v == -(2 ** 63):
+                raise ConnectionError("TCPStore add failed")
+            return v
+        status, out = self._client.request(
+            _ADD, key.encode(), struct.pack("<q", delta))
+        if status != 0 or len(out) != 8:
+            raise ConnectionError("TCPStore add failed")
+        return struct.unpack("<q", out)[0]
+
+    def wait(self, keys, timeout=30.0):
+        if isinstance(keys, str):
+            keys = [keys]
+        tmo = int(timeout * 1000) if timeout is not None else -1
+        for key in keys:
+            if self._lib is not None:
+                rc = self._lib.pt_store_wait(self._client, key.encode(), tmo)
+                if rc == 1:
+                    raise TimeoutError(f"TCPStore: wait({key}) timed out")
+                if rc != 0:
+                    raise ConnectionError("TCPStore wait failed")
+            else:
+                status, _ = self._client.request(
+                    _WAIT, key.encode(), struct.pack("<q", tmo))
+                if status != 0:
+                    raise TimeoutError(f"TCPStore: wait({key}) timed out")
+
+    def delete_key(self, key):
+        if self._lib is not None:
+            return self._lib.pt_store_delete(self._client, key.encode()) == 0
+        status, _ = self._client.request(_DEL, key.encode(), b"")
+        return status == 0
+
+    def num_keys(self):
+        if self._lib is not None:
+            return self._lib.pt_store_num_keys(self._client)
+        _, out = self._client.request(_NUMKEYS, b"", b"")
+        return struct.unpack("<q", out)[0]
+
+    # -- composite --------------------------------------------------
+    def barrier(self, name="barrier", world_size=None, timeout=60.0):
+        """Counter barrier: every rank adds 1, then waits for the release
+        key that the last arriver sets."""
+        n = world_size or self.world_size
+        arrived = self.add(f"__{name}/count", 1)
+        epoch = (arrived - 1) // n
+        release = f"__{name}/release/{epoch}"
+        if arrived % n == 0:
+            self.set(release, b"1")
+        self.wait([release], timeout=timeout)
+
+    def close(self):
+        if self._lib is not None:
+            if self._client:
+                self._lib.pt_store_client_close(self._client)
+                self._client = None
+            if self._server_h:
+                self._lib.pt_store_server_stop(self._server_h)
+                self._server_h = None
+        else:
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def MasterStore(world_size, timeout=30.0):
+    """Build the store from launcher env (PADDLE_MASTER,
+    PADDLE_TRAINER_ID), rank 0 hosting."""
+    master = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, _, port = master.partition(":")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    return TCPStore(host or "127.0.0.1", int(port or 0), is_master=rank == 0,
+                    world_size=world_size, timeout=timeout)

@@ -1,0 +1,465 @@
+"""Probability distributions (reference: python/paddle/distribution/)."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.random import next_key
+from ..tensor._helpers import ensure_tensor, raw
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel", "Laplace",
+           "LogNormal", "Multinomial", "Poisson", "StudentT", "Geometric",
+           "Cauchy", "kl_divergence", "register_kl", "Independent",
+           "TransformedDistribution", "ExponentialFamily"]
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(raw(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return Tensor(jnp.square(raw(self.scale)))
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        z = jax.random.normal(next_key(), shp)
+        return Tensor(raw(self.loc) + raw(self.scale) * z)
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        var = jnp.square(raw(self.scale))
+        return Tensor(-jnp.square(v - raw(self.loc)) / (2 * var) -
+                      jnp.log(raw(self.scale)) -
+                      0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) +
+                      jnp.log(raw(self.scale)) +
+                      jnp.zeros(self._batch_shape))
+
+    def kl_divergence(self, other):
+        var1 = jnp.square(raw(self.scale))
+        var2 = jnp.square(raw(other.scale))
+        return Tensor(jnp.log(raw(other.scale) / raw(self.scale)) +
+                      (var1 + jnp.square(raw(self.loc) - raw(other.loc))) /
+                      (2 * var2) - 0.5)
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(raw(super().sample(shape))))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        logv = jnp.log(v)
+        base = raw(super().log_prob(Tensor(logv)))
+        return Tensor(base - logv)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low)
+        self.high = ensure_tensor(high)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(next_key(), shp)
+        return Tensor(raw(self.low) + (raw(self.high) - raw(self.low)) * u)
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        inside = (v >= raw(self.low)) & (v < raw(self.high))
+        lp = -jnp.log(raw(self.high) - raw(self.low))
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(raw(self.high) - raw(self.low)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = ensure_tensor(logits)
+        super().__init__(tuple(self.logits.shape)[:-1])
+
+    @property
+    def probs_(self):
+        return jax.nn.softmax(raw(self.logits), axis=-1)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.categorical(
+            next_key(), raw(self.logits), shape=shp).astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value)).astype(jnp.int32)
+        logp = jax.nn.log_softmax(raw(self.logits), axis=-1)
+        return Tensor(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(raw(self.log_prob(value))))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(raw(self.logits), axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+    def kl_divergence(self, other):
+        logp = jax.nn.log_softmax(raw(self.logits), axis=-1)
+        logq = jax.nn.log_softmax(raw(other.logits), axis=-1)
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs = ensure_tensor(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            next_key(), raw(self.probs), shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        p = jnp.clip(raw(self.probs), 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(raw(self.probs), 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = ensure_tensor(alpha)
+        self.beta = ensure_tensor(beta)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(next_key(), raw(self.alpha),
+                                      raw(self.beta), shp))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        a, b = raw(self.alpha), raw(self.beta)
+        lbeta = (jax.scipy.special.gammaln(a) +
+                 jax.scipy.special.gammaln(b) -
+                 jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = ensure_tensor(concentration)
+        super().__init__(tuple(self.concentration.shape)[:-1],
+                         tuple(self.concentration.shape)[-1:])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(next_key(),
+                                           raw(self.concentration), shp))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        a = raw(self.concentration)
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), axis=-1) +
+                      jax.scipy.special.gammaln(jnp.sum(a, axis=-1)) -
+                      jnp.sum(jax.scipy.special.gammaln(a), axis=-1))
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = ensure_tensor(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(next_key(), shp) /
+                      raw(self.rate))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        return Tensor(jnp.log(raw(self.rate)) - raw(self.rate) * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(raw(self.rate)))
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = ensure_tensor(concentration)
+        self.rate = ensure_tensor(rate)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.concentration.shape), tuple(self.rate.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(next_key(), raw(self.concentration),
+                                       shp) / raw(self.rate))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        a, b = raw(self.concentration), raw(self.rate)
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v -
+                      jax.scipy.special.gammaln(a))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(raw(self.loc) + raw(self.scale) *
+                      jax.random.gumbel(next_key(), shp))
+
+    def log_prob(self, value):
+        z = (raw(ensure_tensor(value)) - raw(self.loc)) / raw(self.scale)
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(raw(self.scale)))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(raw(self.loc) + raw(self.scale) *
+                      jax.random.laplace(next_key(), shp))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        return Tensor(-jnp.abs(v - raw(self.loc)) / raw(self.scale) -
+                      jnp.log(2 * raw(self.scale)))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs = ensure_tensor(probs)
+        super().__init__(tuple(self.probs.shape)[:-1],
+                         tuple(self.probs.shape)[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        p = raw(self.probs)
+        idx = jax.random.categorical(
+            next_key(), jnp.log(jnp.clip(p, 1e-30)),
+            shape=tuple(shape) + self._batch_shape + (n,))
+        k = p.shape[-1]
+        return Tensor(jax.nn.one_hot(idx, k).sum(axis=-2))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        p = jnp.clip(raw(self.probs), 1e-30)
+        logc = (jax.scipy.special.gammaln(self.total_count + 1.0) -
+                jnp.sum(jax.scipy.special.gammaln(v + 1.0), axis=-1))
+        return Tensor(logc + jnp.sum(v * jnp.log(p), axis=-1))
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = ensure_tensor(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(next_key(), raw(self.rate),
+                                         shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        r = raw(self.rate)
+        return Tensor(v * jnp.log(r) - r -
+                      jax.scipy.special.gammaln(v + 1.0))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = ensure_tensor(df)
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.df.shape), tuple(self.loc.shape),
+            tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(raw(self.loc) + raw(self.scale) *
+                      jax.random.t(next_key(), raw(self.df), shp))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        df, loc, sc = raw(self.df), raw(self.loc), raw(self.scale)
+        z = (v - loc) / sc
+        return Tensor(jax.scipy.special.gammaln((df + 1) / 2) -
+                      jax.scipy.special.gammaln(df / 2) -
+                      0.5 * jnp.log(df * math.pi) - jnp.log(sc) -
+                      (df + 1) / 2 * jnp.log1p(z * z / df))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs = ensure_tensor(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(next_key(), shp)
+        return Tensor(jnp.floor(jnp.log1p(-u) /
+                                jnp.log1p(-raw(self.probs))))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        p = raw(self.probs)
+        return Tensor(v * jnp.log1p(-p) + jnp.log(p))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(raw(self.loc) + raw(self.scale) *
+                      jax.random.cauchy(next_key(), shp))
+
+    def log_prob(self, value):
+        z = (raw(ensure_tensor(value)) - raw(self.loc)) / raw(self.scale)
+        return Tensor(-jnp.log(math.pi * raw(self.scale) * (1 + z * z)))
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - reinterpreted_batch_rank],
+                         bs[len(bs) - reinterpreted_batch_rank:] +
+                         base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = raw(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        """Change of variables: log p(y) = log p_base(x) + Σ ildj."""
+        x = value
+        total = None
+        for t in reversed(self.transforms):
+            ildj = t.inverse_log_det_jacobian(x)
+            x = t.inverse(x)
+            total = ildj if total is None else total + ildj
+        lp = self.base.log_prob(x)
+        return lp if total is None else lp + total
+
+
+# -- KL registry -------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)

@@ -1,0 +1,353 @@
+"""paddle.distribution.transform (reference:
+python/paddle/distribution/transform.py — bijector library for
+TransformedDistribution).
+
+TPU-native: each Transform is a pair of jnp maps + a log-det-Jacobian, run
+through the eager tape (``call_op``) so forward/inverse and
+``TransformedDistribution.log_prob`` are differentiable and jit-safe.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.OTHER
+
+    def forward(self, x):
+        return call_op(self._forward, _as_tensor(x))
+
+    def inverse(self, y):
+        return call_op(self._inverse, _as_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return call_op(self._fldj, _as_tensor(x))
+
+    def inverse_log_det_jacobian(self, y):
+        # default: -fldj(inverse(y))
+        return call_op(lambda v: -self._fldj(self._inverse(v)),
+                       _as_tensor(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # jnp-level implementations to override
+    def _forward(self, v):
+        raise NotImplementedError
+
+    def _inverse(self, v):
+        raise NotImplementedError
+
+    def _fldj(self, v):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (surjection onto [0, inf))."""
+    _type = Type.SURJECTION
+
+    def _forward(self, v):
+        return jnp.abs(v)
+
+    def _inverse(self, v):
+        return v  # principal branch
+
+    def _fldj(self, v):
+        return jnp.zeros_like(v)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def _forward(self, v):
+        return self.loc._value + self.scale._value * v
+
+    def _inverse(self, v):
+        return (v - self.loc._value) / self.scale._value
+
+    def _fldj(self, v):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._value)), v.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, v):
+        return jnp.exp(v)
+
+    def _inverse(self, v):
+        return jnp.log(v)
+
+    def _fldj(self, v):
+        return v
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def _forward(self, v):
+        return jnp.power(v, self.power._value)
+
+    def _inverse(self, v):
+        return jnp.power(v, 1.0 / self.power._value)
+
+    def _fldj(self, v):
+        p = self.power._value
+        return jnp.log(jnp.abs(p * jnp.power(v, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, v):
+        return jax.nn.sigmoid(v)
+
+    def _inverse(self, v):
+        return jnp.log(v) - jnp.log1p(-v)
+
+    def _fldj(self, v):
+        return -jax.nn.softplus(-v) - jax.nn.softplus(v)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, v):
+        return jnp.tanh(v)
+
+    def _inverse(self, v):
+        return jnp.arctanh(v)
+
+    def _fldj(self, v):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - v - jax.nn.softplus(-2.0 * v))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (surjection onto the simplex)."""
+    _type = Type.OTHER
+
+    def _forward(self, v):
+        return jax.nn.softmax(v, axis=-1)
+
+    def _inverse(self, v):
+        return jnp.log(v)
+
+    def _fldj(self, v):
+        raise NotImplementedError("softmax is not injective; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} → open simplex in R^K (reference:
+    transform.StickBreakingTransform)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, v):
+        # y_k = z_k · prod_{j<k}(1-z_j),  z_k = sigmoid(x_k - log(K-k))
+        offset = v.shape[-1] - jnp.arange(v.shape[-1], dtype=v.dtype)
+        z = jax.nn.sigmoid(v - jnp.log(offset))
+        cum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate([jnp.ones_like(cum[..., :1]),
+                                cum[..., :-1]], axis=-1)
+        y = z * lead
+        last = cum[..., -1:]
+        return jnp.concatenate([y, last], axis=-1)
+
+    def _inverse(self, v):
+        y = v[..., :-1]
+        rem = 1 - jnp.cumsum(y, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(rem[..., :1]), rem[..., :-1]], axis=-1)
+        z = y / lead
+        offset = y.shape[-1] - jnp.arange(y.shape[-1], dtype=v.dtype)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, v):
+        # lower-triangular Jacobian: log|det| =
+        # Σ_k [log z_k + log(1-z_k) + log Π_{j<k}(1-z_j)]
+        offset = v.shape[-1] - jnp.arange(v.shape[-1], dtype=v.dtype)
+        z = jax.nn.sigmoid(v - jnp.log(offset))
+        cum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate([jnp.ones_like(cum[..., :1]),
+                                cum[..., :-1]], axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else call_op(
+                lambda a, b: a + b, total, ldj)
+            x = t.forward(x)
+        return total
+
+    def inverse_log_det_jacobian(self, y):
+        total = None
+        for t in reversed(self.transforms):
+            ildj = t.inverse_log_det_jacobian(y)
+            total = ildj if total is None else call_op(
+                lambda a, b: a + b, total, ildj)
+            y = t.inverse(y)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing dims as event dims: sums the base log-det over
+    the last ``reinterpreted_batch_rank`` axes."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        r = self.rank
+        return call_op(lambda v: jnp.sum(v, axis=tuple(range(-r, 0))), ldj)
+
+    def inverse_log_det_jacobian(self, y):
+        ildj = self.base.inverse_log_det_jacobian(y)
+        r = self.rank
+        return call_op(lambda v: jnp.sum(v, axis=tuple(range(-r, 0))), ildj)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, v):
+        batch = v.shape[:v.ndim - len(self.in_event_shape)]
+        return v.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, v):
+        batch = v.shape[:v.ndim - len(self.out_event_shape)]
+        return v.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, v):
+        batch = v.shape[:v.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, v.dtype)
+
+    def forward_shape(self, shape):
+        n = len(shape) - len(self.in_event_shape)
+        return tuple(shape[:n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(shape) - len(self.out_event_shape)
+        return tuple(shape[:n]) + self.in_event_shape
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def forward(self, x):
+        x = _as_tensor(x)
+        ax = self.axis
+
+        def impl(v):
+            parts = [t._forward(p.squeeze(ax)) for t, p in zip(
+                self.transforms,
+                jnp.split(v, len(self.transforms), axis=ax))]
+            return jnp.stack(parts, axis=ax)
+        return call_op(impl, x)
+
+    def inverse(self, y):
+        y = _as_tensor(y)
+        ax = self.axis
+
+        def impl(v):
+            parts = [t._inverse(p.squeeze(ax)) for t, p in zip(
+                self.transforms,
+                jnp.split(v, len(self.transforms), axis=ax))]
+            return jnp.stack(parts, axis=ax)
+        return call_op(impl, y)
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        ax = self.axis
+
+        def impl(v):
+            parts = [t._fldj(p.squeeze(ax)) for t, p in zip(
+                self.transforms,
+                jnp.split(v, len(self.transforms), axis=ax))]
+            return jnp.stack(parts, axis=ax)
+        return call_op(impl, x)
+
+    def inverse_log_det_jacobian(self, y):
+        y = _as_tensor(y)
+        ax = self.axis
+
+        def impl(v):
+            parts = [-t._fldj(t._inverse(p.squeeze(ax))) for t, p in zip(
+                self.transforms,
+                jnp.split(v, len(self.transforms), axis=ax))]
+            return jnp.stack(parts, axis=ax)
+        return call_op(impl, y)

@@ -1,0 +1,166 @@
+"""paddle.fft — discrete Fourier transforms (reference: python/paddle/fft.py
+over phi fft kernels / cuFFT).  TPU-native: jnp.fft lowers to XLA's FFT HLO,
+which runs on the TPU's vector unit; autograd comes from jax.vjp through the
+eager tape like every other op.
+"""
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .framework.autograd import call_op
+from .tensor._helpers import ensure_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_VALID_NORM = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _VALID_NORM:
+        raise ValueError(f"norm must be one of {_VALID_NORM}, got {norm!r}")
+    return norm
+
+
+def _1d(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        norm = _check_norm(norm)
+        return call_op(lambda v: jfn(v, n=n, axis=axis, norm=norm),
+                       ensure_tensor(x))
+    return op
+
+
+def _2d(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        norm = _check_norm(norm)
+        return call_op(lambda v: jfn(v, s=s, axes=tuple(axes), norm=norm),
+                       ensure_tensor(x))
+    return op
+
+
+def _nd(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        norm = _check_norm(norm)
+        ax = tuple(axes) if axes is not None else None
+        return call_op(lambda v: jfn(v, s=s, axes=ax, norm=norm),
+                       ensure_tensor(x))
+    return op
+
+
+fft = _1d(jnp.fft.fft)
+ifft = _1d(jnp.fft.ifft)
+rfft = _1d(jnp.fft.rfft)
+irfft = _1d(jnp.fft.irfft)
+hfft = _1d(jnp.fft.hfft)
+ihfft = _1d(jnp.fft.ihfft)
+
+fft2 = _2d(jnp.fft.fft2)
+ifft2 = _2d(jnp.fft.ifft2)
+
+
+rfft2 = _2d(jnp.fft.rfft2)
+irfft2 = _2d(jnp.fft.irfft2)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _check_norm(norm)
+    return call_op(lambda v: _hfftn_impl(v, s, tuple(axes), norm),
+                   ensure_tensor(x))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _check_norm(norm)
+    return call_op(lambda v: _ihfftn_impl(v, s, tuple(axes), norm),
+                   ensure_tensor(x))
+
+
+fftn = _nd(jnp.fft.fftn)
+ifftn = _nd(jnp.fft.ifftn)
+rfftn = _nd(jnp.fft.rfftn)
+irfftn = _nd(jnp.fft.irfftn)
+
+
+def _default_axes(v, s, axes):
+    """numpy/paddle semantics: axes=None means all axes when s is None,
+    else the LAST len(s) axes."""
+    if axes is not None:
+        return tuple(axes)
+    if s is None:
+        return tuple(range(v.ndim))
+    return tuple(range(v.ndim - len(s), v.ndim))
+
+
+def _hfftn_impl(v, s, axes, norm):
+    """N-d Hermitian FFT: complex-conjugate-symmetric input → real output.
+
+    Last transformed axis uses hfft (expand hermitian half-spectrum); the
+    leading axes are ordinary ffts of a (real) result, matching numpy's
+    definition hfftn(x) = fftn over leading axes then hfft on the last.
+    """
+    axes = _default_axes(v, s, axes)
+    s = list(s) if s is not None else [None] * len(axes)
+    lead_axes, last_axis = axes[:-1], axes[-1]
+    if lead_axes:
+        lead_s = [n for n in s[:-1]]
+        if any(n is not None for n in lead_s):
+            v = jnp.fft.fftn(v, s=lead_s, axes=lead_axes, norm=norm)
+        else:
+            v = jnp.fft.fftn(v, axes=lead_axes, norm=norm)
+    return jnp.fft.hfft(v, n=s[-1], axis=last_axis, norm=norm)
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    axes = _default_axes(v, s, axes)
+    s = list(s) if s is not None else [None] * len(axes)
+    lead_axes, last_axis = axes[:-1], axes[-1]
+    out = jnp.fft.ihfft(v, n=s[-1], axis=last_axis, norm=norm)
+    if lead_axes:
+        lead_s = s[:-1]
+        if any(n is not None for n in lead_s):
+            out = jnp.fft.ifftn(out, s=lead_s, axes=lead_axes, norm=norm)
+        else:
+            out = jnp.fft.ifftn(out, axes=lead_axes, norm=norm)
+    return out
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _check_norm(norm)
+    ax = tuple(axes) if axes is not None else None
+    return call_op(lambda v: _hfftn_impl(v, s, ax, norm), ensure_tensor(x))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _check_norm(norm)
+    ax = tuple(axes) if axes is not None else None
+    return call_op(lambda v: _ihfftn_impl(v, s, ax, norm), ensure_tensor(x))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .framework import dtypes
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .framework import dtypes
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return call_op(lambda v: jnp.fft.fftshift(v, axes=ax), ensure_tensor(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return call_op(lambda v: jnp.fft.ifftshift(v, axes=ax), ensure_tensor(x))

@@ -1,0 +1,5 @@
+from . import dtypes  # noqa: F401
+from .core import Tensor, to_tensor, set_device, get_device  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from . import functional  # noqa: F401

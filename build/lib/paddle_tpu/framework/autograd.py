@@ -1,0 +1,318 @@
+"""Eager (dygraph) reverse-mode autograd.
+
+The reference implements this as a C++ engine over per-op GradNodes
+(reference: paddle/fluid/eager/backward.cc, grad nodes generated from op
+YAML).  TPU-native design: every eager op is executed through ``jax.vjp`` of
+its jnp implementation, which gives us the op's pullback for free — there is
+no per-op grad-kernel registry to maintain, and op/grad parity is guaranteed
+by construction.  The tape is a DAG of ``Node`` objects; ``backward`` runs a
+consumer-counting (Kahn) traversal, mirroring the queue-based traversal of
+``egr::Backward``.
+
+The tape is *only* the dygraph path.  The performance path (``jit``-compiled
+train steps, ``to_static``) never records a tape: it traces layer forwards as
+pure functions and differentiates with ``jax.grad`` (see
+``paddle_tpu.framework.functional``).
+"""
+import weakref
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "call_op", "backward", "grad",
+]
+
+_GRAD_ENABLED = [True]
+# When tracing a pure function (jit / to_static / grad-of-fn) the tape must
+# stay silent; functional.py flips this.
+_TAPE_SUSPENDED = [False]
+
+
+def is_grad_enabled():
+    return _GRAD_ENABLED[0] and not _TAPE_SUSPENDED[0]
+
+
+def set_grad_enabled(mode):
+    _GRAD_ENABLED[0] = bool(mode)
+
+
+class no_grad:
+    """Context manager & decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _GRAD_ENABLED[0]
+        _GRAD_ENABLED[0] = False
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_ENABLED[0] = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _GRAD_ENABLED[0]
+        _GRAD_ENABLED[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_ENABLED[0] = self._prev
+        return False
+
+
+@contextmanager
+def suspend_tape():
+    prev = _TAPE_SUSPENDED[0]
+    _TAPE_SUSPENDED[0] = True
+    try:
+        yield
+    finally:
+        _TAPE_SUSPENDED[0] = prev
+
+
+class Node:
+    """One recorded op: holds the vjp closure and graph edges."""
+    __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "single_out",
+                 "materialize_grads", "__weakref__")
+
+    def __init__(self, vjp, inputs, outputs, single_out):
+        self.vjp = vjp
+        self.inputs = inputs            # tuple[Tensor] — keeps producers alive
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        self.out_avals = [(o._value.shape, o._value.dtype) for o in outputs]
+        self.single_out = single_out
+        # PyLayer nodes may opt out of zero-materialization for unused
+        # outputs (ctx.set_materialize_grads(False)); jax.vjp closures
+        # always need dense cotangents.
+        self.materialize_grads = True
+
+    def release(self):
+        self.vjp = None
+        self.inputs = ()
+
+
+# paddle_tpu.static installs a Program recorder here while static-graph
+# mode is building a program (define-and-run); every call_op appends its
+# primal fn + tensor wiring so Executor.run can replay the graph as a pure
+# jit-compiled function of the feeds.
+_STATIC_RECORDER = [None]
+
+
+def call_op(fn, *tensors, **kwargs):
+    """Run ``fn(*values, **kwargs)`` eagerly, recording the tape if needed.
+
+    ``tensors`` are Tensor positional args; everything else must be static
+    and go through kwargs (closed over for the vjp).  Returns Tensor or
+    tuple of Tensors, matching fn's output structure.
+    """
+    from .core import Tensor  # circular-safe
+    vals = tuple(t._value for t in tensors)
+    f = (lambda *vs: fn(*vs, **kwargs)) if kwargs else fn
+    record = is_grad_enabled() and any(not t.stop_gradient for t in tensors)
+    if not record:
+        out = f(*vals)
+        if isinstance(out, (tuple, list)):
+            result = tuple(Tensor(o, stop_gradient=True) for o in out)
+        else:
+            result = Tensor(out, stop_gradient=True)
+        if _STATIC_RECORDER[0] is not None and not _TAPE_SUSPENDED[0]:
+            # suspend_tape (jit/to_static tracing) must silence program
+            # recording too, or tracer values leak into the Program
+            _STATIC_RECORDER[0].record(
+                f, tensors,
+                result if isinstance(result, tuple) else (result,))
+        return result
+
+    out_vals, vjp_fn = jax.vjp(f, *vals)
+    single = not isinstance(out_vals, (tuple, list))
+    outs_list = [out_vals] if single else list(out_vals)
+    out_tensors = [Tensor(o, stop_gradient=False) for o in outs_list]
+    node = Node(vjp_fn, tensors, out_tensors, single)
+    for i, o in enumerate(out_tensors):
+        o._node = node
+        o._out_idx = i
+    if _STATIC_RECORDER[0] is not None and not _TAPE_SUSPENDED[0]:
+        _STATIC_RECORDER[0].record(f, tensors, tuple(out_tensors))
+    return out_tensors[0] if single else tuple(out_tensors)
+
+
+def _toposort(root_nodes):
+    """Reachable nodes + per-node reachable-consumer counts."""
+    reachable = set()
+    stack = list(root_nodes)
+    order = []
+    while stack:
+        n = stack.pop()
+        if id(n) in reachable:
+            continue
+        reachable.add(id(n))
+        order.append(n)
+        for t in n.inputs:
+            if t.stop_gradient:
+                continue  # no cotangent flows through this edge
+            if t._node is not None and id(t._node) not in reachable:
+                stack.append(t._node)
+    consumers = {id(n): 0 for n in order}
+    for n in order:
+        seen_prod = set()
+        for t in n.inputs:
+            p = t._node
+            # mirror _run_backward exactly: stop_gradient edges carry no
+            # cotangent, so they must not be counted either
+            if t.stop_gradient:
+                continue
+            if p is not None and id(p) in consumers and id(p) not in seen_prod:
+                # count each consumer node once per (consumer, producer) edge
+                seen_prod.add(id(p))
+                consumers[id(p)] += 1
+    return order, consumers
+
+
+def _accumulate(tensor, cot):
+    for h in tensor._hooks:
+        new = h(tensor._wrap_grad(cot))
+        if new is not None:
+            cot = new._value if hasattr(new, "_value") else new
+    if tensor._grad is None:
+        tensor._grad = cot
+    else:
+        tensor._grad = tensor._grad + cot
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            g = (jnp.ones_like(tensor._value) if grad_tensor is None
+                 else grad_tensor._value)
+            _accumulate(tensor, g)
+        return
+    seed = (jnp.ones_like(tensor._value) if grad_tensor is None
+            else grad_tensor._value)
+    _run_backward({(id(tensor._node), tensor._out_idx): (tensor._node, seed)},
+                  retain_graph, sink_map=None)
+
+
+def _run_backward(seeds, retain_graph, sink_map):
+    """seeds: {(node_id, out_idx): (node, cotangent)}.
+
+    If sink_map is not None it is {id(Tensor): Tensor}; gradients for those
+    tensors are collected into the returned dict instead of ``.grad``.
+    """
+    roots = {id(n): n for n, _ in seeds.values()}
+    order, pending = _toposort(roots.values())
+    cots = {id(n): [None] * len(n.out_refs) for n in order}
+    for (nid, idx), (n, g) in seeds.items():
+        cur = cots[nid][idx]
+        cots[nid][idx] = g if cur is None else cur + g
+
+    collected = {} if sink_map is not None else None
+
+    ready = [n for n in order if pending[id(n)] == 0]
+    processed = []
+    while ready:
+        n = ready.pop()
+        if n.vjp is None:
+            raise RuntimeError(
+                "trying to backward through a graph that has already been "
+                "freed; call backward(retain_graph=True) if you need to "
+                "backward twice")
+        processed.append(n)
+        # fire hooks of this node's (alive) output tensors
+        out_cots = []
+        for i, (ref, aval) in enumerate(zip(n.out_refs, n.out_avals)):
+            c = cots[id(n)][i]
+            t = ref()
+            if c is None:
+                if n.materialize_grads:
+                    c = jnp.zeros(aval[0], aval[1])
+            elif t is not None:
+                for h in t._hooks:
+                    new = h(t._wrap_grad(c))
+                    if new is not None:
+                        c = new._value if hasattr(new, "_value") else new
+                if t._retain_grads:
+                    t._grad = c if t._grad is None else t._grad + c
+                if collected is not None and id(t) in sink_map:
+                    prev = collected.get(id(t))
+                    collected[id(t)] = c if prev is None else prev + c
+            out_cots.append(c)
+        in_cots = n.vjp(out_cots[0] if n.single_out else tuple(out_cots))
+        touched_producers = {}
+        for t, c in zip(n.inputs, in_cots):
+            if t.stop_gradient:
+                continue
+            p = t._node
+            if p is None:
+                if collected is not None:
+                    if id(t) in sink_map:
+                        prev = collected.get(id(t))
+                        collected[id(t)] = c if prev is None else prev + c
+                else:
+                    _accumulate(t, c)
+            else:
+                cur = cots[id(p)][t._out_idx]
+                cots[id(p)][t._out_idx] = c if cur is None else cur + c
+                touched_producers[id(p)] = p
+        # decrement once per unique producer, matching _toposort's counting
+        for pid, p in touched_producers.items():
+            pending[pid] -= 1
+            if pending[pid] == 0:
+                ready.append(p)
+        if not retain_graph:
+            n.release()
+    return collected
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """Functional gradient (paddle.grad).  create_graph is not yet supported."""
+    from .core import Tensor
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported in eager mode; "
+            "use paddle_tpu.incubate.autograd or jax transforms directly")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = (grad_outputs if isinstance(grad_outputs, (list, tuple))
+                    else [grad_outputs])
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    seeds = {}
+    trivial = {}
+    for o, go in zip(outputs, grad_outputs):
+        g = jnp.ones_like(o._value) if go is None else go._value
+        if o._node is None:
+            prev = trivial.get(id(o))
+            trivial[id(o)] = g if prev is None else prev + g
+            continue
+        key = (id(o._node), o._out_idx)
+        if key in seeds:
+            seeds[key] = (o._node, seeds[key][1] + g)
+        else:
+            seeds[key] = (o._node, g)
+
+    sink_map = {id(t): t for t in inputs}
+    collected = _run_backward(seeds, retain_graph, sink_map) if seeds else {}
+    for oid, g in trivial.items():
+        if oid in sink_map:
+            prev = collected.get(oid)
+            collected[oid] = g if prev is None else prev + g
+    results = []
+    for t in inputs:
+        g = collected.get(id(t))
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(t._value)
+        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+    return results

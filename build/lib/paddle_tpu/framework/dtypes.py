@@ -1,0 +1,64 @@
+"""Dtype registry.
+
+Mirrors the reference's ``paddle.dtype`` surface (reference:
+paddle/phi/common/data_type.h, python/paddle/framework/dtype.py) but the
+canonical representation is simply ``jnp.dtype`` — XLA owns layout/packing,
+so no DataType enum is needed.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool, "complex64": complex64, "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str | np/jnp dtype | None) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return np.dtype(_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return np.dtype(_DEFAULT_DTYPE[0])
+
+
+def is_floating_dtype(d):
+    return np.issubdtype(np.dtype(d), np.floating) or np.dtype(d) == np.dtype(bfloat16)
+
+
+def is_integer_dtype(d):
+    return np.issubdtype(np.dtype(d), np.integer)

@@ -1,0 +1,60 @@
+"""Runtime flags registry (reference: paddle/phi/core/flags.cc — the
+gflags-style FLAGS_* system exposed via paddle.set_flags).
+
+TPU-native: a plain dict of knobs, env-overridable (``FLAGS_x=...``), plus
+pass-through of ``XLA_FLAGS`` entries.  No C++ needed — XLA owns the deep
+runtime knobs and we forward to it.
+"""
+import os
+
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,  # accepted for compat; no-op
+    "FLAGS_use_cinn": False,             # XLA is always the compiler
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_allocator_strategy": "xla",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_stop_check_timeout": 300,
+    "FLAGS_benchmark": False,
+    "FLAGS_log_level": "info",
+}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        return str(val).lower() in ("1", "true", "yes", "on") \
+            if not isinstance(val, bool) else val
+    if isinstance(cur, int) and not isinstance(cur, bool):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+def _load_env():
+    for k in list(_FLAGS):
+        if k in os.environ:
+            _FLAGS[k] = _coerce(_FLAGS[k], os.environ[k])
+
+
+_load_env()
+
+
+def set_flags(flags):
+    for k, v in flags.items():
+        cur = _FLAGS.get(k)
+        _FLAGS[k] = _coerce(cur, v) if cur is not None else v
+        if k == "FLAGS_check_nan_inf" and _FLAGS[k]:
+            import jax
+            jax.config.update("jax_debug_nans", True)
+        elif k == "FLAGS_check_nan_inf":
+            import jax
+            jax.config.update("jax_debug_nans", False)
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return dict(_FLAGS)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}

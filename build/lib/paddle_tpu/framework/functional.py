@@ -1,0 +1,65 @@
+"""The dygraph↔pure-function seam.
+
+The reference bridges eager layers to compiled programs with the
+dygraph-to-static AST transpiler (reference:
+python/paddle/jit/dy2static/program_translator.py).  On TPU we don't need
+source transforms: JAX traces Python directly.  What we DO need is a clean
+state-capture boundary — paddle Layers are mutable objects holding Parameter
+tensors, while jit/grad want pure pytree functions.
+
+``functional_call(layer, params, fn)`` temporarily rebinds every parameter's
+raw array to the given pytree leaves, runs ``fn`` with the tape suspended,
+and restores.  All jit/grad/pjit paths (Model.fit's compiled train step,
+to_static, parallel wrappers) go through this one seam.
+"""
+from contextlib import contextmanager
+
+from . import autograd as _ag
+
+__all__ = ["capture_params", "functional_call", "swap_params"]
+
+
+def capture_params(layer, include_buffers=True, trainable_only=False):
+    """Return (names, tensors) for the layer's state in deterministic order."""
+    named = list(layer.named_parameters())
+    if trainable_only:
+        named = [(n, p) for n, p in named if not p.stop_gradient]
+    if include_buffers:
+        named += [(f"__buf__{n}", b) for n, b in layer.named_buffers()]
+    names = [n for n, _ in named]
+    tensors = [t for _, t in named]
+    return names, tensors
+
+
+@contextmanager
+def swap_params(tensors, values):
+    """Rebind each tensor's raw array to the corresponding traced value."""
+    originals = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        with _ag.suspend_tape():
+            yield
+    finally:
+        for t, orig in zip(tensors, originals):
+            t._value = orig
+
+
+def functional_call(layer, fn, params_values, buffers_values=None,
+                    param_tensors=None, buffer_tensors=None):
+    """Run ``fn()`` with layer params (and optionally buffers) rebound.
+
+    ``param_tensors``/``buffer_tensors`` can be precomputed (hot path) to
+    avoid re-walking the module tree every step.
+    """
+    if param_tensors is None:
+        param_tensors = [p for _, p in layer.named_parameters()]
+    if buffer_tensors is None and buffers_values is not None:
+        buffer_tensors = [b for _, b in layer.named_buffers()]
+    tensors = list(param_tensors)
+    values = list(params_values)
+    if buffers_values is not None:
+        tensors += list(buffer_tensors)
+        values += list(buffers_values)
+    with swap_params(tensors, values):
+        return fn()

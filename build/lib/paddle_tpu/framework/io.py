@@ -1,0 +1,73 @@
+"""paddle.save/load-style checkpointing (reference:
+python/paddle/framework/io.py).
+
+Format: pickle of a nested structure where Tensors are materialized as a
+small marker dict with numpy payload — portable, mmap-friendly, no jax
+objects inside the pickle.  Sharding-aware async checkpointing for the
+distributed path lives in paddle_tpu.distributed.checkpoint (orbax-style);
+this module is the single-process core API.
+"""
+import io as _io
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core import Tensor
+
+__all__ = ["save", "load"]
+
+_TENSOR_KEY = "__paddle_tpu_tensor__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {_TENSOR_KEY: True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    if hasattr(obj, "state_dict") and callable(obj.state_dict):
+        return _pack(obj.state_dict())
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_TENSOR_KEY):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(jnp.asarray(obj["data"]),
+                       stop_gradient=obj.get("stop_gradient", True),
+                       name=obj.get("name"))
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(str(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    if hasattr(path, "read"):
+        data = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+    return _unpack(data, return_numpy)

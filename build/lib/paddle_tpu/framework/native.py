@@ -1,0 +1,118 @@
+"""ctypes loader for the native C++ runtime layer (paddle_tpu/csrc/).
+
+The reference's runtime around the compute path is C++ (store, readers,
+tracers: paddle/fluid/distributed/store/tcp_store.cc,
+paddle/fluid/operators/reader/, paddle/fluid/platform/profiler/).  Here
+the library is built lazily with g++ on first use (no pybind11 in the
+image — plain C ABI via ctypes), cached next to the sources, and every
+consumer has a pure-Python fallback so the framework still works where a
+toolchain is absent (``PADDLE_TPU_DISABLE_NATIVE=1`` forces that).
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
+_SOURCES = ("tcp_store.cc", "blocking_queue.cc", "host_tracer.cc")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
+    tmp = _SO + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-pthread",
+           "-o", tmp] + srcs
+    subprocess.run(cmd, check=True, capture_output=True, cwd=_CSRC)
+    os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
+
+
+def _stale():
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    paths = [os.path.join(_CSRC, s) for s in _SOURCES]
+    paths.append(os.path.join(_CSRC, "common.h"))
+    return any(os.path.getmtime(p) > so_mtime for p in paths
+               if os.path.exists(p))
+
+
+def _declare(lib):
+    c = ctypes
+    i64, i32, u8p = c.c_int64, c.c_int, c.POINTER(c.c_uint8)
+    sigs = {
+        "pt_buffer_free": (None, [c.c_void_p]),
+        # store
+        "pt_store_server_start": (i64, [i32]),
+        "pt_store_server_port": (i32, [i64]),
+        "pt_store_server_stop": (None, [i64]),
+        "pt_store_client_connect": (i64, [c.c_char_p, i32, i32]),
+        "pt_store_client_close": (None, [i64]),
+        "pt_store_set": (i32, [i64, c.c_char_p, u8p, i64]),
+        "pt_store_get": (i64, [i64, c.c_char_p, i64, c.POINTER(u8p)]),
+        "pt_store_add": (i64, [i64, c.c_char_p, i64]),
+        "pt_store_wait": (i32, [i64, c.c_char_p, i64]),
+        "pt_store_delete": (i32, [i64, c.c_char_p]),
+        "pt_store_num_keys": (i64, [i64]),
+        # queue
+        "pt_queue_create": (i64, [i32]),
+        "pt_queue_push": (i32, [i64, u8p, i64, i64]),
+        "pt_queue_pop": (i64, [i64, i64, c.POINTER(u8p)]),
+        "pt_queue_size": (i32, [i64]),
+        "pt_queue_close": (None, [i64]),
+        "pt_queue_destroy": (None, [i64]),
+        # tracer
+        "pt_tracer_enable": (None, [i32]),
+        "pt_tracer_enabled": (i32, []),
+        "pt_tracer_span_begin": (i64, [c.c_char_p, c.c_char_p]),
+        "pt_tracer_span_end": (None, [i64]),
+        "pt_tracer_record": (None, [c.c_char_p, c.c_char_p, i64, i64]),
+        "pt_tracer_num_spans": (i64, []),
+        "pt_tracer_clear": (None, []),
+        "pt_tracer_export_chrome": (i64, [c.POINTER(u8p)]),
+        "pt_tracer_dump": (i64, [c.POINTER(u8p)]),
+    }
+    for name, (restype, argtypes) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
+
+
+def get_lib():
+    """Return the loaded native library, building it if needed; None when
+    unavailable or disabled."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE") == "1":
+            return None
+        try:
+            if _stale():
+                _build()
+            _lib = _declare(ctypes.CDLL(_SO))
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+def take_buffer(lib, ptr, length):
+    """Copy a malloc'd native buffer into bytes and free it."""
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.pt_buffer_free(ptr)

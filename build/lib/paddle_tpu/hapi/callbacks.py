@@ -1,0 +1,344 @@
+"""Callbacks (reference: python/paddle/hapi/callbacks.py)."""
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "ReduceLROnPlateau", "VisualDL",
+           "config_callbacks", "CallbackList"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_begin(self, mode, logs=None):
+        getattr(self, f"on_{mode}_begin", lambda l=None: None)(logs)
+
+    def on_end(self, mode, logs=None):
+        getattr(self, f"on_{mode}_end", lambda l=None: None)(logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        getattr(self, f"on_{mode}_batch_begin",
+                lambda s, l=None: None)(step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        getattr(self, f"on_{mode}_batch_end",
+                lambda s, l=None: None)(step, logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = callbacks
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def on_begin(self, mode, logs=None):
+        for c in self.callbacks:
+            c.on_begin(mode, logs)
+
+    def on_end(self, mode, logs=None):
+        for c in self.callbacks:
+            c.on_end(mode, logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        for c in self.callbacks:
+            c.on_epoch_begin(epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for c in self.callbacks:
+            c.on_epoch_end(epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        for c in self.callbacks:
+            c.on_batch_begin(mode, step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        for c in self.callbacks:
+            c.on_batch_end(mode, step, logs)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.epoch = 0
+        self._t0 = None
+
+    def on_train_begin(self, logs=None):
+        self._t_train = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._t0 = time.time()
+        self._seen = 0
+        if self.verbose:
+            epochs = self.params.get("epochs")
+            print(f"Epoch {epoch + 1}/{epochs}")
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in logs.items():
+            if k in ("step", "batch_size"):
+                continue
+            if isinstance(v, (float, np.floating)):
+                parts.append(f"{k}: {v:.4f}")
+            elif isinstance(v, (list, tuple)):
+                parts.append(f"{k}: {[round(float(x), 4) for x in v]}")
+            else:
+                parts.append(f"{k}: {v}")
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._seen += logs.get("batch_size", 1)
+        if self.verbose and ((step + 1) % self.log_freq == 0):
+            steps = self.params.get("steps")
+            dt = time.time() - self._t0
+            ips = self._seen / max(dt, 1e-9)
+            print(f"step {step + 1}/{steps} - {self._fmt(logs)}"
+                  f" - {ips:.0f} samples/sec")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            print(f"Epoch {epoch + 1} done in {dt:.1f}s"
+                  + (f" - {self._fmt(logs)}" if logs else ""))
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            cur = logs.get("eval_" + self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: stop (best {self.monitor}="
+                          f"{self.best:.4f})")
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler per batch or per epoch."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            sch = getattr(self.model._optimizer, "_lr_scheduler", None)
+            if sch is not None:
+                sch.step()
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor) or logs.get("eval_" + self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        better = (self.best is None or
+                  (cur < self.best if self.mode == "min" else
+                   cur > self.best))
+        if better:
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = self.model._optimizer
+                new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                self.wait = 0
+                self.cooldown_counter = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference: python/paddle/hapi/callbacks.py VisualDL,
+    which writes VisualDL event files).  No visualdl package is bundled, so
+    this writes the same scalars as JSON-lines under ``log_dir`` — one file
+    per phase, trivially plottable; if a ``visualdl`` package is importable
+    it is used instead."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._files = {}
+        self._steps = {}
+        try:
+            from visualdl import LogWriter  # pragma: no cover
+            self._writer = LogWriter(log_dir)
+        except ImportError:
+            self._writer = None
+
+    def _log(self, phase, logs):
+        import json
+        import os
+        logs = logs or {}
+        step = self._steps.get(phase, 0)
+        self._steps[phase] = step + 1
+        scalars = {k: float(v) for k, v in logs.items()
+                   if isinstance(v, (int, float)) or (
+                       hasattr(v, "ndim") and getattr(v, "ndim", 1) == 0)}
+        if not scalars:
+            return
+        if self._writer is not None:  # pragma: no cover
+            for k, v in scalars.items():
+                self._writer.add_scalar(f"{phase}/{k}", v, step)
+            return
+        f = self._files.get(phase)
+        if f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            f = open(os.path.join(self.log_dir, f"{phase}.jsonl"), "a")
+            self._files[phase] = f
+        f.write(json.dumps({"step": step, **scalars}) + "\n")
+        f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None,
+                     epochs=None, steps=None, log_freq=2, verbose=2,
+                     save_freq=1, save_dir=None, metrics=None, mode="train"):
+    cbks = list(callbacks) if callbacks else []
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({"batch_size": batch_size, "epochs": epochs,
+                    "steps": steps, "verbose": verbose,
+                    "metrics": metrics or []})
+    return lst

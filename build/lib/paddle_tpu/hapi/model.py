@@ -1,0 +1,645 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py —
+Keras-like fit/evaluate/predict with Dynamic/StaticGraphAdapter).
+
+TPU-native: ONE adapter.  ``prepare`` builds a compiled train step — a pure
+function (params, buffers, opt_state, lr, rng, batch) → (loss, preds,
+params', buffers', opt_state') jitted with donated buffers, so the whole
+step (fwd+bwd+optimizer) is a single XLA executable; the reference needed
+the static-graph adapter + fused optimizer kernels to get this.  Eager
+(per-op) execution is kept as a debug mode (``Model.prepare(jit=False)``).
+"""
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import autograd as _ag
+from ..framework.random import rng_scope, next_key
+from ..framework.io import save as _save, load as _load
+from ..metric import Metric
+from ..optimizer.lr import LRScheduler
+from ..optimizer.optimizer import apply_functional_with_clip
+from ..io import DataLoader, Dataset, DistributedBatchSampler
+from . import callbacks as cbks_mod
+
+__all__ = ["Model"]
+
+
+def _to_jnp(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x))
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class _CompiledStepper:
+    """Builds & caches the jitted train/eval/predict steps.
+
+    With a PlacementPlan (fleet/DataParallel/GroupSharded wrappers attach
+    one), state is device_put to its NamedSharding and the step is jitted
+    with in/out shardings — DP/ZeRO/TP become GSPMD placements of the same
+    executable (see distributed/engine.py).
+    """
+
+    def __init__(self, network, loss_fn, optimizer, amp_level=None,
+                 plan=None):
+        self.network = network
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.amp_level = amp_level
+        self.plan = plan if plan is not None else getattr(
+            network, "_placement_plan", None)
+        self._refresh_state_refs()
+        self._train_cache = {}
+        self._grad_cache = {}
+        self._apply_fn = None
+        self._eval_cache = {}
+        self.opt_state = None
+        self._accum_grads = None
+        self._accum_count = 0
+        if self.plan is not None:
+            self._apply_plan()
+
+    def _apply_plan(self):
+        """device_put every param/buffer onto its planned sharding and
+        precompute the sharding trees the jit calls use."""
+        plan = self.plan
+        self._param_specs = [plan.param_pspec(p) for p in self.params]
+        self._param_shardings = [plan.sharding(s) for s in self._param_specs]
+        for p, s in zip(self.params, self._param_shardings):
+            p._value = jax.device_put(p._value, s)
+        self._buffer_shardings = [plan.replicated() for _ in self.buffers]
+        for b, s in zip(self.buffers, self._buffer_shardings):
+            b._value = jax.device_put(b._value, s)
+
+    def _opt_shardings_for(self, opt_state):
+        t_specs = [self._param_specs[i] for i in self.t_idx]
+        t_shapes = [tuple(self.params[i].shape) for i in self.t_idx]
+        return self.plan.opt_state_shardings(opt_state, t_specs, t_shapes)
+
+    def _refresh_state_refs(self):
+        self.params = [p for _, p in self.network.named_parameters()]
+        self.param_names = [n for n, _ in self.network.named_parameters()]
+        self.buffers = [b for _, b in self.network.named_buffers()]
+        self.t_idx = [i for i, p in enumerate(self.params)
+                      if not p.stop_gradient]
+
+    def _forward_pure(self, param_vals, buffer_vals, key, inputs, training):
+        """Run network on traced values; returns (outs, new_buffer_vals)."""
+        olds = [t._value for t in self.params + self.buffers]
+        for t, v in zip(self.params, param_vals):
+            t._value = v
+        for t, v in zip(self.buffers, buffer_vals):
+            t._value = v
+        mode_layers = []
+        if not training:
+            for l in self.network.sublayers(include_self=True):
+                if l.training:
+                    mode_layers.append(l)
+                    l.training = False
+        try:
+            with _ag.suspend_tape(), rng_scope(key):
+                outs = self.network(*[Tensor(v) for v in inputs])
+            outs_l = _as_list(outs)
+            out_vals = [o._value for o in outs_l]
+            new_buf = [b._value for b in self.buffers]
+            return out_vals, new_buf
+        finally:
+            for t, v in zip(self.params + self.buffers, olds):
+                t._value = v
+            for l in mode_layers:
+                l.training = True
+
+    def _loss_pure(self, out_vals, label_vals):
+        with _ag.suspend_tape():
+            outs = [Tensor(v) for v in out_vals]
+            labels = [Tensor(v) for v in label_vals]
+            if callable(self.loss_fn):
+                loss = self.loss_fn(*(outs + labels)) \
+                    if not hasattr(self.loss_fn, "forward") \
+                    else self.loss_fn(*(outs + labels))
+            else:
+                raise TypeError("loss must be callable")
+        if isinstance(loss, (list, tuple)):
+            total = loss[0]
+            for l in loss[1:]:
+                total = total + l
+            loss = total
+        return loss._value
+
+    def _build_train(self, n_in, n_lab):
+        opt = self.optimizer
+        t_idx = self.t_idx
+        amp = self.amp_level
+        pnames = [self.param_names[i] for i in t_idx]
+
+        def step(train_vals, frozen_vals, buffer_vals, opt_state, lr, key,
+                 inputs, labels):
+            def loss_f(tv):
+                full = list(frozen_vals)
+                # merge trainable into full param list
+                pv = []
+                ti = iter(range(len(tv)))
+                tv_map = dict(zip(t_idx, tv))
+                fi = iter(frozen_vals)
+                for i in range(len(self.params)):
+                    if i in tv_map:
+                        v = tv_map[i]
+                        if amp in ("O1", "O2") and \
+                                jnp.issubdtype(v.dtype, jnp.floating):
+                            v = v.astype(jnp.bfloat16)
+                        pv.append(v)
+                    else:
+                        pv.append(next(fi))
+                ins = inputs
+                if amp in ("O1", "O2"):
+                    ins = [v.astype(jnp.bfloat16)
+                           if jnp.issubdtype(v.dtype, jnp.floating) else v
+                           for v in inputs]
+                out_vals, new_buf = self._forward_pure(
+                    pv, buffer_vals, key, ins, training=True)
+                if amp in ("O1", "O2"):
+                    out_vals = [v.astype(jnp.float32)
+                                if jnp.issubdtype(v.dtype, jnp.bfloat16)
+                                else v for v in out_vals]
+                loss = self._loss_pure(out_vals, labels)
+                return loss, (out_vals, new_buf)
+
+            (loss, (out_vals, new_buf)), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(train_vals)
+            new_train, new_opt = apply_functional_with_clip(
+                opt, train_vals, grads, opt_state, lr, param_names=pnames)
+            return loss, out_vals, new_train, new_buf, new_opt
+
+        if self.plan is None:
+            return jax.jit(step, donate_argnums=(0, 2, 3))
+        plan = self.plan
+        t_sh = [self._param_shardings[i] for i in self.t_idx]
+        f_sh = [self._param_shardings[i] for i in range(len(self.params))
+                if i not in set(self.t_idx)]
+        b_sh = list(self._buffer_shardings)
+        o_sh = self._opt_shardings_for(self.opt_state)
+        rep = plan.replicated()
+        return jax.jit(
+            step, donate_argnums=(0, 2, 3),
+            in_shardings=(t_sh, f_sh, b_sh, o_sh, rep, rep,
+                          self._input_shardings, self._label_shardings),
+            out_shardings=(rep, None, t_sh, b_sh, o_sh))
+
+    def _build_grad(self):
+        """Gradient-only step (no optimizer apply) for accumulation."""
+        amp = self.amp_level
+        t_idx = self.t_idx
+
+        def gstep(train_vals, frozen_vals, buffer_vals, key, inputs,
+                  labels):
+            def loss_f(tv):
+                tv_map = dict(zip(t_idx, tv))
+                fi = iter(frozen_vals)
+                pv = []
+                for i in range(len(self.params)):
+                    if i in tv_map:
+                        v = tv_map[i]
+                        if amp in ("O1", "O2") and \
+                                jnp.issubdtype(v.dtype, jnp.floating):
+                            v = v.astype(jnp.bfloat16)
+                        pv.append(v)
+                    else:
+                        pv.append(next(fi))
+                out_vals, new_buf = self._forward_pure(
+                    pv, buffer_vals, key, inputs, training=True)
+                loss = self._loss_pure(out_vals, labels)
+                return loss, (out_vals, new_buf)
+            (loss, (out_vals, new_buf)), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(train_vals)
+            return loss, out_vals, new_buf, grads
+        return jax.jit(gstep)
+
+    def _build_apply(self):
+        opt = self.optimizer
+        pnames = [self.param_names[i] for i in self.t_idx]
+
+        def astep(train_vals, grads, opt_state, lr):
+            return apply_functional_with_clip(
+                opt, train_vals, grads, opt_state, lr, param_names=pnames)
+        return jax.jit(astep, donate_argnums=(0, 2))
+
+    def _build_eval(self, n_in):
+        def step(param_vals, buffer_vals, key, inputs):
+            out_vals, _ = self._forward_pure(param_vals, buffer_vals, key,
+                                             inputs, training=False)
+            return out_vals
+        if self.plan is None:
+            return jax.jit(step)
+        rep = self.plan.replicated()
+        return jax.jit(step, in_shardings=(
+            list(self._param_shardings), list(self._buffer_shardings), rep,
+            self._input_shardings))
+
+    def _shape_key(self, arrays):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+    def train_step(self, inputs, labels, update=True):
+        inputs = [_to_jnp(x) for x in _as_list(inputs)]
+        labels = [_to_jnp(x) for x in _as_list(labels)]
+        if self.plan is not None:
+            self._input_shardings = [self.plan.input_sharding(a.ndim)
+                                     for a in inputs]
+            self._label_shardings = [self.plan.input_sharding(a.ndim)
+                                     for a in labels]
+        key = (self._shape_key(inputs), self._shape_key(labels))
+        train_vals = [self.params[i]._value for i in self.t_idx]
+        frozen_vals = [p._value for i, p in enumerate(self.params)
+                       if i not in set(self.t_idx)]
+        buffer_vals = [b._value for b in self.buffers]
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init_functional_state(train_vals)
+            if self.plan is not None:
+                o_sh = self._opt_shardings_for(self.opt_state)
+                self.opt_state = [
+                    {k: jax.device_put(v, s[k]) for k, v in st.items()}
+                    for st, s in zip(self.opt_state, o_sh)]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        rng = next_key()
+
+        accumulating = (not update) or self._accum_count > 0
+        if not accumulating:
+            # fused fast path: fwd+bwd+update in one executable
+            if key not in self._train_cache:
+                self._train_cache[key] = self._build_train(len(inputs),
+                                                           len(labels))
+            loss, out_vals, new_train, new_buf, new_opt = \
+                self._train_cache[key](train_vals, frozen_vals, buffer_vals,
+                                       self.opt_state, lr, rng, inputs,
+                                       labels)
+            for i, v in zip(self.t_idx, new_train):
+                self.params[i]._value = v
+            for b, v in zip(self.buffers, new_buf):
+                b._value = v
+            self.opt_state = new_opt
+            self.optimizer._global_step += 1
+            return loss, out_vals
+
+        # accumulation path: grads only, apply on the update step
+        if key not in self._grad_cache:
+            self._grad_cache[key] = self._build_grad()
+        loss, out_vals, new_buf, grads = self._grad_cache[key](
+            train_vals, frozen_vals, buffer_vals, rng, inputs, labels)
+        for b, v in zip(self.buffers, new_buf):
+            b._value = v
+        if self._accum_grads is None:
+            self._accum_grads = list(grads)
+        else:
+            self._accum_grads = [a + g for a, g in
+                                 zip(self._accum_grads, grads)]
+        self._accum_count += 1
+        if update:
+            k = self._accum_count
+            mean_grads = [g / k for g in self._accum_grads]
+            if self._apply_fn is None:
+                self._apply_fn = self._build_apply()
+            new_train, new_opt = self._apply_fn(train_vals, mean_grads,
+                                                self.opt_state, lr)
+            for i, v in zip(self.t_idx, new_train):
+                self.params[i]._value = v
+            self.opt_state = new_opt
+            self.optimizer._global_step += 1
+            self._accum_grads = None
+            self._accum_count = 0
+        return loss, out_vals
+
+    def eval_forward(self, inputs):
+        inputs = [_to_jnp(x) for x in _as_list(inputs)]
+        if self.plan is not None:
+            self._input_shardings = [self.plan.input_sharding(a.ndim)
+                                     for a in inputs]
+        key = self._shape_key(inputs)
+        if key not in self._eval_cache:
+            self._eval_cache[key] = self._build_eval(len(inputs))
+        fn = self._eval_cache[key]
+        param_vals = [p._value for p in self.params]
+        buffer_vals = [b._value for b in self.buffers]
+        return fn(param_vals, buffer_vals, next_key(), inputs)
+
+    def sync_opt_state_to_optimizer(self):
+        if self.opt_state is not None:
+            trainable = [self.params[i] for i in self.t_idx]
+            self.optimizer.restore_functional_state(trainable,
+                                                    self.opt_state)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._stepper = None
+        self._jit = True
+        self.stop_training = False
+
+    # -- prepare ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), f"{m} is not a Metric"
+        self._jit = jit
+        amp_level = None
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                amp_level = amp_configs.get("level", "O1")
+        if jit:
+            self._stepper = _CompiledStepper(self.network, loss, optimizer,
+                                             amp_level)
+        if optimizer is not None and optimizer._parameter_list is None:
+            optimizer._parameter_list = self.network.parameters()
+
+    # -- single-batch ops ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._jit and self._stepper is not None:
+            loss, out_vals = self._stepper.train_step(inputs, labels,
+                                                      update=update)
+            metrics = self._update_metrics(
+                [Tensor(v) for v in out_vals], _as_list(labels))
+            if isinstance(self._optimizer._learning_rate, LRScheduler) and \
+                    update:
+                self._optimizer._learning_rate.step()
+            return self._pack_loss_metrics(float(loss), metrics)
+        # eager path
+        ins = [x if isinstance(x, Tensor) else Tensor(_to_jnp(x))
+               for x in _as_list(inputs)]
+        labs = [x if isinstance(x, Tensor) else Tensor(_to_jnp(x))
+                for x in _as_list(labels)]
+        outs = _as_list(self.network(*ins))
+        loss = self._loss(*(outs + labs))
+        if isinstance(loss, (list, tuple)):
+            total = loss[0]
+            for l in loss[1:]:
+                total = total + l
+            loss = total
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            if isinstance(self._optimizer._learning_rate, LRScheduler):
+                self._optimizer._learning_rate.step()
+        metrics = self._update_metrics(outs, labs)
+        return self._pack_loss_metrics(float(loss.item()), metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        with _ag.no_grad():
+            if self._jit and self._stepper is not None:
+                out_vals = self._stepper.eval_forward(inputs)
+                outs = [Tensor(v) for v in out_vals]
+            else:
+                ins = [x if isinstance(x, Tensor) else Tensor(_to_jnp(x))
+                       for x in _as_list(inputs)]
+                outs = _as_list(self.network(*ins))
+            labs = [x if isinstance(x, Tensor) else Tensor(_to_jnp(x))
+                    for x in _as_list(labels)]
+            loss = None
+            if self._loss is not None and labs:
+                loss_t = self._loss(*(outs + labs))
+                if isinstance(loss_t, (list, tuple)):
+                    total = loss_t[0]
+                    for l in loss_t[1:]:
+                        total = total + l
+                    loss_t = total
+                loss = float(loss_t.item())
+            metrics = self._update_metrics(outs, labs)
+        return self._pack_loss_metrics(loss, metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with _ag.no_grad():
+            if self._jit and self._stepper is not None:
+                out_vals = self._stepper.eval_forward(inputs)
+                return [np.asarray(v) for v in out_vals]
+            ins = [x if isinstance(x, Tensor) else Tensor(_to_jnp(x))
+                   for x in _as_list(inputs)]
+            outs = _as_list(self.network(*ins))
+            return [o.numpy() for o in outs]
+
+    def _update_metrics(self, outs, labs):
+        res = {}
+        for m in self._metrics:
+            computed = m.compute(*(outs + labs))
+            r = m.update(*_as_list(computed))
+            names = m.name()
+            if isinstance(names, list):
+                for n, v in zip(names, _as_list(r)):
+                    res[n] = v
+            else:
+                res[names] = r
+        return res
+
+    @staticmethod
+    def _pack_loss_metrics(loss, metrics):
+        if metrics:
+            return [loss], list(metrics.values())
+        return [loss]
+
+    # -- fit / evaluate / predict -------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose,
+            metrics=["loss"] + self._metric_names())
+        cbks.on_begin("train")
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            self._reset_metrics()
+            self.network.train()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = self._split_batch(batch)
+                do_update = (step + 1) % max(accumulate_grad_batches,
+                                             1) == 0
+                res = self.train_batch(ins, labs, update=do_update)
+                logs = self._make_logs(res)
+                logs["step"] = step
+                logs["batch_size"] = (
+                    ins[0].shape[0] if ins and hasattr(ins[0], "shape")
+                    else batch_size)
+                cbks.on_batch_end("train", step, logs)
+                if self.stop_training:
+                    break
+            if eval_loader is not None and \
+                    ((epoch + 1) % eval_freq == 0 or epoch == epochs - 1):
+                eval_logs = self._run_eval(eval_loader, cbks)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=["loss"] + self._metric_names())
+        cbks.on_begin("eval")
+        logs = self._run_eval(loader, cbks, num_iters=num_iters)
+        cbks.on_end("eval", logs)
+        return logs
+
+    def _run_eval(self, loader, cbks, num_iters=None):
+        self._reset_metrics()
+        self.network.eval()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_batch_begin("eval", step, logs)
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = self._make_logs(res)
+            if isinstance(res, tuple) and res[0][0] is not None:
+                losses.append(res[0][0])
+            elif isinstance(res, list) and res[0] is not None:
+                losses.append(res[0])
+            cbks.on_batch_end("eval", step, logs)
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        grouped = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            return [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # -- helpers ------------------------------------------------------------
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _reset_metrics(self):
+        for m in self._metrics:
+            m.reset()
+
+    def _make_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            if losses and losses[0] is not None:
+                logs["loss"] = losses[0]
+            for n, v in zip(self._metric_names(), metrics):
+                logs[n] = v
+        else:
+            if res and res[0] is not None:
+                logs["loss"] = res[0]
+        return logs
+
+    def _split_batch(self, batch, has_labels=True):
+        n_in = len(self._inputs) if self._inputs else 1
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if not has_labels:
+                return batch[:n_in], []
+            if self._loss is None:
+                return batch, []
+            if len(batch) > n_in:
+                return batch[:n_in], batch[n_in:]
+            return batch, []
+        return [batch], []
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        if training:
+            self._sync_opt()
+            _save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                _save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit as _jit
+            specs = self._inputs
+            _jit.save(self.network, path, input_spec=specs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = _load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+            if self._stepper is not None:
+                self._stepper.opt_state = None  # rebuilt from optimizer
+        if self._stepper is not None:
+            self._stepper._refresh_state_refs()
+            self._stepper._train_cache.clear()
+            self._stepper._eval_cache.clear()
+
+    def _sync_opt(self):
+        if self._stepper is not None:
+            self._stepper.sync_opt_state_to_optimizer()
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return summary(self.network, input_size, dtypes=dtype)

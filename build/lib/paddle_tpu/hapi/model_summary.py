@@ -1,0 +1,65 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import autograd as _ag
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    hooks = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            n_params = sum(int(np.prod(p.shape))
+                           for p in l._parameters.values()
+                           if p is not None)
+            rows.append((name or type(l).__name__,
+                         tuple(out.shape) if hasattr(out, "shape") else "?",
+                         n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, l in net.named_sublayers(include_self=False):
+        if not l._sub_layers:  # leaves only
+            register(l, f"{type(l).__name__}[{name}]")
+
+    if input is not None:
+        ins = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        ins = []
+        for i, s in enumerate(sizes):
+            shape = tuple(2 if d is None or d == -1 else d for d in s)
+            dt = (dtypes[i] if isinstance(dtypes, (list, tuple))
+                  else dtypes) or "float32"
+            ins.append(Tensor(np.zeros(shape, dtype=dt)))
+    was_training = net.training
+    net.eval()
+    try:
+        with _ag.no_grad():
+            net(*ins)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    w = 72
+    print("-" * w)
+    print(f"{'Layer (type)':<36}{'Output Shape':<22}{'Param #':<12}")
+    print("=" * w)
+    for name, shape, n in rows:
+        print(f"{name:<36}{str(shape):<22}{n:<12,}")
+    print("=" * w)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * w)
+    return {"total_params": total, "trainable_params": trainable}

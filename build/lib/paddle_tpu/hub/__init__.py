@@ -1,0 +1,42 @@
+"""paddle.hub compat (reference: python/paddle/hapi/hub.py).
+
+No network in scope: only ``source='local'`` entrypoints are supported.
+"""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):
+    if source != "local":
+        raise ValueError("only source='local' is supported (no network)")
+    mod = _load_hubconf(repo_dir)
+    return [k for k in dir(mod) if callable(getattr(mod, k))
+            and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    if source != "local":
+        raise ValueError("only source='local' is supported (no network)")
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(**kwargs)

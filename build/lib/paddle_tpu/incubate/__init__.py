@@ -1,0 +1,48 @@
+"""Incubating APIs (reference: python/paddle/incubate/)."""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+from .nn.functional import flash_attention  # noqa: F401
+
+
+class autograd:
+    """paddle.incubate.autograd compat (reference:
+    python/paddle/incubate/autograd/) — functional transforms over the
+    framework's Tensor facade, delegating to paddle_tpu.autograd."""
+
+    @staticmethod
+    def jvp(func, xs, v=None):
+        from ..autograd import jvp as _jvp
+        return _jvp(func, xs, v)
+
+    @staticmethod
+    def vjp(func, xs, v=None):
+        from ..autograd import vjp as _vjp
+        return _vjp(func, xs, v)
+
+    @staticmethod
+    def Jacobian(func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not supported; vmap the per-sample "
+                "jacobian instead (jax.vmap(jax.jacrev(f)))")
+        from ..autograd import jacobian as _jac
+        return _jac(func, xs)
+
+    @staticmethod
+    def jacobian(func, xs, create_graph=False, allow_unused=False):
+        from ..autograd import jacobian as _jac
+        return _jac(func, xs, create_graph, allow_unused)
+
+    @staticmethod
+    def Hessian(func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not supported; vmap the per-sample "
+                "hessian instead (jax.vmap(jax.hessian(f)))")
+        from ..autograd import hessian as _hes
+        return _hes(func, xs)
+
+    @staticmethod
+    def hessian(func, xs, create_graph=False, allow_unused=False):
+        from ..autograd import hessian as _hes
+        return _hes(func, xs, create_graph, allow_unused)

@@ -1,0 +1,207 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+— NaiveGate, GShardGate (top-2 + load-balance aux loss), SwitchGate
+(top-1 + aux loss), each a small Layer owning the router weight).
+
+TPU-native: gates return dense routing tensors (combine weights + dispatch
+mask) built with one-hot matmuls and cumsum position assignment — the
+GShard dense-dispatch formulation that XLA tiles onto the MXU — instead of
+the reference's index-based scatter (prims that would force dynamic shapes
+under jit).
+"""
+import jax
+import jax.numpy as jnp
+
+from ......framework.core import Tensor
+from ...... import nn
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def _top_k_sparse_routing(logits, top_k, capacity):
+    """Sparse (capacity-bucketed) GShard routing on raw jnp arrays.
+
+    logits: (T, E) fp32. Returns ``(eidx, pos, weight, keep, aux)`` with
+    eidx/pos int32 (T, K) — the chosen expert and its capacity slot for
+    each of a token's K choices — weight fp32 (T, K) the renormalized
+    combine weight (already zeroed for dropped assignments), and keep
+    bool (T, K).  Position-in-expert is assigned by cumsum in token
+    order; tokens beyond capacity are dropped.  This is the O(T*K)
+    routing record that the scatter/gather dispatch consumes; the dense
+    (T, E, C) tensors of :func:`_top_k_routing` are derived from it.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # aux load-balance loss uses the FIRST choice only (GShard eq. (4)):
+    # l_aux = E * mean(me * ce), me = mean gate prob, ce = fraction routed
+    top1 = jnp.argmax(gates, axis=-1)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    remaining = gates
+    # per-expert fill count carried across the k choices so 2nd choices
+    # take positions after 1st choices
+    fill = jnp.zeros((E,), jnp.int32)
+    denom = jnp.zeros((T,), jnp.float32)
+    eidxs, poss, keeps, probs = [], [], [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)            # (T,)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # (T, E)
+        pos_te = jnp.cumsum(mask, axis=0) - 1 + fill[None, :]  # (T, E)
+        pos = jnp.sum(pos_te * mask, axis=-1)           # (T,)
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        prob = jnp.sum(gates * mask, axis=-1)           # (T,)
+        eidxs.append(idx.astype(jnp.int32))
+        poss.append(pos.astype(jnp.int32))
+        keeps.append(keep)
+        probs.append(prob)
+        denom = denom + prob * keep
+        fill = fill + jnp.sum(mask * keep[:, None].astype(jnp.int32),
+                              axis=0)
+        remaining = remaining * (1 - mask)
+    denom = jnp.maximum(denom, 1e-9)
+    eidx = jnp.stack(eidxs, axis=1)
+    pos = jnp.stack(poss, axis=1)
+    keep = jnp.stack(keeps, axis=1)
+    weight = jnp.stack(probs, axis=1) / denom[:, None] \
+        * keep.astype(jnp.float32)
+    return eidx, pos, weight, keep, aux
+
+
+def _densify_routing(eidx, pos, weight, capacity, num_expert):
+    """Sparse routing record -> dense (combine (T,E,C), dispatch bool)."""
+    oh_e = jax.nn.one_hot(eidx, num_expert, dtype=jnp.float32)  # (T,K,E)
+    oh_c = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)     # (T,K,C)
+    combine = jnp.einsum("tke,tkc,tk->tec", oh_e, oh_c, weight)
+    return combine, combine > 0
+
+
+def _top_k_routing(logits, top_k, capacity, jitter_key=None):
+    """Dense GShard routing on raw jnp arrays.
+
+    logits: (T, E) fp32. Returns (combine (T,E,C), dispatch bool (T,E,C),
+    aux_loss scalar).  Derived from the sparse routing record so the
+    dense-einsum and scatter/gather dispatch paths agree bit-for-bit on
+    the routing decision.
+    """
+    E = logits.shape[1]
+    eidx, pos, weight, _, aux = _top_k_sparse_routing(
+        logits, top_k, capacity)
+    combine, dispatch = _densify_routing(eidx, pos, weight, capacity, E)
+    return combine, dispatch, aux
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity_factor=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert            # experts per EP rank
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor or float(top_k)
+        self.weight = self.create_parameter(
+            shape=[d_model, self.tot_expert], is_bias=False)
+        self.loss = None  # aux loss of the last forward (reference: get_loss)
+
+    def capacity(self, num_tokens):
+        cap = int(self.capacity_factor * num_tokens / self.tot_expert)
+        return max(cap, 4)
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def route(self, logits, num_tokens):
+        """raw (T, E) logits -> (combine, dispatch, aux).  THE policy
+        seam: subclasses override this; MoELayer calls it inside its
+        traced forward."""
+        return _top_k_routing(logits, self.top_k,
+                              self.capacity(num_tokens))
+
+    def route_sparse(self, logits, num_tokens):
+        """raw (T, E) logits -> (eidx, pos, weight, keep, aux, capacity)
+        — the O(T*K) routing record consumed by MoELayer's scatter/gather
+        dispatch (reference global_scatter/global_gather semantics).
+        Subclasses with a custom dense ``route`` policy need not override
+        this; MoELayer falls back to the dense path for them."""
+        cap = self.capacity(num_tokens)
+        eidx, pos, weight, keep, aux = _top_k_sparse_routing(
+            logits, self.top_k, cap)
+        return eidx, pos, weight, keep, aux, cap
+
+    def routing(self, x_value):
+        """Standalone raw (T, M) -> routing (eager use)."""
+        return self.route(x_value @ self.weight._value, x_value.shape[0])
+
+    def forward(self, x):
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """top-k routing, no auxiliary loss recorded."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert, world_size, top_k=topk)
+
+    def route(self, logits, num_tokens):
+        c, d, _ = super().route(logits, num_tokens)
+        return c, d, jnp.zeros((), jnp.float32)
+
+    def route_sparse(self, logits, num_tokens):
+        eidx, pos, weight, keep, _, cap = super().route_sparse(
+            logits, num_tokens)
+        return eidx, pos, weight, keep, jnp.zeros((), jnp.float32), cap
+
+
+class GShardGate(BaseGate):
+    """top-2 with load-balance aux loss and capacity (GShard §3.2)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None, gate_bias=True):
+        cap = capacity[0] * topk if isinstance(capacity, (tuple, list)) \
+            else capacity
+        super().__init__(d_model, num_expert, world_size, top_k=topk,
+                         capacity_factor=cap)
+
+
+class SwitchGate(BaseGate):
+    """top-1 Switch-Transformer routing with aux loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=1,
+                         capacity_factor=capacity[0]
+                         if isinstance(capacity, (tuple, list))
+                         else capacity)
+        self.switch_eps = switch_eps
+
+    def _jitter(self, logits):
+        # Switch jitters logits multiplicatively during training for
+        # exploration (reference: switch_gate.py uniform(1-eps, 1+eps));
+        # folded in via the framework RNG so routing stays reproducible
+        if self.training and self.switch_eps:
+            import jax as _jax
+            from ......framework.random import next_key, in_rng_scope
+            if in_rng_scope():
+                key = next_key()
+                noise = _jax.random.uniform(
+                    key, logits.shape, jnp.float32,
+                    1.0 - self.switch_eps, 1.0 + self.switch_eps)
+                logits = logits * noise
+        return logits
+
+    def route(self, logits, num_tokens):
+        return _top_k_routing(self._jitter(logits), 1,
+                              self.capacity(num_tokens))
+
+    def route_sparse(self, logits, num_tokens):
+        cap = self.capacity(num_tokens)
+        eidx, pos, weight, keep, aux = _top_k_sparse_routing(
+            self._jitter(logits), 1, cap)
+        return eidx, pos, weight, keep, aux, cap

@@ -1,0 +1,23 @@
+"""MoE-aware global-norm grad clip (reference:
+python/paddle/incubate/distributed/models/moe/grad_clip.py —
+ClipGradForMOEByGlobalNorm sums expert-param norms across the MoE group so
+each expert's grad counts once globally).
+
+TPU-native: parameters (incl. expert-stacked ones) are logically GLOBAL
+arrays under GSPMD — the compiled global-norm reduction over a sharded
+(E, ...) weight already produces the cross-rank sum the reference builds by
+hand, so this subclass only tags the moe params for bookkeeping."""
+from .....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+
+ClipGradForMoEByGlobalNorm = ClipGradForMOEByGlobalNorm  # alias

@@ -1,0 +1,233 @@
+"""Mixture-of-Experts layer (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py — MoELayer over
+global_scatter/global_gather all-to-all dispatch CUDA ops,
+paddle/fluid/operators/collective/global_scatter_op.cu).
+
+TPU-native design: two dispatch modes, both static-shaped and
+differentiable by construction.  The default *sparse* mode is
+capacity-bucketed scatter/gather — each of a token's K choices lands in
+its (expert, slot) row of the (E*C, M) dispatch buffer via one
+scatter-add (O(T*K*M) work, the reference's global_scatter semantics)
+and combines back with one gather — so dispatch cost no longer scales
+with the expert count.  The *dense* mode keeps the GShard one-hot-einsum
+formulation (O(T*E*C*M), MXU-friendly) as the small-E fallback and for
+custom gates that only define a dense routing policy.  Expert
+parallelism is a *sharding* in either mode: expert-stacked weights
+(E, ...) and the dispatched activations (E, C, M) carry a PartitionSpec
+on the expert mesh axis, and XLA's partitioner inserts the all-to-all
+wire pattern of the reference's global_scatter/global_gather.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor
+from .....framework.autograd import call_op
+from .....framework.functional import swap_params
+from ..... import nn
+from .....nn import functional as F
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertLayer"]
+
+
+def _constraint(value, spec):
+    try:
+        from jax.sharding import PartitionSpec as P
+        return jax.lax.with_sharding_constraint(value, P(*spec))
+    except Exception:
+        return value
+
+
+class ExpertLayer(nn.Layer):
+    """Default FFN expert (d_model -> d_hidden -> d_model).  MoELayer
+    stacks the weights of a homogeneous ExpertLayer list into (E, ...)
+    arrays for the vmapped expert-parallel fast path."""
+
+    def __init__(self, d_model, d_hidden, act="gelu"):
+        super().__init__()
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.act = act
+        self.w1 = self.create_parameter([d_model, d_hidden])
+        self.b1 = self.create_parameter([d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([d_hidden, d_model])
+        self.b2 = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, x):
+        h = F.linear(x, self.w1, self.b1)
+        h = F.gelu(h) if self.act == "gelu" else F.relu(h)
+        return F.linear(h, self.w2, self.b2)
+
+
+def _make_gate(gate, d_model, num_expert):
+    if isinstance(gate, BaseGate):
+        return gate
+    cfg = dict(gate) if isinstance(gate, dict) else {}
+    typ = cfg.pop("type", gate if isinstance(gate, str) else "gshard")
+    top_k = cfg.pop("top_k", 2)
+    if typ in ("gshard", None):
+        return GShardGate(d_model, num_expert, topk=top_k)
+    if typ == "switch":
+        return SwitchGate(d_model, num_expert)
+    if typ == "naive":
+        return NaiveGate(d_model, num_expert, topk=top_k)
+    raise ValueError(f"unknown gate type {typ!r}")
+
+
+class MoELayer(nn.Layer):
+    """paddle.incubate.distributed.models.moe.MoELayer parity.
+
+    moe_group/mp_group keep the reference signature; the expert axis
+    defaults to the "model" mesh axis (EP rides mp's ICI ring unless the
+    caller names another axis via ``expert_axis``).
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, recompute_ctx=None,
+                 expert_axis="model", dispatch_mode="auto"):
+        super().__init__()
+        if dispatch_mode not in ("auto", "dense", "sparse"):
+            raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
+        self.d_model = d_model
+        self.num_expert = len(experts)
+        self.expert_axis = expert_axis
+        self.dispatch_mode = dispatch_mode
+        self.gate = _make_gate(gate, d_model, self.num_expert)
+        # exact-type check: an ExpertLayer SUBCLASS may override forward,
+        # which the stacked einsum fast path would silently ignore
+        self._stacked = all(type(e) is ExpertLayer for e in experts) \
+            and len({(e.d_model, e.d_hidden, e.act) for e in experts}) == 1
+        if self._stacked:
+            self._act = experts[0].act
+            # stack per-expert weights into (E, ...) params sharded on the
+            # expert axis — this is what makes EP a pure GSPMD sharding
+            for nm, axes in (("w1", 3), ("b1", 2), ("w2", 3), ("b2", 2)):
+                stacked = jnp.stack(
+                    [getattr(e, nm)._value for e in experts])
+                p = Tensor(stacked, stop_gradient=False)
+                p.is_parameter = True
+                p.persistable = True
+                p.pspec = (expert_axis,) + (None,) * (axes - 1)
+                p.is_distributed = True
+                setattr(self, f"expert_{nm}", p)
+            self._experts_list = list(experts)  # plain list: not re-registered
+        else:
+            self.experts = nn.LayerList(experts)
+
+    def _use_sparse(self):
+        """Sparse dispatch needs the gate's route_sparse to reflect its
+        routing policy: a subclass that overrides ``route`` (a custom
+        dense policy) without also overriding ``route_sparse`` must take
+        the dense path."""
+        if self.dispatch_mode == "dense":
+            return False
+        if not self._stacked:
+            if self.dispatch_mode == "sparse":
+                raise ValueError(
+                    "dispatch_mode='sparse' needs homogeneous ExpertLayer "
+                    "experts (the stacked fast path); heterogeneous or "
+                    "subclassed experts run the dense generic path")
+            return False
+        cls = type(self.gate)
+        mro = cls.__mro__
+        route_owner = next(i for i, c in enumerate(mro)
+                           if "route" in c.__dict__)
+        sparse_owner = next((i for i, c in enumerate(mro)
+                             if "route_sparse" in c.__dict__), None)
+        supported = sparse_owner is not None and sparse_owner <= route_owner
+        if self.dispatch_mode == "sparse":
+            if not supported:
+                raise ValueError(
+                    f"gate {cls.__name__} overrides route() without a "
+                    "matching route_sparse(); use dispatch_mode='dense'")
+            return True
+        # auto: dense einsum only wins at tiny expert counts
+        return supported and self.num_expert > 4
+
+    def _expert_ffn(self, ein, w1, b1, w2, b2):
+        """(E, C, M) dispatched tokens -> (E, C, M) expert outputs."""
+        h = jnp.einsum("ecm,emh->ech", ein, w1) + b1[:, None, :]
+        h = jax.nn.gelu(h, approximate=False) if self._act == "gelu" \
+            else jax.nn.relu(h)
+        return jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+
+    # -- dense dispatch core (raw jnp) --------------------------------------
+    def _moe_fn_stacked(self, xv, gw, w1, b1, w2, b2):
+        T, M = xv.shape[0], xv.shape[1]
+        logits = xv @ gw
+        combine, dispatch, aux = self.gate.route(logits, T)
+        # (T,E,C) x (T,M) -> (E,C,M), sharded on the expert axis so the
+        # partitioner emits the global_scatter all-to-all
+        ein = jnp.einsum("tec,tm->ecm", dispatch.astype(xv.dtype), xv)
+        ein = _constraint(ein, (self.expert_axis, None, None))
+        eo = self._expert_ffn(ein, w1, b1, w2, b2)
+        eo = _constraint(eo, (self.expert_axis, None, None))
+        # combine (global_gather): (T,E,C) x (E,C,M) -> (T,M)
+        out = jnp.einsum("tec,ecm->tm", combine.astype(xv.dtype), eo)
+        return out, aux
+
+    # -- sparse (scatter/gather) dispatch core ------------------------------
+    def _moe_fn_stacked_sparse(self, xv, gw, w1, b1, w2, b2):
+        """Capacity-bucketed scatter/gather dispatch: O(T*K*M) instead of
+        the dense einsum's O(T*E*C*M) (reference global_scatter /
+        global_gather semantics, global_scatter_op.cu)."""
+        T, M = xv.shape[0], xv.shape[1]
+        E = self.num_expert
+        logits = xv @ gw
+        eidx, pos, weight, keep, aux, C = self.gate.route_sparse(logits, T)
+        K = eidx.shape[1]
+        flat = (eidx * C + pos).reshape(-1)              # (T*K,) slot ids
+        # global_scatter: each kept (token, choice) row lands in its
+        # (expert, slot) row.  Slots are unique per expert by cumsum
+        # construction, so the scatter-add never sums two nonzero rows;
+        # dropped assignments contribute an all-zero update.
+        upd = (xv[:, None, :] * keep[..., None].astype(xv.dtype)
+               ).reshape(T * K, M)
+        buf = jnp.zeros((E * C, M), xv.dtype).at[flat].add(upd)
+        ein = _constraint(buf.reshape(E, C, M),
+                          (self.expert_axis, None, None))
+        eo = self._expert_ffn(ein, w1, b1, w2, b2)
+        eo = _constraint(eo, (self.expert_axis, None, None))
+        # global_gather: pull each assignment's expert-output row back
+        # and reduce over the K choices with the renormalized weights
+        # (already zero for dropped assignments)
+        rows = eo.reshape(E * C, M)[flat].reshape(T, K, M)
+        out = jnp.einsum("tkm,tk->tm", rows, weight.astype(xv.dtype))
+        return out, aux
+
+    def _moe_fn_generic(self, xv, param_tensors, param_vals):
+        with swap_params(param_tensors, param_vals):
+            T = xv.shape[0]
+            logits = xv @ self.gate.weight._value
+            combine, dispatch, aux = self.gate.route(logits, T)
+            ein = jnp.einsum("tec,tm->ecm", dispatch.astype(xv.dtype), xv)
+            outs = []
+            for e in range(self.num_expert):
+                r = self.experts[e](Tensor(ein[e]))
+                outs.append(r._value if isinstance(r, Tensor) else r)
+            eo = jnp.stack(outs)
+            out = jnp.einsum("tec,ecm->tm", combine.astype(xv.dtype), eo)
+            return out, aux
+
+    def forward(self, x):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        shape = x.shape
+        flat = call_op(lambda v: v.reshape(-1, shape[-1]), x)
+        if self._stacked:
+            fn = self._moe_fn_stacked_sparse if self._use_sparse() \
+                else self._moe_fn_stacked
+            out, aux = call_op(
+                fn, flat, self.gate.weight,
+                self.expert_w1, self.expert_b1, self.expert_w2,
+                self.expert_b2)
+        else:
+            tensors = [p for _, p in self.named_parameters()]
+            out, aux = call_op(
+                lambda xv, *vals: self._moe_fn_generic(
+                    xv, tensors, list(vals)),
+                flat, *tensors)
+        # plain attr set: must NOT register the aux-loss Tensor as a
+        # parameter of the gate (Layer.__setattr__ would)
+        object.__setattr__(self.gate, "loss", aux)
+        return call_op(lambda v: v.reshape(shape), out)

@@ -1,0 +1,41 @@
+"""MoE comm utilities (reference: paddle.distributed.utils.global_scatter /
+global_gather — paddle/fluid/operators/collective/global_scatter_op.cu:
+all-to-all exchange of per-(rank, expert) token counts then token rows).
+
+TPU-native: inside a shard_map over the expert axis these lower to
+``lax.all_to_all``; the dense-dispatch MoELayer does not need them (XLA
+inserts the exchange from shardings), they exist for API parity and for
+custom token-level MoE schemes."""
+import jax.numpy as jnp
+from jax import lax
+
+from .....framework.core import Tensor
+from .....framework.autograd import call_op
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _exchange(x, axis, split_axis=0):
+    def f(v):
+        try:
+            lax.axis_index(axis)
+        except Exception:
+            return v  # eager / world of 1: identity
+        return lax.all_to_all(v, axis, split_axis=split_axis,
+                              concat_axis=split_axis, tiled=True)
+    return call_op(f, x) if isinstance(x, Tensor) else f(jnp.asarray(x))
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   use_calc_stream=True, axis="model"):
+    """Dispatch rows to the expert ranks.  With the dense equal-capacity
+    layout (E*C rows per rank, E = experts * world) this is one tiled
+    all-to-all on dim 0; counts args are accepted for API parity."""
+    return _exchange(x, axis)
+
+
+def global_gather(x, local_count=None, global_count=None, group=None,
+                  use_calc_stream=True, axis="model"):
+    """Inverse of global_scatter (all-to-all is an involution on the
+    equal-split layout)."""
+    return _exchange(x, axis)

@@ -1,0 +1,238 @@
+from . import functional  # noqa: F401
+
+# -- Fused transformer layers (reference: python/paddle/incubate/nn/layer/
+# fused_transformer.py over fused CUDA kernels in
+# paddle/phi/kernels/fusion/gpu/fused_attention_kernel.cu etc.)
+#
+# TPU-native: "fused" is XLA's job — these layers express the same math as
+# one traced block (qkv in a single matmul, bias+residual+ln folded) and
+# the compiler emits the fused kernels the reference hand-wrote in CUDA.
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+from ...nn.layer.layers import Layer
+from ...nn import initializer as I
+
+
+def _ln(x, scale, bias, eps):
+    """Shared layer-norm body for the fused layers."""
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with qkv packed in one matmul
+    (reference: incubate.nn.FusedMultiHeadAttention)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._dropout = dropout_rate
+        self._attn_dropout = attn_dropout_rate
+        # packed qkv: [3, H, D, C] in the reference; [C, 3C] here (one GEMM)
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from ...framework.random import next_key
+        H, Dh, eps = self.num_heads, self.head_dim, self._epsilon
+        pre = self.normalize_before
+        m = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+        attn_p = self._attn_dropout if self.training else 0.0
+        out_p = self._dropout if self.training else 0.0
+        rng = next_key() if (attn_p > 0.0 or out_p > 0.0) else None
+
+        def impl(x, qkv_w, qkv_b, lin_w, lin_b, pls, plb, lns, lnb):
+            residual = x
+            if pre:
+                x = _ln(x, pls, plb, eps)
+            B, S, C = x.shape
+            qkv = x @ qkv_w + qkv_b                    # one GEMM
+            q, k, v = jnp.split(qkv.reshape(B, S, 3, H, Dh), 3, axis=2)
+            q, k, v = (t[:, :, 0] for t in (q, k, v))  # [B,S,H,Dh]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) \
+                / math.sqrt(Dh)
+            if m is not None:
+                s = s + m.astype(s.dtype)
+            p = jax.nn.softmax(s, axis=-1)
+            if attn_p > 0.0:
+                k1 = jax.random.fold_in(rng, 0)
+                keep = jax.random.bernoulli(k1, 1.0 - attn_p, p.shape)
+                p = jnp.where(keep, p / (1.0 - attn_p), 0.0)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+            o = o.reshape(B, S, C) @ lin_w + lin_b
+            if out_p > 0.0:
+                k2 = jax.random.fold_in(rng, 1)
+                keep = jax.random.bernoulli(k2, 1.0 - out_p, o.shape)
+                o = jnp.where(keep, o / (1.0 - out_p), 0.0)
+            out = residual + o
+            if not pre:
+                out = _ln(out, lns, lnb, eps)
+            return out
+        return call_op(impl, query, self.qkv_weight, self.qkv_bias,
+                       self.linear_weight, self.linear_bias,
+                       self.pre_ln_scale, self.pre_ln_bias,
+                       self.ln_scale, self.ln_bias)
+
+
+class FusedFeedForward(Layer):
+    """linear→act→linear with residual+LN folded in one traced block
+    (reference: incubate.nn.FusedFeedForward)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._dropout = dropout_rate
+        self._act_dropout = (dropout_rate if act_dropout_rate is None
+                             else act_dropout_rate)
+        self._act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter(
+            [d_model], attr=ln2_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, src, cache=None):
+        from ...framework.random import next_key
+        eps = self._epsilon
+        pre = self.normalize_before
+        act = self._act
+        drop_p = self._dropout if self.training else 0.0
+        act_p = self._act_dropout if self.training else 0.0
+        rng = next_key() if (drop_p > 0.0 or act_p > 0.0) else None
+
+        def impl(x, w1, b1, w2, b2, s1, bb1, s2, bb2):
+            residual = x
+            if pre:
+                x = _ln(x, s1, bb1, eps)
+            h = act(x @ w1 + b1)
+            if act_p > 0.0:
+                ka = jax.random.fold_in(rng, 0)
+                keep = jax.random.bernoulli(ka, 1.0 - act_p, h.shape)
+                h = jnp.where(keep, h / (1.0 - act_p), 0.0)
+            h = h @ w2 + b2
+            if drop_p > 0.0:
+                kb = jax.random.fold_in(rng, 1)
+                keep = jax.random.bernoulli(kb, 1.0 - drop_p, h.shape)
+                h = jnp.where(keep, h / (1.0 - drop_p), 0.0)
+            out = residual + h
+            if not pre:
+                out = _ln(out, s2, bb2, eps)
+            return out
+        return call_op(impl, src, self.linear1_weight, self.linear1_bias,
+                       self.linear2_weight, self.linear2_bias,
+                       self.ln1_scale, self.ln1_bias, self.ln2_scale,
+                       self.ln2_bias)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """FusedMultiHeadAttention + FusedFeedForward (reference:
+    incubate.nn.FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias is one traced op (reference:
+    incubate.nn.FusedLinear over fused_gemm_epilogue)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        t = self.transpose_weight
+
+        def impl(v, w, b):
+            return (v @ (w.T if t else w)) + b
+        return call_op(impl, x, self.weight, self.bias)

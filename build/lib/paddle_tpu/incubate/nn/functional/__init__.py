@@ -1,0 +1,93 @@
+"""Incubate functionals (reference: python/paddle/incubate/nn/functional/
+— fused_multi_head_attention, flash_attention wrapper over the cutlass
+submodule).
+
+TPU-native: flash attention dispatches to the Pallas kernel (M3) when on
+TPU with compatible shapes, falling back to the XLA softmax composition
+(which XLA fuses well on its own).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor
+from ....framework.autograd import call_op
+from ....tensor._helpers import ensure_tensor
+
+__all__ = ["flash_attention", "scaled_dot_product_attention",
+           "fused_multi_head_attention", "flash_attn_unpadded"]
+
+
+def _sdpa(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
+    """q,k,v: (B, S, H, D) paddle flash-attention layout."""
+    d = q.shape[-1]
+    s = scale or (1.0 / math.sqrt(d))
+    # -> (B,H,S,D)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * s
+    if causal:
+        S, T = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((S, T), bool))
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention layout: (B, S, H, D)."""
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    use_pallas = _pallas_ok(q)
+    if use_pallas:
+        from ....ops.pallas.flash_attention import flash_attention_fwd
+        out = call_op(lambda a, b, c: flash_attention_fwd(
+            a, b, c, causal=causal), q, k, v)
+    else:
+        out = call_op(lambda a, b, c: _sdpa(a, b, c, causal=causal), q, k, v)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def _pallas_ok(q):
+    try:
+        import jax
+        dev = jax.devices()[0].platform
+        if dev == "cpu":
+            return False
+        B, S, H, D = q.shape
+        return S % 128 == 0 and D in (64, 128, 256)
+    except Exception:
+        return False
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if attn_mask is not None:
+        m = ensure_tensor(attn_mask)
+        return call_op(lambda a, b, c, mm: _sdpa(a, b, c, mask=mm,
+                                                 causal=is_causal),
+                       q, k, v, m)
+    return call_op(lambda a, b, c: _sdpa(a, b, c, causal=is_causal), q, k, v)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    raise NotImplementedError(
+        "varlen flash attention lands with the Pallas kernel suite (M3)")
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kw):
+    raise NotImplementedError(
+        "use paddle_tpu.nn.MultiHeadAttention; XLA fuses the composed ops")

@@ -1,0 +1,93 @@
+"""Bounded byte-buffer blocking queue over the native C++ core
+(paddle_tpu/csrc/blocking_queue.cc; reference: the reader blocking queue
+in paddle/fluid/operators/reader/ fed by the Python DataLoader).  Python
+``queue.Queue`` fallback keeps semantics identical without the toolchain.
+"""
+import ctypes
+import queue as _pyqueue
+
+from ..framework import native
+
+__all__ = ["BlockingQueue"]
+
+
+class BlockingQueue:
+    """push/pop bytes with backpressure.  close() wakes waiters; pending
+    items stay poppable (drain-then-end), then pop returns None."""
+
+    def __init__(self, capacity):
+        self._lib = native.get_lib()
+        self._closed = False
+        if self._lib is not None:
+            self._h = self._lib.pt_queue_create(int(capacity))
+        else:
+            self._q = _pyqueue.Queue(maxsize=int(capacity))
+
+    def push(self, data: bytes, timeout=None):
+        """True if enqueued; False on timeout or closed queue."""
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        if self._lib is not None:
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+                if data else None
+            return self._lib.pt_queue_push(self._h, buf, len(data), tmo) == 0
+        # Poll in short slices so close() can wake a blocked producer
+        # (the native path wakes waiters via its condition variable).
+        remaining = timeout
+        while True:
+            if self._closed:
+                return False
+            try:
+                self._q.put(data, timeout=0.05 if remaining is None
+                            else min(remaining, 0.05))
+                return True
+            except _pyqueue.Full:
+                if remaining is not None:
+                    remaining -= 0.05
+                    if remaining <= 0:
+                        return False
+
+    def pop(self, timeout=None):
+        """bytes, or None when the queue is closed and drained.
+        Raises TimeoutError on timeout."""
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        if self._lib is not None:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.pt_queue_pop(self._h, tmo, ctypes.byref(out))
+            if n == -1:
+                raise TimeoutError("BlockingQueue.pop timed out")
+            if n == -2:
+                return None
+            return native.take_buffer(self._lib, out, n)
+        while True:
+            try:
+                return self._q.get(
+                    timeout=0.05 if self._closed or timeout is None
+                    else min(timeout, 0.05))
+            except _pyqueue.Empty:
+                if self._closed and self._q.empty():
+                    return None
+                if timeout is not None:
+                    timeout -= 0.05
+                    if timeout <= 0:
+                        raise TimeoutError("BlockingQueue.pop timed out")
+
+    def size(self):
+        if self._lib is not None:
+            return self._lib.pt_queue_size(self._h)
+        return self._q.qsize()
+
+    def close(self):
+        self._closed = True
+        if self._lib is not None and self._h:
+            self._lib.pt_queue_close(self._h)
+
+    def destroy(self):
+        if self._lib is not None and getattr(self, "_h", 0):
+            self._lib.pt_queue_destroy(self._h)
+            self._h = 0
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

@@ -1,0 +1,212 @@
+"""Multiprocess DataLoader machinery (reference:
+python/paddle/io/dataloader/{dataloader_iter,worker}.py —
+``_DataLoaderIterMultiProcess`` feeding the C++ blocking queue).
+
+Architecture, mirrored TPU-side:
+  fork'd worker processes  --(result mp.Queue: pickled numpy batches)-->
+  collector thread (reorders by batch index) --> native C++ BlockingQueue
+  (bounded prefetch backpressure, csrc/blocking_queue.cc) --> train loop.
+
+Workers run only dataset indexing + numpy transforms — never JAX device
+ops (device state is not fork-safe; collation to device arrays happens in
+the parent).
+"""
+import multiprocessing
+import os
+import pickle
+import threading
+import traceback
+
+import numpy as np
+
+from .blocking_queue import BlockingQueue
+
+__all__ = ["MultiProcessIter"]
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.msg = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+
+
+def _to_numpy(sample):
+    # Strip framework tensors down to numpy for IPC.
+    from ..framework.core import Tensor
+    if isinstance(sample, Tensor):
+        return np.asarray(sample._value)
+    if isinstance(sample, tuple) and hasattr(sample, "_fields"):
+        return type(sample)(*(_to_numpy(s) for s in sample))  # namedtuple
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(_to_numpy(s) for s in sample)
+    if isinstance(sample, dict):
+        return {k: _to_numpy(v) for k, v in sample.items()}
+    return sample
+
+
+def _worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
+                 worker_init_fn, base_seed):
+    from . import _worker_info, _WorkerInfo
+    np.random.seed((base_seed + worker_id) % (2 ** 32))
+    _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception as e:
+            result_queue.put(pickle.dumps((-1, _WorkerError(e))))
+            return
+    while True:
+        item = index_queue.get()
+        if item is None:
+            return
+        batch_idx, indices = item
+        try:
+            samples = [_to_numpy(dataset[i]) for i in indices]
+            blob = pickle.dumps((batch_idx, samples), protocol=4)
+        except Exception as e:  # incl. unpicklable samples
+            blob = pickle.dumps((batch_idx, _WorkerError(e)), protocol=4)
+        result_queue.put(blob)
+
+
+class MultiProcessIter:
+    """Order-preserving multiprocess batch iterator over a map-style
+    dataset."""
+
+    def __init__(self, dataset, batch_indices, collate_fn, num_workers,
+                 prefetch_factor=2, timeout=0, worker_init_fn=None):
+        self._collate = collate_fn
+        self._timeout = timeout if timeout and timeout > 0 else None
+        self._batches = list(batch_indices)
+        self._num_workers = num_workers
+        # Outstanding dispatches are capped so workers can't run the whole
+        # epoch ahead of the consumer: the bounded native queue throttles
+        # the collector, and the collector only dispatches a new index
+        # batch after delivering one (reference: _outstanding_capacity in
+        # dataloader_iter.py).
+        self._capacity = max(2, prefetch_factor * num_workers)
+        ctx = multiprocessing.get_context("fork")
+        self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
+        self._result_queue = ctx.Queue()
+        self._out = BlockingQueue(self._capacity)
+        base_seed = int.from_bytes(os.urandom(4), "little")
+        self._stopping = False
+        self._workers = []
+        try:
+            for wid in range(num_workers):
+                p = ctx.Process(
+                    target=_worker_loop,
+                    args=(dataset, self._index_queues[wid],
+                          self._result_queue, wid, num_workers,
+                          worker_init_fn, base_seed),
+                    daemon=True)
+                p.start()
+                self._workers.append(p)
+        except BaseException:  # don't leak already-started workers
+            for p in self._workers:
+                if p.is_alive():
+                    p.terminate()
+            raise
+        self._next_dispatch = 0
+        for _ in range(min(self._capacity + num_workers,
+                           len(self._batches))):
+            self._dispatch_one()
+        if self._next_dispatch >= len(self._batches):
+            self._send_sentinels()
+        self._collector = threading.Thread(target=self._collect, daemon=True)
+        self._collector.start()
+        self._done = False
+
+    def _dispatch_one(self):
+        i = self._next_dispatch
+        self._index_queues[i % self._num_workers].put((i, self._batches[i]))
+        self._next_dispatch += 1
+
+    def _send_sentinels(self):
+        for q in self._index_queues:
+            q.put(None)
+
+    def _collect(self):
+        import queue as _pyq
+        pending = {}
+        next_idx = 0
+        total = len(self._batches)
+        try:
+            while next_idx < total and not self._stopping:
+                try:
+                    blob = self._result_queue.get(timeout=1.0)
+                except _pyq.Empty:
+                    if not any(p.is_alive() for p in self._workers):
+                        # a worker died without reporting (segfault/OOM):
+                        # surface instead of hanging the consumer forever
+                        err = _WorkerError(RuntimeError(
+                            "DataLoader worker(s) exited unexpectedly"))
+                        err.msg = "DataLoader worker(s) exited unexpectedly"
+                        self._out.push(pickle.dumps((-1, err)))
+                        return
+                    continue
+                batch_idx, payload = pickle.loads(blob)
+                if batch_idx == -2:  # shutdown sentinel
+                    return
+                if isinstance(payload, _WorkerError) or batch_idx < 0:
+                    self._out.push(pickle.dumps((-1, payload)))
+                    return
+                pending[batch_idx] = blob
+                while next_idx in pending:
+                    if not self._out.push(pending.pop(next_idx)):
+                        return  # output queue closed under us
+                    next_idx += 1
+                    if self._next_dispatch < total:
+                        self._dispatch_one()
+                        if self._next_dispatch >= total:
+                            self._send_sentinels()
+        except (EOFError, OSError):
+            pass  # torn down mid-epoch
+        finally:
+            self._out.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        try:
+            blob = self._out.pop(timeout=self._timeout)
+        except TimeoutError:
+            # a timed-out epoch is dead (reference: DataLoader raises and
+            # the iterator is unusable); tear down rather than letting a
+            # retried next() race the closed queue into StopIteration
+            self._done = True
+            self._shutdown()
+            raise
+        if blob is None:
+            self._done = True
+            self._shutdown()
+            raise StopIteration
+        batch_idx, payload = pickle.loads(blob)
+        if isinstance(payload, _WorkerError):
+            self._shutdown()
+            raise RuntimeError(
+                "DataLoader worker raised:\n" + payload.msg)
+        return self._collate(payload)
+
+    def _shutdown(self):
+        self._stopping = True
+        self._out.close()
+        try:  # wake a collector blocked in result_queue.get()
+            self._result_queue.put(pickle.dumps((-2, None)))
+        except (OSError, ValueError):
+            pass
+        for p in self._workers:
+            if p.is_alive():
+                p.terminate()
+        for p in self._workers:
+            p.join(timeout=1.0)
+        if self._collector.is_alive():
+            self._collector.join(timeout=1.0)
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,422 @@
+"""Data-dependent control flow for dygraph→static (reference:
+python/paddle/jit/dy2static/ — ~25 AST transformers + convert_operators
+rewriting Python if/while/and/or/not into conditional_block / while ops).
+
+TPU-native: one AST pass rewrites ``if``/``while``/``and``/``or``/``not``
+into calls to runtime converters that dispatch at execution time — a
+concrete (eager) predicate keeps exact Python semantics, a traced
+predicate lowers to ``lax.cond`` / ``lax.while_loop`` so the branch
+becomes real compiled control flow instead of a tracer error.  This is
+the reference's convert_ifelse/convert_while_loop design
+(python/paddle/jit/dy2static/convert_operators.py) collapsed onto XLA's
+structured control-flow primitives.
+
+Supported rewrites (the rest of the function is left untouched and keeps
+plain tracing semantics):
+- ``if``/``elif``/``else`` whose branches assign local variables, or
+  whose branches both end in ``return``.
+- ``while`` whose body assigns its loop-carried variables (no
+  ``break``/``continue``/``return`` inside — XLA has no early exit).
+- ``and``/``or``/``not`` (short-circuit preserved when operands are
+  concrete; ``logical_and/or/not`` when traced).
+
+Gradients flow through converted ``if`` (lax.cond is reverse-mode
+differentiable); a converted ``while`` is forward-only under reverse-mode
+AD — an XLA constraint (lax.while_loop has no transpose rule).
+
+Variables assigned only inside a branch/loop that are unbound before it
+ride an ``_UNDEF`` sentinel: they stay "unbound" (erroring on use) unless
+the executed path binds them — mirroring Python.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not",
+           "transform_function"]
+
+
+class _Undef:
+    """Placeholder for a name unbound at the control-flow entry."""
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def __bool__(self):
+        raise NameError("variable is unbound on this control-flow path "
+                        "(dy2static)")
+
+
+_UNDEF = _Undef()
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_val(x), jax.core.Tracer)
+
+
+def _load(thunk):
+    """Read a possibly-unbound outer local."""
+    try:
+        return thunk()
+    except NameError:
+        return _UNDEF
+
+
+def _unwrap_tree(out):
+    return jax.tree.map(lambda o: _val(o), out,
+                        is_leaf=lambda o: isinstance(o, Tensor))
+
+
+def _wrap_tree(vals):
+    return jax.tree.map(lambda v: Tensor(v), vals)
+
+
+def convert_ifelse(pred, true_fn, false_fn, init=()):
+    """if/else over a possibly-traced predicate.
+
+    init: current values of the variables either branch assigns (so a
+    read-before-write inside a branch sees the outer value instead of
+    hitting UnboundLocalError).  Concrete pred -> exact Python dispatch;
+    traced pred -> ``lax.cond`` with both branches traced.
+    """
+    p = _val(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn(*init) if bool(p) else false_fn(*init)
+    t = lambda: _unwrap_tree(true_fn(*init))
+    f = lambda: _unwrap_tree(false_fn(*init))
+    return _wrap_tree(lax.cond(p, t, f))
+
+
+def convert_while_loop(cond_fn, body_fn, init):
+    """while over a possibly-traced condition.
+
+    init: tuple of loop-carried values (entries may be ``_UNDEF`` for
+    names unbound before the loop — those are treated as body-local
+    temporaries and not carried).  Traced -> ``lax.while_loop``.
+    """
+    init = tuple(init)
+    p0 = cond_fn(*init)
+    if not isinstance(_val(p0), jax.core.Tracer) \
+            and not any(_is_traced(v) for v in init):
+        out = init
+        while bool(_val(cond_fn(*out))):
+            out = tuple(body_fn(*out))
+        return out
+
+    live = [i for i, v in enumerate(init) if v is not _UNDEF]
+    if not live:
+        raise ValueError(
+            "dy2static while: no loop-carried variable is bound before "
+            "the loop; initialize the loop state first (lax.while_loop "
+            "needs concrete initial shapes)")
+    wrap_t = [isinstance(init[i], Tensor) for i in live]
+
+    def full(carry):
+        args = list(init)
+        for j, i in enumerate(live):
+            args[i] = Tensor(carry[j]) if wrap_t[j] else carry[j]
+        return args
+
+    def c(carry):
+        return _val(cond_fn(*full(carry)))
+
+    def b(carry):
+        out = tuple(body_fn(*full(carry)))
+        return tuple(jnp.asarray(_val(out[i])) for i in live)
+
+    carry0 = tuple(jnp.asarray(_val(init[i])) for i in live)
+    final = lax.while_loop(c, b, carry0)
+    out = list(init)
+    for j, i in enumerate(live):
+        out[i] = Tensor(final[j]) if wrap_t[j] else final[j]
+    return tuple(out)
+
+
+def convert_logical_and(a_fn, b_fn):
+    a = a_fn()
+    if _is_traced(a):
+        return Tensor(jnp.logical_and(_val(a), _val(b_fn())))
+    return a and b_fn()
+
+
+def convert_logical_or(a_fn, b_fn):
+    a = a_fn()
+    if _is_traced(a):
+        return Tensor(jnp.logical_or(_val(a), _val(b_fn())))
+    return a or b_fn()
+
+
+def convert_logical_not(a):
+    if _is_traced(a):
+        return Tensor(jnp.logical_not(_val(a)))
+    return not a
+
+
+_RUNTIME = {
+    "__pt_ifelse__": convert_ifelse,
+    "__pt_while__": convert_while_loop,
+    "__pt_and__": convert_logical_and,
+    "__pt_or__": convert_logical_or,
+    "__pt_not__": convert_logical_not,
+    "__pt_ld__": _load,
+}
+
+
+# -- static analysis helpers -------------------------------------------------
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef,
+           ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _walk_scope(node):
+    """Walk statements without descending into nested scopes."""
+    stack = list(node) if isinstance(node, list) else [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _SCOPES):
+                stack.append(child)
+
+
+def _target_names(target, names, ok):
+    if isinstance(target, ast.Name):
+        names.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _target_names(elt, names, ok)
+    elif isinstance(target, ast.Starred):
+        _target_names(target.value, names, ok)
+    else:
+        # attribute/subscript stores are side effects a traced branch
+        # cannot replay — caller must leave this construct untransformed
+        ok[0] = False
+
+
+def _assigned_names(stmts):
+    """(names, transformable) assigned by a statement list."""
+    names, ok = set(), [True]
+    for n in _walk_scope(stmts):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                _target_names(t, names, ok)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            _target_names(n.target, names, ok)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            _target_names(n.optional_vars, names, ok)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.add(n.name)
+        elif isinstance(n, ast.Delete):
+            ok[0] = False
+    return names, ok[0]
+
+
+def _loop_level_break(stmts):
+    """break/continue belonging to THIS loop (not a nested one)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Break, ast.Continue)):
+            return True
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _SCOPES + (ast.For, ast.While)):
+                stack.append(child)
+    return False
+
+
+def _count_returns(stmts):
+    return sum(1 for n in _walk_scope(stmts) if isinstance(n, ast.Return))
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _ld_tuple(names):
+    """(__pt_ld__(lambda: v1), __pt_ld__(lambda: v2), ...)"""
+    elts = [ast.Call(func=_name("__pt_ld__"),
+                     args=[ast.Lambda(
+                         args=ast.arguments(posonlyargs=[], args=[],
+                                            kwonlyargs=[], kw_defaults=[],
+                                            defaults=[]),
+                         body=_name(v))],
+                     keywords=[]) for v in names]
+    return ast.Tuple(elts=elts, ctx=ast.Load())
+
+
+def _fn_def(fname, params, body):
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p) for p in params],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[], returns=None, type_comment=None,
+        type_params=[])
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.changed = False
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- boolean ops ---------------------------------------------------------
+    def visit_BoolOp(self, node):
+        node = self.generic_visit(node)
+        conv = "__pt_and__" if isinstance(node.op, ast.And) else "__pt_or__"
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            thunk_l = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=out)
+            thunk_r = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=rhs)
+            out = ast.Call(func=_name(conv), args=[thunk_l, thunk_r],
+                           keywords=[])
+        self.changed = True
+        return out
+
+    def visit_UnaryOp(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return ast.Call(func=_name("__pt_not__"), args=[node.operand],
+                            keywords=[])
+        return node
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        n = self._uid()
+        t_ret = _count_returns(node.body)
+        f_ret = _count_returns(node.orelse)
+        t_names, t_ok = _assigned_names(node.body)
+        f_names, f_ok = _assigned_names(node.orelse)
+
+        if t_ret == 0 and f_ret == 0 and t_ok and f_ok:
+            out = sorted(t_names | f_names)
+            if not out:
+                return node  # side-effect-only branches: keep Python
+            ret = ast.Return(value=ast.Tuple(
+                elts=[_name(v) for v in out], ctx=ast.Load()))
+            tfn = _fn_def(f"_pt_true_{n}", out, node.body + [ret])
+            ffn = _fn_def(f"_pt_false_{n}", out,
+                          (node.orelse or [ast.Pass()]) + [ret])
+            call = ast.Call(
+                func=_name("__pt_ifelse__"),
+                args=[node.test, _name(f"_pt_true_{n}"),
+                      _name(f"_pt_false_{n}"), _ld_tuple(out)],
+                keywords=[])
+            unpack = ast.Assign(
+                targets=[ast.Tuple(elts=[_name(v, ast.Store()) for v in out],
+                                   ctx=ast.Store())],
+                value=call)
+            self.changed = True
+            return [tfn, ffn, unpack]
+
+        # both branches end in their single return -> return the cond value
+        if (t_ret == 1 and f_ret == 1 and node.orelse
+                and isinstance(node.body[-1], ast.Return)
+                and isinstance(node.orelse[-1], ast.Return)
+                and t_ok and f_ok):
+            out = sorted(t_names | f_names)
+            tfn = _fn_def(f"_pt_true_{n}", out, node.body)
+            ffn = _fn_def(f"_pt_false_{n}", out, node.orelse)
+            call = ast.Call(
+                func=_name("__pt_ifelse__"),
+                args=[node.test, _name(f"_pt_true_{n}"),
+                      _name(f"_pt_false_{n}"), _ld_tuple(out)],
+                keywords=[])
+            self.changed = True
+            return [tfn, ffn, ast.Return(value=call)]
+
+        return node  # early-return / side-effect shapes: keep Python
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse or _loop_level_break(node.body) \
+                or _count_returns(node.body):
+            return node
+        names, ok = _assigned_names(node.body)
+        if not names or not ok:
+            return node
+        n = self._uid()
+        out = sorted(names)
+        cfn = _fn_def(f"_pt_wcond_{n}", out,
+                      [ast.Return(value=node.test)])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(v) for v in out], ctx=ast.Load()))
+        bfn = _fn_def(f"_pt_wbody_{n}", out, node.body + [ret])
+        call = ast.Call(
+            func=_name("__pt_while__"),
+            args=[_name(f"_pt_wcond_{n}"), _name(f"_pt_wbody_{n}"),
+                  _ld_tuple(out)],
+            keywords=[])
+        unpack = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(v, ast.Store()) for v in out],
+                               ctx=ast.Store())],
+            value=call)
+        self.changed = True
+        return [cfn, bfn, unpack]
+
+
+def transform_function(fn):
+    """AST-rewrite a function's tensor control flow.  Returns
+    (function, changed); on any unsupported shape the original function
+    is returned unchanged (plain tracing semantics)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn, False
+    if "super(" in src:
+        # zero-arg super() needs the __class__ cell, which a recompiled
+        # function body does not carry
+        return fn, False
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn, False
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return fn, False
+    fdef.decorator_list = []
+    tr = _CtrlFlowTransformer()
+    tree = tr.visit(tree)
+    if not tr.changed:
+        return fn, False
+    ast.fix_missing_locations(tree)
+    try:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+    except (SyntaxError, ValueError):
+        return fn, False
+    glb = dict(fn.__globals__)
+    if fn.__closure__:
+        glb.update({name: cell.cell_contents
+                    for name, cell in zip(fn.__code__.co_freevars,
+                                          fn.__closure__)})
+    glb.update(_RUNTIME)
+    ns = {}
+    exec(code, glb, ns)
+    new_fn = functools.wraps(fn)(ns[fdef.name])
+    return new_fn, True

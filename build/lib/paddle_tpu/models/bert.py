@@ -1,0 +1,127 @@
+"""BERT family (BASELINE config #3: BERT-base DP+AMP O2).
+
+Encoder built from nn.TransformerEncoder; MLM + NSP pretraining heads.
+"""
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base",
+           "bert_tiny"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=128, **kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings,
+                                                c.hidden_size)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size,
+                                       epsilon=c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..tensor.creation import arange, zeros
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(S, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = zeros(list(input_ids.shape), "int64")
+        x = (self.word_embeddings(input_ids) +
+             self.position_embeddings(position_ids) +
+             self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.dense = nn.Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation="gelu",
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # (B, S) 1/0 mask → additive (B, 1, 1, S)
+            m = attention_mask
+            if isinstance(m, Tensor):
+                m = call_op(
+                    lambda v: (1.0 - v[:, None, None, :].astype(
+                        jnp.float32)) * -1e30, m)
+            attention_mask = m
+        seq = self.encoder(x, attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads; MLM head tied to word embeddings."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        c = config
+        self.transform = nn.Linear(c.hidden_size, c.hidden_size)
+        self.transform_norm = nn.LayerNorm(c.hidden_size,
+                                           epsilon=c.layer_norm_eps)
+        self.mlm_bias = self.create_parameter([c.vocab_size], is_bias=True)
+        self.nsp = nn.Linear(c.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight
+        logits = call_op(lambda hv, wv, bv: hv @ wv.T + bv, h, w,
+                         self.mlm_bias)
+        return logits, self.nsp(pooled)

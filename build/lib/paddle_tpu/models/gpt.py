@@ -1,0 +1,247 @@
+"""GPT family (reference workload: GPT-3 1.3B TP+PP hybrid —
+BASELINE.json config #4; model structure mirrors PaddleNLP's GPTModel,
+parallelised with our mp_layers instead of per-rank weight slices).
+
+TPU-first choices:
+- fused QKV projection (one (H, 3H) matmul for the MXU);
+- pre-LN blocks; bf16-friendly (params fp32, compute cast by AMP);
+- attention via F.scaled_dot_product_attention (Pallas flash for long
+  seqs);
+- TP: QKV/MLP-up are column-parallel, attn-out/MLP-down row-parallel,
+  embeddings vocab-parallel — the Megatron placement expressed as weight
+  pspecs that GSPMD partitions;
+- ``remat`` toggles jax.checkpoint per block (the reference's
+  recompute_interval).
+"""
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from .. import nn
+from ..nn import functional as F
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForPretraining",
+           "GPTPretrainingCriterion", "gpt3_tiny", "gpt3_125m", "gpt3_1p3b"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0        # 0 → 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tensor_parallel: bool = False     # use TP layers (mp mesh axis)
+    remat: bool = False               # jax.checkpoint per block
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+def gpt3_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=128,
+                     **kw)
+
+
+def gpt3_125m(**kw):
+    return GPTConfig(hidden_size=768, num_hidden_layers=12,
+                     num_attention_heads=12, **kw)
+
+
+def gpt3_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_hidden_layers=24,
+                     num_attention_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        H = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = H // self.num_heads
+        self.dropout = config.attention_probs_dropout_prob
+        if config.tensor_parallel:
+            self.qkv_proj = ColumnParallelLinear(H, 3 * H,
+                                                 gather_output=False)
+            self.out_proj = RowParallelLinear(H, H, input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(H, 3 * H)
+            self.out_proj = nn.Linear(H, H)
+
+    def forward(self, x, cache=None):
+        from ..tensor.manipulation import reshape, concat
+        B, S, H = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        out = reshape(out, [B, S, H])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        H, I = config.hidden_size, config.intermediate_size
+        if config.tensor_parallel:
+            self.up = ColumnParallelLinear(H, I, gather_output=False)
+            self.down = RowParallelLinear(I, H, input_is_parallel=True)
+        else:
+            self.up = nn.Linear(H, I)
+            self.down = nn.Linear(I, H)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self._remat = config.remat
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        if config.tensor_parallel:
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size)
+        else:
+            self.word_embeddings = nn.Embedding(config.vocab_size,
+                                                config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..tensor.creation import arange
+        if position_ids is None:
+            S = input_ids.shape[1]
+            position_ids = arange(S, dtype="int64")
+        return self.dropout(self.word_embeddings(input_ids) +
+                            self.position_embeddings(position_ids))
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.final_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        for blk in self.layers:
+            if self.config.remat:
+                x = _remat_block(blk, x)
+            else:
+                x = blk(x)
+        return self.final_norm(x)
+
+
+def _remat_block(blk, x):
+    """jax.checkpoint the block (reference: fleet recompute per layer)."""
+    params = [p for _, p in blk.named_parameters()]
+
+    def run(xv, *pv):
+        olds = [p._value for p in params]
+        for p, v in zip(params, pv):
+            p._value = v
+        try:
+            from ..framework import autograd as _ag
+            with _ag.suspend_tape():
+                return blk(Tensor(xv))._value
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+    return call_op(jax.checkpoint(run), x, *params)
+
+
+def _init_gpt_weights(root, std):
+    """normal(0, initializer_range) for matmul/embedding weights, zero
+    biases, ones for norm scales — the GPT init scheme."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    for name, p in root.named_parameters():
+        shape = tuple(p.shape)
+        if name.endswith("bias") or len(shape) == 0:
+            p._value = jnp.zeros(shape, p.dtype)
+        elif len(shape) == 1:
+            # norm weight
+            if "norm" in name or name.endswith(".weight") and \
+                    "embedding" not in name:
+                p._value = jnp.ones(shape, p.dtype)
+        else:
+            p._value = jnp.asarray(
+                rng.normal(0.0, std, shape).astype("float32"))
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to the input embedding (reference: shared weights via
+    SharedLayerDesc in PP; here the tie is literal reuse)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+        _init_gpt_weights(self, config.initializer_range)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return call_op(lambda h, wv: h @ wv.T, x, w)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Shifted LM cross-entropy; with TP the logits arrive vocab-sharded
+    and the CE reductions lower to the c_softmax_with_cross_entropy wire
+    pattern."""
+
+    def __init__(self, config=None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        V = logits.shape[-1]
+        from ..tensor.manipulation import reshape
+        lg = reshape(logits[:, :-1, :], [-1, V])
+        lb = reshape(labels[:, 1:], [-1])
+        return F.cross_entropy(lg, lb)

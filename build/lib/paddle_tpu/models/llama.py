@@ -1,0 +1,183 @@
+"""LLaMA family (BASELINE config #5: LLaMA-7B ZeRO-3/GroupSharded).
+
+RMSNorm + SwiGLU + rotary embeddings + GQA; TP via the same mp_layers
+annotations as GPT.  RoPE is applied in fp32 (bf16 rotation loses phase
+accuracy at long context).
+"""
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from .. import nn
+from ..nn import functional as F
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_7b",
+           "llama_tiny"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 0      # 0 → same as heads (MHA)
+    intermediate_size: int = 11008
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tensor_parallel: bool = False
+    remat: bool = False
+
+    def __post_init__(self):
+        if not self.num_key_value_heads:
+            self.num_key_value_heads = self.num_attention_heads
+
+
+def llama_7b(**kw):
+    return LlamaConfig(**kw)
+
+
+def llama_tiny(**kw):
+    return LlamaConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       intermediate_size=128,
+                       max_position_embeddings=256, **kw)
+
+
+def _rope(x, theta, position_ids=None):
+    """x: (B, S, H, D) — rotate half, fp32."""
+    B, S, H, D = x.shape
+    pos = jnp.arange(S) if position_ids is None else position_ids
+    freqs = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]   # (S, D/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., ::2], xf[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    out = jnp.stack([r1, r2], axis=-1).reshape(B, S, H, D)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        H = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv = config.num_key_value_heads
+        self.head_dim = H // self.num_heads
+        self.theta = config.rope_theta
+        kv_out = self.num_kv * self.head_dim
+        if config.tensor_parallel:
+            self.q_proj = ColumnParallelLinear(H, H, has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(H, kv_out, has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(H, kv_out, has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(H, H, has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(H, H, bias_attr=False)
+            self.k_proj = nn.Linear(H, kv_out, bias_attr=False)
+            self.v_proj = nn.Linear(H, kv_out, bias_attr=False)
+            self.o_proj = nn.Linear(H, H, bias_attr=False)
+
+    def forward(self, x):
+        from ..tensor.manipulation import reshape
+        B, S, H = x.shape
+        q = reshape(self.q_proj(x), [B, S, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(x), [B, S, self.num_kv, self.head_dim])
+        v = reshape(self.v_proj(x), [B, S, self.num_kv, self.head_dim])
+        q = call_op(lambda t: _rope(t, self.theta), q)
+        k = call_op(lambda t: _rope(t, self.theta), k)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = reshape(out, [B, S, H])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        H, I = config.hidden_size, config.intermediate_size
+        if config.tensor_parallel:
+            self.gate_proj = ColumnParallelLinear(H, I, has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(H, I, has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(I, H, has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(H, I, bias_attr=False)
+            self.up_proj = nn.Linear(H, I, bias_attr=False)
+            self.down_proj = nn.Linear(I, H, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                       config.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(config.vocab_size,
+                                             config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for blk in self.layers:
+            if self.config.remat:
+                from .gpt import _remat_block
+                x = _remat_block(blk, x)
+            else:
+                x = blk(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.model = LlamaModel(config)
+        if config.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        return self.lm_head(self.model(input_ids))

@@ -1,0 +1,19 @@
+"""paddle_tpu.nn — layers + functional (reference: python/paddle/nn)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from .layer.layers import (Layer, LayerList, Sequential, ParameterList,  # noqa: F401
+                           LayerDict)
+from .layer.common import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue)
+
+from .layer import common as _common
+from .layer import norm as _norm
+from .layer import activation as _activation
+from .layer import loss as _loss

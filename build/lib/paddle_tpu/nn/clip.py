@@ -1,0 +1,81 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+Clip objects transform a list of (param, grad) pairs; the optimizer applies
+them before the update, exactly like the reference's ``GradientClipBase``
+protocol.  The distributed variants (hybrid-parallel global-norm across mesh
+axes) subclass ClipGradByGlobalNorm in distributed/fleet.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, None if g is None else
+                 Tensor(jnp.clip(g._value, self.min, self.max))
+                 if isinstance(g, Tensor) else jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return g * scale
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            elif isinstance(g, Tensor):
+                out.append((p, Tensor(self._clip_one(g._value))))
+            else:
+                out.append((p, self._clip_one(g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm_sq(self, grads):
+        """Sum of squares over local grads; distributed subclasses add the
+        cross-axis psum here."""
+        return sum(jnp.sum(jnp.square(
+            g.astype(jnp.float32))) for g in grads)
+
+    def __call__(self, params_grads):
+        raw = [(p, g._value if isinstance(g, Tensor) else g)
+               for p, g in params_grads]
+        grads = [g for _, g in raw if g is not None]
+        if not grads:
+            return params_grads
+        gn = jnp.sqrt(self._global_norm_sq(grads))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for (p, g_orig), (_, g) in zip(params_grads, raw):
+            if g is None:
+                out.append((p, g_orig))
+            else:
+                clipped = (g.astype(jnp.float32) * scale).astype(g.dtype)
+                out.append((p, Tensor(clipped)
+                            if isinstance(g_orig, Tensor) else clipped))
+        return out

@@ -1,0 +1,154 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op
+from ...tensor._helpers import ensure_tensor, unary_op
+
+relu = unary_op(jax.nn.relu)
+relu6 = unary_op(jax.nn.relu6)
+sigmoid = unary_op(jax.nn.sigmoid)
+tanh = unary_op(jnp.tanh)
+silu = unary_op(jax.nn.silu)
+swish = silu
+mish = unary_op(lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+gelu_tanh = unary_op(lambda v: jax.nn.gelu(v, approximate=True))
+hardswish = unary_op(jax.nn.hard_swish)
+hardsigmoid = unary_op(lambda v: jnp.clip(v / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = unary_op(lambda v: v - jnp.tanh(v))
+softsign = unary_op(jax.nn.soft_sign)
+log_sigmoid = unary_op(jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return call_op(lambda v: jax.nn.gelu(v, approximate=approximate),
+                   ensure_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return call_op(lambda v: jax.nn.leaky_relu(v, negative_slope),
+                   ensure_tensor(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return call_op(lambda v: jax.nn.elu(v, alpha), ensure_tensor(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return call_op(lambda v: jax.nn.celu(v, alpha), ensure_tensor(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return call_op(lambda v: scale * jnp.where(v > 0, v,
+                                               alpha * jnp.expm1(v)),
+                   ensure_tensor(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def _prelu(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        elif data_format == "NCHW":
+            wb = w.reshape((1, -1) + (1,) * (v.ndim - 2))
+        else:
+            wb = w.reshape((1,) * (v.ndim - 1) + (-1,))
+        return jnp.where(v > 0, v, wb * v)
+    return call_op(_prelu, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False,
+          name=None):
+    x = ensure_tensor(x)
+    if training:
+        from ...framework.random import next_key
+        import jax.random as jr
+        slope = jr.uniform(next_key(), tuple(x.shape), minval=lower,
+                           maxval=upper)
+        return call_op(lambda v: jnp.where(v >= 0, v, slope * v), x)
+    mid = (lower + upper) / 2.0
+    return call_op(lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return call_op(lambda v: jnp.clip(v, min, max), ensure_tensor(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return call_op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                   ensure_tensor(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return call_op(lambda v: jnp.where(
+        v > threshold, v - threshold,
+        jnp.where(v < -threshold, v + threshold, 0.0)), ensure_tensor(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return call_op(lambda v: jnp.where(
+        beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        ensure_tensor(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def _mo(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new), axis=ax + 1)
+    return call_op(_mo, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from ...framework import dtypes
+    d = dtypes.convert_dtype(dtype)
+
+    def _sm(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+    return call_op(_sm, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from ...framework import dtypes
+    d = dtypes.convert_dtype(dtype)
+
+    def _lsm(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+    return call_op(_lsm, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    from ...framework.random import next_key
+    g = jax.random.gumbel(next_key(), tuple(x.shape))
+
+    def _gs(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return call_op(_gs, x)
+
+
+def glu(x, axis=-1, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return call_op(lambda v: jnp.where(v > threshold, v, value),
+                   ensure_tensor(x))

@@ -1,0 +1,140 @@
+"""Attention functionals (reference:
+python/paddle/nn/functional/flash_attention.py — cutlass flash-attn;
+paddle/phi/kernels/fusion/gpu/fused_attention — fused QKV attention).
+
+TPU-native: one `scaled_dot_product_attention` entry.  Forward uses the
+Pallas blockwise online-softmax kernel on TPU for long sequences (VMEM-
+resident q blocks, streamed k/v — the flash pattern); the XLA path (which
+the compiler already fuses into two MXU matmuls + softmax) is used for
+short sequences, on CPU, and for the backward (recompute-based pullback,
+the flash-bwd recompute strategy expressed at the XLA level).
+"""
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+# Pallas kernel pays off past this seq length on TPU (short seqs fit XLA's
+# fused softmax just fine and avoid kernel-launch overhead)
+_PALLAS_MIN_SEQ = 1024
+
+
+def _xla_attention(q, k, v, mask=None, causal=False, scale=None,
+                   dropout_p=0.0, key=None):
+    """(B, S, H, D) reference attention — fp32 softmax accumulation."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    Hk = k.shape[2]
+    if Hk != H:  # MQA/GQA
+        k = jnp.repeat(k, H // Hk, axis=2)
+        v = jnp.repeat(v, H // Hk, axis=2)
+    # (B,H,Sq,Sk)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(Sq)[:, None]
+        ki = jnp.arange(Sk)[None, :]
+        s = jnp.where(qi >= ki, s, -1e30)
+    if mask is not None:
+        s = s + mask.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o.astype(q.dtype)
+
+
+def _use_pallas(S, scale):
+    # pallas kernel path: default scale only (it bakes 1/sqrt(D))
+    return (scale is None and S >= _PALLAS_MIN_SEQ and S % 512 == 0 and
+            jax.default_backend() == "tpu")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _attention_core(q, k, v, causal, scale):
+    from ...ops.pallas.flash_attention import flash_attention_fwd
+    if _use_pallas(q.shape[1], scale):
+        return flash_attention_fwd(q, k, v, causal=causal)
+    return _xla_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _attn_fwd(q, k, v, causal, scale):
+    from ...ops.pallas.flash_attention import flash_attention_fwd_lse
+    if _use_pallas(q.shape[1], scale):
+        o, lse = flash_attention_fwd_lse(q, k, v, causal=causal)
+        return o, (q, k, v, o, lse)
+    return _xla_attention(q, k, v, causal=causal, scale=scale), \
+        (q, k, v, None, None)
+
+
+def _attn_bwd(causal, scale, res, g):
+    q, k, v, o, lse = res
+    if o is not None:
+        # pallas flash backward: recompute P blockwise from saved lse —
+        # no S×S materialization (the reference's flash_attn_bwd)
+        from ...ops.pallas.flash_attention import flash_attention_bwd
+        return flash_attention_bwd(q, k, v, o, lse, g, causal=causal)
+    # recompute-based pullback at the XLA level (flash-bwd strategy)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
+        q_, k_, v_, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+_attention_core.defvjp(_attn_fwd, _attn_bwd)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention — (B, S, H, D)."""
+    from ...framework.random import next_key
+    tensors = [query, key, value]
+    q, k, v = [t if isinstance(t, Tensor) else Tensor(t) for t in tensors]
+    if attn_mask is None and dropout_p == 0.0:
+        sc = None
+        return call_op(lambda a, b, c: _attention_core(
+            a, b, c, bool(is_causal), sc), q, k, v)
+    rng = next_key() if (dropout_p > 0.0 and training) else None
+    m = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+    return call_op(lambda a, b, c: _xla_attention(
+        a, b, c, mask=m, causal=bool(is_causal),
+        dropout_p=dropout_p if training else 0.0, key=rng), q, k, v)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return (out, None) if return_softmax else (out, None)
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention lands with the ragged kernel; pad to the "
+        "block size and use flash_attention")
+
+
+class sdp_kernel:
+    """Context manager selecting attention backends (torch-compat shim the
+    reference also exposes); on TPU the dispatch is automatic."""
+
+    def __init__(self, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,341 @@
+"""Common functionals: linear, dropout, padding, embedding, interpolate
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op, is_grad_enabled
+from ...framework.random import next_key
+from ...tensor._helpers import ensure_tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: (in_features, out_features)
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        return call_op(lambda v, w, b: jnp.matmul(v, w) + b, x, weight,
+                       ensure_tensor(bias))
+    return call_op(lambda v, w: jnp.matmul(v, w), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return call_op(lambda v: v * (1.0 - p), x)
+        return x
+    if p == 1.0:
+        return call_op(lambda v: jnp.zeros_like(v), x)
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, shape)
+
+    def _do(v):
+        m = keep.astype(v.dtype)
+        if mode == "upscale_in_train":
+            return v * m / (1.0 - p)
+        return v * m
+    return call_op(_do, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2)))
+    b = -a * alpha_p * p
+
+    def _ad(v):
+        m = keep.astype(v.dtype)
+        return a * (v * m + alpha_p * (1 - m)) + b
+    return call_op(_ad, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        n_spatial = len(pad) // 2
+        # paddle spatial pad order is (last-dim-first pairs? no: per spatial
+        # dim starting from the one closest to W): [left,right,top,bottom...]
+        # maps to the LAST n_spatial dims in reverse order
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        for i, d in enumerate(reversed(spatial[-n_spatial:])):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def _pad(v):
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+    return call_op(_pad, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def _emb(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+    return call_op(lambda w, i: _emb(i, w), weight, x.detach())
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._value, num_classes))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+
+    def _ls(v):
+        k = v.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) \
+                else jnp.asarray(prior_dist)
+            return (1 - epsilon) * v + epsilon * pd
+        return (1 - epsilon) * v + epsilon / k
+    return call_op(_ls, label)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    from .conv import _tuple
+    k = _tuple(kernel_sizes, 2)
+    s = _tuple(strides, 2)
+    p = _tuple(paddings, 2) if not isinstance(paddings, (list, tuple)) or \
+        len(paddings) == 2 else tuple(paddings)
+    d = _tuple(dilations, 2)
+
+    def _uf(v):
+        N, C, H, W = v.shape
+        vp = jnp.pad(v, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        out_h = (vp.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        out_w = (vp.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = vp[:, :, i * d[0]: i * d[0] + out_h * s[0]: s[0],
+                        j * d[1]: j * d[1] + out_w * s[1]: s[1]]
+                patches.append(sl)
+        # (N, C*kh*kw, L)
+        st = jnp.stack(patches, axis=2)
+        return st.reshape(N, C * k[0] * k[1], out_h * out_w)
+    return call_op(_uf, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = ensure_tensor(x)
+    from .conv import _tuple
+    osz = _tuple(output_sizes, 2)
+    k = _tuple(kernel_sizes, 2)
+    s = _tuple(strides, 2)
+    p = _tuple(paddings, 2)
+    d = _tuple(dilations, 2)
+
+    def _fold(v):
+        N, CKK, L = v.shape
+        C = CKK // (k[0] * k[1])
+        H = osz[0] + 2 * p[0]
+        W = osz[1] + 2 * p[1]
+        out_h = (H - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        out_w = (W - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        vr = v.reshape(N, C, k[0], k[1], out_h, out_w)
+        out = jnp.zeros((N, C, H, W), v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + out_h * s[0]: s[0],
+                             j * d[1]: j * d[1] + out_w * s[1]: s[1]].add(
+                    vr[:, :, i, j])
+        return out[:, :, p[0]: H - p[0], p[1]: W - p[1]]
+    return call_op(_fold, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim - 2
+    if data_format.startswith("NC"):
+        spatial = tuple(x.shape[2:])
+    else:
+        spatial = tuple(x.shape[1:-1])
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = tuple(int(v._value if isinstance(v, Tensor) else v)
+                          for v in (size if isinstance(size, (list, tuple))
+                                    else [size]))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        out_sizes = tuple(int(s * f) for s, f in zip(spatial, sf))
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic",
+             "area": "linear"}[mode]
+
+    def _interp(v):
+        if data_format.startswith("NC"):
+            new_shape = v.shape[:2] + out_sizes
+        else:
+            new_shape = (v.shape[0],) + out_sizes + (v.shape[-1],)
+        if jmode == "nearest":
+            return jax.image.resize(v, new_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with linear map
+            return _resize_align_corners(v, new_shape, jmode, data_format)
+        return jax.image.resize(v, new_shape, method=jmode)
+    return call_op(_interp, x)
+
+
+def _resize_align_corners(v, new_shape, method, data_format):
+    start = 2 if data_format.startswith("NC") else 1
+    nd = len(new_shape)
+    out = v
+    for ax in range(start, start + (nd - 2)):
+        isize = out.shape[ax]
+        osize = new_shape[ax]
+        if isize == osize:
+            continue
+        if osize == 1:
+            idx = jnp.zeros((1,))
+        else:
+            idx = jnp.arange(osize) * (isize - 1) / (osize - 1)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, isize - 1)
+        w = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = osize
+        w = w.reshape(shape)
+        out = (jnp.take(out, lo, axis=ax) * (1 - w) +
+               jnp.take(out, hi, axis=ax) * w)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def _ps(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            out = v.reshape(N, C // (r * r), r, r, H, W)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = v.shape
+        out = v.reshape(N, H, W, C // (r * r), r, r)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(N, H * r, W * r, C // (r * r))
+    return call_op(_ps, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def _pu(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            out = v.reshape(N, C, H // r, r, W // r, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = v.shape
+        out = v.reshape(N, H // r, r, W // r, r, C)
+        out = jnp.transpose(out, (0, 2, 4, 5, 1, 3))
+        return out.reshape(N, H // r, W // r, C * r * r)
+    return call_op(_pu, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def _cs(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            out = v.reshape(N, groups, C // groups, H, W)
+            out = jnp.swapaxes(out, 1, 2)
+            return out.reshape(N, C, H, W)
+        N, H, W, C = v.shape
+        out = v.reshape(N, H, W, groups, C // groups)
+        out = jnp.swapaxes(out, 3, 4)
+        return out.reshape(N, H, W, C)
+    return call_op(_cs, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = (ensure_tensor(x1), ensure_tensor(x2),
+                      ensure_tensor(weight))
+
+    def _bl(a, b, w, *mb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if mb:
+            out = out + mb[0]
+        return out
+    if bias is not None:
+        return call_op(_bl, x1, x2, weight, ensure_tensor(bias))
+    return call_op(_bl, x1, x2, weight)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def _cos(a, b):
+        an = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        bn = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        num = jnp.sum(a * b, axis=axis)
+        return num / jnp.maximum(an * bn, eps)
+    return call_op(_cos, x1, x2)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def _n(v):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+    return call_op(_n, x)

@@ -1,0 +1,162 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py → Phi
+conv kernels over cuDNN).  TPU-native: `lax.conv_general_dilated`, which XLA
+lowers directly onto the MXU; NCHW (paddle default) and NHWC both supported
+— NHWC is preferred on TPU and the vision models default to it internally.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op
+from ...tensor._helpers import ensure_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n, strides=None):
+    """Normalize paddle padding spec to lax format."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer))
+                                 for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    # list of pairs
+    return [tuple(int(x) for x in p) for p in padding]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+             data_format, n):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        spatial = "".join(chr(ord("0") + i) for i in range(n))
+        dn_in = "NC" + spatial
+        dn_out = "NC" + spatial
+    else:
+        spatial = "".join(chr(ord("0") + i) for i in range(n))
+        dn_in = "N" + spatial + "C"
+        dn_out = "N" + spatial + "C"
+    dn_kernel = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, dn_kernel, dn_out))
+
+    def _conv(v, w, *maybe_bias):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if data_format.startswith("NC"):
+                out = out + b.reshape((1, -1) + (1,) * n)
+            else:
+                out = out + b.reshape((1,) * (n + 1) + (-1,))
+        return out
+    if bias is not None:
+        return call_op(_conv, x, weight, ensure_tensor(bias))
+    return call_op(_conv, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 3)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, n, output_size=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    opad = _tuple(output_padding, n)
+    spatial = "".join(chr(ord("0") + i) for i in range(n))
+    if data_format.startswith("NC"):
+        dn_io = "NC" + spatial
+    else:
+        dn_io = "N" + spatial + "C"
+    # paddle transpose-conv weight layout: (in_channels, out_channels//g, *k)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_io, "IO" + spatial, dn_io))
+
+    def _convt(v, w, *maybe_bias):
+        if isinstance(pad, str):
+            padding_lax = pad
+        else:
+            # grad-of-conv padding: k_eff-1-p on each side + output_padding
+            padding_lax = []
+            for i in range(n):
+                k_eff = (w.shape[2 + i] - 1) * dil[i] + 1
+                lo, hi = pad[i]
+                padding_lax.append((k_eff - 1 - lo,
+                                    k_eff - 1 - hi + opad[i]))
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=(1,) * n, padding=padding_lax,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if data_format.startswith("NC"):
+                out = out + b.reshape((1, -1) + (1,) * n)
+            else:
+                out = out + b.reshape((1,) * (n + 1) + (-1,))
+        return out
+
+    def _flip(w):
+        return jnp.flip(w, axis=tuple(range(2, 2 + n)))
+
+    f = lambda v, w, *rest: _convt(v, _flip(w), *rest)
+    if bias is not None:
+        return call_op(f, x, weight, ensure_tensor(bias))
+    return call_op(f, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              3, output_size)

@@ -1,0 +1,393 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+from ...tensor._helpers import ensure_tensor
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ts = [input, label if soft_label else label.detach()]
+    if weight is not None:
+        ts.append(ensure_tensor(weight).detach())
+
+    def _ce(logits, lab, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and
+                          lab.shape == logits.shape):
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + \
+                    label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if maybe_w:
+                w = jnp.sum(soft * maybe_w[0], axis=axis)
+                loss = loss * w
+            return _reduce(loss, reduction)
+        lab_idx = lab
+        if lab_idx.ndim == logits.ndim:
+            lab_idx = jnp.squeeze(lab_idx, axis=axis)
+        lab_idx = lab_idx.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        if label_smoothing > 0:
+            onehot = jax.nn.one_hot(safe, n_classes, axis=axis,
+                                    dtype=logp.dtype)
+            soft = onehot * (1 - label_smoothing) + \
+                label_smoothing / n_classes
+            nll = -jnp.sum(soft * logp, axis=axis)
+        else:
+            nll = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis
+            ).squeeze(axis)
+        nll = jnp.where(valid, nll, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe] * valid.astype(logp.dtype)
+            nll = nll * w
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            cnt = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+            return jnp.sum(nll) / cnt
+        return _reduce(nll, reduction)
+    return call_op(_ce, *ts)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = loss.unsqueeze(axis) if loss.ndim < ensure_tensor(logits).ndim \
+        else loss
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ts = [input, label.detach()]
+    if weight is not None:
+        ts.append(ensure_tensor(weight))
+
+    def _nll(logp, lab, *maybe_w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        ll = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0] \
+            if logp.ndim == 2 else \
+            -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1
+                                 ).squeeze(1)
+        ll = jnp.where(valid, ll, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe] * valid.astype(logp.dtype)
+            ll = ll * w
+            if reduction == "mean":
+                return jnp.sum(ll) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(ll) / jnp.maximum(
+                jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce(ll, reduction)
+    return call_op(_nll, *ts)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                   input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                   input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _sl1(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return call_op(_sl1, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _h(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d,
+                         delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return call_op(_h, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ts = [input, label]
+    if weight is not None:
+        ts.append(ensure_tensor(weight))
+
+    def _bce(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+    return call_op(_bce, *ts)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    ts = [logit, label]
+    if weight is not None:
+        ts.append(ensure_tensor(weight))
+    pw = ensure_tensor(pos_weight)._value if pos_weight is not None else None
+
+    def _bcel(z, y, *maybe_w):
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight folding
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+    return call_op(_bcel, *ts)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _kl(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.clip(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return call_op(_kl, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = (ensure_tensor(input), ensure_tensor(other),
+                           ensure_tensor(label))
+
+    def _mrl(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return call_op(_mrl, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _hel(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+    return call_op(_hel, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = (ensure_tensor(input1), ensure_tensor(input2),
+                             ensure_tensor(label))
+
+    def _cel(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return call_op(_cel, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean",
+                        name=None):
+    input, positive, negative = (ensure_tensor(input),
+                                 ensure_tensor(positive),
+                                 ensure_tensor(negative))
+
+    def _tml(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p),
+                               axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p),
+                               axis=-1), 1 / p)
+        if swap:
+            dpn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon,
+                                              p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dpn)
+        loss = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(loss, reduction)
+    return call_op(_tml, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda p, y: -y * jnp.log(p + epsilon) -
+                   (1 - y) * jnp.log(1 - p + epsilon), input, label)
+
+
+def square_error_cost(input, label):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda a, b: jnp.square(a - b), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    ts = [logit, label]
+    if normalizer is not None:
+        ts.append(ensure_tensor(normalizer))
+
+    def _focal(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce(loss, reduction)
+    return call_op(_focal, *ts)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    # CTC via the standard forward algorithm in log space (lax.scan over T).
+    log_probs, labels = ensure_tensor(log_probs), ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def _ctc(lp, lab, in_len, lab_len):
+        # lp: (T, B, C) paddle layout
+        T, B, C = lp.shape
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        L = 2 * lab_len + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf),
+                                  alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf),
+                                  alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+            new = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m) +
+                              jnp.exp(a2 - m) + 1e-30)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < in_len)[:, None], new, alpha)
+            return alpha, None
+        alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        idx_last = L - 1
+        idx_prev = L - 2
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / lab_len.astype(loss.dtype))
+        return _reduce(loss, reduction)
+    return call_op(_ctc, log_probs, labels, input_lengths.detach(),
+                   label_lengths.detach())
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label·input)) (reference: nn/functional/loss.py)."""
+    def _sm(x, y):
+        # stable softplus form: log(1+exp(-yx)) == -log_sigmoid(yx)
+        return _reduce(-jax.nn.log_sigmoid(y * x), reduction)
+    return call_op(_sm, ensure_tensor(input), ensure_tensor(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Mean over classes of BCE-with-logits against multi-hot labels."""
+    w = ensure_tensor(weight)._value if weight is not None else None
+
+    def _ml(x, y):
+        per = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w is not None:
+            per = per * w
+        return _reduce(-per.mean(-1), reduction)
+    return call_op(_ml, ensure_tensor(input), ensure_tensor(label))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Poisson negative log likelihood (reference: PoissonNLLLoss)."""
+    def _pn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(y!) where y > 1.  Evaluate on
+            # a safe value so y==0 does not produce NaN in the unselected
+            # branch (jnp.where propagates NaN through the gradient).
+            ys = jnp.where(y > 1, y, 2.0)
+            stirling = (ys * jnp.log(ys) - ys
+                        + 0.5 * jnp.log(2 * jnp.pi * ys))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return call_op(_pn, ensure_tensor(input), ensure_tensor(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Gaussian negative log likelihood with predicted variance."""
+    def _gn(x, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(x - y) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, var.dtype))
+        return _reduce(loss, reduction)
+    return call_op(_gn, ensure_tensor(input), ensure_tensor(label),
+                  ensure_tensor(variance))

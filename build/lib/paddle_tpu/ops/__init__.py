@@ -1,0 +1,2 @@
+from . import pallas  # noqa: F401
+from .ring_attention import ring_flash_attention, ulysses_attention  # noqa: F401

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the native-kernel layer answering the reference's
+CUDA kernel library (paddle/phi/kernels/gpu/, fusion/).
+
+Kernels: flash attention (+ring variant for context parallel), fused
+layernorm/rmsnorm, fused optimizer updates.  Each has an XLA-composed
+fallback for CPU tests; dispatch happens at the functional layer.
+"""

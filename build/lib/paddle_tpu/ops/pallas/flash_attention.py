@@ -1,0 +1,354 @@
+"""Flash attention Pallas kernel (TPU).
+
+Reference analogue: paddle/phi/kernels/gpu/flash_attn_kernel.cu (cutlass
+flash-attn submodule).  TPU-native: blockwise online-softmax attention with
+q blocks resident in VMEM, k/v streamed; grid over (batch*heads, q_blocks).
+Layout is paddle's (B, S, H, D).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_LANES = 128   # lse/delta carry a broadcast lane dim (TPU tiling rule)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                  seq_len):
+    # q_ref: (block_q, d); k_ref/v_ref: (seq_len, d); o_ref: (block_q, d)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:] * scale
+    q_idx = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    num_kb = seq_len // block_k
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(i * block_k, block_k), :]
+        v = v_ref[pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_idx = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        # only iterate k blocks up to (and including) this q block
+        last = (pl.program_id(1) * block_q + block_q + block_k - 1) // block_k
+        nkb = jnp.minimum(last, num_kb)
+        acc, m, l = jax.lax.fori_loop(0, nkb, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bhsd(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
+                block_k=DEFAULT_BLOCK_K):
+    """q,k,v: (BH, S, D) — flattened batch*heads."""
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = 1.0 / math.sqrt(D)
+    grid = (BH, S // block_q)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_len=S)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+    )(q, k, v)
+
+
+def _flash_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                      block_k, seq_len):
+    """Forward that also writes log-sum-exp rows (needed by the backward)."""
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:] * scale
+    q_idx = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    num_kb = seq_len // block_k
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(i * block_k, block_k), :]
+        v = v_ref[pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_idx = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        last = (pl.program_id(1) * block_q + block_q + block_k - 1) // block_k
+        nkb = jnp.minimum(last, num_kb)
+        acc, m, l = jax.lax.fori_loop(0, nkb, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    # lse broadcast across a 128-lane dim (TPU block layout requirement)
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, *, scale, causal, block_k, seq_len):
+    """dQ for one q block: dS = P ∘ (dO·Vᵀ − Δ);  dQ = scale · dS·K."""
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    # (block_q, LANES) lane-broadcast rows → tile across k columns
+    lse = jnp.tile(lse_ref[:], (1, block_k // _LANES))
+    delta = jnp.tile(delta_ref[:], (1, block_k // _LANES))
+    q_idx = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    num_kb = seq_len // block_k
+
+    def body(i, dq_acc):
+        k = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_idx = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        p = jnp.exp(s - lse)                        # softmax via saved lse
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq_acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        last = (pl.program_id(1) * block_q + block_q + block_k - 1) // block_k
+        nkb = jnp.minimum(last, num_kb)
+        dq = jax.lax.fori_loop(0, nkb, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, num_kb, body, dq0)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
+    """dK/dV for one kv block: dV = Pᵀ·dO;  dK = scale · dSᵀ·Q."""
+    block_k = k_ref.shape[0]
+    d = k_ref.shape[1]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_idx = pl.program_id(1) * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    num_qb = seq_len // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = jnp.tile(lse_ref[pl.ds(i * block_q, block_q), :],
+                       (1, block_k // _LANES))
+        delta = jnp.tile(delta_ref[pl.ds(i * block_q, block_q), :],
+                         (1, block_k // _LANES))
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_idx = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        p = jnp.exp(s - lse)                        # (block_q, block_k)
+        dv_acc = dv_acc + jnp.dot(p.T, do,
+                                  preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q is pre-scaled by `scale`, so dsᵀ·q == scale · dsᵀ·Q == dK
+        dk_acc = dk_acc + jnp.dot(ds.T, q,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    if causal:
+        # only q blocks at or after this kv block contribute
+        first = (pl.program_id(1) * block_k) // block_q
+        dk, dv = jax.lax.fori_loop(first, num_qb, body, (dk0, dv0))
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "interpret"))
+def _flash_bhsd_fwd_lse(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
+                        block_k=DEFAULT_BLOCK_K, interpret=False):
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = 1.0 / math.sqrt(D)
+    grid = (BH, S // block_q)
+    kernel = functools.partial(_flash_kernel_lse, scale=scale, causal=causal,
+                               block_k=block_k, seq_len=S)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "interpret"))
+def _flash_bhsd_bwd(q, k, v, o, lse, do, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = 1.0 / math.sqrt(D)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                       # (BH, S)
+    lse_l = jnp.broadcast_to(lse[..., None], (BH, S, _LANES))
+    delta_l = jnp.broadcast_to(delta[..., None], (BH, S, _LANES))
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=S),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse_l, delta_l)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=S),
+        grid=(BH, S // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, _LANES), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, _LANES), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(k, v, q, do, lse_l, delta_l)
+    return dq, dk, dv
+
+
+def _to_bhsd(x):
+    B, S, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+
+def _from_bhsd(x, B, H):
+    BH, S, D = x.shape
+    return jnp.swapaxes(x.reshape(B, H, S, D), 1, 2)
+
+
+def flash_attention_fwd(q, k, v, causal=False):
+    """(B, S, H, D) in/out — paddle layout; supports MQA/GQA (H_kv divides
+    H) by repeating kv heads.  No-grad path: uses the LSE-less kernel so
+    inference pays nothing for backward residuals."""
+    B, S, H, D = q.shape
+    Hk = k.shape[2]
+    if Hk != H:
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    of = _flash_bhsd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), causal=causal)
+    return _from_bhsd(of, B, H)
+
+
+def flash_attention_fwd_lse(q, k, v, causal=False, interpret=False):
+    """Forward returning (o [B,S,H,D], lse [B*H,S]) for the flash bwd."""
+    B, S, H, D = q.shape
+    Hk = k.shape[2]
+    if Hk != H:
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    of, lse = _flash_bhsd_fwd_lse(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                                  causal=causal, interpret=interpret)
+    return _from_bhsd(of, B, H), lse[..., 0]
+
+
+def flash_attention_bwd(q, k, v, o, lse, do, causal=False, interpret=False):
+    """Pallas flash backward — returns (dq, dk, dv) in (B, S, H, D);
+    GQA kv grads are summed back over the repeated query-head groups."""
+    B, S, H, D = q.shape
+    Hk = k.shape[2]
+    if Hk != H:
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    dqf, dkf, dvf = _flash_bhsd_bwd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(o), lse,
+        _to_bhsd(do), causal=causal, interpret=interpret)
+    dq = _from_bhsd(dqf, B, H)
+    dk = _from_bhsd(dkf, B, H)
+    dv = _from_bhsd(dvf, B, H)
+    if Hk != H:
+        rep = H // Hk
+        dk = dk.reshape(B, S, Hk, rep, D).sum(3)
+        dv = dv.reshape(B, S, Hk, rep, D).sum(3)
+    return dq, dk, dv

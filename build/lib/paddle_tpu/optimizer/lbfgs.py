@@ -1,0 +1,300 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py).
+
+TPU-native notes: L-BFGS is inherently sequential and host-driven (the line
+search re-evaluates the closure a data-dependent number of times), so the
+driver loop lives in Python while every closure evaluation is itself an
+eager/jitted device computation.  History vectors are kept as flat jnp
+arrays on device; the two-loop recursion is a handful of dots/axpys that
+XLA fuses per call.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import no_grad
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2)."""
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square ** 0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with optional strong-Wolfe line search.
+
+    ``step(closure)`` — closure clears grads, computes loss, runs backward,
+    returns the loss Tensor.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("only 'strong_wolfe' line search is supported")
+        self.line_search_fn = line_search_fn
+        self._lbfgs_state = {}
+
+    # -- flat param/grad helpers -------------------------------------------
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("LBFGS requires an explicit parameters list")
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _gather_flat_grad(self):
+        views = []
+        for p in self._params():
+            g = p.grad
+            if g is None:
+                views.append(jnp.zeros(p._value.size, p._value.dtype))
+            else:
+                gv = g._value if isinstance(g, Tensor) else g
+                views.append(gv.reshape(-1))
+        return jnp.concatenate(views)
+
+    def _add_grad(self, step_size, update):
+        offset = 0
+        with no_grad():
+            for p in self._params():
+                numel = p._value.size
+                chunk = update[offset:offset + numel].reshape(p._value.shape)
+                p._value = p._value + step_size * chunk.astype(p._value.dtype)
+                offset += numel
+
+    def _clone_param(self):
+        return [p._value for p in self._params()]
+
+    def _set_param(self, params_data):
+        for p, pdata in zip(self._params(), params_data):
+            p._value = pdata
+
+    def _directional_evaluate(self, closure, x, t, d):
+        self._add_grad(t, d)
+        loss = float(closure()._value)
+        flat_grad = self._gather_flat_grad()
+        self._set_param(x)
+        return loss, flat_grad
+
+    # -- strong Wolfe line search ------------------------------------------
+    def _strong_wolfe(self, closure, x, t, d, f, g, gtd,
+                      c1=1e-4, c2=0.9, tolerance_change=1e-9, max_ls=25):
+        d_norm = float(jnp.abs(d).max())
+        f_new, g_new = self._directional_evaluate(closure, x, t, d)
+        ls_func_evals = 1
+        gtd_new = float(jnp.dot(g_new, d))
+
+        t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+        done = False
+        ls_iter = 0
+        bracket = bracket_f = bracket_g = bracket_gtd = None
+        while ls_iter < max_ls:
+            if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new]
+                bracket_gtd = [gtd_prev, gtd_new]
+                break
+            if abs(gtd_new) <= -c2 * gtd:
+                bracket = [t, t]
+                bracket_f = [f_new, f_new]
+                bracket_g = [g_new, g_new]
+                done = True
+                break
+            if gtd_new >= 0:
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new]
+                bracket_gtd = [gtd_prev, gtd_new]
+                break
+            min_step = t + 0.01 * (t - t_prev)
+            max_step = t * 10
+            tmp = t
+            t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new, gtd_new,
+                                   bounds=(min_step, max_step))
+            t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, g_new, gtd_new
+            f_new, g_new = self._directional_evaluate(closure, x, t, d)
+            ls_func_evals += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            ls_iter += 1
+        if ls_iter == max_ls:
+            bracket = [0.0, t]
+            bracket_f = [f, f_new]
+            bracket_g = [g, g_new]
+            bracket_gtd = [gtd, gtd_new]
+
+        # zoom phase
+        insuf_progress = False
+        low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
+        while not done and ls_iter < max_ls:
+            if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+                break
+            t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
+                                   bracket[1], bracket_f[1], bracket_gtd[1])
+            eps = 0.1 * (max(bracket) - min(bracket))
+            if min(max(bracket) - t, t - min(bracket)) < eps:
+                if insuf_progress or t >= max(bracket) or t <= min(bracket):
+                    if abs(t - max(bracket)) < abs(t - min(bracket)):
+                        t = max(bracket) - eps
+                    else:
+                        t = min(bracket) + eps
+                    insuf_progress = False
+                else:
+                    insuf_progress = True
+            else:
+                insuf_progress = False
+            f_new, g_new = self._directional_evaluate(closure, x, t, d)
+            ls_func_evals += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            ls_iter += 1
+            if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+                bracket[high_pos] = t
+                bracket_f[high_pos] = f_new
+                bracket_g[high_pos] = g_new
+                bracket_gtd[high_pos] = gtd_new
+                low_pos, high_pos = ((0, 1) if bracket_f[0] <= bracket_f[1]
+                                     else (1, 0))
+            else:
+                if abs(gtd_new) <= -c2 * gtd:
+                    done = True
+                elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
+                    bracket[high_pos] = bracket[low_pos]
+                    bracket_f[high_pos] = bracket_f[low_pos]
+                    bracket_g[high_pos] = bracket_g[low_pos]
+                    bracket_gtd[high_pos] = bracket_gtd[low_pos]
+                bracket[low_pos] = t
+                bracket_f[low_pos] = f_new
+                bracket_g[low_pos] = g_new
+                bracket_gtd[low_pos] = gtd_new
+
+        t = bracket[low_pos]
+        f_new = bracket_f[low_pos]
+        g_new = bracket_g[low_pos]
+        return f_new, g_new, t, ls_func_evals
+
+    # -- main ---------------------------------------------------------------
+    def step(self, closure):
+        state = self._lbfgs_state
+        state.setdefault("func_evals", 0)
+        state.setdefault("n_iter", 0)
+
+        orig_loss = closure()
+        loss = float(orig_loss._value)
+        current_evals = 1
+        state["func_evals"] += 1
+
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+            return orig_loss
+
+        d = state.get("d")
+        t = state.get("t")
+        old_dirs = state.get("old_dirs", [])
+        old_stps = state.get("old_stps", [])
+        ro = state.get("ro", [])
+        H_diag = state.get("H_diag")
+        prev_flat_grad = state.get("prev_flat_grad")
+        prev_loss = state.get("prev_loss")
+
+        n_iter = 0
+        lr = self.get_lr()
+        while n_iter < self.max_iter:
+            n_iter += 1
+            state["n_iter"] += 1
+            if state["n_iter"] == 1:
+                d = -flat_grad
+                old_dirs, old_stps, ro = [], [], []
+                H_diag = 1.0
+            else:
+                y = flat_grad - prev_flat_grad
+                s = d * t
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    if len(old_dirs) == self.history_size:
+                        old_dirs.pop(0)
+                        old_stps.pop(0)
+                        ro.pop(0)
+                    old_dirs.append(y)
+                    old_stps.append(s)
+                    ro.append(1.0 / ys)
+                    H_diag = ys / float(jnp.dot(y, y))
+                num_old = len(old_dirs)
+                al = [None] * num_old
+                q = -flat_grad
+                for i in range(num_old - 1, -1, -1):
+                    al[i] = float(jnp.dot(old_stps[i], q)) * ro[i]
+                    q = q - al[i] * old_dirs[i]
+                d = q * H_diag
+                for i in range(num_old):
+                    be_i = float(jnp.dot(old_dirs[i], d)) * ro[i]
+                    d = d + old_stps[i] * (al[i] - be_i)
+            prev_flat_grad = flat_grad
+            prev_loss = loss
+
+            if state["n_iter"] == 1:
+                t = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr
+            else:
+                t = lr
+
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self.tolerance_change:
+                break
+
+            ls_func_evals = 0
+            if self.line_search_fn == "strong_wolfe":
+                x_init = self._clone_param()
+                loss, flat_grad, t, ls_func_evals = self._strong_wolfe(
+                    closure, x_init, t, d, loss, flat_grad, gtd)
+                self._add_grad(t, d)
+            else:
+                self._add_grad(t, d)
+                if n_iter != self.max_iter:
+                    loss = float(closure()._value)
+                    flat_grad = self._gather_flat_grad()
+                    ls_func_evals = 1
+            current_evals += ls_func_evals
+            state["func_evals"] += ls_func_evals
+
+            if n_iter == self.max_iter or current_evals >= self.max_eval:
+                break
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            if float(jnp.abs(d * t).max()) <= self.tolerance_change:
+                break
+            if abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        state.update(dict(d=d, t=t, old_dirs=old_dirs, old_stps=old_stps,
+                          ro=ro, H_diag=H_diag, prev_flat_grad=prev_flat_grad,
+                          prev_loss=prev_loss))
+        return orig_loss
+
+    def state_dict(self):
+        return {"lbfgs_state": self._lbfgs_state}
+
+    def set_state_dict(self, state_dict):
+        self._lbfgs_state = state_dict.get("lbfgs_state", {})

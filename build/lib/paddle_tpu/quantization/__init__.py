@@ -1,0 +1,555 @@
+"""paddle.quantization — QAT / PTQ (reference: python/paddle/quantization/
+{config,qat,ptq}.py, observers in python/paddle/quantization/observers/,
+quanters in .../quanters/, quantized layers in python/paddle/nn/quant/).
+
+TPU-native design: fake-quantization is simulated in float with the
+straight-through estimator expressed as ``x + stop_gradient(dq(q(x)) - x)``
+— pure vector ops that XLA fuses into the surrounding matmul, no custom
+kernels.  ``convert`` produces layers holding real int8 weights + scales
+whose matmul runs ``lax.dot_general`` with int8 inputs and int32
+accumulation (the MXU's native int8 path), dequantizing the fp32 result.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..nn.layer.layers import Layer
+from .. import nn as _nn
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "quanters", "observers",
+    "BaseQuanter", "BaseObserver", "quant_linear",
+    "QuantedLinear", "QuantedConv2D", "LinearQuanterDequanter",
+]
+
+
+def _fake_quant(v, scale, bit_length=8):
+    """Symmetric fake quant with STE (values stay float)."""
+    bnd = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(v / s * bnd), -bnd - 1, bnd)
+    dq = q * s / bnd
+    return v + jax.lax.stop_gradient(dq - v)
+
+
+# -- observers (PTQ: collect statistics, no gradient) -------------------------
+
+class BaseObserver(Layer):
+    """Collects activation statistics during calibration forward passes."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def forward(self, x):
+        self._observe(np.asarray(x._value))
+        return x
+
+    def _observe(self, arr):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (reference:
+    python/paddle/quantization/observers/abs_max.py)."""
+
+    def _observe(self, arr):
+        m = float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class AVGObserver(BaseObserver):
+    """Average of per-batch abs-max (reference: observers/avg.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._sum = 0.0
+        self._count = 0
+
+    def _observe(self, arr):
+        self._sum += float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._count += 1
+        self._scale = self._sum / max(self._count, 1)
+
+
+class EMDObserver(BaseObserver):
+    """Scale minimizing earth-mover-ish |x| percentile (simplified to the
+    99.99 percentile of |x|, the common PTQ clip heuristic)."""
+
+    def _observe(self, arr):
+        if arr.size == 0:
+            return
+        m = float(np.percentile(np.abs(arr), 99.99))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class HistObserver(BaseObserver):
+    """Histogram-based observer: accumulates |x| histogram, picks the scale
+    covering `percent` of mass (reference: observers/hist.py)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self._bins = bins_count
+        self._percent = percent
+        self._hist = None
+        self._max = 0.0
+
+    def _observe(self, arr):
+        if arr.size == 0:
+            return
+        a = np.abs(arr).ravel()
+        amax = float(a.max())
+        if self._hist is None:
+            self._max = max(amax, 1e-9)
+            self._hist, _ = np.histogram(a, bins=self._bins,
+                                         range=(0, self._max))
+        else:
+            if amax > self._max:
+                # re-bin old histogram onto the wider range: old bin i
+                # (center (i+0.5)/bins*old_max) lands at new bin
+                # (i+0.5)*old_max/new_max
+                ratio = self._max / amax
+                old = self._hist.astype(np.float64)
+                new_hist = np.zeros_like(old)
+                dst = np.minimum(((np.arange(self._bins) + 0.5) * ratio)
+                                 .astype(int), self._bins - 1)
+                np.add.at(new_hist, dst, old)
+                self._hist = new_hist
+                self._max = amax
+            h, _ = np.histogram(a, bins=self._bins, range=(0, self._max))
+            self._hist = self._hist + h
+        c = np.cumsum(self._hist)
+        total = c[-1]
+        idx = int(np.searchsorted(c, self._percent * total))
+        self._scale = (idx + 1) / self._bins * self._max
+
+
+class KLObserver(HistObserver):
+    """KL-divergence calibration (simplified: percentile fallback keeps the
+    same interface; full KL search over thresholds)."""
+
+    def __init__(self, quant_bits=8, bins_count=1024):
+        super().__init__(quant_bits, bins_count, percent=0.999)
+
+
+# -- quanters (QAT: fake-quant in the forward, STE gradient) ------------------
+
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average abs-max fake quanter (reference:
+    python/paddle/quantization/quanters/abs_max.py
+    FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = bit_length
+        self._state = 1.0
+        self._accum = 1.0
+        self._scale_value = None
+
+    def scales(self):
+        return self._scale_value
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._value)))
+            r = self._moving_rate
+            self._state = r * self._state + 1.0
+            self._accum = r * self._accum + cur
+            self._scale_value = self._accum / self._state
+        scale = self._scale_value if self._scale_value is not None else \
+            float(jnp.max(jnp.abs(x._value)))
+        bits = self._quant_bits
+        return call_op(lambda v: _fake_quant(v, scale, bits), x)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    """Per-output-channel abs-max fake quanter for weights (reference:
+    quanters/abs_max_headless.py / channel-wise variant)."""
+
+    def __init__(self, bit_length=8, quant_axis=0, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._quant_bits = bit_length
+        self._quant_axis = quant_axis
+        self._scale_value = None
+
+    def quant_axis(self):
+        return self._quant_axis
+
+    def scales(self):
+        return self._scale_value
+
+    def forward(self, x):
+        axis = self._quant_axis
+        ndim = len(x.shape)
+        red = tuple(i for i in range(ndim) if i != axis)
+        scale = jnp.max(jnp.abs(x._value), axis=red, keepdims=True)
+        self._scale_value = np.asarray(scale).reshape(-1)
+        bits = self._quant_bits
+
+        def impl(v):
+            return _fake_quant(v, scale, bits)
+        return call_op(impl, x)
+
+
+class quanters:
+    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+    FakeQuanterChannelWiseAbsMaxObserver = \
+        FakeQuanterChannelWiseAbsMaxObserver
+
+
+class observers:
+    AbsmaxObserver = AbsmaxObserver
+    AVGObserver = AVGObserver
+    EMDObserver = EMDObserver
+    HistObserver = HistObserver
+    KLObserver = KLObserver
+
+
+# -- config -------------------------------------------------------------------
+
+class _SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Which layers get which quanter/observer (reference:
+    python/paddle/quantization/config.py)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = _SingleLayerConfig(activation, weight)
+        self._layer_configs = []   # (predicate, config)
+        if not _DEFAULT_QAT_MAPPING:
+            _init_default_mapping()
+        self._qat_mapping = dict(_DEFAULT_QAT_MAPPING)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        ids = {id(l) for l in layers}
+        self._layer_configs.append(
+            (lambda l, _ids=ids: id(l) in _ids,
+             _SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = tuple(layer_type if isinstance(layer_type, (list, tuple))
+                      else [layer_type])
+        self._layer_configs.append(
+            (lambda l, _t=types: type(l) in _t,
+             _SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = set(layer_name if isinstance(layer_name, (list, tuple))
+                    else [layer_name])
+        self._layer_configs.append(
+            (lambda l, _n=names: getattr(l, "_full_name", None) in _n,
+             _SingleLayerConfig(activation, weight)))
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_mapping[source] = target
+
+    def _config_for(self, layer):
+        for pred, cfg in self._layer_configs:
+            if pred(layer):
+                return cfg
+        if self._global.activation is not None or \
+                self._global.weight is not None:
+            return self._global
+        return None
+
+    def _instantiate(self, factory):
+        if factory is None:
+            return None
+        return factory() if callable(factory) and not isinstance(
+            factory, Layer) else factory
+
+
+# -- quantized layers ---------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on input activations + weight (QAT)
+    (reference: python/paddle/nn/quant/qat/linear.py)."""
+
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = q_config.activation
+        self.weight_quanter = q_config.weight
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        out = call_op(lambda xv, wv: xv @ wv, x, w)
+        if self.bias is not None:
+            out = call_op(lambda o, b: o + b, out, self.bias)
+        return out
+
+
+class QuantedConv2D(Layer):
+    """Conv2D (NCHW, matching the dense layer) with fake-quant on
+    activations + weight."""
+
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._layer = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = q_config.activation
+        self.weight_quanter = q_config.weight
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        orig_w = self._layer.weight
+        if self.weight_quanter is not None:
+            self._layer.weight = self.weight_quanter(orig_w)
+        try:
+            out = self._layer(x)
+        finally:
+            self._layer.weight = orig_w
+        return out
+
+
+_DEFAULT_QAT_MAPPING = {}
+
+
+def _init_default_mapping():
+    _DEFAULT_QAT_MAPPING[_nn.Linear] = QuantedLinear
+    _DEFAULT_QAT_MAPPING[_nn.Conv2D] = QuantedConv2D
+
+
+# -- converted (deploy) layers ------------------------------------------------
+
+class LinearQuanterDequanter(Layer):
+    """Standalone quant→dequant stub left in converted graphs (reference:
+    python/paddle/nn/quant/format.py)."""
+
+    def __init__(self, scale, bit_length=8):
+        super().__init__()
+        self._scale = float(scale)
+        self._bits = bit_length
+
+    def forward(self, x):
+        s, b = self._scale, self._bits
+        return call_op(lambda v: _fake_quant(v, s, b), x)
+
+
+class ConvertedQuantedConv2D(Layer):
+    """Deploy-form conv: weight fake-quant baked into static values and a
+    frozen activation quant-dequant stub — no live observers, deterministic
+    inference."""
+
+    def __init__(self, inner, act_scale=None, bit_length=8):
+        super().__init__()
+        self._inner = inner
+        self._act = (LinearQuanterDequanter(act_scale, bit_length)
+                     if act_scale is not None else None)
+
+    def forward(self, x):
+        if self._act is not None:
+            x = self._act(x)
+        return self._inner(x)
+
+
+class ConvertedQuantedLinear(Layer):
+    """Deploy-form linear: int8 weights + per-channel scales; matmul runs
+    on the MXU's int8 path via dot_general(int8, int8)→int32 when the
+    activation scale is known, else weight-only dequant."""
+
+    def __init__(self, int_weight, w_scale, bias, act_scale=None,
+                 bit_length=8):
+        super().__init__()
+        self.w_int = jnp.asarray(int_weight, jnp.int8)
+        self.w_scale = jnp.asarray(w_scale)      # [out]
+        self.bias = bias
+        self.act_scale = act_scale
+        self._bnd = float(2 ** (bit_length - 1) - 1)
+
+    def forward(self, x):
+        w_int, w_scale, bnd = self.w_int, self.w_scale, self._bnd
+        if self.act_scale is not None:
+            a_s = float(self.act_scale)
+
+            def impl(xv):
+                xq = jnp.clip(jnp.round(xv / a_s * bnd), -bnd - 1, bnd) \
+                    .astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    xq, w_int, (((xq.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return acc.astype(jnp.float32) * (a_s / bnd) * \
+                    (w_scale / bnd)
+        else:
+            def impl(xv):
+                w = w_int.astype(xv.dtype) * (w_scale / bnd)
+                return xv @ w
+        out = call_op(impl, x)
+        if self.bias is not None:
+            out = call_op(lambda o, b: o + b, out, self.bias)
+        return out
+
+
+# -- QAT / PTQ drivers --------------------------------------------------------
+
+def _swap_layers(model, config, wrap):
+    for name, sub in list(model._sub_layers.items()):
+        new = wrap(sub)
+        if new is not None:
+            model._sub_layers[name] = new
+        else:
+            _swap_layers(sub, config, wrap)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference:
+    python/paddle/quantization/qat.py)."""
+
+    def __init__(self, config):
+        if not _DEFAULT_QAT_MAPPING:
+            _init_default_mapping()
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not _DEFAULT_QAT_MAPPING:
+            _init_default_mapping()
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            target = self._config._qat_mapping.get(type(layer))
+            if target is None:
+                return None
+            cfg = self._config._config_for(layer)
+            if cfg is None:
+                return None
+            inst = _SingleLayerConfig(
+                self._config._instantiate(cfg.activation),
+                self._config._instantiate(cfg.weight))
+            return target(layer, inst)
+        return _swap_layers(model, self._config, wrap)
+
+    def convert(self, model, inplace=False):
+        """QAT → deploy: bake learned scales into int8 weights."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            if isinstance(layer, QuantedLinear):
+                w = np.asarray(layer.weight._value)
+                wq = layer.weight_quanter
+                bits = wq.bit_length() if wq is not None else 8
+                bnd = 2 ** (bits - 1) - 1
+                if wq is not None and wq.scales() is not None:
+                    scales = np.asarray(wq.scales())
+                    if scales.ndim == 0 or scales.size == 1:
+                        s = np.broadcast_to(np.reshape(scales, (1,)),
+                                            (w.shape[1],)).copy()
+                    elif wq.quant_axis() == 1 and \
+                            scales.size == w.shape[1]:
+                        s = scales.reshape(-1)
+                    else:
+                        # quanter axis is not the output dim ([in, out]
+                        # weights need per-column scales for int8 deploy) —
+                        # re-derive per-output-channel scales
+                        s = np.max(np.abs(w), axis=0)
+                else:
+                    s = np.max(np.abs(w), axis=0)
+                s = np.maximum(s, 1e-9)
+                w_int = np.clip(np.round(w / s * bnd), -bnd - 1, bnd) \
+                    .astype(np.int8)
+                aq = layer.activation_quanter
+                act_scale = aq.scales() if aq is not None else None
+                return ConvertedQuantedLinear(w_int, s.astype(np.float32),
+                                              layer.bias, act_scale, bits)
+            if isinstance(layer, QuantedConv2D):
+                inner = layer._layer
+                wq = layer.weight_quanter
+                bits = wq.bit_length() if wq is not None else 8
+                if wq is not None:
+                    # bake the weight fake-quant statically (frozen scales)
+                    inner.weight = Tensor(
+                        wq(inner.weight)._value, stop_gradient=True)
+                aq = layer.activation_quanter
+                act_scale = aq.scales() if aq is not None else None
+                return ConvertedQuantedConv2D(inner, act_scale, bits)
+            return None
+        return _swap_layers(model, self._config, wrap)
+
+
+class PTQ:
+    """Post-training quantization driver (reference:
+    python/paddle/quantization/ptq.py): insert observers, calibrate by
+    running forwards, then convert."""
+
+    def __init__(self, config):
+        if not _DEFAULT_QAT_MAPPING:
+            _init_default_mapping()
+        self._config = config
+        self._observed = []
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            if not isinstance(layer, (_nn.Linear, _nn.Conv2D)):
+                return None
+            cfg = self._config._config_for(layer)
+            if cfg is None:
+                return None
+            inst = _SingleLayerConfig(
+                self._config._instantiate(cfg.activation),
+                self._config._instantiate(cfg.weight))
+            target = QuantedLinear if isinstance(layer, _nn.Linear) \
+                else QuantedConv2D
+            q = target(layer, inst)
+            self._observed.append(q)
+            return q
+        return _swap_layers(model, self._config, wrap)
+
+    def convert(self, model, inplace=False):
+        # observers/quanters on `model` carry the calibrated scales; convert
+        # in place on the caller-held quantized model unless asked otherwise
+        return QAT(self._config).convert(model, inplace)
+
+
+def quant_linear(x, weight, scale, bias=None, bit_length=8):
+    """Functional fake-quant linear used by custom layers."""
+    xq = call_op(lambda v: _fake_quant(v, scale, bit_length), x)
+    out = call_op(lambda a, w: a @ w, xq, weight)
+    if bias is not None:
+        out = call_op(lambda o, b: o + b, out, bias)
+    return out

@@ -1,0 +1,6 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py) —
+L1Decay/L2Decay weight-decay policies consumed by the optimizers'
+``weight_decay`` argument."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

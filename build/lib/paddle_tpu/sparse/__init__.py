@@ -1,0 +1,555 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops (reference:
+python/paddle/sparse/, C++ kernels paddle/phi/kernels/sparse/{cpu,gpu}/,
+core types paddle/phi/core/sparse_coo_tensor.h / sparse_csr_tensor.h).
+
+TPU-native design: XLA has no native sparse formats, and TPU performance
+comes from static shapes + gather/segment_sum, so a sparse tensor here is a
+pair of dense jnp arrays — ``indices``/``values`` (COO) or
+``crows``/``cols``/``values`` (CSR) — with a **static nnz**.  Elementwise
+ops run on the values array only; spmm is gather-rows + multiply +
+``segment_sum`` (deterministic, fuses well); conversions are scatter/sort.
+``values`` is carried as a framework Tensor so every sparse op records on
+the eager tape and gradients flow to the nonzeros exactly like the
+reference's sparse grad kernels.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..framework import dtypes
+from ..tensor._helpers import ensure_tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "addmm", "transpose", "reshape", "sum", "coalesce", "to_dense",
+    "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "expm1", "neg", "pow", "cast", "scale",
+    "rad2deg", "deg2rad", "relu", "relu6", "leaky_relu", "softmax",
+]
+
+
+def _as_value(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` [sparse_ndim, nnz] int32 + ``values``
+    [nnz, *dense_dims].  nnz is static (XLA requirement)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = jnp.asarray(_as_value(indices), jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- paddle Tensor-protocol surface ------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def sparse_dim(self):
+        return int(self._indices.shape[0])
+
+    def dense_dim(self):
+        return len(self._shape) - self.sparse_dim()
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def is_coalesced(self):
+        return self._coalesced
+
+    def astype(self, dtype):
+        return SparseCooTensor(self._indices, self._values.astype(dtype),
+                               self._shape, self._coalesced)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def backward(self, *a, **k):
+        raise RuntimeError("call backward() on a dense result, not on the "
+                           "sparse tensor itself")
+
+    # -- conversions --------------------------------------------------------
+    def to_dense(self):
+        idx, shape = self._indices, self._shape
+        sd = self.sparse_dim()
+
+        def impl(vals):
+            flat_shape = (int(np.prod(shape[:sd])),) + tuple(shape[sd:])
+            strides = np.cumprod([1] + list(shape[:sd][::-1]))[::-1][1:]
+            strides = jnp.asarray(np.asarray(strides, np.int32))
+            flat_idx = (idx * strides[:, None]).sum(0)
+            out = jnp.zeros(flat_shape, vals.dtype)
+            out = out.at[flat_idx].add(vals)
+            return out.reshape(shape)
+        return call_op(impl, self._values)
+
+    def to_sparse_csr(self):
+        if self.sparse_dim() != 2 or self.dense_dim() != 0:
+            raise ValueError("to_sparse_csr requires a 2-D COO tensor")
+        coo = self.coalesce()
+        rows, cols = coo._indices[0], coo._indices[1]
+        nrows = self._shape[0]
+        crows = jnp.zeros(nrows + 1, jnp.int32).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows).astype(jnp.int32)
+        return SparseCsrTensor(crows, cols, coo._values, self._shape)
+
+    def coalesce(self):
+        """Sort indices, sum duplicates.  nnz stays static: duplicates are
+        summed into their first slot and the freed slots keep the sorted
+        order with zero values (semantically identical downstream)."""
+        if self._coalesced:
+            return self
+        idx, shape = self._indices, self._shape
+        sd = self.sparse_dim()
+        strides = np.cumprod([1] + list(shape[:sd][::-1]))[::-1][1:]
+        strides = jnp.asarray(np.asarray(strides, np.int32))
+        flat = (idx * strides[:, None]).sum(0)
+        order = jnp.argsort(flat)
+        flat_sorted = flat[order]
+        # unique-by-first-occurrence segment ids over the sorted keys
+        is_new = jnp.concatenate([jnp.ones(1, jnp.int32),
+                                  (flat_sorted[1:] != flat_sorted[:-1])
+                                  .astype(jnp.int32)])
+        seg = jnp.cumsum(is_new) - 1
+        new_idx = idx[:, order]
+        # scatter each sorted entry's index to its segment slot; slots freed
+        # by duplicate-merging retain a duplicate's coordinates with value 0
+        # (valid position, zero contribution)
+        slot_idx = new_idx.at[:, seg].set(new_idx)
+
+        def impl(vals):
+            v_sorted = vals[order]
+            out = jnp.zeros_like(v_sorted)
+            return out.at[seg].add(v_sorted)
+        new_vals = call_op(impl, self._values)
+        return SparseCooTensor(slot_idx, new_vals, self._shape, coalesced=True)
+
+    def transpose(self, perm):
+        sd = self.sparse_dim()
+        if sorted(perm) != list(range(len(self._shape))):
+            raise ValueError(f"perm {perm} is not a permutation of dims")
+        if any(p >= sd for p in perm[:sd]):
+            raise ValueError("transpose across sparse/dense boundary is not "
+                             "supported")
+        new_idx = self._indices[jnp.asarray(perm[:sd])]
+        new_shape = tuple(self._shape[p] for p in perm)
+        dense_perm = [0] + [p - sd + 1 for p in perm[sd:]]
+        new_vals = (self._values if len(dense_perm) == 1 else
+                    call_op(lambda v: jnp.transpose(v, dense_perm),
+                            self._values))
+        return SparseCooTensor(new_idx, new_vals, new_shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # elementwise operator sugar
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: ``crows`` [nrows+1], ``cols`` [nnz], ``values``
+    [nnz] (2-D only, optionally batched as [batch, ...] per reference)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_as_value(crows), jnp.int32)
+        self._cols = jnp.asarray(_as_value(cols), jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D shapes")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return 2
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_ids(self):
+        # static expansion of crows → per-nnz row index
+        nnz = self.nnz()
+        positions = jnp.arange(nnz, dtype=jnp.int32)
+        return (jnp.searchsorted(self._crows, positions, side="right")
+                .astype(jnp.int32) - 1)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        if sparse_dim != 2:
+            raise ValueError("CSR→COO only supports sparse_dim=2")
+        idx = jnp.stack([self._row_ids(), self._cols])
+        return SparseCooTensor(idx, self._values, self._shape, coalesced=True)
+
+    def to_dense(self):
+        rows, cols, shape = self._row_ids(), self._cols, self._shape
+
+        def impl(vals):
+            out = jnp.zeros(shape, vals.dtype)
+            return out.at[rows, cols].add(vals)
+        return call_op(impl, self._values)
+
+    def astype(self, dtype):
+        return SparseCsrTensor(self._crows, self._cols,
+                               self._values.astype(dtype), self._shape)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._value)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+# -- creation ----------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor (reference:
+    python/paddle/sparse/creation.py)."""
+    idx = jnp.asarray(_as_value(indices), jnp.int32)
+    vals = ensure_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        sparse_shape = tuple(int(s) for s in
+                             np.asarray(jnp.max(idx, axis=1)) + 1)
+        shape = sparse_shape + tuple(vals._value.shape[1:])
+    vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = ensure_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def coalesce(x):
+    return x.coalesce()
+
+
+def transpose(x, perm):
+    return x.transpose(perm)
+
+
+def reshape(x, shape):
+    """Reshape over the sparse dims: recompute flat indices (dense-dim
+    reshape is not supported, matching the common case)."""
+    if not isinstance(x, SparseCooTensor) or x.dense_dim() != 0:
+        raise ValueError("sparse.reshape supports pure COO tensors")
+    old_shape = x._shape
+    shape = [int(s) for s in shape]
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = int(np.prod(old_shape)) // known
+    shape = tuple(shape)
+    strides_old = np.cumprod([1] + list(old_shape[::-1]))[::-1][1:]
+    flat = (x._indices * jnp.asarray(strides_old, jnp.int32)[:, None]).sum(0)
+    strides_new = np.cumprod([1] + list(shape[::-1]))[::-1][1:]
+    new_idx = jnp.stack([(flat // int(s)) % int(d)
+                         for s, d in zip(strides_new, shape)])
+    return SparseCooTensor(new_idx.astype(jnp.int32), x._values, shape)
+
+
+# -- elementwise --------------------------------------------------------------
+
+def _unary(fn):
+    def op(x, name=None):
+        new_vals = call_op(fn, x._values)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, new_vals, x._shape,
+                                   x._coalesced)
+        return SparseCsrTensor(x._crows, x._cols, new_vals, x._shape)
+    return op
+
+
+abs = _unary(jnp.abs)
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+neg = _unary(jnp.negative)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+relu = _unary(jax.nn.relu)
+relu6 = _unary(lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(lambda v: jnp.where(v >= 0, v, v * negative_slope))(x)
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def scale(x, scale_, bias=0.0, bias_after_scale=True, name=None):
+    if bias_after_scale:
+        return _unary(lambda v: v * scale_ + bias)(x)
+    return _unary(lambda v: (v + bias) * scale_)(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    out = x
+    if value_dtype is not None:
+        out = out.astype(value_dtype)
+    if index_dtype is not None:
+        jd = dtypes.convert_dtype(index_dtype)
+        if isinstance(out, SparseCooTensor):
+            out = SparseCooTensor(out._indices.astype(jd), out._values,
+                                  out._shape, out._coalesced)
+        else:
+            out = SparseCsrTensor(out._crows.astype(jd),
+                                  out._cols.astype(jd), out._values,
+                                  out._shape)
+    return out
+
+
+def _binary(fn):
+    """sparse∘sparse with identical sparsity pattern (the reference's
+    supported fast path), or sparse∘scalar."""
+    def op(x, y, name=None):
+        if isinstance(y, (int, float)):
+            return _unary(lambda v: fn(v, y))(x)
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            xc, yc = x.coalesce(), y.coalesce()
+            if xc.nnz() == yc.nnz() and bool(
+                    jnp.array_equal(xc._indices, yc._indices)):
+                new_vals = call_op(fn, xc._values, yc._values)
+                return SparseCooTensor(xc._indices, new_vals, xc._shape,
+                                       coalesced=True)
+            # differing patterns: fall back to dense (documented)
+            return fn_dense(x, y, fn)
+        if isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor):
+            if x.nnz() == y.nnz() and bool(
+                    jnp.array_equal(x._crows, y._crows)) and bool(
+                    jnp.array_equal(x._cols, y._cols)):
+                new_vals = call_op(fn, x._values, y._values)
+                return SparseCsrTensor(x._crows, x._cols, new_vals, x._shape)
+            return fn_dense(x, y, fn)
+        raise TypeError("sparse binary ops require two sparse tensors of the "
+                        "same format")
+    return op
+
+
+def fn_dense(x, y, fn):
+    dx, dy = x.to_dense(), y.to_dense()
+    dense = call_op(fn, dx, dy)
+    # keep result dense — caller may re-sparsify explicitly
+    return dense
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    if axis is None:
+        out = call_op(lambda v: jnp.sum(v), x._values)
+    else:
+        out = call_op(lambda v: jnp.sum(v, axis=axis, keepdims=keepdim),
+                      x.to_dense())
+    if dtype is not None:
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return out
+
+
+# -- matmul family ------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense (spmm).  TPU-native: gather the dense rows at
+    the column indices, scale by values, segment-sum into output rows —
+    static shapes, deterministic, XLA-fusable (reference:
+    paddle/phi/kernels/sparse/gpu/matmul_kernel.cu over cuSPARSE)."""
+    if isinstance(x, SparseCsrTensor):
+        rows, cols = x._row_ids(), x._cols
+        n_rows = x._shape[0]
+    elif isinstance(x, SparseCooTensor):
+        if x.sparse_dim() != 2 or x.dense_dim() != 0:
+            raise ValueError("matmul needs a 2-D sparse matrix")
+        rows, cols = x._indices[0], x._indices[1]
+        n_rows = x._shape[0]
+    else:
+        raise TypeError("x must be sparse")
+    y = ensure_tensor(y)
+
+    def impl(vals, dense):
+        gathered = dense[cols] * vals[:, None]          # [nnz, N]
+        return jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
+    return call_op(impl, x._values, y)
+
+
+def mv(x, vec, name=None):
+    out = matmul(x, call_op(lambda v: v[:, None], ensure_tensor(vec)))
+    return call_op(lambda v: v[:, 0], out)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    out = matmul(x, y)
+    return call_op(lambda i, o: beta * i + alpha * o,
+                   ensure_tensor(input), out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's nonzero positions (SDDMM).
+    Per-nonzero row·col dot products — O(nnz·K) instead of O(M·N·K)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(mask, SparseCsrTensor):
+        rows, cols = mask._row_ids(), mask._cols
+
+        def impl(xv, yv):
+            return (xv[rows] * yv[:, cols].T).sum(-1)
+        vals = call_op(impl, x, y)
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    if isinstance(mask, SparseCooTensor):
+        rows, cols = mask._indices[0], mask._indices[1]
+
+        def impl(xv, yv):
+            return (xv[rows] * yv[:, cols].T).sum(-1)
+        vals = call_op(impl, x, y)
+        return SparseCooTensor(mask._indices, vals, mask._shape,
+                               mask._coalesced)
+    raise TypeError("mask must be sparse")
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the nonzeros (reference:
+    paddle/phi/kernels/sparse/gpu/softmax_kernel.cu).  Only axis=-1 of a
+    2-D sparse matrix is supported, matching the reference."""
+    if axis != -1:
+        raise ValueError("sparse softmax supports axis=-1 only")
+
+    def _segment_softmax(rows, n_rows):
+        def impl(vals):
+            row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+            e = jnp.exp(vals - row_max[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+            return e / denom[rows]
+        return impl
+
+    if isinstance(x, SparseCsrTensor):
+        impl = _segment_softmax(x._row_ids(), x._shape[0])
+        return SparseCsrTensor(x._crows, x._cols, call_op(impl, x._values),
+                               x._shape)
+    if isinstance(x, SparseCooTensor):
+        # entries are taken as-is (input is expected coalesced — duplicate
+        # coordinates would each count as separate logits)
+        impl = _segment_softmax(x._indices[0], x._shape[0])
+        return SparseCooTensor(x._indices, call_op(impl, x._values),
+                               x._shape, x._coalesced)
+    raise TypeError("x must be sparse")
+
+
+from . import nn  # noqa: E402,F401

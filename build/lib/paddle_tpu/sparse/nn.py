@@ -1,0 +1,394 @@
+"""paddle.sparse.nn — layers over sparse COO tensors (reference:
+python/paddle/sparse/nn/layer/{conv,norm,activation,pooling}.py, kernels
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu — gather/GEMM/scatter sparse
+convolution).
+
+TPU-native design: the reference's gather-GEMM-scatter sparse conv exists
+because GPU dense conv wastes FLOPs on empty space.  On TPU the MXU *is*
+the dense conv engine, so the idiomatic implementation is: densify →
+``lax.conv_general_dilated`` (NDHWC) → gather values at the (static per
+call) output coordinate set.  Submanifold conv's output sites are by
+definition the input sites, so its coordinate set is statically known;
+regular sparse conv computes its output sites host-side from the concrete
+input coordinates (eager mode), mirroring the reference's rulebook build
+on the host.  BatchNorm/activation/pooling act on the values array.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..nn.layer.layers import Layer
+from . import SparseCooTensor, _unary
+from . import relu as _relu_fn, relu6 as _relu6_fn, leaky_relu as _lrelu_fn
+from . import softmax as _softmax_fn
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv3D", "SubmConv3D",
+           "Conv2D", "SubmConv2D", "BatchNorm", "SyncBatchNorm", "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _relu_fn(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _relu6_fn(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return _lrelu_fn(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return _softmax_fn(x, self.axis)
+
+
+def _to_list(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+class _SparseConvNd(Layer):
+    """Shared machinery for (Subm)Conv2D/3D over NDHWC/NHWC COO tensors."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, ndim,
+                 stride=1, padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        if groups != 1:
+            raise ValueError("sparse conv supports groups=1")
+        self._ndim = ndim
+        self._subm = subm
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _to_list(kernel_size, ndim)
+        self._stride = _to_list(stride, ndim)
+        self._padding = _to_list(padding, ndim)
+        self._dilation = _to_list(dilation, ndim)
+        # reference kernel layout: [*spatial, in, out]
+        fan_in = int(np.prod(self._kernel_size)) * in_channels
+        from ..nn.initializer import Uniform
+        k = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            self._kernel_size + [in_channels, out_channels], attr=weight_attr,
+            default_initializer=Uniform(-k, k))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=Uniform(-k, k))
+        else:
+            self.bias = None
+
+    def _out_spatial(self, in_spatial):
+        out = []
+        for i, s in enumerate(in_spatial):
+            k_eff = (self._kernel_size[i] - 1) * self._dilation[i] + 1
+            out.append((s + 2 * self._padding[i] - k_eff)
+                       // self._stride[i] + 1)
+        return out
+
+    def _out_coords(self, x):
+        """Active output sites.  Subm: identical to input.  Regular: host
+        computation over the concrete input coordinates (eager only),
+        mirroring the reference's host-side rulebook."""
+        idx = np.asarray(x._indices)        # [1+ndim, nnz] (batch + spatial)
+        if self._subm:
+            return x._indices
+        in_spatial = x._shape[1:1 + self._ndim]
+        out_spatial = self._out_spatial(in_spatial)
+        coords = set()
+        nnz = idx.shape[1]
+        offsets = np.stack(np.meshgrid(
+            *[np.arange(k) for k in self._kernel_size],
+            indexing="ij")).reshape(self._ndim, -1)  # [ndim, prod(k)]
+        for e in range(nnz):
+            b = idx[0, e]
+            pos = idx[1:1 + self._ndim, e]
+            for o in range(offsets.shape[1]):
+                num = (pos + np.asarray(self._padding)
+                       - offsets[:, o] * np.asarray(self._dilation))
+                if np.any(num % np.asarray(self._stride)):
+                    continue
+                oc = num // np.asarray(self._stride)
+                if np.all(oc >= 0) and np.all(oc < np.asarray(out_spatial)):
+                    coords.add((int(b),) + tuple(int(c) for c in oc))
+        coords = sorted(coords)
+        if not coords:
+            coords = [(0,) * (1 + self._ndim)]
+        return jnp.asarray(np.asarray(coords, np.int32).T)
+
+    def forward(self, x):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse conv expects a SparseCooTensor")
+        ndim = self._ndim
+        in_spatial = x._shape[1:1 + ndim]
+        out_spatial = (in_spatial if self._subm
+                       else self._out_spatial(in_spatial))
+        out_coords = self._out_coords(x)
+        dense = x.to_dense()               # [N, *spatial, C]
+        stride = self._stride
+        padding = self._padding
+        dilation = self._dilation
+        if self._subm:
+            # submanifold: stride 1, 'same' (possibly asymmetric) padding so
+            # the conv output grid matches the input grid exactly — even
+            # kernels need (lo, hi) with lo+hi == (k-1)*dilation
+            stride = [1] * ndim
+            pad_cfg = []
+            for i in range(ndim):
+                total = (self._kernel_size[i] - 1) * self._dilation[i]
+                lo = total // 2
+                pad_cfg.append((lo, total - lo))
+        else:
+            pad_cfg = [(p, p) for p in padding]
+        dn_spec = ("NDHWC", "DHWIO", "NDHWC") if ndim == 3 else \
+                  ("NHWC", "HWIO", "NHWC")
+        gather_idx = tuple(out_coords[i] for i in range(1 + ndim))
+
+        def impl(dv, wv):
+            out = jax.lax.conv_general_dilated(
+                dv, wv, window_strides=stride, padding=pad_cfg,
+                rhs_dilation=dilation,
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    dv.shape, wv.shape, dn_spec))
+            return out[gather_idx]          # [nnz_out, C_out]
+        vals = call_op(impl, dense, self.weight)
+        if self.bias is not None:
+            vals = call_op(lambda v, b: v + b, vals, self.bias)
+        out_shape = (x._shape[0],) + tuple(out_spatial) + \
+            (self._out_channels,)
+        return SparseCooTensor(out_coords, vals, out_shape, coalesced=False)
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the values array: nnz acts as the batch dimension,
+    stats are per-channel (reference:
+    python/paddle/sparse/nn/layer/norm.py)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._use_global_stats = use_global_stats
+        from ..nn.initializer import Constant
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        vals = x.values()
+        use_stats = (self._use_global_stats if self._use_global_stats
+                     is not None else not self.training)
+        eps = self._epsilon
+        if use_stats:
+            mean_v, var_v = self._mean._value, self._variance._value
+
+            def impl(v, w, b):
+                return (v - mean_v) * jax.lax.rsqrt(var_v + eps) * w + b
+        else:
+            # batch statistics must be computed INSIDE the taped op so the
+            # vjp differentiates through mean/var (d mean/d v etc.)
+            def impl(v, w, b):
+                mean_b = jnp.mean(v, axis=0)
+                var_b = jnp.var(v, axis=0)
+                return (v - mean_b) * jax.lax.rsqrt(var_b + eps) * w + b
+            v = vals._value
+            m = self._momentum
+            self._mean._value = (m * self._mean._value
+                                 + (1 - m) * jnp.mean(v, axis=0))
+            self._variance._value = (m * self._variance._value
+                                     + (1 - m) * jnp.var(v, axis=0))
+        new_vals = call_op(impl, vals, self.weight, self.bias)
+        return SparseCooTensor(x._indices, new_vals, x._shape, x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BN; in SPMD execution XLA computes global stats when
+    the values axis is sharded — kept as an alias with the reference's name
+    (reference: python/paddle/sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            new = cls(int(layer.weight.shape[0]), layer._momentum,
+                      layer._epsilon)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._mean = layer._mean
+            new._variance = layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class MaxPool3D(Layer):
+    """Max pooling over a sparse NDHWC tensor (dense-backed window reduce;
+    output sites = pooled input sites, computed host-side)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._kernel = _to_list(kernel_size, 3)
+        self._stride = _to_list(stride if stride is not None else kernel_size,
+                                3)
+        self._padding = _to_list(padding, 3)
+
+    def forward(self, x):
+        in_spatial = x._shape[1:4]
+        out_spatial = [(in_spatial[i] + 2 * self._padding[i]
+                        - self._kernel[i]) // self._stride[i] + 1
+                       for i in range(3)]
+        idx = np.asarray(x._indices)
+        coords = set()
+        kernel = np.asarray(self._kernel)
+        stride = np.asarray(self._stride)
+        pad = np.asarray(self._padding)
+        for e in range(idx.shape[1]):
+            b = int(idx[0, e])
+            pos = idx[1:4, e] + pad
+            # every window covering pos: o*stride <= pos < o*stride + kernel
+            lo = np.maximum(0, -(-(pos - kernel + 1) // stride))  # ceil div
+            hi = np.minimum(np.asarray(out_spatial) - 1, pos // stride)
+            if np.any(lo > hi):
+                continue
+            for od in range(int(lo[0]), int(hi[0]) + 1):
+                for oh in range(int(lo[1]), int(hi[1]) + 1):
+                    for ow in range(int(lo[2]), int(hi[2]) + 1):
+                        coords.add((b, od, oh, ow))
+        coords = sorted(coords) or [(0, 0, 0, 0)]
+        out_coords = jnp.asarray(np.asarray(coords, np.int32).T)
+        gather_idx = tuple(out_coords[i] for i in range(4))
+        kernel, stride, padding = self._kernel, self._stride, self._padding
+        scatter_idx = tuple(x._indices[i] for i in range(4))
+        dense_shape = tuple(x._shape)
+
+        def impl(vals_in):
+            # densify onto -inf so inactive voxels never win the max
+            # (sparse max-pool reduces over active sites only)
+            neg_inf = jnp.finfo(vals_in.dtype).min
+            dv = jnp.full(dense_shape, neg_inf, vals_in.dtype)
+            dv = dv.at[scatter_idx].max(vals_in)
+            out = jax.lax.reduce_window(
+                dv, neg_inf, jax.lax.max,
+                window_dimensions=(1, *kernel, 1),
+                window_strides=(1, *stride, 1),
+                padding=((0, 0), *[(p, p) for p in padding], (0, 0)))
+            return out[gather_idx]
+        vals = call_op(impl, x.values())
+        out_shape = (x._shape[0],) + tuple(out_spatial) + (x._shape[4],)
+        return SparseCooTensor(out_coords, vals, out_shape)
+
+
+class functional:
+    """paddle.sparse.nn.functional"""
+    relu = staticmethod(_relu_fn)
+    relu6 = staticmethod(_relu6_fn)
+    leaky_relu = staticmethod(_lrelu_fn)
+    softmax = staticmethod(_softmax_fn)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-mask attention: scores only at mask nonzeros (SDDMM) →
+        sparse softmax → spmm (reference:
+        paddle/phi/kernels/sparse/gpu/fused_attention_kernel.cu).
+
+        ``key_padding_mask``: [seq_k] with 0 at padded keys (those positions
+        get -inf score); ``attn_mask``: additive [seq_q, seq_k]."""
+        from . import masked_matmul, matmul as sp_matmul, SparseCooTensor
+        import math as _math
+        d = int(query.shape[-1])
+        if len(query.shape) != 2:
+            raise ValueError("functional.attention here takes 2-D q/k/v "
+                             "[seq, dim] per head")
+        kt = call_op(lambda v: v.T, key)
+        scores = masked_matmul(
+            call_op(lambda q: q / _math.sqrt(d), query), kt, sparse_mask)
+        if key_padding_mask is not None or attn_mask is not None:
+            if isinstance(scores, SparseCooTensor):
+                rows, cols = scores._indices[0], scores._indices[1]
+            else:
+                rows, cols = scores._row_ids(), scores._cols
+            kp = (key_padding_mask._value
+                  if hasattr(key_padding_mask, "_value")
+                  else key_padding_mask)
+            am = (attn_mask._value if hasattr(attn_mask, "_value")
+                  else attn_mask)
+
+            def mask_impl(v):
+                if kp is not None:
+                    v = jnp.where(jnp.asarray(kp)[cols] != 0, v, -1e9)
+                if am is not None:
+                    v = v + jnp.asarray(am)[rows, cols]
+                return v
+            new_vals = call_op(mask_impl, scores._values)
+            if isinstance(scores, SparseCooTensor):
+                scores = SparseCooTensor(scores._indices, new_vals,
+                                         scores._shape, scores._coalesced)
+            else:
+                from . import SparseCsrTensor
+                scores = SparseCsrTensor(scores._crows, scores._cols,
+                                         new_vals, scores._shape)
+        probs = _softmax_fn(scores)
+        return sp_matmul(probs, value)

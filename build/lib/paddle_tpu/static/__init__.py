@@ -1,0 +1,369 @@
+"""Static-graph API (reference: python/paddle/static/ — Program/Executor
+define-and-run over ProgramDesc + the C++ interpreters in
+paddle/fluid/framework/new_executor/).
+
+TPU-native design: the "Program" is a recorded op tape — while static mode
+is on, every eager op appends its primal jnp function + tensor wiring to
+the active Program (the analogue of OpDesc insertion).  ``Executor.run``
+replays the tape as ONE pure function of (feeds, parameters) and compiles
+it with ``jax.jit`` keyed by feed shapes — XLA is the InterpreterCore:
+dependency analysis, stream scheduling, fusion, and memory planning all
+happen in the compiler instead of a hand-built C++ interpreter.
+Parameters are passed as runtime arguments, so optimizer updates between
+``run`` calls are visible without retracing.
+"""
+import numpy as np
+
+from ..framework import dtypes
+
+__all__ = ["InputSpec", "enable_static", "disable_static", "Program",
+           "program_guard", "default_main_program", "default_startup_program",
+           "name_scope", "data", "Executor", "save_inference_model",
+           "load_inference_model", "global_scope", "scope_guard",
+           "cpu_places", "cuda_places"]
+
+_static_mode = [False]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(None if s in (-1, None) else int(s)
+                           for s in shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+
+class Program:
+    """Recorded op tape: [(fn, input Tensors, output Tensors)] + the feed
+    placeholders created by ``data()`` while this program was active."""
+
+    def __init__(self):
+        self._ops = []                 # (fn, inputs tuple, outputs tuple)
+        self._placeholders = {}        # name -> Tensor
+
+    # recorder protocol (installed into framework.autograd._STATIC_RECORDER)
+    def record(self, fn, inputs, outputs):
+        self._ops.append((fn, tuple(inputs), tuple(outputs)))
+
+    # -- program surface ----------------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._ops = list(self._ops)
+        p._placeholders = dict(self._placeholders)
+        return p
+
+    @property
+    def num_ops(self):
+        return len(self._ops)
+
+    def __repr__(self):
+        return (f"Program(ops={len(self._ops)}, "
+                f"feeds={list(self._placeholders)})")
+
+    # -- replay -------------------------------------------------------------
+    def _leaf_inputs(self):
+        """Tensors consumed but never produced and not placeholders —
+        parameters/constants, passed as runtime args at run()."""
+        produced = set()
+        ph_ids = {id(t) for t in self._placeholders.values()}
+        leaves, seen = [], set()
+        for _, inputs, outputs in self._ops:
+            for t in inputs:
+                if id(t) not in produced and id(t) not in ph_ids and \
+                        id(t) not in seen:
+                    seen.add(id(t))
+                    leaves.append(t)
+            for t in outputs:
+                produced.add(id(t))
+        return leaves
+
+    def _prune_to(self, fetch_list):
+        """Backward slice: only ops in the fetch cone (the reference's
+        inference-program prune)."""
+        needed = {id(t) for t in fetch_list}
+        kept = []
+        for fn, inputs, outputs in reversed(self._ops):
+            if any(id(t) in needed for t in outputs):
+                kept.append((fn, inputs, outputs))
+                needed.update(id(t) for t in inputs)
+        kept.reverse()
+        return kept, needed
+
+    def _build_pure(self, fetch_list, feed_names=None):
+        """Pure (feed_vals, leaf_vals) -> fetch vals replay function over
+        the fetch cone.  ``feed_names`` restricts which placeholders become
+        feed arguments (the rest must be dead after pruning)."""
+        ops, needed = self._prune_to(fetch_list)
+        ph_items = sorted((n, t) for n, t in self._placeholders.items()
+                          if feed_names is None or n in feed_names)
+        # leaves restricted to the pruned cone
+        produced = set()
+        ph_ids_all = {id(t) for t in self._placeholders.values()}
+        leaves, seen = [], set()
+        for _, inputs, outputs in ops:
+            for t in inputs:
+                if id(t) not in produced and id(t) not in ph_ids_all and \
+                        id(t) not in seen:
+                    seen.add(id(t))
+                    leaves.append(t)
+            produced.update(id(t) for t in outputs)
+        live_ph = {id(t) for _, inputs, _ in ops for t in inputs} & ph_ids_all
+        fed_ids = {id(t) for _, t in ph_items}
+        unfed = live_ph - fed_ids
+        if unfed:
+            names = [n for n, t in self._placeholders.items()
+                     if id(t) in unfed]
+            raise ValueError(
+                f"placeholders {names} are live in the fetch cone but not "
+                "listed as feeds")
+        leaf_ids = [id(t) for t in leaves]
+        ph_ids = [id(t) for _, t in ph_items]
+        fetch_ids = [id(t) for t in fetch_list]
+        fetchable = produced | set(ph_ids) | set(leaf_ids)
+        bad = [i for i, t in enumerate(fetch_list)
+               if id(t) not in fetchable]
+        if bad and self._ops:
+            raise ValueError(
+                f"fetch targets at positions {bad} were not produced by "
+                "this program (was static mode enabled while building?)")
+        fallback = {id(t): t for t in fetch_list}
+
+        def pure(feed_vals, leaf_vals):
+            env = dict(zip(ph_ids, feed_vals))
+            env.update(zip(leaf_ids, leaf_vals))
+            for fn, inputs, outputs in ops:
+                vals = [env[id(t)] if id(t) in env else t._value
+                        for t in inputs]
+                out = fn(*vals)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for t, v in zip(outputs, outs):
+                    env[id(t)] = v
+            return [env[i] if i in env else fallback[i]._value
+                    for i in fetch_ids]
+        return pure, ph_items, leaves
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+def _set_recording(program):
+    from ..framework import autograd as _ag
+    _ag._STATIC_RECORDER[0] = program
+
+
+def enable_static():
+    _static_mode[0] = True
+    _set_recording(_default_main[0])
+
+
+def disable_static(place=None):
+    _static_mode[0] = False
+    _set_recording(None)
+
+
+from contextlib import contextmanager  # noqa: E402
+
+
+@contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_main = _default_main[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        prev_start = _default_startup[0]
+        _default_startup[0] = startup_program
+    if _static_mode[0]:
+        _set_recording(main_program)
+    try:
+        yield
+    finally:
+        _default_main[0] = prev_main
+        if startup_program is not None:
+            _default_startup[0] = prev_start
+        if _static_mode[0]:
+            _set_recording(prev_main)
+
+
+@contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference: paddle.static.data).  Returns a Tensor
+    whose value is a zeros stand-in; Executor.run substitutes the feed."""
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    from ..framework import autograd as _ag
+    d = dtypes.convert_dtype(dtype)
+    concrete = tuple(1 if s in (-1, None) else int(s) for s in shape)
+    with _ag.suspend_tape():
+        t = Tensor(jnp.zeros(concrete, d), name=name)
+    t.is_placeholder = True
+    t.stop_gradient = True
+    _default_main[0]._placeholders[name] = t
+    return t
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+def cpu_places(device_count=None):
+    return ["cpu"] * (device_count or 1)
+
+
+def cuda_places(device_ids=None):
+    ids = device_ids if device_ids is not None else [0]
+    return [f"tpu:{i}" for i in ids]
+
+
+class Executor:
+    """Replay-compile-run (reference: python/paddle/base/executor.py over
+    StandaloneExecutor).  Compiled executables are cached per
+    (program, fetch ids, feed shapes/dtypes)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        import jax
+        import jax.numpy as jnp
+        program = program or _default_main[0]
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if not fetch_list:
+            return []
+        # stop recording while executing (replay must not re-record)
+        from ..framework import autograd as _ag
+        prev = _ag._STATIC_RECORDER[0]
+        _ag._STATIC_RECORDER[0] = None
+        try:
+            feed_arrs = {n: np.asarray(v) for n, v in feed.items()}
+            key_shapes = tuple(sorted(
+                (n, a.shape, str(a.dtype)) for n, a in feed_arrs.items()))
+            key = (id(program), tuple(id(t) for t in fetch_list),
+                   key_shapes, len(program._ops))
+            if key not in self._cache:
+                pure, ph_items, leaves = program._build_pure(fetch_list)
+                missing = [n for n, _ in ph_items if n not in feed_arrs]
+                if missing:
+                    raise ValueError(f"missing feeds: {missing}")
+                self._cache[key] = (jax.jit(pure), ph_items, leaves)
+            fn, ph_items, leaves = self._cache[key]
+            feed_vals = [jnp.asarray(feed_arrs[n]) for n, _ in ph_items]
+            leaf_vals = [t._value for t in leaves]
+            outs = fn(feed_vals, leaf_vals)
+        finally:
+            _ag._STATIC_RECORDER[0] = prev
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        self._cache.clear()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the pruned feed→fetch subgraph as a portable jax.export
+    artifact + params (reference: python/paddle/static/io.py)."""
+    import pickle
+    import os
+    import jax
+    program = program or _default_main[0]
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feed_names = [getattr(v, "name", None) for v in feed_vars]
+    pure, ph_items, leaves = program._build_pure(list(fetch_vars),
+                                                 feed_names=feed_names)
+    arg_shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype)
+                  for _, t in ph_items]
+    leaf_vals = [t._value for t in leaves]
+    exported = jax.export.export(
+        jax.jit(pure), platforms=("cpu", "tpu"))(arg_shapes, leaf_vals)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "w") as f:
+        f.write(exported.mlir_module())
+    meta = {
+        "exported": bytes(exported.serialize()),
+        "feed_names": [n for n, _ in ph_items],
+        "leaves": [np.asarray(v) for v in leaf_vals],
+        "n_fetch": len(fetch_vars),
+    }
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (runner, feed_names, fetch_indices); ``runner.run(feed)``
+    executes the loaded artifact and returns numpy outputs."""
+    import pickle
+    import jax
+    import jax.numpy as jnp
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    exported = jax.export.deserialize(bytearray(meta["exported"]))
+    leaves = [jnp.asarray(a) for a in meta["leaves"]]
+    feed_names = meta["feed_names"]
+
+    class _LoadedProgram:
+        def run(self, feed):
+            vals = [jnp.asarray(feed[n]) for n in feed_names]
+            outs = exported.call(vals, leaves)
+            return [np.asarray(o) for o in outs]
+
+    return _LoadedProgram(), feed_names, list(range(meta["n_fetch"]))
+
+
+# imported last: static.nn pulls in jit.dy2static, which imports back into
+# this (by then fully-populated) module for InputSpec
+from . import nn  # noqa: E402

@@ -1,0 +1,48 @@
+"""paddle.static.nn control-flow ops (reference:
+python/paddle/static/nn/control_flow.py — cond builds a
+conditional_block pair, while_loop builds a While op with a sub-block).
+
+TPU-native: both delegate to the jit.dy2static runtime converters, so a
+concrete predicate keeps Python semantics and a traced predicate lowers
+to ``lax.cond`` / ``lax.while_loop`` — the same machinery the AST pass
+uses, exposed as the explicit user API.
+"""
+from ..framework.core import Tensor
+from ..jit.dy2static import convert_ifelse, convert_while_loop
+
+__all__ = ["cond", "while_loop"]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` or ``false_fn()`` depending on ``pred``.
+
+    Both callables take no arguments and must return structurally
+    matching outputs (lax.cond contract when traced).  A missing branch
+    behaves as ``lambda: None``.
+    """
+    t = true_fn if true_fn is not None else (lambda: None)
+    f = false_fn if false_fn is not None else (lambda: None)
+    return convert_ifelse(pred, lambda *_: t(), lambda *_: f())
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat ``body(*loop_vars)`` while ``cond(*loop_vars)`` holds.
+
+    ``body`` must return the next loop_vars (list/tuple, same structure
+    and shapes).  Returns the final loop_vars as a list, like the
+    reference API.
+    """
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+
+    def body_tuple(*vs):
+        out = body(*vs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        if len(out) != len(loop_vars):
+            raise ValueError(
+                f"body returned {len(out)} vars, expected {len(loop_vars)}")
+        return tuple(out)
+
+    out = convert_while_loop(cond, body_tuple, tuple(loop_vars))
+    return list(out)

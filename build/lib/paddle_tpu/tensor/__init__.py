@@ -1,0 +1,155 @@
+"""Tensor op surface + method patching.
+
+The reference monkey-patches generated ops onto the eager Tensor
+(python/paddle/tensor/__init__.py); we do the same so ``x.sum()``,
+``x + y`` etc. work on the facade.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ._helpers import ensure_tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import logic as _logic
+from . import search as _search
+from . import linalg as _linalg
+from . import stat as _stat
+
+
+def _swap(fn):
+    return lambda x, y, name=None: fn(y, x)
+
+
+# -- dunders ----------------------------------------------------------------
+Tensor.__add__ = _math.add
+Tensor.__radd__ = _math.add
+Tensor.__sub__ = _math.subtract
+Tensor.__rsub__ = _swap(_math.subtract)
+Tensor.__mul__ = _math.multiply
+Tensor.__rmul__ = _math.multiply
+Tensor.__truediv__ = _math.divide
+Tensor.__rtruediv__ = _swap(_math.divide)
+Tensor.__floordiv__ = _math.floor_divide
+Tensor.__rfloordiv__ = _swap(_math.floor_divide)
+Tensor.__mod__ = _math.mod
+Tensor.__rmod__ = _swap(_math.mod)
+Tensor.__pow__ = _math.pow
+Tensor.__rpow__ = _swap(_math.pow)
+Tensor.__neg__ = _math.neg
+Tensor.__abs__ = _math.abs
+Tensor.__matmul__ = _math.matmul
+Tensor.__rmatmul__ = _swap(_math.matmul)
+Tensor.__eq__ = _logic.equal
+Tensor.__ne__ = _logic.not_equal
+Tensor.__lt__ = _logic.less_than
+Tensor.__le__ = _logic.less_equal
+Tensor.__gt__ = _logic.greater_than
+Tensor.__ge__ = _logic.greater_equal
+Tensor.__and__ = _logic.bitwise_and
+Tensor.__or__ = _logic.bitwise_or
+Tensor.__xor__ = _logic.bitwise_xor
+Tensor.__invert__ = _logic.bitwise_not
+
+_METHODS = dict(
+    # math
+    add=_math.add, subtract=_math.subtract, multiply=_math.multiply,
+    divide=_math.divide, pow=_math.pow, mod=_math.mod,
+    remainder=_math.remainder, floor_divide=_math.floor_divide,
+    scale=_math.scale, exp=_math.exp, log=_math.log, log2=_math.log2,
+    log10=_math.log10, log1p=_math.log1p, sqrt=_math.sqrt,
+    rsqrt=_math.rsqrt, abs=_math.abs, sign=_math.sign, floor=_math.floor,
+    ceil=_math.ceil, round=_math.round, trunc=_math.trunc, sin=_math.sin,
+    cos=_math.cos, tan=_math.tan, asin=_math.asin, acos=_math.acos,
+    atan=_math.atan, sinh=_math.sinh, cosh=_math.cosh, tanh=_math.tanh,
+    erf=_math.erf, reciprocal=_math.reciprocal, square=_math.square,
+    sigmoid=_math.sigmoid, neg=_math.neg, clip=_math.clip, lerp=_math.lerp,
+    maximum=_math.maximum, minimum=_math.minimum, fmax=_math.fmax,
+    fmin=_math.fmin, sum=_math.sum, mean=_math.mean, prod=_math.prod,
+    max=_math.max, min=_math.min, amax=_math.amax, amin=_math.amin,
+    logsumexp=_math.logsumexp, cumsum=_math.cumsum, cumprod=_math.cumprod,
+    cummax=_math.cummax, cummin=_math.cummin,
+    trace=_math.trace, diagonal=_math.diagonal, matmul=_math.matmul,
+    mm=_math.mm, bmm=_math.bmm, dot=_math.dot, addmm=_math.addmm,
+    isfinite=_math.isfinite, isinf=_math.isinf, isnan=_math.isnan,
+    inner=_math.inner, outer=_math.outer, kron=_math.kron,
+    atan2=_math.atan2, diff=_math.diff, nan_to_num=_math.nan_to_num,
+    deg2rad=_math.deg2rad, rad2deg=_math.rad2deg, conj=_math.conj,
+    real=_math.real, imag=_math.imag, angle=_math.angle, logit=_math.logit,
+    lgamma=_math.lgamma, digamma=_math.digamma,
+    # manipulation
+    reshape=_manip.reshape, reshape_=_manip.reshape_,
+    flatten=_manip.flatten, transpose=_manip.transpose,
+    moveaxis=_manip.moveaxis, swapaxes=_manip.swapaxes,
+    squeeze=_manip.squeeze, unsqueeze=_manip.unsqueeze,
+    unsqueeze_=_manip.unsqueeze_, expand=_manip.expand,
+    broadcast_to=_manip.broadcast_to, expand_as=_manip.expand_as,
+    tile=_manip.tile, flip=_manip.flip, roll=_manip.roll,
+    gather=_manip.gather, gather_nd=_manip.gather_nd,
+    scatter=_manip.scatter, scatter_nd_add=_manip.scatter_nd_add,
+    index_select=_manip.index_select, index_sample=_manip.index_sample,
+    index_add=_manip.index_add, masked_select=_manip.masked_select,
+    masked_fill=_manip.masked_fill, take_along_axis=_manip.take_along_axis,
+    put_along_axis=_manip.put_along_axis, split=_manip.split,
+    chunk=_manip.chunk, unbind=None, unstack=_manip.unstack,
+    repeat_interleave=_manip.repeat_interleave, rot90=_manip.rot90,
+    fill_diagonal=_manip.fill_diagonal, view=_manip.view,
+    view_as=_manip.view_as, tril=_creation.tril, triu=_creation.triu,
+    diag=_creation.diag, diag_embed=_creation.diag_embed,
+    # logic
+    equal=_logic.equal, not_equal=_logic.not_equal,
+    greater_than=_logic.greater_than, greater_equal=_logic.greater_equal,
+    less_than=_logic.less_than, less_equal=_logic.less_equal,
+    logical_and=_logic.logical_and, logical_or=_logic.logical_or,
+    logical_xor=_logic.logical_xor, logical_not=_logic.logical_not,
+    bitwise_and=_logic.bitwise_and, bitwise_or=_logic.bitwise_or,
+    bitwise_xor=_logic.bitwise_xor, bitwise_not=_logic.bitwise_not,
+    equal_all=_logic.equal_all, allclose=_logic.allclose,
+    isclose=_logic.isclose, all=_logic.all, any=_logic.any,
+    # search
+    argmax=_search.argmax, argmin=_search.argmin, argsort=_search.argsort,
+    sort=_search.sort, topk=_search.topk, where=None,
+    nonzero=_search.nonzero, unique=_search.unique, mode=_search.mode,
+    kthvalue=_search.kthvalue,
+    # linalg
+    norm=_linalg.norm, dist=_linalg.dist, cross=_linalg.cross,
+    cholesky=_linalg.cholesky, inverse=_linalg.inv, pinv=_linalg.pinv,
+    # stat
+    std=_stat.std, var=_stat.var, median=_stat.median,
+    quantile=_stat.quantile,
+    # creation
+    zeros_like=None, ones_like=None, numel=_creation.numel,
+)
+
+
+def unbind(x, axis=0, name=None):
+    return _manip.unstack(x, axis=axis)
+
+
+_METHODS["unbind"] = unbind
+_METHODS["where"] = lambda c, x=None, y=None, name=None: \
+    _search.where(c, x, y)
+_METHODS["zeros_like"] = lambda x, dtype=None, name=None: \
+    _creation.zeros_like(x, dtype)
+_METHODS["ones_like"] = lambda x, dtype=None, name=None: \
+    _creation.ones_like(x, dtype)
+
+for _name, _fn in _METHODS.items():
+    if _fn is not None:
+        setattr(Tensor, _name, _fn)
+
+
+def _item_method(self, *args):
+    return self._value.item(*args)

@@ -1,0 +1,187 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_tensor
+from ..framework.autograd import call_op
+from ..framework import dtypes
+from ._helpers import ensure_tensor
+
+
+def _d(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value if isinstance(s, Tensor) else s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        return Tensor(jnp.full(_shape(shape), fill_value))
+    return Tensor(jnp.full(_shape(shape), fill_value, _d(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._value, fill_value,
+                                dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in ("start", "end", "step"):
+        pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = (np.dtype("int64") if all(isinstance(v, (int, np.integer))
+             for v in (start, end, step)) else dtypes.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_d(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    ts = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) \
+        else args
+    ts = [ensure_tensor(t) for t in ts]
+    return call_op(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *ts)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def _diag(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(v, offset=offset)
+    return call_op(_diag, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return call_op(lambda v: jnp.diagflat(v, k=offset), ensure_tensor(x))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(x)
+
+    def _de(v):
+        out = jnp.zeros(v.shape + (v.shape[-1] + abs(offset),), v.dtype)
+        n = v.shape[-1]
+        idx = jnp.arange(n)
+        r = idx + (abs(offset) if offset < 0 else 0)
+        c = idx + (offset if offset > 0 else 0)
+        out = jnp.zeros(v.shape[:-1] + (n + abs(offset), n + abs(offset)),
+                        v.dtype)
+        out = out.at[..., r, c].set(v)
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2)) \
+            if (dim1, dim2) != (-2, -1) else out
+    return call_op(_de, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return call_op(lambda v: jnp.tril(v, k=diagonal), ensure_tensor(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return call_op(lambda v: jnp.triu(v, k=diagonal), ensure_tensor(x))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_d(dtype, np.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_d(dtype, np.int64)))
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray,
+                                               int, float)) else x
+    if not isinstance(x, Tensor):
+        x = to_tensor(np.asarray(x))
+    if output is None:
+        return call_op(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number)
+                       else v, x)
+    output.set_value(x)
+    return output
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size, dtype=jnp.int64))
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn.initializer import _apply_initializer
+    d = _d(dtype)
+    value = _apply_initializer(default_initializer, _shape(shape), d, is_bias)
+    p = Tensor(value, stop_gradient=False, name=name)
+    p.persistable = True
+    p.is_parameter = True
+    return p

@@ -1,0 +1,12 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py — a hand-written
+planner over matmul/reduce ops; here jnp.einsum lowers straight to MXU
+dot_generals via XLA)."""
+import jax.numpy as jnp
+
+from ..framework.autograd import call_op
+from ._helpers import ensure_tensor
+
+
+def einsum(equation, *operands, name=None):
+    ts = [ensure_tensor(o) for o in operands]
+    return call_op(lambda *vs: jnp.einsum(equation, *vs), *ts)

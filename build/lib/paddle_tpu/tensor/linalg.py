@@ -1,0 +1,234 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py → Phi
+kernels backed by cuBLAS/cuSOLVER; here XLA's native linalg lowering)."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ._helpers import ensure_tensor
+from .math import matmul, mm, bmm, dot  # noqa: F401 (re-export)
+
+
+def mv(x, vec, name=None):
+    x, vec = ensure_tensor(x), ensure_tensor(vec)
+    return call_op(lambda a, b: a @ b, x, vec)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+
+    def _norm(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v))))
+            return jnp.linalg.norm(v, ord=None, axis=axis, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=axis, keepdims=keepdim)
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        elif p == float("-inf"):
+            r = jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        elif p == 0:
+            r = jnp.sum((v != 0).astype(v.dtype), axis=axis, keepdims=keepdim)
+        else:
+            r = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
+                                  keepdims=keepdim), 1.0 / p)
+        return r
+    return call_op(_norm, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                                             keepdims=keepdim), x)
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _dist(a, b):
+        d = jnp.abs(a - b)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return call_op(_dist, x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _cd(a, b):
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == float("inf"):
+            return jnp.max(d, axis=-1)
+        return jnp.power(jnp.sum(jnp.power(d, p), axis=-1), 1.0 / p)
+    return call_op(_cd, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x.shape) if s == 3), -1)
+    return call_op(lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+
+    def _ch(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return call_op(_ch, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _cs(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lm, -1, -2), z, lower=False)
+    return call_op(_cs, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), x, y)
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(jnp.linalg.solve, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _lq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return call_op(_lq, x, y)
+
+
+def inv(x, name=None):
+    return call_op(jnp.linalg.inv, ensure_tensor(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return call_op(lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                             hermitian=hermitian),
+                   ensure_tensor(x))
+
+
+def det(x, name=None):
+    return call_op(jnp.linalg.det, ensure_tensor(x))
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: tuple(jnp.linalg.slogdet(v)), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: tuple(jnp.linalg.svd(
+        v, full_matrices=full_matrices)), x)
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return call_op(lambda v: jnp.linalg.qr(v, mode="r"), x)
+    return call_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    # XLA has no nonsymmetric eig on TPU; run on CPU via numpy fallback.
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x._value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: tuple(jnp.linalg.eigh(
+        v, symmetrize_input=False)), x)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    w = np.linalg.eigvals(np.asarray(ensure_tensor(x)._value))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return call_op(jnp.linalg.eigvalsh, ensure_tensor(x))
+
+
+def matrix_power(x, n, name=None):
+    return call_op(lambda v: jnp.linalg.matrix_power(v, n), ensure_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return call_op(lambda v: jnp.linalg.matrix_rank(v, rtol=tol),
+                   ensure_tensor(x))
+
+
+def multi_dot(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.linalg.multi_dot(vs), *ts)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return call_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    input = ensure_tensor(input)
+    import numpy as np
+    arr = np.asarray(input._value).reshape(-1)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    w = np.asarray(weight._value).reshape(-1) if weight is not None else None
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w,
+                        density=density)
+    return Tensor(jnp.asarray(h if density or w is not None
+                              else h.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+    arr = np.asarray(x._value)
+    w = np.asarray(weights._value) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, w, minlength)))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return call_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), ensure_tensor(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.cov(v, rowvar=rowvar,
+                                     ddof=1 if ddof else 0), x)

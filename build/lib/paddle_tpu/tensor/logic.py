@@ -1,0 +1,55 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ._helpers import ensure_tensor, binary_op, reduce_op
+
+equal = binary_op(jnp.equal)
+not_equal = binary_op(jnp.not_equal)
+greater_than = binary_op(jnp.greater)
+greater_equal = binary_op(jnp.greater_equal)
+less_than = binary_op(jnp.less)
+less_equal = binary_op(jnp.less_equal)
+logical_and = binary_op(jnp.logical_and)
+logical_or = binary_op(jnp.logical_or)
+logical_xor = binary_op(jnp.logical_xor)
+bitwise_and = binary_op(jnp.bitwise_and)
+bitwise_or = binary_op(jnp.bitwise_or)
+bitwise_xor = binary_op(jnp.bitwise_xor)
+bitwise_left_shift = binary_op(jnp.left_shift)
+bitwise_right_shift = binary_op(jnp.right_shift)
+
+all = reduce_op(jnp.all)
+any = reduce_op(jnp.any)
+
+
+def logical_not(x, name=None):
+    return call_op(jnp.logical_not, ensure_tensor(x))
+
+
+def bitwise_not(x, name=None):
+    return call_op(jnp.bitwise_not, ensure_tensor(x))
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if x.shape != y.shape:
+        return Tensor(jnp.asarray(False))
+    return call_op(lambda a, b: jnp.all(a == b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))

@@ -1,0 +1,397 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..framework import dtypes
+from ._helpers import ensure_tensor
+
+
+def _ints(x):
+    if isinstance(x, Tensor):
+        return tuple(int(v) for v in x.tolist())
+    if isinstance(x, (int, np.integer)):
+        return (int(x),)
+    return tuple(int(v._value if isinstance(v, Tensor) else v) for v in x)
+
+
+def reshape(x, shape, name=None):
+    return call_op(lambda v: jnp.reshape(v, _ints(shape)), ensure_tensor(x))
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x._value, _ints(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+
+    def _fl(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new)
+    return call_op(_fl, x)
+
+
+def transpose(x, perm, name=None):
+    return call_op(lambda v: jnp.transpose(v, _ints(perm)), ensure_tensor(x))
+
+
+def t(x, name=None):
+    return call_op(lambda v: v.T, ensure_tensor(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return call_op(lambda v: jnp.moveaxis(v, source, destination),
+                   ensure_tensor(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return call_op(lambda v: jnp.swapaxes(v, axis1, axis2), ensure_tensor(x))
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return call_op(lambda *vs: jnp.concatenate(vs, axis=axis), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.stack(vs, axis=axis), *ts)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    out = call_op(
+        lambda v: tuple(jnp.squeeze(s, axis=axis)
+                        for s in jnp.split(v, n, axis=axis)), x)
+    return list(out)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, int):
+        out = call_op(lambda v: tuple(jnp.split(v, num_or_sections,
+                                                axis=axis)), x)
+    else:
+        secs = [int(s._value if isinstance(s, Tensor) else s)
+                for s in num_or_sections]
+        total = x.shape[axis]
+        known = sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        out = call_op(lambda v: tuple(jnp.split(v, idx, axis=axis)), x)
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def _sq(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _ints(axis)
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return call_op(_sq, x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = _ints(axis)
+    return call_op(lambda v: jnp.expand_dims(v, axes), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    x._value = jnp.expand_dims(x._value, _ints(axis))
+    return x
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    tgt = _ints(shape)
+
+    def _ex(v):
+        full = list(tgt)
+        off = len(full) - v.ndim
+        for i in range(v.ndim):
+            if full[off + i] == -1:
+                full[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(full))
+    return call_op(_ex, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return call_op(lambda v: jnp.broadcast_to(v, _ints(shape)),
+                   ensure_tensor(x))
+
+
+def expand_as(x, y, name=None):
+    return broadcast_to(x, ensure_tensor(y).shape)
+
+
+def broadcast_tensors(input, name=None):
+    ts = [ensure_tensor(t) for t in input]
+    out = call_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts)
+    return list(out)
+
+
+def tile(x, repeat_times, name=None):
+    return call_op(lambda v: jnp.tile(v, _ints(repeat_times)),
+                   ensure_tensor(x))
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis) if not isinstance(axis, int) else (axis,)
+    return call_op(lambda v: jnp.flip(v, axis=ax), ensure_tensor(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return call_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)),
+                   ensure_tensor(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = None if axis is None else (
+        _ints(axis) if not isinstance(axis, int) else axis)
+    return call_op(lambda v: jnp.roll(v, sh, axis=ax), ensure_tensor(x))
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return call_op(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1
+                                         else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def _gnd(v, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v[idx]
+    return call_op(_gnd, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return call_op(lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                   arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def _put(v, i, val):
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s).reshape(
+            [-1 if k == d else 1 for k in range(i.ndim)])
+            for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                    for d in range(i.ndim))
+        if reduce == "assign":
+            return v.at[idx].set(val)
+        if reduce in ("add", "sum"):
+            return v.at[idx].add(val)
+        if reduce in ("mul", "multiply"):
+            return v.at[idx].multiply(val)
+        raise ValueError(f"unsupported reduce {reduce}")
+    return call_op(_put, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = (ensure_tensor(x), ensure_tensor(index),
+                         ensure_tensor(updates))
+
+    def _sc(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return call_op(_sc, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = (ensure_tensor(x), ensure_tensor(index),
+                         ensure_tensor(updates))
+
+    def _snd(v, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v.at[idx].add(u)
+    return call_op(_snd, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    tgt = _ints(shape)
+
+    def _snd(i, u):
+        z = jnp.zeros(tgt, u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return z.at[idx].add(u)
+    return call_op(_snd, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return call_op(lambda v, i: jnp.take(v, i, axis=axis), x, index)
+
+
+def index_sample(x, index):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return call_op(lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = (ensure_tensor(x), ensure_tensor(index),
+                       ensure_tensor(value))
+
+    def _ia(v, i, val):
+        v2 = jnp.moveaxis(v, axis, 0)
+        val2 = jnp.moveaxis(val, axis, 0)
+        out = v2.at[i].add(val2.astype(v2.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return call_op(_ia, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx_ts = [ensure_tensor(i) for i in indices]
+
+    def _ip(v, val, *idxs):
+        if accumulate:
+            return v.at[tuple(idxs)].add(val.astype(v.dtype))
+        return v.at[tuple(idxs)].set(val.astype(v.dtype))
+    return call_op(_ip, x, value, *idx_ts)
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager-only (same restriction as XLA/jit).
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    xv = np.asarray(x._value)
+    mv = np.asarray(mask._value)
+    return Tensor(jnp.asarray(np.broadcast_to(xv, np.broadcast_shapes(
+        xv.shape, mv.shape))[np.broadcast_to(mv, np.broadcast_shapes(
+            xv.shape, mv.shape))]))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = value._value if isinstance(value, Tensor) else value
+    if isinstance(value, Tensor):
+        return call_op(lambda a, m, val: jnp.where(m, val.astype(a.dtype), a),
+                       x, mask, value)
+    return call_op(lambda a, m: jnp.where(m, v, a), x, mask)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    x = ensure_tensor(x)
+
+    def _fd(v):
+        n = min(v.shape[-2], v.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        r = i + (abs(offset) if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        return v.at[..., r, c].set(value)
+    return call_op(_fd, x)
+
+
+_pyslice = __import__("builtins").slice
+
+
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def _sl(v):
+        sl = [_pyslice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            sl[a] = _pyslice(s, e)
+        return v[tuple(sl)]
+    return call_op(_sl, input)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else (0,) * len(shp)
+
+    def _cr(v):
+        sl = tuple(_pyslice(o, o + s) for o, s in zip(offs, shp))
+        return v[sl]
+    return call_op(_cr, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        r = np.asarray(repeats._value)
+        return call_op(lambda v: jnp.repeat(v, jnp.asarray(r), axis=axis,
+                                            total_repeat_length=int(r.sum())),
+                       x)
+    return call_op(lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError("as_strided is not supported on XLA arrays")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return ensure_tensor(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [call_op(jnp.atleast_1d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [call_op(jnp.atleast_2d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [call_op(jnp.atleast_3d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def cast(x, dtype):
+    return ensure_tensor(x).astype(dtype)
+
+
+def as_real(x, name=None):
+    return call_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                   ensure_tensor(x))
+
+
+def as_complex(x, name=None):
+    return call_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+                   ensure_tensor(x))
+
+
+import jax  # noqa: E402  (used by as_complex)

@@ -1,0 +1,272 @@
+"""Math ops (reference: python/paddle/tensor/math.py over Phi kernels)."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..framework import dtypes
+from ._helpers import ensure_tensor, unary_op, binary_op, reduce_op
+
+# -- elementwise unary -------------------------------------------------------
+exp = unary_op(jnp.exp)
+expm1 = unary_op(jnp.expm1)
+log = unary_op(jnp.log)
+log2 = unary_op(jnp.log2)
+log10 = unary_op(jnp.log10)
+log1p = unary_op(jnp.log1p)
+sqrt = unary_op(jnp.sqrt)
+rsqrt = unary_op(lambda v: jax.lax.rsqrt(v))
+abs = unary_op(jnp.abs)
+sign = unary_op(jnp.sign)
+floor = unary_op(jnp.floor)
+ceil = unary_op(jnp.ceil)
+round = unary_op(jnp.round)
+trunc = unary_op(jnp.trunc)
+frac = unary_op(lambda v: v - jnp.trunc(v))
+sin = unary_op(jnp.sin)
+cos = unary_op(jnp.cos)
+tan = unary_op(jnp.tan)
+asin = unary_op(jnp.arcsin)
+acos = unary_op(jnp.arccos)
+atan = unary_op(jnp.arctan)
+sinh = unary_op(jnp.sinh)
+cosh = unary_op(jnp.cosh)
+tanh = unary_op(jnp.tanh)
+asinh = unary_op(jnp.arcsinh)
+acosh = unary_op(jnp.arccosh)
+atanh = unary_op(jnp.arctanh)
+erf = unary_op(jax.scipy.special.erf)
+erfinv = unary_op(jax.scipy.special.erfinv)
+reciprocal = unary_op(lambda v: 1.0 / v)
+square = unary_op(jnp.square)
+neg = unary_op(jnp.negative)
+digamma = unary_op(jax.scipy.special.digamma)
+lgamma = unary_op(jax.scipy.special.gammaln)
+i0 = unary_op(jax.scipy.special.i0)
+i1 = unary_op(jax.scipy.special.i1)
+angle = unary_op(jnp.angle)
+conj = unary_op(jnp.conj)
+real = unary_op(jnp.real)
+imag = unary_op(jnp.imag)
+deg2rad = unary_op(jnp.deg2rad)
+rad2deg = unary_op(jnp.rad2deg)
+sigmoid = unary_op(jax.nn.sigmoid)
+logit = unary_op(jax.scipy.special.logit)
+
+# -- elementwise binary ------------------------------------------------------
+add = binary_op(jnp.add)
+subtract = binary_op(jnp.subtract)
+multiply = binary_op(jnp.multiply)
+divide = binary_op(jnp.divide)
+mod = binary_op(jnp.mod)
+remainder = mod
+floor_mod = mod
+floor_divide = binary_op(jnp.floor_divide)
+pow = binary_op(jnp.power)
+maximum = binary_op(jnp.maximum)
+minimum = binary_op(jnp.minimum)
+fmax = binary_op(jnp.fmax)
+fmin = binary_op(jnp.fmin)
+atan2 = binary_op(jnp.arctan2)
+hypot = binary_op(jnp.hypot)
+logaddexp = binary_op(jnp.logaddexp)
+heaviside = binary_op(jnp.heaviside)
+copysign = binary_op(jnp.copysign)
+nextafter = binary_op(jnp.nextafter)
+gcd = binary_op(jnp.gcd)
+lcm = binary_op(jnp.lcm)
+ldexp = binary_op(jnp.ldexp)
+inner = binary_op(jnp.inner)
+outer = binary_op(jnp.outer)
+kron = binary_op(jnp.kron)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    if bias_after_scale:
+        out = call_op(lambda v: v * scale + bias, x)
+    else:
+        out = call_op(lambda v: (v + bias) * scale, x)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return call_op(lambda v: jnp.clip(v, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return call_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return call_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return call_op(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                            neginf=neginf), ensure_tensor(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return call_op(lambda v: scale_b * jnp.tanh(scale_a * v), ensure_tensor(x))
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(i) for i in inputs]
+    idx = ensure_tensor(index)
+
+    def _mux(idx_v, *vs):
+        stacked = jnp.stack(vs, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx_v.reshape(-1), rows]
+    return call_op(lambda i, *vs: _mux(i, *vs), idx, *ts)
+
+
+# -- reductions --------------------------------------------------------------
+sum = reduce_op(jnp.sum)
+mean = reduce_op(jnp.mean)
+prod = reduce_op(jnp.prod)
+nansum = reduce_op(jnp.nansum)
+nanmean = reduce_op(jnp.nanmean)
+amax = reduce_op(jnp.max)
+amin = reduce_op(jnp.min)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return amax(x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return amin(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return call_op(lambda v: jax.scipy.special.logsumexp(
+        v, axis=axis, keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    if axis is None:
+        return call_op(lambda v: jnp.cumsum(v.reshape(-1), dtype=d), x)
+    return call_op(lambda v: jnp.cumsum(v, axis=axis, dtype=d), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return call_op(lambda v: jnp.cumprod(v, axis=dim, dtype=d), x)
+
+
+def _cummaxmin(x, axis, op, cmp):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else axis
+
+    def _cm(v):
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(op, vv, axis=ax)
+        n = vv.shape[ax]
+        pos = jnp.arange(n).reshape(
+            [-1 if i == (ax % vv.ndim) else 1 for i in range(vv.ndim)])
+        # index of the running extremum: latest position where vv equals vals
+        hit = jnp.where(cmp(vv, vals), pos, -1)
+        idx = jax.lax.associative_scan(jnp.maximum, hit, axis=ax)
+        return vals, idx.astype(jnp.int64)
+    return call_op(_cm, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cummaxmin(x, axis, jnp.maximum, lambda v, s: v >= s)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cummaxmin(x, axis, jnp.minimum, lambda v, s: v <= s)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return call_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                       axis2=axis2), ensure_tensor(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return call_op(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                          axis2=axis2), ensure_tensor(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    ts = [ensure_tensor(x)]
+    if prepend is not None:
+        ts.append(ensure_tensor(prepend))
+    if append is not None:
+        ts.append(ensure_tensor(append))
+
+    def _diff(*vs):
+        v = vs[0]
+        i = 1
+        pre = post = None
+        if prepend is not None:
+            pre = vs[i]; i += 1
+        if append is not None:
+            post = vs[i]
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=post)
+    return call_op(_diff, *ts)
+
+
+# -- matmul family (also exposed via linalg) ---------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return call_op(_mm, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                   input, x, y)
+
+
+def isfinite(x, name=None):
+    return call_op(jnp.isfinite, ensure_tensor(x).detach())
+
+
+def isinf(x, name=None):
+    return call_op(jnp.isinf, ensure_tensor(x).detach())
+
+
+def isnan(x, name=None):
+    return call_op(jnp.isnan, ensure_tensor(x).detach())
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + value
+    return x

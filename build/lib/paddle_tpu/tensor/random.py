@@ -1,0 +1,127 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Eager calls split the global key chain (framework.random); inside a jitted
+step an rng_scope provides the key so the same code is trace-safe.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import dtypes
+from ..framework.random import next_key
+from ._helpers import ensure_tensor
+from .creation import _shape, _d
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape),
+                                     dtype=_d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape),
+                                    dtype=_d(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), shp))
+    return Tensor(mean + std * jax.random.normal(
+        next_key(), _shape(shape), dtype=dtypes.get_default_dtype()))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape),
+                                     dtype=_d(dtype), minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=_d(dtype, jnp.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    d = dtypes.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high
+                                     ).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(
+        _d(dtype, jnp.int64)))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(
+        next_key(), x._value, tuple(x.shape)).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(
+        x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(next_key(), x._value).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits,
+                                     shape=(num_samples,) + v.shape[:-1]
+                                     if v.ndim > 1 else (num_samples,))
+        if v.ndim > 1:
+            out = jnp.moveaxis(out, 0, -1)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(next_key(), v.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = jax.random.exponential(next_key(), tuple(x.shape)).astype(
+        x.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    x._value = jax.random.uniform(next_key(), tuple(x.shape),
+                                  minval=min, maxval=max).astype(x.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = (mean + std * jax.random.normal(
+        next_key(), tuple(x.shape))).astype(x.dtype)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape)).astype(d))
+
+
+def randn_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape)).astype(d))

@@ -1,0 +1,199 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py).
+
+Dynamic-output-shape ops (nonzero, unique, masked_select) are eager-only —
+the same restriction XLA imposes; under jit users pass static alternatives.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..framework import dtypes
+from ._helpers import ensure_tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return call_op(lambda v: jnp.argmax(v, axis=axis,
+                                        keepdims=keepdim).astype(d), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return call_op(lambda v: jnp.argmin(v, axis=axis,
+                                        keepdims=keepdim).astype(d), x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def _as(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable or descending)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+    return call_op(_as, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def _s(v):
+        out = jnp.sort(v, axis=axis, stable=stable or descending)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return call_op(_s, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _tk(v):
+        vv = jnp.moveaxis(v, axis, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, axis),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, axis))
+    return call_op(_tk, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def _kv(v):
+        sv = jnp.sort(v, axis=axis)
+        si = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sv, k - 1, axis=axis)
+        idx = jnp.take(si, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+    return call_op(_kv, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def _mode(v):
+        sv_m = jnp.moveaxis(jnp.sort(v, axis=axis), axis, -1)
+        n = v.shape[axis]
+        pos = jnp.arange(n)
+        # new_run[i] marks the start of a run in the sorted sequence
+        new_run = jnp.concatenate(
+            [jnp.ones(sv_m.shape[:-1] + (1,), bool),
+             sv_m[..., 1:] != sv_m[..., :-1]], axis=-1)
+        # running max of the latest run-start position ≤ i
+        start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(new_run, pos, -1), axis=-1)
+        run_len = pos - start + 1
+        best = jnp.argmax(run_len, axis=-1)  # first longest run's end
+        vals = jnp.take_along_axis(sv_m, best[..., None], axis=-1)[..., 0]
+        # index of an occurrence of the mode in the original tensor
+        hits = jnp.moveaxis(v, axis, -1) == vals[..., None]
+        idx = jnp.argmax(hits, axis=-1)
+        if keepdim:
+            vals, idx = vals[..., None], idx[..., None]
+            return (jnp.moveaxis(vals, -1, axis),
+                    jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+        return vals, idx.astype(jnp.int64)
+    return call_op(_mode, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None], dtype=jnp.int64))
+                     for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask, name)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, values = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def _ssd(s, v):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            flat_s = s.reshape(-1, s.shape[-1])
+            flat_v = v.reshape(-1, v.shape[-1])
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                flat_s, flat_v).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return call_op(_ssd, ss, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    res = np.unique(arr, return_index=True, return_inverse=True,
+                    return_counts=True, axis=axis)
+    vals, idx, inv, cnt = res
+    outs = [Tensor(jnp.asarray(vals))]
+    d = dtypes.convert_dtype(dtype)
+    if return_index:
+        outs.append(Tensor(jnp.asarray(idx.astype(d))))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv.astype(d))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(cnt.astype(d))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        d = np.any(np.diff(arr, axis=axis) != 0,
+                   axis=tuple(i for i in range(arr.ndim) if i != axis))
+        keep = np.concatenate([[True], d])
+        arr = np.take(arr, np.nonzero(keep)[0], axis=axis)
+        return Tensor(jnp.asarray(arr))
+    vals = arr[keep]
+    outs = [Tensor(jnp.asarray(vals))]
+    dd = dtypes.convert_dtype(dtype)
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(dd))))
+    if return_counts:
+        pos = np.nonzero(keep)[0]
+        cnt = np.diff(np.concatenate([pos, [len(arr)]]))
+        outs.append(Tensor(jnp.asarray(cnt.astype(dd))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+import jax  # noqa: E402

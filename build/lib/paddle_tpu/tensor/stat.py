@@ -1,0 +1,51 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+import jax.numpy as jnp
+
+from ..framework.autograd import call_op
+from ._helpers import ensure_tensor
+from .math import mean  # noqa: F401 (re-export)
+
+
+def _axis(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.std(v, axis=_axis(axis),
+                                     ddof=1 if unbiased else 0,
+                                     keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.var(v, axis=_axis(axis),
+                                     ddof=1 if unbiased else 0,
+                                     keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.median(v, axis=_axis(axis),
+                                        keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.nanmedian(v, axis=_axis(axis),
+                                           keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_axis(axis),
+                                          keepdims=keepdim,
+                                          method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.nanquantile(v, jnp.asarray(q),
+                                             axis=_axis(axis),
+                                             keepdims=keepdim), x)

@@ -1,0 +1,275 @@
+"""paddle.text — NLP datasets + ViterbiDecoder (reference:
+python/paddle/text/datasets/{imdb,imikolov,movielens,uci_housing,wmt14,
+wmt16,conll05}.py, python/paddle/text/viterbi_decode.py).
+
+No network egress: like the vision datasets, each dataset yields a
+deterministic synthetic stand-in with the reference's shapes/dtypes/field
+structure (flagged ``.synthetic``) so downstream pipelines run end-to-end.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..io import Dataset
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "ViterbiDecoder", "viterbi_decode"]
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (word-id sequence, 0/1 label)."""
+    VOCAB = 5000
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        self.mode = mode.lower()
+        self.synthetic = True
+        n = 1024 if self.mode == "train" else 256
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        lens = rng.randint(20, 120, size=n)
+        self.labels = rng.randint(0, 2, size=n).astype("int64")
+        # label-dependent token distribution so models can learn
+        self.docs = [
+            ((rng.zipf(1.3, size=l) + self.labels[i] * 7) % self.VOCAB)
+            .astype("int64") for i, l in enumerate(lens)]
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray(self.labels[idx], dtype="int64")
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram language-model dataset."""
+    VOCAB = 2000
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        self.mode = mode.lower()
+        self.synthetic = True
+        self.window_size = window_size
+        n = 2048 if self.mode == "train" else 256
+        rng = np.random.RandomState(2 if self.mode == "train" else 3)
+        stream = (rng.zipf(1.2, size=n + window_size) % self.VOCAB) \
+            .astype("int64")
+        self.grams = [stream[i:i + window_size] for i in range(n)]
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        g = self.grams[idx]
+        return tuple(np.asarray(t, dtype="int64") for t in g)
+
+    def __len__(self):
+        return len(self.grams)
+
+
+class Movielens(Dataset):
+    """Rating prediction: (user features, movie features, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        self.mode = mode.lower()
+        self.synthetic = True
+        n = 1024 if self.mode == "train" else 128
+        rng = np.random.RandomState(rand_seed + (0 if self.mode == "train"
+                                                 else 1))
+        self.user_id = rng.randint(1, 6041, n).astype("int64")
+        self.gender = rng.randint(0, 2, n).astype("int64")
+        self.age = rng.randint(0, 7, n).astype("int64")
+        self.job = rng.randint(0, 21, n).astype("int64")
+        self.movie_id = rng.randint(1, 3953, n).astype("int64")
+        self.category = [rng.randint(0, 18, rng.randint(1, 4))
+                         .astype("int64") for _ in range(n)]
+        self.title = [rng.randint(0, 5000, rng.randint(1, 6))
+                      .astype("int64") for _ in range(n)]
+        self.rating = rng.randint(1, 6, n).astype("float32")
+
+    def __getitem__(self, idx):
+        return (np.asarray(self.user_id[idx]), np.asarray(self.gender[idx]),
+                np.asarray(self.age[idx]), np.asarray(self.job[idx]),
+                np.asarray(self.movie_id[idx]), self.category[idx],
+                self.title[idx], np.asarray(self.rating[idx]))
+
+    def __len__(self):
+        return len(self.user_id)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        self.mode = mode.lower()
+        self.synthetic = True
+        n = 404 if self.mode == "train" else 102
+        rng = np.random.RandomState(4 if self.mode == "train" else 5)
+        self.data = rng.randn(n, 13).astype("float32")
+        w = np.linspace(-1, 1, 13).astype("float32")
+        self.labels = (self.data @ w + 0.1 * rng.randn(n)) \
+            .astype("float32")[:, None]
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _SyntheticTranslation(Dataset):
+    SRC_VOCAB = 3000
+    TRG_VOCAB = 3000
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, src_dict_size=-1, trg_dict_size=-1, mode="train",
+                 data_file=None, download=True, seed=0):
+        self.mode = mode.lower()
+        self.synthetic = True
+        self.src_dict_size = (self.SRC_VOCAB if src_dict_size in (-1, None)
+                              else min(src_dict_size, self.SRC_VOCAB))
+        self.trg_dict_size = (self.TRG_VOCAB if trg_dict_size in (-1, None)
+                              else min(trg_dict_size, self.TRG_VOCAB))
+        n = {"train": 1024, "test": 128, "dev": 128,
+             "val": 128}.get(self.mode, 256)
+        rng = np.random.RandomState(seed + {"train": 0, "test": 1}.get(
+            self.mode, 2))
+        lens = rng.randint(4, 30, size=n)
+        self.src = [(rng.zipf(1.2, l) % (self.src_dict_size - 3) + 3)
+                    .astype("int64") for l in lens]
+        # "translation": deterministic transform of source ids
+        self.trg = [((s * 7 + 13) % (self.trg_dict_size - 3) + 3)
+                    .astype("int64") for s in self.src]
+
+    def __getitem__(self, idx):
+        src = self.src[idx]
+        trg = self.trg[idx]
+        trg_in = np.concatenate([[self.BOS], trg])
+        trg_out = np.concatenate([trg, [self.EOS]])
+        return src, trg_in, trg_out
+
+    def __len__(self):
+        return len(self.src)
+
+
+class WMT14(_SyntheticTranslation):
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        super().__init__(dict_size, dict_size, mode, seed=10)
+
+
+class WMT16(_SyntheticTranslation):
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        super().__init__(src_dict_size, trg_dict_size, mode, seed=20)
+
+
+class Conll05st(Dataset):
+    """SRL dataset: word/predicate/context/mark sequences + label seq."""
+    WORD_VOCAB = 4000
+    LABEL_N = 67
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self.synthetic = True
+        n = 512
+        rng = np.random.RandomState(6)
+        lens = rng.randint(5, 40, size=n)
+        self.words = [rng.randint(0, self.WORD_VOCAB, l).astype("int64")
+                      for l in lens]
+        self.preds = [np.full(l, rng.randint(0, self.WORD_VOCAB),
+                              dtype="int64") for l in lens]
+        self.marks = [rng.randint(0, 2, l).astype("int64") for l in lens]
+        self.labels = [rng.randint(0, self.LABEL_N, l).astype("int64")
+                       for l in lens]
+
+    def __getitem__(self, idx):
+        w = self.words[idx]
+        return (w, w, w, w, w, w, self.preds[idx], self.marks[idx],
+                self.labels[idx])
+
+    def __len__(self):
+        return len(self.words)
+
+
+# -- Viterbi decoding ---------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference: python/paddle/text/viterbi_decode.py
+    over phi viterbi_decode kernel).
+
+    TPU-native: the DP recursion is a ``lax.scan`` over time with a [B, N]
+    score carry and argmax backtrace — static shapes, fully on-device.
+
+    Args:
+        potentials: [B, T, N] unary scores.
+        transition_params: [N, N] transition scores.
+        lengths: [B] int64 actual sequence lengths.
+    Returns:
+        (scores [B], paths [B, T] int64; positions past length are 0).
+    """
+    import jax
+
+    def impl(pots, trans, lens):
+        B, T, N = pots.shape
+        if include_bos_eos_tag:
+            # reference convention: tag N-2 = BOS, N-1 = EOS
+            bos_mask = jnp.full((N,), -1e4).at[:N - 2].set(0.0)
+            init = pots[:, 0] + trans[N - 2][None, :] + bos_mask[None, :]
+        else:
+            init = pots[:, 0]
+
+        def step(carry, t):
+            alpha = carry                       # [B, N]
+            scores = alpha[:, :, None] + trans[None, :, :]   # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)           # [B, N]
+            best_score = jnp.max(scores, axis=1) + pots[:, t]
+            valid = (t < lens)[:, None]
+            alpha_new = jnp.where(valid, best_score, alpha)
+            return alpha_new, jnp.where(valid, best_prev, -1)
+
+        alpha, backptrs = jax.lax.scan(step, init, jnp.arange(1, T))
+        # backptrs: [T-1, B, N]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]
+        last_tag = jnp.argmax(alpha, axis=1)                  # [B]
+        score = jnp.max(alpha, axis=1)
+
+        def backstep(carry, bp_t):
+            tag = carry                                        # [B]
+            prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
+            prev = jnp.where(prev < 0, tag, prev)
+            return prev, tag
+
+        # reverse scan: ys[i] = tag at time i+1, final carry = tag at time 0
+        tag0, tags_later = jax.lax.scan(backstep, last_tag, backptrs,
+                                        reverse=True)
+        paths = jnp.concatenate(
+            [tag0[:, None], jnp.moveaxis(tags_later, 0, 1)], axis=1)  # [B,T]
+        t_idx = jnp.arange(T)[None, :]
+        paths = jnp.where(t_idx < lens[:, None], paths, 0)
+        return score, paths.astype(jnp.int64)
+
+    pots = potentials._value if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._value \
+        if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    lens = lengths._value if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+    score, paths = impl(pots, trans, lens)
+    return Tensor(score), Tensor(paths)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

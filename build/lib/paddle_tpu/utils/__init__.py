@@ -1,0 +1,68 @@
+"""Utilities (reference: python/paddle/utils/)."""
+import jax
+
+__all__ = ["run_check", "try_import", "unique_name", "deprecated"]
+
+
+def run_check():
+    devs = jax.devices()
+    print(f"paddle_tpu is installed; found {len(devs)} device(s): "
+          f"{[str(d) for d in devs]}")
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    print("paddle_tpu run_check passed: compute OK on", devs[0].platform)
+    if len(devs) > 1:
+        print(f"multi-device: {len(devs)} devices available for sharding")
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+class _UniqueName:
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def guard(self, new_generator=None):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def g():
+            yield
+        return g()
+
+
+unique_name = _UniqueName()
+
+
+def deprecated(since="", update_to="", reason="", level=0):
+    def decorator(fn):
+        return fn
+    return decorator
+
+
+def flatten(nest):
+    out = []
+
+    def _walk(x):
+        if isinstance(x, (list, tuple)):
+            for v in x:
+                _walk(v)
+        elif isinstance(x, dict):
+            for v in x.values():
+                _walk(v)
+        else:
+            out.append(x)
+    _walk(nest)
+    return out

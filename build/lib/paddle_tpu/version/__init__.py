@@ -1,0 +1,22 @@
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = True
+commit = "unknown"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (TPU/XLA backend)")
+
+
+def cuda():
+    return False
+
+
+def tpu():
+    return True

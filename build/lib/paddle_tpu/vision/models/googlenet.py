@@ -1,0 +1,222 @@
+"""GoogLeNet + InceptionV3 (reference: python/paddle/vision/models/
+googlenet.py, inceptionv3.py)."""
+from ... import nn
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+class _BasicConv2d(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.branch1 = _BasicConv2d(in_c, c1, 1)
+        self.branch2 = nn.Sequential(_BasicConv2d(in_c, c3r, 1),
+                                     _BasicConv2d(c3r, c3, 3, padding=1))
+        self.branch3 = nn.Sequential(_BasicConv2d(in_c, c5r, 1),
+                                     _BasicConv2d(c5r, c5, 5, padding=2))
+        self.branch4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                     _BasicConv2d(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                       self.branch4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _BasicConv2d(3, 64, 7, stride=2, padding=3)
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.conv2 = _BasicConv2d(64, 64, 1)
+        self.conv3 = _BasicConv2d(64, 192, 3, padding=1)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inception3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inception3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inception4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inception4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inception4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inception4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inception4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool5 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inception5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inception5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool3(self.conv3(self.conv2(x)))
+        x = self.pool4(self.inception3b(self.inception3a(x)))
+        x = self.inception4e(self.inception4d(self.inception4c(
+            self.inception4b(self.inception4a(x)))))
+        x = self.pool5(x)
+        x = self.inception5b(self.inception5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained unavailable offline; use paddle.load")
+    return GoogLeNet(**kwargs)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.branch1x1 = _BasicConv2d(in_c, 64, 1)
+        self.branch5x5 = nn.Sequential(_BasicConv2d(in_c, 48, 1),
+                                       _BasicConv2d(48, 64, 5, padding=2))
+        self.branch3x3dbl = nn.Sequential(
+            _BasicConv2d(in_c, 64, 1), _BasicConv2d(64, 96, 3, padding=1),
+            _BasicConv2d(96, 96, 3, padding=1))
+        self.branch_pool = nn.Sequential(
+            nn.AvgPool2D(3, stride=1, padding=1),
+            _BasicConv2d(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.branch1x1(x), self.branch5x5(x),
+                       self.branch3x3dbl(x), self.branch_pool(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.branch3x3 = _BasicConv2d(in_c, 384, 3, stride=2)
+        self.branch3x3dbl = nn.Sequential(
+            _BasicConv2d(in_c, 64, 1), _BasicConv2d(64, 96, 3, padding=1),
+            _BasicConv2d(96, 96, 3, stride=2))
+        self.branch_pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.branch3x3(x), self.branch3x3dbl(x),
+                       self.branch_pool(x)], axis=1)
+
+
+class _Conv1xN(nn.Layer):
+    """1x7 then 7x1 factorized conv pair."""
+
+    def __init__(self, in_c, mid, out_c, n=7):
+        super().__init__()
+        p = n // 2
+        self.a = _BasicConv2d(in_c, mid, (1, n), padding=(0, p))
+        self.b = _BasicConv2d(mid, out_c, (n, 1), padding=(p, 0))
+
+    def forward(self, x):
+        return self.b(self.a(x))
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.branch1x1 = _BasicConv2d(in_c, 192, 1)
+        self.branch7x7 = nn.Sequential(_BasicConv2d(in_c, c7, 1),
+                                       _Conv1xN(c7, c7, 192))
+        self.branch7x7dbl = nn.Sequential(
+            _BasicConv2d(in_c, c7, 1), _Conv1xN(c7, c7, c7),
+            _Conv1xN(c7, c7, 192))
+        self.branch_pool = nn.Sequential(
+            nn.AvgPool2D(3, stride=1, padding=1), _BasicConv2d(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.branch1x1(x), self.branch7x7(x),
+                       self.branch7x7dbl(x), self.branch_pool(x)], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.branch3x3 = nn.Sequential(_BasicConv2d(in_c, 192, 1),
+                                       _BasicConv2d(192, 320, 3, stride=2))
+        self.branch7x7x3 = nn.Sequential(
+            _BasicConv2d(in_c, 192, 1), _Conv1xN(192, 192, 192),
+            _BasicConv2d(192, 192, 3, stride=2))
+        self.branch_pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.branch3x3(x), self.branch7x7x3(x),
+                       self.branch_pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.branch1x1 = _BasicConv2d(in_c, 320, 1)
+        self.branch3x3_1 = _BasicConv2d(in_c, 384, 1)
+        self.branch3x3_2a = _BasicConv2d(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = _BasicConv2d(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = nn.Sequential(
+            _BasicConv2d(in_c, 448, 1), _BasicConv2d(448, 384, 3, padding=1))
+        self.branch3x3dbl_3a = _BasicConv2d(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _BasicConv2d(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = nn.Sequential(
+            nn.AvgPool2D(3, stride=1, padding=1), _BasicConv2d(in_c, 192, 1))
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = concat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], axis=1)
+        bd = self.branch3x3dbl_1(x)
+        bd = concat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)],
+                    axis=1)
+        return concat([b1, b3, bd, self.branch_pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BasicConv2d(3, 32, 3, stride=2), _BasicConv2d(32, 32, 3),
+            _BasicConv2d(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _BasicConv2d(64, 80, 1), _BasicConv2d(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.inception_block = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.inception_block(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained unavailable offline; use paddle.load")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,267 @@
+"""MobileNet V1/V2/V3 (reference: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py, mobilenetv3.py)."""
+from ... import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3Small",
+           "MobileNetV3Large", "mobilenet_v1", "mobilenet_v2",
+           "mobilenet_v3_small", "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        acts = {"relu": nn.ReLU(), "relu6": nn.ReLU6(),
+                "hardswish": nn.Hardswish(), None: nn.Identity()}
+        self.act = acts[act]
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c1, out_c2, stride, scale):
+        super().__init__()
+        self.dw = ConvBNLayer(in_c, int(out_c1 * scale), 3, stride=stride,
+                              padding=1, groups=in_c)
+        self.pw = ConvBNLayer(int(out_c1 * scale), int(out_c2 * scale), 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [(32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+               (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+               (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+               (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+               (1024, 1024, 1024, 1)]
+        blocks = [DepthwiseSeparable(int(i * scale), o1, o2, s, scale)
+                  for i, o1, o2, s in cfg]
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden_dim = int(round(inp * expand_ratio))
+        self.use_res_connect = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(inp, hidden_dim, 1, act="relu6"))
+        layers += [
+            ConvBNLayer(hidden_dim, hidden_dim, 3, stride=stride, padding=1,
+                        groups=hidden_dim, act="relu6"),
+            ConvBNLayer(hidden_dim, oup, 1, act=None),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res_connect else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = _make_divisible(32 * scale)
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        features = [ConvBNLayer(3, input_channel, 3, stride=2, padding=1,
+                                act="relu6")]
+        for t, c, n, s in cfg:
+            output_channel = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, output_channel, s if i == 0 else 1, t))
+                input_channel = output_channel
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(ConvBNLayer(input_channel, self.last_channel, 1,
+                                    act="relu6"))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(self.last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, channel, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(channel // reduction)
+        self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channel, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, channel, 1)
+        self.hsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avg_pool(x)
+        s = self.hsigmoid(self.fc2(self.relu(self.fc1(s))))
+        return x * s
+
+
+class InvertedResidualV3(nn.Layer):
+    def __init__(self, inp, hidden, oup, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if hidden != inp:
+            layers.append(ConvBNLayer(inp, hidden, 1, act=act))
+        layers.append(ConvBNLayer(hidden, hidden, kernel, stride=stride,
+                                  padding=kernel // 2, groups=hidden,
+                                  act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(hidden))
+        layers.append(ConvBNLayer(hidden, oup, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, in_c, 3, stride=2, padding=1,
+                              act="hardswish")]
+        for k, exp, c, se, act, s in cfg:
+            out_c = _make_divisible(c * scale)
+            hid = _make_divisible(exp * scale)
+            layers.append(InvertedResidualV3(in_c, hid, out_c, k, s, se,
+                                             act))
+            in_c = out_c
+        last_conv = _make_divisible(cfg[-1][1] * scale)
+        layers.append(ConvBNLayer(in_c, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            # k, exp, c, se, act, s
+            (3, 16, 16, True, "relu", 2),
+            (3, 72, 24, False, "relu", 2),
+            (3, 88, 24, False, "relu", 1),
+            (5, 96, 40, True, "hardswish", 2),
+            (5, 240, 40, True, "hardswish", 1),
+            (5, 240, 40, True, "hardswish", 1),
+            (5, 120, 48, True, "hardswish", 1),
+            (5, 144, 48, True, "hardswish", 1),
+            (5, 288, 96, True, "hardswish", 2),
+            (5, 576, 96, True, "hardswish", 1),
+            (5, 576, 96, True, "hardswish", 1),
+        ]
+        super().__init__(cfg, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, False, "relu", 1),
+            (3, 64, 24, False, "relu", 2),
+            (3, 72, 24, False, "relu", 1),
+            (5, 72, 40, True, "relu", 2),
+            (5, 120, 40, True, "relu", 1),
+            (5, 120, 40, True, "relu", 1),
+            (3, 240, 80, False, "hardswish", 2),
+            (3, 200, 80, False, "hardswish", 1),
+            (3, 184, 80, False, "hardswish", 1),
+            (3, 184, 80, False, "hardswish", 1),
+            (3, 480, 112, True, "hardswish", 1),
+            (3, 672, 112, True, "hardswish", 1),
+            (5, 672, 160, True, "hardswish", 2),
+            (5, 960, 160, True, "hardswish", 1),
+            (5, 960, 160, True, "hardswish", 1),
+        ]
+        super().__init__(cfg, 1280, scale, num_classes, with_pool)
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise ValueError("pretrained unavailable offline; use paddle.load")
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,133 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from ... import nn
+from ...tensor.manipulation import concat, flatten, reshape, transpose, split
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = {"relu": nn.ReLU(), "swish": nn.Swish(),
+                    None: nn.Identity()}[act]
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(branch_c, branch_c, 1, act=act),
+                _ConvBNAct(branch_c, branch_c, 3, stride=1, padding=1,
+                           groups=branch_c, act=None),
+                _ConvBNAct(branch_c, branch_c, 1, act=act))
+        else:
+            self.branch1 = nn.Sequential(
+                _ConvBNAct(in_c, in_c, 3, stride=stride, padding=1,
+                           groups=in_c, act=None),
+                _ConvBNAct(in_c, branch_c, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(in_c, branch_c, 1, act=act),
+                _ConvBNAct(branch_c, branch_c, 3, stride=stride, padding=1,
+                           groups=branch_c, act=None),
+                _ConvBNAct(branch_c, branch_c, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        channels = {
+            0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+        }[scale]
+        self.conv1 = _ConvBNAct(3, channels[0], 3, stride=2, padding=1,
+                                act=act)
+        self.max_pool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        blocks = []
+        in_c = channels[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_c = channels[stage + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidual(in_c, out_c,
+                                               2 if i == 0 else 1, act))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _ConvBNAct(in_c, channels[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained unavailable offline; use paddle.load")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kwargs)

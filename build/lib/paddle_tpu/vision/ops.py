@@ -1,0 +1,309 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv2d CUDA kernels).  XLA-composable implementations."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["nms", "roi_align", "box_coder", "yolo_box", "deform_conv2d",
+           "roi_pool", "psroi_pool", "DeformConv2D"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    import numpy as np
+    b = np.asarray(ensure_tensor(boxes)._value)
+    s = np.asarray(ensure_tensor(scores)._value) if scores is not None \
+        else np.arange(len(b))[::-1].astype("float32")
+    order = np.argsort(-s)
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        area_o = ((b[order[1:], 2] - b[order[1:], 0]) *
+                  (b[order[1:], 3] - b[order[1:], 1]))
+        iou = inter / (area_i + area_o - inter + 1e-9)
+        order = order[1:][iou <= iou_threshold]
+    keep = np.asarray(keep, dtype="int64")
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _ra(feat, bxs):
+        N, C, H, W = feat.shape
+        offset = 0.5 if aligned else 0.0
+
+        def one_box(box):
+            x1, y1, x2, y2 = box * spatial_scale - offset
+            bw = jnp.maximum(x2 - x1, 1.0)
+            bh = jnp.maximum(y2 - y1, 1.0)
+            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            f = feat[0]
+            v = (f[:, y0, x0] * (1 - wy) * (1 - wx) +
+                 f[:, y1i, x0] * wy * (1 - wx) +
+                 f[:, y0, x1i] * (1 - wy) * wx +
+                 f[:, y1i, x1i] * wy * wx)
+            return v
+        return jax.vmap(one_box)(bxs)
+    return call_op(_ra, x, boxes)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode",
+              box_normalized=True, axis=0, name=None):
+    raise NotImplementedError("box_coder lands with the detection suite")
+
+
+def yolo_box(*args, **kwargs):
+    raise NotImplementedError("yolo_box lands with the detection suite")
+
+
+def _bilinear_sample(img, y, x):
+    """img [C,H,W]; y/x arbitrary same-shaped float coords → [C, *coords].
+    Zero padding outside (reference deform-conv border handling)."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+
+    def tap(yi, xi, w):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        vals = img[:, yc, xc]                    # [C, *coords]
+        return vals * (w * valid)[None]
+    return (tap(y0, x0, wy0 * wx0) + tap(y0, x1, wy0 * wx1) +
+            tap(y1, x0, wy1 * wx0) + tap(y1, x1, wy1 * wx1))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: python/paddle/vision/ops.py
+    deform_conv2d over paddle/phi/kernels/gpu/deformable_conv_kernel.cu).
+
+    TPU-native: bilinear gather at offset sample points (vectorized over
+    batch/taps with vmap — XLA lowers to gathers) followed by one big
+    matmul over (C_in·K) — the im2col+GEMM formulation on the MXU.
+    x: [N,C,H,W]; offset: [N, 2·K·dg, Ho, Wo]; weight: [Co, C/groups, kh,
+    kw]; mask (v2): [N, K·dg, Ho, Wo].
+    """
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups/deformable_groups > 1 not "
+                                  "supported yet")
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    ts = [ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)]
+    if mask is not None:
+        ts.append(ensure_tensor(mask))
+    if bias is not None:
+        ts.append(ensure_tensor(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def impl(xv, offv, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[-1] if has_bias else None
+        N, C, H, W = xv.shape
+        Co, Ci, kh, kw = wv.shape
+        K = kh * kw
+        Ho = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        Wo = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        # base sampling grid per tap: [K, Ho, Wo]
+        oy, ox = jnp.meshgrid(jnp.arange(Ho), jnp.arange(Wo), indexing="ij")
+        ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+        base_y = (oy[None] * stride[0] - padding[0]
+                  + ky.reshape(-1)[:, None, None] * dilation[0])
+        base_x = (ox[None] * stride[1] - padding[1]
+                  + kx.reshape(-1)[:, None, None] * dilation[1])
+        off = offv.reshape(N, K, 2, Ho, Wo)     # paddle layout: (dy, dx)
+        sy = base_y[None] + off[:, :, 0]
+        sx = base_x[None] + off[:, :, 1]        # [N, K, Ho, Wo]
+
+        def per_image(img, yy, xx, m):
+            samples = _bilinear_sample(img, yy, xx)   # [C, K, Ho, Wo]
+            if m is not None:
+                samples = samples * m[None]
+            return samples
+        if mv is not None:
+            mk = mv.reshape(N, K, Ho, Wo)
+            samples = jax.vmap(per_image)(xv, sy, sx, mk)
+        else:
+            samples = jax.vmap(lambda i, a, b: per_image(i, a, b, None))(
+                xv, sy, sx)
+        # [N, C, K, Ho, Wo] × [Co, C, K] → [N, Co, Ho, Wo]  (one GEMM)
+        out = jnp.einsum("nckhw,ock->nohw", samples,
+                         wv.reshape(Co, Ci, K),
+                         preferred_element_type=jnp.float32)
+        out = out.astype(xv.dtype)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+    return call_op(impl, *ts)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max ROI pooling (reference: ops.roi_pool).  boxes: [R, 4] xyxy.
+
+    Implementation note: each output bin reduces a full-map mask, costing
+    ph·pw full passes per ROI.  This preserves the reference's
+    floor/ceil OVERLAPPING bin boundaries exactly; a single-pass
+    segment-reduce would be ~ph·pw× cheaper but assigns boundary pixels
+    to one bin only, silently diverging from the reference at bin edges.
+    ROI ops are not on this framework's hot path, so exactness wins."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(xv, bv):
+        # single-image path (boxes_num per-image batching: image 0)
+        N, C, H, W = xv.shape
+        if N != 1:
+            raise NotImplementedError(
+                "roi_pool currently supports a single image per call; "
+                "split the batch and concatenate results")
+
+        def one_box(box):
+            x1, y1, x2, y2 = [box[i] * spatial_scale for i in range(4)]
+            x1, y1 = jnp.round(x1), jnp.round(y1)
+            x2, y2 = jnp.round(x2), jnp.round(y2)
+            bw = jnp.maximum(x2 - x1 + 1, 1.0)
+            bh = jnp.maximum(y2 - y1 + 1, 1.0)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            out = jnp.zeros((C, ph, pw), xv.dtype)
+            for i in range(ph):
+                for j in range(pw):
+                    hs = jnp.floor(y1 + bh * i / ph)
+                    he = jnp.ceil(y1 + bh * (i + 1) / ph)
+                    ws = jnp.floor(x1 + bw * j / pw)
+                    we = jnp.ceil(x1 + bw * (j + 1) / pw)
+                    row_m = (ys >= hs) & (ys < he)
+                    col_m = (xs >= ws) & (xs < we)
+                    m = row_m[:, None] & col_m[None, :]
+                    lowest = (jnp.finfo(xv.dtype).min
+                              if jnp.issubdtype(xv.dtype, jnp.floating)
+                              else jnp.iinfo(xv.dtype).min)
+                    cell = jnp.where(m[None], xv[0], lowest)
+                    val = cell.max(axis=(1, 2))
+                    val = jnp.where(m.any(), val, 0.0)
+                    out = out.at[:, i, j].set(val)
+            return out
+        return jax.vmap(one_box)(bv)
+    return call_op(impl, ensure_tensor(x), ensure_tensor(boxes))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI pooling (reference: ops.psroi_pool): input
+    channels C = out_c·ph·pw; bin (i,j) averages channel block (i·pw+j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(xv, bv):
+        N, C, H, W = xv.shape
+        if N != 1:
+            raise NotImplementedError(
+                "psroi_pool currently supports a single image per call; "
+                "split the batch and concatenate results")
+        if C % (ph * pw) != 0 or C < ph * pw:
+            raise ValueError(
+                f"psroi_pool needs channels divisible by output h*w "
+                f"({ph}*{pw}); got C={C}")
+        out_c = C // (ph * pw)
+
+        def one_box(box):
+            x1, y1, x2, y2 = [box[i] * spatial_scale for i in range(4)]
+            bw = jnp.maximum(x2 - x1, 0.1)
+            bh = jnp.maximum(y2 - y1, 0.1)
+            ys = jnp.arange(H, dtype=jnp.float32) + 0.5
+            xs = jnp.arange(W, dtype=jnp.float32) + 0.5
+            out = jnp.zeros((out_c, ph, pw), xv.dtype)
+            for i in range(ph):
+                for j in range(pw):
+                    hs = y1 + bh * i / ph
+                    he = y1 + bh * (i + 1) / ph
+                    ws = x1 + bw * j / pw
+                    we = x1 + bw * (j + 1) / pw
+                    m = ((ys >= hs) & (ys < he))[:, None] & \
+                        ((xs >= ws) & (xs < we))[None, :]
+                    count = jnp.maximum(m.sum(), 1)
+                    # channel-major blocks: out channel c reads input
+                    # channel c·ph·pw + i·pw + j (R-FCN convention)
+                    ch = jnp.arange(out_c) * (ph * pw) + i * pw + j
+                    blk = xv[0, ch]
+                    val = (blk * m[None]).sum(axis=(1, 2)) / count
+                    out = out.at[:, i, j].set(val)
+            return out
+        return jax.vmap(one_box)(bv)
+    return call_op(impl, ensure_tensor(x), ensure_tensor(boxes))
+
+
+from ..nn.layer.layers import Layer as _Layer
+from ..nn import initializer as _I
+
+
+class DeformConv2D(_Layer):
+    """Layer wrapper (reference: paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        import numpy as _np
+        k = 1.0 / float(_np.sqrt(in_channels * ks[0] * ks[1]))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr, default_initializer=_I.Uniform(-k, k))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=_I.Uniform(-k, k))
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)

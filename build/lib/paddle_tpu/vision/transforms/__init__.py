@@ -1,0 +1,532 @@
+"""Transforms (reference: python/paddle/vision/transforms/) — numpy
+(HWC) implementations; ToTensor produces CHW float32."""
+import numbers
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomRotation",
+           "to_tensor", "normalize", "resize", "hflip", "vflip",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "Grayscale", "RandomResizedCrop", "RandomErasing",
+           "RandomAffine", "crop", "center_crop", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue",
+           "to_grayscale", "erase", "rotate"]
+
+
+def _np_img(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _np_img(img).astype("float32")
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    if arr.max() > 1.5:  # uint8 range
+        arr = arr / 255.0
+    return Tensor(arr)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _np_img(img).astype("float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _np_img(img).astype("float32")
+        m = np.asarray(self.mean, dtype="float32")
+        s = np.asarray(self.std, dtype="float32")
+        if self.data_format == "CHW":
+            c = arr.shape[0]
+            m = m[:c].reshape(-1, 1, 1)
+            s = s[:c].reshape(-1, 1, 1)
+        else:
+            c = arr.shape[-1]
+            m, s = m[:c], s[:c]
+        out = (arr - m) / s
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _np_img(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    import jax
+    import jax.numpy as jnp
+    out_shape = tuple(size) + arr.shape[2:]
+    out = np.asarray(jax.image.resize(jnp.asarray(arr), out_shape,
+                                      method="linear"))
+    return out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, int):
+                p = (p, p)
+            arr = np.pad(arr, [(p[0], p[0]), (p[1], p[1])] +
+                         [(0, 0)] * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        return arr[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return _np_img(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np_img(img)[::-1].copy()
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return _np_img(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _np_img(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_brightness(img, factor)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = (padding, padding) if isinstance(padding, int) \
+            else padding
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        p = self.padding
+        return np.pad(arr, [(p[1], p[1]), (p[0], p[0])] +
+                      [(0, 0)] * (arr.ndim - 2))
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        k = np.random.randint(0, 4)
+        return np.rot90(arr, k, axes=(0, 1)).copy()
+
+
+# -- extended functional surface (reference:
+# python/paddle/vision/transforms/functional.py) -----------------------------
+
+def _value_range(arr):
+    """Image value scale: dtype is authoritative (a near-black uint8 image
+    must still be treated as [0, 255]); the max() heuristic only
+    disambiguates floats."""
+    if np.issubdtype(np.asarray(arr).dtype, np.integer):
+        return 255.0
+    return 255.0 if np.asarray(arr).max() > 1.5 else 1.0
+
+
+def crop(img, top, left, height, width):
+    arr = _np_img(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np_img(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return arr[top:top + th, left:left + tw]
+
+
+def _restore_dtype(out, arr0):
+    if np.issubdtype(np.asarray(arr0).dtype, np.integer):
+        return np.round(out).astype(np.asarray(arr0).dtype)
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr0 = _np_img(img)
+    vr = _value_range(arr0)
+    arr = arr0.astype("float32")
+    return _restore_dtype(np.clip(arr * brightness_factor, 0, vr), arr0)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr0 = _np_img(img)
+    vr = _value_range(arr0)
+    arr = arr0.astype("float32")
+    mean = arr.mean()
+    return _restore_dtype(
+        np.clip(mean + (arr - mean) * contrast_factor, 0, vr), arr0)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr0 = _np_img(img)
+    vr = _value_range(arr0)
+    arr = arr0.astype("float32")
+    gray = arr.mean(axis=-1, keepdims=True) if arr.ndim == 3 else arr
+    return _restore_dtype(
+        np.clip(gray + (arr - gray) * saturation_factor, 0, vr), arr0)
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate hue by hue_factor (in [-0.5, 0.5]) via RGB→HSV→RGB."""
+    arr0 = _np_img(img)
+    scale = _value_range(arr0)
+    arr = arr0.astype("float32")
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return arr
+    x = arr / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x.max(-1)
+    minc = x.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(d, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype("int32") % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    return _restore_dtype(
+        np.clip(np.stack([r2, g2, b2], axis=-1) * scale, 0, scale), arr0)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _np_img(img).astype("float32")
+    if arr.ndim == 3 and arr.shape[-1] >= 3:
+        gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])
+    else:
+        gray = arr.reshape(arr.shape[:2])
+    out = gray[..., None]
+    if num_output_channels == 3:
+        out = np.repeat(out, 3, axis=-1)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _np_img(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Arbitrary-angle rotation via inverse-mapped nearest-neighbor
+    sampling (90-degree multiples take the exact np.rot90 path)."""
+    arr = _np_img(img)
+    if angle % 90 == 0:
+        return np.rot90(arr, int(angle // 90) % 4, axes=(0, 1)).copy()
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    theta = np.deg2rad(angle)
+    cos_t, sin_t = np.cos(theta), np.sin(theta)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # inverse map: source coords that land on each destination pixel
+    ys = cy + (yy - cy) * cos_t + (xx - cx) * sin_t
+    xs = cx - (yy - cy) * sin_t + (xx - cx) * cos_t
+    yi = np.round(ys).astype(np.int64)
+    xi = np.round(xs).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, factor)
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue in random order
+    (reference: transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            f = 1 + np.random.uniform(-self.brightness, self.brightness)
+            ops.append(lambda a, f=f: adjust_brightness(a, f))
+        if self.contrast:
+            f = 1 + np.random.uniform(-self.contrast, self.contrast)
+            ops.append(lambda a, f=f: adjust_contrast(a, f))
+        if self.saturation:
+            f = 1 + np.random.uniform(-self.saturation, self.saturation)
+            ops.append(lambda a, f=f: adjust_saturation(a, f))
+        if self.hue:
+            f = np.random.uniform(-self.hue, self.hue)
+            ops.append(lambda a, f=f: adjust_hue(a, f))
+        np.random.shuffle(ops)
+        arr = _np_img(img)
+        for op in ops:
+            arr = op(arr)
+        return arr
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (reference:
+    transforms.RandomResizedCrop — the ImageNet training transform)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * np.random.uniform(*self.scale)
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                arr2 = arr[top:top + ch, left:left + cw]
+                return resize(arr2, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            aspect = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / aspect)))
+            ew = int(round(np.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                top = np.random.randint(0, h - eh)
+                left = np.random.randint(0, w - ew)
+                return erase(arr, top, left, eh, ew, self.value)
+        return arr
+
+
+class RandomAffine(BaseTransform):
+    """Random translation/flip-based affine (rotation snapped to 90° —
+    nearest-grid semantics, no interpolation deps in this image)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        angle = np.random.uniform(*self.degrees)
+        arr = rotate(arr, angle)
+        if self.translate is not None:
+            h, w = arr.shape[:2]
+            tx = int(np.random.uniform(-self.translate[0], self.translate[0])
+                     * w)
+            ty = int(np.random.uniform(-self.translate[1], self.translate[1])
+                     * h)
+            arr = np.roll(np.roll(arr, ty, axis=0), tx, axis=1)
+        return arr

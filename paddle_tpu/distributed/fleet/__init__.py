@@ -8,7 +8,9 @@ from .base.topology import (CommunicateTopology,  # noqa: F401
                             HybridCommunicateGroup)
 from .fleet import (Fleet, init, distributed_model,  # noqa: F401
                     distributed_optimizer, get_hybrid_communicate_group,
-                    worker_num, worker_index, is_first_worker, barrier_worker)
+                    worker_num, worker_index, is_first_worker,
+                    barrier_worker, save_persistables, stop_worker,
+                    register_ps_client)
 from . import utils  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import elastic  # noqa: F401

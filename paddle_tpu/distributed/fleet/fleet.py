@@ -49,8 +49,10 @@ class Fleet:
 
     def distributed_model(self, model):
         from .meta_parallel import wrap_distributed_model
-        return wrap_distributed_model(model, _FLEET["strategy"],
-                                      _FLEET["hcg"])
+        wrapped = wrap_distributed_model(model, _FLEET["strategy"],
+                                         _FLEET["hcg"])
+        _FLEET["model"] = wrapped
+        return wrapped
 
     def distributed_optimizer(self, optimizer, strategy=None):
         from .meta_parallel import HybridParallelOptimizer
@@ -86,10 +88,36 @@ class Fleet:
 
     def save_persistables(self, executor=None, dirname=None,
                           main_program=None, mode=0):
-        pass
+        """Save trainable state (reference: fleet.save_persistables —
+        PS mode saves the server tables, collective mode the program
+        persistables).  Here: a registered PS client saves its tables;
+        otherwise the last distributed_model's state_dict is written as
+        a sharded distributed checkpoint."""
+        if dirname is None:
+            raise ValueError("save_persistables needs dirname")
+        client = _FLEET.get("ps_client")
+        if client is not None:
+            client.save_persistables(dirname)
+            return
+        model = _FLEET.get("model")
+        if model is None:
+            raise RuntimeError(
+                "save_persistables: no PS client registered and no model "
+                "wrapped via fleet.distributed_model yet")
+        from ..checkpoint import save_state_dict
+        save_state_dict(model.state_dict(), dirname)
+
+    def register_ps_client(self, client):
+        """Attach a distributed.ps.PSClient so save_persistables /
+        stop_worker drive the parameter-server runtime."""
+        _FLEET["ps_client"] = client
 
     def stop_worker(self):
-        pass
+        """Tear down PS connections (reference: fleet.stop_worker ends
+        the brpc worker).  No-op in pure collective mode."""
+        client = _FLEET.pop("ps_client", None)
+        if client is not None:
+            client.close()
 
 
 fleet = Fleet()
@@ -101,3 +129,6 @@ worker_index = fleet.worker_index
 is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+save_persistables = fleet.save_persistables
+stop_worker = fleet.stop_worker
+register_ps_client = fleet.register_ps_client

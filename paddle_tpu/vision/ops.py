@@ -78,11 +78,134 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode",
               box_normalized=True, axis=0, name=None):
-    raise NotImplementedError("box_coder lands with the detection suite")
+    """Encode/decode boxes against priors (reference:
+    python/paddle/vision/ops.py box_coder over
+    paddle/phi/kernels/cpu/box_coder.cc — SSD-style center-size coding).
+
+    encode_center_size: prior_box [M,4], target_box [N,4] -> [N,M,4]
+    decode_center_size: target_box [N,M,4], prior_box [M,4] (axis=0)
+    or [N,4] (axis=1) -> [N,M,4].  prior_box_var: [.,4] Tensor, a list
+    of 4 floats, or None.  Boxes are xyxy; +1 extents when
+    box_normalized=False.
+    """
+    pb = ensure_tensor(prior_box)
+    tb = ensure_tensor(target_box)
+    ts = [pb, tb]
+    var_is_tensor = prior_box_var is not None and \
+        not isinstance(prior_box_var, (list, tuple))
+    if var_is_tensor:
+        ts.append(ensure_tensor(prior_box_var))
+    code = code_type.lower()
+    if code not in ("encode", "decode", "encode_center_size",
+                    "decode_center_size"):
+        raise ValueError(f"unknown code_type {code_type!r}")
+    encode = code.startswith("encode")
+    norm_off = 0.0 if box_normalized else 1.0
+
+    def impl(pv, tv, *rest):
+        vv = rest[0] if var_is_tensor else None
+        pw = pv[:, 2] - pv[:, 0] + norm_off
+        ph = pv[:, 3] - pv[:, 1] + norm_off
+        pxc = pv[:, 0] + pw * 0.5
+        pyc = pv[:, 1] + ph * 0.5
+        if vv is None and prior_box_var is not None:
+            var = jnp.asarray(prior_box_var, jnp.float32)  # 4 floats
+        else:
+            var = vv
+        if encode:
+            tw = tv[:, 2] - tv[:, 0] + norm_off
+            th = tv[:, 3] - tv[:, 1] + norm_off
+            txc = tv[:, 0] + tw * 0.5
+            tyc = tv[:, 1] + th * 0.5
+            # [N, M]
+            ox = (txc[:, None] - pxc[None]) / pw[None]
+            oy = (tyc[:, None] - pyc[None]) / ph[None]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None]))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)
+            if var is not None:
+                out = out / (var[None] if var.ndim == 2 else
+                             var.reshape(1, 1, 4))
+            return out
+        # decode: tv [N, M, 4]; priors broadcast along dim `axis`
+        bdim = 1 - axis
+        shape = [1, 1]
+        shape[bdim] = -1
+        pw_, ph_ = pw.reshape(shape), ph.reshape(shape)
+        pxc_, pyc_ = pxc.reshape(shape), pyc.reshape(shape)
+        t = tv
+        if var is not None:
+            v = var.reshape(shape + [4]) if var.ndim == 2 \
+                else var.reshape(1, 1, 4)
+            t = t * v
+        dxc = t[..., 0] * pw_ + pxc_
+        dyc = t[..., 1] * ph_ + pyc_
+        dw = jnp.exp(t[..., 2]) * pw_
+        dh = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([dxc - dw * 0.5, dyc - dh * 0.5,
+                          dxc + dw * 0.5 - norm_off,
+                          dyc + dh * 0.5 - norm_off], axis=-1)
+    return call_op(impl, *ts)
 
 
-def yolo_box(*args, **kwargs):
-    raise NotImplementedError("yolo_box lands with the detection suite")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """YOLOv3 detection-head decode (reference: python/paddle/vision/
+    ops.py yolo_box over paddle/phi/kernels/gpu/yolo_box_kernel.cu).
+
+    x: [N, A*(5+cls), H, W] (A = len(anchors)//2; +A iou channels first
+    when iou_aware).  img_size: [N, 2] (h, w).  Returns (boxes
+    [N, A*H*W, 4] xyxy in image coords, scores [N, A*H*W, class_num]);
+    predictions with objectness below conf_thresh are zeroed.
+    """
+    xt, st = ensure_tensor(x), ensure_tensor(img_size)
+    anchors = [int(a) for a in anchors]
+    A = len(anchors) // 2
+
+    def impl(xv, sz):
+        N, C, H, W = xv.shape
+        aw = jnp.asarray(anchors[0::2], jnp.float32)
+        ah = jnp.asarray(anchors[1::2], jnp.float32)
+        if iou_aware:
+            iou = jax.nn.sigmoid(xv[:, :A].reshape(N, A, 1, H, W))
+            xv = xv[:, A:]
+        xv = xv.reshape(N, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(xv[:, :, 0]) * scale_x_y - bias + gx) / W
+        cy = (jax.nn.sigmoid(xv[:, :, 1]) * scale_x_y - bias + gy) / H
+        input_w = float(downsample_ratio) * W
+        input_h = float(downsample_ratio) * H
+        bw = jnp.exp(xv[:, :, 2]) * aw[None, :, None, None] / input_w
+        bh = jnp.exp(xv[:, :, 3]) * ah[None, :, None, None] / input_h
+        conf = jax.nn.sigmoid(xv[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                iou[:, :, 0] ** iou_aware_factor
+        keep = conf >= conf_thresh                         # [N,A,H,W]
+        img_h = sz[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = sz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw * 0.5) * img_w
+        y1 = (cy - bh * 0.5) * img_h
+        x2 = (cx + bw * 0.5) * img_w
+        y2 = (cy + bh * 0.5) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1)
+            y1 = jnp.clip(y1, 0.0, img_h - 1)
+            x2 = jnp.clip(x2, 0.0, img_w - 1)
+            y2 = jnp.clip(y2, 0.0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)       # [N,A,H,W,4]
+        boxes = boxes * keep[..., None]
+        scores = jax.nn.sigmoid(xv[:, :, 5:]) * conf[:, :, None]
+        scores = scores * keep[:, :, None]
+        boxes = boxes.reshape(N, A * H * W, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(N, A * H * W,
+                                                     class_num)
+        return boxes, scores
+    out = call_op(impl, xt, st)
+    return out
 
 
 def _bilinear_sample(img, y, x):
@@ -117,11 +240,10 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     batch/taps with vmap — XLA lowers to gathers) followed by one big
     matmul over (C_in·K) — the im2col+GEMM formulation on the MXU.
     x: [N,C,H,W]; offset: [N, 2·K·dg, Ho, Wo]; weight: [Co, C/groups, kh,
-    kw]; mask (v2): [N, K·dg, Ho, Wo].
+    kw]; mask (v2): [N, K·dg, Ho, Wo].  deformable_groups splits the
+    input channels into dg blocks each sampling with its own offsets;
+    groups blocks the GEMM channel-wise (grouped-conv semantics).
     """
-    if groups != 1 or deformable_groups != 1:
-        raise NotImplementedError("groups/deformable_groups > 1 not "
-                                  "supported yet")
     stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
     padding = (padding, padding) if isinstance(padding, int) \
         else tuple(padding)
@@ -141,6 +263,11 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         N, C, H, W = xv.shape
         Co, Ci, kh, kw = wv.shape
         K = kh * kw
+        dg = deformable_groups
+        if C % dg or C % groups or Co % groups or Ci * groups != C:
+            raise ValueError(
+                f"channel mismatch: C={C}, Co={Co}, weight Ci={Ci}, "
+                f"groups={groups}, deformable_groups={dg}")
         Ho = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
             // stride[0] + 1
         Wo = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
@@ -152,26 +279,35 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   + ky.reshape(-1)[:, None, None] * dilation[0])
         base_x = (ox[None] * stride[1] - padding[1]
                   + kx.reshape(-1)[:, None, None] * dilation[1])
-        off = offv.reshape(N, K, 2, Ho, Wo)     # paddle layout: (dy, dx)
-        sy = base_y[None] + off[:, :, 0]
-        sx = base_x[None] + off[:, :, 1]        # [N, K, Ho, Wo]
+        # paddle layout: per deformable group, K taps of (dy, dx)
+        off = offv.reshape(N, dg, K, 2, Ho, Wo)
+        sy = base_y[None, None] + off[:, :, :, 0]
+        sx = base_x[None, None] + off[:, :, :, 1]   # [N, dg, K, Ho, Wo]
+
+        def per_group(img_d, yy, xx):
+            return _bilinear_sample(img_d, yy, xx)  # [C/dg, K, Ho, Wo]
 
         def per_image(img, yy, xx, m):
-            samples = _bilinear_sample(img, yy, xx)   # [C, K, Ho, Wo]
+            # each dg block of channels samples with its own offsets
+            s = jax.vmap(per_group)(img.reshape(dg, C // dg, H, W), yy, xx)
+            s = s.reshape(C, K, Ho, Wo)
             if m is not None:
-                samples = samples * m[None]
-            return samples
+                # mask is per (dg, tap): broadcast over the block channels
+                s = (s.reshape(dg, C // dg, K, Ho, Wo) * m[:, None]
+                     ).reshape(C, K, Ho, Wo)
+            return s
         if mv is not None:
-            mk = mv.reshape(N, K, Ho, Wo)
+            mk = mv.reshape(N, dg, K, Ho, Wo)
             samples = jax.vmap(per_image)(xv, sy, sx, mk)
         else:
             samples = jax.vmap(lambda i, a, b: per_image(i, a, b, None))(
                 xv, sy, sx)
-        # [N, C, K, Ho, Wo] × [Co, C, K] → [N, Co, Ho, Wo]  (one GEMM)
-        out = jnp.einsum("nckhw,ock->nohw", samples,
-                         wv.reshape(Co, Ci, K),
+        # grouped GEMM: [N, g, C/g, K, Ho, Wo] × [g, Co/g, C/g, K]
+        sg = samples.reshape(N, groups, C // groups, K, Ho, Wo)
+        wg = wv.reshape(groups, Co // groups, Ci, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", sg, wg,
                          preferred_element_type=jnp.float32)
-        out = out.astype(xv.dtype)
+        out = out.reshape(N, Co, Ho, Wo).astype(xv.dtype)
         if bv is not None:
             out = out + bv[None, :, None, None]
         return out

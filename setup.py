@@ -1,0 +1,30 @@
+"""Wheel build for paddle_tpu (reference analogue: the reference's
+cmake + setup.py wheel pipeline, SURVEY.md §2.3 build-system row).
+
+The native runtime layer (TCPStore, blocking queue, host tracer —
+paddle_tpu/csrc/) is compiled via its Makefile during the build so the
+wheel ships the .so; if the toolchain is unavailable the build still
+succeeds and ``framework.native`` falls back to compiling lazily on
+first import (or pure-Python paths where implemented).
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        csrc = Path(__file__).parent / "paddle_tpu" / "csrc"
+        try:
+            subprocess.run(["make", "-C", str(csrc)], check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"WARNING: native build skipped ({e}); "
+                  "framework.native will build lazily at import",
+                  file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

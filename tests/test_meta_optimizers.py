@@ -175,6 +175,23 @@ def test_strategy_swaps_momentum_for_lars_and_dgc():
     assert apply_meta_optimizers(sgd, s) is sgd
 
 
+def test_fleet_save_persistables(tmp_path):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.checkpoint import load_state_dict
+    fleet.init(is_collective=True)
+    paddle.seed(3)
+    net = nn.Linear(4, 2)
+    dnet = fleet.distributed_model(net)
+    out = str(tmp_path / "persist")
+    fleet.save_persistables(dirname=out)
+    loaded = load_state_dict(out)
+    ref = net.state_dict()
+    for k, v in ref.items():
+        np.testing.assert_allclose(np.asarray(loaded[k]),
+                                   np.asarray(v._value))
+    fleet.stop_worker()  # no PS registered: clean no-op
+
+
 def test_hybrid_optimizer_trains_model_with_lars():
     paddle.seed(0)
     net = nn.Linear(4, 2)

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.vision.ops import (deform_conv2d, roi_pool, psroi_pool,
-                                   DeformConv2D)
+                                   DeformConv2D, box_coder, yolo_box)
 
 
 class TestDeformConv:
@@ -58,6 +58,122 @@ class TestDeformConv:
         off = paddle.to_tensor(np.zeros((1, 18, 5, 5), "float32"))
         out = layer(x, off)
         assert list(out.shape) == [1, 4, 5, 5]
+
+    def test_groups_zero_offset_equals_grouped_conv(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 4, 8, 8).astype("float32")
+        w = rng.randn(6, 2, 3, 3).astype("float32")  # groups=2: Ci=4/2
+        off = np.zeros((2, 2 * 9, 6, 6), "float32")
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w), groups=2).numpy()
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=2)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_deformable_groups_shift_per_block(self):
+        # dg=2 with a 1x1 kernel: block 0 shifts (+1,+1), block 1 stays.
+        x = np.stack([np.arange(25, dtype="float32").reshape(5, 5),
+                      np.arange(25, 50, dtype="float32").reshape(5, 5)]
+                     )[None]                                # [1, 2, 5, 5]
+        w = np.eye(2, dtype="float32").reshape(2, 2, 1, 1)  # identity mix
+        off = np.zeros((1, 2 * 1 * 2, 5, 5), "float32")
+        off[:, 0:2] = 1.0  # dg block 0: dy=dx=+1
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w),
+                            deformable_groups=2).numpy()
+        ref0 = np.zeros((5, 5), "float32")
+        ref0[:4, :4] = x[0, 0, 1:, 1:]
+        np.testing.assert_allclose(got[0, 0], ref0, atol=1e-5)
+        np.testing.assert_allclose(got[0, 1], x[0, 1], atol=1e-5)
+
+
+class TestBoxCoder:
+    def test_encode_manual(self):
+        prior = np.asarray([[0.0, 0.0, 10.0, 10.0]], "float32")
+        target = np.asarray([[2.0, 2.0, 8.0, 8.0]], "float32")
+        out = box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                        paddle.to_tensor(target)).numpy()
+        # centers: prior (5,5) w=h=10; target (5,5) w=h=6
+        ox = (5.0 - 5.0) / 10.0 / 0.1
+        ow = np.log(6.0 / 10.0) / 0.2
+        np.testing.assert_allclose(out[0, 0], [ox, ox, ow, ow], rtol=1e-5)
+
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        M, N = 4, 6
+        xy = rng.rand(M, 2) * 50
+        prior = np.concatenate([xy, xy + 1 + rng.rand(M, 2) * 20],
+                               axis=1).astype("f4")
+        txy = rng.rand(N, 2) * 50
+        target = np.concatenate([txy, txy + 1 + rng.rand(N, 2) * 20],
+                                axis=1).astype("f4")
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = box_coder(paddle.to_tensor(prior), var,
+                        paddle.to_tensor(target), code_type="encode")
+        dec = box_coder(paddle.to_tensor(prior), var, enc,
+                        code_type="decode", axis=0).numpy()
+        # decoding the encoding against the same priors recovers targets
+        for j in range(M):
+            np.testing.assert_allclose(dec[:, j], target, rtol=1e-4,
+                                       atol=1e-3)
+
+    def test_unnormalized_boxes(self):
+        prior = np.asarray([[0.0, 0.0, 9.0, 9.0]], "float32")  # w=h=10
+        target = np.asarray([[0.0, 0.0, 9.0, 9.0]], "float32")
+        enc = box_coder(paddle.to_tensor(prior), None,
+                        paddle.to_tensor(target),
+                        box_normalized=False).numpy()
+        np.testing.assert_allclose(enc[0, 0], [0, 0, 0, 0], atol=1e-6)
+
+
+class TestYoloBox:
+    def test_manual_single_cell(self):
+        # 1 anchor, 1 class, 1x1 grid: verify the decode formulas
+        A, cls, H = 1, 1, 1
+        t = np.zeros((1, A * (5 + cls), H, H), "float32")
+        t[0, 0] = 0.0   # tx -> sigmoid=0.5 -> cx=(0.5+0)/1
+        t[0, 1] = 0.0
+        t[0, 2] = 0.0   # tw -> bw = anchor_w / (32*1)
+        t[0, 3] = 0.0
+        t[0, 4] = 5.0   # high objectness
+        t[0, 5] = 0.0   # class logit -> 0.5
+        img = np.asarray([[64, 64]], "int32")
+        boxes, scores = yolo_box(paddle.to_tensor(t), paddle.to_tensor(img),
+                                 anchors=[16, 16], class_num=cls,
+                                 downsample_ratio=32)
+        b = boxes.numpy()[0, 0]
+        cx, bw = 0.5, 16.0 / 32.0
+        exp = np.asarray([(cx - bw / 2) * 64, (cx - bw / 2) * 64,
+                          (cx + bw / 2) * 64, (cx + bw / 2) * 64])
+        np.testing.assert_allclose(b, exp, rtol=1e-5)
+        conf = 1.0 / (1.0 + np.exp(-5.0))
+        np.testing.assert_allclose(scores.numpy()[0, 0, 0], conf * 0.5,
+                                   rtol=1e-5)
+
+    def test_conf_thresh_zeroes(self):
+        t = np.zeros((1, 6, 2, 2), "float32")
+        t[0, 4] = -10.0  # objectness ~ 0
+        img = np.asarray([[32, 32]], "int32")
+        boxes, scores = yolo_box(paddle.to_tensor(t), paddle.to_tensor(img),
+                                 anchors=[8, 8], class_num=1,
+                                 conf_thresh=0.5)
+        assert np.all(boxes.numpy() == 0)
+        assert np.all(scores.numpy() == 0)
+
+    def test_clip_bbox(self):
+        t = np.zeros((1, 6, 1, 1), "float32")
+        t[0, 2] = 3.0   # huge width -> clips to image
+        t[0, 3] = 3.0
+        t[0, 4] = 5.0
+        img = np.asarray([[40, 40]], "int32")
+        boxes, _ = yolo_box(paddle.to_tensor(t), paddle.to_tensor(img),
+                            anchors=[32, 32], class_num=1,
+                            downsample_ratio=32, clip_bbox=True)
+        b = boxes.numpy()[0, 0]
+        assert b[0] >= 0 and b[1] >= 0 and b[2] <= 39 and b[3] <= 39
 
 
 class TestRoiPool:
