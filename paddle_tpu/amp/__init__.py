@@ -14,15 +14,29 @@ from ..framework.core import Tensor
 from ..framework import dtypes
 
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
-           "is_auto_cast_enabled", "get_amp_dtype"]
+           "is_auto_cast_enabled", "get_amp_dtype", "autocast_inputs"]
 
-_AMP_STATE = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1"}
+_AMP_STATE = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1",
+              "white": frozenset(), "black": frozenset()}
 
-# Ops whitelisted for low precision under O1 (matmul-class only, mirroring
-# the reference's white list in paddle/fluid/eager/amp_utils).
-WHITE_LIST = {"matmul", "conv2d", "einsum", "linear"}
-BLACK_LIST = {"log", "exp", "softmax", "cross_entropy", "mean", "sum",
-              "norm", "layer_norm", "batch_norm"}
+# O1 per-op cast policy (reference: the op lists in
+# python/paddle/amp/amp_lists.py / paddle/fluid/eager/amp_utils.h).
+# WHITE: matmul-class ops that are fast AND safe in low precision — cast
+# their floating inputs down.  BLACK: numerically-sensitive ops
+# (exp/log/softmax/norm/loss reductions) — cast their inputs up to fp32.
+# Everything else runs in whatever dtype its inputs arrive in (promote).
+WHITE_LIST = frozenset({
+    "conv2d", "conv3d", "conv1d", "conv2d_transpose", "conv3d_transpose",
+    "matmul", "matmul_v2", "mul", "mm", "bmm", "fc", "linear", "einsum",
+    "addmm", "attention", "depthwise_conv2d"})
+BLACK_LIST = frozenset({
+    "exp", "log", "log2", "log10", "log1p", "square", "pow", "rsqrt",
+    "mean", "sum", "cos_sim", "softmax", "log_softmax",
+    "softmax_with_cross_entropy", "cross_entropy", "nll_loss",
+    "sigmoid_cross_entropy_with_logits", "c_softmax_with_cross_entropy",
+    "layer_norm", "group_norm", "instance_norm", "batch_norm", "norm",
+    "reduce_sum", "cumsum", "logsumexp", "erf", "erfinv", "softplus",
+    "log_sigmoid", "margin_cross_entropy", "kldiv_loss", "l1_norm"})
 
 
 def is_auto_cast_enabled():
@@ -37,6 +51,39 @@ def get_amp_level():
     return _AMP_STATE["level"]
 
 
+def _op_target_dtype(op_name):
+    """O1 policy: the dtype this op's floating inputs should carry, or
+    None to leave them alone."""
+    if not _AMP_STATE["enabled"] or _AMP_STATE["level"] != "O1":
+        return None
+    black = (BLACK_LIST | _AMP_STATE["black"]) - _AMP_STATE["white"]
+    white = (WHITE_LIST | _AMP_STATE["white"]) - _AMP_STATE["black"]
+    if op_name in black:
+        return jnp.float32
+    if op_name in white:
+        return _AMP_STATE["dtype"]
+    return None
+
+
+def autocast_inputs(op_name, *tensors):
+    """Apply the O1 per-op cast policy to a tuple of Tensors (None
+    entries pass through).  Casts run through the tape so gradients see
+    the cast transpose.  Called by the op layer (linear/matmul/conv/
+    softmax/norm/... sites)."""
+    tgt = _op_target_dtype(op_name)
+    if tgt is None:
+        return tensors if len(tensors) != 1 else tensors[0]
+    from ..framework.autograd import call_op
+    out = []
+    for t in tensors:
+        if t is not None and isinstance(t, Tensor) \
+                and dtypes.is_floating_dtype(t._value.dtype) \
+                and t._value.dtype != tgt:
+            t = call_op(lambda v, _d=tgt: v.astype(_d), t)
+        out.append(t)
+    return tuple(out) if len(out) != 1 else out[0]
+
+
 @contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="bfloat16", use_promote=True):
@@ -44,6 +91,8 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     _AMP_STATE["enabled"] = enable
     _AMP_STATE["dtype"] = dtypes.convert_dtype(dtype)
     _AMP_STATE["level"] = level
+    _AMP_STATE["white"] = frozenset(custom_white_list or ())
+    _AMP_STATE["black"] = frozenset(custom_black_list or ())
     try:
         yield
     finally:

@@ -1,0 +1,132 @@
+"""Semi-auto parallel API (reference:
+python/paddle/distributed/auto_parallel/ — Engine
+(auto_parallel/static/engine.py: fit/evaluate/predict over the
+auto-completed distributed program), Strategy, shard_tensor annotations).
+
+TPU-native: the annotation layer (ProcessMesh / shard_tensor / shard_op /
+reshard, distributed/mesh.py) marks placements and GSPMD does the
+completion/partition/reshard passes that the reference implements in
+Python+C++ (SURVEY §7.1).  Engine is therefore a thin driver: it builds
+a PlacementPlan from the Strategy (or an auto data-parallel plan), pins
+it on the model, and delegates the epoch loop to the hapi Model stepper,
+which compiles one SPMD train step from the plan.
+"""
+import jax
+
+from ..mesh import (ProcessMesh, shard_tensor, shard_op, reshard,  # noqa: F401
+                    Shard, Replicate, Partial, get_mesh, set_mesh)
+from ..engine import PlacementPlan, make_data_parallel_plan, plan_from_hcg
+
+__all__ = ["Engine", "Strategy", "ProcessMesh", "shard_tensor", "shard_op",
+           "reshard", "Shard", "Replicate", "Partial"]
+
+
+class Strategy:
+    """auto_parallel.Strategy (reference: auto_parallel/strategy.py) —
+    dataclass-style knobs; the meaningful-on-TPU subset."""
+
+    class _Section(dict):
+        def __getattr__(self, k):
+            return self.get(k)
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        self.amp = self._Section(enable=False, dtype="bfloat16", level="O1")
+        self.sharding = self._Section(enable=False, stage=1, degree=1)
+        self.recompute = self._Section(enable=False)
+        self.pipeline = self._Section(enable=False, schedule_mode="1F1B",
+                                      accumulate_steps=1)
+        self.mp_degree = 1
+        self.dp_degree = 1
+        if config:
+            for k, v in config.items():
+                setattr(self, k, v)
+
+
+class Engine:
+    """auto_parallel.Engine parity: fit/evaluate/predict on a model whose
+    tensors may carry ProcessMesh placements.  The heavy lifting
+    (partitioning, resharding, collective insertion) is GSPMD's; Engine
+    assembles the plan + compiled stepper."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._network = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics
+        self._strategy = strategy or Strategy()
+        self._model = None
+
+    # -- plan ----------------------------------------------------------------
+    def _build_plan(self):
+        s = self._strategy
+        level = None
+        if s.sharding.get("enable"):
+            level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(
+                s.sharding.get("stage", 1), "os")
+        mp = getattr(s, "mp_degree", 1) or 1
+        if mp > 1:
+            import numpy as np
+            from jax.sharding import Mesh
+            n = jax.device_count()
+            dp = max(n // mp, 1)
+            mesh = Mesh(np.asarray(jax.devices()[:dp * mp]).reshape(dp, mp),
+                        ("data", "model"))
+            return PlacementPlan(mesh, level=level)
+        return make_data_parallel_plan(level=level)
+
+    def _ensure_model(self):
+        if self._model is not None:
+            return self._model
+        from ...hapi.model import Model
+        net = self._network
+        if getattr(net, "_placement_plan", None) is None:
+            net._placement_plan = self._build_plan()
+        m = Model(net)
+        amp_level = None
+        if self._strategy.amp.get("enable"):
+            amp_level = self._strategy.amp.get("level", "O1")
+        m.prepare(self._optimizer, self._loss, self._metrics,
+                  amp_configs=amp_level)
+        self._model = m
+        return m
+
+    @property
+    def main_program(self):
+        return None  # jaxpr/HLO is the program; kept for API parity
+
+    # -- user surface --------------------------------------------------------
+    def fit(self, train_data, valid_data=None, train_sample_split=None,
+            batch_size=1, epochs=1, steps_per_epoch=None, log_freq=10,
+            save_dir=None, save_freq=1, valid_freq=1, valid_steps=None,
+            collate_fn=None, callbacks=None, verbose=2, nvprof_range=None):
+        m = self._ensure_model()
+        return m.fit(train_data, eval_data=valid_data,
+                     batch_size=batch_size, epochs=epochs,
+                     eval_freq=valid_freq, log_freq=log_freq,
+                     save_dir=save_dir, save_freq=save_freq,
+                     verbose=verbose, callbacks=callbacks)
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, callbacks=None,
+                 verbose=2):
+        m = self._ensure_model()
+        return m.evaluate(valid_data, batch_size=batch_size,
+                          log_freq=log_freq, verbose=verbose,
+                          callbacks=callbacks)
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=2):
+        m = self._ensure_model()
+        return m.predict(test_data, batch_size=batch_size, verbose=verbose,
+                         callbacks=callbacks)
+
+    def save(self, path, training=True):
+        return self._ensure_model().save(path, training=training)
+
+    def load(self, path, strict=True, load_optimizer=True):
+        return self._ensure_model().load(
+            path, reset_optimizer=not load_optimizer)

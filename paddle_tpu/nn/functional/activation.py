@@ -104,7 +104,8 @@ def maxout(x, groups, axis=1, name=None):
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
-    x = ensure_tensor(x)
+    from ...amp import autocast_inputs
+    x = autocast_inputs("softmax", ensure_tensor(x))
     from ...framework import dtypes
     d = dtypes.convert_dtype(dtype)
 
@@ -116,7 +117,8 @@ def softmax(x, axis=-1, dtype=None, name=None):
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
-    x = ensure_tensor(x)
+    from ...amp import autocast_inputs
+    x = autocast_inputs("log_softmax", ensure_tensor(x))
     from ...framework import dtypes
     d = dtypes.convert_dtype(dtype)
 

@@ -12,10 +12,13 @@ from ...tensor._helpers import ensure_tensor
 
 def linear(x, weight, bias=None, name=None):
     # paddle weight layout: (in_features, out_features)
-    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    from ...amp import autocast_inputs
+    x, weight, bias = autocast_inputs(
+        "linear", ensure_tensor(x), ensure_tensor(weight),
+        ensure_tensor(bias) if bias is not None else None)
     if bias is not None:
         return call_op(lambda v, w, b: jnp.matmul(v, w) + b, x, weight,
-                       ensure_tensor(bias))
+                       bias)
     return call_op(lambda v, w: jnp.matmul(v, w), x, weight)
 
 

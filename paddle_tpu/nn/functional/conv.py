@@ -79,12 +79,18 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
+    from ...amp import autocast_inputs
+    x, weight = autocast_inputs("conv2d", ensure_tensor(x),
+                                ensure_tensor(weight))
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
                     data_format, 2)
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
+    from ...amp import autocast_inputs
+    x, weight = autocast_inputs("conv3d", ensure_tensor(x),
+                                ensure_tensor(weight))
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
                     data_format, 3)
 

@@ -18,7 +18,9 @@ def _reduce(v, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
-    input, label = ensure_tensor(input), ensure_tensor(label)
+    from ...amp import autocast_inputs
+    input = autocast_inputs("cross_entropy", ensure_tensor(input))
+    label = ensure_tensor(label)
     ts = [input, label if soft_label else label.detach()]
     if weight is not None:
         ts.append(ensure_tensor(weight).detach())

@@ -18,7 +18,8 @@ def _param_shape(ndim, axis):
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-05,
                data_format="NCHW", use_global_stats=None, name=None):
-    x = ensure_tensor(x)
+    from ...amp import autocast_inputs
+    x = autocast_inputs("batch_norm", ensure_tensor(x))
     ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     bshape = [1] * x.ndim
@@ -70,7 +71,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
-    x = ensure_tensor(x)
+    from ...amp import autocast_inputs
+    x = autocast_inputs("layer_norm", ensure_tensor(x))
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
     nd = len(normalized_shape)

@@ -8,5 +8,9 @@ from ._helpers import ensure_tensor
 
 
 def einsum(equation, *operands, name=None):
+    from ..amp import autocast_inputs
     ts = [ensure_tensor(o) for o in operands]
+    ts = autocast_inputs("einsum", *ts)
+    if not isinstance(ts, tuple):
+        ts = (ts,)
     return call_op(lambda *vs: jnp.einsum(equation, *vs), *ts)

@@ -220,7 +220,8 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 
 # -- matmul family (also exposed via linalg) ---------------------------------
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    x, y = ensure_tensor(x), ensure_tensor(y)
+    from ..amp import autocast_inputs
+    x, y = autocast_inputs("matmul", ensure_tensor(x), ensure_tensor(y))
 
     def _mm(a, b):
         if transpose_x:

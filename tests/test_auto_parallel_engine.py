@@ -1,0 +1,108 @@
+"""auto_parallel Engine + O1 per-op autocast tests (reference pattern:
+test/auto_parallel/test_engine_api.py — Engine.fit/evaluate/predict on a
+small net; amp O1 list tests from test_amp_o1.py)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+from paddle_tpu.io import Dataset
+
+
+class RandDataset(Dataset):
+    def __init__(self, n=64):
+        self.x = np.random.RandomState(0).rand(n, 8).astype("f4")
+        self.y = (self.x.sum(-1, keepdims=True) > 4.0).astype("i8")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 2)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def test_engine_fit_evaluate_predict():
+    paddle.seed(0)
+    net = TinyNet()
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    engine = Engine(net, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                    strategy=Strategy())
+    ds = RandDataset()
+    engine.fit(ds, batch_size=16, epochs=2, verbose=0)
+    # model carries a placement plan (auto dp over the mesh)
+    assert net._placement_plan is not None
+    res = engine.evaluate(ds, batch_size=16, verbose=0)
+    assert np.isfinite(res["loss"][0] if isinstance(res["loss"], list)
+                       else res["loss"])
+    out = engine.predict(ds, batch_size=16, verbose=0)
+    assert len(out) >= 1
+
+
+def test_engine_sharding_strategy_sets_level():
+    s = Strategy()
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    paddle.seed(1)
+    net = TinyNet()
+    engine = Engine(net, loss=nn.CrossEntropyLoss(),
+                    optimizer=paddle.optimizer.Adam(
+                        1e-2, parameters=net.parameters()),
+                    strategy=s)
+    plan = engine._build_plan()
+    assert plan.level == "p_g_os"
+
+
+def test_amp_o1_white_black_policy():
+    from paddle_tpu import amp
+    x = Tensor(jnp.ones((4, 8), jnp.float32))
+    w = Tensor(jnp.ones((8, 4), jnp.float32))
+    with amp.auto_cast(level="O1"):
+        out = paddle.matmul(x, w)
+        assert out._value.dtype == jnp.bfloat16  # white: computes low
+        sm = nn.functional.softmax(Tensor(jnp.ones((4,), jnp.bfloat16)))
+        assert sm._value.dtype == jnp.float32    # black: forced fp32
+    # outside the context nothing is cast
+    out = paddle.matmul(x, w)
+    assert out._value.dtype == jnp.float32
+
+
+def test_amp_o1_custom_lists():
+    from paddle_tpu import amp
+    x = Tensor(jnp.ones((4, 8), jnp.float32))
+    w = Tensor(jnp.ones((8, 4), jnp.float32))
+    with amp.auto_cast(level="O1", custom_black_list={"matmul"}):
+        out = paddle.matmul(x, w)
+        assert out._value.dtype == jnp.float32   # black overrides white
+    with amp.auto_cast(level="O1", custom_white_list={"softmax"}):
+        sm = nn.functional.softmax(Tensor(jnp.ones((4,), jnp.float32)))
+        assert sm._value.dtype == jnp.bfloat16
+
+
+def test_amp_o1_grads_flow_through_casts():
+    from paddle_tpu import amp
+    paddle.seed(2)
+    net = TinyNet()
+    x = Tensor(jnp.asarray(np.random.RandomState(3)
+                           .rand(4, 8).astype("f4")))
+    with amp.auto_cast(level="O1"):
+        out = net(x)
+        loss = (out.astype("float32") ** 2).mean()
+    loss.backward()
+    g = net.fc1.weight.grad
+    assert g is not None
+    assert g._value.dtype == jnp.float32  # param grads back in fp32
+    assert float(jnp.abs(g._value).sum()) > 0
