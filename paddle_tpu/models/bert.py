@@ -27,6 +27,7 @@ class BertConfig:
     hidden_dropout_prob: float = 0.1
     attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
 
 
 def bert_base(**kw):
@@ -37,6 +38,20 @@ def bert_tiny(**kw):
     return BertConfig(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
                       num_attention_heads=4, intermediate_size=128,
                       max_position_embeddings=128, **kw)
+
+
+def _init_weights(root, std):
+    """Reference BertPretrainedModel.init_weights: every Linear/Embedding
+    weight redrawn Normal(0, initializer_range); biases/LayerNorm keep
+    their zero/one defaults.  Without this, nn.Embedding's Normal(0,1)
+    default gives BERT sqrt(H)-scale logits (initial CE ~125 instead of
+    ~ln V)."""
+    from ..nn.initializer import Normal
+    init = Normal(0.0, std)
+    for layer in root.sublayers(include_self=True):
+        if isinstance(layer, (nn.Linear, nn.Embedding)):
+            w = layer.weight
+            w.set_value(Tensor(init(tuple(w.shape), w._value.dtype)))
 
 
 class BertEmbeddings(nn.Layer):
@@ -87,6 +102,7 @@ class BertModel(nn.Layer):
         self.encoder = nn.TransformerEncoder(enc_layer,
                                              config.num_hidden_layers)
         self.pooler = BertPooler(config)
+        _init_weights(self, config.initializer_range)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
@@ -115,6 +131,8 @@ class BertForPretraining(nn.Layer):
                                            epsilon=c.layer_norm_eps)
         self.mlm_bias = self.create_parameter([c.vocab_size], is_bias=True)
         self.nsp = nn.Linear(c.hidden_size, 2)
+        _init_weights(self.transform, c.initializer_range)
+        _init_weights(self.nsp, c.initializer_range)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):

@@ -22,6 +22,21 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
            "TransformerDecoder", "Transformer"]
 
 
+def _reown_params(layer):
+    """Give a deepcopied layer its own device buffers.  deepcopy of an
+    immutable jax array returns the SAME buffer, so the N stacked layers
+    would alias one buffer per param — donating such a param list to a
+    jitted step (hapi/bench steppers use donate_argnums) double-donates
+    a buffer and the TPU runtime rejects the launch.  Layers still start
+    with identical values (torch/paddle deepcopy-stacking semantics)."""
+    import jax.numpy as jnp
+    for _, p in layer.named_parameters():
+        p._value = jnp.copy(p._value)
+    for _, b in layer.named_buffers():
+        b._value = jnp.copy(b._value)
+    return layer
+
+
 class MultiHeadAttention(Layer):
     """paddle.nn.MultiHeadAttention.  Cache protocol (Cache/StaticCache
     namedtuples) kept for incremental decoding parity."""
@@ -156,7 +171,8 @@ class TransformerEncoder(Layer):
         super().__init__()
         import copy
         self.layers = LayerList([encoder_layer] + [
-            copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+            _reown_params(copy.deepcopy(encoder_layer))
+            for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
 
@@ -253,7 +269,8 @@ class TransformerDecoder(Layer):
         super().__init__()
         import copy
         self.layers = LayerList([decoder_layer] + [
-            copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+            _reown_params(copy.deepcopy(decoder_layer))
+            for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
 
