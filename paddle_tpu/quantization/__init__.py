@@ -400,14 +400,23 @@ class ConvertedQuantedLinear(Layer):
         if self.act_scale is not None:
             a_s = float(self.act_scale)
 
-            def impl(xv):
-                xq = jnp.clip(jnp.round(xv / a_s * bnd), -bnd - 1, bnd) \
-                    .astype(jnp.int8)
-                acc = jax.lax.dot_general(
-                    xq, w_int, (((xq.ndim - 1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32)
-                return acc.astype(jnp.float32) * (a_s / bnd) * \
-                    (w_scale / bnd)
+            if jax.default_backend() == "tpu":
+                # fused quantize+int8-GEMM+dequant Pallas kernel: the
+                # int8 activations / int32 accumulator stay in VMEM
+                from ..ops.pallas.quant_matmul import int8_matmul
+
+                def impl(xv):
+                    return int8_matmul(xv, w_int, w_scale, a_s,
+                                       out_dtype=jnp.float32)
+            else:
+                def impl(xv):
+                    xq = jnp.clip(jnp.round(xv / a_s * bnd), -bnd - 1,
+                                  bnd).astype(jnp.int8)
+                    acc = jax.lax.dot_general(
+                        xq, w_int, (((xq.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    return acc.astype(jnp.float32) * (a_s / bnd) * \
+                        (w_scale / bnd)
         else:
             def impl(xv):
                 w = w_int.astype(xv.dtype) * (w_scale / bnd)
