@@ -450,12 +450,13 @@ def main():
     budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", "1500"))
     start = time.perf_counter()
 
-    def want(name):
+    def want(name, result_key=None):
         named = which is None or name in which
         if not named:
             return False
         if name != "gpt125m" and time.perf_counter() - start > budget_s:
-            configs[name] = {"skipped": "BENCH_TIME_BUDGET_S exhausted"}
+            configs[result_key or name] = {
+                "skipped": "BENCH_TIME_BUDGET_S exhausted"}
             return False
         return True
 
@@ -486,13 +487,13 @@ def main():
                 configs["resnet50"] = bench_resnet50(B=256, iters=10)
             except Exception as e:
                 configs["resnet50"] = {"error": repr(e)[:200]}
-        if want("bert"):
+        if want("bert", "bert_base_amp"):
             try:
                 configs["bert_base_amp"] = bench_bert(B=16, S=512,
                                                       iters=10, peak=peak)
             except Exception as e:
                 configs["bert_base_amp"] = {"error": repr(e)[:200]}
-        if want("longctx"):
+        if want("longctx", "gpt125m_s4096"):
             try:
                 gptlc = GPTConfig(
                     vocab_size=50304, hidden_size=768,
@@ -502,12 +503,12 @@ def main():
                                                      iters=10, peak=peak)
             except Exception as e:
                 configs["gpt125m_s4096"] = {"error": repr(e)[:200]}
-        if want("gpt1p3b"):
+        if want("gpt1p3b", "gpt1p3b_hybrid"):
             try:
                 configs["gpt1p3b_hybrid"] = bench_gpt1p3b_hybrid(peak=peak)
             except Exception as e:
                 configs["gpt1p3b_hybrid"] = {"error": repr(e)[:200]}
-        if want("eager"):
+        if want("eager", "eager_overhead"):
             try:
                 configs["eager_overhead"] = bench_eager_overhead()
             except Exception as e:
