@@ -1,0 +1,110 @@
+// POSIX shared-memory batch transport for the multiprocess DataLoader
+// (reference: python/paddle/io/dataloader's use_shared_memory=True path —
+// _share_memory tensors + paddle/fluid/memory/allocation shared-memory
+// segments).  Worker processes serialize a batch's arrays into one shm
+// segment and pass only (name, layout) through the result queue; the
+// consumer maps the segment, builds zero-copy views, and unlinks.  This
+// removes the pickle+pipe double copy for large batches.
+//
+// API (ctypes, see framework/native.py):
+//   pt_shm_create(name, bytes)  -> handle  (worker: create+map, O_EXCL)
+//   pt_shm_attach(name)         -> handle  (consumer: map existing)
+//   pt_shm_ptr(handle)          -> uint8_t* (base address)
+//   pt_shm_size(handle)         -> int64   (segment bytes)
+//   pt_shm_write(handle, off, src, len) / pt_shm_read(handle, off, dst, len)
+//   pt_shm_close(handle, unlink) (munmap+close; unlink!=0 removes the name)
+//   pt_shm_unlink(name)         (cleanup of a segment by name alone)
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "common.h"
+
+namespace {
+
+struct ShmSeg {
+  void* addr = nullptr;
+  int64_t size = 0;
+  std::string name;
+};
+
+}  // namespace
+
+PT_EXPORT int64_t pt_shm_create(const char* name, int64_t bytes) {
+  if (bytes <= 0) return 0;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return 0;
+  if (ftruncate(fd, bytes) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return 0;
+  }
+  void* addr = mmap(nullptr, static_cast<size_t>(bytes),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);  // mapping keeps the segment alive
+  if (addr == MAP_FAILED) {
+    shm_unlink(name);
+    return 0;
+  }
+  auto* seg = new ShmSeg{addr, bytes, name};
+  return reinterpret_cast<int64_t>(seg);
+}
+
+PT_EXPORT int64_t pt_shm_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return 0;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    close(fd);
+    return 0;
+  }
+  void* addr = mmap(nullptr, static_cast<size_t>(st.st_size),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) return 0;
+  auto* seg = new ShmSeg{addr, static_cast<int64_t>(st.st_size), name};
+  return reinterpret_cast<int64_t>(seg);
+}
+
+PT_EXPORT uint8_t* pt_shm_ptr(int64_t h) {
+  auto* seg = reinterpret_cast<ShmSeg*>(h);
+  return seg ? reinterpret_cast<uint8_t*>(seg->addr) : nullptr;
+}
+
+PT_EXPORT int64_t pt_shm_size(int64_t h) {
+  auto* seg = reinterpret_cast<ShmSeg*>(h);
+  return seg ? seg->size : 0;
+}
+
+PT_EXPORT int pt_shm_write(int64_t h, int64_t off, const uint8_t* src,
+                           int64_t len) {
+  auto* seg = reinterpret_cast<ShmSeg*>(h);
+  if (!seg || off < 0 || len < 0 || off + len > seg->size) return -1;
+  std::memcpy(reinterpret_cast<uint8_t*>(seg->addr) + off, src,
+              static_cast<size_t>(len));
+  return 0;
+}
+
+PT_EXPORT int pt_shm_read(int64_t h, int64_t off, uint8_t* dst, int64_t len) {
+  auto* seg = reinterpret_cast<ShmSeg*>(h);
+  if (!seg || off < 0 || len < 0 || off + len > seg->size) return -1;
+  std::memcpy(dst, reinterpret_cast<uint8_t*>(seg->addr) + off,
+              static_cast<size_t>(len));
+  return 0;
+}
+
+PT_EXPORT void pt_shm_close(int64_t h, int unlink_it) {
+  auto* seg = reinterpret_cast<ShmSeg*>(h);
+  if (!seg) return;
+  munmap(seg->addr, static_cast<size_t>(seg->size));
+  if (unlink_it) shm_unlink(seg->name.c_str());
+  delete seg;
+}
+
+PT_EXPORT void pt_shm_unlink(const char* name) { shm_unlink(name); }

@@ -16,7 +16,8 @@ import threading
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "csrc")
 _SO = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
-_SOURCES = ("tcp_store.cc", "blocking_queue.cc", "host_tracer.cc")
+_SOURCES = ("tcp_store.cc", "blocking_queue.cc", "host_tracer.cc",
+            "shm_transport.cc")
 
 _lock = threading.Lock()
 _lib = None
@@ -67,6 +68,15 @@ def _declare(lib):
         "pt_queue_size": (i32, [i64]),
         "pt_queue_close": (None, [i64]),
         "pt_queue_destroy": (None, [i64]),
+        # shm batch transport
+        "pt_shm_create": (i64, [c.c_char_p, i64]),
+        "pt_shm_attach": (i64, [c.c_char_p]),
+        "pt_shm_ptr": (c.c_void_p, [i64]),
+        "pt_shm_size": (i64, [i64]),
+        "pt_shm_write": (i32, [i64, i64, u8p, i64]),
+        "pt_shm_read": (i32, [i64, i64, u8p, i64]),
+        "pt_shm_close": (None, [i64, i32]),
+        "pt_shm_unlink": (None, [c.c_char_p]),
         # tracer
         "pt_tracer_enable": (None, [i32]),
         "pt_tracer_enabled": (i32, []),

@@ -259,7 +259,10 @@ def default_collate_fn(batch):
         return Tensor(np.stack([np.asarray(b._value) for b in batch]))
     if isinstance(sample, np.ndarray):
         return Tensor(np.stack(batch))
-    if isinstance(sample, (int, float)):
+    if isinstance(sample, (int, float, np.number)):
+        # np.number: numpy scalars (e.g. np.int64 labels) must collate the
+        # same whether they rode the worker queue or came straight from
+        # the dataset (single-process path)
         return Tensor(np.asarray(batch))
     return batch
 
@@ -334,7 +337,8 @@ class DataLoader:
                     self.dataset, batches, self.collate_fn,
                     self.num_workers, prefetch_factor=self.prefetch_factor,
                     timeout=self.timeout,
-                    worker_init_fn=self.worker_init_fn)
+                    worker_init_fn=self.worker_init_fn,
+                    use_shared_memory=self.use_shared_memory)
             except OSError:  # fork unavailable on this platform
                 it = None
             if it is not None:

@@ -1,0 +1,139 @@
+"""Shared-memory batch transport over the native layer (reference:
+python/paddle/io/dataloader use_shared_memory=True — workers move batch
+tensors through shared memory instead of pickling them into the result
+pipe; csrc/shm_transport.cc is the native core).
+
+Protocol: the worker flattens a batch's numpy arrays into one shm
+segment and returns a small layout dict (segment name + per-leaf
+dtype/shape/offset + the batch pytree rebuilt around ``_ShmRef``
+placeholders); the consumer attaches, rebuilds the arrays (one copy out
+of the segment — the device upload would copy anyway) and unlinks.
+Non-array leaves ride the layout pickle unchanged.
+"""
+import ctypes
+import os
+import uuid
+
+import numpy as np
+
+from ..framework import native
+
+__all__ = ["write_batch", "read_batch", "unlink", "available"]
+
+
+class _ShmRef:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def available():
+    return native.get_lib() is not None
+
+
+def _flatten(obj, leaves):
+    if isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        leaves.append(np.ascontiguousarray(obj))
+        return _ShmRef(len(leaves) - 1)
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*(_flatten(v, leaves) for v in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten(v, leaves) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _flatten(v, leaves) for k, v in obj.items()}
+    return obj
+
+
+def _unflatten(obj, arrays):
+    if isinstance(obj, _ShmRef):
+        return arrays[obj.idx]
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*(_unflatten(v, arrays) for v in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unflatten(v, arrays) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _unflatten(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def write_batch(batch, min_bytes=0, name_prefix="pt_batch"):
+    """Batch pytree -> (meta dict) with arrays parked in a shm segment,
+    or None when the native layer is unavailable, the batch holds no
+    arrays, or the arrays total under ``min_bytes`` (caller falls back
+    to pickling the batch whole — the pipe wins for tiny payloads).
+    ``name_prefix`` scopes the segment name so the owning loader can
+    glob-unlink leftovers at shutdown."""
+    lib = native.get_lib()
+    if lib is None:
+        return None
+    leaves = []
+    tree = _flatten(batch, leaves)
+    if not leaves or sum(a.nbytes for a in leaves) < min_bytes:
+        return None
+    align = 64
+    offsets, total = [], 0
+    for a in leaves:
+        total = (total + align - 1) // align * align
+        offsets.append(total)
+        total += a.nbytes
+    name = f"/{name_prefix}_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+    h = lib.pt_shm_create(name.encode(), total)
+    if not h:
+        return None
+    try:
+        for a, off in zip(leaves, offsets):
+            src = a.view(np.uint8).reshape(-1)
+            lib.pt_shm_write(
+                h, off,
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                a.nbytes)
+    finally:
+        lib.pt_shm_close(h, 0)  # keep the name: consumer unlinks
+    layout = [(str(a.dtype), a.shape, off)
+              for a, off in zip(leaves, offsets)]
+    return {"shm": name, "layout": layout, "tree": tree}
+
+
+def read_batch(meta):
+    """Rebuild the batch from a write_batch() meta dict and unlink the
+    segment."""
+    lib = native.get_lib()
+    if lib is None:
+        raise RuntimeError("shm transport needs the native library")
+    name = meta["shm"]
+    h = lib.pt_shm_attach(name.encode())
+    if not h:
+        raise RuntimeError(f"shm segment {name} vanished (producer died "
+                           "before handoff?)")
+    try:
+        arrays = []
+        for dtype, shape, off in meta["layout"]:
+            a = np.empty(shape, dtype=np.dtype(dtype))
+            if a.nbytes:
+                lib.pt_shm_read(
+                    h, off,
+                    a.view(np.uint8).reshape(-1).ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint8)),
+                    a.nbytes)
+            arrays.append(a)
+    finally:
+        lib.pt_shm_close(h, 1)
+    return _unflatten(meta["tree"], arrays)
+
+
+def unlink(name):
+    """Best-effort cleanup of a segment by name (shutdown path)."""
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_shm_unlink(name.encode())
+
+
+def unlink_prefix(name_prefix):
+    """Unlink every leftover segment carrying this loader's tag —
+    idempotent sweep that covers teardown races (a worker terminated
+    between segment creation and the queue put loses the name forever
+    otherwise)."""
+    import glob as _glob
+    for path in _glob.glob(f"/dev/shm/{name_prefix}_*"):
+        unlink("/" + os.path.basename(path))

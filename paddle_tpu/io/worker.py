@@ -20,14 +20,26 @@ import traceback
 import numpy as np
 
 from .blocking_queue import BlockingQueue
+from . import shm as _shm
 
 __all__ = ["MultiProcessIter"]
+
+# arrays under this many bytes ride the pickle pipe; larger batches go
+# through the csrc shm transport (reference: use_shared_memory default)
+_SHM_MIN_BYTES = 1 << 14
 
 
 class _WorkerError:
     def __init__(self, exc):
         self.msg = "".join(traceback.format_exception(
             type(exc), exc, exc.__traceback__))
+
+
+class _ShmBatch:
+    """Queue marker: the real arrays live in the named shm segment."""
+
+    def __init__(self, meta):
+        self.meta = meta
 
 
 def _to_numpy(sample):
@@ -45,7 +57,7 @@ def _to_numpy(sample):
 
 
 def _worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
-                 worker_init_fn, base_seed):
+                 worker_init_fn, base_seed, shm_tag=None):
     from . import _worker_info, _WorkerInfo
     np.random.seed((base_seed + worker_id) % (2 ** 32))
     _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
@@ -62,7 +74,13 @@ def _worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
         batch_idx, indices = item
         try:
             samples = [_to_numpy(dataset[i]) for i in indices]
-            blob = pickle.dumps((batch_idx, samples), protocol=4)
+            payload = samples
+            if shm_tag is not None:
+                meta = _shm.write_batch(samples, min_bytes=_SHM_MIN_BYTES,
+                                        name_prefix=shm_tag)
+                if meta is not None:
+                    payload = _ShmBatch(meta)
+            blob = pickle.dumps((batch_idx, payload), protocol=4)
         except Exception as e:  # incl. unpicklable samples
             blob = pickle.dumps((batch_idx, _WorkerError(e)), protocol=4)
         result_queue.put(blob)
@@ -73,7 +91,8 @@ class MultiProcessIter:
     dataset."""
 
     def __init__(self, dataset, batch_indices, collate_fn, num_workers,
-                 prefetch_factor=2, timeout=0, worker_init_fn=None):
+                 prefetch_factor=2, timeout=0, worker_init_fn=None,
+                 use_shared_memory=True):
         self._collate = collate_fn
         self._timeout = timeout if timeout and timeout > 0 else None
         self._batches = list(batch_indices)
@@ -84,6 +103,9 @@ class MultiProcessIter:
         # batch after delivering one (reference: _outstanding_capacity in
         # dataloader_iter.py).
         self._capacity = max(2, prefetch_factor * num_workers)
+        import uuid as _uuid
+        self._shm_tag = f"pt_batch_{_uuid.uuid4().hex[:10]}" \
+            if (use_shared_memory and _shm.available()) else None
         ctx = multiprocessing.get_context("fork")
         self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
         self._result_queue = ctx.Queue()
@@ -97,7 +119,7 @@ class MultiProcessIter:
                     target=_worker_loop,
                     args=(dataset, self._index_queues[wid],
                           self._result_queue, wid, num_workers,
-                          worker_init_fn, base_seed),
+                          worker_init_fn, base_seed, self._shm_tag),
                     daemon=True)
                 p.start()
                 self._workers.append(p)
@@ -162,7 +184,7 @@ class MultiProcessIter:
         except (EOFError, OSError):
             pass  # torn down mid-epoch
         finally:
-            self._out.close()
+            self._out.close()  # leftover shm swept by tag in _shutdown
 
     def __iter__(self):
         return self
@@ -188,11 +210,13 @@ class MultiProcessIter:
             self._shutdown()
             raise RuntimeError(
                 "DataLoader worker raised:\n" + payload.msg)
+        if isinstance(payload, _ShmBatch):
+            payload = _shm.read_batch(payload.meta)
         return self._collate(payload)
 
     def _shutdown(self):
         self._stopping = True
-        self._out.close()
+        self._out.close()  # wakes a blocked collector push; drain-then-end
         try:  # wake a collector blocked in result_queue.get()
             self._result_queue.put(pickle.dumps((-2, None)))
         except (OSError, ValueError):
@@ -204,6 +228,10 @@ class MultiProcessIter:
             p.join(timeout=1.0)
         if self._collector.is_alive():
             self._collector.join(timeout=1.0)
+        if self._shm_tag is not None:
+            # sweep every segment this loader tagged: covers blobs lost in
+            # queue buffers and workers killed between create and put
+            _shm.unlink_prefix(self._shm_tag)
 
     def __del__(self):
         try:
