@@ -44,6 +44,7 @@ from . import onnx  # noqa: F401
 from . import regularizer  # noqa: F401
 from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import incubate  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401

@@ -91,3 +91,151 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kw):
     raise NotImplementedError(
         "use paddle_tpu.nn.MultiHeadAttention; XLA fuses the composed ops")
+
+
+# -- fused norm / rotary / activation surface (reference:
+# python/paddle/incubate/nn/functional/{fused_layer_norm,fused_rms_norm,
+# fused_rotary_position_embedding,swiglu,fused_dropout_add}.py) ------------
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    """RMSNorm over the last axis via the Pallas one-pass kernel
+    (ops/pallas/fused_norm.py; CPU fallback identical numerics).
+    Optional pre-norm residual-add (returns (out, residual_out) then,
+    reference signature).  norm_bias adds after scaling."""
+    from ....ops.pallas.fused_norm import fused_rms_norm as _kernel
+    xt = ensure_tensor(x)
+    ts = [xt, ensure_tensor(norm_weight)]
+    has_res = residual is not None
+    has_bias = bias is not None
+    has_nb = norm_bias is not None
+    if has_res:
+        ts.append(ensure_tensor(residual))
+    if has_bias:
+        ts.append(ensure_tensor(bias))
+    if has_nb:
+        ts.append(ensure_tensor(norm_bias))
+
+    def impl(xv, gv, *rest):
+        i = 0
+        rv = rest[i] if has_res else None
+        i += has_res
+        bv = rest[i] if has_bias else None
+        i += has_bias
+        nb = rest[i] if has_nb else None
+        pre = xv
+        if bv is not None:
+            pre = pre + bv
+        if rv is not None:
+            pre = pre + rv
+        out = _kernel(pre, gv, eps=epsilon)
+        if nb is not None:
+            out = out + nb
+        return (out, pre) if has_res else out
+    return call_op(impl, *ts)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    """LayerNorm via the Pallas one-pass kernel, with the reference's
+    optional residual/bias pre-adds."""
+    from ....ops.pallas.fused_norm import fused_layer_norm as _kernel
+    xt = ensure_tensor(x)
+    ts = [xt, ensure_tensor(norm_weight), ensure_tensor(norm_bias)]
+    has_res = residual is not None
+    has_bias = bias is not None
+    if has_res:
+        ts.append(ensure_tensor(residual))
+    if has_bias:
+        ts.append(ensure_tensor(bias))
+
+    def impl(xv, gv, bv, *rest):
+        i = 0
+        rv = rest[i] if has_res else None
+        i += has_res
+        pb = rest[i] if has_bias else None
+        pre = xv
+        if pb is not None:
+            pre = pre + pb
+        if rv is not None:
+            pre = pre + rv
+        out = _kernel(pre, gv, bv, eps=epsilon)
+        return (out, pre) if has_res else out
+    return call_op(impl, *ts)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE applied to q/k (v passes through untouched when given) —
+    reference: incubate/nn/functional/fused_rotary_position_embedding.py.
+    (B, S, H, D) layout.  With use_neox_rotary_style the rotation pairs
+    (x_i, x_{i+D/2}); otherwise interleaved (x_{2i}, x_{2i+1})."""
+    outs = []
+
+    def rope_one(xv, sin_v, cos_v):
+        B, S, H, D = xv.shape
+        if sin_v is None:
+            pos = jnp.arange(S) if position_ids is None else position_ids
+            freqs = 1.0 / (rotary_emb_base ** (
+                jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+            ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+            cos_a = jnp.cos(ang)[None, :, None, :]
+            sin_a = jnp.sin(ang)[None, :, None, :]
+        else:
+            # accepted shapes (B?, S, 1?, D) carrying duplicated halves —
+            # take the leading D/2 columns
+            sin_a = jnp.asarray(sin_v, jnp.float32).reshape(1, S, 1, -1)[..., :D // 2]
+            cos_a = jnp.asarray(cos_v, jnp.float32).reshape(1, S, 1, -1)[..., :D // 2]
+        xf = xv.astype(jnp.float32)
+        if use_neox_rotary_style:
+            x1, x2 = xf[..., :D // 2], xf[..., D // 2:]
+            r1 = x1 * cos_a - x2 * sin_a
+            r2 = x2 * cos_a + x1 * sin_a
+            out = jnp.concatenate([r1, r2], axis=-1)
+        else:
+            x1, x2 = xf[..., ::2], xf[..., 1::2]
+            r1 = x1 * cos_a - x2 * sin_a
+            r2 = x2 * cos_a + x1 * sin_a
+            out = jnp.stack([r1, r2], axis=-1).reshape(B, S, H, D)
+        return out.astype(xv.dtype)
+
+    sv = sin._value if isinstance(sin, Tensor) else sin
+    cv = cos._value if isinstance(cos, Tensor) else cos
+    for t in (q, k):
+        if t is None:
+            outs.append(None)
+            continue
+        tt = ensure_tensor(t)
+        outs.append(call_op(lambda xv: rope_one(xv, sv, cv), tt))
+    outs.append(ensure_tensor(v) if v is not None else None)
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; with y=None x splits in half on the last axis
+    (reference: incubate/nn/functional/swiglu.py)."""
+    xt = ensure_tensor(x)
+    if y is None:
+        def impl(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return call_op(impl, xt)
+    return call_op(lambda a, b: jax.nn.silu(a) * b, xt, ensure_tensor(y))
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one op (reference:
+    incubate/nn/functional/fused_dropout_add.py); XLA fuses the mask and
+    add into one kernel."""
+    from ....nn import functional as _F
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    dropped = _F.dropout(xt, p=p, training=training, mode=mode)
+    return call_op(lambda a, b: a + b, dropped, yt)
+
+
+__all__ += ["fused_rms_norm", "fused_layer_norm",
+            "fused_rotary_position_embedding", "swiglu",
+            "fused_dropout_add"]

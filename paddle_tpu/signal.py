@@ -1,0 +1,156 @@
+"""paddle.signal (reference: python/paddle/signal.py — frame,
+overlap_add, stft, istft over the fft ops).
+
+TPU-native: framing is a gather-free strided reshape XLA fuses, the FFT
+is XLA's native rfft/irfft batched over frames, and istft's overlap-add
+is a segment-sum — all static-shaped.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor
+from .framework.autograd import call_op
+from .tensor._helpers import ensure_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along ``axis`` (reference:
+    signal.frame).  Output appends a frame axis after ``axis``:
+    (..., num_frames, frame_length) for axis=-1."""
+    xt = ensure_tensor(x)
+
+    def impl(v):
+        ax = axis if axis >= 0 else v.ndim + axis
+        n = v.shape[ax]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        return jnp.take(v, idx, axis=ax)
+    return call_op(impl, xt)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: (..., num_frames, frame_length) -> signal
+    (reference: signal.overlap_add)."""
+    xt = ensure_tensor(x)
+
+    def impl(v):
+        if axis not in (-1, v.ndim - 1):
+            v = jnp.moveaxis(v, axis, -1)
+        *lead, num, fl = v.shape
+        out_len = (num - 1) * hop_length + fl
+        seg = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(fl)[None, :]).reshape(-1)
+        flat = v.reshape(*lead, num * fl)
+        out = jax.vmap(
+            lambda row: jnp.zeros(out_len, row.dtype).at[seg].add(row)
+        )(flat.reshape(-1, num * fl))
+        out = out.reshape(*lead, out_len)
+        if axis not in (-1, v.ndim - 1):
+            out = jnp.moveaxis(out, -1, axis)
+        return out
+    return call_op(impl, xt)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform, torch/paddle semantics: output
+    (..., n_fft//2+1 [or n_fft], num_frames) complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = ensure_tensor(x)
+    wv = None
+    if window is not None:
+        wv = window._value if isinstance(window, Tensor) \
+            else jnp.asarray(np.asarray(window))
+
+    def impl(v, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        if w is None:
+            w = jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(n_fft // 2,) * 2],
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = v[..., idx] * w                     # (..., num, n_fft)
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)            # (..., freq, num)
+        return spec[0] if squeeze else spec
+    args = (xt,) + ((Tensor(wv),) if wv is not None else ())
+    return call_op(impl, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with overlap-add and window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = ensure_tensor(x)
+    wv = None
+    if window is not None:
+        wv = window._value if isinstance(window, Tensor) \
+            else jnp.asarray(np.asarray(window))
+
+    def impl(spec, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        if w is None:
+            w = jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        frames = jnp.swapaxes(spec, -1, -2)          # (..., num, freq)
+        if normalized:
+            frames = frames * jnp.sqrt(n_fft)
+        if onesided:
+            t = jnp.fft.irfft(frames, n=n_fft, axis=-1)
+        else:
+            t = jnp.fft.ifft(frames, axis=-1)
+            if not return_complex:
+                t = t.real
+        t = t * w                                     # windowed frames
+        *lead, num, fl = t.shape
+        out_len = (num - 1) * hop_length + fl
+        seg = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(fl)[None, :]).reshape(-1)
+
+        def ola(row):
+            return jnp.zeros(out_len, row.dtype).at[seg].add(row)
+        sig = jax.vmap(ola)(t.reshape(-1, num * fl)).reshape(*lead, out_len)
+        env = jax.vmap(ola)((jnp.broadcast_to(w * w, (num, fl))
+                             ).reshape(1, -1).astype(jnp.float32)
+                            )[0]                      # window-square OLA
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            cur = sig.shape[-1]
+            if cur < length:  # tail samples the frame grid never covered
+                sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1)
+                              + [(0, length - cur)])
+            else:
+                sig = sig[..., :length]
+        return sig[0] if squeeze else sig
+    args = (xt,) + ((Tensor(wv),) if wv is not None else ())
+    return call_op(impl, *args)

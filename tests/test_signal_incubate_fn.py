@@ -1,0 +1,133 @@
+"""paddle.signal + incubate fused functionals (reference pattern:
+test/legacy_test/test_stft_op.py, test_fused_rotary_position_embedding
+.py — torch/numpy goldens)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+
+class TestSignal:
+    def test_stft_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(0).randn(2, 4000).astype("f4")
+        win = np.hanning(400).astype("f4")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=512,
+                                  hop_length=160, win_length=400,
+                                  window=paddle.to_tensor(win))
+        ref = torch.stft(torch.tensor(x), n_fft=512, hop_length=160,
+                         win_length=400, window=torch.tensor(win),
+                         return_complex=True, center=True,
+                         pad_mode="reflect")
+        np.testing.assert_allclose(np.asarray(spec._value), ref.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_istft_reconstructs(self):
+        x = np.random.RandomState(1).randn(3000).astype("f4")
+        win = np.hanning(512).astype("f4")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=512,
+                                  hop_length=128,
+                                  window=paddle.to_tensor(win))
+        rec = paddle.signal.istft(spec, n_fft=512, hop_length=128,
+                                  window=paddle.to_tensor(win), length=3000)
+        r = np.asarray(rec._value)
+        assert r.shape == (3000,)
+        # the frame grid covers the first 2944 samples; the rest is the
+        # documented zero-pad (torch.istft length semantics)
+        np.testing.assert_allclose(r[:2944], x[:2944], atol=1e-5)
+        np.testing.assert_allclose(r[2944:], 0.0, atol=1e-7)
+
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.arange(64, dtype="f4")
+        f = paddle.signal.frame(paddle.to_tensor(x), 16, 16)  # no overlap
+        assert tuple(f.shape) == (4, 16)
+        back = paddle.signal.overlap_add(f, 16)
+        np.testing.assert_allclose(np.asarray(back._value), x)
+
+
+class TestIncubateFused:
+    def test_fused_rms_norm_matches_manual(self):
+        from paddle_tpu.incubate.nn.functional import fused_rms_norm
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 64).astype("f4")
+        g = rng.rand(64).astype("f4")
+        out = fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(g))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * g
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_rms_norm_residual(self):
+        from paddle_tpu.incubate.nn.functional import fused_rms_norm
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 64).astype("f4")
+        r = rng.randn(4, 64).astype("f4")
+        g = np.ones(64, "f4")
+        out, res = fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(g),
+                                  residual=paddle.to_tensor(r))
+        np.testing.assert_allclose(np.asarray(res._value), x + r, rtol=1e-6)
+        pre = x + r
+        ref = pre / np.sqrt((pre ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_layer_norm_matches_nn(self):
+        from paddle_tpu.incubate.nn.functional import fused_layer_norm
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        x = rng.randn(6, 32).astype("f4")
+        g = rng.rand(32).astype("f4")
+        b = rng.randn(32).astype("f4")
+        out = fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(g),
+                               paddle.to_tensor(b))
+        ref = F.layer_norm(paddle.to_tensor(x), 32,
+                           weight=paddle.to_tensor(g),
+                           bias=paddle.to_tensor(b))
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(ref._value), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rope_matches_llama_interleaved(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+        from paddle_tpu.models.llama import _rope
+        rng = np.random.RandomState(3)
+        q = rng.randn(2, 16, 4, 32).astype("f4")
+        out_q, out_k, out_v = fused_rotary_position_embedding(
+            paddle.to_tensor(q), use_neox_rotary_style=False)
+        ref = _rope(jnp.asarray(q), 10000.0)
+        np.testing.assert_allclose(np.asarray(out_q._value),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-5)
+        assert out_k is None and out_v is None
+
+    def test_rope_neox_rotates_halves(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+        q = np.zeros((1, 4, 1, 8), "f4")
+        q[..., 0] = 1.0  # unit vector on the first half
+        out_q, _, _ = fused_rotary_position_embedding(
+            paddle.to_tensor(q), use_neox_rotary_style=True)
+        o = np.asarray(out_q._value)
+        # position 0: rotation is identity
+        np.testing.assert_allclose(o[0, 0, 0], q[0, 0, 0], atol=1e-6)
+        # later positions rotate energy into the second half
+        assert abs(o[0, 3, 0, 4]) > 0
+
+    def test_swiglu(self):
+        from paddle_tpu.incubate.nn.functional import swiglu
+        rng = np.random.RandomState(4)
+        x = rng.randn(3, 8).astype("f4")
+        out = swiglu(paddle.to_tensor(x))
+        a, b = x[:, :4], x[:, 4:]
+        ref = a / (1 + np.exp(-a)) * b
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5)
+
+    def test_fused_dropout_add_eval(self):
+        from paddle_tpu.incubate.nn.functional import fused_dropout_add
+        x = np.ones((2, 4), "f4")
+        y = np.full((2, 4), 2.0, "f4")
+        out = fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y),
+                                p=0.5, training=False)
+        np.testing.assert_allclose(np.asarray(out._value), x + y)
