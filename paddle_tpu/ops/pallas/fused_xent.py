@@ -1,0 +1,190 @@
+"""Fused softmax cross-entropy Pallas kernel (TPU).
+
+Reference analogue: paddle/phi/kernels/gpu/cross_entropy_kernel.cu
+(softmax_with_cross_entropy fused kernel).  For an LM head the logits
+tensor is huge (B*S x V ~ GBs in bf16); the XLA composition (max pass,
+exp-sum pass, gather, then a recompute in backward) streams it from HBM
+several times and materializes fp32 intermediates.  This kernel makes
+ONE pass for the forward — streaming V in lane-aligned chunks with an
+online max/sum (flash-style) while picking the label logit — and ONE
+pass for the backward, writing dlogits = scale * (softmax - onehot)
+directly from the saved row lse.
+
+``fused_softmax_xent(logits2, labels)`` takes flattened (T, V) bf16/f32
+logits and int32 labels (negative = ignore) and returns per-row
+(lse - picked) with zeros at ignored rows; mean/sum reduction lives in
+the caller.  Off-TPU, an identical-math jnp fallback keeps it testable.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_softmax_xent"]
+
+_LANES = 128
+_BT = 256          # rows per program
+_MAX_BV = 2048     # V streamed in chunks of <= this many lanes
+_FORCE_INTERPRET = False   # tests: run the kernels in interpret mode on CPU
+
+
+def _pick_bv(V):
+    """Largest lane-multiple chunk width <= _MAX_BV that divides V, or
+    None when V has no lane-aligned factorization (caller falls back)."""
+    if V % _LANES:
+        return None
+    best = None
+    for mult in range(1, _MAX_BV // _LANES + 1):
+        bv = mult * _LANES
+        if V % bv == 0:
+            best = bv
+    return best
+
+
+def _xent_fwd_kernel(lg_ref, lb_ref, out_ref, lse_ref, m_ref, s_ref, p_ref,
+                     *, n_v, bv):
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        p_ref[:] = jnp.zeros_like(p_ref)
+
+    chunk = lg_ref[:].astype(jnp.float32)            # (bt, bv)
+    lb = lb_ref[:, 0]                                 # (bt,)
+    m_prev = m_ref[:, 0]
+    m_cur = jnp.max(chunk, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    s_new = s_ref[:, 0] * alpha + jnp.sum(
+        jnp.exp(chunk - m_new[:, None]), axis=-1)
+    # label logit if it falls inside this chunk
+    off = lb - vi * bv                                # (bt,)
+    col = jax.lax.broadcasted_iota(jnp.int32, chunk.shape, 1)
+    hit = col == off[:, None]
+    p_new = p_ref[:, 0] + jnp.sum(jnp.where(hit, chunk, 0.0), axis=-1)
+    m_ref[:, 0] = m_new
+    s_ref[:, 0] = s_new
+    p_ref[:, 0] = p_new
+
+    @pl.when(vi == n_v - 1)
+    def _fin():
+        lse = m_new + jnp.log(jnp.maximum(s_new, 1e-30))
+        valid = lb >= 0
+        out_ref[:, 0] = jnp.where(valid, lse - p_new, 0.0)
+        lse_ref[:, 0] = lse
+
+
+def _xent_bwd_kernel(lg_ref, lb_ref, lse_ref, g_ref, dlg_ref, *, bv):
+    vi = pl.program_id(1)
+    chunk = lg_ref[:].astype(jnp.float32)
+    lb = lb_ref[:, 0]
+    lse = lse_ref[:, 0]
+    scale = g_ref[:, 0]                               # per-row upstream g
+    p = jnp.exp(chunk - lse[:, None])
+    off = lb - vi * bv
+    col = jax.lax.broadcasted_iota(jnp.int32, chunk.shape, 1)
+    onehot = (col == off[:, None]).astype(jnp.float32)
+    valid = (lb >= 0).astype(jnp.float32)
+    dlg_ref[:] = ((p - onehot) * (scale * valid)[:, None]
+                  ).astype(dlg_ref.dtype)
+
+
+def _lane_col(x, bt_rows):
+    """(T,) -> (T, LANES) with the value in column 0 (TPU block rule)."""
+    return jnp.pad(x[:, None], ((0, 0), (0, _LANES - 1)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_softmax_xent(logits2, labels):
+    out, _ = _fwd_impl(logits2, labels)
+    return out
+
+
+def _ref_rowloss(logits2, labels):
+    lg = logits2.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    picked = jnp.take_along_axis(lg, safe[:, None], 1)[:, 0]
+    return jnp.where(labels >= 0, lse - picked, 0.0)
+
+
+def _fwd_impl(logits2, labels):
+    T, V = logits2.shape
+    bv = _pick_bv(V)
+    interp = _FORCE_INTERPRET
+    on_tpu = jax.default_backend() == "tpu" or interp
+    if not on_tpu or bv is None or T % _BT:
+        lg = logits2.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        return _ref_rowloss(logits2, labels), lse
+    lbl = _lane_col(labels.astype(jnp.int32), T)
+    n_v = V // bv
+    out, lse = pl.pallas_call(
+        functools.partial(_xent_fwd_kernel, n_v=n_v, bv=bv),
+        grid=(T // _BT, n_v),
+        in_specs=[
+            pl.BlockSpec((_BT, bv), lambda t, v: (t, v)),
+            pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),
+            pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((T, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((_BT, _LANES), jnp.float32),
+                        pltpu.VMEM((_BT, _LANES), jnp.float32),
+                        pltpu.VMEM((_BT, _LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interp,
+    )(logits2, lbl)
+    return out[:, 0], lse[:, 0]
+
+
+def _xent_fwd(logits2, labels):
+    out, lse = _fwd_impl(logits2, labels)
+    return out, (logits2, labels, lse)
+
+
+def _xent_bwd(res, g):
+    logits2, labels, lse = res
+    T, V = logits2.shape
+    bv = _pick_bv(V)
+    interp = _FORCE_INTERPRET
+    on_tpu = jax.default_backend() == "tpu" or interp
+    if not on_tpu or bv is None or T % _BT:
+        p = jnp.exp(logits2.astype(jnp.float32) - lse[:, None])
+        safe = jnp.maximum(labels, 0)
+        onehot = jax.nn.one_hot(safe, V, dtype=jnp.float32)
+        valid = (labels >= 0).astype(jnp.float32)
+        dlg = (p - onehot) * (g * valid)[:, None]
+        return dlg.astype(logits2.dtype), None
+    lbl = _lane_col(labels.astype(jnp.int32), T)
+    lse_l = _lane_col(lse, T)
+    g_l = _lane_col(g.astype(jnp.float32), T)
+    dlg = pl.pallas_call(
+        functools.partial(_xent_bwd_kernel, bv=bv),
+        grid=(T // _BT, V // bv),
+        in_specs=[
+            pl.BlockSpec((_BT, bv), lambda t, v: (t, v)),
+            pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),
+            pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),
+            pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BT, bv), lambda t, v: (t, v)),
+        out_shape=jax.ShapeDtypeStruct((T, V), logits2.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interp,
+    )(logits2, lbl, lse_l, g_l)
+    return dlg, None
+
+
+fused_softmax_xent.defvjp(_xent_fwd, _xent_bwd)
