@@ -26,6 +26,28 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         ts.append(ensure_tensor(weight).detach())
 
     def _ce(logits, lab, *maybe_w):
+        # hot-path dispatch: hard labels, no weights/smoothing, last-axis
+        # softmax -> the Pallas one-pass streamed kernel (fused_xent.py);
+        # the win grows with the class count (LM heads)
+        if (use_softmax and not soft_label and not maybe_w
+                and label_smoothing == 0.0 and axis in (-1, logits.ndim - 1)
+                and lab.shape != logits.shape):
+            from ...ops.pallas.fused_xent import fused_softmax_xent
+            lab_idx = lab
+            if lab_idx.ndim == logits.ndim:
+                lab_idx = jnp.squeeze(lab_idx, axis=-1)
+            if lab_idx.ndim == logits.ndim - 1:
+                V = logits.shape[-1]
+                flat = logits.reshape(-1, V)
+                li = lab_idx.reshape(-1).astype(jnp.int32)
+                li = jnp.where(li == ignore_index, -1, li)
+                row = fused_softmax_xent(flat, li)
+                row = row.reshape(lab_idx.shape)
+                if reduction == "mean":
+                    cnt = jnp.maximum(
+                        jnp.sum((li >= 0).astype(jnp.float32)), 1.0)
+                    return jnp.sum(row) / cnt
+                return _reduce(row, reduction)
         if use_softmax:
             logp = jax.nn.log_softmax(logits, axis=axis)
         else:
