@@ -45,6 +45,7 @@ from . import regularizer  # noqa: F401
 from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import audio  # noqa: F401
 from . import incubate  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401

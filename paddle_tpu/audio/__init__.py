@@ -1,0 +1,12 @@
+"""paddle.audio (reference: python/paddle/audio/ — features
+(Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers), functional
+(mel scale, fbank matrix, dct), backends).
+
+TPU-native: features are Layers over paddle.signal's XLA STFT plus one
+fbank/DCT matmul (MXU); the mel/DCT matrices are precomputed numpy
+constants (host-side, trace-free).
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+
+__all__ = ["functional", "features"]

@@ -1,0 +1,95 @@
+"""paddle.audio.features (reference:
+python/paddle/audio/features/layers.py — Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC as Layers)."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from .. import nn
+from ..signal import stft
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    """|STFT|^power over (N, T) or (T,) waveforms ->
+    (N, n_fft//2+1, num_frames)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return call_op(
+            lambda s: jnp.abs(s) ** self.power
+            if self.power != 2.0 else (s.real * s.real + s.imag * s.imag),
+            spec)
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # (..., freq, frames)
+        return call_op(lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+                       spec, self.fbank)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db, dtype)
+        self.dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        lm = self.logmel(x)                 # (..., n_mels, frames)
+        return call_op(lambda s, d: jnp.einsum("mk,...mt->...kt", d, s),
+                       lm, self.dct)

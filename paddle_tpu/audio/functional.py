@@ -1,0 +1,122 @@
+"""paddle.audio.functional (reference:
+python/paddle/audio/functional/{window,functional}.py — mel scale
+conversions, filterbank construction, dct, window functions)."""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hz -> mel (slaney by default, matching the reference)."""
+    scalar = np.isscalar(freq)
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                       out)
+    return float(out) if scalar else out
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = np.isscalar(mel)
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if scalar else out
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0.0, sr / 2.0, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank: (n_mels, n_fft//2 + 1)."""
+    f_max = f_max or sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        fb = fb * enorm[:, None]
+    return Tensor(jnp.asarray(fb.astype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=None):
+    """10*log10(spect/ref) with floor and optional dynamic-range cap."""
+    x = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    db = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    db = db - 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+    if top_db is not None:
+        db = jnp.maximum(db, jnp.max(db) - top_db)
+    return Tensor(db)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix (n_mels, n_mfcc) — reference layout: logmel @ dct."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return Tensor(jnp.asarray(dct.astype(dtype)))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window by name (reference: audio/functional/window.py)."""
+    name = window if isinstance(window, str) else window[0]
+    M = win_length + (1 if fftbins else 0)  # periodic vs symmetric
+    if name in ("hann", "hanning"):
+        w = np.hanning(M)
+    elif name == "hamming":
+        w = np.hamming(M)
+    elif name == "blackman":
+        w = np.blackman(M)
+    elif name == "bartlett":
+        w = np.bartlett(M)
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(M)
+    elif name == "gaussian":
+        std = window[1] if not isinstance(window, str) else 7.0
+        n = np.arange(M) - (M - 1) / 2.0
+        w = np.exp(-0.5 * (n / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w.astype(dtype)))

@@ -131,3 +131,46 @@ class TestIncubateFused:
         out = fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y),
                                 p=0.5, training=False)
         np.testing.assert_allclose(np.asarray(out._value), x + y)
+
+
+class TestAudio:
+    def test_mel_scale_roundtrip(self):
+        from paddle_tpu.audio import functional as AF
+        freqs = np.asarray([0.0, 440.0, 1000.0, 4000.0, 8000.0])
+        back = AF.mel_to_hz(AF.hz_to_mel(freqs))
+        np.testing.assert_allclose(back, freqs, rtol=1e-6)
+        back_htk = AF.mel_to_hz(AF.hz_to_mel(freqs, htk=True), htk=True)
+        np.testing.assert_allclose(back_htk, freqs, rtol=1e-6)
+
+    def test_fbank_shape_and_coverage(self):
+        from paddle_tpu.audio import functional as AF
+        fb = np.asarray(AF.compute_fbank_matrix(16000, 512, n_mels=40,
+                                                norm=None)._value)
+        assert fb.shape == (40, 257)
+        assert fb.min() >= 0
+        # every filter has support, triangles peak at 1 without norm
+        assert (fb.max(axis=1) > 0.5).all()
+
+    def test_spectrogram_matches_stft_power(self):
+        x = np.random.RandomState(0).randn(2, 2000).astype("f4")
+        spec = paddle.audio.features.Spectrogram(n_fft=256, hop_length=128)
+        out = np.asarray(spec(paddle.to_tensor(x))._value)
+        ref = paddle.signal.stft(paddle.to_tensor(x), 256, 128,
+                                 window=spec.window)
+        ref = np.abs(np.asarray(ref._value)) ** 2
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_mfcc_pipeline_shapes(self):
+        x = np.random.RandomState(1).randn(3, 4000).astype("f4")
+        mfcc = paddle.audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=512,
+                                          n_mels=40)
+        out = mfcc(paddle.to_tensor(x))
+        assert tuple(out.shape)[0] == 3 and tuple(out.shape)[1] == 13
+        assert np.isfinite(np.asarray(out._value)).all()
+
+    def test_logmel_top_db_caps_range(self):
+        x = np.random.RandomState(2).randn(2000).astype("f4")
+        lm = paddle.audio.features.LogMelSpectrogram(sr=16000, n_fft=256,
+                                                     top_db=60.0)
+        out = np.asarray(lm(paddle.to_tensor(x))._value)
+        assert out.max() - out.min() <= 60.0 + 1e-4
