@@ -203,6 +203,14 @@ class Tensor:
 
     def detach(self):
         t = Tensor(self._value, stop_gradient=True, name=self.name)
+        # static recording: the detached copy must stay linked to its
+        # producer in the Program op tape (ops like embedding/CE detach
+        # their index inputs; without this link a fed placeholder's
+        # detached view would replay as a frozen constant).  No autograd
+        # node — detach still blocks gradients.
+        rec = _ag._STATIC_RECORDER[0]
+        if rec is not None and not _ag._TAPE_SUSPENDED[0]:
+            rec.record(lambda v: v, (self,), (t,))
         return t
 
     def clone(self):

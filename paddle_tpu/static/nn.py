@@ -1,16 +1,20 @@
-"""paddle.static.nn control-flow ops (reference:
-python/paddle/static/nn/control_flow.py — cond builds a
-conditional_block pair, while_loop builds a While op with a sub-block).
+"""paddle.static.nn (reference: python/paddle/static/nn/ — control_flow
+(cond/while_loop over conditional_block/While ops) + the legacy layer
+builders (fc, embedding, conv2d, batch_norm, ...) that construct
+parameters in the program's scope).
 
-TPU-native: both delegate to the jit.dy2static runtime converters, so a
-concrete predicate keeps Python semantics and a traced predicate lowers
-to ``lax.cond`` / ``lax.while_loop`` — the same machinery the AST pass
-uses, exposed as the explicit user API.
+TPU-native: control flow delegates to the jit.dy2static runtime
+converters (concrete predicate keeps Python semantics, traced lowers to
+``lax.cond`` / ``lax.while_loop``); the layer builders construct the
+dynamic ``paddle.nn`` layers once per (program, name) and call them —
+the op tape records their ops and params exactly like hand-built
+layers, so Executor/persistables see them unchanged.
 """
 from ..framework.core import Tensor
 from ..jit.dy2static import convert_ifelse, convert_while_loop
 
-__all__ = ["cond", "while_loop"]
+__all__ = ["cond", "while_loop", "fc", "embedding", "conv2d",
+           "batch_norm", "layer_norm"]
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
@@ -46,3 +50,113 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
 
     out = convert_while_loop(cond, body_tuple, tuple(loop_vars))
     return list(out)
+
+
+# -- legacy layer builders ---------------------------------------------------
+_LAYER_CACHE = {}
+_AUTO_NAMES = {}
+
+
+def _layer_for(kind, name, factory):
+    """One layer instance per (current program, kind, name): repeated
+    calls inside the same program reuse the parameters (reference:
+    unique_name + scope var lookup)."""
+    from . import default_main_program
+    prog = default_main_program()
+    if name is None:
+        counter_key = (id(prog), kind)
+        n = _AUTO_NAMES.get(counter_key, 0)
+        _AUTO_NAMES[counter_key] = n + 1
+        name = f"{kind}_{n}"
+    key = (id(prog), kind, name)
+    layer = _LAYER_CACHE.get(key)
+    if layer is None:
+        layer = factory()
+        _LAYER_CACHE[key] = layer
+    return layer
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static.nn.fc — flatten trailing dims, Linear, optional
+    activation by name."""
+    from .. import nn
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    in_feats = 1
+    for d in xt.shape[num_flatten_dims:]:
+        in_feats *= int(d)
+    layer = _layer_for("fc", name, lambda: nn.Linear(
+        in_feats, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    # -1 keeps the (possibly dynamic) batch dim; later lead dims and the
+    # flattened feature dims must be static
+    new_shape = [-1] + [int(d) for d in xt.shape[1:num_flatten_dims]] \
+        + [in_feats]
+    out = layer(xt.reshape(new_shape))
+    if activation is not None:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32",
+              name=None):
+    from .. import nn
+    layer = _layer_for("embedding", name, lambda: nn.Embedding(
+        size[0], size[1], padding_idx=padding_idx,
+        weight_attr=param_attr))
+    return layer(input if isinstance(input, Tensor) else Tensor(input))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    xt = input if isinstance(input, Tensor) else Tensor(input)
+    in_ch = int(xt.shape[1 if data_format == "NCHW" else -1])
+    layer = _layer_for("conv2d", name, lambda: nn.Conv2D(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format))
+    out = layer(xt)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-05, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None, **kw):
+    from .. import nn
+    xt = input if isinstance(input, Tensor) else Tensor(input)
+    ch = int(xt.shape[1 if data_layout == "NCHW" else -1])
+    layer = _layer_for("batch_norm", name, lambda: nn.BatchNorm2D(
+        ch, momentum=momentum, epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_layout)
+        if xt.ndim == 4 else nn.BatchNorm1D(
+        ch, momentum=momentum, epsilon=epsilon))
+    if is_test:
+        layer.eval()
+    out = layer(xt)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+    xt = input if isinstance(input, Tensor) else Tensor(input)
+    norm_shape = [int(d) for d in xt.shape[begin_norm_axis:]]
+    layer = _layer_for("layer_norm", name, lambda: nn.LayerNorm(
+        norm_shape, epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False))
+    out = layer(xt)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out

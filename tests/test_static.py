@@ -163,3 +163,66 @@ class TestInferenceModelIO:
         assert feed_names == ["x"]
         (got,) = loaded.run({"x": xs})
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestStaticNNBuilders:
+    """Legacy static.nn layer builders (reference: static.nn.fc etc.)."""
+
+    def test_fc_runs_and_caches_params(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                h = static.nn.fc(x, 8, activation="relu", name="h")
+                out = static.nn.fc(h, 2, name="o")
+            exe = static.Executor()
+            feed = {"x": np.random.RandomState(0).rand(5, 4).astype("f4")}
+            r1 = exe.run(main, feed=feed, fetch_list=[out])
+            r2 = exe.run(main, feed=feed, fetch_list=[out])
+            assert r1[0].shape == (5, 2)
+            np.testing.assert_allclose(r1[0], r2[0])  # same cached params
+        finally:
+            paddle.disable_static()
+
+    def test_conv_bn_pipeline(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("img", [None, 3, 8, 8], "float32")
+                c = static.nn.conv2d(x, 4, 3, padding=1, name="c1")
+                b = static.nn.batch_norm(c, act="relu", is_test=True,
+                                         name="bn1")
+                ln = static.nn.layer_norm(b, begin_norm_axis=1, name="ln1")
+            exe = static.Executor()
+            r = exe.run(main,
+                        feed={"img": np.random.RandomState(1)
+                              .rand(2, 3, 8, 8).astype("f4")},
+                        fetch_list=[ln])
+            assert r[0].shape == (2, 4, 8, 8)
+            assert np.isfinite(r[0]).all()
+        finally:
+            paddle.disable_static()
+
+    def test_embedding_builder(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                ids = static.data("ids", [None, 6], "int64")
+                emb = static.nn.embedding(ids, size=[32, 8], name="emb")
+            exe = static.Executor()
+            r = exe.run(main,
+                        feed={"ids": np.random.RandomState(2)
+                              .randint(0, 32, (3, 6)).astype("i8")},
+                        fetch_list=[emb])
+            assert r[0].shape == (3, 6, 8)
+        finally:
+            paddle.disable_static()
