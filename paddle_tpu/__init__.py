@@ -62,7 +62,7 @@ CPUPlace = lambda: "cpu"
 CUDAPlace = lambda idx=0: f"tpu:{idx}"  # no GPUs; map onto TPU
 TPUPlace = lambda idx=0: f"tpu:{idx}"
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 
 def in_dynamic_mode():

@@ -26,7 +26,8 @@ _AMP_STATE = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1",
 # (exp/log/softmax/norm/loss reductions) — cast their inputs up to fp32.
 # Everything else runs in whatever dtype its inputs arrive in (promote).
 WHITE_LIST = frozenset({
-    "conv2d", "conv3d", "conv1d", "conv2d_transpose", "conv3d_transpose",
+    "conv2d", "conv3d", "conv1d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose",
     "matmul", "matmul_v2", "mul", "mm", "bmm", "fc", "linear", "einsum",
     "addmm", "attention", "depthwise_conv2d"})
 BLACK_LIST = frozenset({

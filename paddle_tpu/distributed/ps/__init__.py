@@ -23,7 +23,22 @@ parameter service:
 Workers pull rows into the jax program's inputs, compute grads under the
 normal autograd, and push sparse grads back; the TPU never holds the full
 table.
+
+Security: wire frames are pickled, but deserialization goes through a
+RESTRICTED unpickler that admits only numpy arrays/scalars/dtypes and
+builtin containers — a frame referencing any other global (e.g.
+``os.system``) is rejected before construction, so a reachable port is
+not an arbitrary-code-execution hole.  There is still no authentication
+or encryption: run the PS on a trusted network segment (localhost /
+cluster-private VLAN), exactly like the reference's brpc endpoints.
+
+Scale envelope (deliberate lean design vs the reference's ~120k-LoC
+brpc subsystem): tables are in-process Python dicts guarded by ONE lock
+per table, rows travel fully pickled per request, and there is no SSD
+tier, TTL eviction, or CTR accessor.  Good for O(10^6) rows and a few
+thousand touched rows/step per shard; shard count is the scaling knob.
 """
+import io
 import pickle
 import socket
 import socketserver
@@ -146,6 +161,36 @@ class DenseTable:
 # wire protocol
 # ---------------------------------------------------------------------------
 
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Admit only the globals a PS frame legitimately needs: numpy array
+    reconstruction + dtypes.  Everything else (os.system, subprocess,
+    functools, ...) raises before any object is constructed."""
+
+    _ALLOWED = {
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.numeric", "_frombuffer"),
+        ("numpy._core.numeric", "_frombuffer"),
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED or \
+                module in ("numpy.dtypes", "numpy._core.numerictypes",
+                           "numpy.core.numerictypes"):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"PS wire: refusing to unpickle global {module}.{name} "
+            "(only numpy arrays and builtin containers are accepted)")
+
+
+def _safe_loads(data):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
 def _send_frame(sock, obj):
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(struct.pack("!I", len(data)) + data)
@@ -165,7 +210,7 @@ def _recv_frame(sock):
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return _safe_loads(bytes(buf))
 
 
 class PSServer:
@@ -179,7 +224,16 @@ class PSServer:
             def handle(self):
                 try:
                     while True:
-                        req = _recv_frame(self.request)
+                        try:
+                            req = _recv_frame(self.request)
+                        except (ConnectionError, OSError):
+                            return
+                        except Exception as e:
+                            # malicious/garbage/truncated frame (rejected
+                            # unpickle, EOFError, ...): report + drop conn
+                            _send_frame(self.request,
+                                        {"ok": False, "error": repr(e)})
+                            return
                         _send_frame(self.request, srv_self._dispatch(req))
                 except (ConnectionError, OSError):
                     pass
@@ -353,7 +407,7 @@ class PSClient:
         for s, c in enumerate(self.conns):
             fp = os.path.join(path, f"ps_shard_{s}.pkl")
             with open(fp, "rb") as f:
-                state = pickle.load(f)
+                state = _safe_loads(f.read())
             c.call({"op": "load", "state": state})
 
     def close(self):

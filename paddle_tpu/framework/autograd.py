@@ -188,7 +188,34 @@ def _accumulate(tensor, cot):
         tensor._grad = tensor._grad + cot
 
 
+_EAGER_BACKWARD_CALLS = 0
+_EAGER_LOOP_WARN_AT = 16
+
+
+def _warn_eager_loop():
+    """One-time hint when .backward() keeps running un-jitted: eager
+    tape replay is measured ~2.7x slower per step than a compiled train
+    step (BENCH eager_overhead row)."""
+    global _EAGER_BACKWARD_CALLS
+    if _EAGER_BACKWARD_CALLS < 0:
+        return
+    _EAGER_BACKWARD_CALLS += 1
+    if _EAGER_BACKWARD_CALLS >= _EAGER_LOOP_WARN_AT:
+        import warnings
+        warnings.warn(
+            "paddle_tpu: .backward() has run eagerly "
+            f"{_EAGER_BACKWARD_CALLS} times. Eager autograd replays the "
+            "tape op-by-op (~2.7x slower per step than a compiled step). "
+            "For training loops, wrap the step with paddle.jit.to_static, "
+            "use hapi Model.fit, or the fleet/auto_parallel steppers.",
+            stacklevel=3)
+        _EAGER_BACKWARD_CALLS = -1  # warn once
+
+
 def backward(tensor, grad_tensor=None, retain_graph=False):
+    import jax.core as _jcore
+    if not isinstance(tensor._value, _jcore.Tracer):
+        _warn_eager_loop()
     if tensor._node is None:
         if not tensor.stop_gradient:
             g = (jnp.ones_like(tensor._value) if grad_tensor is None

@@ -71,26 +71,32 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
     return call_op(_conv, x, weight)
 
 
+def _autocast_conv(op_name, x, weight, bias):
+    # O1 cast covers bias too — a fp32 bias would promote the conv
+    # output back to fp32 (same policy as linear)
+    from ...amp import autocast_inputs
+    return autocast_inputs(
+        op_name, ensure_tensor(x), ensure_tensor(weight),
+        ensure_tensor(bias) if bias is not None else None)
+
+
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
+    x, weight, bias = _autocast_conv("conv1d", x, weight, bias)
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
                     data_format, 1)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
-    from ...amp import autocast_inputs
-    x, weight = autocast_inputs("conv2d", ensure_tensor(x),
-                                ensure_tensor(weight))
+    x, weight, bias = _autocast_conv("conv2d", x, weight, bias)
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
                     data_format, 2)
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
-    from ...amp import autocast_inputs
-    x, weight = autocast_inputs("conv3d", ensure_tensor(x),
-                                ensure_tensor(weight))
+    x, weight, bias = _autocast_conv("conv3d", x, weight, bias)
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
                     data_format, 3)
 
@@ -147,6 +153,7 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCL", name=None):
+    x, weight, bias = _autocast_conv("conv1d_transpose", x, weight, bias)
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, dilation, groups, data_format,
                               1, output_size)
@@ -155,6 +162,7 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCHW", name=None):
+    x, weight, bias = _autocast_conv("conv2d_transpose", x, weight, bias)
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, dilation, groups, data_format,
                               2, output_size)
@@ -163,6 +171,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCDHW", name=None):
+    x, weight, bias = _autocast_conv("conv3d_transpose", x, weight, bias)
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, dilation, groups, data_format,
                               3, output_size)

@@ -18,6 +18,20 @@ DEFAULT_BLOCK_K = 512
 _LANES = 128   # lse/delta carry a broadcast lane dim (TPU tiling rule)
 
 
+def _fwd_blocks(S):
+    """Measured on v5e (r3 autotune): at S>=4096 streaming k/v in 1024-
+    wide blocks cuts fwd time ~20% (fewer loop trips); below that
+    256/256 wins for the head-folded kernel (smaller unrolled stack,
+    better VPU/MXU overlap).  Blocks must DIVIDE S — the kernels size
+    their loops as S // block (S=4608 with bk=1024 would silently skip
+    the last 512 keys)."""
+    if S >= 4096 and S % 1024 == 0:
+        return (512, 1024)
+    if S % 256 == 0:
+        return (256, 256)
+    return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
                   seq_len):
     # q_ref: (block_q, d); k_ref/v_ref: (seq_len, d); o_ref: (block_q, d)
@@ -248,57 +262,82 @@ def _flash_bhsd_fwd_lse(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
 
 def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                            scale, causal, block_q, block_k, seq_len):
-    """One-pass backward for one (batch*head): every (q,k) block pair is
-    visited ONCE, producing dQ and accumulating dK/dV in fp32 VMEM
-    scratch — vs the two-pass kernels that recompute S/P/dP twice.  The
-    q/k loops are static Python, so causal block skipping and diagonal
-    masking are resolved at trace time."""
-    nq = seq_len // block_q
+                            scale, causal, block_k, seq_len):
+    """One-pass backward: every (q,k) block pair is visited ONCE,
+    producing dQ and accumulating dK/dV in fp32 VMEM scratch — vs the
+    two-pass kernels that recompute S/P/dP twice.
+
+    The grid's second axis walks q blocks SEQUENTIALLY (dimension
+    semantics "arbitrary"), so only one (block_q, D) q/do tile is VMEM-
+    resident at a time while the dk/dv accumulators persist across grid
+    steps; that keeps the VMEM footprint ~16·S·D bytes and lets the
+    one-pass kernel run to S=8192 at D=64 (the old all-in-one-program
+    variant held every q block at once and topped out at S=2048)."""
+    qi = pl.program_id(1)
+    nq = pl.num_programs(1)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
     nk = seq_len // block_k
-    dk_acc[:] = jnp.zeros_like(dk_acc)
-    dv_acc[:] = jnp.zeros_like(dv_acc)
-    for qi in range(nq):
-        q = q_ref[pl.ds(qi * block_q, block_q), :] * scale
-        do = do_ref[pl.ds(qi * block_q, block_q), :]
-        lse = jnp.tile(lse_ref[pl.ds(qi * block_q, block_q), :],
-                       (1, block_k // _LANES))
-        delta = jnp.tile(delta_ref[pl.ds(qi * block_q, block_q), :],
-                         (1, block_k // _LANES))
-        dq = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
-        for ki in range(nk):
-            q_lo, q_hi = qi * block_q, qi * block_q + block_q - 1
-            k_lo, k_hi = ki * block_k, ki * block_k + block_k - 1
-            if causal and k_lo > q_hi:
-                continue                      # fully above the diagonal
-            k = k_ref[pl.ds(k_lo, block_k), :]
-            v = v_ref[pl.ds(k_lo, block_k), :]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-            if causal and k_hi > q_lo:        # diagonal-straddling block
-                q_idx = q_lo + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, 1), 0)
-                k_idx = k_lo + jax.lax.broadcasted_iota(
-                    jnp.int32, (1, block_k), 1)
-                s = jnp.where(q_idx >= k_idx, s, -1e30)
-            p = jnp.exp(s - lse)
-            pb = p.astype(do.dtype)
-            dv_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
-                pb.T, do, preferred_element_type=jnp.float32)
-            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta)).astype(q.dtype)
-            dq = dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
-            dk_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
-                ds.T, q, preferred_element_type=jnp.float32)
-        dq_ref[pl.ds(qi * block_q, block_q), :] = \
-            (dq * scale).astype(dq_ref.dtype)
-    dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
-    dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+    @pl.when(qi == 0)
+    def _zero():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[:] * scale
+    do = do_ref[:]
+    lse = jnp.tile(lse_ref[:], (1, block_k // _LANES))
+    delta = jnp.tile(delta_ref[:], (1, block_k // _LANES))
+    q_idx = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    def body(i, dq):
+        k_lo = i * block_k
+        k = k_ref[pl.ds(k_lo, block_k), :]
+        v = v_ref[pl.ds(k_lo, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_idx = k_lo + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        p = jnp.exp(s - lse)
+        pb = p.astype(do.dtype)
+        dv_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
+            pb.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # only k blocks at or below this q block's diagonal contribute
+        nkb = jnp.minimum((qi * block_q + block_q + block_k - 1) // block_k,
+                          nk)
+        dq = jax.lax.fori_loop(0, nkb, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, nk, body, dq0)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-# fused one-pass bwd keeps q/k/v/do plus fp32 dk/dv scratch VMEM-resident
-# per (batch*head); past this seq length that no longer fits and the
-# two-pass kernels take over
-_FUSED_BWD_MAX_SEQ = 2048
+# fused one-pass bwd keeps k/v (+ fp32 dk/dv scratch and bf16 dk/dv
+# output tiles) VMEM-resident per (batch*head): ~16 bytes/element of
+# (S, D).  Past this S·D budget it no longer fits alongside the q/do
+# tiles and the two-pass kernels take over.
+_FUSED_BWD_MAX_SD = 8192 * 64
+# head-folded kernels fully unroll the q/k block loops, and Mosaic does
+# NOT reuse stack slots across unrolled bodies — past these S*D caps the
+# s/p temporaries overflow the 16MB scoped VMEM (fwd S=4096 measured
+# 41MB).  Measured crossover: mh bwd beats grid-fused only at S<=1024
+# (6.1 vs 5.5ms at S=2048).
+_MH_FWD_MAX_SD = 2048 * 64
+_MH_BWD_MAX_SD = 1024 * 64
 
 
 def _bwd_prep(o, do, lse):
@@ -322,16 +361,17 @@ def _flash_bhsd_bwd_fused(q, k, v, o, lse, do, causal=False,
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
     lse_l, delta_l = _bwd_prep(o, do, lse)
-    full = lambda b: (b, 0, 0)
+    qblk = lambda b, i: (b, i, 0)
+    full = lambda b, i: (b, 0, 0)
+    spec_qd = pl.BlockSpec((None, block_q, D), qblk)
+    spec_ql = pl.BlockSpec((None, block_q, _LANES), qblk)
     spec_sd = pl.BlockSpec((None, S, D), full)
-    spec_sl = pl.BlockSpec((None, S, _LANES), full)
     return pl.pallas_call(
         functools.partial(_flash_bwd_fused_kernel, scale=scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          seq_len=S),
-        grid=(BH,),
-        in_specs=[spec_sd, spec_sd, spec_sd, spec_sd, spec_sl, spec_sl],
-        out_specs=[spec_sd, spec_sd, spec_sd],
+                          causal=causal, block_k=block_k, seq_len=S),
+        grid=(BH, S // block_q),
+        in_specs=[spec_qd, spec_sd, spec_sd, spec_qd, spec_ql, spec_ql],
+        out_specs=[spec_qd, spec_sd, spec_sd],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, S, D), k.dtype),
@@ -339,6 +379,8 @@ def _flash_bhsd_bwd_fused(q, k, v, o, lse, do, causal=False,
         ],
         scratch_shapes=[pltpu.VMEM((S, D), jnp.float32),
                         pltpu.VMEM((S, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
 
@@ -394,6 +436,185 @@ def _flash_bhsd_bwd(q, k, v, o, lse, do, causal=False,
     return dq, dk, dv
 
 
+# ---------------------------------------------------------------------------
+# head-folded kernels: several (batch, head) slices per pallas program.
+# At D=64/S~1k each q-block program does only ~0.1ms-equivalent of MXU
+# work while per-program overhead (prologue, DMA issue, semaphores) is
+# ~3-4us, so the per-(b,h)-per-q-block grid ran at <10% MXU (measured
+# r3).  Folding HB heads into one program with fully static q/k loops
+# amortizes that overhead ~HB*nq-fold.
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                         causal, block_q, block_k, seq_len, with_lse):
+    hb = q_ref.shape[0]
+    d = q_ref.shape[2]
+    nq = seq_len // block_q
+    nk = seq_len // block_k
+    for h in range(hb):
+        for qi in range(nq):
+            q_lo = qi * block_q
+            q = q_ref[h, pl.ds(q_lo, block_q), :] * scale
+            acc = jnp.zeros((block_q, d), jnp.float32)
+            m = jnp.full((block_q, 1), -1e30, jnp.float32)
+            l = jnp.zeros((block_q, 1), jnp.float32)
+            for ki in range(nk):
+                k_lo = ki * block_k
+                if causal and k_lo > q_lo + block_q - 1:
+                    continue                  # fully above the diagonal
+                k = k_ref[h, pl.ds(k_lo, block_k), :]
+                v = v_ref[h, pl.ds(k_lo, block_k), :]
+                s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+                if causal and k_lo + block_k - 1 > q_lo:   # straddles diag
+                    q_idx = q_lo + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, 1), 0)
+                    k_idx = k_lo + jax.lax.broadcasted_iota(
+                        jnp.int32, (1, block_k), 1)
+                    s = jnp.where(q_idx >= k_idx, s, -1e30)
+                m_cur = jnp.max(s, axis=-1, keepdims=True)
+                m_new = jnp.maximum(m, m_cur)
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m - m_new)
+                l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+                acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                            preferred_element_type=jnp.float32)
+                m = m_new
+            l = jnp.maximum(l, 1e-30)
+            o_ref[h, pl.ds(q_lo, block_q), :] = \
+                (acc / l).astype(o_ref.dtype)
+            if with_lse:
+                lse_ref[h, pl.ds(q_lo, block_q), :] = \
+                    jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
+
+
+def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                         causal, block_q, block_k, seq_len):
+    """One-pass backward, HB heads per program, static loops; dk/dv
+    accumulate in fp32 VMEM scratch within the program (no cross-program
+    state — each program owns its heads outright)."""
+    hb = q_ref.shape[0]
+    d = q_ref.shape[2]
+    nq = seq_len // block_q
+    nk = seq_len // block_k
+    for h in range(hb):
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+        for qi in range(nq):
+            q_lo = qi * block_q
+            q = q_ref[h, pl.ds(q_lo, block_q), :] * scale
+            do = do_ref[h, pl.ds(q_lo, block_q), :]
+            # column-broadcast instead of tiling to (block_q, block_k):
+            # sublane broadcast is free on the VPU, the tile was a real
+            # materialized copy
+            lse = lse_ref[h, pl.ds(q_lo, block_q), :][:, :1]
+            delta = delta_ref[h, pl.ds(q_lo, block_q), :][:, :1]
+            dq = jnp.zeros((block_q, d), jnp.float32)
+            for ki in range(nk):
+                k_lo = ki * block_k
+                if causal and k_lo > q_lo + block_q - 1:
+                    continue
+                k = k_ref[h, pl.ds(k_lo, block_k), :]
+                v = v_ref[h, pl.ds(k_lo, block_k), :]
+                s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+                if causal and k_lo + block_k - 1 > q_lo:
+                    q_idx = q_lo + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, 1), 0)
+                    k_idx = k_lo + jax.lax.broadcasted_iota(
+                        jnp.int32, (1, block_k), 1)
+                    s = jnp.where(q_idx >= k_idx, s, -1e30)
+                p = jnp.exp(s - lse)
+                pb = p.astype(do.dtype)
+                dv_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
+                    pb.T, do, preferred_element_type=jnp.float32)
+                dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+                ds = (p * (dp - delta)).astype(q.dtype)
+                dk_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
+                    ds.T, q, preferred_element_type=jnp.float32)
+                dq = dq + jnp.dot(ds, k,
+                                  preferred_element_type=jnp.float32)
+            dq_ref[h, pl.ds(q_lo, block_q), :] = \
+                (dq * scale).astype(dq_ref.dtype)
+        dk_ref[h, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[h, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pick_hb(BH, S, D, n_bufs):
+    """Heads per program: largest divisor of BH whose n_bufs (S, D)
+    buffers fit a ~2MB VMEM budget (the 16MB scoped budget must also
+    hold double-buffered block DMA + the unrolled loop's s/p stack
+    temporaries, measured ~3x the block bytes)."""
+    budget = 2 * 1024 * 1024
+    per_head = n_bufs * S * D * 2 + S * _LANES * 8   # bf16 bufs + lse/delta
+    hb = max(1, budget // max(per_head, 1))
+    while hb > 1 and BH % hb:
+        hb -= 1
+    return min(hb, BH)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "with_lse", "interpret"))
+def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
+                       block_k=DEFAULT_BLOCK_K, with_lse=True,
+                       interpret=False):
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = 1.0 / math.sqrt(D)
+    hb = _pick_hb(BH, S, D, n_bufs=4)
+    spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
+    out_specs = [spec]
+    out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((hb, S, _LANES), lambda b: (b, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32))
+    kernel = functools.partial(_flash_fwd_mh_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, seq_len=S, with_lse=with_lse)
+    if not with_lse:
+        kernel_nl = kernel
+        kernel = lambda qr, kr, vr, orf: kernel_nl(qr, kr, vr, orf, None)
+    out = pl.pallas_call(
+        kernel,
+        grid=(BH // hb,),
+        in_specs=[spec, spec, spec],
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
+        interpret=interpret,
+    )(q, k, v)
+    return out if with_lse else (out, None)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "interpret"))
+def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
+                       block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                       interpret=False):
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = 1.0 / math.sqrt(D)
+    lse_l, delta_l = _bwd_prep(o, do, lse)
+    hb = _pick_hb(BH, S, D, n_bufs=7)
+    spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
+    spec_l = pl.BlockSpec((hb, S, _LANES), lambda b: (b, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_mh_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(BH // hb,),
+        in_specs=[spec, spec, spec, spec, spec_l, spec_l],
+        out_specs=[spec, spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((S, D), jnp.float32),
+                        pltpu.VMEM((S, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_l, delta_l)
+
+
 def _to_bhsd(x):
     B, S, H, D = x.shape
     return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
@@ -414,7 +635,14 @@ def flash_attention_fwd(q, k, v, causal=False):
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    of = _flash_bhsd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), causal=causal)
+    bq, bk = _fwd_blocks(S)
+    if S * D <= _MH_FWD_MAX_SD:
+        of, _ = _flash_bhsd_fwd_mh(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                                   causal=causal, block_q=bq, block_k=bk,
+                                   with_lse=False)
+    else:
+        of = _flash_bhsd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                         causal=causal, block_q=bq, block_k=bk)
     return _from_bhsd(of, B, H)
 
 
@@ -426,8 +654,16 @@ def flash_attention_fwd_lse(q, k, v, causal=False, interpret=False):
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    of, lse = _flash_bhsd_fwd_lse(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
-                                  causal=causal, interpret=interpret)
+    bq, bk = _fwd_blocks(S)
+    if S * D <= _MH_FWD_MAX_SD:
+        of, lse = _flash_bhsd_fwd_mh(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                                     causal=causal, block_q=bq, block_k=bk,
+                                     with_lse=True, interpret=interpret)
+    else:
+        of, lse = _flash_bhsd_fwd_lse(_to_bhsd(q), _to_bhsd(k),
+                                      _to_bhsd(v), causal=causal,
+                                      block_q=bq, block_k=bk,
+                                      interpret=interpret)
     return _from_bhsd(of, B, H), lse[..., 0]
 
 
@@ -440,8 +676,14 @@ def flash_attention_bwd(q, k, v, o, lse, do, causal=False, interpret=False):
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    bwd = _flash_bhsd_bwd_fused if S <= _FUSED_BWD_MAX_SEQ \
-        else _flash_bhsd_bwd
+    # ladder: head-folded one-pass (smallest grids, whole (b,h) resident)
+    # -> q-grid one-pass (cross-step dk/dv scratch) -> two-pass
+    if S * D <= _MH_BWD_MAX_SD:
+        bwd = _flash_bhsd_bwd_mh
+    elif S * D <= _FUSED_BWD_MAX_SD:
+        bwd = _flash_bhsd_bwd_fused
+    else:
+        bwd = _flash_bhsd_bwd
     dqf, dkf, dvf = bwd(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(o), lse,
         _to_bhsd(do), causal=causal, interpret=interpret)

@@ -31,20 +31,18 @@ _FORCE_INTERPRET = False   # tests: run the kernels in interpret mode on CPU
 
 
 def _pick_bv(V):
-    """Largest lane-multiple chunk width <= _MAX_BV that divides V, or
-    None when V has no lane-aligned factorization (caller falls back)."""
+    """Fixed wide chunk (good HBM streaming + few grid trips); the tail
+    chunk is masked by global column index, so V only needs LANE
+    alignment, not divisibility (50304 = 393·128 would otherwise force
+    384-wide chunks — 131 grid trips/row-block, measured 3x slower than
+    the masked 2048-wide stream)."""
     if V % _LANES:
         return None
-    best = None
-    for mult in range(1, _MAX_BV // _LANES + 1):
-        bv = mult * _LANES
-        if V % bv == 0:
-            best = bv
-    return best
+    return min(_MAX_BV, V)
 
 
 def _xent_fwd_kernel(lg_ref, lb_ref, out_ref, lse_ref, m_ref, s_ref, p_ref,
-                     *, n_v, bv):
+                     *, n_v, bv, V):
     vi = pl.program_id(1)
 
     @pl.when(vi == 0)
@@ -54,6 +52,10 @@ def _xent_fwd_kernel(lg_ref, lb_ref, out_ref, lse_ref, m_ref, s_ref, p_ref,
         p_ref[:] = jnp.zeros_like(p_ref)
 
     chunk = lg_ref[:].astype(jnp.float32)            # (bt, bv)
+    col = vi * bv + jax.lax.broadcasted_iota(jnp.int32, chunk.shape, 1)
+    if V % bv:
+        # tail chunk: out-of-range lanes read padding — exclude them
+        chunk = jnp.where(col < V, chunk, -1e30)
     lb = lb_ref[:, 0]                                 # (bt,)
     m_prev = m_ref[:, 0]
     m_cur = jnp.max(chunk, axis=-1)
@@ -62,9 +64,7 @@ def _xent_fwd_kernel(lg_ref, lb_ref, out_ref, lse_ref, m_ref, s_ref, p_ref,
     s_new = s_ref[:, 0] * alpha + jnp.sum(
         jnp.exp(chunk - m_new[:, None]), axis=-1)
     # label logit if it falls inside this chunk
-    off = lb - vi * bv                                # (bt,)
-    col = jax.lax.broadcasted_iota(jnp.int32, chunk.shape, 1)
-    hit = col == off[:, None]
+    hit = col == lb[:, None]
     p_new = p_ref[:, 0] + jnp.sum(jnp.where(hit, chunk, 0.0), axis=-1)
     m_ref[:, 0] = m_new
     s_ref[:, 0] = s_new
@@ -78,16 +78,17 @@ def _xent_fwd_kernel(lg_ref, lb_ref, out_ref, lse_ref, m_ref, s_ref, p_ref,
         lse_ref[:, 0] = lse
 
 
-def _xent_bwd_kernel(lg_ref, lb_ref, lse_ref, g_ref, dlg_ref, *, bv):
+def _xent_bwd_kernel(lg_ref, lb_ref, lse_ref, g_ref, dlg_ref, *, bv, V):
     vi = pl.program_id(1)
     chunk = lg_ref[:].astype(jnp.float32)
+    col = vi * bv + jax.lax.broadcasted_iota(jnp.int32, chunk.shape, 1)
+    if V % bv:
+        chunk = jnp.where(col < V, chunk, -1e30)  # exp -> 0 in the pad
     lb = lb_ref[:, 0]
     lse = lse_ref[:, 0]
     scale = g_ref[:, 0]                               # per-row upstream g
     p = jnp.exp(chunk - lse[:, None])
-    off = lb - vi * bv
-    col = jax.lax.broadcasted_iota(jnp.int32, chunk.shape, 1)
-    onehot = (col == off[:, None]).astype(jnp.float32)
+    onehot = (col == lb[:, None]).astype(jnp.float32)
     valid = (lb >= 0).astype(jnp.float32)
     dlg_ref[:] = ((p - onehot) * (scale * valid)[:, None]
                   ).astype(dlg_ref.dtype)
@@ -122,9 +123,9 @@ def _fwd_impl(logits2, labels):
         lse = jax.scipy.special.logsumexp(lg, axis=-1)
         return _ref_rowloss(logits2, labels), lse
     lbl = _lane_col(labels.astype(jnp.int32), T)
-    n_v = V // bv
+    n_v = -(-V // bv)      # ceil: tail chunk masked in-kernel
     out, lse = pl.pallas_call(
-        functools.partial(_xent_fwd_kernel, n_v=n_v, bv=bv),
+        functools.partial(_xent_fwd_kernel, n_v=n_v, bv=bv, V=V),
         grid=(T // _BT, n_v),
         in_specs=[
             pl.BlockSpec((_BT, bv), lambda t, v: (t, v)),
@@ -170,8 +171,8 @@ def _xent_bwd(res, g):
     lse_l = _lane_col(lse, T)
     g_l = _lane_col(g.astype(jnp.float32), T)
     dlg = pl.pallas_call(
-        functools.partial(_xent_bwd_kernel, bv=bv),
-        grid=(T // _BT, V // bv),
+        functools.partial(_xent_bwd_kernel, bv=bv, V=V),
+        grid=(T // _BT, -(-V // bv)),
         in_specs=[
             pl.BlockSpec((_BT, bv), lambda t, v: (t, v)),
             pl.BlockSpec((_BT, _LANES), lambda t, v: (t, 0)),

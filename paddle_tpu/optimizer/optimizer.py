@@ -30,10 +30,8 @@ def apply_functional_with_clip(opt, train_vals, grads, opt_state, lr,
     if opt._grad_clip is not None:
         clipped = opt._grad_clip(list(zip(train_vals, grads)))
         grads = [g for _, g in clipped]
-    if isinstance(opt, AdamW):
-        return opt.apply_functional(train_vals, grads, opt_state, lr,
-                                    param_names=param_names)
-    return opt.apply_functional(train_vals, grads, opt_state, lr)
+    return opt.apply_functional(train_vals, grads, opt_state, lr,
+                                param_names=param_names)
 
 
 class L2Decay:
@@ -107,6 +105,12 @@ class Optimizer:
     def _update(self, param, grad, state, lr):
         raise NotImplementedError
 
+    def _update_named(self, param, grad, state, lr, name):
+        """Name-aware hook; default ignores the name.  Overridden by
+        optimizers whose rule depends on the param name (AdamW decoupled
+        decay lists, LARS exclusion)."""
+        return self._update(param, grad, state, lr)
+
     def _apply_decay(self, param, grad):
         if self._l2_coeff:
             grad = grad + self._l2_coeff * param
@@ -122,6 +126,8 @@ class Optimizer:
             raise ValueError("optimizer constructed without parameters; "
                              "pass parameters=model.parameters()")
         lr = self.get_lr()
+        names = {id(p): (p.name or f"param_{i}")
+                 for i, p in enumerate(params)}
         pairs = [(p, p._grad) for p in params
                  if not p.stop_gradient and p._grad is not None]
         if self._grad_clip is not None:
@@ -133,7 +139,8 @@ class Optimizer:
                 continue
             g = self._apply_decay(p._value, g.astype(p._value.dtype))
             st = self._state_of(p)
-            new_p, new_st = self._update(p._value, g, st, lr)
+            new_p, new_st = self._update_named(p._value, g, st, lr,
+                                               names[id(p)])
             p._value = new_p
             self._accumulators[id(p)] = new_st
         self._global_step += 1
@@ -166,17 +173,19 @@ class Optimizer:
         for p, st in zip(params, state):
             self._accumulators[id(p)] = st
 
-    def apply_functional(self, param_values, grad_values, state, lr):
+    def apply_functional(self, param_values, grad_values, state, lr,
+                         param_names=None):
         """Pure: returns (new_param_values, new_state).  lr is a scalar
         (python float or traced array)."""
         new_params, new_state = [], []
-        for p, g, st in zip(param_values, grad_values, state):
+        names = param_names or [None] * len(param_values)
+        for p, g, st, nm in zip(param_values, grad_values, state, names):
             if g is None:
                 new_params.append(p)
                 new_state.append(st)
                 continue
             g = self._apply_decay(p, g.astype(p.dtype))
-            np_, nst = self._update(p, g, st, lr)
+            np_, nst = self._update_named(p, g, st, lr, nm)
             new_params.append(np_)
             new_state.append(nst)
         return new_params, new_state
@@ -304,7 +313,6 @@ class AdamW(Adam):
             else weight_decay.coeff
         self._apply_decay_fn = apply_decay_param_fun
         self._lr_ratio = lr_ratio
-        self._param_names = {}
 
     def _decoupled_decay(self, param, lr, p_name):
         if self._apply_decay_fn is not None and \
@@ -312,42 +320,9 @@ class AdamW(Adam):
             return param
         return param * (1.0 - lr * self._wd)
 
-    @no_grad()
-    def step(self):
-        params = self._parameter_list
-        lr = self.get_lr()
-        pairs = [(p, p._grad) for p in params
-                 if not p.stop_gradient and p._grad is not None]
-        if self._grad_clip is not None:
-            clipped = self._grad_clip(pairs)
-            pairs = [(p, g._value if isinstance(g, Tensor) else g)
-                     for p, g in clipped]
-        names = {id(p): (p.name or f"param_{i}")
-                 for i, p in enumerate(params)}
-        for p, g in pairs:
-            if g is None:
-                continue
-            pv = self._decoupled_decay(p._value, lr, names[id(p)])
-            st = self._state_of(p)
-            new_p, new_st = self._update(pv, g.astype(pv.dtype), st, lr)
-            p._value = new_p
-            self._accumulators[id(p)] = new_st
-        self._global_step += 1
-
-    def apply_functional(self, param_values, grad_values, state, lr,
-                         param_names=None):
-        new_params, new_state = [], []
-        names = param_names or [None] * len(param_values)
-        for p, g, st, nm in zip(param_values, grad_values, state, names):
-            if g is None:
-                new_params.append(p)
-                new_state.append(st)
-                continue
-            pv = self._decoupled_decay(p, lr, nm)
-            np_, nst = self._update(pv, g.astype(pv.dtype), st, lr)
-            new_params.append(np_)
-            new_state.append(nst)
-        return new_params, new_state
+    def _update_named(self, param, grad, state, lr, name):
+        pv = self._decoupled_decay(param, lr, name)
+        return self._update(pv, grad.astype(pv.dtype), state, lr)
 
 
 class Adamax(Optimizer):
@@ -507,9 +482,14 @@ class LarsMomentum(Optimizer):
     def _excluded(self, param_name):
         return any(s in (param_name or "") for s in self._exclude)
 
-    def _update(self, param, grad, state, lr):
+    def _update_one(self, param, grad, state, lr, excluded):
         p32 = param.astype(jnp.float32)
         g32 = grad.astype(jnp.float32)
+        if excluded:
+            # reference: excluded params (bias/bn) get plain momentum —
+            # no weight decay, no layer-adaptive lr scaling
+            v = self._momentum * state["velocity"] + lr * g32
+            return (p32 - v).astype(param.dtype), {"velocity": v}
         w_norm = jnp.linalg.norm(p32)
         g_norm = jnp.linalg.norm(g32)
         local_lr = lr * self._lars_coeff * w_norm / (
@@ -519,6 +499,13 @@ class LarsMomentum(Optimizer):
             + local_lr * (g32 + self._lars_wd * p32)
         new_p = p32 - v
         return new_p.astype(param.dtype), {"velocity": v}
+
+    def _update(self, param, grad, state, lr):
+        return self._update_one(param, grad, state, lr, False)
+
+    def _update_named(self, param, grad, state, lr, name):
+        return self._update_one(param, grad, state, lr,
+                                self._excluded(name))
 
 
 class DGCMomentum(Optimizer):
@@ -534,9 +521,12 @@ class DGCMomentum(Optimizer):
     what DGC contributes here is the optimizer-side semantics (identical
     update math to the reference), exercised before ``rampup_begin_step``
     as plain momentum.  The top-k is a static-shape ``lax.top_k``
-    threshold pick, MXU/VPU-friendly.
+    threshold pick, MXU/VPU-friendly.  The rampup phase flag is a traced
+    per-param step counter carried in the accumulator state, so a
+    compiled stepper crosses ``rampup_begin_step`` correctly instead of
+    freezing the phase at trace time.
     """
-    _state_names = ["u", "v"]
+    _state_names = ["u", "v", "step"]
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  sparsity=0.999, rampup_begin_step=0, weight_decay=None,
@@ -547,28 +537,49 @@ class DGCMomentum(Optimizer):
         self._sparsity = float(sparsity)
         self._rampup_begin = int(rampup_begin_step)
 
+    def _init_state_for(self, p_value):
+        return {"u": jnp.zeros_like(p_value),
+                "v": jnp.zeros_like(p_value),
+                "step": jnp.zeros((), jnp.int32)}
+
     def _update(self, param, grad, state, lr):
         from jax import lax
         m = self._momentum
         u = m * state["u"] + grad
-        if self._global_step < self._rampup_begin:
+        step = state["step"]
+
+        def _momentum_phase(_):
             # plain momentum before the rampup (reference: dgc regular
-            # momentum phase); note: in a compiled stepper this phase
-            # flag is frozen at trace time
-            return param - lr * u, {"u": u, "v": state["v"]}
-        v = state["v"] + u
-        flat = v.reshape(-1).astype(jnp.float32)
-        n = flat.shape[0]
-        k = max(1, int(round(n * (1.0 - self._sparsity))))
-        if k >= n:
-            send = v
-            v_new = jnp.zeros_like(v)
-            u_new = jnp.zeros_like(u)
+            # momentum phase)
+            return param - lr * u.astype(param.dtype), u, state["v"]
+
+        def _dgc_phase(_):
+            v = state["v"] + u
+            flat = v.reshape(-1).astype(jnp.float32)
+            n = flat.shape[0]
+            k = max(1, int(round(n * (1.0 - self._sparsity))))
+            if k >= n:
+                send, v_new, u_new = v, jnp.zeros_like(v), jnp.zeros_like(u)
+            else:
+                thr = lax.top_k(jnp.abs(flat), k)[0][-1]
+                mask = (jnp.abs(flat) >= thr).reshape(v.shape)
+                send = jnp.where(mask, v, 0.0)
+                v_new = jnp.where(mask, 0.0, v)
+                u_new = jnp.where(mask, 0.0, u)
+            return param - lr * send.astype(param.dtype), u_new, v_new
+
+        if self._rampup_begin <= 0:
+            new_p, u_new, v_new = _dgc_phase(None)
         else:
-            thr = lax.top_k(jnp.abs(flat), k)[0][-1]
-            mask = (jnp.abs(flat) >= thr).reshape(v.shape)
-            send = jnp.where(mask, v, 0.0)
-            v_new = jnp.where(mask, 0.0, v)
-            u_new = jnp.where(mask, 0.0, u)
-        new_p = param - lr * send.astype(param.dtype)
-        return new_p, {"u": u_new, "v": v_new}
+            new_p, u_new, v_new = lax.cond(
+                step < self._rampup_begin, _momentum_phase, _dgc_phase, None)
+        return new_p, {"u": u_new, "v": v_new, "step": step + 1}
+
+    def set_state_dict(self, state_dict):
+        super().set_state_dict(state_dict)
+        # pre-r3 checkpoints carried no per-param 'step'; seed it from
+        # the restored global step so resume keeps the rampup phase
+        if self._global_step:
+            for st in self._accumulators.values():
+                if "step" in st and int(st["step"]) == 0:
+                    st["step"] = jnp.asarray(self._global_step, jnp.int32)

@@ -53,8 +53,12 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
 
 
 # -- legacy layer builders ---------------------------------------------------
-_LAYER_CACHE = {}
-_AUTO_NAMES = {}
+# Keyed by a weakref to the Program so cache entries (and auto-name
+# counters) die with it — id() reuse after GC must not leak another
+# program's layers into a new one.
+import weakref
+_LAYER_CACHE = weakref.WeakKeyDictionary()   # prog -> {(kind, name): layer}
+_AUTO_NAMES = weakref.WeakKeyDictionary()    # prog -> {kind: counter}
 
 
 def _layer_for(kind, name, factory):
@@ -64,15 +68,15 @@ def _layer_for(kind, name, factory):
     from . import default_main_program
     prog = default_main_program()
     if name is None:
-        counter_key = (id(prog), kind)
-        n = _AUTO_NAMES.get(counter_key, 0)
-        _AUTO_NAMES[counter_key] = n + 1
+        counters = _AUTO_NAMES.setdefault(prog, {})
+        n = counters.get(kind, 0)
+        counters[kind] = n + 1
         name = f"{kind}_{n}"
-    key = (id(prog), kind, name)
-    layer = _LAYER_CACHE.get(key)
+    cache = _LAYER_CACHE.setdefault(prog, {})
+    layer = cache.get((kind, name))
     if layer is None:
         layer = factory()
-        _LAYER_CACHE[key] = layer
+        cache[(kind, name)] = layer
     return layer
 
 

@@ -1,6 +1,6 @@
-full_version = "0.1.0"
+full_version = "0.3.0"
 major = "0"
-minor = "1"
+minor = "3"
 patch = "0"
 rc = "0"
 cuda_version = "False"

@@ -185,11 +185,11 @@ class TestConvPool(OpTest):
         np.testing.assert_allclose(out.numpy(), ref)
         ref_avg = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
         out = F.avg_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2)
-        np.testing.assert_allclose(out.numpy(), ref_avg, rtol=1e-6)
+        np.testing.assert_allclose(out.numpy(), ref_avg, rtol=5e-6)
         out = F.adaptive_avg_pool2d(paddle.to_tensor(x), output_size=1)
         np.testing.assert_allclose(out.numpy(),
                                    x.mean(axis=(2, 3), keepdims=True),
-                                   rtol=1e-6)
+                                   rtol=5e-6)
 
     def test_embedding_linear(self):
         table = rng.randn(10, 4).astype("f4")
