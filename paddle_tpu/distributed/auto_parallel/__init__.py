@@ -37,9 +37,11 @@ class Strategy:
         self.sharding = self._Section(enable=False, stage=1, degree=1)
         self.recompute = self._Section(enable=False)
         self.pipeline = self._Section(enable=False, schedule_mode="1F1B",
-                                      accumulate_steps=1)
+                                      accumulate_steps=1,
+                                      micro_batch_size=None)
         self.mp_degree = 1
         self.dp_degree = 1
+        self.pp_degree = 1
         if config:
             for k, v in config.items():
                 setattr(self, k, v)
@@ -61,25 +63,55 @@ class Engine:
         self._model = None
 
     # -- plan ----------------------------------------------------------------
+    def _degrees(self):
+        """Resolve (dp, sharding, mp, pp) from the Strategy + world size.
+        Explicit degrees win; dp absorbs the remainder."""
+        s = self._strategy
+        n = jax.device_count()
+        mp = int(getattr(s, "mp_degree", 1) or 1)
+        pp = int(getattr(s, "pp_degree", 1) or 1) \
+            if s.pipeline.get("enable") else 1
+        sh = 1
+        if s.sharding.get("enable"):
+            sh = int(s.sharding.get("degree", 1) or 1)
+            if sh <= 1:
+                # degree unset: shard across everything left after mp/pp
+                sh = max(n // (mp * pp), 1)
+        dp_explicit = int(getattr(s, "dp_degree", 0) or 0)
+        # the default dp_degree=1 means "infer": dp absorbs the devices
+        # left over after mp/pp/sharding; an explicit >1 value wins
+        dp = dp_explicit if dp_explicit > 1 \
+            else max(n // (mp * pp * sh), 1)
+        return dp, sh, mp, pp
+
     def _build_plan(self):
+        """dpxsharding x mp mesh honoring Strategy.sharding.degree (the pp
+        axis is handled by the fleet _PipelineStepper route, not here)."""
         s = self._strategy
         level = None
         if s.sharding.get("enable"):
             level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(
                 s.sharding.get("stage", 1), "os")
-        mp = getattr(s, "mp_degree", 1) or 1
-        if mp > 1:
+        dp, sh, mp, _ = self._degrees()
+        if sh > 1 or mp > 1:
             import numpy as np
             from jax.sharding import Mesh
-            n = jax.device_count()
-            dp = max(n // mp, 1)
-            mesh = Mesh(np.asarray(jax.devices()[:dp * mp]).reshape(dp, mp),
-                        ("data", "model"))
+            mesh = Mesh(
+                np.asarray(jax.devices()[:dp * sh * mp]).reshape(dp, sh, mp),
+                ("data", "sharding", "model"))
             return PlacementPlan(mesh, level=level)
         return make_data_parallel_plan(level=level)
 
+    def _is_pipeline(self):
+        from ..fleet.meta_parallel import PipelineLayer
+        return bool(self._strategy.pipeline.get("enable")) and \
+            isinstance(self._network, PipelineLayer)
+
     def _ensure_model(self):
         if self._model is not None:
+            return self._model
+        if self._is_pipeline():
+            self._model = self._build_pipeline_model()
             return self._model
         from ...hapi.model import Model
         net = self._network
@@ -94,15 +126,66 @@ class Engine:
         self._model = m
         return m
 
+    def _build_pipeline_model(self):
+        """Route Strategy.pipeline through the fleet SPMD pipeline
+        stepper (reference: auto_parallel/static/engine.py drives pp
+        through the same parallelizer the fleet API uses)."""
+        from .. import fleet
+        s = self._strategy
+        dp, sh, mp, pp = self._degrees()
+        fs = fleet.DistributedStrategy()
+        fs.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                             "pp_degree": pp, "sharding_degree": sh}
+        pcfg = {"accumulate_steps":
+                int(s.pipeline.get("accumulate_steps", 1) or 1)}
+        if s.pipeline.get("micro_batch_size"):
+            pcfg["micro_batch_size"] = int(s.pipeline["micro_batch_size"])
+        fs.pipeline_configs = pcfg
+        if s.sharding.get("enable"):
+            fs.sharding = True
+            fs.sharding_configs = {"stage": s.sharding.get("stage", 1)}
+        fleet.init(is_collective=True, strategy=fs)
+        return fleet.distributed_model(self._network)
+
     @property
     def main_program(self):
         return None  # jaxpr/HLO is the program; kept for API parity
 
     # -- user surface --------------------------------------------------------
+    def _batches(self, data, batch_size, collate_fn, shuffle,
+                 drop_last=False):
+        from ...io import DataLoader, Dataset
+        if isinstance(data, (list, tuple)):
+            return data    # pre-made batches
+        if isinstance(data, Dataset) or (hasattr(data, "__getitem__")
+                                         and hasattr(data, "__len__")):
+            # drop_last only on the train path (micro-batch divisibility);
+            # evaluate/predict must see every sample
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              collate_fn=collate_fn, drop_last=drop_last)
+        return data    # already an iterable of batches
+
     def fit(self, train_data, valid_data=None, train_sample_split=None,
             batch_size=1, epochs=1, steps_per_epoch=None, log_freq=10,
             save_dir=None, save_freq=1, valid_freq=1, valid_steps=None,
             collate_fn=None, callbacks=None, verbose=2, nvprof_range=None):
+        if self._is_pipeline():
+            m = self._ensure_model()
+            hist = {"loss": []}
+            for ep in range(epochs):
+                for it, batch in enumerate(
+                        self._batches(train_data, batch_size, collate_fn,
+                                      shuffle=True, drop_last=True)):
+                    if steps_per_epoch and it >= steps_per_epoch:
+                        break
+                    data = [b.numpy() if hasattr(b, "numpy") else b
+                            for b in batch]
+                    loss = m.train_batch(data, self._optimizer)
+                    hist["loss"].append(float(loss))
+                    if verbose and log_freq and it % log_freq == 0:
+                        print(f"[Engine/pp] epoch {ep} step {it} "
+                              f"loss {float(loss):.4f}")
+            return hist
         m = self._ensure_model()
         return m.fit(train_data, eval_data=valid_data,
                      batch_size=batch_size, epochs=epochs,
@@ -113,6 +196,18 @@ class Engine:
     def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
                  steps=None, log_freq=10, collate_fn=None, callbacks=None,
                  verbose=2):
+        if self._is_pipeline():
+            m = self._ensure_model()
+            losses = []
+            for it, batch in enumerate(
+                    self._batches(valid_data, batch_size, collate_fn,
+                                  shuffle=False)):
+                if steps and it >= steps:
+                    break
+                data = [b.numpy() if hasattr(b, "numpy") else b
+                        for b in batch]
+                losses.append(float(m.eval_batch(data)))
+            return {"loss": sum(losses) / max(len(losses), 1)}
         m = self._ensure_model()
         return m.evaluate(valid_data, batch_size=batch_size,
                           log_freq=log_freq, verbose=verbose,
@@ -120,13 +215,36 @@ class Engine:
 
     def predict(self, test_data, test_sample_split=None, batch_size=1,
                 steps=None, collate_fn=None, callbacks=None, verbose=2):
+        if self._is_pipeline():
+            m = self._ensure_model()
+            outs = []
+            for it, batch in enumerate(
+                    self._batches(test_data, batch_size, collate_fn,
+                                  shuffle=False)):
+                if steps and it >= steps:
+                    break
+                data = [b.numpy() if hasattr(b, "numpy") else b
+                        for b in batch]
+                outs.append(m.eval_batch(data, compute_loss=False))
+            return outs
         m = self._ensure_model()
         return m.predict(test_data, batch_size=batch_size, verbose=verbose,
                          callbacks=callbacks)
 
     def save(self, path, training=True):
+        if self._is_pipeline():
+            from ... import save as _save
+            # the wrapper's state_dict syncs the trained stacked values
+            # back into the block params; fall back to the raw layer if
+            # fit was never called
+            src = self._model if self._model is not None else self._network
+            return _save(src.state_dict(), path + ".pdparams")
         return self._ensure_model().save(path, training=training)
 
     def load(self, path, strict=True, load_optimizer=True):
+        if self._is_pipeline():
+            from ... import load as _load
+            self._network.set_state_dict(_load(path + ".pdparams"))
+            return
         return self._ensure_model().load(
             path, reset_optimizer=not load_optimizer)

@@ -362,9 +362,12 @@ class PipelineParallel(Layer):
 
     def eval_batch(self, data, compute_loss=True):
         self._sync()
-        x, y = data
+        # predict-style batches carry no labels
+        x, y = data if len(data) == 2 else (data[0], None)
         out = self._layers(x if isinstance(x, Tensor) else Tensor(x))
         if not compute_loss:
             return out
+        if y is None:
+            raise ValueError("eval_batch(compute_loss=True) needs [x, y]")
         loss_f = getattr(self._layers, "_loss_fn", None)
         return loss_f(out, y if isinstance(y, Tensor) else Tensor(y))

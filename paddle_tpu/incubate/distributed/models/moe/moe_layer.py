@@ -141,8 +141,12 @@ class MoELayer(nn.Layer):
                     f"gate {cls.__name__} overrides route() without a "
                     "matching route_sparse(); use dispatch_mode='dense'")
             return True
-        # auto: dense einsum only wins at tiny expert counts
-        return supported and self.num_expert > 4
+        # auto: sparse wins at every measured expert count (v5e r3,
+        # T=8192 M=512 H=2048 top2 — dense/sparse ms: E=2: 11.8/6.6,
+        # E=4: 11.4/10.3, E=8: 8.5/6.9, E=16: 8.7/6.9); the dense
+        # einsum's O(T*E*C*M) dispatch never beats the O(T*K*M)
+        # scatter, so auto = sparse whenever the gate supports it
+        return supported
 
     def _expert_ffn(self, ein, w1, b1, w2, b2):
         """(E, C, M) dispatched tokens -> (E, C, M) expert outputs."""

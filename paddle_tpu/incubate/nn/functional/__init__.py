@@ -84,8 +84,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False, name=None):
-    raise NotImplementedError(
-        "varlen flash attention lands with the Pallas kernel suite (M3)")
+    """Varlen flash attention (reference: paddle.incubate varlen entry);
+    delegates to the segment-id-masked Pallas kernel."""
+    from ...nn.functional.attention import flash_attn_unpadded as _fa
+    return _fa(query, key, value, cu_seqlens_q, cu_seqlens_k,
+               max_seqlen_q, max_seqlen_k, scale=scale, dropout=dropout,
+               causal=causal, return_softmax=return_softmax)
 
 
 def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kw):

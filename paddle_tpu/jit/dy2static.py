@@ -17,6 +17,11 @@ plain tracing semantics):
   whose branches both end in ``return``.
 - ``while`` whose body assigns its loop-carried variables (no
   ``break``/``continue``/``return`` inside — XLA has no early exit).
+- ``for`` with a single-name target: ``range(tensor_n)`` lowers to
+  ``lax.fori_loop``, iterating a traced Tensor lowers to ``lax.scan``
+  over its leading axis, anything else keeps plain Python iteration;
+  ``break``/``continue`` inside a tensor-bounded ``for`` raises a clear
+  error (the loop var is not visible after a converted loop).
 - ``and``/``or``/``not`` (short-circuit preserved when operands are
   concrete; ``logical_and/or/not`` when traced).
 
@@ -144,6 +149,105 @@ def convert_while_loop(cond_fn, body_fn, init):
     return tuple(out)
 
 
+class _TracedRange:
+    """range() whose bounds are traced tensors — consumed by
+    ``convert_for`` (lowered to lax.fori_loop)."""
+
+    def __init__(self, *args):
+        vals = [jnp.asarray(_val(a)) for a in args]
+        if len(vals) == 1:
+            self.lower, self.upper, self.step = 0, vals[0], 1
+        elif len(vals) == 2:
+            self.lower, self.upper, self.step = vals[0], vals[1], 1
+        else:
+            self.lower, self.upper, self.step = vals
+
+    def __iter__(self):
+        raise NotImplementedError(
+            "dy2static: a tensor-bounded range() can only drive a "
+            "converted for loop (no break/continue/return inside)")
+
+
+def convert_range(*args):
+    """range over possibly-traced bounds."""
+    if any(_is_traced(a) for a in args):
+        return _TracedRange(*args)
+    return range(*(int(_val(a)) for a in args))
+
+
+def convert_range_guard(*args):
+    """range at a non-convertible ``for`` site (break/continue/return in
+    the body): concrete bounds keep Python semantics; traced bounds get
+    a clear error instead of a silent mistrace."""
+    if any(_is_traced(a) for a in args):
+        raise NotImplementedError(
+            "dy2static: break/continue/return inside a tensor-bounded "
+            "for loop is not supported (XLA control flow has no early "
+            "exit); hoist the exit into a mask or a while_loop condition")
+    return range(*(int(_val(a)) for a in args))
+
+
+def convert_for(iterable, body_fn, init):
+    """for over a possibly-traced iterable.
+
+    ``body_fn(loop_var, *carried) -> tuple(carried)``.  Dispatch:
+    - ``_TracedRange`` -> ``lax.fori_loop`` (forward-only under AD —
+      while_loop semantics; use a concrete bound for trainable loops)
+    - traced Tensor -> ``lax.scan`` over the leading axis (reverse-mode
+      differentiable)
+    - anything else -> plain Python iteration (exact semantics)
+
+    The loop variable is NOT visible after the loop (unlike Python);
+    carried entries may be ``_UNDEF`` like convert_while_loop.
+    """
+    init = tuple(init)
+    traced_tensor = isinstance(iterable, Tensor) and _is_traced(iterable)
+    if not isinstance(iterable, _TracedRange) and not traced_tensor:
+        out = init
+        for item in iterable:
+            out = tuple(body_fn(item, *out))
+        return out
+
+    live = [i for i, v in enumerate(init) if v is not _UNDEF]
+    if not live:
+        raise ValueError(
+            "dy2static for: no loop-carried variable is bound before the "
+            "loop; initialize the state first (XLA loops need concrete "
+            "initial shapes)")
+    wrap_t = [isinstance(init[i], Tensor) for i in live]
+
+    def full(carry):
+        args = list(init)
+        for j, i in enumerate(live):
+            args[i] = Tensor(carry[j]) if wrap_t[j] else carry[j]
+        return args
+
+    carry0 = tuple(jnp.asarray(_val(init[i])) for i in live)
+
+    if isinstance(iterable, _TracedRange):
+        lower, upper, step = iterable.lower, iterable.upper, iterable.step
+        n_iters = jnp.maximum(
+            (upper - lower + step - jnp.sign(step)) // step, 0)
+
+        def b(k, carry):
+            i = lower + k * step
+            out = tuple(body_fn(Tensor(i), *full(carry)))
+            return tuple(jnp.asarray(_val(out[j])) for j in live)
+
+        final = lax.fori_loop(0, n_iters, b, carry0)
+    else:
+        def f(carry, x):
+            out = tuple(body_fn(Tensor(x), *full(carry)))
+            return tuple(jnp.asarray(_val(out[j])) for j in live), None
+
+        final, _ = lax.scan(f, carry0, _val(iterable))
+
+    out = list(init)
+    for j, i in enumerate(live):
+        out[i] = Tensor(final[j]) if wrap_t[j] else final[j]
+    return tuple(out)
+
+
 def convert_logical_and(a_fn, b_fn):
     a = a_fn()
     if _is_traced(a):
@@ -167,6 +271,9 @@ def convert_logical_not(a):
 _RUNTIME = {
     "__pt_ifelse__": convert_ifelse,
     "__pt_while__": convert_while_loop,
+    "__pt_for__": convert_for,
+    "__pt_range__": convert_range,
+    "__pt_range_guard__": convert_range_guard,
     "__pt_and__": convert_logical_and,
     "__pt_or__": convert_logical_or,
     "__pt_not__": convert_logical_not,
@@ -218,7 +325,7 @@ def _assigned_names(stmts):
         elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                             ast.ClassDef)):
             names.add(n.name)
-        elif isinstance(n, ast.Delete):
+        elif isinstance(n, (ast.Delete, ast.Global, ast.Nonlocal)):
             ok[0] = False
     return names, ok[0]
 
@@ -350,6 +457,51 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
             return [tfn, ffn, ast.Return(value=call)]
 
         return node  # early-return / side-effect shapes: keep Python
+
+    # -- for -----------------------------------------------------------------
+    @staticmethod
+    def _is_range_call(e):
+        return (isinstance(e, ast.Call) and isinstance(e.func, ast.Name)
+                and e.func.id == "range" and not e.keywords)
+
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        is_range = self._is_range_call(node.iter)
+
+        def guarded():
+            # non-convertible shape: keep Python, but a range() iter gets
+            # the runtime guard so traced bounds error clearly
+            if is_range:
+                node.iter = ast.Call(func=_name("__pt_range_guard__"),
+                                     args=node.iter.args, keywords=[])
+                self.changed = True
+            return node
+
+        if node.orelse or not isinstance(node.target, ast.Name) \
+                or _loop_level_break(node.body) or _count_returns(node.body):
+            return guarded()
+        names, ok = _assigned_names(node.body)
+        names.discard(node.target.id)   # loop var is a body param
+        if not names or not ok:
+            return guarded()
+        n = self._uid()
+        out = sorted(names)
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(v) for v in out], ctx=ast.Load()))
+        bfn = _fn_def(f"_pt_fbody_{n}", [node.target.id] + out,
+                      node.body + [ret])
+        it = ast.Call(func=_name("__pt_range__"), args=node.iter.args,
+                      keywords=[]) if is_range else node.iter
+        call = ast.Call(
+            func=_name("__pt_for__"),
+            args=[it, _name(f"_pt_fbody_{n}"), _ld_tuple(out)],
+            keywords=[])
+        unpack = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(v, ast.Store()) for v in out],
+                               ctx=ast.Store())],
+            value=call)
+        self.changed = True
+        return [bfn, unpack]
 
     # -- while ---------------------------------------------------------------
     def visit_While(self, node):

@@ -120,10 +120,32 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return (out, None) if return_softmax else (out, None)
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError(
-        "varlen flash attention lands with the ragged kernel; pad to the "
-        "block size and use flash_attention")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """paddle.nn.functional.flash_attention.flash_attn_unpadded parity:
+    packed (total, H, D) q/k/v with (B+1,) cu_seqlens prefix sums.
+    TPU-native: segment-id-masked Pallas flash kernel (see
+    ops/pallas/flash_attention_varlen.py)."""
+    from ...ops.pallas.flash_attention_varlen import (
+        flash_attn_unpadded as _raw)
+    q, k, v = [t if isinstance(t, Tensor) else Tensor(t)
+               for t in (query, key, value)]
+    cu_q = cu_seqlens_q._value if isinstance(cu_seqlens_q, Tensor) \
+        else jnp.asarray(cu_seqlens_q, jnp.int32)
+    cu_k = cu_seqlens_k._value if isinstance(cu_seqlens_k, Tensor) \
+        else jnp.asarray(cu_seqlens_k, jnp.int32)
+    drop = dropout if training else 0.0
+    from ...framework.random import next_key
+    dkey = next_key() if drop and drop > 0.0 else None
+    out = call_op(
+        lambda a, b, c: _raw(a, b, c, cu_q, cu_k, max_seqlen_q,
+                             max_seqlen_k, scale=scale, dropout=drop,
+                             causal=bool(causal), dropout_key=dkey)[0],
+        q, k, v)
+    return out, None
 
 
 class sdp_kernel:

@@ -1,0 +1,346 @@
+"""Varlen ("unpadded") flash attention Pallas kernels (TPU).
+
+Reference analogue: paddle.nn.functional.flash_attention.flash_attn_unpadded
+(cutlass flash_attn varlen_fwd/varlen_bwd kernels; SURVEY §5.7).  The
+reference packs B variable-length sequences into one (total, H, D) tensor
+with ``cu_seqlens`` prefix sums and launches per-sequence tiles.
+
+TPU-native design: packed tokens stay one contiguous (H, total, D) array
+and sequence isolation is a SEGMENT-ID mask inside the standard online-
+softmax flash kernel — each token carries its sequence index (computed
+from cu_seqlens with searchsorted), and a (q, k) pair contributes only
+when segments match (AND the causal predicate, which — because segments
+are contiguous runs — is just the global position compare).  This is the
+shard_map-friendly TPU formulation (same trick as jax splash-attention's
+segment ids): no ragged shapes, no per-sequence kernel launches, MXU-
+sized blocks straddling sequence boundaries are handled by masking.
+
+VMEM envelope: the backward keeps k/v (+ fp32 dk/dv scratch) resident
+per head, so total*head_dim is capped (~8192*64); past it the caller
+gets a clear error suggesting chunking the pack.  The total is padded to
+the q block size with segment id -1 (never matches a real segment).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _LANES, _bwd_prep
+
+_VARLEN_MAX_TD = 8192 * 64
+_BLOCK = 512
+
+
+def _varlen_fwd_kernel(segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref,
+                       lse_ref, *, scale, causal, block_k, total):
+    """grid = (H, total // block_q); segq/segk: (8, total) int32 (row 0
+    is the data; 8 rows for int32 tile alignment).  Separate q/k segment
+    arrays support cross-attention packs where cu_seqlens_q and
+    cu_seqlens_k slice the same total differently."""
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q_lo = pl.program_id(1) * block_q
+    q = q_ref[:] * scale
+    seg_q = segq_ref[0, pl.ds(q_lo, block_q)][:, None]       # (bq, 1)
+    q_idx = q_lo + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    nk = total // block_k
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k_lo = i * block_k
+        k = k_ref[pl.ds(k_lo, block_k), :]
+        v = v_ref[pl.ds(k_lo, block_k), :]
+        seg_k = segk_ref[0, pl.ds(k_lo, block_k)][None, :]    # (1, bk)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        live = seg_q == seg_k
+        if causal:
+            k_idx = k_lo + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            live = live & (q_idx >= k_idx)
+        s = jnp.where(live, s, -1e30)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        # segments are contiguous: keys past this q block's last row are
+        # either future positions (causal-masked) or later segments
+        last = (q_lo + block_q + block_k - 1) // block_k
+        nkb = jnp.minimum(last, nk)
+        acc, m, l = jax.lax.fori_loop(0, nkb, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
+
+
+def _varlen_bwd_kernel(segq_ref, segk_ref, q_ref, k_ref, v_ref, do_ref,
+                       lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, dk_acc,
+                       dv_acc, *, scale, causal, block_k, total):
+    """One-pass backward, sequential q-block grid axis with persistent
+    dk/dv scratch (same scheme as _flash_bwd_fused_kernel) + seg mask."""
+    qi = pl.program_id(1)
+    nq = pl.num_programs(1)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    nk = total // block_k
+    q_lo = qi * block_q
+
+    @pl.when(qi == 0)
+    def _zero():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[:] * scale
+    do = do_ref[:]
+    lse = lse_ref[:][:, :1]
+    delta = delta_ref[:][:, :1]
+    seg_q = segq_ref[0, pl.ds(q_lo, block_q)][:, None]
+    q_idx = q_lo + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(i, dq):
+        k_lo = i * block_k
+        k = k_ref[pl.ds(k_lo, block_k), :]
+        v = v_ref[pl.ds(k_lo, block_k), :]
+        seg_k = segk_ref[0, pl.ds(k_lo, block_k)][None, :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        live = seg_q == seg_k
+        if causal:
+            k_idx = k_lo + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            live = live & (q_idx >= k_idx)
+        s = jnp.where(live, s, -1e30)
+        p = jnp.exp(s - lse)
+        pb = p.astype(do.dtype)
+        dv_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
+            pb.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[pl.ds(k_lo, block_k), :] += jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        nkb = jnp.minimum((q_lo + block_q + block_k - 1) // block_k, nk)
+        dq = jax.lax.fori_loop(0, nkb, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, nk, body, dq0)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _seg2d(seg):
+    """(T,) int32 -> (8, T) for int32 tile alignment."""
+    return jnp.broadcast_to(seg[None, :], (8, seg.shape[0]))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "interpret"))
+def _varlen_fwd(q, k, v, seg_q, seg_k, causal, block_q=_BLOCK,
+                block_k=_BLOCK, interpret=False):
+    """q/k/v: (H, T, D) packed+padded; seg_q/seg_k: (T,) int32, -1 =
+    padding."""
+    H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    scale = 1.0 / math.sqrt(D)
+    spec_q = pl.BlockSpec((None, block_q, D), lambda h, i: (h, i, 0))
+    spec_full = pl.BlockSpec((None, T, D), lambda h, i: (h, 0, 0))
+    spec_seg = pl.BlockSpec((8, T), lambda h, i: (0, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_varlen_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, total=T),
+        grid=(H, T // block_q),
+        in_specs=[
+            spec_seg, spec_seg,
+            spec_q, spec_full, spec_full,
+        ],
+        out_specs=[
+            spec_q,
+            pl.BlockSpec((None, block_q, _LANES), lambda h, i: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((H, T, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_seg2d(seg_q), _seg2d(seg_k), q, k, v)
+    return out, lse[..., 0]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "interpret"))
+def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, causal, block_q=_BLOCK,
+                block_k=_BLOCK, interpret=False):
+    H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    scale = 1.0 / math.sqrt(D)
+    lse_l, delta_l = _bwd_prep(o, do, lse)
+    spec_q = pl.BlockSpec((None, block_q, D), lambda h, i: (h, i, 0))
+    spec_ql = pl.BlockSpec((None, block_q, _LANES), lambda h, i: (h, i, 0))
+    spec_full = pl.BlockSpec((None, T, D), lambda h, i: (h, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_varlen_bwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, total=T),
+        grid=(H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((8, T), lambda h, i: (0, 0)),
+            pl.BlockSpec((8, T), lambda h, i: (0, 0)),
+            spec_q, spec_full, spec_full, spec_q, spec_ql, spec_ql,
+        ],
+        out_specs=[spec_q, spec_full, spec_full],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((H, T, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((T, D), jnp.float32),
+                        pltpu.VMEM((T, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(_seg2d(seg_q), _seg2d(seg_k), q, k, v, do, lse_l, delta_l)
+
+
+def _segments_from_cu(cu_seqlens, total_pad):
+    """cu_seqlens (B+1,) -> per-token segment ids (total_pad,), -1 pad.
+
+    searchsorted over the prefix sums; tokens at/after cu[-1] get -1."""
+    cu = jnp.asarray(cu_seqlens, jnp.int32)
+    pos = jnp.arange(total_pad, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu[1:], pos, side="right").astype(jnp.int32)
+    return jnp.where(pos < cu[-1], seg, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _varlen_core(q, k, v, seg_q, seg_k, causal, interpret):
+    out, _ = _varlen_fwd(q, k, v, seg_q, seg_k, causal, interpret=interpret)
+    return out
+
+
+def _varlen_core_fwd(q, k, v, seg_q, seg_k, causal, interpret):
+    out, lse = _varlen_fwd(q, k, v, seg_q, seg_k, causal,
+                           interpret=interpret)
+    return out, (q, k, v, out, lse, seg_q, seg_k)
+
+
+def _varlen_core_bwd(causal, interpret, res, g):
+    q, k, v, out, lse, seg_q, seg_k = res
+    dq, dk, dv = _varlen_bwd(q, k, v, out, lse, g, seg_q, seg_k, causal,
+                             interpret=interpret)
+    return dq, dk, dv, None, None
+
+
+_varlen_core.defvjp(_varlen_core_fwd, _varlen_core_bwd)
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, interpret=False,
+                        dropout_key=None):
+    """Packed varlen flash attention on raw arrays.
+
+    q/k/v: (total, H, D) packed across sequences; cu_seqlens_q/k: (B+1,)
+    int32 prefix sums over the SAME total (cross-attention packs may
+    slice it differently; ``causal=True`` additionally requires
+    cu_seqlens_q == cu_seqlens_k, since causality across differently-
+    packed q/k has no well-defined position mapping).  Returns
+    (out (total, H, D), None) — softmax_return is not materialized (the
+    reference only returns it in debug mode).
+
+    ``scale`` other than 1/sqrt(D) and dropout>0 fall back to a dense
+    segment-masked XLA path (same math + real dropout via
+    ``dropout_key``, (T, T) memory).  Raw-array function — the
+    Tensor/tape wiring lives in nn.functional.attention.
+    """
+    q_, k_, v_ = q, k, v
+    total, H, D = q_.shape
+    if k_.shape[0] != total:
+        raise NotImplementedError(
+            "flash_attn_unpadded: q and k packs must share the same "
+            f"total length (got {total} vs {k_.shape[0]}); pad the "
+            "shorter pack")
+    if total * D > _VARLEN_MAX_TD:
+        raise NotImplementedError(
+            f"flash_attn_unpadded: packed total*head_dim {total * D} "
+            f"exceeds the VMEM-resident envelope ({_VARLEN_MAX_TD}); "
+            "chunk the pack into <=8192-token (at D=64) batches")
+    cu_q = jnp.asarray(cu_seqlens_q, jnp.int32)
+    cu_k = jnp.asarray(cu_seqlens_k, jnp.int32)
+    if causal:
+        both_concrete = not isinstance(cu_q, jax.core.Tracer) \
+            and not isinstance(cu_k, jax.core.Tracer)
+        if both_concrete and (cu_q.shape != cu_k.shape
+                              or not bool(jnp.all(cu_q == cu_k))):
+            raise ValueError(
+                "flash_attn_unpadded(causal=True) requires cu_seqlens_q "
+                "== cu_seqlens_k (self-attention packing)")
+    block = min(_BLOCK, total)
+    pad = (-total) % block
+    Tp = total + pad
+    seg_q = _segments_from_cu(cu_q, Tp)
+    seg_k = _segments_from_cu(cu_k, Tp)
+
+    default_scale = scale is None or abs(scale - 1.0 / math.sqrt(D)) < 1e-9
+    use_kernel = (default_scale and dropout == 0.0 and D % 128 in (0, 64)
+                  and (interpret or jax.default_backend() == "tpu"))
+
+    def packed_hTd(x):
+        x = jnp.moveaxis(x, 1, 0)                     # (H, total, D)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    if use_kernel:
+        out = _varlen_core(packed_hTd(q_), packed_hTd(k_), packed_hTd(v_),
+                           seg_q, seg_k, bool(causal), interpret)
+        out = jnp.moveaxis(out[:, :total, :], 0, 1)   # (total, H, D)
+    else:
+        out = _varlen_dense(q_, k_, v_, seg_q[:total], seg_k[:total],
+                            scale, dropout, causal, dropout_key)
+    return out, None
+
+
+def _varlen_dense(q, k, v, seg_q, seg_k, scale, dropout, causal,
+                  dropout_key=None):
+    """Dense segment-masked fallback (exact math, (T, T) memory).
+    dropout>0 needs ``dropout_key``; it is applied to the attention
+    probabilities with inverted-probability rescaling (the reference
+    semantics)."""
+    T, H, D = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    live = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        pos = jnp.arange(T)
+        live = live & (pos[:, None] >= pos[None, :])
+    s = jnp.where(live[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout and dropout > 0.0:
+        if dropout_key is None:
+            raise ValueError(
+                "flash_attn_unpadded: dropout>0 needs a dropout_key "
+                "(the nn.functional wrapper threads the framework RNG)")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+    return jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)

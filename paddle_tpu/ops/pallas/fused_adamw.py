@@ -33,18 +33,19 @@ _ROW = 1024          # flat vector viewed as (R, _ROW); 8x128-tile friendly
 _BLOCK_ROWS = 128    # 128x1024 fp32 = 512KB/buffer; 9 buffers ~ 4.6MB VMEM
 
 
-def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, wd_ref, sc_ref,
-                  np_ref, nm_ref, nv_ref):
-    # sc: [lr, b1, b2, eps, wd, bc1, bc2]  (bc = 1 - beta^t)
+def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, wd_ref, bc1_ref, bc2_ref,
+                  sc_ref, np_ref, nm_ref, nv_ref):
+    # sc: [lr, b1, b2, eps, wd]; bc1/bc2 ride per-ELEMENT rows (params in
+    # one fused call may sit at different step counts, e.g. after a
+    # freeze/unfreeze — a shared scalar correction would be wrong)
     sc = sc_ref[0]
     lr, b1, b2, eps, wd = sc[0], sc[1], sc[2], sc[3], sc[4]
-    bc1, bc2 = sc[5], sc[6]
     p = p_ref[:]
     g = g_ref[:]
     m = b1 * m_ref[:] + (1.0 - b1) * g
     v = b2 * v_ref[:] + (1.0 - b2) * g * g
-    mhat = m / bc1
-    vhat = v / bc2
+    mhat = m / jnp.maximum(bc1_ref[:], 1e-30)
+    vhat = v / jnp.maximum(bc2_ref[:], 1e-30)
     upd = mhat / (jnp.sqrt(vhat) + eps) + wd * wd_ref[:] * p
     np_ref[:] = p - lr * upd
     nm_ref[:] = m
@@ -72,23 +73,39 @@ def _split_back(flat2, sizes, shapes, dtypes):
 
 
 def fused_adamw(params, grads, ms, vs, lr, beta1=0.9, beta2=0.999,
-                eps=1e-8, weight_decay=0.01, step=1, decay_mask=None):
+                eps=1e-8, weight_decay=0.01, step=1, decay_mask=None,
+                bias_correction=None):
     """One fused AdamW step over a list of tensors.
 
     step: 1-based step count (python int or traced scalar) for bias
-    correction.  Returns (new_params, new_ms, new_vs) with the original
+    correction; alternatively pass ``bias_correction=(bc1_list,
+    bc2_list)`` with PER-PARAM 1-beta^t values (scalars broadcast) —
+    params in one call may sit at different step counts (freeze/
+    unfreeze), so the correction rides per-element rows like the decay
+    mask.  Returns (new_params, new_ms, new_vs) with the original
     shapes/dtypes (moments kept fp32)."""
     shapes = [p.shape for p in params]
     dtypes = [p.dtype for p in params]
-    mask = decay_mask if decay_mask is not None else [1.0] * len(params)
+    n_t = len(params)
+    mask = decay_mask if decay_mask is not None else [1.0] * n_t
 
-    t = jnp.asarray(step, jnp.float32)
-    bc1 = 1.0 - jnp.asarray(beta1, jnp.float32) ** t
-    bc2 = 1.0 - jnp.asarray(beta2, jnp.float32) ** t
+    def _per_param(x):
+        if isinstance(x, (list, tuple)):
+            return [jnp.asarray(v, jnp.float32) for v in x]
+        return [jnp.asarray(x, jnp.float32)] * n_t
+
+    if bias_correction is not None:
+        bc1s = _per_param(bias_correction[0])
+        bc2s = _per_param(bias_correction[1])
+    else:
+        t = jnp.asarray(step, jnp.float32)
+        bc1s = _per_param(1.0 - jnp.asarray(beta1, jnp.float32) ** t)
+        bc2s = _per_param(1.0 - jnp.asarray(beta2, jnp.float32) ** t)
 
     if jax.default_backend() != "tpu":
         new_p, new_m, new_v = [], [], []
-        for p, g, m, v, dm in zip(params, grads, ms, vs, mask):
+        for p, g, m, v, dm, bc1, bc2 in zip(params, grads, ms, vs, mask,
+                                            bc1s, bc2s):
             pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
             nm = beta1 * m + (1 - beta1) * gf
             nv = beta2 * v + (1 - beta2) * gf * gf
@@ -103,32 +120,39 @@ def fused_adamw(params, grads, ms, vs, lr, beta1=0.9, beta2=0.999,
     g2, _, _ = _flatten_concat(grads)
     m2, _, _ = _flatten_concat(ms)
     v2, _, _ = _flatten_concat(vs)
+    zpad = [jnp.zeros(pad, jnp.float32)] if pad else []
     wd_vec = jnp.concatenate(
         [jnp.full(n, float(dm), jnp.float32)
-         for n, dm in zip(sizes, mask)] +
-        ([jnp.zeros(pad, jnp.float32)] if pad else []))
+         for n, dm in zip(sizes, mask)] + zpad)
     wd2 = wd_vec.reshape(-1, _ROW)
+    # per-element bias-correction rows (pad with 1s: divide-safe)
+    opad = [jnp.ones(pad, jnp.float32)] if pad else []
+    bc1_2 = jnp.concatenate(
+        [jnp.broadcast_to(b, (n,)) for n, b in zip(sizes, bc1s)] + opad
+    ).reshape(-1, _ROW)
+    bc2_2 = jnp.concatenate(
+        [jnp.broadcast_to(b, (n,)) for n, b in zip(sizes, bc2s)] + opad
+    ).reshape(-1, _ROW)
 
     sc = jnp.stack([jnp.asarray(lr, jnp.float32),
                     jnp.asarray(beta1, jnp.float32),
                     jnp.asarray(beta2, jnp.float32),
                     jnp.asarray(eps, jnp.float32),
-                    jnp.asarray(weight_decay, jnp.float32),
-                    bc1, bc2])[None, :]          # (1, 7)
+                    jnp.asarray(weight_decay, jnp.float32)])[None, :]
 
     R = p2.shape[0]
     block = min(_BLOCK_ROWS, R)  # padding guarantees R % block == 0
     grid = (R // block,)
     bspec = pl.BlockSpec((block, _ROW), lambda i: (i, 0))
-    sspec = pl.BlockSpec((1, 7), lambda i: (0, 0))
+    sspec = pl.BlockSpec((1, 5), lambda i: (0, 0))
     shape = jax.ShapeDtypeStruct((R, _ROW), jnp.float32)
     np2, nm2, nv2 = pl.pallas_call(
         _adamw_kernel,
         grid=grid,
-        in_specs=[bspec, bspec, bspec, bspec, bspec, sspec],
+        in_specs=[bspec, bspec, bspec, bspec, bspec, bspec, bspec, sspec],
         out_specs=[bspec, bspec, bspec],
         out_shape=[shape, shape, shape],
-    )(p2, g2, m2, v2, wd2, sc)
+    )(p2, g2, m2, v2, wd2, bc1_2, bc2_2, sc)
 
     new_p = _split_back(np2, sizes, shapes, dtypes)
     f32 = [jnp.float32] * len(sizes)

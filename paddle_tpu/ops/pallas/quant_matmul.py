@@ -30,7 +30,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["int8_matmul"]
+__all__ = ["int8_matmul", "fp8_matmul", "fp8_quantize_weight"]
 
 _BM, _BK, _BN = 256, 512, 256
 
@@ -104,3 +104,50 @@ def int8_matmul(x, w_int, w_scale, act_scale, bit_length=8,
         interpret=interpret,
     )(xp, wp, wsp.reshape(1, -1), sc)
     return out[:M, :N].reshape(*lead, N)
+
+
+# ---------------------------------------------------------------------------
+# fp8 matmul epilogue (SURVEY §7.1 "int8/fp8 matmul epilogues" row)
+# ---------------------------------------------------------------------------
+
+_F8_MAX = 448.0      # float8_e4m3fn max finite value
+
+
+def fp8_quantize_weight(w):
+    """Per-output-channel fp8 (e4m3) quantization of a (K, N) weight.
+
+    Returns (w_fp8 (K, N), w_scale (N,) fp32) with w ≈ w_fp8 * w_scale.
+    """
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)
+    scale = jnp.maximum(amax / _F8_MAX, 1e-12)
+    return (wf / scale[None, :]).astype(jnp.float8_e4m3fn), scale
+
+
+def fp8_matmul(x, w_fp8, w_scale, act_scale=None, out_dtype=jnp.float32):
+    """fp8(e4m3) matmul with fused quantize/dequant epilogue.
+
+    x: (..., K) float; w_fp8: (K, N) float8_e4m3fn; w_scale: (N,) fp32;
+    act_scale: None (dynamic per-call amax) or a python float / 0-d
+    array.  out = (q(x) @ w_fp8) * act_scale * w_scale.
+
+    v5e reality check (measured r3): the MXU has no native fp8 path —
+    XLA upconverts, so a 4096^3 fp8 dot times ~equal to bf16 (6.3 vs
+    6.7ms).  What fp8 buys on this chip is MEMORY: half the weight HBM
+    footprint/bandwidth of bf16 and a quarter of fp32, which is the
+    deploy win (QuantizedLinear-style serving).  XLA fuses the
+    quantize + dequant epilogue around the dot — no Pallas needed where
+    there is no custom arithmetic to reach.
+    """
+    xf = jnp.asarray(x, jnp.float32)
+    lead, K = xf.shape[:-1], xf.shape[-1]
+    x2 = xf.reshape(-1, K)
+    if act_scale is None:
+        act_scale = jnp.maximum(jnp.max(jnp.abs(x2)) / _F8_MAX, 1e-12)
+    else:
+        act_scale = jnp.asarray(act_scale, jnp.float32)
+    xq = (x2 / act_scale).astype(jnp.float8_e4m3fn)
+    acc = lax.dot_general(xq, w_fp8, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    out = acc * act_scale * w_scale.astype(jnp.float32)[None, :]
+    return out.astype(out_dtype).reshape(*lead, w_fp8.shape[1])

@@ -269,6 +269,7 @@ class Adam(Optimizer):
                          name, multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._amsgrad = amsgrad
+        self._use_multi_tensor = use_multi_tensor
         if amsgrad:
             self._state_names = self._state_names + ["moment2_max"]
 
@@ -304,11 +305,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None,
-                 amsgrad=False):
+                 lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         False, name, amsgrad)
+                         use_multi_tensor, name, amsgrad)
         self._wd = float(weight_decay) if not hasattr(weight_decay, "coeff") \
             else weight_decay.coeff
         self._apply_decay_fn = apply_decay_param_fun
@@ -323,6 +324,47 @@ class AdamW(Adam):
     def _update_named(self, param, grad, state, lr, name):
         pv = self._decoupled_decay(param, lr, name)
         return self._update(pv, grad.astype(pv.dtype), state, lr)
+
+    def apply_functional(self, param_values, grad_values, state, lr,
+                         param_names=None):
+        """``use_multi_tensor=True`` routes the whole list through the
+        fused multi-tensor Pallas kernel.  Measured tradeoff (v5e r3):
+        300 small tensors (64^2..256^2): fused 21.1ms vs per-tensor XLA
+        22.4ms (~6% win); 4x 4096^2 tensors: fused 17.7ms vs 8.5ms (2x
+        LOSS — the concat/split copies outweigh the batching; same
+        reason GPT-125M measured 36.6% vs 42.3% MFU with it in r2).
+        Default stays off; enable only for many-small-param models."""
+        if not (self._use_multi_tensor and not self._amsgrad
+                and jax.default_backend() == "tpu"):
+            return super().apply_functional(param_values, grad_values,
+                                            state, lr, param_names)
+        from ..ops.pallas.fused_adamw import fused_adamw
+        names = param_names or [None] * len(param_values)
+        live = [i for i, g in enumerate(grad_values) if g is not None]
+        if not live:
+            return list(param_values), list(state)
+        ps = [param_values[i] for i in live]
+        gs = [grad_values[i] for i in live]
+        ms = [state[i]["moment1"] for i in live]
+        vs = [state[i]["moment2"] for i in live]
+        mask = [0.0 if (self._apply_decay_fn is not None
+                        and not self._apply_decay_fn(names[i] or ""))
+                else 1.0 for i in live]
+        # per-param bias corrections: params may sit at different step
+        # counts (freeze/unfreeze), exactly like the per-tensor path
+        bc1s = [1.0 - state[i]["beta1_pow"] * self._beta1 for i in live]
+        bc2s = [1.0 - state[i]["beta2_pow"] * self._beta2 for i in live]
+        np_, nm, nv = fused_adamw(
+            ps, gs, ms, vs, lr, self._beta1, self._beta2, self._eps,
+            self._wd, decay_mask=mask, bias_correction=(bc1s, bc2s))
+        new_params, new_state = list(param_values), [dict(s) for s in state]
+        for j, i in enumerate(live):
+            new_params[i] = np_[j]
+            new_state[i].update(
+                moment1=nm[j], moment2=nv[j],
+                beta1_pow=state[i]["beta1_pow"] * self._beta1,
+                beta2_pow=state[i]["beta2_pow"] * self._beta2)
+        return new_params, new_state
 
 
 class Adamax(Optimizer):

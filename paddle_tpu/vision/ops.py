@@ -314,9 +314,23 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return call_op(impl, *ts)
 
 
+def _roi_image_ids(boxes_num, n_rois):
+    """Per-ROI image index from the boxes_num per-image counts
+    (reference: the boxes_num contract of ops.roi_pool/psroi_pool —
+    boxes are concatenated image-major)."""
+    if boxes_num is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    bn = boxes_num._value if hasattr(boxes_num, "_value") \
+        else jnp.asarray(boxes_num)
+    cum = jnp.cumsum(bn.astype(jnp.int32))
+    return jnp.searchsorted(cum, jnp.arange(n_rois, dtype=jnp.int32),
+                            side="right").astype(jnp.int32)
+
+
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
              name=None):
-    """Max ROI pooling (reference: ops.roi_pool).  boxes: [R, 4] xyxy.
+    """Max ROI pooling (reference: ops.roi_pool).  boxes: [R, 4] xyxy,
+    concatenated over the batch with per-image counts in ``boxes_num``.
 
     Implementation note: each output bin reduces a full-map mask, costing
     ph·pw full passes per ROI.  This preserves the reference's
@@ -329,14 +343,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     ph, pw = output_size
 
     def impl(xv, bv):
-        # single-image path (boxes_num per-image batching: image 0)
         N, C, H, W = xv.shape
-        if N != 1:
-            raise NotImplementedError(
-                "roi_pool currently supports a single image per call; "
-                "split the batch and concatenate results")
+        img_ids = _roi_image_ids(boxes_num, bv.shape[0])
 
-        def one_box(box):
+        def one_box(box, img_id):
+            img = jnp.take(xv, img_id, axis=0)        # (C, H, W)
             x1, y1, x2, y2 = [box[i] * spatial_scale for i in range(4)]
             x1, y1 = jnp.round(x1), jnp.round(y1)
             x2, y2 = jnp.round(x2), jnp.round(y2)
@@ -357,12 +368,12 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     lowest = (jnp.finfo(xv.dtype).min
                               if jnp.issubdtype(xv.dtype, jnp.floating)
                               else jnp.iinfo(xv.dtype).min)
-                    cell = jnp.where(m[None], xv[0], lowest)
+                    cell = jnp.where(m[None], img, lowest)
                     val = cell.max(axis=(1, 2))
                     val = jnp.where(m.any(), val, 0.0)
                     out = out.at[:, i, j].set(val)
             return out
-        return jax.vmap(one_box)(bv)
+        return jax.vmap(one_box)(bv, img_ids)
     return call_op(impl, ensure_tensor(x), ensure_tensor(boxes))
 
 
@@ -376,17 +387,15 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     def impl(xv, bv):
         N, C, H, W = xv.shape
-        if N != 1:
-            raise NotImplementedError(
-                "psroi_pool currently supports a single image per call; "
-                "split the batch and concatenate results")
         if C % (ph * pw) != 0 or C < ph * pw:
             raise ValueError(
                 f"psroi_pool needs channels divisible by output h*w "
                 f"({ph}*{pw}); got C={C}")
         out_c = C // (ph * pw)
+        img_ids = _roi_image_ids(boxes_num, bv.shape[0])
 
-        def one_box(box):
+        def one_box(box, img_id):
+            img = jnp.take(xv, img_id, axis=0)        # (C, H, W)
             x1, y1, x2, y2 = [box[i] * spatial_scale for i in range(4)]
             bw = jnp.maximum(x2 - x1, 0.1)
             bh = jnp.maximum(y2 - y1, 0.1)
@@ -405,11 +414,11 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     # channel-major blocks: out channel c reads input
                     # channel c·ph·pw + i·pw + j (R-FCN convention)
                     ch = jnp.arange(out_c) * (ph * pw) + i * pw + j
-                    blk = xv[0, ch]
+                    blk = img[ch]
                     val = (blk * m[None]).sum(axis=(1, 2)) / count
                     out = out.at[:, i, j].set(val)
             return out
-        return jax.vmap(one_box)(bv)
+        return jax.vmap(one_box)(bv, img_ids)
     return call_op(impl, ensure_tensor(x), ensure_tensor(boxes))
 
 

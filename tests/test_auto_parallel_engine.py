@@ -106,3 +106,138 @@ def test_amp_o1_grads_flow_through_casts():
     assert g is not None
     assert g._value.dtype == jnp.float32  # param grads back in fp32
     assert float(jnp.abs(g._value).sum()) > 0
+
+
+# -- Engine pipeline routing (VERDICT r2 #3) ---------------------------------
+
+class _PPBlock(nn.Layer):
+    def __init__(self, h=16):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x):
+        return nn.functional.relu(self.fc(x))
+
+
+class _PairData(Dataset):
+    """(x, y) regression pairs for the PipelineLayer's MSE loss."""
+    def __init__(self, n=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(n, 8).astype("f4")
+        self.y = rng.rand(n, 4).astype("f4")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _build_pp_layer():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer)
+    paddle.seed(11)
+    return PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16),
+                LayerDesc(_PPBlock, 16),
+                LayerDesc(_PPBlock, 16),
+                LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2, loss_fn=nn.MSELoss())
+
+
+def test_engine_pipeline_strategy_routes_to_pp_stepper():
+    """Engine.fit with a dp x mp x pp Strategy must take the fleet
+    compiled-SPMD pipeline path and match a single-device golden run."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallel
+
+    pl = _build_pp_layer()
+    snap = {k: np.asarray(v._value).copy()
+            for k, v in pl.state_dict().items()}
+
+    s = Strategy()
+    s.pipeline.enable = True
+    s.pipeline.accumulate_steps = 2
+    s.pp_degree = 2
+    s.mp_degree = 2
+    s.dp_degree = 2
+    lr = 0.05
+    opt = paddle.optimizer.SGD(learning_rate=lr, parameters=pl.parameters())
+    eng = Engine(pl, optimizer=opt, strategy=s)
+    rng = np.random.RandomState(5)
+    batches = [(rng.rand(8, 8).astype("f4"), rng.rand(8, 4).astype("f4"))
+               for _ in range(4)]
+    hist = eng.fit(batches, epochs=1, verbose=0)
+    assert isinstance(eng._model, PipelineParallel), \
+        "Engine must route Strategy.pipeline to the fleet PP wrapper"
+    assert eng._model._stepper is not None, "compiled path not taken"
+    assert len(hist["loss"]) == 4
+
+    # full-strategy (dp=2 x mp=2 x pp=2) vs pp-only (dp absorbs the rest)
+    # must produce identical losses on the same fixed batches
+    pl2 = _build_pp_layer()
+    pl2.set_state_dict({k: paddle.to_tensor(v) for k, v in snap.items()})
+    opt2 = paddle.optimizer.SGD(learning_rate=lr,
+                                parameters=pl2.parameters())
+    s2 = Strategy()
+    s2.pipeline.enable = True
+    s2.pipeline.accumulate_steps = 2
+    s2.pp_degree = 2
+    eng2 = Engine(pl2, optimizer=opt2, strategy=s2)
+    hist2 = eng2.fit(batches, epochs=1, verbose=0)
+    np.testing.assert_allclose(hist["loss"], hist2["loss"], rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_engine_pp_golden_parity_fixed_batches():
+    """Deterministic batch order: Engine pp losses == eager single-device
+    losses on the same PipelineLayer (the test_fleet_pp_compiled pattern
+    through the Engine API)."""
+    pl = _build_pp_layer()
+    snap = {k: np.asarray(v._value).copy()
+            for k, v in pl.state_dict().items()}
+    rng = np.random.RandomState(3)
+    steps, lr = 3, 0.05
+    xs = [rng.rand(8, 8).astype("f4") for _ in range(steps)]
+    ys = [rng.rand(8, 4).astype("f4") for _ in range(steps)]
+
+    s = Strategy()
+    s.pipeline.enable = True
+    s.pipeline.accumulate_steps = 2
+    s.pp_degree = 2
+    s.dp_degree = 2
+    opt = paddle.optimizer.SGD(learning_rate=lr, parameters=pl.parameters())
+    eng = Engine(pl, optimizer=opt, strategy=s)
+    # feed pre-made batches (Engine accepts an iterable of batches)
+    hist = eng.fit(list(zip(xs, ys)), epochs=1, verbose=0)
+
+    pl2 = _build_pp_layer()
+    pl2.set_state_dict({k: paddle.to_tensor(v) for k, v in snap.items()})
+    opt2 = paddle.optimizer.SGD(learning_rate=lr,
+                                parameters=pl2.parameters())
+    loss_fn = nn.MSELoss()
+    golden = []
+    for t in range(steps):
+        o = pl2(paddle.to_tensor(xs[t]))
+        loss = loss_fn(o, paddle.to_tensor(ys[t]))
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        golden.append(float(loss))
+    np.testing.assert_allclose(hist["loss"], golden, rtol=2e-4, atol=2e-5)
+
+
+def test_engine_honors_sharding_degree():
+    """Strategy.sharding.degree=2 must build a (dp=2, sharding=2, mp=2)
+    mesh rather than inferring sharding from the world size."""
+    s = Strategy()
+    s.sharding.enable = True
+    s.sharding.stage = 2
+    s.sharding.degree = 2
+    s.mp_degree = 2
+    eng = Engine(TinyNet(), strategy=s)
+    plan = eng._build_plan()
+    assert plan.mesh.shape["data"] == 2
+    assert plan.mesh.shape["sharding"] == 2
+    assert plan.mesh.shape["model"] == 2
+    assert plan.level == "os_g"

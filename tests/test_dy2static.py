@@ -216,3 +216,95 @@ def test_static_nn_while_loop():
         return r[0]._value
     np.testing.assert_allclose(
         np.asarray(jax.jit(traced)(jnp.asarray([1.0]))), [32.0])
+
+
+# -- for-loop conversion (VERDICT r2 #5) --------------------------------------
+
+def test_transform_for_range_tensor_bound():
+    """for i in range(tensor_n) compiles to lax.fori_loop and matches
+    eager."""
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x * ((i + 1) * 1.0)
+        return acc
+
+    g, changed = transform_function(f)
+    assert changed
+    x = Tensor(jnp.asarray([1.0, 2.0]))
+    # concrete bound: plain python semantics
+    out = g(x, Tensor(jnp.asarray(3)))
+    np.testing.assert_allclose(np.asarray(out._value), [6.0, 12.0])
+    # traced bound: must compile (fori_loop), same numbers
+    jit_out = jax.jit(lambda v, n: g(Tensor(v), Tensor(n))._value)(
+        jnp.asarray([1.0, 2.0]), jnp.asarray(3))
+    np.testing.assert_allclose(np.asarray(jit_out), [6.0, 12.0])
+    # the jaxpr must contain structured looping, not a 3x unroll
+    jx = str(jax.make_jaxpr(lambda v, n: g(Tensor(v), Tensor(n))._value)(
+        jnp.asarray([1.0, 2.0]), jnp.asarray(3)))
+    assert "while" in jx or "fori" in jx
+
+
+def test_transform_for_range_step():
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(1, n, 2):
+            acc = acc + x * (i * 1.0)
+        return acc
+
+    g, changed = transform_function(f)
+    assert changed
+    out = jax.jit(lambda v, n: g(Tensor(v), Tensor(n))._value)(
+        jnp.asarray([1.0]), jnp.asarray(6))
+    np.testing.assert_allclose(np.asarray(out), [9.0])   # 1+3+5
+
+
+def test_transform_for_over_tensor_scan():
+    """for row in tensor lowers to lax.scan and is differentiable."""
+    def f(xs):
+        acc = xs[0] * 0.0
+        for row in xs:
+            acc = acc + row * row
+        return acc.sum()
+
+    g, changed = transform_function(f)
+    assert changed
+    xs = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    out = jax.jit(lambda v: g(Tensor(v))._value)(xs)
+    np.testing.assert_allclose(float(out), 30.0)
+    # reverse-mode AD through the scan
+    grad = jax.grad(lambda v: g(Tensor(v))._value)(xs)
+    np.testing.assert_allclose(np.asarray(grad), 2 * np.asarray(xs))
+
+
+def test_transform_for_python_iterable_unchanged_semantics():
+    def f(x):
+        acc = x * 0.0
+        for w in [1.0, 2.0, 3.0]:
+            acc = acc + x * w
+        return acc
+
+    g, changed = transform_function(f)
+    assert changed
+    out = g(Tensor(jnp.asarray([2.0])))
+    np.testing.assert_allclose(np.asarray(out._value), [12.0])
+
+
+def test_for_with_break_concrete_ok_traced_errors():
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            if i >= 2:
+                break
+            acc = acc + x
+        return acc
+
+    g, changed = transform_function(f)
+    assert changed   # the range guard was installed
+    # concrete bound: python break works
+    out = g(Tensor(jnp.asarray([1.0])), 5)
+    np.testing.assert_allclose(np.asarray(out._value), [2.0])
+    # traced bound: clear error, not silent mistrace
+    with pytest.raises(NotImplementedError, match="break/continue"):
+        jax.jit(lambda v, n: g(Tensor(v), Tensor(n))._value)(
+            jnp.asarray([1.0]), jnp.asarray(5))

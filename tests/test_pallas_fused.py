@@ -126,3 +126,75 @@ class TestFusedAdamW:
                                     + 0.01 * ref_p)
         np.testing.assert_allclose(np.asarray(p[0]), ref_p, rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_adamw_use_multi_tensor_parity():
+    """AdamW(use_multi_tensor=True) routes through the fused kernel (on
+    TPU; jnp fallback elsewhere) and matches the per-tensor path,
+    including decoupled-decay exclusion by name (VERDICT r2 #8)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.optimizer.optimizer import AdamW
+
+    rng = np.random.RandomState(0)
+    ps = [jnp.asarray(rng.randn(8, 8), jnp.float32),
+          jnp.asarray(rng.randn(16,), jnp.float32)]
+    gs = [jnp.asarray(rng.randn(8, 8), jnp.float32),
+          jnp.asarray(rng.randn(16,), jnp.float32)]
+    names = ["fc_weight", "fc_bias"]
+
+    def run(use_mt):
+        opt = AdamW(learning_rate=1e-2, weight_decay=0.1,
+                    use_multi_tensor=use_mt,
+                    apply_decay_param_fun=lambda n: "bias" not in n)
+        st = [opt._init_state_for(p) for p in ps]
+        out_p, out_s = ps, st
+        for _ in range(3):
+            out_p, out_s = opt.apply_functional(out_p, gs, out_s, 1e-2,
+                                                param_names=names)
+        return out_p, out_s
+
+    p_ref, s_ref = run(False)
+    p_mt, s_mt = run(True)
+    for a, b in zip(p_ref, p_mt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    for a, b in zip(s_ref, s_mt):
+        np.testing.assert_allclose(np.asarray(a["moment1"]),
+                                   np.asarray(b["moment1"]), rtol=2e-5,
+                                   atol=2e-6)
+        np.testing.assert_allclose(float(a["beta1_pow"]),
+                                   float(b["beta1_pow"]), rtol=1e-6)
+
+
+def test_adamw_multi_tensor_per_param_bias_correction():
+    """Params at different step counts (freeze/unfreeze) must get their
+    OWN bias correction in the fused path (review r3)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.optimizer.optimizer import AdamW
+
+    rng = np.random.RandomState(1)
+    ps = [jnp.asarray(rng.randn(8, 8), jnp.float32),
+          jnp.asarray(rng.randn(8, 8), jnp.float32)]
+    g1 = [None, jnp.asarray(rng.randn(8, 8), jnp.float32)]
+    g2 = [jnp.asarray(rng.randn(8, 8), jnp.float32),
+          jnp.asarray(rng.randn(8, 8), jnp.float32)]
+
+    def run(use_mt):
+        opt = AdamW(learning_rate=1e-2, weight_decay=0.0,
+                    use_multi_tensor=use_mt)
+        st = [opt._init_state_for(p) for p in ps]
+        out_p, out_s = ps, st
+        # 5 steps with param 0 frozen, then 3 with both live
+        for _ in range(5):
+            out_p, out_s = opt.apply_functional(out_p, g1, out_s, 1e-2)
+        for _ in range(3):
+            out_p, out_s = opt.apply_functional(out_p, g2, out_s, 1e-2)
+        return out_p
+
+    p_ref = run(False)
+    p_mt = run(True)
+    for a, b in zip(p_ref, p_mt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)

@@ -66,3 +66,26 @@ def test_quantized_linear_uses_same_math():
                       jnp.asarray(w_scale * 127.0), a_s, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref._value),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_fp8_matmul_close_to_fp32():
+    """fp8 e4m3 weight+act quantized matmul stays within fp8 tolerance
+    of the fp32 product (SURVEY fp8 epilogue row)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.quant_matmul import (
+        fp8_matmul, fp8_quantize_weight)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 64).astype("f4")
+    w = rng.randn(64, 48).astype("f4")
+    w8, ws = fp8_quantize_weight(w)
+    assert str(w8.dtype) == "float8_e4m3fn"
+    out = fp8_matmul(x, w8, ws)
+    ref = x @ w
+    # e4m3 has ~2 decimal digits; error scales with K=64 accumulation
+    rel = np.abs(np.asarray(out) - ref).max() / np.abs(ref).max()
+    assert rel < 0.08, rel
+    # static act_scale path
+    out2 = fp8_matmul(x, w8, ws, act_scale=float(np.abs(x).max() / 448.0))
+    rel2 = np.abs(np.asarray(out2) - ref).max() / np.abs(ref).max()
+    assert rel2 < 0.08, rel2

@@ -215,13 +215,54 @@ class TestRoiPool:
                     ref[0, c, i, j] = c * ph * pw + i * pw + j
         np.testing.assert_allclose(got, ref, rtol=1e-6)
 
-    def test_batched_input_raises(self):
+    def test_batched_input_supported(self):
+        # r3: N>1 via boxes_num now works (was NotImplementedError)
         x = np.ones((2, 8, 4, 4), "float32")
         boxes = np.array([[0.0, 0.0, 4.0, 4.0]], "float32")
-        with pytest.raises(NotImplementedError):
-            psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
-                       paddle.to_tensor(np.array([1, 0], "int32")), 2)
-        with pytest.raises(NotImplementedError):
-            roi_pool(paddle.to_tensor(np.ones((2, 1, 4, 4), "float32")),
-                     paddle.to_tensor(boxes),
-                     paddle.to_tensor(np.array([1, 0], "int32")), 2)
+        out = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1, 0], "int32")), 2)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+        out2 = roi_pool(paddle.to_tensor(np.ones((2, 1, 4, 4), "float32")),
+                        paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1, 0], "int32")), 2)
+        assert tuple(out2.shape) == (1, 1, 2, 2)
+
+
+def test_roi_pool_batched_matches_per_image():
+    """N>1 with boxes_num (VERDICT r2 missing #5): batched call ==
+    single-image calls concatenated."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import roi_pool
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 16, 16).astype("f4")
+    b0 = np.asarray([[0, 0, 7, 7], [4, 4, 12, 12]], "f4")
+    b1 = np.asarray([[2, 2, 10, 10]], "f4")
+    boxes = np.concatenate([b0, b1])
+    out = roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                   np.asarray([2, 1], "i4"), output_size=4)
+    ref0 = roi_pool(paddle.to_tensor(x[:1]), paddle.to_tensor(b0),
+                    np.asarray([2], "i4"), output_size=4)
+    ref1 = roi_pool(paddle.to_tensor(x[1:]), paddle.to_tensor(b1),
+                    np.asarray([1], "i4"), output_size=4)
+    np.testing.assert_allclose(out.numpy()[:2], ref0.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(out.numpy()[2:], ref1.numpy(), rtol=1e-6)
+
+
+def test_psroi_pool_batched_matches_per_image():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import psroi_pool
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 2 * 3 * 3, 12, 12).astype("f4")
+    b0 = np.asarray([[0, 0, 6, 6]], "f4")
+    b1 = np.asarray([[3, 3, 11, 11], [1, 1, 8, 8]], "f4")
+    boxes = np.concatenate([b0, b1])
+    out = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                     np.asarray([1, 2], "i4"), output_size=3)
+    ref0 = psroi_pool(paddle.to_tensor(x[:1]), paddle.to_tensor(b0),
+                      np.asarray([1], "i4"), output_size=3)
+    ref1 = psroi_pool(paddle.to_tensor(x[1:]), paddle.to_tensor(b1),
+                      np.asarray([2], "i4"), output_size=3)
+    np.testing.assert_allclose(out.numpy()[:1], ref0.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(out.numpy()[1:], ref1.numpy(), rtol=1e-6)
