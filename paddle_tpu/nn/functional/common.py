@@ -342,3 +342,119 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
                                 keepdims=True), 1.0 / p)
         return v / jnp.maximum(nrm, epsilon)
     return call_op(_n, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference: paddle.nn.functional.affine_grid — sampling grid from a
+    batch of 2x3 affine matrices (4D NCHW out_shape [N, C, H, W])."""
+    theta = ensure_tensor(theta)
+    if hasattr(out_shape, "_value"):
+        out_shape = [int(v) for v in np.asarray(out_shape._value)]
+    N, _, H, W = [int(s) for s in out_shape]
+
+    def _grid(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H, dtype=jnp.float32) * 2 + 1) / H - 1.0
+            xs = (jnp.arange(W, dtype=jnp.float32) * 2 + 1) / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)     # (H, W, 3)
+        return jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+    return call_op(_grid, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference: paddle.nn.functional.grid_sample — sample NCHW ``x`` at
+    normalized [-1, 1] ``grid`` (N, Hg, Wg, 2) locations.  Modes:
+    bilinear/nearest; padding zeros/border/reflection.  XLA lowers the
+    gathers to TPU dynamic-gather; fully differentiable wrt x and grid
+    (bilinear)."""
+    x = ensure_tensor(x)
+    grid = ensure_tensor(grid)
+
+    def _sample(xv, gv):
+        N, C, H, W = xv.shape
+        gx, gy = gv[..., 0], gv[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * 0.5 * (W - 1)
+            fy = (gy + 1.0) * 0.5 * (H - 1)
+        else:
+            fx = ((gx + 1.0) * W - 1.0) * 0.5
+            fy = ((gy + 1.0) * H - 1.0) * 0.5
+
+        def reflect(f, lo, hi):
+            # reflect into [lo, hi] (border-inclusive reflection)
+            rng_ = hi - lo
+            if rng_ <= 0:
+                return jnp.zeros_like(f)
+            f = jnp.abs(f - lo) % (2 * rng_)
+            return lo + jnp.where(f > rng_, 2 * rng_ - f, f)
+
+        if padding_mode == "reflection":
+            # align_corners picks the reflection walls: pixel centers
+            # ([0, size-1]) vs pixel edges ([-0.5, size-0.5]) — the
+            # paddle/torch convention
+            if align_corners:
+                fx = reflect(fx, 0.0, W - 1.0)
+                fy = reflect(fy, 0.0, H - 1.0)
+            else:
+                fx = jnp.clip(reflect(fx, -0.5, W - 0.5), 0, W - 1)
+                fy = jnp.clip(reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+        def gather(ix, iy):
+            """x[n, :, iy, ix] with out-of-range handling."""
+            inb = ((ix >= 0) & (ix <= W - 1) &
+                   (iy >= 0) & (iy <= H - 1))
+            cx = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            flat = xv.reshape(N, C, H * W)
+            idx = cy * W + cx                             # (N, Hg, Wg)
+            vals = jnp.take_along_axis(
+                flat[:, :, :], idx.reshape(N, 1, -1), axis=2
+            ).reshape(N, C, *idx.shape[1:])
+            if padding_mode == "zeros":
+                vals = vals * inb[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fx), jnp.round(fy))
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (fx - x0) * (y1 - fy)
+        wc = (x1 - fx) * (fy - y0)
+        wd = (fx - x0) * (fy - y0)
+        out = (gather(x0, y0) * wa[:, None] + gather(x1, y0) * wb[:, None]
+               + gather(x0, y1) * wc[:, None]
+               + gather(x1, y1) * wd[:, None])
+        return out.astype(xv.dtype)
+    return call_op(_sample, x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """reference: paddle.nn.functional.temporal_shift (TSM): shift a
+    fraction of channels one step forward/backward along the segment
+    (time) axis; zero-padded at the ends."""
+    x = ensure_tensor(x)
+
+    def _shift(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v5 = v.reshape(N, seg_num, C, H, W)
+        fold = int(C * shift_ratio)
+        back = jnp.pad(v5[:, 1:, :fold], ((0, 0), (0, 1), (0, 0),
+                                          (0, 0), (0, 0)))
+        fwd = jnp.pad(v5[:, :-1, fold:2 * fold], ((0, 0), (1, 0), (0, 0),
+                                                  (0, 0), (0, 0)))
+        out = jnp.concatenate([back, fwd, v5[:, :, 2 * fold:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return call_op(_shift, x)

@@ -260,7 +260,7 @@ def _flash_bhsd_fwd_lse(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
     )(q, k, v)
 
 
-def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                             dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                             scale, causal, block_k, seq_len):
     """One-pass backward: every (q,k) block pair is visited ONCE,
@@ -272,7 +272,9 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     resident at a time while the dk/dv accumulators persist across grid
     steps; that keeps the VMEM footprint ~16·S·D bytes and lets the
     one-pass kernel run to S=8192 at D=64 (the old all-in-one-program
-    variant held every q block at once and topped out at S=2048)."""
+    variant held every q block at once and topped out at S=2048).
+    delta = rowsum(do*o) is computed in-kernel and lse rides the slim
+    (1, S) layout (no (S, LANES) HBM broadcast)."""
     qi = pl.program_id(1)
     nq = pl.num_programs(1)
     block_q = q_ref.shape[0]
@@ -286,8 +288,10 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[:] * scale
     do = do_ref[:]
-    lse = jnp.tile(lse_ref[:], (1, block_k // _LANES))
-    delta = jnp.tile(delta_ref[:], (1, block_k // _LANES))
+    o = o_ref[:]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1,
+                    keepdims=True)
+    lse = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
     q_idx = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)
 
@@ -360,17 +364,16 @@ def _flash_bhsd_bwd_fused(q, k, v, o, lse, do, causal=False,
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
-    lse_l, delta_l = _bwd_prep(o, do, lse)
     qblk = lambda b, i: (b, i, 0)
     full = lambda b, i: (b, 0, 0)
     spec_qd = pl.BlockSpec((None, block_q, D), qblk)
-    spec_ql = pl.BlockSpec((None, block_q, _LANES), qblk)
     spec_sd = pl.BlockSpec((None, S, D), full)
+    spec_lse = pl.BlockSpec((None, 1, S), full)
     return pl.pallas_call(
         functools.partial(_flash_bwd_fused_kernel, scale=scale,
                           causal=causal, block_k=block_k, seq_len=S),
         grid=(BH, S // block_q),
-        in_specs=[spec_qd, spec_sd, spec_sd, spec_qd, spec_ql, spec_ql],
+        in_specs=[spec_qd, spec_sd, spec_sd, spec_qd, spec_qd, spec_lse],
         out_specs=[spec_qd, spec_sd, spec_sd],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -382,7 +385,7 @@ def _flash_bhsd_bwd_fused(q, k, v, o, lse, do, causal=False,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_l, delta_l)
+    )(q, k, v, do, o, lse[:, None, :].astype(jnp.float32))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -447,6 +450,11 @@ def _flash_bhsd_bwd(q, k, v, o, lse, do, causal=False,
 
 def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
                          causal, block_q, block_k, seq_len, with_lse):
+    """lse is stored UNBROADCAST as (hb, 1, S) — the (S, LANES) lane-
+    broadcast layout cost a 128x-inflated HBM write (151MB per layer at
+    BH=288/S=1024, measured ~24% of bwd time); the (block_q,) lane
+    vector <-> (block_q, 1) column relayout inside the kernel is far
+    cheaper."""
     hb = q_ref.shape[0]
     d = q_ref.shape[2]
     nq = seq_len // block_q
@@ -483,16 +491,19 @@ def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
             o_ref[h, pl.ds(q_lo, block_q), :] = \
                 (acc / l).astype(o_ref.dtype)
             if with_lse:
-                lse_ref[h, pl.ds(q_lo, block_q), :] = \
-                    jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
+                lse_ref[h, 0, pl.ds(q_lo, block_q)] = \
+                    (m + jnp.log(l))[:, 0]
 
 
-def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                          dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
                          causal, block_q, block_k, seq_len):
     """One-pass backward, HB heads per program, static loops; dk/dv
     accumulate in fp32 VMEM scratch within the program (no cross-program
-    state — each program owns its heads outright)."""
+    state — each program owns its heads outright).  delta = rowsum(do*o)
+    is computed in-kernel from the o block and lse rides the slim
+    (hb, 1, S) layout — the old precomputed (S, LANES) broadcasts were
+    ~300MB/layer of pure HBM overhead (measured 24% of bwd time)."""
     hb = q_ref.shape[0]
     d = q_ref.shape[2]
     nq = seq_len // block_q
@@ -504,11 +515,10 @@ def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_lo = qi * block_q
             q = q_ref[h, pl.ds(q_lo, block_q), :] * scale
             do = do_ref[h, pl.ds(q_lo, block_q), :]
-            # column-broadcast instead of tiling to (block_q, block_k):
-            # sublane broadcast is free on the VPU, the tile was a real
-            # materialized copy
-            lse = lse_ref[h, pl.ds(q_lo, block_q), :][:, :1]
-            delta = delta_ref[h, pl.ds(q_lo, block_q), :][:, :1]
+            o = o_ref[h, pl.ds(q_lo, block_q), :]
+            delta = jnp.sum(do.astype(jnp.float32)
+                            * o.astype(jnp.float32), -1, keepdims=True)
+            lse = lse_ref[h, 0, pl.ds(q_lo, block_q)][:, None]
             dq = jnp.zeros((block_q, d), jnp.float32)
             for ki in range(nk):
                 k_lo = ki * block_k
@@ -566,8 +576,8 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
     out_specs = [spec]
     out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype)]
     if with_lse:
-        out_specs.append(pl.BlockSpec((hb, S, _LANES), lambda b: (b, 0, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((BH, 1, S), jnp.float32))
     kernel = functools.partial(_flash_fwd_mh_kernel, scale=scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, seq_len=S, with_lse=with_lse)
@@ -582,7 +592,9 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
         out_shape=out_shape if with_lse else out_shape[0],
         interpret=interpret,
     )(q, k, v)
-    return out if with_lse else (out, None)
+    if with_lse:
+        return out[0], out[1][:, 0, :]     # lse -> (BH, S)
+    return out, None
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -594,15 +606,14 @@ def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
-    lse_l, delta_l = _bwd_prep(o, do, lse)
     hb = _pick_hb(BH, S, D, n_bufs=7)
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
-    spec_l = pl.BlockSpec((hb, S, _LANES), lambda b: (b, 0, 0))
+    spec_l = pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0))
     return pl.pallas_call(
         functools.partial(_flash_bwd_mh_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=S),
         grid=(BH // hb,),
-        in_specs=[spec, spec, spec, spec, spec_l, spec_l],
+        in_specs=[spec, spec, spec, spec, spec, spec_l],
         out_specs=[spec, spec, spec],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -612,7 +623,7 @@ def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
         scratch_shapes=[pltpu.VMEM((S, D), jnp.float32),
                         pltpu.VMEM((S, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse_l, delta_l)
+    )(q, k, v, do, o, lse[:, None, :].astype(jnp.float32))
 
 
 def _to_bhsd(x):
@@ -659,11 +670,12 @@ def flash_attention_fwd_lse(q, k, v, causal=False, interpret=False):
         of, lse = _flash_bhsd_fwd_mh(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
                                      causal=causal, block_q=bq, block_k=bk,
                                      with_lse=True, interpret=interpret)
-    else:
-        of, lse = _flash_bhsd_fwd_lse(_to_bhsd(q), _to_bhsd(k),
-                                      _to_bhsd(v), causal=causal,
-                                      block_q=bq, block_k=bk,
-                                      interpret=interpret)
+        # mh path already returns lse as (BH, S)
+        return _from_bhsd(of, B, H), lse
+    of, lse = _flash_bhsd_fwd_lse(_to_bhsd(q), _to_bhsd(k),
+                                  _to_bhsd(v), causal=causal,
+                                  block_q=bq, block_k=bk,
+                                  interpret=interpret)
     return _from_bhsd(of, B, H), lse[..., 0]
 
 

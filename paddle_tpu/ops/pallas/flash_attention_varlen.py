@@ -28,7 +28,6 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _LANES, _bwd_prep
 
 _VARLEN_MAX_TD = 8192 * 64
 _BLOCK = 512
@@ -83,14 +82,15 @@ def _varlen_fwd_kernel(segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref,
         acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANES))
+    lse_ref[0, pl.ds(q_lo, block_q)] = (m + jnp.log(l))[:, 0]
 
 
 def _varlen_bwd_kernel(segq_ref, segk_ref, q_ref, k_ref, v_ref, do_ref,
-                       lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, dk_acc,
+                       o_ref, lse_ref, dq_ref, dk_ref, dv_ref, dk_acc,
                        dv_acc, *, scale, causal, block_k, total):
     """One-pass backward, sequential q-block grid axis with persistent
-    dk/dv scratch (same scheme as _flash_bwd_fused_kernel) + seg mask."""
+    dk/dv scratch (same scheme as _flash_bwd_fused_kernel) + seg mask.
+    delta computed in-kernel; lse rides the slim (1, T) layout."""
     qi = pl.program_id(1)
     nq = pl.num_programs(1)
     block_q = q_ref.shape[0]
@@ -105,8 +105,10 @@ def _varlen_bwd_kernel(segq_ref, segk_ref, q_ref, k_ref, v_ref, do_ref,
 
     q = q_ref[:] * scale
     do = do_ref[:]
-    lse = lse_ref[:][:, :1]
-    delta = delta_ref[:][:, :1]
+    o = o_ref[:]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1,
+                    keepdims=True)
+    lse = lse_ref[0, pl.ds(q_lo, block_q)][:, None]
     seg_q = segq_ref[0, pl.ds(q_lo, block_q)][:, None]
     q_idx = q_lo + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
@@ -174,15 +176,15 @@ def _varlen_fwd(q, k, v, seg_q, seg_k, causal, block_q=_BLOCK,
         ],
         out_specs=[
             spec_q,
-            pl.BlockSpec((None, block_q, _LANES), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, 1, T), lambda h, i: (h, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((H, T, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((H, 1, T), jnp.float32),
         ],
         interpret=interpret,
     )(_seg2d(seg_q), _seg2d(seg_k), q, k, v)
-    return out, lse[..., 0]
+    return out, lse[:, 0, :]
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -193,10 +195,9 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, causal, block_q=_BLOCK,
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     scale = 1.0 / math.sqrt(D)
-    lse_l, delta_l = _bwd_prep(o, do, lse)
     spec_q = pl.BlockSpec((None, block_q, D), lambda h, i: (h, i, 0))
-    spec_ql = pl.BlockSpec((None, block_q, _LANES), lambda h, i: (h, i, 0))
     spec_full = pl.BlockSpec((None, T, D), lambda h, i: (h, 0, 0))
+    spec_lse = pl.BlockSpec((None, 1, T), lambda h, i: (h, 0, 0))
     return pl.pallas_call(
         functools.partial(_varlen_bwd_kernel, scale=scale, causal=causal,
                           block_k=block_k, total=T),
@@ -204,7 +205,7 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, causal, block_q=_BLOCK,
         in_specs=[
             pl.BlockSpec((8, T), lambda h, i: (0, 0)),
             pl.BlockSpec((8, T), lambda h, i: (0, 0)),
-            spec_q, spec_full, spec_full, spec_q, spec_ql, spec_ql,
+            spec_q, spec_full, spec_full, spec_q, spec_q, spec_lse,
         ],
         out_specs=[spec_q, spec_full, spec_full],
         out_shape=[
@@ -217,7 +218,8 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, causal, block_q=_BLOCK,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(_seg2d(seg_q), _seg2d(seg_k), q, k, v, do, lse_l, delta_l)
+    )(_seg2d(seg_q), _seg2d(seg_k), q, k, v, do, o,
+      lse[:, None, :].astype(jnp.float32))
 
 
 def _segments_from_cu(cu_seqlens, total_pad):

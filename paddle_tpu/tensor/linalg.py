@@ -232,3 +232,49 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     x = ensure_tensor(x)
     return call_op(lambda v: jnp.cov(v, rowvar=rowvar,
                                      ddof=1 if ddof else 0), x)
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference: paddle.linalg.cond) — default 2-norm
+    via SVD; also p in {'fro', 'nuc', 1, -1, 2, -2, inf, -inf}."""
+    x = ensure_tensor(x)
+
+    def _cond(v):
+        vf = v.astype(jnp.float32) if not jnp.issubdtype(
+            v.dtype, jnp.floating) else v
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(vf, compute_uv=False)
+            smax, smin = s[..., 0], s[..., -1]
+            return smax / smin if (p is None or p == 2) else smin / smax
+        nx = jnp.linalg.norm(vf, ord=p, axis=(-2, -1))
+        ni = jnp.linalg.norm(jnp.linalg.inv(vf), ord=p, axis=(-2, -1))
+        return nx * ni
+    return call_op(_cond, x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference: paddle.linalg.lu) — returns the
+    packed LU matrix and 1-based pivots (paddle layout).  ``pivot=False``
+    is rejected (LAPACK getrf always pivots; same as the reference GPU
+    path)."""
+    if not pivot:
+        raise ValueError("paddle.linalg.lu: pivot=False is not supported")
+    x = ensure_tensor(x)
+    import jax.scipy.linalg as jsl
+
+    def _lu(v):
+        lu_mat, piv = jsl.lu_factor(v)
+        outs = [lu_mat, (piv + 1).astype(jnp.int32)]
+        if get_infos:
+            outs.append(jnp.zeros(v.shape[:-2], jnp.int32))
+        return tuple(outs)
+    return call_op(_lu, x)
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (reference:
+    paddle.linalg.householder_product; LAPACK orgqr)."""
+    x = ensure_tensor(x)
+    tau = ensure_tensor(tau)
+    return call_op(
+        lambda a, t: jax.lax.linalg.householder_product(a, t), x, tau)

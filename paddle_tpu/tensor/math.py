@@ -271,3 +271,77 @@ def broadcast_shape(x_shape, y_shape):
 def increment(x, value=1.0, name=None):
     x._value = x._value + value
     return x
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """reference: paddle.logcumsumexp — numerically-stable cumulative
+    logsumexp (lax.cumlogsumexp)."""
+    x = ensure_tensor(x)
+
+    def _lcse(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else (axis if axis >= 0 else axis + v.ndim)
+        out = jax.lax.cumlogsumexp(vv.astype(jnp.float32), axis=ax)
+        return out.astype(dtype) if dtype else out.astype(
+            v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
+            else jnp.float32)
+    return call_op(_lcse, x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference: paddle.trapezoid — trapezoidal rule integration."""
+    y = ensure_tensor(y)
+    if x is not None:
+        return call_op(lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis),
+                       y, ensure_tensor(x))
+    return call_op(lambda yv: jnp.trapezoid(
+        yv, dx=1.0 if dx is None else dx, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference: paddle.cumulative_trapezoid."""
+    import jax.scipy.integrate as jsi  # noqa: F401 (availability check)
+    y = ensure_tensor(y)
+
+    def _ct(yv, xv=None):
+        yl = jnp.moveaxis(yv, axis, -1)
+        step = (jnp.diff(jnp.moveaxis(xv, axis, -1), axis=-1)
+                if xv is not None else (1.0 if dx is None else dx))
+        avg = (yl[..., 1:] + yl[..., :-1]) * 0.5 * step
+        return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+    if x is not None:
+        return call_op(_ct, y, ensure_tensor(x))
+    return call_op(_ct, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """reference: paddle.renorm — clamp each slice along ``axis`` to at
+    most ``max_norm`` in p-norm."""
+    x = ensure_tensor(x)
+
+    def _renorm(v):
+        perm_axis = axis if axis >= 0 else axis + v.ndim
+        red = tuple(i for i in range(v.ndim) if i != perm_axis)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) \
+            ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * factor
+    return call_op(_renorm, x)
+
+
+def frexp(x, name=None):
+    """reference: paddle.frexp — mantissa/exponent decomposition."""
+    x = ensure_tensor(x)
+
+    def _frexp(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+    return call_op(_frexp, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """reference: paddle.vander — Vandermonde matrix."""
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.vander(
+        v, N=n, increasing=increasing), x)

@@ -231,3 +231,45 @@ class TestSearchSort(OpTest):
                           index=paddle.to_tensor(idx), axis=0)
         self.check_output(paddle.index_select, [x], x[:, [0, 2]],
                           index=paddle.to_tensor(np.array([0, 2])), axis=1)
+
+
+def test_new_math_ops_r3():
+    """logcumsumexp / trapezoid / renorm / frexp / vander (reference:
+    paddle.* op surface)."""
+    x = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], "f4"))
+    lcse = paddle.logcumsumexp(x, axis=1).numpy()
+    ref = np.log(np.cumsum(np.exp(x.numpy()), axis=1))
+    np.testing.assert_allclose(lcse, ref, rtol=1e-5)
+    t = float(paddle.trapezoid(paddle.to_tensor(
+        np.asarray([1.0, 2.0, 3.0], "f4"))))
+    assert t == pytest.approx(4.0)
+    rn = paddle.renorm(x, p=2.0, axis=0, max_norm=1.0).numpy()
+    np.testing.assert_allclose(np.linalg.norm(rn, axis=1), [1.0, 1.0],
+                               rtol=1e-5)
+    m, e = paddle.frexp(paddle.to_tensor(np.asarray([8.0, 0.5], "f4")))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0, 0.5])
+    v = paddle.vander(paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], "f4")),
+                      n=3).numpy()
+    np.testing.assert_allclose(v, np.vander([1.0, 2.0, 3.0], 3))
+
+
+def test_new_linalg_ops_r3():
+    """linalg.cond / lu / householder_product."""
+    import scipy.linalg as sl
+    a = np.asarray([[1.0, 2.0], [3.0, 4.0]], "f4")
+    c = float(paddle.linalg.cond(paddle.to_tensor(a)))
+    assert c == pytest.approx(np.linalg.cond(a), rel=1e-4)
+    lu_m, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    ref_lu, ref_piv = sl.lu_factor(a)
+    np.testing.assert_allclose(lu_m.numpy(), ref_lu, rtol=1e-5)
+    np.testing.assert_allclose(piv.numpy(), ref_piv + 1)
+    (h, tau), _r = sl.qr(np.random.RandomState(0).randn(4, 3),
+                         mode="raw")
+    q = paddle.linalg.householder_product(
+        paddle.to_tensor(np.asarray(h, "f4").copy()),
+        paddle.to_tensor(np.asarray(tau, "f4").copy()))
+    assert tuple(q.shape) == (4, 3)
+    # golden: Q reconstructed by scipy's orgqr from the same reflectors
+    ref_q = sl.lapack.sorgqr(np.asarray(h, "f4"), np.asarray(tau, "f4"))[0]
+    np.testing.assert_allclose(q.numpy(), ref_q[:, :3], rtol=1e-4,
+                               atol=1e-5)

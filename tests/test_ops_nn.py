@@ -224,3 +224,66 @@ class TestConvPool(OpTest):
                            mode="nearest")
         assert up.shape == [1, 1, 6, 6]
         np.testing.assert_allclose(up.numpy()[0, 0, ::2, ::2], x[0, 0])
+
+
+class TestGridSample:
+    """grid_sample / affine_grid / temporal_shift (reference:
+    paddle.nn.functional; goldens from torch, like the signal suite)."""
+
+    def test_grid_sample_torch_golden(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as TF
+        r = np.random.RandomState(0)
+        x = r.randn(2, 3, 8, 8).astype("f4")
+        # range [-2, 2): out-of-bounds coords exercise every padding mode
+        grid = (r.rand(2, 5, 6, 2).astype("f4") * 4 - 2)
+        for mode in ("bilinear", "nearest"):
+            for pad in ("zeros", "border", "reflection"):
+                for ac in (True, False):
+                    ref = TF.grid_sample(torch.tensor(x),
+                                         torch.tensor(grid), mode=mode,
+                                         padding_mode=pad,
+                                         align_corners=ac).numpy()
+                    got = F.grid_sample(paddle.to_tensor(x),
+                                        paddle.to_tensor(grid), mode=mode,
+                                        padding_mode=pad,
+                                        align_corners=ac).numpy()
+                    np.testing.assert_allclose(got, ref, rtol=1e-5,
+                                               atol=1e-5,
+                                               err_msg=f"{mode}/{pad}/ac={ac}")
+
+    def test_grid_sample_grads_flow(self):
+        r = np.random.RandomState(1)
+        x = paddle.to_tensor(r.randn(1, 2, 4, 4).astype("f4"),
+                             stop_gradient=False)
+        g = paddle.to_tensor((r.rand(1, 3, 3, 2).astype("f4") - 0.5),
+                             stop_gradient=False)
+        F.grid_sample(x, g).sum().backward()
+        assert x.grad is not None and g.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_affine_grid_torch_golden(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as TF
+        r = np.random.RandomState(2)
+        theta = r.randn(2, 2, 3).astype("f4")
+        for ac in (True, False):
+            ref = TF.affine_grid(torch.tensor(theta), (2, 3, 5, 7),
+                                 align_corners=ac).numpy()
+            got = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                                align_corners=ac).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_temporal_shift(self):
+        r = np.random.RandomState(3)
+        x = r.randn(4, 8, 2, 2).astype("f4")      # N=2 segments of T=2
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        v5 = x.reshape(2, 2, 8, 2, 2)
+        # first quarter shifted backward (t+1), second quarter forward
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   v5[:, 1, :2])
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 1, 2:4],
+                                   v5[:, 0, 2:4])
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, :, 4:],
+                                   v5[:, :, 4:])
