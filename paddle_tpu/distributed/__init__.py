@@ -12,10 +12,13 @@ from .collective import (all_reduce, all_gather, all_gather_object,  # noqa: F40
                          reduce_scatter, alltoall, alltoall_single,
                          broadcast, reduce, scatter, send, recv, barrier,
                          new_group, wait, get_group, destroy_process_group,
-                         ReduceOp, stream)
-from .parallel import DataParallel  # noqa: F401
+                         ReduceOp, stream, broadcast_object_list,
+                         scatter_object_list, gather, isend, irecv,
+                         P2POp, batch_isend_irecv, get_backend)
+from .parallel import DataParallel, split  # noqa: F401
 from .mesh import (ProcessMesh, get_mesh, set_mesh, auto_mesh,  # noqa: F401
-                   shard_tensor, shard_op, Shard, Replicate, Partial)
+                   shard_tensor, shard_op, Shard, Replicate, Partial,
+                   reshard, dtensor_from_fn)
 from .store import TCPStore, MasterStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401

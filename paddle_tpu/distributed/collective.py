@@ -304,3 +304,137 @@ class stream:
     broadcast = staticmethod(broadcast)
     scatter = staticmethod(scatter)
     reduce = staticmethod(reduce)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: paddle.distributed.broadcast_object_list.  Single-
+    controller SPMD runs one Python process per host with a shared
+    program, so the source rank's objects are already what every rank
+    holds; multi-host exchange rides the TCP store."""
+    import jax
+    if jax.process_count() > 1:
+        # two-phase broadcast (size then padded bytes) so shapes agree on
+        # every host; multihost broadcast sources process 0
+        if src != 0:
+            raise NotImplementedError(
+                "broadcast_object_list: multi-host broadcast sources "
+                "process 0 (jax multihost_utils); re-root your objects "
+                "or use the TCP store for arbitrary-src exchange")
+        from jax.experimental import multihost_utils
+        import numpy as _np
+        import pickle
+        payload = _np.frombuffer(
+            pickle.dumps(list(object_list)), dtype=_np.uint8)
+        size = int(multihost_utils.broadcast_one_to_all(
+            _np.asarray([payload.size], _np.int32))[0])
+        buf = _np.zeros((size,), _np.uint8)
+        buf[:min(payload.size, size)] = payload[:size]
+        synced = multihost_utils.broadcast_one_to_all(buf)
+        object_list[:] = pickle.loads(bytes(_np.asarray(synced)))
+    return _Task(object_list)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: paddle.distributed.scatter_object_list."""
+    from .env import get_rank, get_world_size
+    rank = group.get_group_rank(get_rank()) if group is not None and \
+        hasattr(group, "get_group_rank") else get_rank()
+    n = (group.nranks if group is not None and
+         getattr(group, "nranks", None) else get_world_size())
+    out_object_list.clear()
+    if in_object_list:
+        if len(in_object_list) < n:
+            raise ValueError(
+                f"scatter_object_list: {len(in_object_list)} objects for "
+                f"{n} ranks")
+        out_object_list.append(in_object_list[rank])
+    return _Task(out_object_list)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: paddle.distributed.gather — collect shards to dst.  In
+    the SPMD trace every rank computes the gathered list (XLA all_gather;
+    dst selection is a no-op on a single program)."""
+    axis = _axis_of(group)
+    if axis is not None and _in_named_trace(axis):
+        out = _apply(tensor, lambda v: lax.all_gather(v, axis))
+        n = out.shape[0]
+        if gather_list is not None:
+            gather_list.clear()
+            for i in range(n):
+                gather_list.append(out[i])
+        return _Task(gather_list if gather_list is not None else out)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.append(tensor)
+    return _Task(gather_list)
+
+
+class P2POp:
+    """reference: paddle.distributed.P2POp — one op of a batched P2P
+    exchange.  op: distributed.isend / distributed.irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst=0, group=None):
+    raise RuntimeError(
+        "point-to-point isend is not exposed eagerly on TPU; batch the "
+        "exchange with distributed.batch_isend_irecv (lowered to ONE "
+        "lax.ppermute inside shard_map) or use p2p.ppermute directly")
+
+
+def irecv(tensor, src=0, group=None):
+    raise RuntimeError(
+        "point-to-point irecv is not exposed eagerly on TPU; batch the "
+        "exchange with distributed.batch_isend_irecv (lowered to ONE "
+        "lax.ppermute inside shard_map) or use p2p.ppermute directly")
+
+
+def batch_isend_irecv(p2p_op_list):
+    """reference: paddle.distributed.batch_isend_irecv.  TPU-native: the
+    whole batch must describe a permutation (each rank sends one tensor,
+    receives one) and lowers to a single ``lax.ppermute`` — XLA's native
+    neighbor exchange over ICI (this is exactly how the pipeline runtime
+    rotates activations).  Must run inside shard_map on the group axis;
+    the isend op's tensor supplies the payload, the matching irecv's
+    tensor is rebound to the received value."""
+    sends = [p for p in p2p_op_list if p.op is isend]
+    recvs = [p for p in p2p_op_list if p.op is irecv]
+    if not sends or len(sends) != len(recvs):
+        raise ValueError(
+            "batch_isend_irecv needs a balanced send/recv batch "
+            f"(got {len(sends)} sends, {len(recvs)} recvs)")
+    group = sends[0].group
+    axis = _axis_of(group)
+    if axis is None or not _in_named_trace(axis):
+        raise RuntimeError(
+            "batch_isend_irecv must run inside shard_map over the group "
+            "axis (TPU p2p is the ppermute collective)")
+    from .env import get_world_size
+    n = group.nranks if group is not None and hasattr(group, "nranks") \
+        else get_world_size()
+    from .env import get_rank
+    tasks = []
+    for s, r in zip(sends, recvs):
+        # single-program SPMD: the declared peer implies a uniform shift
+        # (every rank sends to rank+shift), which IS a permutation
+        shift = (s.peer - get_rank()) % n
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        out = _apply(s.tensor, lambda v, _p=perm: lax.ppermute(v, axis, _p))
+        r.tensor._value = out._value
+        r.tensor._node = out._node
+        r.tensor._out_idx = out._out_idx
+        tasks.append(_Task(r.tensor))
+    return tasks
+
+
+def get_backend(group=None):
+    """reference: paddle.distributed.get_backend — this framework's
+    collectives are XLA's (ICI/DCN), reported as 'XLA'."""
+    return "XLA"

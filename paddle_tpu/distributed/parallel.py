@@ -70,3 +70,47 @@ class DataParallel(Layer):
             return super().__getattr__(name)
         except AttributeError:
             return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+_SPLIT_CACHE = {}
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=None,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """reference: paddle.distributed.split — megatron-style sharded
+    linear/embedding as a functional op.  Delegates to the fleet TP
+    layers (Column/Row-parallel linear, VocabParallel embedding), cached
+    per ``name`` so repeated calls reuse the distributed weights (the
+    reference's unique_name behavior).  axis=1 splits the linear's
+    output columns (column parallel); axis=0 splits rows (row parallel).
+    """
+    from .fleet.meta_parallel import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+    # no name -> fresh distributed weights on every call (the
+    # reference's unique_name behavior); pass name= to reuse weights
+    # across steps
+    key = name
+    layer = _SPLIT_CACHE.get(key) if key is not None else None
+    if layer is None:
+        if operation == "linear":
+            in_f, out_f = size
+            if axis == 1:
+                layer = ColumnParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            else:
+                layer = RowParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    input_is_parallel=False)
+        elif operation == "embedding":
+            num_emb, emb_dim = size
+            layer = VocabParallelEmbedding(num_emb, emb_dim,
+                                           weight_attr=weight_attr)
+        else:
+            raise ValueError(f"split: unknown operation {operation!r}")
+        if key is not None:
+            _SPLIT_CACHE[key] = layer
+    return layer(x)

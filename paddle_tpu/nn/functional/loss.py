@@ -415,3 +415,163 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
         return _reduce(loss, reduction)
     return call_op(_gn, ensure_tensor(input), ensure_tensor(label),
                   ensure_tensor(variance))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference: paddle.nn.functional.triplet_margin_with_distance_loss —
+    triplet loss with a user distance callable (default: pairwise L2).
+    The default distance runs inside one taped op so gradients flow to
+    all three inputs; a custom distance_function must itself be built
+    from taped ops (paddle_tpu tensor operations) for the same."""
+    input, positive, negative = (ensure_tensor(input),
+                                 ensure_tensor(positive),
+                                 ensure_tensor(negative))
+    if distance_function is None:
+        def _tml(a, pos, neg):
+            dp = jnp.sqrt(jnp.sum(jnp.square(a - pos), -1) + 1e-12)
+            dn = jnp.sqrt(jnp.sum(jnp.square(a - neg), -1) + 1e-12)
+            if swap:
+                dpn = jnp.sqrt(jnp.sum(jnp.square(pos - neg), -1) + 1e-12)
+                dn = jnp.minimum(dn, dpn)
+            return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+        return call_op(_tml, input, positive, negative)
+    dp = ensure_tensor(distance_function(input, positive))
+    dn = ensure_tensor(distance_function(input, negative))
+    if swap:
+        from ...tensor.math import minimum as _min
+        dn = _min(dn, ensure_tensor(distance_function(positive, negative)))
+    return call_op(lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0),
+                                        reduction), dp, dn)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-06, keepdim=False, name=None):
+    """reference: paddle.nn.functional.pairwise_distance."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.power(
+        jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p), axis=-1,
+                keepdims=keepdim), 1.0 / p), x, y)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: paddle.nn.functional.rnnt_loss over
+    warprnnt; Graves 2012).
+
+    input: (B, T, U+1, V) joint-network logits (log_softmax applied
+    internally, matching warprnnt); label: (B, U) int; lengths (B,).
+
+    TPU-native: the forward algorithm runs as a lax.scan over T with an
+    associative first-order recurrence in U solved per step — log-space
+    alpha lattice, no Python loops over the batch.  FastEmit
+    (fastemit_lambda > 0) scales the final emission-path term by
+    (1 + lambda), the loss-side form of the warprnnt regularizer.
+    """
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    il = (input_lengths._value if hasattr(input_lengths, "_value")
+          else jnp.asarray(input_lengths)).astype(jnp.int32)
+    ll = (label_lengths._value if hasattr(label_lengths, "_value")
+          else jnp.asarray(label_lengths)).astype(jnp.int32)
+
+    def _rnnt(logits, lab):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # blank(t, u) and emit(t, u) log-probs
+        lp_blank = lp[..., blank]                          # (B, T, U+1)
+        lab_idx = jnp.minimum(lab, V - 1).astype(jnp.int32)  # (B, U)
+        lp_emit = jnp.take_along_axis(
+            lp[:, :, :U, :], lab_idx[:, None, :, None], axis=3
+        )[..., 0]                                          # (B, T, U)
+        neg_inf = jnp.float32(-1e30)
+
+        def step(alpha_prev, t):
+            # horizontal (blank) move from t-1 at same u
+            horiz = jnp.where(t == 0,
+                              jnp.where(jnp.arange(U1)[None, :] == 0, 0.0,
+                                        neg_inf),
+                              alpha_prev + lp_blank[:, jnp.maximum(t - 1, 0)])
+            # vertical (emit) within this t: first-order recurrence
+            # a[u] = logaddexp(horiz[u], a[u-1] + emit[t, u-1])
+            em = lp_emit[:, t]                             # (B, U)
+
+            def vstep(carry, u):
+                a_prev = carry
+                a_u = jnp.logaddexp(horiz[:, u],
+                                    jnp.where(u == 0, neg_inf,
+                                              a_prev + em[:, jnp.maximum(
+                                                  u - 1, 0)]))
+                return a_u, a_u
+            _, cols = jax.lax.scan(vstep, jnp.full((B,), neg_inf),
+                                   jnp.arange(U1))
+            alpha_t = jnp.moveaxis(cols, 0, 1)             # (B, U+1)
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(step, jnp.full((B, U1), neg_inf),
+                                 jnp.arange(T))            # (T, B, U+1)
+        alphas = jnp.moveaxis(alphas, 0, 1)                # (B, T, U+1)
+        # terminal: alpha[T_b - 1, U_b] + blank(T_b - 1, U_b)
+        bi = jnp.arange(B)
+        t_last = jnp.maximum(il - 1, 0)
+        a_term = alphas[bi, t_last, ll]
+        final_blank = lp_blank[bi, t_last, ll]
+        ll_total = a_term + final_blank
+        loss = -(1.0 + fastemit_lambda) * ll_total \
+            if fastemit_lambda else -ll_total
+        return _reduce(loss, reduction)
+    return call_op(_rnnt, input, label)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: paddle.nn.functional.adaptive_log_softmax_with_loss —
+    hierarchical (clustered) softmax.  head covers the first cutoff
+    classes + one slot per tail cluster; tail cluster i projects down
+    then up (tail_weights[i] = [down (in, h_i), up (h_i, n_i)])."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    head_weight = ensure_tensor(head_weight)
+    n_clusters = len(tail_weights)
+    shortlist = cutoffs[0]
+
+    raw = [input, label, head_weight]
+    if head_bias is not None:
+        raw.append(ensure_tensor(head_bias))
+    tails_flat = []
+    for pair in tail_weights:
+        tails_flat.extend(ensure_tensor(w) for w in pair)
+    raw.extend(tails_flat)
+
+    def _als(x, y, hw, *rest):
+        off = 0
+        hb = None
+        if head_bias is not None:
+            hb = rest[0]
+            off = 1
+        tw = [(rest[off + 2 * i], rest[off + 2 * i + 1])
+              for i in range(n_clusters)]
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, -1)   # (B, shortlist+K)
+        B = x.shape[0]
+        bi = jnp.arange(B)
+        out = jnp.zeros((B,), jnp.float32)
+        in_short = y < shortlist
+        short_lp = head_lp[bi, jnp.minimum(y, shortlist - 1)]
+        out = jnp.where(in_short, short_lp, out)
+        for i in range(n_clusters):
+            lo = cutoffs[i]
+            hi = cutoffs[i + 1]
+            in_c = (y >= lo) & (y < hi)
+            down, up = tw[i]
+            tail_lp = jax.nn.log_softmax((x @ down) @ up, -1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            lp_c = head_lp[:, shortlist + i] + tail_lp[bi, rel]
+            out = jnp.where(in_c, lp_c, out)
+        loss = -jnp.mean(out)
+        return out, loss
+    return call_op(_als, *raw)

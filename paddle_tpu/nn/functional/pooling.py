@@ -76,8 +76,13 @@ def _max_pool_nd(x, kernel_size, stride, padding, ceil_mode, return_mask,
                                      pad)
     out = call_op(_mp, x)
     if return_mask:
-        # indices within each window (flattened spatial), computed eagerly
-        idx = call_op(lambda v: _argmax_pool(v, dims, strides, pad), x)
+        # per-(N, C)-plane flattened-spatial argmax indices (the paddle
+        # max_pool mask convention — makes max_unpool independent of the
+        # batch/channel layout and valid for any output_size)
+        spatial = _spatial_sizes(x, n, data_format)
+        plane = int(np.prod(spatial))
+        idx = call_op(lambda v: _argmax_pool(v, dims, strides, pad)
+                      % plane, x)
         return out, idx
     return out
 
@@ -204,3 +209,137 @@ def lp_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                                   jax.lax.add, dims, strides, pad)
         return jnp.power(p, 1.0 / norm_type)
     return call_op(_lp, x)
+
+
+# -- max unpooling (reference: python/paddle/nn/functional/pooling.py
+# max_unpool1d/2d/3d over the return_mask indices) ---------------------------
+
+def _max_unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                   data_format, n):
+    """Scatter pooled values back to their argmax positions.  The mask
+    convention matches return_mask (and the paddle reference): flattened
+    spatial indices WITHIN each (N, C) plane of the pre-pool tensor."""
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    p = _padding(padding, n)
+    if isinstance(p, str):
+        raise ValueError("max_unpool: string padding unsupported")
+    if data_format.startswith("NC"):
+        N, C = x.shape[0], x.shape[1]
+        spatial_in = x.shape[2:2 + n]
+    else:
+        raise NotImplementedError("max_unpool: NHWC not supported")
+    if output_size is None:
+        out_spatial = tuple(
+            (spatial_in[i] - 1) * s[i] - 2 * p[i][0] + k[i]
+            for i in range(n))
+    else:
+        out_spatial = tuple(int(v) for v in output_size[-n:])
+    plane = int(np.prod(out_spatial))
+
+    def _unpool(v, idx):
+        v2 = v.reshape(N * C, -1)
+        idx2 = idx.reshape(N * C, -1).astype(jnp.int32)
+        flat = jnp.zeros((N * C, plane), v.dtype)
+        flat = jax.vmap(lambda f, i, val: f.at[i].set(val))(flat, idx2, v2)
+        return flat.reshape((N, C) + out_spatial)
+    return call_op(_unpool, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, data_format, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, data_format, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, data_format, 3)
+
+
+# -- fractional max pooling (reference: fractional_max_pool2d/3d; Graham
+# 2014 pseudo-random pooling regions) ----------------------------------------
+
+def _frac_boundaries(in_size, out_size, u):
+    """Static bin boundaries a_0..a_out from the random shift u (0,1):
+    a_i = ceil(alpha*(i+u)) - ceil(alpha*u) (disjoint regions)."""
+    import math as _m
+    alpha = in_size / out_size
+    base = _m.ceil(alpha * u)
+    bounds = [_m.ceil(alpha * (i + u)) - base for i in range(out_size + 1)]
+    bounds[-1] = max(bounds[-1], in_size)
+    return bounds
+
+
+def _fractional_max_pool_nd(x, output_size, kernel_size, random_u,
+                            return_mask, n):
+    x = ensure_tensor(x)
+    if random_u is None:
+        random_u = float(np.random.uniform(0.01, 0.99))
+    out_sz = _tuple(output_size, n)
+    spatial = x.shape[2:2 + n]
+    bounds = [_frac_boundaries(spatial[i], out_sz[i], random_u)
+              for i in range(n)]
+    k = _tuple(kernel_size, n) if kernel_size is not None else None
+
+    def _fmp(v):
+        import itertools
+        outs = jnp.zeros(v.shape[:2] + out_sz, v.dtype)
+        for pos in itertools.product(*(range(o) for o in out_sz)):
+            sl = [slice(None), slice(None)]
+            for d, i in enumerate(pos):
+                lo = bounds[d][i]
+                hi = lo + k[d] if k is not None else bounds[d][i + 1]
+                hi = min(max(hi, lo + 1), spatial[d])
+                sl.append(slice(lo, hi))
+            cell = v[tuple(sl)]
+            outs = outs.at[(slice(None), slice(None)) + pos].set(
+                cell.max(axis=tuple(range(2, 2 + n))))
+        return outs
+    out = call_op(_fmp, x)
+    if return_mask:
+        idx = call_op(lambda v: _frac_argmax(v, bounds, out_sz, k, n), x)
+        return out, idx
+    return out
+
+
+def _frac_argmax(v, bounds, out_sz, k, n):
+    import itertools
+    flat_idx = jnp.arange(int(np.prod(v.shape))).reshape(v.shape)
+    outs = jnp.zeros(v.shape[:2] + out_sz, jnp.int64)
+    spatial = v.shape[2:2 + n]
+    for pos in itertools.product(*(range(o) for o in out_sz)):
+        sl = [slice(None), slice(None)]
+        for d, i in enumerate(pos):
+            lo = bounds[d][i]
+            hi = lo + k[d] if k is not None else bounds[d][i + 1]
+            hi = min(max(hi, lo + 1), spatial[d])
+            sl.append(slice(lo, hi))
+        cell = v[tuple(sl)].reshape(v.shape[0], v.shape[1], -1)
+        ci = flat_idx[tuple(sl)].reshape(v.shape[0], v.shape[1], -1)
+        am = jnp.argmax(cell, axis=-1)
+        plane = int(np.prod(spatial))
+        outs = outs.at[(slice(None), slice(None)) + pos].set(
+            jnp.take_along_axis(ci, am[..., None], -1)[..., 0] % plane)
+    return outs
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool_nd(x, output_size, kernel_size, random_u,
+                                   return_mask, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool_nd(x, output_size, kernel_size, random_u,
+                                   return_mask, 3)

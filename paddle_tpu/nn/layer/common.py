@@ -428,3 +428,136 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, *self._args)
+
+
+class Unflatten(Layer):
+    """reference: paddle.nn.Unflatten — reshape one axis into a shape."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = tuple(shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import reshape
+        ax = self._axis if self._axis >= 0 else self._axis + x.ndim
+        new = tuple(x.shape[:ax]) + self._shape + tuple(x.shape[ax + 1:])
+        return reshape(x, new)
+
+
+class ChannelShuffle(Layer):
+    """reference: paddle.nn.ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PairwiseDistance(Layer):
+    """reference: paddle.nn.PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-06, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._eps, self._keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self._p, self._eps, self._keepdim)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._output_size,
+                                     self._return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size,
+                                     self._return_mask)
+
+
+class _MaxUnPoolNd(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+        self._data_format = data_format
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        k, s, p = self._args
+        return type(self)._fn(x, indices, k, s, p,
+                              data_format=self._data_format,
+                              output_size=self._output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    """reference: paddle.nn.MaxUnPool1D."""
+    _fn = staticmethod(F.max_unpool1d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    """reference: paddle.nn.MaxUnPool2D."""
+    _fn = staticmethod(F.max_unpool2d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    """reference: paddle.nn.MaxUnPool3D."""
+    _fn = staticmethod(F.max_unpool3d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
+
+class FractionalMaxPool2D(Layer):
+    """reference: paddle.nn.FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._a
+        return F.fractional_max_pool2d(x, o, k, u, m)
+
+
+class FractionalMaxPool3D(Layer):
+    """reference: paddle.nn.FractionalMaxPool3D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._a
+        return F.fractional_max_pool3d(x, o, k, u, m)

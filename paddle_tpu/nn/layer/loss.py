@@ -186,3 +186,74 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, **self._kw)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """reference: paddle.nn.TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._a
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, distance_function=d, margin=m,
+            swap=s, reduction=r)
+
+
+class RNNTLoss(Layer):
+    """reference: paddle.nn.RNNTLoss (warprnnt)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        b, f, r = self._a
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=b, fastemit_lambda=f, reduction=r)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: paddle.nn.AdaptiveLogSoftmaxWithLoss — hierarchical
+    softmax over frequency-sorted classes; returns (per-sample log-prob
+    of the target, mean loss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if any(c <= 0 or c >= n_classes for c in cutoffs) or \
+                sorted(set(cutoffs)) != cutoffs:
+            raise ValueError("cutoffs must be unique, ascending, in "
+                             "(0, n_classes)")
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        shortlist = cutoffs[0]
+        from ..initializer import XavierUniform
+        self.head_weight = self.create_parameter(
+            (in_features, shortlist + self.n_clusters),
+            default_initializer=XavierUniform())
+        self.head_bias = self.create_parameter(
+            (shortlist + self.n_clusters,), is_bias=True) \
+            if head_bias else None
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            h = max(1, int(in_features // (div_value ** (i + 1))))
+            n_i = self.cutoffs[i + 1] - self.cutoffs[i]
+            down = self.create_parameter(
+                (in_features, h), default_initializer=XavierUniform())
+            up = self.create_parameter(
+                (h, n_i), default_initializer=XavierUniform())
+            setattr(self, f"_tail_down_{i}", down)
+            setattr(self, f"_tail_up_{i}", up)
+            self.tail_weights.append((down, up))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight,
+            [list(p) for p in self.tail_weights], self.cutoffs,
+            head_bias=self.head_bias)

@@ -16,7 +16,7 @@ from ...framework.core import Tensor
 from ...framework.autograd import call_op
 from .layers import Layer, LayerList
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
            "LSTM", "GRU"]
 
 

@@ -125,3 +125,54 @@ def randn_like(x, dtype=None, name=None):
     x = ensure_tensor(x)
     d = dtypes.convert_dtype(dtype) or x.dtype
     return Tensor(jax.random.normal(next_key(), tuple(x.shape)).astype(d))
+
+
+def binomial(count, prob, name=None):
+    """reference: paddle.binomial — elementwise Binomial(count, prob)
+    samples (int64).  Exact trial summation up to count<=256 (bounded
+    O(256 x size) memory via a scan over trial chunks); larger counts
+    use the normal approximation (np >= ~77 at p=0.3 keeps the error
+    far below sampling noise)."""
+    count = ensure_tensor(count)
+    prob = ensure_tensor(prob)
+    n = jnp.asarray(count._value)
+    p = jnp.asarray(prob._value, jnp.float32)
+    shape = jnp.broadcast_shapes(n.shape, p.shape)
+    n_b = jnp.broadcast_to(n, shape).astype(jnp.int32)
+    p_b = jnp.broadcast_to(p, shape)
+    n_max = int(jnp.max(n_b)) if n_b.size else 0
+    if n_max <= 256:
+        chunk = max(n_max, 1)
+        u = jax.random.uniform(next_key(), (chunk,) + tuple(shape))
+        trials = (u < p_b[None]).astype(jnp.int64)
+        live = jnp.arange(chunk)[(...,) + (None,) * len(shape)] < n_b
+        return Tensor(jnp.sum(jnp.where(live, trials, 0), axis=0))
+    g = jax.random.normal(next_key(), tuple(shape))
+    mean = n_b * p_b
+    std = jnp.sqrt(jnp.maximum(n_b * p_b * (1.0 - p_b), 1e-12))
+    samp = jnp.round(mean + std * g)
+    return Tensor(jnp.clip(samp, 0, n_b).astype(jnp.int64))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """reference: paddle.log_normal — exp(Normal(mean, std))."""
+    if hasattr(mean, "_value") or hasattr(std, "_value") or shape is None:
+        m = ensure_tensor(mean)._value if hasattr(mean, "_value") else mean
+        s = ensure_tensor(std)._value if hasattr(std, "_value") else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s)) \
+            if shape is None else _shape(shape)
+        return Tensor(jnp.exp(
+            m + s * jax.random.normal(next_key(), shp)))
+    return Tensor(jnp.exp(mean + std * jax.random.normal(
+        next_key(), _shape(shape), dtype=dtypes.get_default_dtype())))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """reference: paddle.Tensor.cauchy_ — fill in-place with Cauchy
+    samples (inverse-CDF over uniform)."""
+    x = ensure_tensor(x)
+    u = jax.random.uniform(next_key(), tuple(x.shape), minval=1e-7,
+                           maxval=1.0 - 1e-7)
+    x._value = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(
+        x._value.dtype)
+    return x

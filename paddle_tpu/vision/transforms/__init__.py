@@ -12,7 +12,7 @@ __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "to_tensor", "normalize", "resize", "hflip", "vflip",
            "ContrastTransform", "SaturationTransform", "HueTransform",
            "ColorJitter", "Grayscale", "RandomResizedCrop", "RandomErasing",
-           "RandomAffine", "crop", "center_crop", "adjust_brightness",
+           "RandomAffine", "RandomPerspective", "perspective", "crop", "center_crop", "adjust_brightness",
            "adjust_contrast", "adjust_saturation", "adjust_hue",
            "to_grayscale", "erase", "rotate"]
 
@@ -530,3 +530,75 @@ class RandomAffine(BaseTransform):
                      * h)
             arr = np.roll(np.roll(arr, ty, axis=0), tx, axis=1)
         return arr
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference: paddle.vision.transforms.perspective — warp the image so
+    ``startpoints`` (4 corner [x, y] pairs) map onto ``endpoints``.
+    Solves the 8-dof homography and samples via F.grid_sample."""
+    import jax.numpy as jnp
+    from ...nn.functional import grid_sample
+    arr = _np_img(img).astype("float32")
+    h, w = arr.shape[:2]
+    # homography coeffs a..h from 4 point pairs (standard 8x8 system):
+    # maps OUTPUT (end) coords back to INPUT (start) coords for sampling
+    A, b = [], []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coef = np.linalg.solve(np.asarray(A, "f8"), np.asarray(b, "f8"))
+    a_, b_, c_, d_, e_, f_, g_, h_ = coef
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = g_ * xs + h_ * ys + 1.0
+    src_x = (a_ * xs + b_ * ys + c_) / den
+    src_y = (d_ * xs + e_ * ys + f_) / den
+    # normalize to [-1, 1] grid for grid_sample (align_corners=True)
+    gx = 2.0 * src_x / max(w - 1, 1) - 1.0
+    gy = 2.0 * src_y / max(h - 1, 1) - 1.0
+    grid = np.stack([gx, gy], -1)[None].astype("f4")
+    chw = np.moveaxis(arr if arr.ndim == 3 else arr[..., None], -1, 0)
+    out = grid_sample(
+        jnp.asarray(chw[None]), jnp.asarray(grid),
+        mode="bilinear" if interpolation == "bilinear" else "nearest",
+        padding_mode="zeros", align_corners=True)
+    res = np.moveaxis(np.asarray(out._value[0]), 0, -1)
+    if fill:
+        # out-of-bounds region: sample a ones-mask; where coverage < 1
+        # blend toward the fill color (paddle fill semantics)
+        ones = np.ones_like(chw[:1])
+        cov = grid_sample(jnp.asarray(ones[None]), jnp.asarray(grid),
+                          mode="bilinear" if interpolation == "bilinear"
+                          else "nearest", padding_mode="zeros",
+                          align_corners=True)
+        cov = np.asarray(cov._value[0, 0])[..., None]
+        res = res + (1.0 - cov) * np.asarray(fill, "f4")
+    return res if arr.ndim == 3 else res[..., 0]
+
+
+class RandomPerspective(BaseTransform):
+    """reference: paddle.vision.transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return _np_img(img)
+        arr = _np_img(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_w, half_h = int(d * w / 2), int(d * h / 2)
+
+        def jitter(x, y, dx, dy):
+            return [x + np.random.randint(0, max(dx, 1)) * np.sign(w / 2 - x - 0.1),
+                    y + np.random.randint(0, max(dy, 1)) * np.sign(h / 2 - y - 0.1)]
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [jitter(x, y, half_w, half_h) for x, y in start]
+        return perspective(arr, start, end, self.interpolation, self.fill)
