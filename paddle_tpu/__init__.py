@@ -96,3 +96,64 @@ def get_cudnn_version():
 
 
 from . import version  # noqa: F401,E402
+
+
+def iinfo(dtype):
+    """reference: paddle.iinfo."""
+    import numpy as _np
+    return _np.iinfo(_np.dtype(str(_dtypes.convert_dtype(dtype))))
+
+
+def finfo(dtype):
+    """reference: paddle.finfo."""
+    import jax.numpy as _jnp
+    return _jnp.finfo(_dtypes.convert_dtype(dtype))
+
+
+# CUDA-named RNG state entry points map to the device-agnostic RNG
+# (reference: get/set_cuda_rng_state; one RNG stream here)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """reference: paddle.flops — model FLOPs for one forward pass.
+
+    TPU-native: instead of the reference's per-layer-type FLOPs table,
+    trace the ACTUAL forward with jax and read XLA's compiled cost
+    analysis — counts every op the compiler will run, including fusions
+    the table-based counter cannot see."""
+    import numpy as _np
+    import jax
+    import jax.numpy as _jnp
+    from .framework import autograd as _ag
+    from .framework.random import rng_scope
+
+    x = _jnp.zeros(tuple(input_size), _jnp.float32)
+    params = [p for _, p in net.named_parameters()]
+    vals = [p._value for p in params]
+
+    def fwd(pv, xv):
+        olds = [p._value for p in params]
+        for p, v in zip(params, pv):
+            p._value = v
+        try:
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                out = net(Tensor(xv))
+            return out._value if hasattr(out, "_value") else out
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+
+    try:
+        cost = jax.jit(fwd).lower(vals, x).compile().cost_analysis()
+        total = int(cost.get("flops", 0)) if cost else 0
+    except Exception:
+        total = 0
+    if print_detail:
+        import builtins
+        # NB: plain `sum` here would resolve to paddle.sum (the tensor
+        # reduce op star-exported into this module)
+        n_params = builtins.sum(int(_np.prod(p.shape)) for p in params)
+        print(f"Total Flops: {total}     Total Params: {n_params}")
+    return total

@@ -221,3 +221,48 @@ class GradScaler:
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(device=None):
+    """reference: paddle.amp.is_bfloat16_supported — always on TPU (the
+    MXU's native dtype)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """reference: paddle.amp.is_float16_supported — fp16 compute exists
+    on TPU but bf16 is preferred (no loss-scaling needed)."""
+    return True
+
+
+class debugging:
+    """paddle.amp.debugging subset (reference:
+    python/paddle/amp/debugging.py)."""
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="",
+                       debug_mode=None):
+        """NaN/Inf check on a tensor; raises on hit (the reference's
+        check_numerics op semantics)."""
+        import jax.numpy as jnp
+        from ..framework.core import Tensor
+        v = tensor._value if isinstance(tensor, Tensor) else tensor
+        import numpy as np
+        arr = np.asarray(v)
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"check_numerics: {op_type}/{var_name}: {n_nan} NaN, "
+                f"{n_inf} Inf")
+        return tensor
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        from ..framework.flags import set_flags
+        set_flags({"FLAGS_check_nan_inf": True})
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        from ..framework.flags import set_flags
+        set_flags({"FLAGS_check_nan_inf": False})

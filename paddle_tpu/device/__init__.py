@@ -97,3 +97,84 @@ class tpu:
     memory_allocated = staticmethod(_CudaNamespace.memory_allocated)
     max_memory_allocated = staticmethod(_CudaNamespace.max_memory_allocated)
     synchronize = staticmethod(_CudaNamespace.synchronize)
+
+
+def get_all_device_type():
+    """reference: paddle.device.get_all_device_type."""
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def get_cudnn_version():
+    return None
+
+
+# -- stream/event surface (reference: paddle.device.Stream/Event) -----------
+# PJRT/XLA own scheduling on TPU: one compiled program per device, no
+# user-visible streams.  The API class exists for parity; synchronize is
+# the only operation with real semantics (device barrier).
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_CURRENT_STREAM = Stream()
+
+
+def current_stream(device=None):
+    return _CURRENT_STREAM
+
+
+def set_stream(stream):
+    global _CURRENT_STREAM
+    prev = _CURRENT_STREAM
+    _CURRENT_STREAM = stream
+    return prev
+
+
+from contextlib import contextmanager as _ctx
+
+
+@_ctx
+def stream_guard(stream):
+    prev = set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(prev)

@@ -342,3 +342,26 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
                     "steps": steps, "verbose": verbose,
                     "metrics": metrics or []})
     return lst
+
+
+class WandbCallback(Callback):
+    """reference: paddle.callbacks.WandbCallback — logs metrics to
+    Weights & Biases.  Gated on the wandb package (not bundled here)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the wandb package") from e
+        self.wandb = wandb
+        self._run = wandb.init(project=project, entity=entity, name=name,
+                               dir=dir, mode=mode, job_type=job_type,
+                               **kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._run.log(dict(logs or {}, epoch=epoch))
+
+    def on_train_end(self, logs=None):
+        self._run.finish()

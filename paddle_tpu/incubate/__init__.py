@@ -1,7 +1,11 @@
 """Incubating APIs (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import optimizer  # noqa: F401
 from .nn.functional import flash_attention  # noqa: F401
+from .ops import (segment_sum, segment_mean, segment_max,  # noqa: F401
+                  segment_min, graph_send_recv, softmax_mask_fuse,
+                  softmax_mask_fuse_upper_triangle, identity_loss)
 
 
 class autograd:

@@ -1,0 +1,144 @@
+"""Incubate tensor/graph ops (reference: python/paddle/incubate/
+{tensor,operators}/ — segment pooling, graph message passing, fused
+masked softmax, identity_loss).
+
+TPU-native: segment reductions are ``jax.ops.segment_*`` (XLA scatter
+reductions, fully differentiable); graph_send_recv composes a gather
+with a segment reduce — the same math the reference's CUDA
+graph_send_recv kernel fuses.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.autograd import call_op
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "identity_loss"]
+
+
+def _empty_fill(out, ids, num, dtype):
+    """Empty segments: jax fills +/-identity (inf or INT_MIN/MAX); the
+    reference fills 0 — detect via counts, preserve the input dtype."""
+    cnt = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.int32), ids,
+                              num_segments=num)
+    shape = (num,) + (1,) * (out.ndim - 1)
+    return jnp.where(cnt.reshape(shape) > 0, out,
+                     jnp.zeros((), dtype))
+
+
+def _segment(op_name, data, segment_ids):
+    data = ensure_tensor(data)
+    segment_ids = ensure_tensor(segment_ids)
+    ids = segment_ids._value.astype(jnp.int32)
+    num = int(ids.max()) + 1 if ids.size else 0
+
+    def _seg(v):
+        fn = getattr(jax.ops, f"segment_{op_name}")
+        out = fn(v, ids, num_segments=num)
+        if op_name in ("max", "min"):
+            out = _empty_fill(out, ids, num, v.dtype)
+        return out
+    return call_op(_seg, data)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference: paddle.incubate.segment_sum."""
+    return _segment("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    """reference: paddle.incubate.segment_mean."""
+    data = ensure_tensor(data)
+    segment_ids = ensure_tensor(segment_ids)
+    ids = segment_ids._value.astype(jnp.int32)
+    num = int(ids.max()) + 1 if ids.size else 0
+
+    def _mean(v):
+        s = jax.ops.segment_sum(v, ids, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones((v.shape[0],), v.dtype), ids,
+                                  num_segments=num)
+        shape = (num,) + (1,) * (v.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1.0)
+    return call_op(_mean, data)
+
+
+def segment_max(data, segment_ids, name=None):
+    """reference: paddle.incubate.segment_max."""
+    return _segment("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    """reference: paddle.incubate.segment_min."""
+    return _segment("min", data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """reference: paddle.incubate.graph_send_recv (a.k.a.
+    geometric.send_u_recv): gather x rows at src_index, reduce them at
+    dst_index.  gather + segment-reduce; XLA fuses the pair."""
+    x = ensure_tensor(x)
+    src = ensure_tensor(src_index)._value.astype(jnp.int32)
+    dst = ensure_tensor(dst_index)._value.astype(jnp.int32)
+    pool = pool_type.lower()
+    n_out = int(out_size) if out_size is not None else None
+
+    def _gsr(v):
+        num = n_out if n_out is not None else v.shape[0]
+        msgs = jnp.take(v, src, axis=0)
+        if pool == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=num)
+        if pool == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), v.dtype), dst,
+                num_segments=num)
+            return s / jnp.maximum(
+                cnt.reshape((num,) + (1,) * (v.ndim - 1)), 1.0)
+        if pool == "max":
+            out = jax.ops.segment_max(msgs, dst, num_segments=num)
+            return _empty_fill(out, dst, num, v.dtype)
+        if pool == "min":
+            out = jax.ops.segment_min(msgs, dst, num_segments=num)
+            return _empty_fill(out, dst, num, v.dtype)
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return call_op(_gsr, x)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: paddle.incubate.softmax_mask_fuse — softmax(x + mask)
+    in one pass (the reference fuses the CUDA kernels; XLA fuses the add
+    into the softmax here)."""
+    x = ensure_tensor(x)
+    mask = ensure_tensor(mask)
+    return call_op(lambda a, m: jax.nn.softmax(
+        a.astype(jnp.float32) + m.astype(jnp.float32), axis=-1
+    ).astype(a.dtype), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: paddle.incubate.softmax_mask_fuse_upper_triangle —
+    causal-masked softmax over the last two axes."""
+    x = ensure_tensor(x)
+
+    def _smfu(a):
+        S = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], S), bool))
+        s = jnp.where(mask, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(s, axis=-1).astype(a.dtype)
+    return call_op(_smfu, x)
+
+
+def identity_loss(x, reduction="none"):
+    """reference: paddle.incubate.identity_loss — mark a value as the
+    loss (IPU pipeline hint in the reference; here just the reduction)."""
+    x = ensure_tensor(x)
+    red = {0: "sum", 1: "mean", 2: "none", "sum": "sum", "mean": "mean",
+           "none": "none"}[reduction]
+    if red == "sum":
+        return x.sum()
+    if red == "mean":
+        return x.mean()
+    return x

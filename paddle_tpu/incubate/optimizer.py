@@ -1,0 +1,115 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+LookAhead, ModelAverage wrappers).
+
+TPU-native: both are pytree transforms over the inner optimizer's
+params — slow/averaged copies live as host-side jnp arrays updated on
+the step cadence, no special kernels needed.
+"""
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """reference: incubate.optimizer.LookAhead (Zhang et al. 2019):
+    every k steps, slow weights move alpha of the way toward the fast
+    (inner-optimizer) weights and the fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+
+    # delegate the Optimizer surface to the inner optimizer
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        self.inner.step()
+        self._steps += 1
+        params = self.inner._parameter_list or []
+        if self._steps % self.k:
+            return
+        for p in params:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._value
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        sd = self.inner.state_dict()
+        sd["lookahead_step"] = self._steps
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._steps = int(state_dict.pop("lookahead_step", 0))
+        self.inner.set_state_dict(state_dict)
+
+
+class ModelAverage(Optimizer):
+    """reference: incubate.optimizer.ModelAverage: maintain a running
+    average of parameters; ``apply()`` swaps it in for evaluation,
+    ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(parameters=parameters)
+        self._sum = {}
+        self._cnt = {}
+        self._backup = None
+        self._max_window = int(max_average_window)
+
+    def step(self):
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            k = id(p)
+            if k not in self._sum or self._cnt[k] >= self._max_window:
+                self._sum[k] = jnp.zeros_like(p._value)
+                self._cnt[k] = 0
+            self._sum[k] = self._sum[k] + p._value
+            self._cnt[k] += 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {}
+        for p in self._parameter_list or []:
+            k = id(p)
+            if k in self._sum and self._cnt[k]:
+                self._backup[k] = p._value
+                p._value = (self._sum[k] / self._cnt[k]).astype(
+                    p._value.dtype)
+        if not need_restore:
+            self._backup = None
+        return _SwapCtx(self)
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameter_list or []:
+                k = id(p)
+                if k in self._backup:
+                    p._value = self._backup[k]
+        self._backup = None
+
+
+class _SwapCtx:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False

@@ -81,8 +81,15 @@ def _max_pool_nd(x, kernel_size, stride, padding, ceil_mode, return_mask,
         # batch/channel layout and valid for any output_size)
         spatial = _spatial_sizes(x, n, data_format)
         plane = int(np.prod(spatial))
-        idx = call_op(lambda v: _argmax_pool(v, dims, strides, pad)
-                      % plane, x)
+        if data_format.startswith("NC"):
+            # flat = ((n*C + c)*plane + spatial_idx)
+            conv = lambda g: g % plane
+        else:
+            # channels-last: flat = (n*plane + spatial_idx)*C + c
+            C = x.shape[-1]
+            conv = lambda g: (g // C) % plane
+        idx = call_op(lambda v: conv(_argmax_pool(v, dims, strides, pad)),
+                      x)
         return out, idx
     return out
 

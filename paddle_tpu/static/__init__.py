@@ -367,3 +367,195 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 # imported last: static.nn pulls in jit.dy2static, which imports back into
 # this (by then fully-populated) module for InputSpec
 from . import nn  # noqa: E402
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: paddle.static.gradients — static autodiff from targets
+    to inputs.  TPU-native: the Program is an op tape over jax.vjp
+    nodes, so static gradients ARE the eager tape's gradients — delegate
+    to autograd.grad on the recorded tensors (the reference's
+    append_backward grad-op construction is jax.vjp here)."""
+    from ..autograd import grad as _grad
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gt = target_gradients
+    if gt is not None and not isinstance(gt, (list, tuple)):
+        gt = [gt]
+    outs = _grad(targets, inputs, grad_outputs=gt, allow_unused=True,
+                 retain_graph=True)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: paddle.static.append_backward — build grads for every
+    trainable param reachable from ``loss`` and return (param, grad)
+    pairs.  Delegates to the tape (see gradients())."""
+    prog = default_main_program()
+    if parameter_list is None:
+        seen, parameter_list = set(), []
+        for op in getattr(prog, "ops", []):
+            for t in op[1]:
+                if getattr(t, "is_parameter", False) and \
+                        not t.stop_gradient and id(t) not in seen:
+                    seen.add(id(t))
+                    parameter_list.append(t)
+    if not parameter_list:
+        return []
+    gs = gradients([loss], list(parameter_list))
+    return [(p, g) for p, g in zip(parameter_list, gs) if g is not None]
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """reference: paddle.static.py_func — host-side python op inside the
+    graph.  TPU-native: jax.pure_callback (runs on host, shape-checked
+    against ``out``).  ``backward_func(*inputs, *out_grads) -> in_grads``
+    registers a custom vjp (also a host callback); without it the op is
+    non-differentiable (pure_callback has no autodiff rule)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    from ..framework.autograd import call_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+          for t in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), jnp.dtype(
+        o.dtype if isinstance(o.dtype, str) else o._value.dtype))
+        for o in outs]
+    single = not isinstance(out, (list, tuple))
+
+    def _host(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r) for r in res]
+
+    def _fwd_impl(*vals):
+        res = jax.pure_callback(
+            _host, shapes if not single else shapes[:1], *vals)
+        return res[0] if single else tuple(res)
+
+    if backward_func is None:
+        return call_op(_fwd_impl, *xs)
+
+    in_shapes = [jax.ShapeDtypeStruct(tuple(t._value.shape),
+                                      t._value.dtype) for t in xs]
+
+    @jax.custom_vjp
+    def _op(*vals):
+        return _fwd_impl(*vals)
+
+    def _op_fwd(*vals):
+        return _fwd_impl(*vals), vals
+
+    def _op_bwd(res_vals, g):
+        gs = [g] if single else list(g)
+
+        def _host_bwd(*vals_and_grads):
+            arrs = [np.asarray(v) for v in vals_and_grads]
+            grads = backward_func(*arrs)
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            return [np.asarray(gr) for gr in grads]
+        return tuple(jax.pure_callback(_host_bwd, in_shapes,
+                                       *res_vals, *gs))
+
+    _op.defvjp(_op_fwd, _op_bwd)
+    return call_op(lambda *vals: _op(*vals), *xs)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: paddle.static.create_parameter."""
+    from ..framework.core import Tensor
+    from ..framework import dtypes as _dt
+    from ..nn.initializer import XavierUniform, Constant
+    init = default_initializer
+    if attr is not None and attr is not False:
+        init = getattr(attr, "initializer", None) or init
+        name = getattr(attr, "name", None) or name
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierUniform()
+    d = _dt.convert_dtype(dtype)
+    value = init(tuple(int(s) for s in shape), d)
+    p = Tensor(value, stop_gradient=False)
+    p.is_parameter = True
+    p.name = name
+    return p
+
+
+class ExponentialMovingAverage:
+    """reference: paddle.static.ExponentialMovingAverage — shadow
+    parameters theta_ema = decay * theta_ema + (1 - decay) * theta with
+    apply()/restore() swap (the evaluation-time EMA trick)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema = {}
+        self._backup = None
+        self._params = []
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            k = id(p)
+            prev = self._ema.get(k)
+            self._ema[k] = p._value if prev is None else \
+                self._decay * prev + (1.0 - self._decay) * p._value
+        return self
+
+    def apply(self, executor=None, need_restore=True):
+        from ..incubate.optimizer import _SwapCtx
+        self._backup = {}
+        for p in self._params:
+            k = id(p)
+            if k in self._ema:
+                self._backup[k] = p._value
+                p._value = self._ema[k].astype(p._value.dtype)
+        if not need_restore:
+            self._backup = None
+        return _SwapCtx(self)
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params:
+                k = id(p)
+                if k in self._backup:
+                    p._value = self._backup[k]
+        self._backup = None
+
+
+from contextlib import contextmanager as _ctxmgr
+
+
+@_ctxmgr
+def device_guard(device=None):
+    """reference: paddle.static.device_guard — op device placement hint.
+    XLA owns placement on TPU (one device per program shard); the guard
+    is accepted and ignored."""
+    yield
+
+
+class WeightNormParamAttr:
+    """reference: paddle.static.WeightNormParamAttr — ParamAttr marking
+    a weight for weight normalization; layers consume it by wrapping
+    themselves with nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+__all__ += ["gradients", "append_backward", "py_func", "create_parameter",
+            "ExponentialMovingAverage", "device_guard",
+            "WeightNormParamAttr"]

@@ -153,3 +153,113 @@ for _name, _fn in _METHODS.items():
 
 def _item_method(self, *args):
     return self._value.item(*args)
+
+
+# -- in-place method family (reference: paddle.Tensor.*_ methods) -----------
+# TPU-native in-place = rebind the facade's value/graph node to the
+# out-of-place result (jax arrays are immutable); the tape keeps flowing
+# because the rebind carries the producing node, the same seam the
+# collective in-place ops use.
+
+def _rebind(dst, src):
+    dst._value = src._value
+    dst._node = src._node
+    dst._out_idx = src._out_idx
+    dst.stop_gradient = src.stop_gradient
+    return dst
+
+
+def _inplace(fn):
+    def method(self, *args, **kwargs):
+        # run the op against a SHADOW facade holding the old producing
+        # node, so the recorded tape edge does not alias the mutated
+        # output (grads keep flowing through the pre-mutation graph);
+        # like paddle, gradient accumulation targets non-leaf history
+        shadow = Tensor(self._value, stop_gradient=self.stop_gradient)
+        shadow._node = self._node
+        shadow._out_idx = self._out_idx
+        return _rebind(self, fn(shadow, *args, **kwargs))
+    return method
+
+
+Tensor.add_ = _inplace(_math.add)
+Tensor.subtract_ = _inplace(_math.subtract)
+Tensor.multiply_ = _inplace(_math.multiply)
+Tensor.scale_ = _inplace(_math.scale)
+Tensor.clip_ = _inplace(_math.clip)
+Tensor.floor_ = _inplace(_math.floor)
+Tensor.ceil_ = _inplace(_math.ceil)
+Tensor.exp_ = _inplace(_math.exp)
+Tensor.sqrt_ = _inplace(_math.sqrt)
+Tensor.rsqrt_ = _inplace(_math.rsqrt)
+Tensor.round_ = _inplace(_math.round)
+Tensor.reciprocal_ = _inplace(_math.reciprocal)
+
+
+def _zero_(self):
+    self._value = jnp.zeros_like(self._value)
+    self._node = None
+    return self
+
+
+def _fill_(self, value):
+    self._value = jnp.full_like(self._value, value)
+    self._node = None
+    return self
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+    from ..framework.random import next_key
+    import jax
+    self._value = jax.random.uniform(
+        next_key(), tuple(self.shape), minval=min, maxval=max
+    ).astype(self._value.dtype)
+    self._node = None
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0, shape=None, name=None):
+    from ..framework.random import next_key
+    import jax
+    self._value = (mean + std * jax.random.normal(
+        next_key(), tuple(self.shape))).astype(self._value.dtype)
+    self._node = None
+    return self
+
+
+def _exponential_(self, lam=1.0, name=None):
+    from ..framework.random import next_key
+    import jax
+    u = jax.random.uniform(next_key(), tuple(self.shape),
+                           minval=1e-7, maxval=1.0)
+    self._value = (-jnp.log(u) / lam).astype(self._value.dtype)
+    self._node = None
+    return self
+
+
+def _cauchy_method(self, loc=0, scale=1, name=None):
+    from .random import cauchy_ as _c
+    return _c(self, loc=loc, scale=scale)
+
+
+def _detach_(self):
+    self._node = None
+    self.stop_gradient = True
+    return self
+
+
+def _element_size(self):
+    return int(jnp.dtype(self._value.dtype).itemsize)
+
+
+Tensor.zero_ = _zero_
+Tensor.fill_ = _fill_
+Tensor.uniform_ = _uniform_
+Tensor.normal_ = _normal_
+Tensor.exponential_ = _exponential_
+Tensor.cauchy_ = _cauchy_method
+Tensor.detach_ = _detach_
+Tensor.element_size = _element_size
+Tensor.nbytes = property(
+    lambda self: int(self._value.size
+                     * jnp.dtype(self._value.dtype).itemsize))

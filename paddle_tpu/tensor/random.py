@@ -129,10 +129,11 @@ def randn_like(x, dtype=None, name=None):
 
 def binomial(count, prob, name=None):
     """reference: paddle.binomial — elementwise Binomial(count, prob)
-    samples (int64).  Exact trial summation up to count<=256 (bounded
-    O(256 x size) memory via a scan over trial chunks); larger counts
-    use the normal approximation (np >= ~77 at p=0.3 keeps the error
-    far below sampling noise)."""
+    samples (int64).  Exact trial summation up to count<=256 as a
+    lax.scan over single trials (O(size) memory); larger counts use the
+    normal approximation (np >= ~77 at p=0.3 keeps the error far below
+    sampling noise)."""
+    from jax import lax
     count = ensure_tensor(count)
     prob = ensure_tensor(prob)
     n = jnp.asarray(count._value)
@@ -142,11 +143,16 @@ def binomial(count, prob, name=None):
     p_b = jnp.broadcast_to(p, shape)
     n_max = int(jnp.max(n_b)) if n_b.size else 0
     if n_max <= 256:
-        chunk = max(n_max, 1)
-        u = jax.random.uniform(next_key(), (chunk,) + tuple(shape))
-        trials = (u < p_b[None]).astype(jnp.int64)
-        live = jnp.arange(chunk)[(...,) + (None,) * len(shape)] < n_b
-        return Tensor(jnp.sum(jnp.where(live, trials, 0), axis=0))
+        keys = jax.random.split(next_key(), max(n_max, 1))
+
+        def body(carry, key):
+            acc, i = carry
+            u = jax.random.uniform(key, tuple(shape))
+            acc = acc + ((u < p_b) & (i < n_b)).astype(jnp.int64)
+            return (acc, i + 1), None
+        (acc, _), _ = lax.scan(
+            body, (jnp.zeros(shape, jnp.int64), jnp.int32(0)), keys)
+        return Tensor(acc)
     g = jax.random.normal(next_key(), tuple(shape))
     mean = n_b * p_b
     std = jnp.sqrt(jnp.maximum(n_b * p_b * (1.0 - p_b), 1e-12))

@@ -601,4 +601,5 @@ class RandomPerspective(BaseTransform):
                     y + np.random.randint(0, max(dy, 1)) * np.sign(h / 2 - y - 0.1)]
         start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
         end = [jitter(x, y, half_w, half_h) for x, y in start]
-        return perspective(arr, start, end, self.interpolation, self.fill)
+        out = perspective(arr, start, end, self.interpolation, self.fill)
+        return out.astype(arr.dtype)   # dtype-stable across the prob draw

@@ -249,3 +249,167 @@ def test_triplet_with_distance_grads_flow():
     loss.backward()
     assert a.grad is not None and p.grad is not None and n.grad is not None
     assert np.abs(a.grad.numpy()).sum() > 0
+
+
+def test_tensor_inplace_methods_r3():
+    """In-place Tensor method family (reference: paddle.Tensor.*_):
+    rebind semantics keep the autograd tape intact."""
+    x = paddle.to_tensor(np.ones((2,), "f4"), stop_gradient=False)
+    y = x * 3.0
+    y.add_(paddle.to_tensor(np.ones((2,), "f4")))
+    y.scale_(2.0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    t = paddle.to_tensor(np.ones((2, 2), "f4"))
+    t.fill_(5.0)
+    assert (t.numpy() == 5.0).all()
+    t.zero_()
+    assert (t.numpy() == 0.0).all()
+    t.uniform_(0.0, 1.0)
+    assert ((t.numpy() >= 0) & (t.numpy() <= 1)).all()
+    assert t.element_size() == 4 and t.nbytes == 16
+    t.detach_()
+    assert t.stop_gradient
+
+
+def test_incubate_segment_and_graph_ops():
+    import paddle_tpu.incubate as inc
+    x = paddle.to_tensor(np.asarray([[1., 2.], [3., 4.], [5., 6.]], "f4"),
+                         stop_gradient=False)
+    ids = paddle.to_tensor(np.asarray([0, 0, 1], "i4"))
+    s = inc.segment_sum(x, ids)
+    np.testing.assert_allclose(s.numpy(), [[4., 6.], [5., 6.]])
+    np.testing.assert_allclose(inc.segment_mean(x, ids).numpy(),
+                               [[2., 3.], [5., 6.]])
+    np.testing.assert_allclose(inc.segment_max(x, ids).numpy(),
+                               [[3., 4.], [5., 6.]])
+    np.testing.assert_allclose(inc.segment_min(x, ids).numpy(),
+                               [[1., 2.], [5., 6.]])
+    # differentiable
+    s.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 2)))
+    out = inc.graph_send_recv(
+        x, paddle.to_tensor(np.asarray([0, 1, 2], "i4")),
+        paddle.to_tensor(np.asarray([1, 1, 0], "i4")), "mean")
+    np.testing.assert_allclose(out.numpy(), [[5., 6.], [2., 3.], [0., 0.]])
+    m = inc.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(np.zeros((1, 1, 4, 4), "f4")))
+    np.testing.assert_allclose(m.numpy()[0, 0, 0], [1, 0, 0, 0], atol=1e-6)
+    assert float(inc.identity_loss(x, "mean")) == pytest.approx(3.5)
+
+
+def test_incubate_lookahead_and_model_average():
+    from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    w0 = lin.weight.numpy().copy()
+    for _ in range(2):
+        loss = lin(paddle.ones([2, 4])).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k steps the weight is slow + alpha*(fast - slow)
+    assert not np.allclose(lin.weight.numpy(), w0)
+    ma = ModelAverage(parameters=lin.parameters())
+    v1 = lin.weight.numpy().copy()
+    ma.step()
+    lin.weight._value = lin.weight._value + 1.0
+    ma.step()
+    with ma.apply():
+        np.testing.assert_allclose(lin.weight.numpy(), v1 + 0.5,
+                                   rtol=1e-6)
+    np.testing.assert_allclose(lin.weight.numpy(), v1 + 1.0, rtol=1e-6)
+
+
+def test_static_extras_r3():
+    import paddle_tpu.static as static
+    x = paddle.to_tensor(np.asarray([3.0], "f4"), stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = static.gradients([y], [x])
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    r = static.py_func(lambda a: a * 2 + 1,
+                       paddle.to_tensor(np.asarray([1., 2.], "f4")),
+                       paddle.zeros([2]))
+    np.testing.assert_allclose(r.numpy(), [3., 5.])
+    p = static.create_parameter([2, 2], "float32")
+    assert not p.stop_gradient and p.is_parameter
+    ema = static.ExponentialMovingAverage(0.5)
+    p._value = jnp.ones((2, 2))
+    ema.update([p])
+    p._value = jnp.full((2, 2), 3.0)
+    ema.update([p])
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), np.full((2, 2), 2.0))
+    np.testing.assert_allclose(p.numpy(), np.full((2, 2), 3.0))
+    attr = static.WeightNormParamAttr(dim=0)
+    assert attr.dim == 0
+
+
+def test_misc_surface_r3():
+    """iinfo/finfo/flops/rng aliases/amp queries/device stream shims."""
+    assert paddle.iinfo("int32").max == 2**31 - 1
+    assert paddle.finfo("bfloat16").max > 3e38
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    import paddle_tpu.amp as amp
+    assert amp.is_bfloat16_supported() and amp.is_float16_supported()
+    amp.debugging.check_numerics(paddle.to_tensor(np.ones(3, "f4")))
+    with pytest.raises(FloatingPointError):
+        amp.debugging.check_numerics(
+            paddle.to_tensor(np.asarray([np.inf], "f4")))
+    import paddle_tpu.device as device
+    s = device.Stream()
+    s.synchronize()
+    with device.stream_guard(s):
+        assert device.current_stream() is s
+    assert "cpu" in device.get_all_device_type() or \
+        "tpu" in device.get_all_device_type()
+
+
+def test_flops_via_cost_analysis():
+    """paddle.flops reads XLA's compiled cost analysis; LeNet@28x28 is
+    ~0.7 MFLOP/img at batch 1 (conv+fc macs x2)."""
+    from paddle_tpu.vision.models import LeNet
+    fl = paddle.flops(LeNet(), [1, 1, 28, 28])
+    assert 3e5 < fl < 3e6, fl
+
+
+def test_review_fixes_r3b():
+    """Review follow-ups: NHWC mask indices, int segment dtype,
+    create_parameter init, py_func backward, dtype-stable perspective."""
+    import paddle_tpu.static as static
+    import paddle_tpu.incubate as inc
+    # create_parameter must NOT be all zeros (Xavier init applied)
+    p = static.create_parameter([16, 16], "float32")
+    assert np.abs(p.numpy()).sum() > 0
+    # NHWC mask: spatial index must exclude the channel stride
+    x = np.zeros((1, 2, 2, 2), "f4")      # NHWC
+    x[0, 1, 1, 0] = 5.0                    # ch0 max at spatial (1,1) -> 3
+    x[0, 0, 0, 1] = 7.0                    # ch1 max at spatial (0,0) -> 0
+    _, idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                          return_mask=True, data_format="NHWC")
+    assert sorted(idx.numpy().reshape(-1).tolist()) == [0, 3]
+    # int segments keep dtype; empty segments fill 0
+    xi = paddle.to_tensor(np.asarray([[4], [2]], "i4"))
+    ids = paddle.to_tensor(np.asarray([0, 2], "i4"))   # segment 1 empty
+    out = inc.segment_max(xi, ids)
+    assert str(out.dtype).endswith("int32"), out.dtype
+    np.testing.assert_array_equal(out.numpy(), [[4], [0], [2]])
+    # py_func custom backward
+    r = static.py_func(lambda a: a * 2,
+                       paddle.to_tensor(np.asarray([1., 2.], "f4"),
+                                        stop_gradient=False),
+                       paddle.zeros([2]),
+                       backward_func=lambda a, g: g * 3)
+    xs = paddle.to_tensor(np.asarray([1., 2.], "f4"), stop_gradient=False)
+    r2 = static.py_func(lambda a: a * 2, xs, paddle.zeros([2]),
+                        backward_func=lambda a, g: g * 3)
+    r2.sum().backward()
+    np.testing.assert_allclose(xs.grad.numpy(), [3., 3.])
+    # RandomPerspective keeps dtype
+    from paddle_tpu.vision import transforms as T
+    img8 = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
+    out8 = T.RandomPerspective(prob=1.0)(img8)
+    assert out8.dtype == np.uint8
