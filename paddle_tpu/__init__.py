@@ -46,6 +46,7 @@ from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import hub  # noqa: F401
 from . import utils  # noqa: F401
@@ -145,8 +146,11 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
             for p, v in zip(params, olds):
                 p._value = v
 
+    compiled = jax.jit(fwd).lower(vals, x).compile()
     try:
-        cost = jax.jit(fwd).lower(vals, x).compile().cost_analysis()
+        # only the analysis readout is best-effort — trace/compile
+        # errors above are REAL user errors and must propagate
+        cost = compiled.cost_analysis()
         total = int(cost.get("flops", 0)) if cost else 0
     except Exception:
         total = 0

@@ -1,0 +1,67 @@
+"""Graph-learning ops (reference: python/paddle/geometric/ —
+send_u_recv / send_ue_recv message passing, segment pooling,
+sample_neighbors).  The compute cores live in incubate.ops (gather +
+XLA scatter reductions); this namespace carries the 2.x public API.
+"""
+import jax.numpy as jnp
+
+from ..incubate.ops import (segment_sum, segment_mean, segment_max,  # noqa: F401
+                            segment_min, graph_send_recv)
+from ..framework.autograd import call_op
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv",
+           "segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """reference: paddle.geometric.send_u_recv — gather source-node
+    features along edges, reduce at destination nodes."""
+    return graph_send_recv(x, src_index, dst_index, pool_type=reduce_op,
+                           out_size=out_size)
+
+
+def _ue_compute(xv, ev, compute_op):
+    if compute_op == "add":
+        return xv + ev
+    if compute_op == "sub":
+        return xv - ev
+    if compute_op == "mul":
+        return xv * ev
+    if compute_op == "div":
+        return xv / ev
+    raise ValueError(f"unknown compute_op {compute_op!r}")
+
+
+def send_ue_recv(x, y, src_index, dst_index, compute_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """reference: paddle.geometric.send_ue_recv — combine source-node
+    features with edge features (add/sub/mul/div), reduce at dst."""
+    from ..incubate.ops import _segment_reduce
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = ensure_tensor(src_index)._value.astype(jnp.int32)
+    dst = ensure_tensor(dst_index)._value.astype(jnp.int32)
+    pool = reduce_op.lower()
+    n_out = int(out_size) if out_size is not None else None
+
+    def _impl(xv, ev):
+        num = n_out if n_out is not None else xv.shape[0]
+        msgs = _ue_compute(jnp.take(xv, src, axis=0), ev, compute_op)
+        return _segment_reduce(msgs, dst, num, pool)
+    return call_op(_impl, x, y)
+
+
+def send_uv(x, y, src_index, dst_index, compute_op="add", name=None):
+    """reference: paddle.geometric.send_uv — per-edge message from
+    source and destination node features (no reduction)."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = ensure_tensor(src_index)._value.astype(jnp.int32)
+    dst = ensure_tensor(dst_index)._value.astype(jnp.int32)
+
+    def _impl(xv, yv):
+        return _ue_compute(jnp.take(xv, src, axis=0),
+                           jnp.take(yv, dst, axis=0), compute_op)
+    return call_op(_impl, x, y)

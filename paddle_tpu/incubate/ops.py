@@ -74,6 +74,27 @@ def segment_min(data, segment_ids, name=None):
     return _segment("min", data, segment_ids)
 
 
+def _segment_reduce(msgs, dst, num, pool):
+    """Shared sum/mean/max/min segment-reduce ladder (graph_send_recv,
+    geometric.send_ue_recv)."""
+    if pool == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=num)
+    if pool == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((msgs.shape[0],), msgs.dtype), dst,
+            num_segments=num)
+        return s / jnp.maximum(
+            cnt.reshape((num,) + (1,) * (msgs.ndim - 1)), 1.0)
+    if pool == "max":
+        return _empty_fill(jax.ops.segment_max(
+            msgs, dst, num_segments=num), dst, num, msgs.dtype)
+    if pool == "min":
+        return _empty_fill(jax.ops.segment_min(
+            msgs, dst, num_segments=num), dst, num, msgs.dtype)
+    raise ValueError(f"unknown pool/reduce op {pool!r}")
+
+
 def graph_send_recv(x, src_index, dst_index, pool_type="sum",
                     out_size=None, name=None):
     """reference: paddle.incubate.graph_send_recv (a.k.a.
@@ -87,23 +108,7 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
 
     def _gsr(v):
         num = n_out if n_out is not None else v.shape[0]
-        msgs = jnp.take(v, src, axis=0)
-        if pool == "sum":
-            return jax.ops.segment_sum(msgs, dst, num_segments=num)
-        if pool == "mean":
-            s = jax.ops.segment_sum(msgs, dst, num_segments=num)
-            cnt = jax.ops.segment_sum(
-                jnp.ones((msgs.shape[0],), v.dtype), dst,
-                num_segments=num)
-            return s / jnp.maximum(
-                cnt.reshape((num,) + (1,) * (v.ndim - 1)), 1.0)
-        if pool == "max":
-            out = jax.ops.segment_max(msgs, dst, num_segments=num)
-            return _empty_fill(out, dst, num, v.dtype)
-        if pool == "min":
-            out = jax.ops.segment_min(msgs, dst, num_segments=num)
-            return _empty_fill(out, dst, num, v.dtype)
-        raise ValueError(f"unknown pool_type {pool_type!r}")
+        return _segment_reduce(jnp.take(v, src, axis=0), dst, num, pool)
     return call_op(_gsr, x)
 
 

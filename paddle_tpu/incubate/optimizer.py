@@ -21,25 +21,33 @@ class LookAhead(Optimizer):
         self.inner = inner_optimizer
         self.alpha = float(alpha)
         self.k = int(k)
-        self._slow = {}
+        # slow weights seeded from the CURRENT params (reference
+        # semantics: the first lookahead round interpolates back toward
+        # the start-of-round weights)
+        self._slow = {id(p): p._value
+                      for p in (inner_optimizer._parameter_list or [])
+                      if not p.stop_gradient}
         self._steps = 0
 
     # delegate the Optimizer surface to the inner optimizer
     def __getattr__(self, name):
+        if name == "inner":      # guard: unpickling/copy pre-__init__
+            raise AttributeError(name)
         return getattr(self.inner, name)
 
     def step(self):
+        params = self.inner._parameter_list or []
+        for p in params:
+            if not p.stop_gradient and id(p) not in self._slow:
+                self._slow[id(p)] = p._value   # late-registered param
         self.inner.step()
         self._steps += 1
-        params = self.inner._parameter_list or []
         if self._steps % self.k:
             return
         for p in params:
             if p.stop_gradient:
                 continue
-            slow = self._slow.get(id(p))
-            if slow is None:
-                slow = p._value
+            slow = self._slow[id(p)]
             slow = slow + self.alpha * (p._value - slow)
             self._slow[id(p)] = slow
             p._value = slow
@@ -48,12 +56,24 @@ class LookAhead(Optimizer):
         self.inner.clear_grad(set_to_zero)
 
     def state_dict(self):
+        from ..framework.core import Tensor
         sd = self.inner.state_dict()
         sd["lookahead_step"] = self._steps
+        for i, p in enumerate(self.inner._parameter_list or []):
+            if id(p) in self._slow:
+                sd[f"lookahead_slow_{i}"] = Tensor(self._slow[id(p)])
         return sd
 
     def set_state_dict(self, state_dict):
+        import jax.numpy as jnp
+        import numpy as np
         self._steps = int(state_dict.pop("lookahead_step", 0))
+        for i, p in enumerate(self.inner._parameter_list or []):
+            key = f"lookahead_slow_{i}"
+            if key in state_dict:
+                v = state_dict.pop(key)
+                self._slow[id(p)] = v._value if hasattr(v, "_value") \
+                    else jnp.asarray(np.asarray(v))
         self.inner.set_state_dict(state_dict)
 
 

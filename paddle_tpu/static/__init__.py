@@ -410,8 +410,10 @@ def py_func(func, x, out, backward_func=None,
             skip_vars_in_backward_input=None):
     """reference: paddle.static.py_func — host-side python op inside the
     graph.  TPU-native: jax.pure_callback (runs on host, shape-checked
-    against ``out``).  ``backward_func(*inputs, *out_grads) -> in_grads``
-    registers a custom vjp (also a host callback); without it the op is
+    against ``out``).  ``backward_func(*inputs, *outputs, *out_grads) ->
+    in_grads`` (the reference contract) registers a custom vjp (also a
+    host callback); inputs listed in ``skip_vars_in_backward_input`` are
+    omitted from the backward call.  Without backward_func the op is
     non-differentiable (pure_callback has no autodiff rule)."""
     import jax
     import numpy as np
@@ -442,24 +444,33 @@ def py_func(func, x, out, backward_func=None,
 
     in_shapes = [jax.ShapeDtypeStruct(tuple(t._value.shape),
                                       t._value.dtype) for t in xs]
+    skip = skip_vars_in_backward_input or []
+    skip = skip if isinstance(skip, (list, tuple)) else [skip]
+    skip_ids = {id(s) for s in skip}
+    keep = [i for i, t in enumerate(xs) if id(t) not in skip_ids]
 
     @jax.custom_vjp
     def _op(*vals):
         return _fwd_impl(*vals)
 
     def _op_fwd(*vals):
-        return _fwd_impl(*vals), vals
+        out_vals = _fwd_impl(*vals)
+        return out_vals, (vals, out_vals)
 
-    def _op_bwd(res_vals, g):
+    def _op_bwd(res, g):
+        in_vals, out_vals = res
+        outs_list = [out_vals] if single else list(out_vals)
         gs = [g] if single else list(g)
+        kept_ins = [in_vals[i] for i in keep]
 
-        def _host_bwd(*vals_and_grads):
-            arrs = [np.asarray(v) for v in vals_and_grads]
+        def _host_bwd(*args):
+            arrs = [np.asarray(v) for v in args]
             grads = backward_func(*arrs)
             grads = grads if isinstance(grads, (list, tuple)) else [grads]
             return [np.asarray(gr) for gr in grads]
-        return tuple(jax.pure_callback(_host_bwd, in_shapes,
-                                       *res_vals, *gs))
+        in_grads = jax.pure_callback(_host_bwd, in_shapes,
+                                     *kept_ins, *outs_list, *gs)
+        return tuple(in_grads)
 
     _op.defvjp(_op_fwd, _op_bwd)
     return call_op(lambda *vals: _op(*vals), *xs)

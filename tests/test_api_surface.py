@@ -133,3 +133,63 @@ class TestDistributedSurface:
     def test_sharding(self):
         import paddle_tpu.distributed.sharding as sh
         assert hasattr(sh, "group_sharded_parallel")
+
+
+class TestRound3Surface:
+    """Components landed in round 3 — keep the completeness gate green."""
+
+    def test_varlen_and_kernels(self):
+        import paddle_tpu.nn.functional as F
+        _has(F, "flash_attn_unpadded", "scaled_dot_product_attention",
+             "grid_sample", "affine_grid", "temporal_shift",
+             "max_unpool1d", "max_unpool2d", "max_unpool3d",
+             "fractional_max_pool2d", "fractional_max_pool3d",
+             "rnnt_loss", "adaptive_log_softmax_with_loss",
+             "triplet_margin_with_distance_loss", "pairwise_distance")
+        from paddle_tpu.ops.pallas import quant_matmul
+        _has(quant_matmul, "int8_matmul", "fp8_matmul",
+             "fp8_quantize_weight")
+
+    def test_nn_layers_r3(self):
+        import paddle_tpu.nn as nn
+        _has(nn, "Unflatten", "ChannelShuffle", "PairwiseDistance",
+             "AdaptiveMaxPool1D", "AdaptiveMaxPool3D", "MaxUnPool1D",
+             "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+             "FractionalMaxPool3D", "TripletMarginWithDistanceLoss",
+             "AdaptiveLogSoftmaxWithLoss", "RNNTLoss", "RNNCellBase")
+
+    def test_distributed_r3(self):
+        import paddle_tpu.distributed as dist
+        _has(dist, "gather", "broadcast_object_list",
+             "scatter_object_list", "P2POp", "batch_isend_irecv",
+             "get_backend", "split", "reshard", "dtensor_from_fn",
+             "isend", "irecv")
+
+    def test_namespaces_r3(self):
+        _has(paddle, "geometric.send_u_recv", "geometric.send_ue_recv",
+             "geometric.send_uv", "geometric.segment_sum",
+             "incubate.segment_mean", "incubate.graph_send_recv",
+             "incubate.softmax_mask_fuse", "incubate.identity_loss",
+             "incubate.optimizer.LookAhead",
+             "incubate.optimizer.ModelAverage",
+             "iinfo", "finfo", "flops", "binomial", "log_normal",
+             "cauchy_", "logcumsumexp", "trapezoid", "renorm", "frexp",
+             "vander")
+        _has(paddle.linalg, "cond", "lu", "householder_product")
+        _has(paddle.static, "gradients", "append_backward", "py_func",
+             "create_parameter", "ExponentialMovingAverage",
+             "device_guard", "WeightNormParamAttr")
+        _has(paddle.amp, "is_bfloat16_supported", "debugging")
+        _has(paddle.device, "Stream", "Event", "stream_guard",
+             "current_stream")
+
+    def test_tensor_inplace_r3(self):
+        import numpy as np
+        t = paddle.to_tensor(np.zeros((2,), "f4"))
+        _has(type(t), "add_", "scale_", "zero_", "fill_", "uniform_",
+             "normal_", "cauchy_", "detach_", "element_size")
+
+    def test_engine_pipeline_r3(self):
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+        s = Strategy()
+        assert hasattr(s, "pipeline") and hasattr(s, "pp_degree")

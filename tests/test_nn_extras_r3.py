@@ -398,18 +398,64 @@ def test_review_fixes_r3b():
     assert str(out.dtype).endswith("int32"), out.dtype
     np.testing.assert_array_equal(out.numpy(), [[4], [0], [2]])
     # py_func custom backward
-    r = static.py_func(lambda a: a * 2,
-                       paddle.to_tensor(np.asarray([1., 2.], "f4"),
-                                        stop_gradient=False),
-                       paddle.zeros([2]),
-                       backward_func=lambda a, g: g * 3)
+    # paddle contract: backward_func(*inputs, *outputs, *out_grads)
     xs = paddle.to_tensor(np.asarray([1., 2.], "f4"), stop_gradient=False)
     r2 = static.py_func(lambda a: a * 2, xs, paddle.zeros([2]),
-                        backward_func=lambda a, g: g * 3)
+                        backward_func=lambda a, out, g: g * 3)
     r2.sum().backward()
     np.testing.assert_allclose(xs.grad.numpy(), [3., 3.])
+    # skip_vars_in_backward_input drops the input from the bwd call
+    xs2 = paddle.to_tensor(np.asarray([1., 2.], "f4"), stop_gradient=False)
+    r3 = static.py_func(lambda a: a * 2, xs2, paddle.zeros([2]),
+                        backward_func=lambda out, g: g * 5,
+                        skip_vars_in_backward_input=[xs2])
+    r3.sum().backward()
+    np.testing.assert_allclose(xs2.grad.numpy(), [5., 5.])
     # RandomPerspective keeps dtype
     from paddle_tpu.vision import transforms as T
     img8 = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
     out8 = T.RandomPerspective(prob=1.0)(img8)
     assert out8.dtype == np.uint8
+
+
+def test_geometric_namespace():
+    """paddle.geometric send_u_recv / send_ue_recv / send_uv parity."""
+    import paddle_tpu.geometric as G
+    x = paddle.to_tensor(np.asarray([[1., 2.], [3., 4.], [5., 6.]], "f4"),
+                         stop_gradient=False)
+    e = paddle.to_tensor(np.asarray([[10., 10.], [20., 20.]], "f4"))
+    src = np.asarray([0, 1], "i4")
+    dst = np.asarray([1, 2], "i4")
+    out = G.send_u_recv(x, src, dst, "sum")
+    np.testing.assert_allclose(out.numpy(), [[0., 0.], [1., 2.], [3., 4.]])
+    out2 = G.send_ue_recv(x, e, src, dst, "add", "sum")
+    np.testing.assert_allclose(out2.numpy(),
+                               [[0., 0.], [11., 12.], [23., 24.]])
+    out3 = G.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_allclose(out3.numpy(), [[3., 8.], [15., 24.]])
+    out2.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_lookahead_slow_weights_seeded_and_saved():
+    """Review r3b: slow weights seed from the construction-time params
+    (first round interpolates toward them) and persist in state_dict."""
+    from paddle_tpu.incubate.optimizer import LookAhead
+    paddle.seed(1)
+    lin = nn.Linear(2, 2)
+    w0 = lin.weight.numpy().copy()
+    opt = LookAhead(paddle.optimizer.SGD(0.5, parameters=lin.parameters()),
+                    alpha=0.5, k=1)
+    lin(paddle.ones([1, 2])).sum().backward()
+    opt.step()
+    # one step, k=1: w = (w0 + w_fast)/2 — NOT w_fast
+    fast = w0 - 0.5 * np.ones((2, 2), "f4") * 0  # grad of sum wrt weight = x
+    assert not np.allclose(lin.weight.numpy(), w0)
+    sd = opt.state_dict()
+    assert any(k.startswith("lookahead_slow_") for k in sd)
+    # roundtrip keeps the slow copy
+    opt2 = LookAhead(paddle.optimizer.SGD(0.5,
+                                          parameters=lin.parameters()),
+                     alpha=0.5, k=1)
+    opt2.set_state_dict(sd)
+    assert opt2._steps == 1
