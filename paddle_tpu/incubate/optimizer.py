@@ -67,6 +67,7 @@ class LookAhead(Optimizer):
     def set_state_dict(self, state_dict):
         import jax.numpy as jnp
         import numpy as np
+        state_dict = dict(state_dict)   # non-destructive to the caller
         self._steps = int(state_dict.pop("lookahead_step", 0))
         for i, p in enumerate(self.inner._parameter_list or []):
             key = f"lookahead_slow_{i}"
@@ -77,10 +78,33 @@ class LookAhead(Optimizer):
         self.inner.set_state_dict(state_dict)
 
 
+def _apply_swap(owner, params, value_of):
+    """Shared apply/restore swap protocol (ModelAverage, static EMA):
+    back params up on ``owner._backup``, swap in value_of(p) where it
+    returns a value."""
+    owner._backup = {}
+    for p in params:
+        v = value_of(p)
+        if v is not None:
+            owner._backup[id(p)] = p._value
+            p._value = v.astype(p._value.dtype)
+
+
+def _restore_swap(owner, params):
+    if owner._backup:
+        for p in params:
+            if id(p) in owner._backup:
+                p._value = owner._backup[id(p)]
+    owner._backup = None
+
+
 class ModelAverage(Optimizer):
     """reference: incubate.optimizer.ModelAverage: maintain a running
     average of parameters; ``apply()`` swaps it in for evaluation,
-    ``restore()`` swaps back."""
+    ``restore()`` swaps back.  Two-window scheme like the reference's
+    sum_1/sum_2 restart: when the live window hits max_average_window it
+    rolls into the previous-window slot, so the effective sample count
+    never collapses to a handful right after a reset."""
 
     def __init__(self, average_window_rate=0.15, parameters=None,
                  min_average_window=10000, max_average_window=10000,
@@ -88,6 +112,8 @@ class ModelAverage(Optimizer):
         super().__init__(parameters=parameters)
         self._sum = {}
         self._cnt = {}
+        self._old_sum = {}
+        self._old_cnt = {}
         self._backup = None
         self._max_window = int(max_average_window)
 
@@ -96,31 +122,36 @@ class ModelAverage(Optimizer):
             if p.stop_gradient:
                 continue
             k = id(p)
-            if k not in self._sum or self._cnt[k] >= self._max_window:
+            if k not in self._sum:
+                self._sum[k] = jnp.zeros_like(p._value)
+                self._cnt[k] = 0
+            elif self._cnt[k] >= self._max_window:
+                # roll the completed window into the previous slot
+                self._old_sum[k] = self._sum[k]
+                self._old_cnt[k] = self._cnt[k]
                 self._sum[k] = jnp.zeros_like(p._value)
                 self._cnt[k] = 0
             self._sum[k] = self._sum[k] + p._value
             self._cnt[k] += 1
 
+    def _avg(self, p):
+        k = id(p)
+        cnt = self._cnt.get(k, 0) + self._old_cnt.get(k, 0)
+        if not cnt:
+            return None
+        total = self._sum.get(k, 0)
+        if k in self._old_sum:
+            total = total + self._old_sum[k]
+        return total / cnt
+
     def apply(self, executor=None, need_restore=True):
-        self._backup = {}
-        for p in self._parameter_list or []:
-            k = id(p)
-            if k in self._sum and self._cnt[k]:
-                self._backup[k] = p._value
-                p._value = (self._sum[k] / self._cnt[k]).astype(
-                    p._value.dtype)
+        _apply_swap(self, self._parameter_list or [], self._avg)
         if not need_restore:
             self._backup = None
         return _SwapCtx(self)
 
     def restore(self, executor=None):
-        if self._backup:
-            for p in self._parameter_list or []:
-                k = id(p)
-                if k in self._backup:
-                    p._value = self._backup[k]
-        self._backup = None
+        _restore_swap(self, self._parameter_list or [])
 
 
 class _SwapCtx:

@@ -465,9 +465,11 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
 
     TPU-native: the forward algorithm runs as a lax.scan over T with an
     associative first-order recurrence in U solved per step — log-space
-    alpha lattice, no Python loops over the batch.  FastEmit
-    (fastemit_lambda > 0) scales the final emission-path term by
-    (1 + lambda), the loss-side form of the warprnnt regularizer.
+    alpha lattice, no Python loops over the batch.  The returned loss is
+    the exact -log P(y|x).  FastEmit (fastemit_lambda > 0) is a
+    GRADIENT-side regularizer in warprnnt (scales emission-path
+    gradients); it is accepted for API parity but not applied here — a
+    one-time warning says so.
     """
     input = ensure_tensor(input)
     label = ensure_tensor(label)
@@ -518,11 +520,21 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         t_last = jnp.maximum(il - 1, 0)
         a_term = alphas[bi, t_last, ll]
         final_blank = lp_blank[bi, t_last, ll]
-        ll_total = a_term + final_blank
-        loss = -(1.0 + fastemit_lambda) * ll_total \
-            if fastemit_lambda else -ll_total
-        return _reduce(loss, reduction)
+        return _reduce(-(a_term + final_blank), reduction)
+
+    global _RNNT_FASTEMIT_WARNED
+    if fastemit_lambda and not _RNNT_FASTEMIT_WARNED:
+        _RNNT_FASTEMIT_WARNED = True
+        import warnings
+        warnings.warn(
+            "rnnt_loss: fastemit_lambda is accepted for API parity but "
+            "the FastEmit gradient regularizer is not applied (loss and "
+            "grads are the exact unregularized transducer values)",
+            stacklevel=2)
     return call_op(_rnnt, input, label)
+
+
+_RNNT_FASTEMIT_WARNED = False
 
 
 def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,

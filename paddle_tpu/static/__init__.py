@@ -447,7 +447,11 @@ def py_func(func, x, out, backward_func=None,
     skip = skip_vars_in_backward_input or []
     skip = skip if isinstance(skip, (list, tuple)) else [skip]
     skip_ids = {id(s) for s in skip}
-    keep = [i for i, t in enumerate(xs) if id(t) not in skip_ids]
+    # match against BOTH the wrapped tensors and the caller's original
+    # objects (non-Tensor inputs get wrapped in fresh facades above)
+    originals = x if isinstance(x, (list, tuple)) else [x]
+    keep = [i for i, (t, o) in enumerate(zip(xs, originals))
+            if id(t) not in skip_ids and id(o) not in skip_ids]
 
     @jax.custom_vjp
     def _op(*vals):
@@ -519,24 +523,15 @@ class ExponentialMovingAverage:
         return self
 
     def apply(self, executor=None, need_restore=True):
-        from ..incubate.optimizer import _SwapCtx
-        self._backup = {}
-        for p in self._params:
-            k = id(p)
-            if k in self._ema:
-                self._backup[k] = p._value
-                p._value = self._ema[k].astype(p._value.dtype)
+        from ..incubate.optimizer import _SwapCtx, _apply_swap
+        _apply_swap(self, self._params, lambda p: self._ema.get(id(p)))
         if not need_restore:
             self._backup = None
         return _SwapCtx(self)
 
     def restore(self, executor=None):
-        if self._backup:
-            for p in self._params:
-                k = id(p)
-                if k in self._backup:
-                    p._value = self._backup[k]
-        self._backup = None
+        from ..incubate.optimizer import _restore_swap
+        _restore_swap(self, self._params)
 
 
 from contextlib import contextmanager as _ctxmgr

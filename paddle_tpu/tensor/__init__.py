@@ -171,10 +171,18 @@ def _rebind(dst, src):
 
 def _inplace(fn):
     def method(self, *args, **kwargs):
+        # paddle contract: a grad-requiring LEAF cannot be mutated in
+        # place (its accumulated .grad slot would silently detach)
+        from ..framework.autograd import is_grad_enabled
+        if self._node is None and not self.stop_gradient \
+                and is_grad_enabled():
+            raise RuntimeError(
+                "Leaf Tensor that requires grad can't use inplace "
+                "strategy (its .grad would silently detach); use the "
+                "out-of-place op or wrap in paddle.no_grad()")
         # run the op against a SHADOW facade holding the old producing
         # node, so the recorded tape edge does not alias the mutated
-        # output (grads keep flowing through the pre-mutation graph);
-        # like paddle, gradient accumulation targets non-leaf history
+        # output (grads keep flowing through the pre-mutation graph)
         shadow = Tensor(self._value, stop_gradient=self.stop_gradient)
         shadow._node = self._node
         shadow._out_idx = self._out_idx

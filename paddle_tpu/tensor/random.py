@@ -175,10 +175,13 @@ def log_normal(mean=1.0, std=2.0, shape=None, name=None):
 
 def cauchy_(x, loc=0, scale=1, name=None):
     """reference: paddle.Tensor.cauchy_ — fill in-place with Cauchy
-    samples (inverse-CDF over uniform)."""
+    samples (inverse-CDF over uniform).  Detaches like the other
+    in-place fillers: the old producing graph no longer describes the
+    overwritten value."""
     x = ensure_tensor(x)
     u = jax.random.uniform(next_key(), tuple(x.shape), minval=1e-7,
                            maxval=1.0 - 1e-7)
     x._value = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(
         x._value.dtype)
+    x._node = None
     return x

@@ -459,3 +459,37 @@ def test_lookahead_slow_weights_seeded_and_saved():
                      alpha=0.5, k=1)
     opt2.set_state_dict(sd)
     assert opt2._steps == 1
+
+
+def test_inplace_leaf_guard_and_cauchy_detach():
+    """Review r3c: grad-requiring leaf in-place raises (paddle
+    contract); cauchy_ detaches the producing node like other fillers."""
+    w = paddle.to_tensor(np.ones((2,), "f4"), stop_gradient=False)
+    with pytest.raises(RuntimeError, match="[Ll]eaf"):
+        w.add_(paddle.to_tensor(np.ones((2,), "f4")))
+    # no_grad context allows it (manual update loops)
+    with paddle.no_grad():
+        w.add_(paddle.to_tensor(np.ones((2,), "f4")))
+    np.testing.assert_allclose(w.numpy(), [2.0, 2.0])
+    # cauchy_ on a derived tensor cuts the tape
+    x = paddle.to_tensor(np.ones((4,), "f4"), stop_gradient=False)
+    y = x * 2.0
+    y.cauchy_()
+    y.sum().backward()
+    assert x.grad is None
+
+
+def test_model_average_two_window():
+    """ModelAverage window roll: right after max_average_window the
+    average still spans the previous window."""
+    from paddle_tpu.incubate.optimizer import ModelAverage
+    lin = nn.Linear(2, 2)
+    ma = ModelAverage(parameters=lin.parameters(), max_average_window=3)
+    with paddle.no_grad():
+        for v in (1.0, 2.0, 3.0, 10.0):   # 4th step rolls the window
+            lin.weight.fill_(v)
+            ma.step()
+    with ma.apply():
+        # average spans ALL 4 samples (old window 1,2,3 + live 10)
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   np.full((2, 2), 4.0), rtol=1e-6)
