@@ -549,13 +549,13 @@ def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[h, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _pick_hb(BH, S, D, n_bufs):
+def _pick_hb(BH, S, D, n_bufs, budget=2 * 1024 * 1024):
     """Heads per program: largest divisor of BH whose n_bufs (S, D)
-    buffers fit a ~2MB VMEM budget (the 16MB scoped budget must also
-    hold double-buffered block DMA + the unrolled loop's s/p stack
-    temporaries, measured ~3x the block bytes)."""
-    budget = 2 * 1024 * 1024
-    per_head = n_bufs * S * D * 2 + S * _LANES * 8   # bf16 bufs + lse/delta
+    buffers fit the VMEM budget (the 16MB scoped budget must also hold
+    double-buffered block DMA + the unrolled loop's s/p stack
+    temporaries, which Mosaic does NOT slot-share across unrolled
+    bodies).  lse rides the slim (1, S) f32 layout."""
+    per_head = n_bufs * S * D * 2 + S * 4            # bf16 bufs + slim lse
     hb = max(1, budget // max(per_head, 1))
     while hb > 1 and BH % hb:
         hb -= 1
@@ -571,7 +571,7 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
-    hb = _pick_hb(BH, S, D, n_bufs=4)
+    hb = _pick_hb(BH, S, D, n_bufs=4, budget=1280 * 1024)  # measured: hb=2 best at S=1024
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
     out_specs = [spec]
     out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype)]
@@ -606,7 +606,7 @@ def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
-    hb = _pick_hb(BH, S, D, n_bufs=7)
+    hb = _pick_hb(BH, S, D, n_bufs=7, budget=1024 * 1024)  # bwd: hb=1 measured flat-optimal
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
     spec_l = pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0))
     return pl.pallas_call(
