@@ -272,7 +272,19 @@ def _run_backward(seeds, retain_graph, sink_map):
                     prev = collected.get(id(t))
                     collected[id(t)] = c if prev is None else prev + c
             out_cots.append(c)
-        in_cots = n.vjp(out_cots[0] if n.single_out else tuple(out_cots))
+        try:
+            in_cots = n.vjp(out_cots[0] if n.single_out
+                            else tuple(out_cots))
+        except ValueError as e:
+            if "Reverse-mode differentiation does not work" in str(e):
+                raise RuntimeError(
+                    "reverse-mode AD reached a loop with a dynamic trip "
+                    "count (lax.while_loop / lax.fori_loop has no "
+                    "transpose). If this came from a dy2static-converted "
+                    "for/while, wrap the call in "
+                    "paddle.jit.bounded_loops(max_iters) to lower it to a "
+                    "differentiable masked scan") from e
+            raise
         touched_producers = {}
         for t, c in zip(n.inputs, in_cots):
             if t.stop_gradient:

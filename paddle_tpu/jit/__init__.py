@@ -28,8 +28,11 @@ from ..framework.random import rng_scope, next_key
 from ..nn.layer.layers import Layer
 from ..static import InputSpec
 
+from .dy2static import bounded_loops, active_loop_bound
+
 __all__ = ["to_static", "not_to_static", "save", "load", "StaticFunction",
-           "TranslatedLayer", "ignore_module", "enable_to_static"]
+           "TranslatedLayer", "ignore_module", "enable_to_static",
+           "bounded_loops"]
 
 _TO_STATIC_ENABLED = [True]
 
@@ -134,7 +137,10 @@ class StaticFunction:
                 return self._function(self._layer, *args, **kwargs)
             return self._function(*args, **kwargs)
         training = self._layer.training if self._layer is not None else False
-        key = (_spec_key(args), tuple(sorted(kwargs)), training)
+        # the ambient loop bound changes how converted loops lower
+        # (masked scan vs fori/while), so it is part of the compile key
+        key = (_spec_key(args), tuple(sorted(kwargs)), training,
+               active_loop_bound())
         self._tensor_pos = {i for i, a in enumerate(args)
                             if isinstance(a, (Tensor, np.ndarray, jax.Array))}
         if key not in self._cache:

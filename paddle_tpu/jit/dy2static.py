@@ -26,8 +26,16 @@ plain tracing semantics):
   concrete; ``logical_and/or/not`` when traced).
 
 Gradients flow through converted ``if`` (lax.cond is reverse-mode
-differentiable); a converted ``while`` is forward-only under reverse-mode
-AD — an XLA constraint (lax.while_loop has no transpose rule).
+differentiable) and through any loop given a static trip-count bound:
+under ``bounded_loops(N)`` a tensor-bounded ``for``/``while`` lowers to a
+masked ``lax.scan`` of length N (reverse-mode differentiable — the scan
+saves per-iteration residuals, iterations past the dynamic trip count
+are identity via ``where``).  Without a bound the loop lowers to
+``lax.fori_loop``/``lax.while_loop``, which XLA cannot transpose
+(dynamic trip count ⇒ unbounded residual storage); reverse AD through
+one raises a clear error pointing at ``bounded_loops``.  This mirrors
+the reference's while_grad op (python/paddle/static/nn/control_flow.py)
+under XLA's static-shape constraint.
 
 Variables assigned only inside a branch/loop that are unbound before it
 ride an ``_UNDEF`` sentinel: they stay "unbound" (erroring on use) unless
@@ -37,6 +45,8 @@ import ast
 import functools
 import inspect
 import textwrap
+import threading
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +56,91 @@ from ..framework.core import Tensor
 
 __all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
            "convert_logical_or", "convert_logical_not",
-           "transform_function"]
+           "transform_function", "bounded_loops"]
+
+_LOOP_BOUND = threading.local()
+
+
+class bounded_loops:
+    """Give tensor-bounded converted loops a static max trip count.
+
+    Inside this context a dy2static-converted ``for range(tensor_n)`` or
+    ``while`` lowers to a masked ``lax.scan`` of length ``max_iters``
+    instead of ``lax.fori_loop``/``lax.while_loop`` — making the loop
+    reverse-mode differentiable (scan records residuals; iterations past
+    the dynamic trip count keep the carry unchanged, so their cotangent
+    contribution is exactly zero).  If the dynamic trip count exceeds
+    ``max_iters`` the loop is truncated and a RuntimeWarning is emitted
+    from a debug callback — on backends with host-callback support
+    (cpu/gpu/tpu; the axon tunnel has none, there the bound is a hard
+    cap like a generation max_length).
+
+    Usage::
+
+        with paddle.jit.bounded_loops(64):
+            loss = static_fn(x, n)   # n a traced step count <= 64
+            loss.backward()
+    """
+
+    def __init__(self, max_iters):
+        if not isinstance(max_iters, (int, jnp.integer)):
+            raise TypeError(
+                "bounded_loops: max_iters must be a concrete Python int "
+                f"(the static scan length), got {type(max_iters).__name__}")
+        self.max_iters = int(max_iters)
+        if self.max_iters <= 0:
+            raise ValueError("bounded_loops: max_iters must be positive")
+
+    def __enter__(self):
+        stack = getattr(_LOOP_BOUND, "stack", None)
+        if stack is None:
+            stack = _LOOP_BOUND.stack = []
+        stack.append(self.max_iters)
+        return self
+
+    def __exit__(self, *exc):
+        _LOOP_BOUND.stack.pop()
+        return False
+
+
+def active_loop_bound():
+    stack = getattr(_LOOP_BOUND, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _overflow_warn(flag, kind, bound):
+    if flag:
+        warnings.warn(
+            f"dy2static bounded_loops({bound}): a converted {kind} loop "
+            f"needed more than {bound} iterations and was truncated; "
+            f"raise the bound", RuntimeWarning, stacklevel=2)
+
+
+def _bounded_scan(step_masked, carry0, bound, overflow_flag_fn, kind):
+    """Masked scan of static length ``bound`` + truncation warning.
+
+    The warning rides a ``jax.debug.callback``, emitted only on backends
+    that support host callbacks — the axon PJRT tunnel does not (any
+    host send/recv in the program raises UNIMPLEMENTED at run time), so
+    there the bound is a silent hard cap, documented in bounded_loops.
+    """
+    final, _ = lax.scan(step_masked, carry0, jnp.arange(bound))
+    if _host_callbacks_supported():
+        jax.debug.callback(
+            functools.partial(_overflow_warn, kind=kind, bound=bound),
+            jnp.asarray(overflow_flag_fn(final)))
+    return final
+
+
+@functools.lru_cache(maxsize=1)
+def _host_callbacks_supported():
+    # the axon PJRT tunnel reports platform "tpu" but rejects host
+    # send/recv (debug.callback/pure_callback) with UNIMPLEMENTED; its
+    # marker is the platform_version string
+    try:
+        return "axon" not in jax.devices()[0].client.platform_version
+    except Exception:
+        return True
 
 
 class _Undef:
@@ -142,7 +236,21 @@ def convert_while_loop(cond_fn, body_fn, init):
         return tuple(jnp.asarray(_val(out[i])) for i in live)
 
     carry0 = tuple(jnp.asarray(_val(init[i])) for i in live)
-    final = lax.while_loop(c, b, carry0)
+    bound = active_loop_bound()
+    if bound is not None:
+        # masked scan: differentiable bounded while.  Post-termination
+        # iterations still run the body (static shapes) but the carry is
+        # frozen by the where, so they contribute zero cotangent.
+        def step(carry, _):
+            active = jnp.asarray(c(carry))
+            new = b(carry)
+            return tuple(jnp.where(active, nw, old)
+                         for nw, old in zip(new, carry)), None
+
+        final = _bounded_scan(step, carry0, bound,
+                              lambda fin: c(fin), "while")
+    else:
+        final = lax.while_loop(c, b, carry0)
     out = list(init)
     for j, i in enumerate(live):
         out[i] = Tensor(final[j]) if wrap_t[j] else final[j]
@@ -191,8 +299,9 @@ def convert_for(iterable, body_fn, init):
     """for over a possibly-traced iterable.
 
     ``body_fn(loop_var, *carried) -> tuple(carried)``.  Dispatch:
-    - ``_TracedRange`` -> ``lax.fori_loop`` (forward-only under AD —
-      while_loop semantics; use a concrete bound for trainable loops)
+    - ``_TracedRange`` -> masked ``lax.scan`` under ``bounded_loops``
+      (reverse-mode differentiable), else ``lax.fori_loop`` (forward
+      only — dynamic trip count has no transpose)
     - traced Tensor -> ``lax.scan`` over the leading axis (reverse-mode
       differentiable)
     - anything else -> plain Python iteration (exact semantics)
@@ -234,7 +343,19 @@ def convert_for(iterable, body_fn, init):
             out = tuple(body_fn(Tensor(i), *full(carry)))
             return tuple(jnp.asarray(_val(out[j])) for j in live)
 
-        final = lax.fori_loop(0, n_iters, b, carry0)
+        bound = active_loop_bound()
+        if bound is not None:
+            # masked scan: differentiable bounded fori (see bounded_loops)
+            def sbody(carry, k):
+                new = b(k, carry)
+                keep = k < n_iters
+                return tuple(jnp.where(keep, nw, old)
+                             for nw, old in zip(new, carry)), None
+
+            final = _bounded_scan(sbody, carry0, bound,
+                                  lambda fin: n_iters > bound, "for")
+        else:
+            final = lax.fori_loop(0, n_iters, b, carry0)
     else:
         def f(carry, x):
             out = tuple(body_fn(Tensor(x), *full(carry)))

@@ -308,3 +308,118 @@ def test_for_with_break_concrete_ok_traced_errors():
     with pytest.raises(NotImplementedError, match="break/continue"):
         jax.jit(lambda v, n: g(Tensor(v), Tensor(n))._value)(
             jnp.asarray([1.0]), jnp.asarray(5))
+
+
+# -- bounded_loops: reverse-mode AD through converted loops (VERDICT r3 #1) ---
+
+def test_bounded_for_grad_parity():
+    """A converted for range(traced_n) under bounded_loops lowers to a
+    masked scan and is reverse-mode differentiable, matching the
+    unrolled eager gradient."""
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x * ((i + 1) * 1.0)
+        return acc.sum()
+
+    g, changed = transform_function(f)
+    assert changed
+    x = jnp.asarray([1.0, 2.0])
+
+    with paddle.jit.bounded_loops(8):
+        val, grad = jax.value_and_grad(
+            lambda v, n: g(Tensor(v), Tensor(n))._value)(x, jnp.asarray(3))
+    np.testing.assert_allclose(float(val), 18.0)     # (1+2+3)*(1+2)
+    np.testing.assert_allclose(np.asarray(grad), [6.0, 6.0])
+    # the lowering must be a scan (differentiable), visible in the jaxpr
+    with paddle.jit.bounded_loops(8):
+        jx = str(jax.make_jaxpr(
+            lambda v, n: g(Tensor(v), Tensor(n))._value)(x, jnp.asarray(3)))
+    assert "scan" in jx
+
+
+def test_bounded_while_grad_parity():
+    def f(x, n):
+        s = x.sum() * 0.0
+        i = n * 0
+        while i < n:
+            s = s + x.sum() * 2.0
+            i = i + 1
+        return s
+
+    g, changed = transform_function(f)
+    assert changed
+    x = jnp.asarray([1.0, 3.0])
+    with paddle.jit.bounded_loops(16):
+        val, grad = jax.value_and_grad(
+            lambda v, n: g(Tensor(v), Tensor(n))._value)(x, jnp.asarray(4))
+    np.testing.assert_allclose(float(val), 32.0)     # 4 * 2 * (1+3)
+    np.testing.assert_allclose(np.asarray(grad), [8.0, 8.0])
+
+
+class AccumNet(nn.Layer):
+    """GPT-style accumulation: apply the same block n (traced) times."""
+
+    def __init__(self):
+        super(AccumNet, self).__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x, n):
+        acc = x
+        for i in range(n):
+            acc = acc + paddle.tanh(self.fc(acc)) * 0.5
+        return acc.sum()
+
+
+def test_to_static_bounded_loop_trains():
+    """loss.backward() flows through a converted loop in a @to_static
+    forward (the VERDICT r3 'done' criterion), with eager parity."""
+    paddle.seed(7)
+    net = AccumNet()
+    x = Tensor(jnp.asarray(np.random.RandomState(0)
+                           .randn(2, 4).astype("f4")))
+    n = Tensor(jnp.asarray(3))
+
+    # eager reference: plain python loop (concrete n), eager tape
+    loss_e = net(x, 3)
+    loss_e.backward()
+    ge = np.asarray(net.fc.weight.grad._value)
+    net.clear_gradients()
+
+    snet = paddle.jit.to_static(net)
+    with paddle.jit.bounded_loops(8):
+        loss_s = snet(x, n)
+        loss_s.backward()
+    gs = np.asarray(net.fc.weight.grad._value)
+    np.testing.assert_allclose(float(loss_s._value), float(loss_e._value),
+                               rtol=1e-5)
+    np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-5)
+
+
+def test_unbounded_loop_grad_clear_error():
+    paddle.seed(7)
+    net = AccumNet()
+    snet = paddle.jit.to_static(net)
+    x = Tensor(jnp.asarray(np.random.RandomState(0)
+                           .randn(2, 4).astype("f4")))
+    loss = snet(x, Tensor(jnp.asarray(3)))
+    with pytest.raises(RuntimeError, match="bounded_loops"):
+        loss.backward()
+
+
+def test_bounded_loop_truncation_warns():
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x
+        return acc.sum()
+
+    g, changed = transform_function(f)
+    assert changed
+    x = jnp.asarray([1.0])
+    with paddle.jit.bounded_loops(2):
+        with pytest.warns(RuntimeWarning, match="truncated"):
+            out = jax.jit(lambda v, n: g(Tensor(v), Tensor(n))._value)(
+                x, jnp.asarray(5))
+            jax.block_until_ready(out)
+    np.testing.assert_allclose(float(out), 2.0)   # capped at bound
