@@ -420,17 +420,44 @@ def batch_isend_irecv(p2p_op_list):
     n = group.nranks if group is not None and hasattr(group, "nranks") \
         else get_world_size()
     from .env import get_rank
+    rank = get_rank()
     tasks = []
-    for s, r in zip(sends, recvs):
-        # single-program SPMD: the declared peer implies a uniform shift
-        # (every rank sends to rank+shift), which IS a permutation
-        shift = (s.peer - get_rank()) % n
+    # single-program SPMD: each send's declared peer implies a uniform
+    # shift (every rank sends to rank+shift), which IS a permutation.
+    # The inference is only sound if a recv in the batch declares the
+    # matching source (rank-shift) — pair by shift, not declaration
+    # order (the reference imposes no send/recv ordering).  A
+    # rank-dependent pattern (e.g. pairwise even/odd exchange) has no
+    # matching recv and is rejected loudly instead of silently tracing
+    # the wrong permutation on every rank but this one.
+    unmatched = list(recvs)
+    for s in sends:
+        shift = (s.peer - rank) % n
+        r = next((x for x in unmatched if (rank - x.peer) % n == shift),
+                 None)
+        if r is None:
+            raise ValueError(
+                f"batch_isend_irecv: this rank sends to rank+{shift} but "
+                "no irecv in the batch declares the matching source "
+                f"rank-{shift}; the SPMD lowering bakes ONE uniform "
+                "shift per send/recv pair into the traced program, so "
+                "peers must describe the same rotation on every rank. "
+                "For a non-rotation permutation build the static perm "
+                "list yourself with p2p.ppermute")
+        unmatched.remove(r)
         perm = [(i, (i + shift) % n) for i in range(n)]
         out = _apply(s.tensor, lambda v, _p=perm: lax.ppermute(v, axis, _p))
         r.tensor._value = out._value
         r.tensor._node = out._node
         r.tensor._out_idx = out._out_idx
         tasks.append(_Task(r.tensor))
+    if unmatched:
+        raise ValueError(
+            f"batch_isend_irecv: {len(unmatched)} irecv(s) matched no "
+            "isend shift (peers "
+            f"{[x.peer for x in unmatched]}); every recv must pair with "
+            "a send describing the same rotation, or its buffer would "
+            "silently keep stale data")
     return tasks
 
 

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..framework.random import next_key
 from ..tensor._helpers import ensure_tensor, raw
+from ..framework.dtypes import index_dtype as _i64
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel", "Laplace",
@@ -138,7 +139,7 @@ class Categorical(Distribution):
     def sample(self, shape=()):
         shp = tuple(shape) + self._batch_shape
         return Tensor(jax.random.categorical(
-            next_key(), raw(self.logits), shape=shp).astype(jnp.int64))
+            next_key(), raw(self.logits), shape=shp).astype(_i64()))
 
     def log_prob(self, value):
         v = raw(ensure_tensor(value)).astype(jnp.int32)

@@ -4,7 +4,22 @@ Mirrors the reference's ``paddle.dtype`` surface (reference:
 paddle/phi/common/data_type.h, python/paddle/framework/dtype.py) but the
 canonical representation is simply ``jnp.dtype`` — XLA owns layout/packing,
 so no DataType enum is needed.
+
+64-bit policy (TPU-native, differs from the reference on purpose): the
+reference's default index/integer dtype is int64; on TPU the VPU/MXU and
+XLA's index paths are 32-bit, and JAX disables 64-bit types by default
+(``jax_enable_x64``).  paddle_tpu OWNS this narrowing instead of leaking
+jax's per-call UserWarning: any int64/uint64/float64/complex128 request
+is mapped to its 32/64-bit-half sibling at the ``convert_dtype`` seam,
+with a single startup-style notice the first time it happens.  Arrays
+big enough to need int64 indexing (>2^31 elements) exceed a single
+chip's HBM anyway; users who truly need 64-bit math can call
+``jax.config.update("jax_enable_x64", True)`` before importing, which
+this seam respects.
 """
+import warnings
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,15 +52,48 @@ _ALIASES = {
 _DEFAULT_DTYPE = [jnp.float32]
 
 
+_NARROW_64 = {np.dtype(np.int64): np.dtype(np.int32),
+              np.dtype(np.uint64): np.dtype(np.uint32),
+              np.dtype(np.float64): np.dtype(np.float32),
+              np.dtype(np.complex128): np.dtype(np.complex64)}
+_NARROW_NOTICED = [False]
+
+
+def _apply_64bit_policy(d):
+    if d in _NARROW_64 and not jax.config.jax_enable_x64:
+        if not _NARROW_NOTICED[0]:
+            _NARROW_NOTICED[0] = True
+            warnings.warn(
+                "paddle_tpu maps 64-bit dtypes (int64/float64/...) to "
+                "their 32-bit siblings: TPU compute and XLA indexing are "
+                "32-bit and jax_enable_x64 is off. This notice is shown "
+                "once; enable x64 in jax.config to keep 64-bit types.",
+                stacklevel=3)
+        return _NARROW_64[d]
+    return d
+
+
+def index_dtype():
+    """Index dtype under the 64-bit policy above: the reference's int64
+    narrowed to int32 on TPU unless jax_enable_x64 is set.  Internal —
+    reads the policy table directly so framework-originated calls never
+    consume the once-only user notice."""
+    d = np.dtype(np.int64)
+    if not jax.config.jax_enable_x64:
+        return _NARROW_64[d]
+    return d
+
+
 def convert_dtype(dtype):
-    """Normalize any dtype spec (str | np/jnp dtype | None) to a numpy dtype."""
+    """Normalize any dtype spec (str | np/jnp dtype | None) to a numpy
+    dtype, applying the module-level 64-bit narrowing policy."""
     if dtype is None:
         return None
     if isinstance(dtype, str):
         if dtype not in _ALIASES:
             raise ValueError(f"unknown dtype {dtype!r}")
-        return np.dtype(_ALIASES[dtype])
-    return np.dtype(dtype)
+        return _apply_64bit_policy(np.dtype(_ALIASES[dtype]))
+    return _apply_64bit_policy(np.dtype(dtype))
 
 
 def set_default_dtype(d):

@@ -28,11 +28,33 @@ def _empty_fill(out, ids, num, dtype):
                      jnp.zeros((), dtype))
 
 
-def _segment(op_name, data, segment_ids):
+def _segment_count(ids, num_segments):
+    """Static segment count: the explicit hint, else concretized from
+    eager ids.  Traced ids (jit/to_static) have no concrete max — XLA
+    needs a static output shape — so the hint becomes mandatory there,
+    mirroring graph_send_recv's out_size contract."""
+    if num_segments is not None:
+        v = (num_segments._value if hasattr(num_segments, "_value")
+             else num_segments)
+        if isinstance(v, jax.core.Tracer):
+            raise ValueError(
+                "segment ops: num_segments must be a static value (it is "
+                "the XLA output shape); got a traced tensor — pass a "
+                "Python int")
+        return int(v)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ops: segment_ids is traced (inside jit/to_static), "
+            "so the segment count cannot be read from its values; pass "
+            "num_segments= explicitly (static output shape for XLA)")
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(op_name, data, segment_ids, num_segments=None):
     data = ensure_tensor(data)
     segment_ids = ensure_tensor(segment_ids)
     ids = segment_ids._value.astype(jnp.int32)
-    num = int(ids.max()) + 1 if ids.size else 0
+    num = _segment_count(ids, num_segments)
 
     def _seg(v):
         fn = getattr(jax.ops, f"segment_{op_name}")
@@ -43,17 +65,18 @@ def _segment(op_name, data, segment_ids):
     return call_op(_seg, data)
 
 
-def segment_sum(data, segment_ids, name=None):
-    """reference: paddle.incubate.segment_sum."""
-    return _segment("sum", data, segment_ids)
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    """reference: paddle.incubate.segment_sum (num_segments: TPU-native
+    extension — required when segment_ids is traced)."""
+    return _segment("sum", data, segment_ids, num_segments)
 
 
-def segment_mean(data, segment_ids, name=None):
+def segment_mean(data, segment_ids, name=None, num_segments=None):
     """reference: paddle.incubate.segment_mean."""
     data = ensure_tensor(data)
     segment_ids = ensure_tensor(segment_ids)
     ids = segment_ids._value.astype(jnp.int32)
-    num = int(ids.max()) + 1 if ids.size else 0
+    num = _segment_count(ids, num_segments)
 
     def _mean(v):
         s = jax.ops.segment_sum(v, ids, num_segments=num)
@@ -64,14 +87,14 @@ def segment_mean(data, segment_ids, name=None):
     return call_op(_mean, data)
 
 
-def segment_max(data, segment_ids, name=None):
+def segment_max(data, segment_ids, name=None, num_segments=None):
     """reference: paddle.incubate.segment_max."""
-    return _segment("max", data, segment_ids)
+    return _segment("max", data, segment_ids, num_segments)
 
 
-def segment_min(data, segment_ids, name=None):
+def segment_min(data, segment_ids, name=None, num_segments=None):
     """reference: paddle.incubate.segment_min."""
-    return _segment("min", data, segment_ids)
+    return _segment("min", data, segment_ids, num_segments)
 
 
 def _segment_reduce(msgs, dst, num, pool):

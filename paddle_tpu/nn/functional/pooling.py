@@ -7,6 +7,8 @@ import jax.numpy as jnp
 from ...framework.autograd import call_op
 from ...tensor._helpers import ensure_tensor
 from .conv import _tuple, _padding
+from ...framework.dtypes import index_dtype as _i64
+
 
 
 def _window(kernel, stride, n, data_format):
@@ -106,7 +108,7 @@ def _argmax_pool(v, dims, strides, pad):
     vals, idx = jax.lax.reduce_window(
         (v, flat_idx), init, reducer, dims, strides,
         pad if isinstance(pad, str) else pad)
-    return idx.astype(jnp.int64)
+    return idx.astype(_i64())
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -322,7 +324,7 @@ def _fractional_max_pool_nd(x, output_size, kernel_size, random_u,
 def _frac_argmax(v, bounds, out_sz, k, n):
     import itertools
     flat_idx = jnp.arange(int(np.prod(v.shape))).reshape(v.shape)
-    outs = jnp.zeros(v.shape[:2] + out_sz, jnp.int64)
+    outs = jnp.zeros(v.shape[:2] + out_sz, _i64())
     spatial = v.shape[2:2 + n]
     for pos in itertools.product(*(range(o) for o in out_sz)):
         sl = [slice(None), slice(None)]

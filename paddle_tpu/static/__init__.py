@@ -413,8 +413,11 @@ def py_func(func, x, out, backward_func=None,
     against ``out``).  ``backward_func(*inputs, *outputs, *out_grads) ->
     in_grads`` (the reference contract) registers a custom vjp (also a
     host callback); inputs listed in ``skip_vars_in_backward_input`` are
-    omitted from the backward call.  Without backward_func the op is
-    non-differentiable (pure_callback has no autodiff rule)."""
+    omitted from the backward CALL ONLY — backward_func still returns
+    one gradient per forward input, in forward order, skipped or not
+    (the reference's contract: its docs' tanh example skips x from the
+    backward input yet tanh_grad returns dx).  Without backward_func the
+    op is non-differentiable (pure_callback has no autodiff rule)."""
     import jax
     import numpy as np
     import jax.numpy as jnp

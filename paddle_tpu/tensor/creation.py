@@ -6,6 +6,7 @@ from ..framework.core import Tensor, to_tensor
 from ..framework.autograd import call_op
 from ..framework import dtypes
 from ._helpers import ensure_tensor
+from ..framework.dtypes import index_dtype as _i64
 
 
 def _d(dtype, default=None):
@@ -73,8 +74,12 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         start, end = 0, start
     d = dtypes.convert_dtype(dtype)
     if d is None:
-        d = (np.dtype("int64") if all(isinstance(v, (int, np.integer))
-             for v in (start, end, step)) else dtypes.get_default_dtype())
+        # reference default is int64 for integer bounds; the framework's
+        # 64-bit policy (framework/dtypes.py) narrows it on TPU
+        d = (dtypes.convert_dtype("int64")
+             if all(isinstance(v, (int, np.integer))
+                    for v in (start, end, step))
+             else dtypes.get_default_dtype())
     return Tensor(jnp.arange(start, end, step, dtype=d))
 
 
@@ -169,7 +174,7 @@ def assign(x, output=None):
 
 
 def numel(x, name=None):
-    return Tensor(jnp.asarray(ensure_tensor(x).size, dtype=jnp.int64))
+    return Tensor(jnp.asarray(ensure_tensor(x).size, dtype=_i64()))
 
 
 def clone(x, name=None):

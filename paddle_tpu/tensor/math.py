@@ -6,6 +6,7 @@ from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from ..framework import dtypes
 from ._helpers import ensure_tensor, unary_op, binary_op, reduce_op
+from ..framework.dtypes import index_dtype as _i64
 
 # -- elementwise unary -------------------------------------------------------
 exp = unary_op(jnp.exp)
@@ -177,7 +178,7 @@ def _cummaxmin(x, axis, op, cmp):
         # index of the running extremum: latest position where vv equals vals
         hit = jnp.where(cmp(vv, vals), pos, -1)
         idx = jax.lax.associative_scan(jnp.maximum, hit, axis=ax)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(_i64())
     return call_op(_cm, x)
 
 

@@ -11,6 +11,7 @@ from ..framework import dtypes
 from ..framework.random import next_key
 from ._helpers import ensure_tensor
 from .creation import _shape, _d
+from ..framework.dtypes import index_dtype as _i64
 
 
 def rand(shape, dtype=None, name=None):
@@ -46,7 +47,7 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
     return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
-                                     dtype=_d(dtype, jnp.int64)))
+                                     dtype=_d(dtype, _i64())))
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
@@ -60,7 +61,7 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
 
 def randperm(n, dtype="int64", name=None):
     return Tensor(jax.random.permutation(next_key(), n).astype(
-        _d(dtype, jnp.int64)))
+        _d(dtype, _i64())))
 
 
 def bernoulli(x, name=None):
@@ -90,11 +91,11 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
                                      if v.ndim > 1 else (num_samples,))
         if v.ndim > 1:
             out = jnp.moveaxis(out, 0, -1)
-        return Tensor(out.astype(jnp.int64))
+        return Tensor(out.astype(_i64()))
     # without replacement: Gumbel top-k trick
     g = jax.random.gumbel(next_key(), v.shape)
     _, idx = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(idx.astype(jnp.int64))
+    return Tensor(idx.astype(_i64()))
 
 
 def exponential_(x, lam=1.0, name=None):
@@ -148,16 +149,16 @@ def binomial(count, prob, name=None):
         def body(carry, key):
             acc, i = carry
             u = jax.random.uniform(key, tuple(shape))
-            acc = acc + ((u < p_b) & (i < n_b)).astype(jnp.int64)
+            acc = acc + ((u < p_b) & (i < n_b)).astype(_i64())
             return (acc, i + 1), None
         (acc, _), _ = lax.scan(
-            body, (jnp.zeros(shape, jnp.int64), jnp.int32(0)), keys)
+            body, (jnp.zeros(shape, _i64()), jnp.int32(0)), keys)
         return Tensor(acc)
     g = jax.random.normal(next_key(), tuple(shape))
     mean = n_b * p_b
     std = jnp.sqrt(jnp.maximum(n_b * p_b * (1.0 - p_b), 1e-12))
     samp = jnp.round(mean + std * g)
-    return Tensor(jnp.clip(samp, 0, n_b).astype(jnp.int64))
+    return Tensor(jnp.clip(samp, 0, n_b).astype(_i64()))
 
 
 def log_normal(mean=1.0, std=2.0, shape=None, name=None):

@@ -10,6 +10,7 @@ from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from ..framework import dtypes
 from ._helpers import ensure_tensor
+from ..framework.dtypes import index_dtype as _i64
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
@@ -33,7 +34,7 @@ def argsort(x, axis=-1, descending=False, stable=False, name=None):
         idx = jnp.argsort(v, axis=axis, stable=stable or descending)
         if descending:
             idx = jnp.flip(idx, axis=axis)
-        return idx.astype(jnp.int64)
+        return idx.astype(_i64())
     return call_op(_as, x)
 
 
@@ -61,7 +62,7 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
             vals, idx = jax.lax.top_k(-vv, k)
             vals = -vals
         return (jnp.moveaxis(vals, -1, axis),
-                jnp.moveaxis(idx.astype(jnp.int64), -1, axis))
+                jnp.moveaxis(idx.astype(_i64()), -1, axis))
     return call_op(_tk, x)
 
 
@@ -76,7 +77,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         if keepdim:
             vals = jnp.expand_dims(vals, axis)
             idx = jnp.expand_dims(idx, axis)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(_i64())
     return call_op(_kv, x)
 
 
@@ -103,8 +104,8 @@ def mode(x, axis=-1, keepdim=False, name=None):
         if keepdim:
             vals, idx = vals[..., None], idx[..., None]
             return (jnp.moveaxis(vals, -1, axis),
-                    jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
-        return vals, idx.astype(jnp.int64)
+                    jnp.moveaxis(idx, -1, axis).astype(_i64()))
+        return vals, idx.astype(_i64())
     return call_op(_mode, x)
 
 
@@ -121,9 +122,9 @@ def nonzero(x, as_tuple=False):
     arr = np.asarray(x._value)
     nz = np.nonzero(arr)
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(i[:, None], dtype=jnp.int64))
+        return tuple(Tensor(jnp.asarray(i[:, None], dtype=_i64()))
                      for i in nz)
-    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=_i64()))
 
 
 def masked_select(x, mask, name=None):
@@ -144,7 +145,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False,
             flat_v = v.reshape(-1, v.shape[-1])
             out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
                 flat_s, flat_v).reshape(v.shape)
-        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        return out.astype(jnp.int32 if out_int32 else _i64())
     return call_op(_ssd, ss, values)
 
 

@@ -13,6 +13,8 @@ from ..io import Dataset
 from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from ..nn.layer.layers import Layer
+from ..framework.dtypes import index_dtype as _i64
+
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
            "Conll05st", "ViterbiDecoder", "viterbi_decode"]
@@ -251,7 +253,7 @@ def viterbi_decode(potentials, transition_params, lengths,
             [tag0[:, None], jnp.moveaxis(tags_later, 0, 1)], axis=1)  # [B,T]
         t_idx = jnp.arange(T)[None, :]
         paths = jnp.where(t_idx < lens[:, None], paths, 0)
-        return score, paths.astype(jnp.int64)
+        return score, paths.astype(_i64())
 
     pots = potentials._value if isinstance(potentials, Tensor) \
         else jnp.asarray(potentials)

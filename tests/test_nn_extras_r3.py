@@ -493,3 +493,203 @@ def test_model_average_two_window():
         # average spans ALL 4 samples (old window 1,2,3 + live 10)
         np.testing.assert_allclose(lin.weight.numpy(),
                                    np.full((2, 2), 4.0), rtol=1e-6)
+
+
+def _lattice_np(lpb, lpe):
+    """Numpy transducer DP parameterized by the blank/emit lattices
+    directly (single example, full lengths) — the FastEmit surrogate
+    reference: L~ = L(lpb, lpe) + lam * L(frozen lpb, lpe)."""
+    T, U1 = lpb.shape
+    U = U1 - 1
+    alpha = np.full((T, U1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U1):
+            if t == 0 and u == 0:
+                continue
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + lpb[t - 1, u])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + lpe[t, u - 1])
+            alpha[t, u] = np.logaddexp.reduce(cands)
+    return -(alpha[T - 1, U] + lpb[T - 1, U])
+
+
+def test_rnnt_fastemit_gradient_finite_difference():
+    """FastEmit (VERDICT r3 #7): grad of rnnt_loss(fastemit_lambda=lam)
+    must equal the exact gradient of the surrogate
+    L + lam * L(stop_grad(blank), emit), finite-differenced in f64."""
+    rng = np.random.RandomState(5)
+    T, U, V, lam = 3, 2, 4, 0.3
+    z0 = rng.randn(T, U + 1, V).astype("f8")
+    labels = rng.randint(1, V, (U,)).astype("i4")
+
+    def lsm(z):
+        m = z - z.max(-1, keepdims=True)
+        return m - np.log(np.exp(m).sum(-1, keepdims=True))
+
+    def split(z):
+        lp = lsm(z)
+        lpb = lp[:, :, 0]
+        lpe = np.stack([lp[:, u, labels[u]] for u in range(U)], 1)
+        return lpb, lpe
+
+    lpb0, lpe0 = split(z0)
+
+    def f_full(z):                      # L(lpb(z), lpe(z))
+        return _lattice_np(*split(z))
+
+    def f_frozen(z):                    # L(sg(lpb), lpe(z))
+        return _lattice_np(lpb0, split(z)[1])
+
+    eps = 1e-5
+    ref = np.zeros_like(z0)
+    for i in np.ndindex(z0.shape):
+        zp, zm = z0.copy(), z0.copy()
+        zp[i] += eps
+        zm[i] -= eps
+        ref[i] = ((f_full(zp) - f_full(zm))
+                  + lam * (f_frozen(zp) - f_frozen(zm))) / (2 * eps)
+
+    x = paddle.to_tensor(z0[None].astype("f4"), stop_gradient=False)
+    loss = F.rnnt_loss(x, paddle.to_tensor(labels[None]),
+                       np.asarray([T], "i4"), np.asarray([U], "i4"),
+                       blank=0, fastemit_lambda=lam, reduction="none")
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy()[0], ref, rtol=2e-3,
+                               atol=2e-4)
+    # identity forward: regularizer must not move the loss value
+    plain = F.rnnt_loss(paddle.to_tensor(z0[None].astype("f4")),
+                        paddle.to_tensor(labels[None]),
+                        np.asarray([T], "i4"), np.asarray([U], "i4"),
+                        blank=0, fastemit_lambda=0.0, reduction="none")
+    np.testing.assert_allclose(loss.numpy(), plain.numpy(), rtol=1e-6)
+    # lam > 0 must actually change the gradient
+    x2 = paddle.to_tensor(z0[None].astype("f4"), stop_gradient=False)
+    F.rnnt_loss(x2, paddle.to_tensor(labels[None]), np.asarray([T], "i4"),
+                np.asarray([U], "i4"), blank=0, fastemit_lambda=0.0,
+                reduction="none").backward()
+    assert np.abs(x.grad.numpy() - x2.grad.numpy()).max() > 1e-4
+
+
+def test_segment_ops_traced_ids_num_segments_hint():
+    """ADVICE r3: traced segment_ids need an explicit num_segments (XLA
+    static shapes); without it the error must be clear, not a
+    ConcretizationTypeError."""
+    import paddle_tpu.incubate as inc
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], "f4")
+    ids = np.asarray([0, 0, 1], "i4")
+
+    def traced(v, i):
+        return inc.segment_sum(paddle.to_tensor(v), paddle.to_tensor(i),
+                               num_segments=2)._value
+
+    out = jax.jit(traced)(jnp.asarray(x), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(out), [[4.0, 6.0], [5.0, 6.0]])
+
+    # mean/max/min take the hint too
+    def traced_mean(v, i):
+        return inc.segment_mean(paddle.to_tensor(v), paddle.to_tensor(i),
+                                num_segments=2)._value
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(traced_mean)(jnp.asarray(x), jnp.asarray(ids))),
+        [[2.0, 3.0], [5.0, 6.0]])
+
+    with pytest.raises(ValueError, match="num_segments"):
+        jax.jit(lambda v, i: inc.segment_sum(
+            paddle.to_tensor(v), paddle.to_tensor(i))._value)(
+            jnp.asarray(x), jnp.asarray(ids))
+
+
+def test_batch_isend_irecv_rejects_inconsistent_shift():
+    """ADVICE r3: a batch whose send and recv peers imply different
+    rotations must be rejected (the SPMD lowering can only bake one
+    uniform shift), not silently mistraced."""
+    import paddle_tpu.distributed as dist
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map as smap
+    from paddle_tpu.framework.core import Tensor
+
+    dist.init_parallel_env()
+    g = dist.new_group(list(range(8)), axis_name="g2")
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("g2",))
+
+    def bad(v):
+        t = Tensor(v)
+        recv_buf = Tensor(jnp.zeros_like(v))
+        # send to rank+1 but claim to receive from rank+2
+        ops = [dist.P2POp(dist.isend, t, 1, g),
+               dist.P2POp(dist.irecv, recv_buf, 2, g)]
+        dist.batch_isend_irecv(ops)
+        return recv_buf._value
+
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    with pytest.raises(ValueError, match="uniform shift|same rotation"):
+        smap(bad, mesh, P("g2"), P("g2"))(x)
+
+
+def test_py_func_skip_vars_backward_shapes():
+    """ADVICE r3 (adjudicated): skip_vars_in_backward_input only trims
+    the backward CALL; backward_func still returns one gradient per
+    forward input in forward order — the reference contract (its docs'
+    tanh example skips x yet returns dx).  Multi-input + mixed shapes
+    exercise the declared callback shapes."""
+    from paddle_tpu import static
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], "f4"), stop_gradient=False)
+    y = paddle.to_tensor(np.asarray([[3.0], [4.0], [5.0]], "f4"),
+                         stop_gradient=False)  # different shape than x
+
+    def fwd(a, b):
+        return a * float(b.sum())
+
+    # backward sees only x (y skipped) but returns (gx, gy)
+    def bwd(a, out, gout):
+        return gout * 12.0, np.zeros((3, 1), "f4") + float(
+            (gout * a).sum())
+
+    r = static.py_func(fwd, [x, y], paddle.zeros([2]), backward_func=bwd,
+                       skip_vars_in_backward_input=[y])
+    r.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0, 12.0])
+    np.testing.assert_allclose(y.grad.numpy(), np.full((3, 1), 3.0))
+
+
+def test_batch_isend_irecv_bidirectional_pairs_by_shift():
+    """Send/recv ops pair by implied shift, not declaration order: a
+    bidirectional exchange declared sends-first must work."""
+    import paddle_tpu.distributed as dist
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map as smap
+    from paddle_tpu.framework.core import Tensor
+
+    dist.init_parallel_env()
+    g = dist.new_group(list(range(8)), axis_name="g3")
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("g3",))
+
+    def bidir(v):
+        t = Tensor(v)
+        fwd_buf = Tensor(jnp.zeros_like(v))
+        bwd_buf = Tensor(jnp.zeros_like(v))
+        ops = [dist.P2POp(dist.isend, t, 1, g),          # to rank+1
+               dist.P2POp(dist.isend, Tensor(v * 10.0), 7, g),  # to rank-1
+               dist.P2POp(dist.irecv, fwd_buf, 7, g),    # from rank-1
+               dist.P2POp(dist.irecv, bwd_buf, 1, g)]    # from rank+1
+        dist.batch_isend_irecv(ops)
+        return fwd_buf._value + bwd_buf._value
+
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = smap(bidir, mesh, P("g3"), P("g3"))(x)
+    expect = np.roll(np.arange(8.0), 1) + 10.0 * np.roll(np.arange(8.0), -1)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), expect)
+
+
+def test_segment_num_segments_traced_hint_rejected():
+    import paddle_tpu.incubate as inc
+    x = np.asarray([[1.0], [2.0]], "f4")
+    ids = np.asarray([0, 1], "i4")
+    with pytest.raises(ValueError, match="static"):
+        jax.jit(lambda v, i, m: inc.segment_sum(
+            paddle.to_tensor(v), paddle.to_tensor(i),
+            num_segments=paddle.to_tensor(m))._value)(
+            jnp.asarray(x), jnp.asarray(ids), jnp.asarray(2))
