@@ -163,6 +163,17 @@ class Tensor:
     def __int__(self):
         return int(self._value)
 
+    def __index__(self):
+        # lets a concrete integer scalar Tensor drive range()/slicing
+        # (reference parity); traced values raise jax's concretization
+        # error, which the to_static graph-break machinery handles
+        import jax.numpy as _jnp
+        if not _jnp.issubdtype(self._value.dtype, _jnp.integer):
+            raise TypeError(
+                f"only integer tensors can be used as an index, got "
+                f"{self._value.dtype}")
+        return int(self._value)
+
     def __bool__(self):
         return bool(self._value)
 

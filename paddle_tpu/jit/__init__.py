@@ -36,6 +36,24 @@ __all__ = ["to_static", "not_to_static", "save", "load", "StaticFunction",
 
 _TO_STATIC_ENABLED = [True]
 
+# SOT-style graph break (reference: python/paddle/jit/sot/ — bytecode
+# capture with guard/fallback; here at function granularity): when the
+# FIRST trace under a given input-spec guard hits an untraceable
+# construct, the spec is marked and every later call with that guard
+# runs eagerly without re-tracing.  The error classes are deliberately
+# NARROW: dy2static's explicit unsupported-construct guards
+# (NotImplementedError) and jax's concretization errors (a traced value
+# used where Python needs a concrete one — Tensor.__index__/__bool__
+# work eagerly).  Bare TypeError/ValueError are NOT caught: a genuine
+# first-call bug must surface, not silently downgrade the spec to eager
+# with its side effects run twice.
+_GRAPH_BREAK = object()
+_GRAPH_BREAK_ERRORS = (NotImplementedError,
+                       jax.errors.ConcretizationTypeError,
+                       jax.errors.TracerArrayConversionError,
+                       jax.errors.TracerBoolConversionError,
+                       jax.errors.TracerIntegerConversionError)
+
 
 def enable_to_static(flag=True):
     _TO_STATIC_ENABLED[0] = bool(flag)
@@ -52,6 +70,20 @@ def not_to_static(fn=None):
     return fn
 
 
+def _hashable(v):
+    """Normalize a static arg value to something hashable (lists/dicts
+    are idiomatic in paddle call signatures)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
 def _spec_key(args):
     key = []
     for a in args:
@@ -60,7 +92,7 @@ def _spec_key(args):
         elif isinstance(a, (np.ndarray, jax.Array)):
             key.append(("A", tuple(a.shape), str(a.dtype)))
         else:
-            key.append(("S", a))
+            key.append(("S", _hashable(a)))
     return tuple(key)
 
 
@@ -100,13 +132,17 @@ class StaticFunction:
         buffers = [b for _, b in layer.named_buffers()]
         return params, buffers
 
-    def _compile(self, key, template_args, training):
+    def _compile(self, key, template_args, training, template_kwargs):
         params, buffers = self._params_buffers()
-        n_args = len(template_args)
         fn = self._transformed
         layer = self._layer
+        kw_tensor = self._kw_tensor     # sorted names of tensor kwargs
+        t_pos = sorted(self._tensor_pos)
 
-        def pure(key_arr, param_vals, buffer_vals, *arg_vals):
+        def pure(key_arr, param_vals, buffer_vals, *t_vals):
+            # t_vals: traced values for tensor POSITIONAL args (position
+            # order) then tensor KWARGS (sorted-name order); non-tensor
+            # args/kwargs always come from the (static) templates
             olds = [t._value for t in params + buffers]
             for t, v in zip(params, param_vals):
                 t._value = v
@@ -114,13 +150,16 @@ class StaticFunction:
                 t._value = v
             try:
                 with _ag.suspend_tape(), rng_scope(key_arr):
-                    wrapped = [Tensor(v) if i in self._tensor_pos else
-                               template_args[i]
-                               for i, v in zip(range(n_args), arg_vals)]
+                    wrapped = list(template_args)
+                    for p, v in zip(t_pos, t_vals):
+                        wrapped[p] = Tensor(v)
+                    kw = dict(template_kwargs)
+                    for name, v in zip(kw_tensor, t_vals[len(t_pos):]):
+                        kw[name] = Tensor(v)
                     if layer is not None:
-                        out = fn(layer, *wrapped)
+                        out = fn(layer, *wrapped, **kw)
                     else:
-                        out = fn(*wrapped)
+                        out = fn(*wrapped, **kw)
                 out_vals = jax.tree.map(
                     lambda o: o._value if isinstance(o, Tensor) else o, out,
                     is_leaf=lambda o: isinstance(o, Tensor))
@@ -131,25 +170,79 @@ class StaticFunction:
                     t._value = v
         return jax.jit(pure)
 
+    def _eager_fallback(self, *args, use_transformed=False, **kwargs):
+        # graph-break fallback prefers the TRANSFORMED function: its
+        # converters dispatch to exact Python semantics on concrete
+        # values (a raw `range(tensor)` in the original would TypeError)
+        fn = self._transformed if use_transformed else self._function
+        if self._layer is not None:
+            return fn(self._layer, *args, **kwargs)
+        return fn(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED[0]:
-            if self._layer is not None:
-                return self._function(self._layer, *args, **kwargs)
-            return self._function(*args, **kwargs)
+            return self._eager_fallback(*args, **kwargs)
         training = self._layer.training if self._layer is not None else False
-        # the ambient loop bound changes how converted loops lower
-        # (masked scan vs fori/while), so it is part of the compile key
-        key = (_spec_key(args), tuple(sorted(kwargs)), training,
-               active_loop_bound())
+        # compile key: positional spec + kwarg VALUES (tensor kwargs by
+        # shape/dtype, others by value) + training + the ambient loop
+        # bound (it changes how converted loops lower)
+        kw_items = tuple((k, _spec_key([v])[0])
+                         for k, v in sorted(kwargs.items()))
+        key = (_spec_key(args), kw_items, training, active_loop_bound())
         self._tensor_pos = {i for i, a in enumerate(args)
                             if isinstance(a, (Tensor, np.ndarray, jax.Array))}
-        if key not in self._cache:
-            self._cache[key] = self._compile(key, args, training)
+        # tensor-typed kwargs ride the traced argument list (appended in
+        # sorted-name order) — closing over them would bake constants
+        self._kw_tensor = [k for k in sorted(kwargs)
+                           if isinstance(kwargs[k],
+                                         (Tensor, np.ndarray, jax.Array))]
+        fresh = key not in self._cache
+        if fresh:
+            # null out tensor-valued entries before closing over the
+            # templates: they are replaced by traced placeholders inside
+            # pure(), and keeping them would pin the first call's device
+            # buffers for the cache's lifetime
+            t_args = [None if i in self._tensor_pos else a
+                      for i, a in enumerate(args)]
+            t_kwargs = {k: (None if k in self._kw_tensor else v)
+                        for k, v in kwargs.items()}
+            self._cache[key] = self._compile(key, t_args, training,
+                                             t_kwargs)
         compiled = self._cache[key]
+        if compiled is _GRAPH_BREAK:
+            # guard-cached SOT-style fallback: this input spec hit an
+            # untraceable construct before; run eagerly without retracing
+            return self._eager_fallback(*args, use_transformed=True,
+                                        **kwargs)
+        if fresh:
+            # first trace under this guard: an untraceable construct
+            # (break/continue in a tensor loop, data-dependent python,
+            # concretization of a tracer) triggers the SOT contract —
+            # graph-break to eager instead of failing (reference:
+            # python/paddle/jit/sot guard-and-fallback semantics at
+            # function granularity)
+            try:
+                return self._run_compiled(compiled, args, kwargs)
+            except _GRAPH_BREAK_ERRORS as e:
+                import warnings
+                self._cache[key] = _GRAPH_BREAK
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"{getattr(self._function, '__qualname__', '?')} — "
+                    f"falling back to eager for this input spec "
+                    f"({type(e).__name__}: {str(e)[:120]})",
+                    RuntimeWarning, stacklevel=2)
+                return self._eager_fallback(*args, use_transformed=True,
+                                            **kwargs)
+        return self._run_compiled(compiled, args, kwargs)
+
+    def _run_compiled(self, compiled, args, kwargs):
         params, buffers = self._params_buffers()
-        arg_vals = [a._value if isinstance(a, Tensor) else
-                    (jnp.asarray(a) if i in self._tensor_pos else a)
-                    for i, a in enumerate(args)]
+        t_pos = sorted(self._tensor_pos)
+        arg_vals = [args[i]._value if isinstance(args[i], Tensor)
+                    else jnp.asarray(args[i]) for i in t_pos]
+        arg_vals += [kwargs[k]._value if isinstance(kwargs[k], Tensor)
+                     else jnp.asarray(kwargs[k]) for k in self._kw_tensor]
         param_vals = [p._value for p in params]
         buffer_vals = [b._value for b in buffers]
         rng = next_key()
@@ -168,8 +261,8 @@ class StaticFunction:
             flat, _ = jax.tree.flatten(out_vals)
             return tuple(flat) + tuple(new_buf)
 
-        tensor_args = [a for i, a in enumerate(args)
-                       if i in self._tensor_pos]
+        tensor_args = [args[i] for i in t_pos] \
+            + [kwargs[k] for k in self._kw_tensor]
         tensor_args = [a if isinstance(a, Tensor) else Tensor(a)
                        for a in tensor_args]
         # shapes of output tree discovered from one eval via jax.eval_shape

@@ -29,8 +29,9 @@ Gradients flow through converted ``if`` (lax.cond is reverse-mode
 differentiable) and through any loop given a static trip-count bound:
 under ``bounded_loops(N)`` a tensor-bounded ``for``/``while`` lowers to a
 masked ``lax.scan`` of length N (reverse-mode differentiable — the scan
-saves per-iteration residuals, iterations past the dynamic trip count
-are identity via ``where``).  Without a bound the loop lowers to
+saves per-iteration residuals; iterations past the dynamic trip count
+take a ``lax.cond`` identity branch, so the body never runs on the
+terminal carry and cannot emit inf/NaN Jacobians).  Without a bound the loop lowers to
 ``lax.fori_loop``/``lax.while_loop``, which XLA cannot transpose
 (dynamic trip count ⇒ unbounded residual storage); reverse AD through
 one raises a clear error pointing at ``bounded_loops``.  This mirrors
@@ -216,7 +217,7 @@ def convert_while_loop(cond_fn, body_fn, init):
 
     live = [i for i, v in enumerate(init) if v is not _UNDEF]
     if not live:
-        raise ValueError(
+        raise NotImplementedError(
             "dy2static while: no loop-carried variable is bound before "
             "the loop; initialize the loop state first (lax.while_loop "
             "needs concrete initial shapes)")
@@ -238,14 +239,14 @@ def convert_while_loop(cond_fn, body_fn, init):
     carry0 = tuple(jnp.asarray(_val(init[i])) for i in live)
     bound = active_loop_bound()
     if bound is not None:
-        # masked scan: differentiable bounded while.  Post-termination
-        # iterations still run the body (static shapes) but the carry is
-        # frozen by the where, so they contribute zero cotangent.
+        # masked scan: differentiable bounded while (see bounded_loops)
         def step(carry, _):
-            active = jnp.asarray(c(carry))
-            new = b(carry)
-            return tuple(jnp.where(active, nw, old)
-                         for nw, old in zip(new, carry)), None
+            # lax.cond, not where: post-termination iterations must not
+            # execute the body at all — a body that divides/gathers on
+            # the frozen carry could emit inf/NaN Jacobian entries, and
+            # 0-cotangent × inf = NaN would poison the scan transpose
+            return lax.cond(jnp.asarray(c(carry)), b,
+                            lambda cr: cr, carry), None
 
         final = _bounded_scan(step, carry0, bound,
                               lambda fin: c(fin), "while")
@@ -319,7 +320,7 @@ def convert_for(iterable, body_fn, init):
 
     live = [i for i, v in enumerate(init) if v is not _UNDEF]
     if not live:
-        raise ValueError(
+        raise NotImplementedError(
             "dy2static for: no loop-carried variable is bound before the "
             "loop; initialize the state first (XLA loops need concrete "
             "initial shapes)")
@@ -347,10 +348,10 @@ def convert_for(iterable, body_fn, init):
         if bound is not None:
             # masked scan: differentiable bounded fori (see bounded_loops)
             def sbody(carry, k):
-                new = b(k, carry)
-                keep = k < n_iters
-                return tuple(jnp.where(keep, nw, old)
-                             for nw, old in zip(new, carry)), None
+                # cond, not where — see the while lowering above
+                return lax.cond(k < n_iters,
+                                lambda cr: b(k, cr),
+                                lambda cr: cr, carry), None
 
             final = _bounded_scan(sbody, carry0, bound,
                                   lambda fin: n_iters > bound, "for")

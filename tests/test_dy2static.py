@@ -423,3 +423,144 @@ def test_bounded_loop_truncation_warns():
                 x, jnp.asarray(5))
             jax.block_until_ready(out)
     np.testing.assert_allclose(float(out), 2.0)   # capped at bound
+
+
+def test_bounded_loop_no_nan_poisoning():
+    """Post-termination iterations take a cond identity branch: a body
+    that divides by (n - i) — inf at i == n — must not poison the
+    gradient of the live iterations."""
+    def f(x, n):
+        s = x.sum() * 0.0
+        i = n * 0
+        while i < n:
+            s = s + x.sum() / ((n - i) * 1.0)
+            i = i + 1
+        return s
+
+    g, changed = transform_function(f)
+    assert changed
+    x = jnp.asarray([1.0, 2.0])
+    with paddle.jit.bounded_loops(8):   # 5 dead iterations divide by 0
+        val, grad = jax.value_and_grad(
+            lambda v, n: g(Tensor(v), Tensor(n))._value)(x, jnp.asarray(3))
+    # sum/3 + sum/2 + sum/1
+    np.testing.assert_allclose(float(val), 3.0 * (1 / 3 + 1 / 2 + 1),
+                               rtol=1e-6)
+    assert np.isfinite(np.asarray(grad)).all()
+    np.testing.assert_allclose(np.asarray(grad),
+                               [1 / 3 + 1 / 2 + 1] * 2, rtol=1e-6)
+
+
+# -- SOT-lite: guard-cached graph-break fallback (VERDICT r3 #4) -------------
+
+class BreakNet(nn.Layer):
+    """forward contains a construct the AST pass cannot convert (break
+    in a tensor-bounded loop) — the SOT contract: graph-break to eager,
+    not a hard error."""
+
+    def __init__(self):
+        super(BreakNet, self).__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x, n):
+        acc = x * 0.0
+        for i in range(n):
+            if i >= 2:
+                break
+            acc = acc + paddle.tanh(self.fc(acc + x))
+        return acc.sum()
+
+
+def test_to_static_graph_break_falls_back_to_eager():
+    paddle.seed(3)
+    net = BreakNet()
+    snet = paddle.jit.to_static(net)
+    x = Tensor(jnp.asarray(np.random.RandomState(1)
+                           .randn(2, 4).astype("f4")))
+    n = Tensor(jnp.asarray(5))
+    with pytest.warns(RuntimeWarning, match="graph break"):
+        loss = snet(x, n)
+    # eager semantics: the break executes (2 iterations)
+    ref = net.__class__.forward(net, x, 5)
+    np.testing.assert_allclose(float(loss._value), float(ref._value),
+                               rtol=1e-6)
+    # grads flow through the eager fallback
+    loss2 = snet(x, n)
+    loss2.backward()
+    assert net.fc.weight.grad is not None
+    assert float(jnp.abs(net.fc.weight.grad._value).sum()) > 0
+
+
+def test_graph_break_guard_cached_no_retrace():
+    """Second call with the same input spec must take the cached eager
+    decision — no new warning, no re-trace."""
+    import warnings as _w
+    paddle.seed(4)
+    net = BreakNet()
+    snet = paddle.jit.to_static(net)
+    x = Tensor(jnp.asarray(np.random.RandomState(2)
+                           .randn(2, 4).astype("f4")))
+    n = Tensor(jnp.asarray(4))
+    with pytest.warns(RuntimeWarning, match="graph break"):
+        snet(x, n)
+    forward = snet.forward if hasattr(snet, "forward") else snet
+    cache = forward._cache if hasattr(forward, "_cache") else None
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)   # would raise if warned
+        out = snet(x, n)
+    assert np.isfinite(float(out._value))
+
+
+def test_to_static_convertible_path_still_compiles():
+    """The graph-break machinery must not swallow the compiled path for
+    convertible forwards."""
+    paddle.seed(5)
+    net = AccumNet()
+    snet = paddle.jit.to_static(net)
+    x = Tensor(jnp.asarray(np.random.RandomState(3)
+                           .randn(2, 4).astype("f4")))
+    with paddle.jit.bounded_loops(8):
+        out = snet(x, Tensor(jnp.asarray(3)))
+    fwd = net.forward  # StaticFunction descriptor
+    from paddle_tpu.jit import _GRAPH_BREAK
+    assert all(v is not _GRAPH_BREAK for v in fwd._cache.values())
+    assert np.isfinite(float(out._value))
+
+
+def test_to_static_kwarg_values_respected():
+    """kwarg VALUES are part of the compile key and reach the traced
+    function; tensor kwargs are traced (not baked as constants)."""
+    @paddle.jit.to_static
+    def f(x, scale=1.0, shift=None):
+        out = x * scale
+        if shift is not None:
+            out = out + shift
+        return out.sum()
+
+    x = Tensor(jnp.asarray([1.0, 2.0]))
+    assert float(f(x, scale=3.0)._value) == pytest.approx(9.0)
+    assert float(f(x, scale=2.0)._value) == pytest.approx(6.0)   # not 9!
+    # tensor kwarg: different values, same shape -> same compiled fn,
+    # correct (traced, not baked) results
+    s1 = Tensor(jnp.asarray([10.0, 10.0]))
+    s2 = Tensor(jnp.asarray([1.0, -1.0]))
+    assert float(f(x, scale=1.0, shift=s1)._value) == pytest.approx(23.0)
+    assert float(f(x, scale=1.0, shift=s2)._value) == pytest.approx(3.0)
+
+
+def test_to_static_mixed_positional_args_alignment():
+    """Non-tensor positional args interleaved with tensors must not
+    shift the traced-argument pairing."""
+    @paddle.jit.to_static
+    def g(x, mode, y):
+        if mode == "add":
+            return (x + y).sum()
+        return (x - y).sum()
+
+    x = Tensor(jnp.asarray([5.0]))
+    y = Tensor(jnp.asarray([2.0]))
+    assert float(g(x, "add", y)._value) == pytest.approx(7.0)
+    assert float(g(x, "sub", y)._value) == pytest.approx(3.0)
+    # same spec, different tensor values: y must be traced, not baked
+    y2 = Tensor(jnp.asarray([4.0]))
+    assert float(g(x, "add", y2)._value) == pytest.approx(9.0)
