@@ -140,6 +140,15 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     drop = dropout if training else 0.0
     from ...framework.random import next_key
     dkey = next_key() if drop and drop > 0.0 else None
+    if return_softmax:
+        # debug mode: dense path materializes the probabilities
+        out, p = call_op(
+            lambda a, b, c: _raw(a, b, c, cu_q, cu_k, max_seqlen_q,
+                                 max_seqlen_k, scale=scale, dropout=drop,
+                                 causal=bool(causal), dropout_key=dkey,
+                                 return_softmax=True),
+            q, k, v)
+        return out, p
     out = call_op(
         lambda a, b, c: _raw(a, b, c, cu_q, cu_k, max_seqlen_q,
                              max_seqlen_k, scale=scale, dropout=drop,
