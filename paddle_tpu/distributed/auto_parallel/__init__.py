@@ -42,6 +42,8 @@ class Strategy:
         self.mp_degree = 1
         self.dp_degree = 1
         self.pp_degree = 1
+        self.sep_degree = 1     # ring/Ulysses sequence parallelism
+        self.ep_degree = 1      # expert parallelism (MoE)
         if config:
             for k, v in config.items():
                 setattr(self, k, v)
@@ -64,43 +66,72 @@ class Engine:
 
     # -- plan ----------------------------------------------------------------
     def _degrees(self):
-        """Resolve (dp, sharding, mp, pp) from the Strategy + world size.
-        Explicit degrees win; dp absorbs the remainder."""
+        """Resolve (dp, sharding, mp, pp, sep, ep) from the Strategy +
+        world size.  Explicit degrees win; dp absorbs the remainder."""
         s = self._strategy
         n = jax.device_count()
         mp = int(getattr(s, "mp_degree", 1) or 1)
         pp = int(getattr(s, "pp_degree", 1) or 1) \
             if s.pipeline.get("enable") else 1
+        sep = int(getattr(s, "sep_degree", 1) or 1)
+        ep = int(getattr(s, "ep_degree", 1) or 1)
         sh = 1
         if s.sharding.get("enable"):
             sh = int(s.sharding.get("degree", 1) or 1)
             if sh <= 1:
-                # degree unset: shard across everything left after mp/pp
-                sh = max(n // (mp * pp), 1)
+                # degree unset: shard across everything left over
+                sh = max(n // (mp * pp * sep * ep), 1)
         dp_explicit = int(getattr(s, "dp_degree", 0) or 0)
         # the default dp_degree=1 means "infer": dp absorbs the devices
-        # left over after mp/pp/sharding; an explicit >1 value wins
+        # left over after mp/pp/sharding/sep/ep; an explicit >1 value wins
         dp = dp_explicit if dp_explicit > 1 \
-            else max(n // (mp * pp * sh), 1)
-        return dp, sh, mp, pp
+            else max(n // (mp * pp * sh * sep * ep), 1)
+        return dp, sh, mp, pp, sep, ep
 
     def _build_plan(self):
-        """dpxsharding x mp mesh honoring Strategy.sharding.degree (the pp
-        axis is handled by the fleet _PipelineStepper route, not here)."""
+        """dp x sharding x sep x expert x model mesh honoring the Strategy
+        degrees (the pp axis is handled by the fleet _PipelineStepper
+        route, not here).  The axis names match the fleet topology plus
+        the dedicated "expert" axis, so sep_attention's auto-shard_map
+        and MoE's expert-pspec land on the right devices."""
         s = self._strategy
         level = None
         if s.sharding.get("enable"):
             level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(
                 s.sharding.get("stage", 1), "os")
-        dp, sh, mp, _ = self._degrees()
-        if sh > 1 or mp > 1:
+        dp, sh, mp, _, sep, ep = self._degrees()
+        # (re)register the ambient sep mesh for THIS plan — and clear a
+        # stale one from a previous Engine when this plan has no sep
+        # axis, so sep_attention outside shard_map fails loudly instead
+        # of silently riding an old topology
+        from ..fleet.utils.sep_utils import set_sep_mesh
+        if sh > 1 or mp > 1 or sep > 1 or ep > 1:
             import numpy as np
             from jax.sharding import Mesh
+            n = dp * sh * sep * ep * mp
             mesh = Mesh(
-                np.asarray(jax.devices()[:dp * sh * mp]).reshape(dp, sh, mp),
-                ("data", "sharding", "model"))
+                np.asarray(jax.devices()[:n]).reshape(dp, sh, sep, ep, mp),
+                ("data", "sharding", "sep", "expert", "model"))
+            set_sep_mesh(mesh if sep > 1 else None)
             return PlacementPlan(mesh, level=level)
+        set_sep_mesh(None)
         return make_data_parallel_plan(level=level)
+
+    def _rebind_expert_axis(self, net):
+        """Strategy.ep_degree > 1: route MoE layers onto the dedicated
+        "expert" mesh axis (their default is "model", which the TP axis
+        owns) by rewriting the stacked-expert param pspecs."""
+        if self._degrees()[5] <= 1:
+            return
+        from ...incubate.distributed.models.moe import MoELayer
+        for sub in net.sublayers(include_self=True):
+            if isinstance(sub, MoELayer) and sub.expert_axis != "expert":
+                sub.expert_axis = "expert"
+                for nm in ("expert_w1", "expert_b1",
+                           "expert_w2", "expert_b2"):
+                    p = getattr(sub, nm, None)
+                    if p is not None and getattr(p, "pspec", None):
+                        p.pspec = ("expert",) + tuple(p.pspec[1:])
 
     def _is_pipeline(self):
         from ..fleet.meta_parallel import PipelineLayer
@@ -117,6 +148,7 @@ class Engine:
         net = self._network
         if getattr(net, "_placement_plan", None) is None:
             net._placement_plan = self._build_plan()
+        self._rebind_expert_axis(net)
         m = Model(net)
         amp_level = None
         if self._strategy.amp.get("enable"):
@@ -132,10 +164,16 @@ class Engine:
         through the same parallelizer the fleet API uses)."""
         from .. import fleet
         s = self._strategy
-        dp, sh, mp, pp = self._degrees()
+        dp, sh, mp, pp, sep, ep = self._degrees()
+        if ep > 1:
+            raise NotImplementedError(
+                "Engine: ep_degree > 1 with Strategy.pipeline is not "
+                "supported (the fleet topology has no expert axis); use "
+                "the non-pipeline Engine path for MoE models")
         fs = fleet.DistributedStrategy()
         fs.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
-                             "pp_degree": pp, "sharding_degree": sh}
+                             "pp_degree": pp, "sharding_degree": sh,
+                             "sep_degree": sep}
         pcfg = {"accumulate_steps":
                 int(s.pipeline.get("accumulate_steps", 1) or 1)}
         if s.pipeline.get("micro_batch_size"):

@@ -241,3 +241,102 @@ def test_engine_honors_sharding_degree():
     assert plan.mesh.shape["sharding"] == 2
     assert plan.mesh.shape["model"] == 2
     assert plan.level == "os_g"
+
+
+# -- Engine sep/ep axes (VERDICT r3 #9) ---------------------------------------
+
+class _SepMoENet(nn.Layer):
+    """Tiny block exercising BOTH new Engine axes: sep_attention over the
+    sequence axis + an MoE FFN over the expert axis."""
+
+    def __init__(self, d=16, heads=2, n_expert=4):
+        super().__init__()
+        from paddle_tpu.incubate.distributed.models.moe import (
+            MoELayer, ExpertLayer)
+        self.qkv = nn.Linear(d, 3 * d)
+        self.proj = nn.Linear(d, d)
+        self.moe = MoELayer(d, [ExpertLayer(d, 2 * d)
+                                for _ in range(n_expert)],
+                            dispatch_mode="dense")
+        self.head = nn.Linear(d, 1)
+        self.d, self.heads = d, heads
+
+    def forward(self, x):
+        from paddle_tpu.distributed.fleet.utils.sep_utils import (
+            sep_attention)
+        B, S, D = x.shape
+        qkv = self.qkv(x).reshape([B, S, 3, self.heads, D // self.heads])
+        o = sep_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                          is_causal=True)
+        h = x + self.proj(o.reshape([B, S, D]))
+        h = h + self.moe(h.reshape([B * S, D])).reshape([B, S, D])
+        return self.head(h).mean(axis=[1, 2])
+
+
+class _DenseAttnMoENet(_SepMoENet):
+    """Golden twin: identical math with single-device dense attention."""
+
+    def forward(self, x):
+        import math
+        B, S, D = x.shape
+        qkv = self.qkv(x).reshape([B, S, 3, self.heads, D // self.heads])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        hd = D // self.heads
+        s = paddle.matmul(q.transpose([0, 2, 1, 3]),
+                          k.transpose([0, 2, 3, 1])) / math.sqrt(hd)
+        mask = paddle.tril(paddle.ones([S, S]))
+        s = s + (1.0 - mask) * -1e30
+        p = nn.functional.softmax(s, axis=-1)
+        o = paddle.matmul(p, v.transpose([0, 2, 1, 3]))
+        o = o.transpose([0, 2, 1, 3]).reshape([B, S, D])
+        h = x + self.proj(o)
+        h = h + self.moe(h.reshape([B * S, D])).reshape([B, S, D])
+        return self.head(h).mean(axis=[1, 2])
+
+
+def test_engine_sep_ep_golden_parity():
+    """Engine with sep_degree=2 x ep_degree=2 (dp absorbs to 2) on the
+    8-device mesh: losses match a single-device dense golden."""
+    from paddle_tpu.distributed.fleet.utils.sep_utils import set_sep_mesh
+    steps, lr = 3, 0.05
+    rng = np.random.RandomState(7)
+    xs = [rng.rand(4, 8, 16).astype("f4") for _ in range(steps)]
+    ys = [rng.rand(4).astype("f4") for _ in range(steps)]
+
+    paddle.seed(21)
+    net = _SepMoENet()
+    s = Strategy()
+    s.sep_degree = 2
+    s.ep_degree = 2
+    opt = paddle.optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    eng = Engine(net, loss=nn.MSELoss(), optimizer=opt, strategy=s)
+    try:
+        m = eng._ensure_model()
+        # the plan mesh carries all five axes with sep/expert active
+        plan = net._placement_plan
+        assert dict(zip(plan.mesh.axis_names,
+                        [plan.mesh.shape[a] for a in plan.mesh.axis_names])
+                    ) == {"data": 2, "sharding": 1, "sep": 2, "expert": 2,
+                          "model": 1}
+        # ep routing rewired the MoE onto the expert axis
+        assert net.moe.expert_axis == "expert"
+        assert net.moe.expert_w1.pspec[0] == "expert"
+        losses = [float(m.train_batch([x], [y])[0])
+                  for x, y in zip(xs, ys)]
+    finally:
+        set_sep_mesh(None)
+
+    paddle.seed(21)
+    golden = _DenseAttnMoENet()
+    gopt = paddle.optimizer.SGD(learning_rate=lr,
+                                parameters=golden.parameters())
+    glosses = []
+    for x, y in zip(xs, ys):
+        out = golden(paddle.to_tensor(x))
+        loss = nn.MSELoss()(out, paddle.to_tensor(y))
+        loss.backward()
+        gopt.step()
+        gopt.clear_grad()
+        glosses.append(float(loss))
+
+    np.testing.assert_allclose(losses, glosses, rtol=2e-4, atol=2e-5)
