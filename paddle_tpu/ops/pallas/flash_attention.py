@@ -563,15 +563,16 @@ def _pick_hb(BH, S, D, n_bufs, budget=2 * 1024 * 1024):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                              "with_lse", "interpret"))
+                                              "with_lse", "interpret", "hb"))
 def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
                        block_k=DEFAULT_BLOCK_K, with_lse=True,
-                       interpret=False):
+                       interpret=False, hb=None):
     BH, S, D = q.shape
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
-    hb = _pick_hb(BH, S, D, n_bufs=4, budget=1280 * 1024)  # measured: hb=2 best at S=1024
+    if hb is None:  # hb is a REAL static arg so autotune sweeps retrace
+        hb = _pick_hb(BH, S, D, n_bufs=4, budget=1280 * 1024)  # hb=2 best at S=1024 (measured)
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
     out_specs = [spec]
     out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype)]
@@ -598,15 +599,16 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                              "interpret"))
+                                              "interpret", "hb"))
 def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                       interpret=False):
+                       interpret=False, hb=None):
     BH, S, D = q.shape
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
-    hb = _pick_hb(BH, S, D, n_bufs=7, budget=1024 * 1024)  # bwd: hb=1 measured flat-optimal
+    if hb is None:  # static arg: see fwd
+        hb = _pick_hb(BH, S, D, n_bufs=7, budget=1024 * 1024)  # bwd: hb=1 measured flat-optimal
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
     spec_l = pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0))
     return pl.pallas_call(
