@@ -42,6 +42,7 @@ from . import inference  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
 from .autograd import PyLayer  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

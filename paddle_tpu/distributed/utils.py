@@ -1,0 +1,27 @@
+"""paddle.distributed.utils (reference: python/paddle/distributed/utils/
+— log utils + the MoE global_scatter/global_gather all-to-all ops).
+
+TPU-native mapping: the reference's ragged count-driven NCCL
+all-to-alls are expressed as static-shape ``lax.all_to_all`` exchanges
+over capacity-bucketed dispatch buffers — the implementation lives with
+the MoE machinery (incubate/distributed/models/moe/utils.py) and is
+re-exported here for the reference import path.
+"""
+import logging
+
+from ..incubate.distributed.models.moe.utils import (  # noqa: F401
+    global_scatter, global_gather)
+
+__all__ = ["get_logger", "global_scatter", "global_gather"]
+
+
+def get_logger(log_level=logging.INFO, name="paddle_tpu.distributed"):
+    """reference: paddle.distributed.utils.log_utils.get_logger."""
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        logger.addHandler(h)
+    return logger

@@ -7,7 +7,7 @@ the step cadence, no special kernels needed.
 """
 import jax.numpy as jnp
 
-from ..optimizer.optimizer import Optimizer
+from ...optimizer.optimizer import Optimizer
 
 __all__ = ["LookAhead", "ModelAverage"]
 
@@ -56,7 +56,7 @@ class LookAhead(Optimizer):
         self.inner.clear_grad(set_to_zero)
 
     def state_dict(self):
-        from ..framework.core import Tensor
+        from ...framework.core import Tensor
         sd = self.inner.state_dict()
         sd["lookahead_step"] = self._steps
         for i, p in enumerate(self.inner._parameter_list or []):
@@ -164,3 +164,7 @@ class _SwapCtx:
     def __exit__(self, *exc):
         self._ma.restore()
         return False
+
+
+# paddle.incubate.optimizer.functional (minimize_bfgs/minimize_lbfgs)
+from . import functional  # noqa: E402,F401

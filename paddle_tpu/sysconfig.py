@@ -1,0 +1,25 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building custom C++ ops against the installed
+framework).
+
+TPU-native: the native seam is ``csrc/`` (C++ built with g++ + ctypes
+bindings, see framework/native.py); get_include points at its headers
+and get_lib at the lazily-built shared library directory.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def get_include():
+    """Directory containing the framework's C++ headers (common.h)."""
+    return _CSRC
+
+
+def get_lib():
+    """Directory containing libpaddle_tpu_native.so (built on first
+    native-feature use; run paddle_tpu.framework.native functions or
+    `make -C csrc` to materialize it)."""
+    return os.path.join(_CSRC, "build")

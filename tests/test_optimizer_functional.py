@@ -1,0 +1,77 @@
+"""minimize_bfgs / minimize_lbfgs (reference:
+test/legacy_test/test_minimize_{bfgs,lbfgs}.py — quadratic + Rosenbrock
+convergence, jit-compatibility)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.optimizer.functional import (minimize_bfgs,
+                                                      minimize_lbfgs)
+
+
+def _quad(x):
+    # f(x) = 0.5 x^T A x - b^T x with SPD A; minimum at A^-1 b
+    A = jnp.asarray([[3.0, 0.5], [0.5, 1.0]])
+    b = jnp.asarray([1.0, -2.0])
+    return 0.5 * x @ A @ x - b @ x
+
+
+_QUAD_MIN = np.linalg.solve(np.asarray([[3.0, 0.5], [0.5, 1.0]]),
+                            np.asarray([1.0, -2.0]))
+
+
+def _rosenbrock(x):
+    return (1.0 - x[0]) ** 2 + 100.0 * (x[1] - x[0] ** 2) ** 2
+
+
+@pytest.mark.parametrize("minimize", [minimize_bfgs, minimize_lbfgs])
+def test_quadratic_converges(minimize):
+    # tolerance_grad=1e-5: the default 1e-7 sits at f32 machine eps
+    out = minimize(_quad, np.asarray([0.0, 0.0], "f4"), max_iters=50,
+                   tolerance_grad=1e-5)
+    converged, nf, x, fx, gx = out[:5]
+    assert bool(converged.numpy())
+    np.testing.assert_allclose(x.numpy(), _QUAD_MIN, atol=1e-4)
+    assert float(jnp.max(jnp.abs(gx._value))) < 1e-3
+    assert int(nf.numpy()) > 0
+
+
+@pytest.mark.parametrize("minimize", [minimize_bfgs, minimize_lbfgs])
+def test_rosenbrock_converges(minimize):
+    # NOTE: the framework 64-bit policy keeps jax_enable_x64 off, so
+    # this executes in f32 — tolerances are f32-appropriate
+    out = minimize(_rosenbrock, np.asarray([-1.2, 1.0], "f4"),
+                   max_iters=200)
+    converged, nf, x, fx, gx = out[:5]
+    np.testing.assert_allclose(x.numpy(), [1.0, 1.0], atol=5e-3)
+    assert float(fx.numpy()) < 1e-5
+
+
+def test_lbfgs_small_history_ring_buffer():
+    out = minimize_lbfgs(_rosenbrock, np.asarray([-1.2, 1.0], "f4"),
+                         history_size=3, max_iters=300)
+    _, _, x, fx, _ = out[:5]
+    np.testing.assert_allclose(x.numpy(), [1.0, 1.0], atol=5e-3)
+
+
+def test_lbfgs_initial_inverse_hessian_seed_used():
+    """The provided H0 seed must change the iterates (it preconditions
+    the two-loop recursion)."""
+    H0 = np.diag([1.0, 0.01]).astype("f4")
+    out_a = minimize_lbfgs(_quad, np.asarray([0.0, 0.0], "f4"),
+                           max_iters=1)
+    out_b = minimize_lbfgs(_quad, np.asarray([0.0, 0.0], "f4"),
+                           max_iters=1,
+                           initial_inverse_hessian_estimate=H0)
+    assert not np.allclose(out_a[2].numpy(), out_b[2].numpy())
+
+
+def test_bfgs_tensor_objective_and_initial_position():
+    # objective written against the paddle Tensor API, Tensor x0
+    def f(x):
+        return ((x - paddle.to_tensor(np.asarray([2.0, -1.0], "f4"))) ** 2
+                ).sum()
+    out = minimize_bfgs(f, paddle.to_tensor(np.zeros(2, "f4")))
+    _, _, x, fx, _ = out[:5]
+    np.testing.assert_allclose(x.numpy(), [2.0, -1.0], atol=1e-4)
