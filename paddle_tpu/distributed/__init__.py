@@ -26,6 +26,7 @@ from . import rpc  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import utils  # noqa: F401
+from . import communication  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 

@@ -1,0 +1,15 @@
+"""paddle.distributed.communication (reference:
+python/paddle/distributed/communication/ — the package the collective
+API migrated to; paddle.distributed re-exports it).
+
+Here the implementations live in ``distributed.collective`` (XLA
+collectives over ICI/DCN); this package provides the reference import
+paths, including the ``stream`` namespace (on TPU there are no CUDA
+streams — PJRT owns scheduling — so stream.* are the same ops; the
+sync_op/use_calc_stream flags are accepted and meaningless)."""
+from ..collective import (  # noqa: F401
+    all_reduce, all_gather, reduce, broadcast, scatter, reduce_scatter,
+    alltoall, alltoall_single, send, recv, barrier, ReduceOp,
+    all_gather_object, broadcast_object_list, scatter_object_list,
+    gather, batch_isend_irecv, P2POp, isend, irecv, get_backend)
+from . import stream  # noqa: F401

@@ -1,0 +1,6 @@
+"""paddle.distributed.communication.stream — stream-variant collective
+API (reference: .../communication/stream/).  PJRT owns scheduling on
+TPU; these are the same XLA collectives (flags accepted, no-op)."""
+from ..collective import (  # noqa: F401
+    all_reduce, all_gather, reduce, broadcast, scatter, reduce_scatter,
+    alltoall, alltoall_single, send, recv)

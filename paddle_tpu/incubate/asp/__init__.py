@@ -1,0 +1,142 @@
+"""paddle.incubate.asp — Automatic SParsity (reference:
+python/paddle/incubate/asp/ — 2:4 structured pruning: prune_model
+computes n:m masks, decorate(optimizer) re-applies them after every
+step so pruned weights stay pruned through training).
+
+TPU-native: masks are plain jnp 0/1 arrays stored next to each pruned
+parameter (``param.asp_mask``); ``decorate`` wraps ``optimizer.step``
+to multiply the masks back in after the update (one fused elementwise
+per pruned param — XLA folds it into the update kernel).  v5e has no
+sparse-MXU path, so 2:4 here is a MODEL-SIZE/regularization feature
+(and an export-compatible mask layout), not a FLOP win — documented,
+unlike silently pretending sparse speedup.
+
+Supported mask algorithms: ``mask_1d`` (reference default: per
+contiguous group of m weights along the last axis keep the n largest
+|w|) and ``mask_2d_greedy``/``mask_2d_best`` mapped onto mask_1d over
+both orientations picking the better Frobenius retention.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ... import nn as _nn
+
+__all__ = ["decorate", "prune_model", "calculate_density",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+_EXCLUDED = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """reference: asp.set_excluded_layers — skip these params in
+    prune_model (by parameter or layer name substring)."""
+    for n in (param_names if isinstance(param_names, (list, tuple))
+              else [param_names]):
+        _EXCLUDED.add(str(n))
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x):
+    """reference: asp.calculate_density — fraction of nonzeros."""
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return float(jnp.mean((arr != 0).astype(jnp.float32)))
+
+
+def _mask_1d(w, n, m):
+    """Per contiguous m-group along the LAST axis keep the n largest
+    |w| (the reference's get_mask_1d)."""
+    shape = w.shape
+    flat = w.reshape(-1, m)
+    order = jnp.argsort(jnp.abs(flat), axis=-1)        # ascending
+    keep = order[:, m - n:]                            # top-n indices
+    mask = jnp.zeros_like(flat)
+    rows = jnp.arange(flat.shape[0])[:, None]
+    mask = mask.at[rows, keep].set(1.0)
+    return mask.reshape(shape)
+
+
+def _compute_mask(w, n, m, algo):
+    if w.shape[-1] % m:
+        return None                                    # not maskable
+    if algo in ("mask_1d",):
+        return _mask_1d(w, n, m)
+    if algo in ("mask_2d_greedy", "mask_2d_best"):
+        # both orientations of mask_1d; keep the one retaining more
+        # weight magnitude (a cheap stand-in for the reference's 2d
+        # permutation search, which is host-side numpy there too)
+        m1 = _mask_1d(w, n, m)
+        if w.shape[0] % m == 0:
+            m2 = jnp.swapaxes(
+                _mask_1d(jnp.swapaxes(w, 0, -1), n, m), 0, -1)
+            r1 = jnp.sum(jnp.abs(w) * m1)
+            r2 = jnp.sum(jnp.abs(w) * m2)
+            return jnp.where(r1 >= r2, m1, m2)
+        return m1
+    raise ValueError(f"unknown mask_algo {algo!r}")
+
+
+def _prunable_params(model):
+    for name, layer in model.named_sublayers(include_self=True):
+        if type(layer) not in (_nn.Linear, _nn.Conv2D):
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or len(w.shape) < 2:
+            continue
+        full = f"{name}.weight" if name else "weight"
+
+        def _excluded():
+            lname = layer.full_name() if hasattr(layer, "full_name") \
+                else ""
+            for ex in _EXCLUDED:
+                # exact param name, exact layer name, or a layer-name
+                # PREFIX at a dot boundary ("0" excludes "0.weight" but
+                # not "10.weight")
+                if ex in (full, name, lname) or \
+                        full.startswith(ex + "."):
+                    return True
+            return False
+        if _excluded():
+            continue
+        yield full, w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference: asp.prune_model — compute n:m masks for every
+    supported layer's weight, zero the pruned entries, and (with_mask)
+    remember the mask for decorate()'s post-step re-application."""
+    masks = {}
+    for full, w in _prunable_params(model):
+        mask = _compute_mask(w._value.astype(jnp.float32), n, m,
+                             mask_algo)
+        if mask is None:
+            continue
+        mask = mask.astype(w._value.dtype)
+        w._value = w._value * mask
+        if with_mask:
+            w.asp_mask = mask
+        masks[full] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """reference: asp.decorate — wrap optimizer.step so that masked
+    weights stay zero through updates (mask re-applied after step)."""
+    if getattr(optimizer, "_asp_decorated", False):
+        return optimizer
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._parameter_list or []:
+            mask = getattr(p, "asp_mask", None)
+            if mask is not None:
+                p._value = p._value * mask
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

@@ -271,3 +271,15 @@ Tensor.element_size = _element_size
 Tensor.nbytes = property(
     lambda self: int(self._value.size
                      * jnp.dtype(self._value.dtype).itemsize))
+
+
+# round-4 additions: windowed views, masked/indexed fills (+ in-place)
+Tensor.unfold = _manip.unfold_windows
+Tensor.masked_scatter = _manip.masked_scatter
+Tensor.masked_scatter_ = _inplace(_manip.masked_scatter)
+Tensor.index_fill = _manip.index_fill
+Tensor.index_fill_ = _inplace(_manip.index_fill)
+Tensor.scatter_ = _inplace(_manip.scatter)
+Tensor.signbit = _math.signbit
+Tensor.polygamma = _math.polygamma
+Tensor.pdist = _linalg.pdist

@@ -1,5 +1,6 @@
 """Linear algebra (reference: python/paddle/tensor/linalg.py → Phi
 kernels backed by cuBLAS/cuSOLVER; here XLA's native linalg lowering)."""
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -278,3 +279,36 @@ def householder_product(x, tau, name=None):
     tau = ensure_tensor(tau)
     return call_op(
         lambda a, t: jax.lax.linalg.householder_product(a, t), x, tau)
+
+
+def pdist(x, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """reference: paddle.pdist — condensed pairwise distances of the
+    rows of a (N, D) matrix: the upper-triangle (i < j) of cdist,
+    flattened to (N*(N-1)/2,)."""
+    x = ensure_tensor(x)
+
+    def _pdist(v):
+        n = v.shape[0]
+        d = jnp.sum(jnp.abs(v[:, None, :] - v[None, :, :]) ** p,
+                    axis=-1) ** (1.0 / p)
+        iu, ju = jnp.triu_indices(n, k=1)
+        return d[iu, ju]
+    return call_op(_pdist, x)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """reference: paddle.histogramdd — D-dimensional histogram of a
+    (N, D) sample.  Returns (hist, list-of-edges)."""
+    from ..framework.core import Tensor as _T
+    x = ensure_tensor(x)
+    w = None if weights is None else ensure_tensor(weights)._value
+    if isinstance(bins, _T):
+        bins = np.asarray(bins._value)
+    if isinstance(bins, (list, tuple)):
+        bins = [np.asarray(b._value) if isinstance(b, _T) else b
+                for b in bins]
+    hist, edges = jnp.histogramdd(x._value, bins=bins, range=ranges,
+                                  density=density, weights=w)
+    return _T(hist), [_T(e) for e in edges]

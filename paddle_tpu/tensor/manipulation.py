@@ -395,3 +395,65 @@ def as_complex(x, name=None):
 
 
 import jax  # noqa: E402  (used by as_complex)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """reference: paddle.masked_scatter — fill True positions of mask
+    (broadcast to x) with CONSECUTIVE elements of value (row-major)."""
+    x = ensure_tensor(x)
+    mask = ensure_tensor(mask)
+    value = ensure_tensor(value)
+
+    def _ms(v, m, val):
+        m = jnp.broadcast_to(m, v.shape)
+        flat_m = m.reshape(-1)
+        # reference contract: value must supply every True position
+        # (validated when the mask is concrete; a traced mask cannot be
+        # counted and falls back to clamping on the last element)
+        import jax as _jax
+        if not isinstance(flat_m, _jax.core.Tracer):
+            need = int(flat_m.sum())
+            if need > val.size:
+                raise ValueError(
+                    f"masked_scatter: mask selects {need} elements but "
+                    f"value has only {val.size}")
+        # k-th True position takes value.flatten()[k]
+        idx = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = val.reshape(-1)
+        take = src[jnp.clip(idx, 0, src.shape[0] - 1)]
+        out = jnp.where(flat_m, take.astype(v.dtype), v.reshape(-1))
+        return out.reshape(v.shape)
+    return call_op(_ms, x, mask, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    """reference: paddle.index_fill — set full slices at `index` along
+    `axis` to the scalar `value`."""
+    x = ensure_tensor(x)
+    idx = (index._value if hasattr(index, "_value")
+           else jnp.asarray(index)).astype(jnp.int32)
+    if hasattr(value, "_value"):
+        value = value._value
+
+    def _if(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+    return call_op(_if, x)
+
+
+def unfold_windows(x, axis, size, step, name=None):
+    """reference: paddle.Tensor.unfold(axis, size, step) — sliding
+    windows along `axis`, window dim appended last (nn.functional.unfold
+    is the im2col op and lives in nn)."""
+    x = ensure_tensor(x)
+
+    def _uf(v):
+        n = v.shape[axis]
+        starts = jnp.arange(0, n - size + 1, step)
+        gather = starts[:, None] + jnp.arange(size)[None, :]   # (W, size)
+        moved = jnp.moveaxis(v, axis, 0)                       # (n, ...)
+        win = moved[gather]                                    # (W, size, ...)
+        win = jnp.moveaxis(win, 1, -1)                         # (W, ..., size)
+        return jnp.moveaxis(win, 0, axis)
+    return call_op(_uf, x)

@@ -346,3 +346,19 @@ def vander(x, n=None, increasing=False, name=None):
     x = ensure_tensor(x)
     return call_op(lambda v: jnp.vander(
         v, N=n, increasing=increasing), x)
+
+
+signbit = unary_op(jnp.signbit)
+
+
+def polygamma(x, n, name=None):
+    """reference: paddle.polygamma — n-th derivative of digamma;
+    preserves a floating input dtype."""
+    from jax.scipy.special import polygamma as _pg
+    x = ensure_tensor(x)
+
+    def _poly(v):
+        ft = v.dtype if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.float32
+        return _pg(n, v.astype(ft)).astype(ft)
+    return call_op(_poly, x)

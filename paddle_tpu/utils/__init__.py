@@ -1,6 +1,8 @@
 """Utilities (reference: python/paddle/utils/)."""
 import jax
 
+from . import cpp_extension  # noqa: F401
+
 __all__ = ["run_check", "try_import", "unique_name", "deprecated"]
 
 

@@ -603,3 +603,177 @@ class RandomPerspective(BaseTransform):
         end = [jitter(x, y, half_w, half_h) for x, y in start]
         out = perspective(arr, start, end, self.interpolation, self.fill)
         return out.astype(arr.dtype)   # dtype-stable across the prob draw
+
+
+# -- RandAugment (reference: python/paddle/vision/transforms/transforms.py
+# RandAugment; Cubuk et al. 2020) --------------------------------------------
+
+def posterize(img, bits):
+    """reference: F.posterize — keep the top `bits` bits per channel."""
+    arr = _np_img(img)
+    scale = _value_range(arr)
+    u8 = np.clip(np.asarray(arr, np.float64) / scale * 255.0,
+                 0, 255).astype(np.uint8)
+    mask = np.uint8(256 - (1 << (8 - int(bits))))
+    out = (u8 & mask).astype(np.float64) / 255.0 * scale
+    return out.astype(np.asarray(arr).dtype)
+
+
+def solarize(img, threshold):
+    """reference: F.solarize — invert pixels above threshold (threshold
+    on the image's own value scale)."""
+    arr = _np_img(img)
+    scale = _value_range(arr)
+    a = np.asarray(arr, np.float64)
+    out = np.where(a >= threshold, scale - a, a)
+    return out.astype(np.asarray(arr).dtype)
+
+
+def autocontrast(img):
+    """reference: F.autocontrast — per-channel min/max stretch."""
+    arr = _np_img(img)
+    scale = _value_range(arr)
+    a = np.asarray(arr, np.float64)
+    lo = a.min(axis=(0, 1), keepdims=True)
+    hi = a.max(axis=(0, 1), keepdims=True)
+    rng = np.where(hi > lo, hi - lo, 1.0)
+    out = (a - lo) / rng * scale
+    out = np.where(hi > lo, out, a)
+    return out.astype(np.asarray(arr).dtype)
+
+
+def equalize(img):
+    """reference: F.equalize — per-channel histogram equalization (on
+    the 255-value grid, like PIL)."""
+    arr = _np_img(img)
+    scale = _value_range(arr)
+    a = np.clip(np.asarray(arr, np.float64) / scale * 255.0,
+                0, 255).astype(np.uint8)
+    was_2d = a.ndim == 2
+    if was_2d:
+        a = a[:, :, None]
+    chans = []
+    for c in range(a.shape[2]):
+        ch = a[:, :, c]
+        hist = np.bincount(ch.reshape(-1), minlength=256)
+        nz = hist[hist > 0]
+        if nz.size <= 1:
+            chans.append(ch)
+            continue
+        step = (hist.sum() - nz[-1]) // 255
+        if step == 0:
+            chans.append(ch)
+            continue
+        lut = (np.cumsum(hist) - hist // 2) // step
+        lut = np.clip(lut, 0, 255).astype(np.uint8)
+        chans.append(lut[ch])
+    out = np.stack(chans, axis=2).astype(np.float64) / 255.0 * scale
+    if was_2d:
+        out = out[:, :, 0]
+    return out.astype(np.asarray(arr).dtype)
+
+
+def adjust_sharpness(img, sharpness_factor):
+    """reference: F.adjust_sharpness — blend with a 3x3 smoothed copy
+    (factor 0 = smoothed, 1 = original, >1 = sharpened)."""
+    arr = _np_img(img)
+    a = np.asarray(arr, np.float64)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    pad = np.pad(a, ((1, 1), (1, 1), (0, 0)), mode="edge")
+    smooth = np.zeros_like(a)
+    # PIL SMOOTH kernel: center 5, edges 1, normalized by 13
+    w = np.asarray([[1, 1, 1], [1, 5, 1], [1, 1, 1]], np.float64) / 13.0
+    for dy in range(3):
+        for dx in range(3):
+            smooth += w[dy, dx] * pad[dy:dy + a.shape[0],
+                                      dx:dx + a.shape[1]]
+    out = smooth + sharpness_factor * (a - smooth)
+    out = np.clip(out, 0, _value_range(arr))
+    if _np_img(img).ndim == 2:
+        out = out[:, :, 0]
+    return out.astype(np.asarray(arr).dtype)
+
+
+class RandAugment(BaseTransform):
+    """reference: paddle.vision.transforms.RandAugment — apply
+    ``num_ops`` random ops at strength ``magnitude`` (of
+    ``num_magnitude_bins``).  Geometry ops ride the shared homography
+    helper (`perspective`); photometric ops are the functional surface
+    above."""
+
+    def __init__(self, num_ops=2, magnitude=9, num_magnitude_bins=31,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.num_ops = num_ops
+        self.magnitude = magnitude
+        self.bins = num_magnitude_bins
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _corners(self, w, h):
+        return [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+
+    def _warp(self, arr, endpoints):
+        h, w = arr.shape[:2]
+        return perspective(arr, self._corners(w, h), endpoints,
+                           interpolation=self.interpolation,
+                           fill=self.fill)
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        frac = self.magnitude / max(self.bins - 1, 1)
+        scale = _value_range(arr)
+        h, w = arr.shape[:2]
+
+        def shear_x(a):
+            s = 0.3 * frac * (1 if np.random.rand() < 0.5 else -1)
+            d = s * (h - 1)
+            return self._warp(a, [[0, 0], [w - 1, 0],
+                                  [w - 1 + d, h - 1], [d, h - 1]])
+
+        def shear_y(a):
+            s = 0.3 * frac * (1 if np.random.rand() < 0.5 else -1)
+            d = s * (w - 1)
+            return self._warp(a, [[0, 0], [w - 1, d],
+                                  [w - 1, h - 1 + d], [0, h - 1]])
+
+        def translate_x(a):
+            d = 150.0 / 331.0 * w * frac * \
+                (1 if np.random.rand() < 0.5 else -1)
+            c = self._corners(w, h)
+            return self._warp(a, [[x + d, y] for x, y in c])
+
+        def translate_y(a):
+            d = 150.0 / 331.0 * h * frac * \
+                (1 if np.random.rand() < 0.5 else -1)
+            c = self._corners(w, h)
+            return self._warp(a, [[x, y + d] for x, y in c])
+
+        ops = [
+            lambda a: a,                                        # identity
+            shear_x, shear_y, translate_x, translate_y,
+            lambda a: rotate(a, 30.0 * frac *
+                             (1 if np.random.rand() < 0.5 else -1)),
+            lambda a: adjust_brightness(a, 1.0 + 0.9 * frac *
+                                        (1 if np.random.rand() < 0.5
+                                         else -1)),
+            lambda a: adjust_saturation(a, 1.0 + 0.9 * frac *
+                                        (1 if np.random.rand() < 0.5
+                                         else -1)),
+            lambda a: adjust_contrast(a, 1.0 + 0.9 * frac *
+                                      (1 if np.random.rand() < 0.5
+                                       else -1)),
+            lambda a: adjust_sharpness(a, 1.0 + 0.9 * frac *
+                                       (1 if np.random.rand() < 0.5
+                                        else -1)),
+            lambda a: posterize(a, max(1, int(round(8 - 4 * frac)))),
+            lambda a: solarize(a, _value_range(a) * (1.0 - frac)),
+            lambda a: autocontrast(a),
+            lambda a: equalize(a),
+        ]
+        out = arr
+        for _ in range(self.num_ops):
+            op = ops[np.random.randint(0, len(ops))]
+            out = op(out)
+        return out.astype(np.asarray(arr).dtype)

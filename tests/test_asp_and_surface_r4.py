@@ -1,0 +1,85 @@
+"""ASP 2:4 sparsity + round-4 API-surface additions (reference:
+test/asp/test_asp_pruning_*.py — density after prune, mask persistence
+through decorated optimizer steps)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+def test_prune_model_2_4_density():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(net, n=2, m=4)
+    assert len(masks) == 2
+    for _, w in [("0", net[0].weight), ("2", net[2].weight)]:
+        d = asp.calculate_density(w)
+        assert d == pytest.approx(0.5, abs=1e-6)
+        # every contiguous 4-group along the last axis has exactly 2
+        g = np.asarray(w._value).reshape(-1, 4)
+        np.testing.assert_array_equal((g != 0).sum(-1),
+                                      np.full(g.shape[0], 2))
+
+
+def test_decorated_optimizer_keeps_sparsity():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=net.parameters())
+    asp.prune_model(net)
+    opt = asp.decorate(opt)
+    x = paddle.to_tensor(np.random.RandomState(2).rand(4, 8).astype("f4"))
+    y = paddle.to_tensor(np.random.RandomState(3).rand(4, 4).astype("f4"))
+    mask0 = np.asarray(net[0].weight._value != 0)
+    for _ in range(3):
+        loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w = np.asarray(net[0].weight._value)
+    assert (w[~mask0] == 0).all(), "pruned weights must stay zero"
+    assert asp.calculate_density(net[0].weight) == pytest.approx(0.5)
+    # weights actually trained (masked positions moved)
+    assert np.abs(w).sum() > 0
+
+
+def test_excluded_layers_skipped():
+    asp.reset_excluded_layers()
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(["0.weight"])
+    try:
+        masks = asp.prune_model(net)
+        assert "0.weight" not in masks and len(masks) == 1
+        assert asp.calculate_density(net[0].weight) == 1.0
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_mask_2d_best():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 8))
+    asp.prune_model(net, mask_algo="mask_2d_best")
+    assert asp.calculate_density(net[0].weight) == pytest.approx(0.5)
+
+
+def test_round4_namespace_surface():
+    import paddle_tpu.distributed.communication as comm
+    from paddle_tpu.distributed.communication import stream
+    assert comm.ReduceOp is not None and callable(stream.all_reduce)
+    assert callable(paddle.utils.cpp_extension.load)
+    assert callable(paddle.sysconfig.get_include)
+    from paddle_tpu.vision.transforms import RandAugment
+    assert RandAugment is not None
+    from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+    assert callable(minimize_lbfgs)
+    for name in ("signbit", "polygamma", "pdist", "histogramdd",
+                 "masked_scatter", "index_fill"):
+        assert callable(getattr(paddle, name)), name
+    t = paddle.to_tensor(np.zeros((4, 4), "f4"))
+    for meth in ("unfold", "masked_scatter_", "index_fill_", "scatter_",
+                 "signbit"):
+        assert hasattr(t, meth), meth
