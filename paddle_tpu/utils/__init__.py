@@ -68,3 +68,22 @@ def flatten(nest):
             out.append(x)
     _walk(nest)
     return out
+
+
+class dlpack:
+    """paddle.utils.dlpack (reference: python/paddle/utils/dlpack.py) —
+    zero-copy tensor exchange via the DLPack protocol (jax arrays
+    implement __dlpack__; works with torch/numpy/cupy consumers)."""
+
+    @staticmethod
+    def to_dlpack(x):
+        from ..framework.core import Tensor
+        v = x._value if isinstance(x, Tensor) else x
+        return v.__dlpack__()
+
+    @staticmethod
+    def from_dlpack(capsule):
+        import jax.numpy as jnp
+        from ..framework.core import Tensor
+        # jnp.from_dlpack accepts capsules and __dlpack__-bearing objects
+        return Tensor(jnp.from_dlpack(capsule))

@@ -83,3 +83,15 @@ def test_round4_namespace_surface():
     for meth in ("unfold", "masked_scatter_", "index_fill_", "scatter_",
                  "signbit"):
         assert hasattr(t, meth), meth
+
+
+def test_dlpack_roundtrip_torch():
+    """paddle.utils.dlpack: zero-copy exchange with torch (reference:
+    paddle.utils.dlpack.to_dlpack/from_dlpack)."""
+    import torch
+    t = paddle.to_tensor(np.arange(6, dtype="f4").reshape(2, 3))
+    tt = torch.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    assert tuple(tt.shape) == (2, 3) and float(tt.sum()) == 15.0
+    back = paddle.utils.dlpack.from_dlpack(
+        torch.arange(4, dtype=torch.float32))
+    np.testing.assert_allclose(back.numpy(), [0.0, 1.0, 2.0, 3.0])
