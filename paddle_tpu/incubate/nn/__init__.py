@@ -236,3 +236,30 @@ class FusedLinear(Layer):
         def impl(v, w, b):
             return (v @ (w.T if t else w)) + b
         return call_op(impl, x, self.weight, self.bias)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: incubate.nn.FusedBiasDropoutResidualLayerNorm —
+    LN(residual + dropout(x + bias)) as one fused region."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)

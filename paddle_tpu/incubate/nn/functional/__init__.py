@@ -8,6 +8,7 @@ TPU with compatible shapes, falling back to the XLA softmax composition
 """
 import math
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -243,3 +244,255 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
 __all__ += ["fused_rms_norm", "fused_layer_norm",
             "fused_rotary_position_embedding", "swiglu",
             "fused_dropout_add"]
+
+
+def _ln_apply(h, scale, bias, eps):
+    """Shared last-axis layer norm body for the fused ops below."""
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mu) / jnp.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _drop_apply(h, key, rate, mode):
+    """Shared dropout body (reference mode semantics, matching
+    nn.functional.dropout): upscale_in_train scales kept values at
+    train time; downscale_in_infer scales ALL values at eval time."""
+    if key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, h / (1.0 - rate), 0.0)
+        return jnp.where(keep, h, 0.0)
+    if mode == "downscale_in_infer" and rate > 0.0:
+        return h * (1.0 - rate)
+    return h
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference: incubate.nn.functional.fused_linear — matmul + bias in
+    one call (XLA fuses the epilogue; the reference fuses via cublasLt)."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    args = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+
+    def _fl(xv, wv, *b):
+        w = wv.T if transpose_weight else wv
+        out = jnp.dot(xv, w, preferred_element_type=jnp.float32)
+        if b:
+            out = out + b[0]
+        return out.astype(xv.dtype)
+    return call_op(_fl, *args)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """reference: incubate.nn.functional.fused_linear_activation —
+    matmul + bias + activation epilogue."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    bias = ensure_tensor(bias)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "none": lambda v: v}[activation]
+
+    def _fla(xv, yv, bv):
+        a = xv.T if trans_x else xv
+        b = yv.T if trans_y else yv
+        out = jnp.dot(a, b, preferred_element_type=jnp.float32) + bv
+        return act(out).astype(xv.dtype)
+    return call_op(_fla, x, y, bias)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """reference: incubate.nn.functional.fused_bias_dropout_residual_
+    layer_norm — LN(residual + dropout(x + bias)); one fused region
+    under XLA."""
+    from ....framework.random import next_key
+    x = ensure_tensor(x)
+    residual = ensure_tensor(residual)
+    opt = [t for t in (bias, ln_scale, ln_bias) if t is not None]
+    has = [t is not None for t in (bias, ln_scale, ln_bias)]
+    key = next_key() if (training and dropout_rate > 0.0) else None
+
+    def _f(xv, rv, *rest):
+        it = iter(rest)
+        bv = next(it) if has[0] else None
+        sv = next(it) if has[1] else None
+        lbv = next(it) if has[2] else None
+        h = xv if bv is None else xv + bv
+        h = _drop_apply(h, key, dropout_rate, mode)
+        h = h + rv
+        out = _ln_apply(h, sv, lbv, ln_epsilon)
+        return out.astype(xv.dtype)
+    return call_op(_f, x, residual, *[ensure_tensor(t) for t in opt])
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False,
+                      training=True, mode="upscale_in_train", name=None):
+    """reference: incubate.nn.functional.fused_feedforward — the full
+    transformer FFN block: residual + LN around
+    linear2(dropout1(act(linear1(x))))."""
+    from ....framework.random import next_key
+    x = ensure_tensor(x)
+    tensors = {"w1": ensure_tensor(linear1_weight),
+               "w2": ensure_tensor(linear2_weight)}
+    for nm, t in (("b1", linear1_bias), ("b2", linear2_bias),
+                  ("s1", ln1_scale), ("lb1", ln1_bias),
+                  ("s2", ln2_scale), ("lb2", ln2_bias)):
+        if t is not None:
+            tensors[nm] = ensure_tensor(t)
+    names = list(tensors)
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+    k1 = next_key() if (training and dropout1_rate > 0.0) else None
+    k2 = next_key() if (training and dropout2_rate > 0.0) else None
+
+    def _ff(xv, *vals):
+        d = dict(zip(names, vals))
+        h = xv
+        if pre_layer_norm:
+            h = _ln_apply(h, d.get("s1"), d.get("lb1"), ln1_epsilon)
+        h = jnp.dot(h, d["w1"], preferred_element_type=jnp.float32)
+        if "b1" in d:
+            h = h + d["b1"]
+        h = _drop_apply(act(h), k1, dropout1_rate, mode)
+        h = jnp.dot(h, d["w2"], preferred_element_type=jnp.float32)
+        if "b2" in d:
+            h = h + d["b2"]
+        h = xv + _drop_apply(h, k2, dropout2_rate, mode).astype(xv.dtype)
+        if not pre_layer_norm:
+            # post-LN applies the ln2 params only (reference contract)
+            h = _ln_apply(h, d.get("s2"), d.get("lb2"), ln2_epsilon)
+        return h.astype(xv.dtype)
+    return call_op(_ff, x, *tensors.values())
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """reference: incubate.nn.functional.variable_length_memory_
+    efficient_attention — (B, H, S, D) attention with per-batch valid
+    lengths.  TPU-native: length masks folded into one XLA softmax
+    region (the reference's cutlass memory-efficient kernel's job is
+    done by not materializing fp32 probs in HBM — XLA keeps the
+    block-softmax in registers)."""
+    q, k, v = (ensure_tensor(t) for t in (query, key, value))
+    sl = ensure_tensor(seq_lens)._value.reshape(-1).astype(jnp.int32)
+    kvl = ensure_tensor(kv_seq_lens)._value.reshape(-1).astype(jnp.int32)
+    m = None if mask is None else ensure_tensor(mask)._value
+
+    def _vl(qv, kv_, vv):
+        B, H, S, D = qv.shape
+        T = kv_.shape[2]
+        sc = scale or 1.0 / math.sqrt(D)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qv.astype(jnp.float32),
+                            kv_.astype(jnp.float32)) * sc
+        q_live = jnp.arange(S)[None, :] < sl[:, None]          # (B, S)
+        k_live = jnp.arange(T)[None, :] < kvl[:, None]         # (B, T)
+        live = q_live[:, None, :, None] & k_live[:, None, None, :]
+        if causal:
+            # pre_cache_length offsets the causal diagonal: query i may
+            # attend keys [0, pre_cache_length + i]
+            live = live & jnp.tril(jnp.ones((S, T), bool),
+                                   k=int(pre_cache_length))[None, None]
+        logits = jnp.where(live, logits, -1e30)
+        if m is not None:
+            logits = logits + m
+        p = jax.nn.softmax(logits, axis=-1)
+        # rows with no live keys (query past kv_seq_len): exact zeros
+        p = jnp.where(jnp.any(live, -1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p, vv.astype(jnp.float32)
+                          ).astype(qv.dtype)
+    return call_op(_vl, q, k, v)
+
+
+__all__ += ["fused_linear", "fused_linear_activation",
+            "fused_bias_dropout_residual_layer_norm",
+            "fused_feedforward",
+            "variable_length_memory_efficient_attention"]
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """reference: incubate.nn.functional.masked_multihead_attention —
+    single-step decoder attention over a KV cache.
+
+    Core contract (the serving path): x (B, 3*H*D) fused qkv for ONE new
+    token; cache_kv (2, B, H, T_max, D); sequence_lengths (B,) = tokens
+    already cached.  The new k/v are written at each batch row's length,
+    attention runs over the valid prefix + the new token, and the
+    UPDATED cache is returned alongside the (B, H*D) output.  Quant /
+    beam-search / neox-rotary knobs of the reference CUDA kernel are not
+    supported here and raise."""
+    if beam_cache_offset is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam_cache_offset / rotary "
+            "embedding application is not supported; apply rotary to x "
+            "before the call")
+    if out_scale > 0 or use_neox_rotary_style or \
+            compute_dtype not in ("default",):
+        raise NotImplementedError(
+            "masked_multihead_attention: quantized output (out_scale>0), "
+            "neox rotary style, and compute_dtype overrides are not "
+            "supported")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv")
+    x = ensure_tensor(x)
+    cache = ensure_tensor(cache_kv)
+    args = [x, cache]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    has_bias = bias is not None
+    mask_v = None if src_mask is None else ensure_tensor(src_mask)._value
+    _, B, H, T, D = cache.shape
+    if sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention: sequence_lengths is required "
+            "(static shapes need the explicit cache fill level)")
+    lens = ensure_tensor(sequence_lengths)._value.reshape(-1) \
+        .astype(jnp.int32)
+    if not isinstance(lens, jax.core.Tracer) and bool((lens >= T).any()):
+        raise ValueError(
+            f"masked_multihead_attention: KV cache full (capacity {T}, "
+            f"lengths {np.asarray(lens).tolist()}) — the scatter for the "
+            "new token would be dropped silently")
+
+    def _mmha(xv, cachev, *rest):
+        qkv = xv + rest[0] if has_bias else xv
+        qkv = qkv.reshape(B, 3, H, D)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # (B, H, D)
+        bi = jnp.arange(B)
+        k_cache = cachev[0].at[bi, :, lens, :].set(k_new)
+        v_cache = cachev[1].at[bi, :, lens, :].set(v_new)
+        sc = 1.0 / math.sqrt(D)
+        logits = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) * sc
+        live = jnp.arange(T)[None, :] <= lens[:, None]      # (B, T)
+        logits = jnp.where(live[:, None, :], logits, -1e30)
+        if mask_v is not None:
+            logits = logits + mask_v.reshape(B, 1, -1)[..., :T]
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bht,bhtd->bhd", p,
+                         v_cache.astype(jnp.float32))
+        return (out.reshape(B, H * D).astype(xv.dtype),
+                jnp.stack([k_cache, v_cache]))
+    return call_op(_mmha, *args)
+
+
+__all__ += ["masked_multihead_attention"]

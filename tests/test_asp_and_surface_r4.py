@@ -3,6 +3,7 @@ test/asp/test_asp_pruning_*.py — density after prune, mask persistence
 through decorated optimizer steps)."""
 import numpy as np
 import pytest
+import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -95,3 +96,105 @@ def test_dlpack_roundtrip_torch():
     back = paddle.utils.dlpack.from_dlpack(
         torch.arange(4, dtype=torch.float32))
     np.testing.assert_allclose(back.numpy(), [0.0, 1.0, 2.0, 3.0])
+
+
+def test_incubate_fused_functionals():
+    """fused_linear(+activation), fused_bias_dropout_residual_layer_norm,
+    fused_feedforward, variable_length_memory_efficient_attention
+    (reference: incubate.nn.functional fused ops; eval-mode numerics vs
+    unfused compositions)."""
+    import paddle_tpu.incubate.nn as inn
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype("f4"))
+    w = paddle.to_tensor(rng.randn(8, 6).astype("f4"))
+    b = paddle.to_tensor(rng.randn(6).astype("f4"))
+    np.testing.assert_allclose(
+        IF.fused_linear(x, w, b).numpy(),
+        x.numpy() @ w.numpy() + b.numpy(), rtol=2e-5)
+    out = IF.fused_linear_activation(x, w, b, activation="relu")
+    np.testing.assert_allclose(
+        out.numpy(), np.maximum(x.numpy() @ w.numpy() + b.numpy(), 0),
+        rtol=2e-5)
+
+    # bias-dropout-residual-LN (eval: dropout off)
+    res = paddle.to_tensor(rng.randn(4, 8).astype("f4"))
+    bias = paddle.to_tensor(rng.randn(8).astype("f4"))
+    got = IF.fused_bias_dropout_residual_layer_norm(
+        x, res, bias, dropout_rate=0.3, training=False,
+        mode="upscale_in_train").numpy()
+    h = x.numpy() + bias.numpy() + res.numpy()
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    # the Layer wrapper
+    paddle.seed(4)
+    layer = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    out2 = layer(x, res)
+    assert tuple(out2.shape) == (4, 8)
+
+    # fused_feedforward (post-LN, eval)
+    w1 = paddle.to_tensor(rng.randn(8, 16).astype("f4") * 0.1)
+    w2 = paddle.to_tensor(rng.randn(16, 8).astype("f4") * 0.1)
+    ffn = IF.fused_feedforward(
+        x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+        activation="relu", training=False).numpy()
+    h = x.numpy() + np.maximum(x.numpy() @ w1.numpy(), 0) @ w2.numpy()
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(ffn, ref, rtol=2e-4, atol=2e-5)
+
+    # varlen memory-efficient attention: matches masked dense
+    B, H, S, D = 2, 2, 8, 4
+    q = paddle.to_tensor(rng.randn(B, H, S, D).astype("f4"))
+    k = paddle.to_tensor(rng.randn(B, H, S, D).astype("f4"))
+    v = paddle.to_tensor(rng.randn(B, H, S, D).astype("f4"))
+    lens = np.asarray([8, 5], "i4")
+    out = IF.variable_length_memory_efficient_attention(
+        q, k, v, lens, lens).numpy()
+    import math as _m
+    for bi in range(B):
+        L = lens[bi]
+        s_ = np.einsum("hsd,htd->hst", q.numpy()[bi][:, :L],
+                       k.numpy()[bi][:, :L]) / _m.sqrt(D)
+        p = np.asarray(jax.nn.softmax(jnp.asarray(s_), -1))
+        ref = np.einsum("hst,htd->hsd", p, v.numpy()[bi][:, :L])
+        np.testing.assert_allclose(out[bi][:, :L], ref, rtol=2e-3,
+                                   atol=2e-4)
+        np.testing.assert_allclose(out[bi][:, L:], 0.0, atol=1e-6)
+
+
+def test_masked_multihead_attention_decode_step():
+    """Single-step KV-cache decode matches dense attention over the
+    concatenated prefix + new token."""
+    import math as _m
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(1)
+    B, H, D, T = 2, 2, 4, 8
+    lens = np.asarray([3, 5], "i4")
+    cache = np.zeros((2, B, H, T, D), "f4")
+    hist_k = rng.randn(B, H, T, D).astype("f4")
+    hist_v = rng.randn(B, H, T, D).astype("f4")
+    for b in range(B):
+        cache[0, b, :, :lens[b]] = hist_k[b, :, :lens[b]]
+        cache[1, b, :, :lens[b]] = hist_v[b, :, :lens[b]]
+    x = rng.randn(B, 3 * H * D).astype("f4")
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens))
+    out = out.numpy()
+    new_cache = new_cache.numpy()
+    qkv = x.reshape(B, 3, H, D)
+    for b in range(B):
+        L = lens[b]
+        q = qkv[b, 0]
+        ks = np.concatenate([hist_k[b, :, :L], qkv[b, 1][:, None]], 1)
+        vs = np.concatenate([hist_v[b, :, :L], qkv[b, 2][:, None]], 1)
+        s = np.einsum("hd,htd->ht", q, ks) / _m.sqrt(D)
+        p = np.asarray(jax.nn.softmax(jnp.asarray(s), -1))
+        ref = np.einsum("ht,htd->hd", p, vs).reshape(-1)
+        np.testing.assert_allclose(out[b], ref, rtol=2e-4, atol=2e-5)
+        # cache updated at position L with the new k/v
+        np.testing.assert_allclose(new_cache[0, b, :, L], qkv[b, 1],
+                                   rtol=1e-6)
