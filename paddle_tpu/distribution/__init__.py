@@ -14,7 +14,9 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel", "Laplace",
            "LogNormal", "Multinomial", "Poisson", "StudentT", "Geometric",
            "Cauchy", "kl_divergence", "register_kl", "Independent",
-           "TransformedDistribution", "ExponentialFamily"]
+           "TransformedDistribution", "ExponentialFamily",
+           "Binomial", "Chi2", "ContinuousBernoulli",
+           "MultivariateNormal"]
 
 
 class Distribution:
@@ -464,3 +466,161 @@ from .transform import (  # noqa: E402,F401
     Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
     IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
     SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)
+
+
+class Binomial(Distribution):
+    """reference: paddle.distribution.Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = ensure_tensor(total_count)
+        self.probs = ensure_tensor(probs)
+        super().__init__(jnp.broadcast_shapes(
+            tuple(self.total_count.shape), tuple(self.probs.shape)))
+
+    @property
+    def mean(self):
+        return Tensor(raw(self.total_count) * raw(self.probs))
+
+    @property
+    def variance(self):
+        p = raw(self.probs)
+        return Tensor(raw(self.total_count) * p * (1 - p))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        n = jnp.broadcast_to(raw(self.total_count), self._batch_shape)
+        p = jnp.broadcast_to(raw(self.probs), self._batch_shape)
+        return Tensor(jax.random.binomial(
+            next_key(), jnp.broadcast_to(n, shp).astype(jnp.float32),
+            jnp.broadcast_to(p, shp)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        n = raw(self.total_count).astype(jnp.float32)
+        p = raw(self.probs)
+        comb = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        return Tensor(comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        # sum over the support (exact; paddle computes the same way)
+        n = int(np.max(np.asarray(raw(self.total_count))))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        shape = (n + 1,) + tuple(1 for _ in self._batch_shape)
+        lp = self.log_prob(Tensor(ks.reshape(shape))
+                           )._value
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=0))
+
+
+class Chi2(Gamma):
+    """reference: paddle.distribution.Chi2(df) = Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = ensure_tensor(df)
+        super().__init__(concentration=Tensor(raw(self.df) * 0.5),
+                         rate=Tensor(jnp.full_like(raw(self.df) * 1.0,
+                                                   0.5)))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: paddle.distribution.ContinuousBernoulli(probs) —
+    CB(λ) on [0, 1] (Loaiza-Ganem & Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = ensure_tensor(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _clamped(self):
+        lam = raw(self.probs)
+        lo, hi = self._lims
+        # the normalizer is singular at 0.5; paddle clamps a band
+        return jnp.where((lam > lo) & (lam < hi),
+                         jnp.full_like(lam, lo), lam)
+
+    def _log_norm(self):
+        lam = self._clamped()
+        return jnp.log(jnp.abs(
+            2.0 * jnp.arctanh(1.0 - 2.0 * lam))) - \
+            jnp.log(jnp.abs(1.0 - 2.0 * lam))
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value))
+        lam = self._clamped()
+        return Tensor(v * jnp.log(lam) + (1 - v) * jnp.log1p(-lam)
+                      + self._log_norm())
+
+    @property
+    def mean(self):
+        lam = self._clamped()
+        return Tensor(lam / (2.0 * lam - 1.0)
+                      + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * lam)))
+
+    def sample(self, shape=()):
+        # inverse CDF: icdf(u) = [log(1-λ+u(2λ-1)) - log(1-λ)] /
+        #                        [log λ - log(1-λ)]
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(next_key(), shp, minval=1e-6,
+                               maxval=1 - 1e-6)
+        lam = self._clamped()
+        num = jnp.log1p(-lam + u * (2.0 * lam - 1.0)) - jnp.log1p(-lam)
+        den = jnp.log(lam) - jnp.log1p(-lam)
+        return Tensor(jnp.clip(num / den, 0.0, 1.0))
+
+
+class MultivariateNormal(Distribution):
+    """reference: paddle.distribution.MultivariateNormal(loc,
+    covariance_matrix=... | scale_tril=...)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = ensure_tensor(loc)
+        d = self.loc.shape[-1]
+        if scale_tril is not None:
+            self._tril = raw(ensure_tensor(scale_tril))
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                raw(ensure_tensor(covariance_matrix)))
+        elif precision_matrix is not None:
+            cov = jnp.linalg.inv(raw(ensure_tensor(precision_matrix)))
+            self._tril = jnp.linalg.cholesky(cov)
+        else:
+            raise ValueError(
+                "MultivariateNormal needs covariance_matrix, "
+                "precision_matrix, or scale_tril")
+        super().__init__(tuple(self.loc.shape[:-1]))
+        self._event = (d,)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._tril ** 2, axis=-1))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape + self._event
+        z = jax.random.normal(next_key(), shp)
+        return Tensor(raw(self.loc)
+                      + jnp.einsum("...ij,...j->...i", self._tril, z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = raw(ensure_tensor(value)) - raw(self.loc)
+        d = self._event[0]
+        # solve L y = v  ->  maha = |y|^2
+        y = jax.scipy.linalg.solve_triangular(
+            self._tril, v[..., None], lower=True)[..., 0]
+        maha = jnp.sum(y ** 2, axis=-1)
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self._tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(-0.5 * (maha + d * jnp.log(2 * jnp.pi) + logdet))
+
+    def entropy(self):
+        d = self._event[0]
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self._tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(0.5 * (d * (1 + jnp.log(2 * jnp.pi)) + logdet))

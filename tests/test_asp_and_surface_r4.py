@@ -214,3 +214,49 @@ def test_audio_datasets():
     # train/dev splits differ
     dev = paddle.audio.datasets.TESS(mode="dev", feat_type="raw")
     assert not np.allclose(dev[0][0], ds[0][0])
+
+
+def test_new_distributions_vs_scipy():
+    """Binomial/Chi2/ContinuousBernoulli/MultivariateNormal numerics
+    vs scipy (reference: paddle.distribution round-3 additions)."""
+    import scipy.stats as st
+    from paddle_tpu.distribution import (Binomial, Chi2,
+                                         ContinuousBernoulli,
+                                         MultivariateNormal)
+    paddle.seed(0)
+    b = Binomial(10, 0.3)
+    np.testing.assert_allclose(
+        float(b.log_prob(paddle.to_tensor(np.asarray([3.0])))._value[0]),
+        st.binom.logpmf(3, 10, 0.3), rtol=1e-5)
+    assert 2.0 < float(b.sample([800])._value.mean()) < 4.0
+    np.testing.assert_allclose(float(b.mean._value), 3.0, rtol=1e-6)
+
+    c = Chi2(3.0)
+    np.testing.assert_allclose(
+        float(c.log_prob(paddle.to_tensor(np.asarray([2.0])))._value[0]),
+        st.chi2.logpdf(2.0, 3), rtol=1e-5)
+
+    cb = ContinuousBernoulli(np.asarray([0.3]))
+    want = 0.3 / (2 * 0.3 - 1) + 1 / (2 * np.arctanh(1 - 2 * 0.3))
+    np.testing.assert_allclose(float(cb.mean._value[0]), want, rtol=1e-5)
+    samp = cb.sample([4000])
+    assert abs(float(samp._value.mean()) - want) < 0.02
+    lp = cb.log_prob(paddle.to_tensor(np.asarray([0.25])))
+    ref_lp = (0.25 * np.log(0.3) + 0.75 * np.log(0.7)
+              + np.log(abs(2 * np.arctanh(1 - 2 * 0.3)))
+              - np.log(abs(1 - 2 * 0.3)))
+    np.testing.assert_allclose(float(lp._value[0]), ref_lp, rtol=1e-5)
+
+    loc = np.asarray([1.0, -2.0], "f4")
+    cov = np.asarray([[2.0, 0.5], [0.5, 1.0]], "f4")
+    mvn = MultivariateNormal(loc, covariance_matrix=cov)
+    val = np.asarray([0.5, -1.0], "f4")
+    np.testing.assert_allclose(
+        float(mvn.log_prob(paddle.to_tensor(val))._value),
+        st.multivariate_normal.logpdf(val, loc, cov), rtol=1e-4)
+    np.testing.assert_allclose(float(mvn.entropy()._value),
+                               st.multivariate_normal.entropy(loc, cov),
+                               rtol=1e-5)
+    s = mvn.sample([4000])
+    np.testing.assert_allclose(np.cov(np.asarray(s._value).T), cov,
+                               atol=0.15)
