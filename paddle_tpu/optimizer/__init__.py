@@ -1,5 +1,6 @@
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
                         Adagrad, RMSProp, Adadelta, Lamb, LarsMomentum,
-                        DGCMomentum, L2Decay, L1Decay)
+                        DGCMomentum, L2Decay, L1Decay,
+                        Rprop, ASGD, NAdam, RAdam)
 from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401

@@ -625,3 +625,138 @@ class DGCMomentum(Optimizer):
             for st in self._accumulators.values():
                 if "step" in st and int(st["step"]) == 0:
                     st["step"] = jnp.asarray(self._global_step, jnp.int32)
+
+
+class Rprop(Optimizer):
+    """reference: paddle.optimizer.Rprop — resilient backprop: per-
+    element step sizes grown/shrunk by sign agreement (full-batch
+    method; the reference docs carry the same caveat)."""
+    _state_names = ["prev_grad", "step_size"]
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr0 = learning_rate
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_state_for(self, p_value):
+        return {"prev_grad": jnp.zeros_like(p_value),
+                "step_size": jnp.full_like(p_value, self._lr0)}
+
+    def _update(self, param, grad, state, lr):
+        sign = jnp.sign(grad * state["prev_grad"])
+        step = jnp.clip(
+            jnp.where(sign > 0, state["step_size"] * self._eta_pos,
+                      jnp.where(sign < 0,
+                                state["step_size"] * self._eta_neg,
+                                state["step_size"])),
+            self._lr_min, self._lr_max)
+        # on a sign flip the gradient is suppressed for this step
+        g_eff = jnp.where(sign < 0, 0.0, grad)
+        new_p = param - jnp.sign(g_eff) * step
+        return new_p.astype(param.dtype), \
+            {"prev_grad": g_eff, "step_size": step}
+
+
+class ASGD(Optimizer):
+    """reference: paddle.optimizer.ASGD — stochastic average gradient:
+    d keeps the running sum of the last ``batch_num`` gradients (ring
+    buffer) and the step uses d / batch_num."""
+    _state_names = ["d", "ys", "idx"]
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._n = int(batch_num)
+
+    def _init_state_for(self, p_value):
+        return {"d": jnp.zeros_like(p_value),
+                "ys": jnp.zeros((self._n,) + tuple(p_value.shape),
+                                p_value.dtype),
+                "idx": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr):
+        i = state["idx"] % self._n
+        old = state["ys"][i]
+        d = state["d"] - old + grad
+        ys = state["ys"].at[i].set(grad)
+        new_p = param - lr * d / self._n
+        return new_p.astype(param.dtype), \
+            {"d": d, "ys": ys, "idx": state["idx"] + 1}
+
+
+class NAdam(Optimizer):
+    """reference: paddle.optimizer.NAdam — Adam with Nesterov momentum
+    (Dozat 2016; the momentum-decay schedule mu_t)."""
+    _state_names = ["m", "v", "mu_prod", "t"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_state_for(self, p_value):
+        return {"m": jnp.zeros_like(p_value),
+                "v": jnp.zeros_like(p_value),
+                "mu_prod": jnp.ones((), jnp.float32),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr):
+        b1, b2, eps = self._b1, self._b2, self._eps
+        t = state["t"] + 1
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * grad / (1 - mu_prod))
+        v_hat = v / (1 - b2 ** t)
+        new_p = param - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_p.astype(param.dtype), \
+            {"m": m, "v": v, "mu_prod": mu_prod, "t": t}
+
+
+class RAdam(Optimizer):
+    """reference: paddle.optimizer.RAdam — rectified Adam (Liu et al.
+    2020): falls back to un-adapted momentum while the variance
+    estimate's dof rho_t <= 5, rectifies afterwards."""
+    _state_names = ["m", "v", "t"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _init_state_for(self, p_value):
+        return {"m": jnp.zeros_like(p_value),
+                "v": jnp.zeros_like(p_value),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr):
+        b1, b2, eps = self._b1, self._b2, self._eps
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        b2t = b2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1 - b2t)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        r = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-30), 0.0))
+        v_hat = jnp.sqrt(v / (1 - b2t)) + eps
+        rect = lr * r * m_hat / v_hat
+        plain = lr * m_hat
+        new_p = param - jnp.where(rho_t > 5.0, rect, plain)
+        return new_p.astype(param.dtype), {"m": m, "v": v, "t": t}

@@ -104,3 +104,32 @@ def test_optimizer_state_dict_roundtrip():
     np.testing.assert_allclose(np.asarray(st["moment1"]),
                                np.asarray(opt._accumulators[id(w)]
                                           ["moment1"]))
+
+
+def test_round4_optimizers_converge_quadratic():
+    """Rprop/ASGD/NAdam/RAdam (reference: paddle.optimizer round-3
+    additions) minimize a convex quadratic; state shapes sane."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    target = np.asarray([1.5, -2.0, 0.5, 3.0], "f4")
+
+    def run(opt_cls, steps=120, **kw):
+        paddle.seed(0)
+        p = paddle.to_tensor(np.zeros(4, "f4"), stop_gradient=False)
+        opt = opt_cls(parameters=[p], **kw)
+        for _ in range(steps):
+            loss = ((p - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(p._value)
+
+    got = run(paddle.optimizer.Rprop, learning_rate=0.01)
+    np.testing.assert_allclose(got, target, atol=0.05)
+    got = run(paddle.optimizer.ASGD, learning_rate=0.05, batch_num=2)
+    np.testing.assert_allclose(got, target, atol=0.05)
+    got = run(paddle.optimizer.NAdam, learning_rate=0.3)
+    np.testing.assert_allclose(got, target, atol=0.1)
+    got = run(paddle.optimizer.RAdam, learning_rate=0.3)
+    np.testing.assert_allclose(got, target, atol=0.1)
