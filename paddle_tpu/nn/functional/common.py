@@ -458,3 +458,43 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
             out = jnp.transpose(out, (0, 2, 3, 1))
         return out
     return call_op(_shift, x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: paddle.nn.functional.sequence_mask — mask[i, j] =
+    j < x[i] (appends the maxlen axis)."""
+    from ...framework import dtypes as _dt
+    x = ensure_tensor(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x._value))
+    d = _dt.convert_dtype(dtype)
+
+    def _sm(v):
+        pos = jnp.arange(int(maxlen))
+        return (pos < v[..., None]).astype(d)
+    return call_op(_sm, x)
+
+
+def gather_tree(ids, parents, name=None):
+    """reference: paddle.nn.functional.gather_tree — walk beam-search
+    parent pointers backwards to reconstruct full sequences.
+    ids/parents: (T, B, beam)."""
+    ids = ensure_tensor(ids)
+    parents = ensure_tensor(parents)
+
+    def _gt(idv, par):
+        par = par.astype(jnp.int32)   # carry dtype stable under x64
+        T = idv.shape[0]
+        beams = jnp.arange(idv.shape[2])
+
+        def step(carry, t):
+            beam_idx = carry                       # (B, beam)
+            tok = jnp.take_along_axis(idv[t], beam_idx, axis=1)
+            parent = jnp.take_along_axis(par[t], beam_idx, axis=1)
+            return parent, tok
+
+        init = jnp.broadcast_to(beams[None, :],
+                                idv.shape[1:]).astype(jnp.int32)
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, 0)
+    return call_op(_gt, ids, parents)

@@ -587,3 +587,87 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
         loss = -jnp.mean(out)
         return out, loss
     return call_op(_als, *raw)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: paddle.nn.functional.dice_loss — 1 - 2|X∩Y|/(|X|+|Y|)
+    per sample; input (N, ..., C) probabilities, label (N, ..., 1) int."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def _dice(p, y):
+        C = p.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0], C, dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inter / (union + epsilon))
+    return call_op(_dice, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference: paddle.nn.functional.npair_loss (Sohn 2016) —
+    softmax CE over anchor·positiveᵀ with same-label targets + L2."""
+    anchor = ensure_tensor(anchor)
+    positive = ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    def _np(a, p, y):
+        y = y.reshape(-1)
+        sim = jnp.dot(a, p.T)                       # (B, B)
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+        xent = -jnp.sum(tgt * jax.nn.log_softmax(sim, -1), -1).mean()
+        # reference: (mean_i |a_i|^2 + mean_i |p_i|^2) * 0.25 * l2_reg
+        reg = 0.25 * l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                               + jnp.mean(jnp.sum(p * p, -1)))
+        return xent + reg
+    return call_op(_np, anchor, positive, labels)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference: paddle.nn.functional.multi_margin_loss —
+    mean_j max(0, margin - x_y + x_j)^p / C."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    args = [input, label] + ([ensure_tensor(weight)]
+                             if weight is not None else [])
+
+    def _mm(x, y, *w):
+        C = x.shape[1]
+        xy = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            m = m * w[0][y.astype(jnp.int32)][:, None]
+        m = m.at[jnp.arange(x.shape[0]), y.astype(jnp.int32)].set(0.0)
+        row = jnp.sum(m, 1) / C
+        return _reduce(row, reduction)
+    return call_op(_mm, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """reference: paddle.nn.functional.margin_cross_entropy —
+    ArcFace-family margins: target logit cos(m1·θ + m2) - m3, all
+    scaled by s.  Single-shard form; under model parallelism shard the
+    class dim with the mp_layers ParallelCrossEntropy machinery."""
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+
+    def _mce(x, y):
+        y = y.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(x, -1.0, 1.0)
+        theta = jnp.arccos(jnp.take_along_axis(cos, y[:, None], 1))[:, 0]
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = cos.at[jnp.arange(x.shape[0]), y].set(target)
+        z = adj * scale
+        logp = jax.nn.log_softmax(z, -1)
+        row = -jnp.take_along_axis(logp, y[:, None], 1)[:, 0]
+        loss = _reduce(row, reduction)
+        if return_softmax:
+            return loss, jax.nn.softmax(z, -1)
+        return loss
+    return call_op(_mce, logits, label)

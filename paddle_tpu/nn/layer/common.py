@@ -561,3 +561,48 @@ class FractionalMaxPool3D(Layer):
     def forward(self, x):
         o, k, u, m = self._a
         return F.fractional_max_pool3d(x, o, k, u, m)
+
+
+class FeatureAlphaDropout(Layer):
+    """reference: paddle.nn.FeatureAlphaDropout — alpha dropout that
+    drops whole feature maps (channel granularity)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ...framework.random import next_key
+        import jax
+        v = x._value
+        # SELU-preserving alpha dropout, mask broadcast over (N, C)
+        alpha_p = -1.7580993408473766
+        keep = 1.0 - self.p
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        shape = v.shape[:2] + (1,) * (v.ndim - 2)
+        m = jax.random.bernoulli(next_key(), keep, shape)
+        from ...framework.autograd import call_op
+        return call_op(
+            lambda vv: a * (jnp.where(m, vv, alpha_p)) + b, x)
+
+
+class GLU(Layer):
+    """reference: paddle.nn.GLU — gated linear unit over `axis`."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, axis=self.axis)
+
+
+class Softmax2D(Layer):
+    """reference: paddle.nn.Softmax2D — softmax over the channel axis
+    of (N, C, H, W) / (C, H, W) inputs."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

@@ -17,7 +17,7 @@ from ...framework.autograd import call_op
 from .layers import Layer, LayerList
 
 __all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
-           "LSTM", "GRU"]
+           "LSTM", "GRU", "BiRNN"]
 
 
 class RNNCellBase(Layer):
@@ -172,30 +172,69 @@ class RNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         return _scan_cell(self.cell, inputs, initial_states,
-                          self.time_major, self.is_reverse)
+                          self.time_major, self.is_reverse,
+                          sequence_length)
 
 
 def _cell_params(cell):
     return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
 
 
-def _scan_cell(cell, inputs, initial_states, time_major, is_reverse):
-    """Run the cell over time with lax.scan on raw values."""
+def _scan_cell(cell, inputs, initial_states, time_major, is_reverse,
+               sequence_length=None):
+    """Run the cell over time with lax.scan on raw values.
+
+    initial_states: None (zeros) or (B, H) Tensor / tuple for LSTM.
+    sequence_length: None or (B,) Tensor — timesteps past a row's
+    length keep the previous state (so final states come from the last
+    VALID step) and emit zero outputs; the reverse direction flips only
+    the valid prefix (padding stays at the tail), matching the
+    reference's padded-batch semantics."""
     is_lstm = isinstance(cell, LSTMCell)
     H = cell.hidden_size
     params = _cell_params(cell)
+    extra_in = []
+    has_init = initial_states is not None
+    if has_init:
+        init_list = (list(initial_states) if is_lstm
+                     else [initial_states])
+        extra_in += init_list
+    has_len = sequence_length is not None
+    if has_len:
+        from ...tensor._helpers import ensure_tensor as _ens
+        extra_in.append(_ens(sequence_length))
 
-    def run(x, *pvals):
-        wi, wh, bi, bh = pvals
+    def run(x, wi, wh, bi, bh, *extra):
+        it = iter(extra)
+        inits = [next(it) for _ in range(
+            (2 if is_lstm else 1) if has_init else 0)]
+        lens = next(it).astype(jnp.int32) if has_len else None
         if not time_major:
             x = jnp.swapaxes(x, 0, 1)  # (T, B, C)
+        T, B = x.shape[0], x.shape[1]
         if is_reverse:
-            x = jnp.flip(x, 0)
-        B = x.shape[1]
-        h0 = jnp.zeros((B, H), x.dtype)
+            if lens is None:
+                x = jnp.flip(x, 0)
+            else:
+                # flip only each row's valid prefix: t -> len-1-t
+                tidx = jnp.arange(T)[:, None]
+                src = jnp.where(tidx < lens[None, :],
+                                lens[None, :] - 1 - tidx, tidx)
+                x = jnp.take_along_axis(x, src[:, :, None], axis=0)
+        h0 = inits[0] if has_init else jnp.zeros((B, H), x.dtype)
+        live = None if lens is None else \
+            (jnp.arange(T)[:, None] < lens[None, :])     # (T, B)
+
+        def gate(t_live, new, old):
+            if t_live is None:
+                return new
+            return jnp.where(t_live[:, None], new, old)
 
         if is_lstm:
-            def step(carry, xt):
+            c0 = inits[1] if has_init else jnp.zeros((B, H), x.dtype)
+
+            def step(carry, xt_l):
+                xt, t_live = xt_l
                 h_, c_ = carry
                 z = xt @ wi.T + bi + h_ @ wh.T + bh
                 i, f, g, o = jnp.split(z, 4, axis=-1)
@@ -204,11 +243,16 @@ def _scan_cell(cell, inputs, initial_states, time_major, is_reverse):
                 g = jnp.tanh(g)
                 nc = f * c_ + i * g
                 nh = o * jnp.tanh(nc)
-                return (nh, nc), nh
-            (hT, cT), ys = jax.lax.scan(step, (h0, h0), x)
-            extra = (hT, cT)
+                nh = gate(t_live, nh, h_)
+                nc = gate(t_live, nc, c_)
+                y = nh if t_live is None else \
+                    jnp.where(t_live[:, None], nh, 0.0)
+                return (nh, nc), y
+            (hT, cT), ys = jax.lax.scan(step, (h0, c0), (x, live))
+            extra_out = (hT, cT)
         elif isinstance(cell, GRUCell):
-            def step(h_, xt):
+            def step(h_, xt_l):
+                xt, t_live = xt_l
                 gi = xt @ wi.T + bi
                 gh = h_ @ wh.T + bh
                 ir, iz, in_ = jnp.split(gi, 3, axis=-1)
@@ -217,27 +261,40 @@ def _scan_cell(cell, inputs, initial_states, time_major, is_reverse):
                 z = jax.nn.sigmoid(iz + hz)
                 n = jnp.tanh(in_ + r * hn)
                 nh = (1 - z) * n + z * h_
-                return nh, nh
-            hT, ys = jax.lax.scan(step, h0, x)
-            extra = hT
+                nh = gate(t_live, nh, h_)
+                y = nh if t_live is None else \
+                    jnp.where(t_live[:, None], nh, 0.0)
+                return nh, y
+            hT, ys = jax.lax.scan(step, h0, (x, live))
+            extra_out = hT
         else:
             act = jnp.tanh if cell.activation == "tanh" else \
                 (lambda v: jnp.maximum(v, 0))
 
-            def step(h_, xt):
+            def step(h_, xt_l):
+                xt, t_live = xt_l
                 nh = act(xt @ wi.T + bi + h_ @ wh.T + bh)
-                return nh, nh
-            hT, ys = jax.lax.scan(step, h0, x)
-            extra = hT
+                nh = gate(t_live, nh, h_)
+                y = nh if t_live is None else \
+                    jnp.where(t_live[:, None], nh, 0.0)
+                return nh, y
+            hT, ys = jax.lax.scan(step, h0, (x, live))
+            extra_out = hT
         if is_reverse:
-            ys = jnp.flip(ys, 0)
+            if lens is None:
+                ys = jnp.flip(ys, 0)
+            else:
+                tidx = jnp.arange(T)[:, None]
+                src = jnp.where(tidx < lens[None, :],
+                                lens[None, :] - 1 - tidx, tidx)
+                ys = jnp.take_along_axis(ys, src[:, :, None], axis=0)
         if not time_major:
             ys = jnp.swapaxes(ys, 0, 1)
         if is_lstm:
-            return ys, extra[0], extra[1]
-        return ys, extra
+            return ys, extra_out[0], extra_out[1]
+        return ys, extra_out
 
-    outs = call_op(run, inputs, *params)
+    outs = call_op(run, inputs, *params, *extra_in)
     if is_lstm:
         ys, hT, cT = outs
         return ys, (hT, cT)
@@ -277,17 +334,35 @@ class _RNNBase(Layer):
         self.cells_fw = LayerList(cells_fw)
         self.cells_bw = LayerList(cells_bw) if self.bidirect else None
 
+    def _layer_init(self, initial_states, layer, direction):
+        """Slice (num_layers*dirs, B, H) stacked init states for one
+        cell; None passes through (zero init)."""
+        if initial_states is None:
+            return None
+        dirs = 2 if self.bidirect else 1
+        idx = layer * dirs + direction
+        if self.CELL is LSTMCell:
+            h0, c0 = initial_states
+            return (h0[idx], c0[idx])
+        return initial_states[idx]
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...tensor.manipulation import concat, stack
         x = inputs
         last_h, last_c = [], []
         is_lstm = self.CELL is LSTMCell
         for layer in range(self.num_layers):
-            ys_f, st_f = _scan_cell(self.cells_fw[layer], x, None,
-                                    self.time_major, False)
+            ys_f, st_f = _scan_cell(self.cells_fw[layer], x,
+                                    self._layer_init(initial_states,
+                                                     layer, 0),
+                                    self.time_major, False,
+                                    sequence_length)
             if self.bidirect:
-                ys_b, st_b = _scan_cell(self.cells_bw[layer], x, None,
-                                        self.time_major, True)
+                ys_b, st_b = _scan_cell(self.cells_bw[layer], x,
+                                        self._layer_init(initial_states,
+                                                         layer, 1),
+                                        self.time_major, True,
+                                        sequence_length)
                 x = concat([ys_f, ys_b], axis=-1)
                 if is_lstm:
                     last_h += [st_f[0], st_b[0]]
@@ -329,3 +404,26 @@ class LSTM(_RNNBase):
 
 class GRU(_RNNBase):
     CELL = GRUCell
+
+
+class BiRNN(Layer):
+    """reference: paddle.nn.BiRNN — run a forward and a backward cell
+    over the sequence, concatenating outputs on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self._fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self._bw(inputs, st_bw, sequence_length)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)

@@ -260,3 +260,56 @@ def test_new_distributions_vs_scipy():
     s = mvn.sample([4000])
     np.testing.assert_allclose(np.cov(np.asarray(s._value).T), cov,
                                atol=0.15)
+
+
+def test_nn_round4_layers_and_losses():
+    """BiRNN/GLU/Softmax2D/FeatureAlphaDropout + the round-4 loss and
+    sequence functionals."""
+    import paddle_tpu.nn.functional as F
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    cell_f, cell_b = nn.GRUCell(4, 6), nn.GRUCell(4, 6)
+    out, (hf, hb) = nn.BiRNN(cell_f, cell_b)(
+        paddle.to_tensor(rng.randn(2, 5, 4).astype("f4")))
+    assert tuple(out.shape) == (2, 5, 12)
+    # backward half really runs in reverse: flip-invariance check
+    g = nn.GLU()(paddle.to_tensor(rng.rand(2, 8).astype("f4")))
+    assert tuple(g.shape) == (2, 4)
+    s2 = nn.Softmax2D()(paddle.to_tensor(rng.rand(2, 3, 4, 4).astype("f4")))
+    np.testing.assert_allclose(np.asarray(s2._value.sum(1)), 1.0,
+                               rtol=1e-5)
+    fad = nn.FeatureAlphaDropout(0.5)
+    fad.train()
+    o = fad(paddle.to_tensor(rng.rand(2, 6, 4, 4).astype("f4")))
+    per_chan = np.asarray(o._value).std(axis=(2, 3))
+    assert (per_chan < 1e-6).any()
+
+    sm = F.sequence_mask(paddle.to_tensor(np.asarray([2, 4])), maxlen=5)
+    np.testing.assert_array_equal(np.asarray(sm._value),
+                                  [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    # dice on a perfect prediction -> ~0
+    oh = np.eye(3, dtype="f4")[np.asarray([0, 1, 2, 1])][None]
+    lab = np.asarray([0, 1, 2, 1]).reshape(1, 4, 1)
+    d = F.dice_loss(paddle.to_tensor(oh), paddle.to_tensor(lab))
+    assert float(d._value) < 0.01
+    mm = F.multi_margin_loss(
+        paddle.to_tensor(np.asarray([[10.0, 0, 0], [0, 10.0, 0]], "f4")),
+        paddle.to_tensor(np.asarray([0, 1])))
+    assert float(mm._value) == 0.0   # correct by a wide margin
+    # margin CE reduces to plain scaled CE at zero margins
+    logits = rng.rand(4, 6).astype("f4") * 2 - 1
+    y = np.asarray([1, 5, 2, 0])
+    a = F.margin_cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(y), margin1=1.0,
+                               margin2=0.0, margin3=0.0, scale=1.0)
+    b = F.cross_entropy(paddle.to_tensor(np.clip(logits, -1, 1)),
+                        paddle.to_tensor(y))
+    np.testing.assert_allclose(float(a._value), float(b._value),
+                               rtol=1e-4)
+    # gather_tree walks parents (beam reconstr.)
+    ids = np.asarray([[[2, 5]], [[6, 1]], [[3, 8]]], "i4")
+    parents = np.asarray([[[0, 0]], [[1, 0]], [[1, 0]]], "i4")
+    gt = np.asarray(F.gather_tree(paddle.to_tensor(ids),
+                                  paddle.to_tensor(parents))._value)
+    assert gt.shape == ids.shape
+    np.testing.assert_array_equal(gt[2], ids[2])   # last step unchanged

@@ -61,3 +61,65 @@ def test_bidirectional_and_grad():
     y.mean().backward()
     assert x.grad is not None
     assert net.cells_fw[0].weight_ih.grad is not None
+
+
+def test_rnn_initial_states_and_sequence_length():
+    """Round-4: _scan_cell honors warm-start states and padded-batch
+    sequence_length (final state from last VALID step, outputs past
+    length zeroed, reverse flips only the valid prefix)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    cell = nn.GRUCell(3, 5)
+    rnn = nn.RNN(cell)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 6, 3).astype("f4"))
+    h0 = paddle.to_tensor(rng.randn(2, 5).astype("f4"))
+    out0, hT0 = rnn(x)
+    out1, hT1 = rnn(x, h0)
+    assert not np.allclose(np.asarray(out0._value),
+                           np.asarray(out1._value)), \
+        "initial_states must change the result"
+    # warm start == manually stepping the cell
+    h = h0
+    for t in range(6):
+        _, h = cell(x[:, t, :], h)
+    np.testing.assert_allclose(np.asarray(hT1._value),
+                               np.asarray(h._value), rtol=1e-5,
+                               atol=1e-6)
+
+    # sequence_length: row 1 has only 3 valid steps
+    lens = paddle.to_tensor(np.asarray([6, 3], "i4"))
+    out2, hT2 = rnn(x, None, lens)
+    # outputs past the length are zero
+    np.testing.assert_allclose(np.asarray(out2._value)[1, 3:], 0.0)
+    # final state of row 1 equals the full-run state at t=2
+    np.testing.assert_allclose(np.asarray(hT2._value)[1],
+                               np.asarray(out0._value)[1, 2], rtol=1e-5,
+                               atol=1e-6)
+
+    # reverse with lengths: valid prefix flipped, padding stays zero
+    rrev = nn.RNN(nn.GRUCell(3, 5), is_reverse=True)
+    outr, _ = rrev(x, None, lens)
+    assert np.allclose(np.asarray(outr._value)[1, 3:], 0.0)
+    assert not np.allclose(np.asarray(outr._value)[1, :3], 0.0)
+
+
+def test_birnn_states_and_lengths_flow():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    paddle.seed(1)
+    bi = nn.BiRNN(nn.GRUCell(3, 4), nn.GRUCell(3, 4))
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 5, 3).astype("f4"))
+    st = (paddle.to_tensor(rng.randn(2, 4).astype("f4")),
+          paddle.to_tensor(rng.randn(2, 4).astype("f4")))
+    out_a, _ = bi(x)
+    out_b, _ = bi(x, st)
+    assert not np.allclose(np.asarray(out_a._value),
+                           np.asarray(out_b._value))
+    lens = paddle.to_tensor(np.asarray([5, 2], "i4"))
+    out_c, _ = bi(x, None, lens)
+    np.testing.assert_allclose(np.asarray(out_c._value)[1, 2:], 0.0)
