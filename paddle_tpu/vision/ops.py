@@ -452,3 +452,247 @@ class DeformConv2D(_Layer):
     def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              mask=mask, **self._cfg)
+
+
+class RoIAlign(_Layer):
+    """reference: paddle.vision.ops.RoIAlign layer wrapper."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(_Layer):
+    """reference: paddle.vision.ops.RoIPool layer wrapper."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(_Layer):
+    """reference: paddle.vision.ops.PSRoIPool layer wrapper."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """reference: paddle.vision.ops.prior_box — SSD anchor generation.
+    input (N, C, H, W) feature map, image (N, C, Him, Wim).  Returns
+    (boxes (H, W, n_priors, 4) normalized xyxy, variances same shape)."""
+    import numpy as np
+    fh, fw = ensure_tensor(input).shape[2:4]
+    ih, iw = ensure_tensor(image).shape[2:4]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        # aspect-ratio boxes for this min_size
+        sizes = []
+        if min_max_aspect_ratios_order:
+            sizes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        boxes.append(sizes)
+    per_cell = [s for group in boxes for s in group]
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    out = np.zeros((fh, fw, len(per_cell), 4), "float32")
+    for k, (bw, bh) in enumerate(per_cell):
+        out[:, :, k, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, k, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, k, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, k, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, "float32"),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """reference: paddle.vision.ops.distribute_fpn_proposals — assign
+    each RoI to an FPN level by its scale:
+    level = floor(log2(sqrt(area) / refer_scale + eps)) + refer_level."""
+    import numpy as np
+    rois = np.asarray(ensure_tensor(fpn_rois)._value)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype("int64")
+    multi_rois, restore, rois_num_per = [], [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        rois_num_per.append(Tensor(jnp.asarray(
+            np.asarray([len(idx)], "int32"))))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), "int64")
+    restore = np.argsort(order).astype("int32")[:, None]
+    outs = (multi_rois, Tensor(jnp.asarray(restore)))
+    if rois_num is not None:
+        return outs[0], outs[1], rois_num_per
+    return outs
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference: paddle.vision.ops.matrix_nms (SOLOv2) — parallel
+    soft-NMS: each box's score decays by its max IoU with higher-scored
+    same-class boxes (gaussian or linear decay)."""
+    import numpy as np
+    b = np.asarray(ensure_tensor(bboxes)._value)    # (N, M, 4)
+    s = np.asarray(ensure_tensor(scores)._value)    # (N, C, M)
+    off = 0.0 if normalized else 1.0
+    outs, idxs, nums = [], [], []
+    for n in range(b.shape[0]):
+        dets, det_idx = [], []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bb, ss = b[n][order], sc[order]
+            x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            xx1 = np.maximum(x1[:, None], x1[None, :])
+            yy1 = np.maximum(y1[:, None], y1[None, :])
+            xx2 = np.minimum(x2[:, None], x2[None, :])
+            yy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = np.maximum(0, xx2 - xx1 + off) * \
+                np.maximum(0, yy2 - yy1 + off)
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-9)
+            iou = np.triu(iou, 1)                # IoU with higher-scored
+            iou_max = iou.max(0)                 # per box
+            comp = iou_max[:, None]              # IoU compensation
+            if use_gaussian:
+                decay = np.exp((comp ** 2 - iou ** 2) / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - comp, 1e-9)
+            decay = np.triu(decay, 1) + np.tril(np.ones_like(decay))
+            dec = decay.min(0)
+            new_s = ss * dec
+            ok = new_s > post_threshold
+            for j in np.where(ok)[0]:
+                dets.append([c, new_s[j], *bb[j]])
+                det_idx.append(order[j] + n * b.shape[1])
+        if dets:
+            dets = np.asarray(dets, "float32")
+            det_idx = np.asarray(det_idx, "int64")
+            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, det_idx = dets[top], det_idx[top]
+        else:
+            dets = np.zeros((0, 6), "float32")
+            det_idx = np.zeros((0,), "int64")
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0)))
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, "int32")))
+    index = Tensor(jnp.asarray(np.concatenate(idxs, 0)[:, None]))
+    if return_index:
+        return (out, index, rois_num) if return_rois_num else (out, index)
+    return (out, rois_num) if return_rois_num else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """reference: paddle.vision.ops.generate_proposals — RPN: decode
+    anchor deltas, clip to the image, filter small boxes, NMS, top-k."""
+    import numpy as np
+    sc = np.asarray(ensure_tensor(scores)._value)        # (N, A, H, W)
+    bd = np.asarray(ensure_tensor(bbox_deltas)._value)   # (N, 4A, H, W)
+    im = np.asarray(ensure_tensor(img_size)._value)      # (N, 2) h, w
+    an = np.asarray(ensure_tensor(anchors)._value).reshape(-1, 4)
+    va = np.asarray(ensure_tensor(variances)._value).reshape(-1, 4)
+    N, A = sc.shape[0], sc.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_nums, all_scores = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)             # (H*W*A)
+        d = bd[n].reshape(A, 4, *bd.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, var = s[order], d[order], an[order % an.shape[0]], \
+            va[order % va.shape[0]]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], 1)
+        H_img, W_img = im[n, 0], im[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W_img - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H_img - off)
+        keep = np.where((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                        (boxes[:, 3] - boxes[:, 1] + off >= min_size))[0]
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                                  iou_threshold=nms_thresh,
+                                  scores=Tensor(jnp.asarray(s))
+                                  )._value)[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        all_rois.append(boxes.astype("float32"))
+        all_scores.append(s.astype("float32"))
+        all_nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0)[:, None]))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(
+            np.asarray(all_nums, "int32")))
+    return rois, rscores

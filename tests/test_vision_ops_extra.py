@@ -266,3 +266,66 @@ def test_psroi_pool_batched_matches_per_image():
                       np.asarray([2], "i4"), output_size=3)
     np.testing.assert_allclose(out.numpy()[:1], ref0.numpy(), rtol=1e-6)
     np.testing.assert_allclose(out.numpy()[1:], ref1.numpy(), rtol=1e-6)
+
+
+def test_round4_detection_ops():
+    """prior_box / distribute_fpn_proposals / matrix_nms /
+    generate_proposals / RoI layer wrappers (reference:
+    paddle.vision.ops detection family)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import ops as V
+
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), "f4"))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), "f4"))
+    boxes, var = V.prior_box(feat, img, min_sizes=[16], max_sizes=[32],
+                             aspect_ratios=[2.0], flip=True, clip=True)
+    assert tuple(boxes.shape) == (4, 4, 4, 4)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    # anchor centers follow the offset*step grid
+    np.testing.assert_allclose((b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2,
+                               (0.5 * 16) / 64, atol=1e-6)
+
+    rois = np.asarray([[0, 0, 10, 10], [0, 0, 100, 100],
+                       [0, 0, 500, 500]], "f4")
+    multi, restore = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    assert sum(m.shape[0] for m in multi) == 3
+    assert sorted(restore.numpy().ravel().tolist()) == [0, 1, 2]
+
+    bb = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 10],
+                      [20, 20, 30, 30]]], "f4")
+    ss = np.zeros((1, 2, 3), "f4")
+    ss[0, 1] = [0.9, 0.8, 0.7]
+    out, nums = V.matrix_nms(paddle.to_tensor(bb), paddle.to_tensor(ss),
+                             score_threshold=0.1, post_threshold=0.2,
+                             nms_top_k=10, keep_top_k=5)
+    o = out.numpy()
+    assert o.shape[1] == 6 and int(nums.numpy()[0]) >= 2
+    # identical twin decays: its soft score drops below the leader's
+    assert o[0, 1] >= o[1, 1]
+
+    H = W = 4
+    A = 3
+    sc = np.random.RandomState(0).rand(1, A, H, W).astype("f4")
+    bd = np.zeros((1, 4 * A, H, W), "f4")
+    anchors = np.random.RandomState(1).rand(H, W, A, 4).astype("f4") * 32
+    anchors[..., 2:] += anchors[..., :2] + 8
+    var = np.full((H, W, A, 4), 0.1, "f4")
+    rois2, rs, rn = V.generate_proposals(
+        paddle.to_tensor(sc), paddle.to_tensor(bd),
+        paddle.to_tensor(np.asarray([[64, 64]], "f4")),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        pre_nms_top_n=20, post_nms_top_n=5, return_rois_num=True)
+    assert rois2.shape[0] <= 5 and int(rn.numpy()[0]) == rois2.shape[0]
+    # zero deltas -> proposals are clipped anchors: inside the image
+    r = rois2.numpy()
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+
+    x = paddle.to_tensor(np.random.rand(1, 4, 8, 8).astype("f4"))
+    box1 = paddle.to_tensor(np.asarray([[0, 0, 7, 7]], "f4"))
+    bn = paddle.to_tensor(np.asarray([1], "i4"))
+    assert tuple(V.RoIAlign(2)(x, box1, bn).shape) == (1, 4, 2, 2)
+    assert tuple(V.RoIPool(2)(x, box1, bn).shape) == (1, 4, 2, 2)
+    assert tuple(V.PSRoIPool(2, 1.0)(x, box1, bn).shape) == (1, 1, 2, 2)
