@@ -696,3 +696,139 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         return rois, rscores, Tensor(jnp.asarray(
             np.asarray(all_nums, "int32")))
     return rois, rscores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: paddle.vision.ops.yolo_loss (YOLOv3).
+
+    x: (N, A*(5+C), H, W) raw head output for this scale; gt_box
+    (N, B, 4) normalized cxcywh... actually the reference feeds x,y,w,h
+    in [0,1] image-normalized *corner-free* cx,cy,w,h form; gt_label
+    (N, B) int; anchors: full anchor list [w0,h0,w1,h1,...] in input
+    pixels; anchor_mask: indices of this scale's anchors.
+
+    TPU-native: assignment (best-anchor-per-gt, responsible cell) is
+    computed with traced one-hot scatters, so the whole loss jits —
+    loss = sce(x,y) + L1(w,h) (both scaled by 2-w*h) + obj/noobj sce
+    with the >ignore_thresh IoU mask + class sce, summed per image,
+    meaned over the batch (the reference's reduction)."""
+    x = ensure_tensor(x)
+    gt_box = ensure_tensor(gt_box)
+    gt_label = ensure_tensor(gt_label)
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(ensure_tensor(gt_score))
+    anchors_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    mask = jnp.asarray(anchor_mask, jnp.int32)
+    A = mask.shape[0]
+    C = int(class_num)
+
+    def _yl(xv, gb, gl, *gs_):
+        N, _, H, W = xv.shape
+        in_h, in_w = H * downsample_ratio, W * downsample_ratio
+        p = xv.reshape(N, A, 5 + C, H, W)
+        px, py = p[:, :, 0], p[:, :, 1]          # raw logits (N,A,H,W)
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]                       # (N, A, C, H, W)
+        amask_wh = anchors_all[mask]             # (A, 2) pixels
+
+        B = gb.shape[1]
+        gx, gy = gb[:, :, 0], gb[:, :, 1]        # normalized cx, cy
+        gw, gh = gb[:, :, 2], gb[:, :, 3]        # normalized w, h
+        valid = (gw > 0) & (gh > 0)              # (N, B)
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        # best anchor per gt over the FULL anchor list (wh IoU)
+        gw_pix, gh_pix = gw * in_w, gh * in_h
+        inter = jnp.minimum(gw_pix[..., None], anchors_all[None, None, :, 0]) * \
+            jnp.minimum(gh_pix[..., None], anchors_all[None, None, :, 1])
+        union = gw_pix[..., None] * gh_pix[..., None] + \
+            anchors_all[None, None, :, 0] * anchors_all[None, None, :, 1] \
+            - inter
+        best = jnp.argmax(inter / (union + 1e-9), axis=-1)    # (N, B)
+        # position of `best` inside this scale's mask (or -1)
+        in_mask = (best[..., None] == mask[None, None, :])    # (N,B,A)
+        a_idx = jnp.argmax(in_mask, axis=-1)                  # (N, B)
+        resp = valid & jnp.any(in_mask, axis=-1)
+        score = gs_[0] if gs_ else jnp.ones_like(gx)
+
+        # scatter gt targets onto the (A, H, W) grid
+        def scat(tgt_val):
+            # tgt_val: (N, B) -> (N, A, H, W) sum-scatter at resp cells
+            out = jnp.zeros((N, A, H, W), jnp.float32)
+            ni = jnp.arange(N)[:, None] * jnp.ones((1, B), jnp.int32)
+            flat = ((ni * A + a_idx) * H + gj) * W + gi
+            val = jnp.where(resp, tgt_val, 0.0)
+            return jnp.zeros((N * A * H * W,), jnp.float32) \
+                .at[flat.reshape(-1)].add(val.reshape(-1),
+                                          mode="drop") \
+                .reshape(N, A, H, W)
+
+        obj_t = jnp.clip(scat(jnp.ones_like(gx)), 0.0, 1.0)
+        tx = scat(gx * W - gi.astype(jnp.float32))
+        ty = scat(gy * H - gj.astype(jnp.float32))
+        tw = scat(jnp.log(jnp.maximum(
+            gw_pix / amask_wh[a_idx % A, 0], 1e-9)))
+        th = scat(jnp.log(jnp.maximum(
+            gh_pix / amask_wh[a_idx % A, 1], 1e-9)))
+        box_scale = jnp.clip(scat(2.0 - gw * gh), 0.0, 2.0)
+        tscore = jnp.clip(scat(score), 0.0, 1.0)
+
+        def sce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        lxy = (sce(px, tx) + sce(py, ty)) * box_scale * obj_t
+        lwh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * box_scale * obj_t
+
+        # ignore mask: predicted boxes with IoU > thresh vs ANY gt
+        grid_x = jnp.arange(W)[None, None, None, :]
+        grid_y = jnp.arange(H)[None, None, :, None]
+        bx = (jax.nn.sigmoid(px) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + grid_x) / W
+        by = (jax.nn.sigmoid(py) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + grid_y) / H
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * amask_wh[None, :, 0,
+                                                       None, None] / in_w
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * amask_wh[None, :, 1,
+                                                       None, None] / in_h
+        px1, py1 = bx - bw / 2, by - bh / 2
+        px2, py2 = bx + bw / 2, by + bh / 2
+        gx1, gy1 = gx - gw / 2, gy - gh / 2
+        gx2, gy2 = gx + gw / 2, gy + gh / 2
+        def e(a):        # (N,A,H,W) -> (N,A,H,W,1)
+            return a[..., None]
+        iw = jnp.maximum(0.0, jnp.minimum(e(px2), gx2[:, None, None, None])
+                         - jnp.maximum(e(px1), gx1[:, None, None, None]))
+        ih = jnp.maximum(0.0, jnp.minimum(e(py2), gy2[:, None, None, None])
+                         - jnp.maximum(e(py1), gy1[:, None, None, None]))
+        inter_b = iw * ih
+        uni = e(bw * bh) + (gw * gh)[:, None, None, None] - inter_b
+        iou_b = jnp.where(valid[:, None, None, None], inter_b /
+                          (uni + 1e-9), 0.0)
+        ignore = jnp.max(iou_b, axis=-1) > ignore_thresh
+        lobj = sce(pobj, tscore) * obj_t + \
+            sce(pobj, jnp.zeros_like(pobj)) * (1 - obj_t) * \
+            (1 - ignore.astype(jnp.float32))
+
+        smooth = 1.0 / jnp.maximum(C, 1) if use_label_smooth else 0.0
+        cls_t = jnp.zeros((N, A, C, H, W), jnp.float32)
+        ni = jnp.arange(N)[:, None] * jnp.ones((1, B), jnp.int32)
+        gl_i = jnp.clip(gl.astype(jnp.int32), 0, C - 1)
+        flat_c = (((ni * A + a_idx) * C + gl_i) * H + gj) * W + gi
+        cls_t = jnp.zeros((N * A * C * H * W,), jnp.float32) \
+            .at[flat_c.reshape(-1)].add(
+                jnp.where(resp, 1.0, 0.0).reshape(-1), mode="drop") \
+            .reshape(N, A, C, H, W)
+        cls_t = jnp.clip(cls_t, 0.0, 1.0) * (1 - smooth) + smooth / 2
+        lcls = sce(pcls, cls_t) * obj_t[:, :, None]
+
+        per_img = (jnp.sum(lxy, axis=(1, 2, 3))
+                   + jnp.sum(lwh, axis=(1, 2, 3))
+                   + jnp.sum(lobj, axis=(1, 2, 3))
+                   + jnp.sum(lcls, axis=(1, 2, 3, 4)))
+        return per_img
+    return call_op(_yl, *args)

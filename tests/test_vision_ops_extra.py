@@ -329,3 +329,48 @@ def test_round4_detection_ops():
     assert tuple(V.RoIAlign(2)(x, box1, bn).shape) == (1, 4, 2, 2)
     assert tuple(V.RoIPool(2)(x, box1, bn).shape) == (1, 4, 2, 2)
     assert tuple(V.PSRoIPool(2, 1.0)(x, box1, bn).shape) == (1, 1, 2, 2)
+
+
+def test_yolo_loss_basics():
+    """yolo_loss (reference: paddle.vision.ops.yolo_loss) — finite
+    per-image losses, gradient flow, responsiveness to gt presence,
+    and jit-compatibility (traced scatter assignment)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import ops as V
+
+    rng = np.random.RandomState(0)
+    N, A, C, H, W = 2, 3, 4, 8, 8
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+               116, 90, 156, 198, 373, 326]
+    mask = [0, 1, 2]
+    xv = rng.randn(N, A * (5 + C), H, W).astype("f4") * 0.1
+    gt = np.zeros((N, 5, 4), "f4")
+    gt[0, 0] = [0.5, 0.5, 0.1, 0.15]
+    gt[1, 0] = [0.6, 0.3, 0.12, 0.1]
+    gl = np.zeros((N, 5), "i4")
+    gl[0, 0] = 2
+
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(gl),
+                       anchors, mask, C, ignore_thresh=0.7,
+                       downsample_ratio=32)
+    assert loss.shape == [N] and np.isfinite(loss.numpy()).all()
+    loss.sum().backward()
+    assert np.abs(x.grad.numpy()).sum() > 0
+
+    # objectness target responds to gt: loss differs from the empty case
+    empty = V.yolo_loss(paddle.to_tensor(xv),
+                        paddle.to_tensor(np.zeros((N, 5, 4), "f4")),
+                        paddle.to_tensor(np.zeros((N, 5), "i4")),
+                        anchors, mask, C, 0.7, 32)
+    assert not np.allclose(loss.numpy(), empty.numpy())
+
+    # jits (traced gt): same numbers as eager
+    import jax.numpy as jnp
+    jl = jax.jit(lambda xv_, gb, lb: V.yolo_loss(
+        paddle.Tensor(xv_), paddle.Tensor(gb), paddle.Tensor(lb),
+        anchors, mask, C, 0.7, 32)._value)(
+        jnp.asarray(xv), jnp.asarray(gt), jnp.asarray(gl))
+    np.testing.assert_allclose(np.asarray(jl), loss.numpy(), rtol=1e-4)
