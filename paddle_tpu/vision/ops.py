@@ -8,7 +8,10 @@ from ..framework.autograd import call_op
 from ..tensor._helpers import ensure_tensor
 
 __all__ = ["nms", "roi_align", "box_coder", "yolo_box", "deform_conv2d",
-           "roi_pool", "psroi_pool", "DeformConv2D"]
+           "roi_pool", "psroi_pool", "DeformConv2D",
+           "prior_box", "distribute_fpn_proposals", "matrix_nms",
+           "generate_proposals", "yolo_loss",
+           "RoIAlign", "RoIPool", "PSRoIPool"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -561,13 +564,22 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = np.sqrt(np.maximum(w * h, 1e-12))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype("int64")
+    if rois_num is not None:
+        rn = np.asarray(ensure_tensor(rois_num)._value).reshape(-1)
+        img_of = np.repeat(np.arange(len(rn)), rn)    # roi -> image id
     multi_rois, restore, rois_num_per = [], [], []
     order = []
     for L in range(min_level, max_level + 1):
         idx = np.where(lvl == L)[0]
         multi_rois.append(Tensor(jnp.asarray(rois[idx])))
-        rois_num_per.append(Tensor(jnp.asarray(
-            np.asarray([len(idx)], "int32"))))
+        if rois_num is not None:
+            # per-IMAGE counts at this level (the reference shape (B,))
+            cnt = np.bincount(img_of[idx], minlength=len(rn))
+            rois_num_per.append(Tensor(jnp.asarray(
+                cnt.astype("int32"))))
+        else:
+            rois_num_per.append(Tensor(jnp.asarray(
+                np.asarray([len(idx)], "int32"))))
         order.append(idx)
     order = np.concatenate(order) if order else np.zeros((0,), "int64")
     restore = np.argsort(order).astype("int32")[:, None]
@@ -598,7 +610,9 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
             keep = np.where(sc > score_threshold)[0]
             if keep.size == 0:
                 continue
-            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            order = keep[np.argsort(-sc[keep])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
             bb, ss = b[n][order], sc[order]
             x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
             area = (x2 - x1 + off) * (y2 - y1 + off)
@@ -613,7 +627,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
             iou_max = iou.max(0)                 # per box
             comp = iou_max[:, None]              # IoU compensation
             if use_gaussian:
-                decay = np.exp((comp ** 2 - iou ** 2) / gaussian_sigma)
+                # SOLOv2: exp(-sigma*iou^2) / exp(-sigma*comp^2)
+                decay = np.exp((comp ** 2 - iou ** 2) * gaussian_sigma)
             else:
                 decay = (1 - iou) / np.maximum(1 - comp, 1e-9)
             decay = np.triu(decay, 1) + np.tril(np.ones_like(decay))
@@ -626,7 +641,9 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
         if dets:
             dets = np.asarray(dets, "float32")
             det_idx = np.asarray(det_idx, "int64")
-            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            top = np.argsort(-dets[:, 1])
+            if keep_top_k > 0:             # -1 keeps all (reference)
+                top = top[:keep_top_k]
             dets, det_idx = dets[top], det_idx[top]
         else:
             dets = np.zeros((0, 6), "float32")
@@ -781,8 +798,9 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             return jnp.maximum(logit, 0) - logit * target + \
                 jnp.log1p(jnp.exp(-jnp.abs(logit)))
 
-        lxy = (sce(px, tx) + sce(py, ty)) * box_scale * obj_t
-        lwh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * box_scale * obj_t
+        # gt_score (mixup) weights the coordinate/class losses too
+        lxy = (sce(px, tx) + sce(py, ty)) * box_scale * tscore
+        lwh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * box_scale * tscore
 
         # ignore mask: predicted boxes with IoU > thresh vs ANY gt
         grid_x = jnp.arange(W)[None, None, None, :]
@@ -814,7 +832,10 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             sce(pobj, jnp.zeros_like(pobj)) * (1 - obj_t) * \
             (1 - ignore.astype(jnp.float32))
 
-        smooth = 1.0 / jnp.maximum(C, 1) if use_label_smooth else 0.0
+        # reference smoothing: delta = min(1/C, 1/40); targets are
+        # (1 - delta) positive / delta negative
+        delta = min(1.0 / max(C, 1), 1.0 / 40.0) if use_label_smooth \
+            else 0.0
         cls_t = jnp.zeros((N, A, C, H, W), jnp.float32)
         ni = jnp.arange(N)[:, None] * jnp.ones((1, B), jnp.int32)
         gl_i = jnp.clip(gl.astype(jnp.int32), 0, C - 1)
@@ -823,8 +844,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             .at[flat_c.reshape(-1)].add(
                 jnp.where(resp, 1.0, 0.0).reshape(-1), mode="drop") \
             .reshape(N, A, C, H, W)
-        cls_t = jnp.clip(cls_t, 0.0, 1.0) * (1 - smooth) + smooth / 2
-        lcls = sce(pcls, cls_t) * obj_t[:, :, None]
+        cls_t = jnp.clip(cls_t, 0.0, 1.0) * (1 - 2 * delta) + delta
+        lcls = sce(pcls, cls_t) * tscore[:, :, None]
 
         per_img = (jnp.sum(lxy, axis=(1, 2, 3))
                    + jnp.sum(lwh, axis=(1, 2, 3))
