@@ -162,3 +162,59 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         n_params = builtins.sum(int(_np.prod(p.shape)) for p in params)
         print(f"Total Flops: {total}     Total Params: {n_params}")
     return total
+
+
+def in_static_mode():
+    return not in_dynamic_mode()
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_name=None):
+    # the axon TPU plugin IS a custom PJRT device
+    return is_compiled_with_tpu()
+
+
+def disable_signal_handler():
+    """reference: paddle.disable_signal_handler — the reference installs
+    C++ fault handlers it sometimes must drop; PJRT installs none, so
+    this is a true no-op kept for API parity."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: paddle.batch — wrap an item reader into a batch
+    reader (legacy reader-decorator API)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """reference: paddle.LazyGuard — delay parameter initialization.
+
+    Inside the context, ``create_parameter`` skips running the
+    initializer (parameters hold zeros of the right shape/dtype and
+    remember their initializer); call ``param.initialize()`` — or
+    iterate ``layer.parameters()`` calling it — to materialize.  On TPU
+    the main win is skipping redundant init compute for params that a
+    checkpoint load or a sharded init will overwrite anyway.
+    """
+
+    def __enter__(self):
+        from .nn.layer import layers as _l
+        _l._LAZY_INIT[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        from .nn.layer import layers as _l
+        _l._LAZY_INIT[0] = False
+        return False

@@ -3,6 +3,7 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 from .nn.functional import flash_attention  # noqa: F401
 from .ops import (segment_sum, segment_mean, segment_max,  # noqa: F401
                   segment_min, graph_send_recv, softmax_mask_fuse,

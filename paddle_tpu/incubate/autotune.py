@@ -1,0 +1,25 @@
+"""paddle.incubate.autotune (reference:
+python/paddle/incubate/autotune.py — kernel/layout/dataloader tuning
+config).  TPU-native: XLA autotunes convolution/matmul algorithm choice
+during compilation and PJRT owns layouts, so the kernel/layout knobs
+are accepted and recorded but have nothing left to tune; the dataloader
+knob feeds io.DataLoader's worker heuristics."""
+
+_CONFIG = {}
+
+
+def set_config(config=None):
+    """Accept and record the tuning config (dict or JSON file path)."""
+    global _CONFIG
+    if config is None:
+        _CONFIG = {"kernel": {"enable": True}}
+        return
+    if isinstance(config, str):
+        import json
+        with open(config) as f:
+            config = json.load(f)
+    _CONFIG = dict(config)
+
+
+def get_config():
+    return dict(_CONFIG)

@@ -32,7 +32,7 @@ from .dy2static import bounded_loops, active_loop_bound
 
 __all__ = ["to_static", "not_to_static", "save", "load", "StaticFunction",
            "TranslatedLayer", "ignore_module", "enable_to_static",
-           "bounded_loops"]
+           "bounded_loops", "enable_sot"]
 
 _TO_STATIC_ENABLED = [True]
 
@@ -57,6 +57,17 @@ _GRAPH_BREAK_ERRORS = (NotImplementedError,
 
 def enable_to_static(flag=True):
     _TO_STATIC_ENABLED[0] = bool(flag)
+
+
+_SOT_ENABLED = [True]
+
+
+def enable_sot(flag=True):
+    """Toggle the SOT-style graph-break fallback (reference:
+    paddle.jit.enable_sot / ENABLE_SOT).  Disabled, an untraceable
+    construct raises instead of silently running that input spec
+    eagerly — useful to HARD-ASSERT everything compiles."""
+    _SOT_ENABLED[0] = bool(flag)
 
 
 def ignore_module(modules):
@@ -224,6 +235,8 @@ class StaticFunction:
             try:
                 return self._run_compiled(compiled, args, kwargs)
             except _GRAPH_BREAK_ERRORS as e:
+                if not _SOT_ENABLED[0]:
+                    raise
                 import warnings
                 self._cache[key] = _GRAPH_BREAK
                 warnings.warn(

@@ -17,6 +17,9 @@ from ...framework import dtypes
 from ...framework.autograd import no_grad
 from ..initializer import _apply_initializer
 
+# paddle.LazyGuard state (see paddle_tpu/__init__.py)
+_LAZY_INIT = [False]
+
 __all__ = ["Layer", "LayerList", "Sequential", "ParameterList", "LayerDict"]
 
 
@@ -95,6 +98,22 @@ class Layer:
         if attr is not None and attr is not False:
             init = getattr(attr, "initializer", None) or init
             name = getattr(attr, "name", None)
+        if _LAZY_INIT[0]:
+            # paddle.LazyGuard: defer the initializer; zeros hold the
+            # shape/dtype until param.initialize() materializes
+            import jax.numpy as _jnp
+            p = Tensor(_jnp.zeros(tuple(int(s) for s in shape), d),
+                       stop_gradient=False, name=name)
+            _shape, _init, _bias = tuple(int(s) for s in shape), init, \
+                is_bias
+
+            def _materialize(_p=p, _s=_shape, _i=_init, _b=_bias, _d=d):
+                _p._value = _apply_initializer(_i, _s, _d, _b)
+                return _p
+            p.initialize = _materialize
+            p.persistable = True
+            p.is_parameter = True
+            return p
         value = _apply_initializer(init, tuple(int(s) for s in shape), d,
                                    is_bias)
         p = Tensor(value, stop_gradient=False, name=name)

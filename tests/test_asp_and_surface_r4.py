@@ -313,3 +313,42 @@ def test_nn_round4_layers_and_losses():
                                   paddle.to_tensor(parents))._value)
     assert gt.shape == ids.shape
     np.testing.assert_array_equal(gt[2], ids[2])   # last step unchanged
+
+
+def test_lazy_guard_and_misc_helpers():
+    """paddle.LazyGuard deferred init + batch/enable_sot/flag helpers."""
+    with paddle.LazyGuard():
+        net = nn.Linear(8, 8)
+    assert (net.weight.numpy() == 0).all()
+    paddle.seed(0)
+    for p in net.parameters():
+        if hasattr(p, "initialize"):
+            p.initialize()
+    assert np.abs(net.weight.numpy()).sum() > 0
+    assert paddle.in_static_mode() == (not paddle.in_dynamic_mode())
+    paddle.disable_signal_handler()
+    r = paddle.batch(lambda: iter(range(7)), 3)
+    assert [len(b) for b in r()] == [3, 3, 1]
+    from paddle_tpu.incubate import autotune
+    autotune.set_config({"kernel": {"enable": True}})
+    assert autotune.get_config()["kernel"]["enable"]
+
+
+def test_enable_sot_off_raises_instead_of_graph_break():
+    import warnings as _w
+    from tests.test_dy2static import BreakNet  # reuse the break model
+    paddle.seed(9)
+    net = BreakNet()
+    snet = paddle.jit.to_static(net)
+    import jax.numpy as _jnp
+    from paddle_tpu.framework.core import Tensor as _T
+    x = _T(_jnp.asarray(np.random.RandomState(4).randn(2, 4).astype("f4")))
+    n = _T(_jnp.asarray(5))
+    paddle.jit.enable_sot(False)
+    try:
+        with pytest.raises(Exception):
+            with _w.catch_warnings():
+                _w.simplefilter("ignore")
+                snet(x, n)
+    finally:
+        paddle.jit.enable_sot(True)
