@@ -164,3 +164,158 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
         from ..nn import functional as F
         out = getattr(F, act)(out)
     return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: paddle.static.nn.switch_case — dispatch on a (possibly
+    traced) integer index.  Traced index -> lax.switch."""
+    import jax
+    from ..framework.core import Tensor
+    from ..jit.dy2static import _val, _unwrap_tree, _wrap_tree
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        pairs = list(branch_fns)
+        if pairs and isinstance(pairs[0], (tuple, list)):
+            keys = [k for k, _ in pairs]
+            fns = [f for _, f in pairs]
+        else:
+            keys = list(range(len(pairs)))
+            fns = pairs
+    if default is None:
+        default = fns[-1]
+    idx = _val(branch_index)
+    if not isinstance(idx, jax.core.Tracer):
+        return dict(zip(keys, fns)).get(int(idx), default)()
+    # traced: map arbitrary keys onto a dense switch table + default
+    import jax.numpy as jnp
+    table = fns + [default]
+    sel = jnp.full((), len(fns), jnp.int32)
+    for i, k in enumerate(keys):
+        sel = jnp.where(idx == k, i, sel)
+    return _wrap_tree(jax.lax.switch(
+        sel, [lambda f=f: _unwrap_tree(f()) for f in table]))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: paddle.static.nn.case — first true predicate wins."""
+    from ..jit.dy2static import convert_ifelse
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(i):
+        if i >= len(pairs):
+            if default is None:
+                return pairs[-1][1]
+            return default
+        pred, fn = pairs[i]
+        return lambda: convert_ifelse(pred, lambda: fn(),
+                                      lambda: build(i + 1)())
+    return build(0)()
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference: paddle.static.nn.static_pylayer — custom forward +
+    backward inside the static graph.  TPU-native: jax.custom_vjp over
+    the traced forward; backward_fn(*out_grads) -> in_grads."""
+    import jax
+    from ..framework.core import Tensor
+    from ..framework.autograd import call_op
+    ins = [t if isinstance(t, Tensor) else Tensor(t) for t in inputs]
+
+    if backward_fn is None:
+        def stop(*vals):
+            out = forward_fn(*[Tensor(v) for v in vals])
+            out_t = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(jax.lax.stop_gradient(o._value) for o in out_t)
+        res = call_op(stop, *ins)
+        return res if isinstance(res, tuple) and len(res) > 1 else (
+            res[0] if isinstance(res, tuple) else res)
+
+    @jax.custom_vjp
+    def op(*vals):
+        out = forward_fn(*[Tensor(v) for v in vals])
+        out_t = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._value for o in out_t)
+
+    def fwd(*vals):
+        return op(*vals), None
+
+    def bwd(_, gs):
+        grads = backward_fn(*[Tensor(g) for g in gs])
+        grads = grads if isinstance(grads, (list, tuple)) else [grads]
+        return tuple(g._value if isinstance(g, Tensor) else g
+                     for g in grads)
+
+    op.defvjp(fwd, bwd)
+    res = call_op(lambda *vs: op(*vs), *ins)
+    return res if isinstance(res, tuple) and len(res) > 1 else (
+        res[0] if isinstance(res, tuple) else res)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn as _nn
+    C = input.shape[1 if data_layout == "NCHW" else -1]
+    layer = _layer_for("group_norm", name, lambda: _nn.GroupNorm(
+        num_groups=groups, num_channels=C, epsilon=epsilon,
+        weight_attr=param_attr, bias_attr=bias_attr))
+    out = layer(input)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn as _nn
+    C = input.shape[1]
+    layer = _layer_for("instance_norm", name, lambda: _nn.InstanceNorm2D(
+        C, epsilon=epsilon, weight_attr=param_attr, bias_attr=bias_attr))
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn as _nn
+    n = 1 if mode == "all" else x.shape[1]
+    layer = _layer_for("prelu", name, lambda: _nn.PReLU(
+        num_parameters=n, weight_attr=param_attr,
+        data_format=data_format))
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.utils import spectral_norm as _sn_hook
+    from ..framework.core import Tensor
+    from ..framework.autograd import call_op
+    import jax.numpy as jnp
+    import jax
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+
+    def _sn(v):
+        mat = jnp.moveaxis(v, dim, 0).reshape(v.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), v.dtype)
+        for _ in range(max(1, power_iters)):
+            vv = mat.T @ u
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            u = mat @ vv
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ vv
+        return v / (sigma + eps)
+    return call_op(_sn, w)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn as _nn
+    layer = _layer_for("bilinear", name, lambda: _nn.Bilinear(
+        x.shape[-1], y.shape[-1], size, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return _act(layer(x, y), act)
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    from ..nn import functional as F
+    return getattr(F, act)(out)

@@ -226,3 +226,49 @@ class TestStaticNNBuilders:
             assert r[0].shape == (3, 6, 8)
         finally:
             paddle.disable_static()
+
+
+def test_static_nn_round4_surface():
+    """switch_case (concrete + traced lax.switch), case, static_pylayer
+    custom vjp, and the norm/prelu/bilinear/spectral wrappers."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.core import Tensor
+    sn = paddle.static.nn
+
+    out = jax.jit(lambda i: sn.switch_case(
+        Tensor(i), {1: lambda: Tensor(jnp.asarray(10.0)),
+                    5: lambda: Tensor(jnp.asarray(50.0))},
+        default=lambda: Tensor(jnp.asarray(-1.0)))._value)
+    assert float(out(jnp.asarray(5))) == 50.0
+    assert float(out(jnp.asarray(3))) == -1.0
+
+    r = sn.case([(paddle.to_tensor(np.asarray(False)),
+                  lambda: paddle.to_tensor(np.asarray(1.0))),
+                 (paddle.to_tensor(np.asarray(True)),
+                  lambda: paddle.to_tensor(np.asarray(2.0)))],
+                default=lambda: paddle.to_tensor(np.asarray(3.0)))
+    assert float(r._value) == 2.0
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], "f4"),
+                         stop_gradient=False)
+    out2 = sn.static_pylayer(lambda a: a * 2.0, [x],
+                             backward_fn=lambda g: g * 7.0)
+    out2.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0, 7.0])
+    # no backward_fn -> gradients blocked
+    x2 = paddle.to_tensor(np.asarray([1.0], "f4"), stop_gradient=False)
+    out3 = sn.static_pylayer(lambda a: a * 3.0, [x2])
+    assert float(out3._value[0]) == 3.0
+
+    img = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4, 8, 8).astype("f4"))
+    assert tuple(sn.group_norm(img, groups=2).shape) == (2, 4, 8, 8)
+    assert tuple(sn.instance_norm(img).shape) == (2, 4, 8, 8)
+    assert tuple(sn.prelu(img).shape) == (2, 4, 8, 8)
+    w = paddle.to_tensor(np.random.RandomState(1).randn(6, 4).astype("f4"))
+    s_max = np.linalg.svd(sn.spectral_norm(w, power_iters=3).numpy(),
+                          compute_uv=False)[0]
+    assert abs(s_max - 1.0) < 0.2
