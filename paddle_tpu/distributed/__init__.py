@@ -27,6 +27,7 @@ from . import checkpoint  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from . import communication  # noqa: F401
+from . import passes  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 

@@ -1,0 +1,66 @@
+"""paddle.distributed.passes (reference:
+python/paddle/distributed/passes/ — graph passes applied to the static
+program: fuse_optimizer, fuse_all_reduce, recompute, AMP, sharding...).
+
+TPU-native: XLA owns operator fusion/scheduling and GSPMD owns
+communication placement, so most reference passes have no separate
+artifact to rewrite — their INTENT maps onto DistributedStrategy knobs
+(recompute/amp/sharding meta-optimizers) or is already the compiler's
+default (fusion).  ``new_pass`` returns a PassBase that records its
+config; ``apply`` validates the mapping and is otherwise a no-op, so
+reference pass-driving code runs unchanged.
+"""
+
+__all__ = ["new_pass", "PassBase", "PassManager"]
+
+# reference pass name -> where the equivalent lives here
+_KNOWN = {
+    "fuse_optimizer": "XLA fuses the optimizer update chain at compile",
+    "fuse_all_reduce": "GSPMD/XLA coalesce collectives",
+    "fuse_gemm_epilogue": "XLA fuses bias/activation epilogues",
+    "fuse_bn_act": "XLA fusion",
+    "fuse_elewise_add_act": "XLA fusion",
+    "auto_parallel_recompute": "fleet.utils.recompute / strategy",
+    "auto_parallel_amp": "paddle.amp / DistributedStrategy.amp",
+    "auto_parallel_fp16": "paddle.amp O2",
+    "auto_parallel_sharding": "DistributedStrategy.sharding",
+    "auto_parallel_gradient_merge": "GradientMerge meta-optimizer",
+    "pipeline_scheduler_1F1B": "fleet pipeline stepper (1F1B)",
+    "pipeline_scheduler_FThenB": "fleet pipeline stepper",
+}
+
+
+class PassBase:
+    def __init__(self, name, attrs=None):
+        if name not in _KNOWN:
+            raise ValueError(
+                f"unknown pass {name!r}; known passes: "
+                f"{sorted(_KNOWN)}")
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+        return self
+
+    def apply(self, main_programs=None, startup_programs=None,
+              context=None):
+        """No separate graph artifact to rewrite on TPU — see module
+        docstring; returns the mapping note for introspection."""
+        return _KNOWN[self.name]
+
+
+def new_pass(name, pass_attrs=None):
+    return PassBase(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self.passes = list(passes or [])
+
+    def append(self, p):
+        self.passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        return [p.apply(main_programs, startup_programs)
+                for p in self.passes]
