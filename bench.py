@@ -632,7 +632,24 @@ def main():
         if want("bert", "bert_base_amp"):
             try:
                 # B sweep (r3): 16→36.0%, 32→37.9%, 48→41.2%, 64→38.2%
-                # (the MLM logits block tops out VMEM-friendly at 48)
+                # (the MLM logits block tops out VMEM-friendly at 48);
+                # r4 scanned re-check: 48→43.4%, 64→40.6%, 96→38.0%.
+                #
+                # Why BERT sits at ~43% (latency-free r4 analysis, the
+                # VERDICT #2 "residual is physics" note): the bidir
+                # flash kernels are VPU-transcendental-bound, not
+                # schedule-bound — EVERY (hb, bq, bk) config measures
+                # fwd 2.5-2.8ms / bwd 2.9-3.4ms per layer on 50-call
+                # latency-free chains (7-17% of MXU peak; attention is
+                # 8% of credited FLOPs but ~30% of wall). The XLA
+                # dense path is 1.27-1.37x SLOWER at this shape, so
+                # flash is the right call. Ablations: stubbing
+                # attention or the MLM head moves the step <5% each;
+                # the non-attention remainder runs at ~85% matmul
+                # efficiency. A microbench-winning config (256,512,
+                # hb=8) collapsed the FULL model to 11% MFU (VMEM
+                # pressure beside live model buffers) — kernel tables
+                # must be validated at model level.
                 configs["bert_base_amp"] = bench_bert(B=48, S=512,
                                                       iters=10, peak=peak)
             except Exception as e:
@@ -643,7 +660,10 @@ def main():
                     vocab_size=50304, hidden_size=768,
                     num_hidden_layers=12, num_attention_heads=12,
                     max_position_embeddings=4096)
-                configs["gpt125m_s4096"] = bench_gpt(gptlc, B=6, S=4096,
+                # r4 scanned-bench B sweep: B=6 45.4%, 4 46.0%, 3 46.1%,
+                # 2 46.7%, 1 43.4% — smaller per-step HBM live set wins
+                # until B=1 under-fills the MXU
+                configs["gpt125m_s4096"] = bench_gpt(gptlc, B=2, S=4096,
                                                      iters=10, peak=peak)
             except Exception as e:
                 configs["gpt125m_s4096"] = {"error": repr(e)[:200]}

@@ -55,7 +55,15 @@ def _xla_attention(q, k, v, mask=None, causal=False, scale=None,
 
 
 def _use_pallas(S, scale):
-    # pallas kernel path: default scale only (it bakes 1/sqrt(D))
+    # pallas kernel path: default scale only (it bakes 1/sqrt(D));
+    # PADDLE_TPU_ATTN_IMPL=dense|flash overrides for A/B tuning
+    import os
+    ov = os.environ.get("PADDLE_TPU_ATTN_IMPL")
+    if ov == "dense":
+        return False
+    if ov == "flash":
+        return scale is None and S % 512 == 0 \
+            and jax.default_backend() == "tpu"
     return (scale is None and S >= _PALLAS_MIN_SEQ and S % 512 == 0 and
             jax.default_backend() == "tpu")
 

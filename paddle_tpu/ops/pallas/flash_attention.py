@@ -24,9 +24,18 @@ def _fwd_blocks(S):
     256/256 wins for the head-folded kernel (smaller unrolled stack,
     better VPU/MXU overlap).  Blocks must DIVIDE S — the kernels size
     their loops as S // block (S=4608 with bk=1024 would silently skip
-    the last 512 keys)."""
-    if S >= 4096 and S % 1024 == 0:
-        return (512, 1024)
+    the last 512 keys).  PADDLE_TPU_FLASH_BLOCKS="bq,bk" overrides for
+    model-level A/B tuning."""
+    import os
+    ov = os.environ.get("PADDLE_TPU_FLASH_BLOCKS")
+    if ov:
+        bq, bk = (int(t) for t in ov.split(","))
+        if S % bq == 0 and S % bk == 0:
+            return (bq, bk)
+    if S >= 4096 and S % 512 == 0:
+        # r4 scan autotune: (512,512) 6.97ms vs (512,1024) 7.36ms at
+        # S=4096 (the r3 pick was taken under ~5ms dispatch noise)
+        return (512, 512)
     if S % 256 == 0:
         return (256, 256)
     return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
@@ -572,6 +581,10 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
     block_k = min(block_k, S)
     scale = 1.0 / math.sqrt(D)
     if hb is None:  # hb is a REAL static arg so autotune sweeps retrace
+        # NOTE r4: an isolated-kernel autotune said (256,512,hb=8) wins
+        # at the BERT shape, but the FULL model collapsed to 11% MFU
+        # with it (VMEM pressure alongside the live model buffers) —
+        # kernel tables must be validated at model level
         hb = _pick_hb(BH, S, D, n_bufs=4, budget=1280 * 1024)  # hb=2 best at S=1024 (measured)
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
     out_specs = [spec]
