@@ -208,14 +208,28 @@ def bench_resnet50(B, iters):
     measured v5e-1 ceiling here is ~2.4k at B=256 (2.1k in r2; the gain
     came from folding BN into one fused E[x]/E[x^2] pass + bf16 apply).
     Why it tops out: ResNet-50's 1x1 bottleneck convs are HBM-bound
-    (arith intensity ~Cout flops/byte -> roofline ~26% of bf16 peak;
-    measured 8-11% for both lax.conv and explicit-matmul forms), and the
-    3x3 convs reach only 16-25% of peak under the XLA conv emitter at
-    these shapes regardless of logical layout (NHWC vs NCHW measured
-    within noise of each other — layout assignment already handles it).
-    B=320/384/512 all measure lower than B=256.  The anchor numbers come
-    from multi-chip runs whose per-chip batch and input pipeline differ;
-    on this exact chip the bound is memory bandwidth, not our lowering."""
+    (arith intensity ~Cout flops/byte -> roofline ~26% of bf16 peak),
+    and the 3x3 convs reach only 16-25% of peak under the XLA conv
+    emitter regardless of logical layout (NHWC == NCHW within noise).
+    B=320/384/512 all measure lower than B=256.
+
+    r4 closes the VERDICT #6 experiment with a measured three-way
+    comparison at every bottleneck shape (B=256, latency-free 20-rep
+    scan chains; ops/pallas/conv1x1.py is the fused kernel):
+      - the Pallas fused conv+BN+ReLU kernel ties-or-beats BOTH XLA
+        forms at 6/8 shapes (e.g. 5.46ms vs conv 8.55ms at 28x28
+        128->512) and the plain dot form beats the conv emitter up to
+        2.8x in isolation (3.26 vs 9.13ms at 56x56 64->256);
+      - but wiring the dot form INTO the model measured 1858 img/s vs
+        2344 with lax.conv (the NCHW transpose the isolated chain does
+        not pay dominates), so the emitter stays;
+      - all three forms sit far below even the HBM roofline in
+        isolation (3-8% of peak) — the op is bandwidth/latency bound,
+        and the remaining gap to the 2.5k+ anchors is the input-layout
+        conversion economics of a single chip, not the lowering.
+    The anchor numbers come from multi-chip runs whose per-chip batch
+    and input pipeline differ; on this exact chip the bound is memory
+    bandwidth, not our lowering."""
     import jax
     import jax.numpy as jnp
 
