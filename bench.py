@@ -616,10 +616,9 @@ def main():
             # chip-health reference: bare-matmul fraction of peak (see
             # chip_calibration docstring; degraded tunnel sessions make
             # every MFU below scale down with this number)
-            configs["chip_calibration_matmul_peak_frac"] = \
-                chip_calibration()
+            configs["chip_calibration"] = chip_calibration()
         except Exception as e:
-            configs["chip_calibration_matmul_peak_frac"] = repr(e)[:120]
+            configs["chip_calibration"] = repr(e)[:120]
         gpt125 = GPTConfig(vocab_size=50304, hidden_size=768,
                            num_hidden_layers=12, num_attention_heads=12,
                            max_position_embeddings=1024)

@@ -32,6 +32,11 @@ def _fwd_blocks(S):
         bq, bk = (int(t) for t in ov.split(","))
         if S % bq == 0 and S % bk == 0:
             return (bq, bk)
+        import warnings
+        warnings.warn(
+            f"PADDLE_TPU_FLASH_BLOCKS={ov} ignored: blocks must divide "
+            f"S={S} (measurement would be attributed to the wrong "
+            "config)", RuntimeWarning)
     if S >= 4096 and S % 512 == 0:
         # r4 scan autotune: (512,512) 6.97ms vs (512,1024) 7.36ms at
         # S=4096 (the r3 pick was taken under ~5ms dispatch noise)

@@ -14,7 +14,9 @@ from ..framework.core import Tensor
 from ..jit.dy2static import convert_ifelse, convert_while_loop
 
 __all__ = ["cond", "while_loop", "fc", "embedding", "conv2d",
-           "batch_norm", "layer_norm"]
+           "batch_norm", "layer_norm", "switch_case", "case", "static_pylayer", "group_norm",
+           "instance_norm", "prelu", "spectral_norm",
+           "bilinear_tensor_product"]
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
@@ -170,7 +172,6 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     """reference: paddle.static.nn.switch_case — dispatch on a (possibly
     traced) integer index.  Traced index -> lax.switch."""
     import jax
-    from ..framework.core import Tensor
     from ..jit.dy2static import _val, _unwrap_tree, _wrap_tree
     if isinstance(branch_fns, dict):
         keys = sorted(branch_fns)
@@ -261,7 +262,8 @@ def group_norm(input, groups, epsilon=1e-05, param_attr=None,
     C = input.shape[1 if data_layout == "NCHW" else -1]
     layer = _layer_for("group_norm", name, lambda: _nn.GroupNorm(
         num_groups=groups, num_channels=C, epsilon=epsilon,
-        weight_attr=param_attr, bias_attr=bias_attr))
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_layout))
     out = layer(input)
     return _act(out, act)
 
@@ -277,7 +279,14 @@ def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
 
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     from .. import nn as _nn
-    n = 1 if mode == "all" else x.shape[1]
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = x.shape[1] if data_format.startswith("NC") else x.shape[-1]
+    else:
+        raise NotImplementedError(
+            "static.nn.prelu: mode='element' (per-element alphas) is "
+            "not supported; use nn.PReLU with an explicit weight shape")
     layer = _layer_for("prelu", name, lambda: _nn.PReLU(
         num_parameters=n, weight_attr=param_attr,
         data_format=data_format))
@@ -285,11 +294,9 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
-    from ..nn.utils import spectral_norm as _sn_hook
     from ..framework.core import Tensor
     from ..framework.autograd import call_op
     import jax.numpy as jnp
-    import jax
     w = weight if isinstance(weight, Tensor) else Tensor(weight)
 
     def _sn(v):
