@@ -498,3 +498,56 @@ def gather_tree(ids, parents, name=None):
         _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
         return jnp.flip(toks, 0)
     return call_op(_gt, ids, parents)
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean",
+                  per_sample_weights=None, name=None):
+    """reference: paddle.nn.functional.embedding_bag — gather rows and
+    reduce per bag.  2D input (B, L): each row is a bag; 1D input +
+    offsets: ragged bags (offsets are bag starts)."""
+    from ...framework import dtypes as _dt
+    input = ensure_tensor(input)
+    weight = ensure_tensor(weight)
+    args = [input, weight]
+    if per_sample_weights is not None:
+        args.append(ensure_tensor(per_sample_weights))
+
+    if input._value.ndim == 2:
+        def _eb(idx, w, *psw):
+            rows = w[idx.astype(jnp.int32)]            # (B, L, D)
+            if psw:
+                rows = rows * psw[0][..., None]
+            if mode == "sum":
+                return rows.sum(1)
+            if mode == "mean":
+                return rows.mean(1)
+            if mode == "max":
+                return rows.max(1)
+            raise ValueError(f"unknown mode {mode!r}")
+        return call_op(_eb, *args)
+
+    if offsets is None:
+        raise ValueError("embedding_bag: 1D input needs offsets")
+    off = ensure_tensor(offsets)
+
+    def _eb1(idx, w, offv, *psw):
+        idx = idx.astype(jnp.int32)
+        n = idx.shape[0]
+        offv = offv.astype(jnp.int32)
+        # bag id per element via searchsorted on offsets
+        seg = jnp.searchsorted(offv, jnp.arange(n), side="right") - 1
+        rows = w[idx]
+        if psw:
+            rows = rows * psw[0][..., None]
+        nb = offv.shape[0]
+        if mode == "sum":
+            return jax.ops.segment_sum(rows, seg, num_segments=nb)
+        if mode == "mean":
+            s = jax.ops.segment_sum(rows, seg, num_segments=nb)
+            cnt = jax.ops.segment_sum(jnp.ones((n,), rows.dtype), seg,
+                                      num_segments=nb)
+            return s / jnp.maximum(cnt[:, None], 1.0)
+        if mode == "max":
+            return jax.ops.segment_max(rows, seg, num_segments=nb)
+        raise ValueError(f"unknown mode {mode!r}")
+    return call_op(_eb1, args[0], args[1], off, *args[2:])

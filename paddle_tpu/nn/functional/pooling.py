@@ -352,3 +352,17 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     return _fractional_max_pool_nd(x, output_size, kernel_size, random_u,
                                    return_mask, 3)
+
+
+def lp_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", norm_type=2.0, name=None):
+    """reference: paddle.nn.functional.lp_pool1d."""
+    x = ensure_tensor(x)
+    dims, strides, k, _ = _window(kernel_size, stride, 1, data_format)
+    pad = _pad_spec(padding, 1, data_format)
+
+    def _lp(v):
+        p = jax.lax.reduce_window(jnp.power(jnp.abs(v), norm_type), 0.0,
+                                  jax.lax.add, dims, strides, pad)
+        return jnp.power(p, 1.0 / norm_type)
+    return call_op(_lp, x)

@@ -312,3 +312,109 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     hist, edges = jnp.histogramdd(x._value, bins=bins, range=ranges,
                                   density=density, weights=w)
     return _T(hist), [_T(e) for e in edges]
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """reference: paddle.linalg.lu_unpack — split the packed LU matrix
+    into (P, L, U); pivots are 1-based (paddle layout)."""
+    lu_t = ensure_tensor(lu_data)
+    piv = ensure_tensor(lu_pivots)
+
+    def _unpack(v, p):
+        m, n = v.shape[-2], v.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(v[..., :, :k], -1) + jnp.eye(m, k, dtype=v.dtype)
+        U = jnp.triu(v[..., :k, :])
+        # pivots -> permutation matrix: row swaps applied in order
+        pi = p.astype(jnp.int32) - 1
+        perm = jnp.arange(m)
+
+        def swap(i, perm):
+            j = pi[..., i]
+            a, b = perm[i], perm[j]
+            return perm.at[i].set(b).at[j].set(a)
+        perm = jax.lax.fori_loop(0, pi.shape[-1], swap, perm)
+        P = jnp.eye(m, dtype=v.dtype)[:, perm]
+        return P, L, U
+    out = call_op(_unpack, lu_t, piv)
+    return out
+
+
+def matrix_exp(x, name=None):
+    """reference: paddle.linalg.matrix_exp."""
+    import jax.scipy.linalg as jsl
+    return call_op(lambda v: jsl.expm(v), ensure_tensor(x))
+
+
+def svdvals(x, name=None):
+    """reference: paddle.linalg.svdvals — singular values only."""
+    return call_op(lambda v: jnp.linalg.svd(v, compute_uv=False),
+                   ensure_tensor(x))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """reference: paddle.linalg.ormqr — multiply y by the orthogonal Q
+    of a householder-packed QR (x, tau)."""
+    x, tau, y = (ensure_tensor(t) for t in (x, tau, y))
+
+    def _ormqr(a, t, other):
+        # materialize Q from the householder reflectors, then multiply
+        # (LAPACK applies reflectors directly; on TPU a dense matmul of
+        # the same Q is the MXU-native form)
+        m = a.shape[-2]
+        k = t.shape[-1]
+        Q = jnp.eye(m, dtype=a.dtype)
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
+            v = v.at[i].set(1.0)
+            H = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            Q = Q @ H
+        Qm = Q.T if transpose else Q
+        return Qm @ other if left else other @ Qm
+    return call_op(_ormqr, x, tau, y)
+
+
+def _lowrank(v, q, key, niter=2):
+    """Randomized range finder (Halko et al.) shared by svd_lowrank /
+    pca_lowrank."""
+    m, n = v.shape[-2], v.shape[-1]
+    g = jax.random.normal(key, v.shape[:-2] + (n, q), v.dtype)
+    Y = v @ g
+    Qm, _ = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = v.T @ Qm if v.ndim == 2 else jnp.swapaxes(v, -1, -2) @ Qm
+        Qz, _ = jnp.linalg.qr(Z)
+        Y = v @ Qz
+        Qm, _ = jnp.linalg.qr(Y)
+    B = jnp.swapaxes(Qm, -1, -2) @ v
+    u_b, s, vt = jnp.linalg.svd(B, full_matrices=False)
+    return Qm @ u_b, s, jnp.swapaxes(vt, -1, -2)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """reference: paddle.linalg.svd_lowrank — randomized truncated SVD
+    (Halko-Martinsson-Tropp power iterations)."""
+    from ..framework.random import next_key
+    x = ensure_tensor(x)
+    key = next_key()
+    mshift = None if M is None else ensure_tensor(M)
+
+    def _svdl(v, *mm):
+        vv = v - mm[0] if mm else v
+        return _lowrank(vv, int(q), key, int(niter))
+    args = [x] + ([mshift] if mshift is not None else [])
+    return call_op(_svdl, *args)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: paddle.linalg.pca_lowrank — randomized PCA."""
+    from ..framework.random import next_key
+    x = ensure_tensor(x)
+    qq = int(q) if q is not None else min(6, *x.shape[-2:])
+    key = next_key()
+
+    def _pca(v):
+        vv = v - jnp.mean(v, axis=-2, keepdims=True) if center else v
+        return _lowrank(vv, qq, key, int(niter))
+    return call_op(_pca, x)

@@ -352,3 +352,70 @@ def test_enable_sot_off_raises_instead_of_graph_break():
                 snet(x, n)
     finally:
         paddle.jit.enable_sot(True)
+
+
+def test_linalg_round4_additions():
+    """lu_unpack / matrix_exp / svdvals / ormqr / svd_lowrank /
+    pca_lowrank vs scipy-numpy references."""
+    import scipy.linalg
+    L = paddle.linalg
+    rng = np.random.RandomState(0)
+    a = rng.randn(5, 5).astype("f4")
+
+    lu_t, piv = L.lu(paddle.to_tensor(a))[:2]
+    P, Lm, U = L.lu_unpack(lu_t, piv)
+    np.testing.assert_allclose(P.numpy() @ Lm.numpy() @ U.numpy(), a,
+                               rtol=1e-4, atol=1e-5)
+
+    me = L.matrix_exp(paddle.to_tensor(a * 0.1))
+    np.testing.assert_allclose(me.numpy(), scipy.linalg.expm(a * 0.1),
+                               rtol=1e-4, atol=1e-5)
+
+    sv = L.svdvals(paddle.to_tensor(a))
+    np.testing.assert_allclose(sv.numpy(),
+                               np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-4)
+
+    # ormqr applied to identity reproduces Q (LAPACK raw packing:
+    # scipy mode='raw' returns ((h, tau), r))
+    (h, tau), _r = scipy.linalg.qr(a, mode="raw")
+    q_ref = np.linalg.qr(a)[0]
+    out = L.ormqr(paddle.to_tensor(h.astype("f4")),
+                  paddle.to_tensor(tau.astype("f4")),
+                  paddle.to_tensor(np.eye(5, dtype="f4")))
+    np.testing.assert_allclose(np.abs(out.numpy()), np.abs(q_ref),
+                               rtol=1e-3, atol=1e-4)
+
+    paddle.seed(0)
+    base = (rng.randn(8, 2) @ rng.randn(2, 6)).astype("f4")
+    U2, s2, V2 = L.svd_lowrank(paddle.to_tensor(base), q=4)
+    rec = (U2.numpy() * s2.numpy()) @ V2.numpy().T
+    np.testing.assert_allclose(rec, base, rtol=1e-3, atol=1e-3)
+    U3, s3, V3 = L.pca_lowrank(paddle.to_tensor(base), q=2)
+    assert s3.numpy().shape[-1] == 2
+
+
+def test_lp_pool1d_and_embedding_bag():
+    import paddle_tpu.nn.functional as F
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 8)
+                         .astype("f4"))
+    o = F.lp_pool1d(x, 2, 2)
+    assert tuple(o.shape) == (2, 3, 4)
+    # norm_type=2: sqrt of summed squares
+    ref = np.sqrt((x.numpy() ** 2).reshape(2, 3, 4, 2).sum(-1))
+    np.testing.assert_allclose(o.numpy(), ref, rtol=1e-5)
+
+    w = paddle.to_tensor(np.random.RandomState(1).randn(10, 4)
+                         .astype("f4"))
+    ids2 = paddle.to_tensor(np.asarray([[1, 2, 3], [4, 5, 6]]))
+    eb = F.embedding_bag(ids2, w, mode="mean")
+    np.testing.assert_allclose(
+        eb.numpy(),
+        w.numpy()[np.asarray([[1, 2, 3], [4, 5, 6]])].mean(1), rtol=1e-6)
+    ids1 = paddle.to_tensor(np.asarray([1, 2, 3, 4, 5]))
+    offs = paddle.to_tensor(np.asarray([0, 2]))
+    eb1 = F.embedding_bag(ids1, w, offsets=offs, mode="sum")
+    np.testing.assert_allclose(eb1.numpy()[0], w.numpy()[[1, 2]].sum(0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(eb1.numpy()[1],
+                               w.numpy()[[3, 4, 5]].sum(0), rtol=1e-6)
