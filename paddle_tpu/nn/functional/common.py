@@ -505,7 +505,6 @@ def embedding_bag(input, weight, offsets=None, mode="mean",
     """reference: paddle.nn.functional.embedding_bag — gather rows and
     reduce per bag.  2D input (B, L): each row is a bag; 1D input +
     offsets: ragged bags (offsets are bag starts)."""
-    from ...framework import dtypes as _dt
     input = ensure_tensor(input)
     weight = ensure_tensor(weight)
     args = [input, weight]

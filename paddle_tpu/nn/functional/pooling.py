@@ -210,8 +210,9 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
 def lp_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
               data_format="NCHW", norm_type=2.0, name=None):
     x = ensure_tensor(x)
-    dims, strides, k, _ = _window(kernel_size, stride, 2, data_format)
-    pad = _pad_spec(padding, 2, data_format)
+    dims, strides, k, s_ = _window(kernel_size, stride, 2, data_format)
+    pad = _pad_spec(padding, 2, data_format, ceil_mode,
+                    _spatial_sizes(x, 2, data_format), k, s_)
 
     def _lp(v):
         p = jax.lax.reduce_window(jnp.power(jnp.abs(v), norm_type), 0.0,
@@ -358,8 +359,9 @@ def lp_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
               data_format="NCL", norm_type=2.0, name=None):
     """reference: paddle.nn.functional.lp_pool1d."""
     x = ensure_tensor(x)
-    dims, strides, k, _ = _window(kernel_size, stride, 1, data_format)
-    pad = _pad_spec(padding, 1, data_format)
+    dims, strides, k, s_ = _window(kernel_size, stride, 1, data_format)
+    pad = _pad_spec(padding, 1, data_format, ceil_mode,
+                    _spatial_sizes(x, 1, data_format), k, s_)
 
     def _lp(v):
         p = jax.lax.reduce_window(jnp.power(jnp.abs(v), norm_type), 0.0,

@@ -317,28 +317,38 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
 def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
               name=None):
     """reference: paddle.linalg.lu_unpack — split the packed LU matrix
-    into (P, L, U); pivots are 1-based (paddle layout)."""
+    into (P, L, U); pivots are 1-based (paddle layout); un-requested
+    outputs are None (the reference contract).  Batched inputs unpack
+    via vmap over the leading dims."""
     lu_t = ensure_tensor(lu_data)
     piv = ensure_tensor(lu_pivots)
 
-    def _unpack(v, p):
+    def _one(v, p):
         m, n = v.shape[-2], v.shape[-1]
         k = min(m, n)
-        L = jnp.tril(v[..., :, :k], -1) + jnp.eye(m, k, dtype=v.dtype)
-        U = jnp.triu(v[..., :k, :])
-        # pivots -> permutation matrix: row swaps applied in order
+        L = jnp.tril(v[:, :k], -1) + jnp.eye(m, k, dtype=v.dtype)
+        U = jnp.triu(v[:k, :])
         pi = p.astype(jnp.int32) - 1
         perm = jnp.arange(m)
 
         def swap(i, perm):
-            j = pi[..., i]
+            j = pi[i]
             a, b = perm[i], perm[j]
             return perm.at[i].set(b).at[j].set(a)
-        perm = jax.lax.fori_loop(0, pi.shape[-1], swap, perm)
+        perm = jax.lax.fori_loop(0, pi.shape[0], swap, perm)
         P = jnp.eye(m, dtype=v.dtype)[:, perm]
         return P, L, U
-    out = call_op(_unpack, lu_t, piv)
-    return out
+
+    def _unpack(v, p):
+        f = _one
+        for _ in range(v.ndim - 2):
+            f = jax.vmap(f)
+        P, L, U = f(v, p)
+        return P, L, U
+    P, L, U = call_op(_unpack, lu_t, piv)
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
 
 
 def matrix_exp(x, name=None):
@@ -359,18 +369,11 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
     x, tau, y = (ensure_tensor(t) for t in (x, tau, y))
 
     def _ormqr(a, t, other):
-        # materialize Q from the householder reflectors, then multiply
-        # (LAPACK applies reflectors directly; on TPU a dense matmul of
-        # the same Q is the MXU-native form)
-        m = a.shape[-2]
-        k = t.shape[-1]
-        Q = jnp.eye(m, dtype=a.dtype)
-        for i in range(k):
-            v = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
-            v = v.at[i].set(1.0)
-            H = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
-            Q = Q @ H
-        Qm = Q.T if transpose else Q
+        # materialize Q from the householder reflectors (batched,
+        # LAPACK orgqr semantics), then one MXU matmul — the TPU-native
+        # form of LAPACK's reflector application
+        Q = jax.lax.linalg.householder_product(a, t)
+        Qm = jnp.swapaxes(Q, -1, -2) if transpose else Q
         return Qm @ other if left else other @ Qm
     return call_op(_ormqr, x, tau, y)
 
