@@ -1,0 +1,85 @@
+"""End-to-end user journeys across subsystems (reference pattern:
+test/legacy_test/test_imperative_* full-training smoke tests): real
+datasets -> DataLoader -> model -> optimizer -> metric, loss must
+actually fall."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_audio_classification_journey():
+    """audio.datasets.ESC50 logmel features -> Conv2D classifier."""
+    paddle.seed(0)
+    ds = paddle.audio.datasets.ESC50(mode="train", feat_type="logmel",
+                                     n_fft=256, hop_length=256)
+    feats, labels = zip(*[ds[i] for i in range(0, len(ds), 2)])
+    x = paddle.to_tensor(np.stack(feats)[:, None].astype("f4"))
+    y = paddle.to_tensor(np.asarray(labels, "i8"))
+
+    net = nn.Sequential(
+        nn.Conv2D(1, 8, 3, stride=2, padding=1), nn.ReLU(),
+        nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+        nn.Linear(8 * 16, 50))
+    opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(8):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_text_imdb_birnn_journey():
+    """text.Imdb synthetic split -> embedding -> BiRNN(GRU) -> logits,
+    exercising the round-4 sequence_length masking path."""
+    paddle.seed(1)
+    ds = paddle.text.Imdb(mode="train")
+    n = 32
+    max_len = 40
+    xs = np.zeros((n, max_len), "i8")
+    lens = np.zeros((n,), "i4")
+    ys = np.zeros((n,), "i8")
+    vocab_max = 1
+    for i in range(n):
+        doc, lab = ds[i]
+        L = min(len(doc), max_len)
+        xs[i, :L] = np.asarray(doc[:L]) % 5000
+        lens[i] = L
+        ys[i] = int(lab)
+        vocab_max = max(vocab_max, int(xs[i].max()))
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab_max + 1, 16)
+            self.rnn = nn.BiRNN(nn.GRUCell(16, 16), nn.GRUCell(16, 16))
+            self.head = nn.Linear(32, 2)
+
+        def forward(self, ids, lens):
+            h = self.emb(ids)
+            out, _ = self.rnn(h, None, lens)
+            # mean over valid steps only
+            mask = (paddle.arange(max_len).unsqueeze(0)
+                    < lens.unsqueeze(1)).astype("float32")
+            pooled = (out * mask.unsqueeze(-1)).sum(axis=1) / \
+                lens.astype("float32").unsqueeze(1)
+            return self.head(pooled)
+
+    net = Net()
+    opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    ids_t = paddle.to_tensor(xs)
+    lens_t = paddle.to_tensor(lens)
+    y_t = paddle.to_tensor(ys)
+    losses = []
+    for _ in range(10):
+        loss = loss_fn(net(ids_t, lens_t), y_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.95, losses
