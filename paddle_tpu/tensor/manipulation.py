@@ -457,3 +457,141 @@ def unfold_windows(x, axis, size, step, name=None):
         win = jnp.moveaxis(win, 1, -1)                         # (W, ..., size)
         return jnp.moveaxis(win, 0, axis)
     return call_op(_uf, x)
+
+
+def take(x, index, mode="raise", name=None):
+    """reference: paddle.take — flat-index gather with clip/wrap
+    out-of-range modes."""
+    x = ensure_tensor(x)
+    index = ensure_tensor(index)
+
+    def _take(v, i):
+        flat = v.reshape(-1)
+        i = i.astype(jnp.int32)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        else:                       # raise/clip: XLA clamps anyway
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return flat[i]
+    return call_op(_take, x, index)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """reference: paddle.select_scatter — write `values` into slice
+    `index` along `axis`."""
+    x = ensure_tensor(x)
+    values = ensure_tensor(values)
+
+    def _ss(v, val):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[index].set(val.astype(v.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+    return call_op(_ss, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """reference: paddle.slice_scatter."""
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+
+    def _ss(v, val):
+        import builtins
+        # NB: this module defines paddle.slice, shadowing the builtin
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return call_op(_ss, x, value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """reference: paddle.diagonal_scatter — write y onto a diagonal."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+
+    def _ds(v, val):
+        moved = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        m, n = moved.shape[-2], moved.shape[-1]
+        r0 = -offset if offset < 0 else 0
+        c0 = offset if offset > 0 else 0
+        k = min(m - r0, n - c0)
+        rows = jnp.arange(k) + r0
+        cols = jnp.arange(k) + c0
+        moved = moved.at[..., rows, cols].set(val.astype(v.dtype))
+        return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+    return call_op(_ds, x, y)
+
+
+def column_stack(x, name=None):
+    xs = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.column_stack(vs), *xs)
+
+
+def row_stack(x, name=None):
+    xs = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.vstack(vs), *xs)
+
+
+def _nsplit(fn):
+    def _split(x, num_or_indices, name=None):
+        x = ensure_tensor(x)
+        out = fn(x._value, num_or_indices)
+        return [Tensor(o) for o in out]
+    return _split
+
+
+hsplit = _nsplit(jnp.hsplit)
+vsplit = _nsplit(jnp.vsplit)
+dsplit = _nsplit(jnp.dsplit)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    return [Tensor(o) for o in
+            jnp.array_split(x._value, num_or_indices, axis=axis)]
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [call_op(jnp.atleast_1d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [call_op(jnp.atleast_2d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [call_op(jnp.atleast_3d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def block_diag(inputs, name=None):
+    """reference: paddle.block_diag."""
+    import jax.scipy.linalg as jsl
+    xs = [ensure_tensor(t) for t in inputs]
+    return call_op(lambda *vs: jsl.block_diag(*vs), *xs)
+
+
+def cartesian_prod(x, name=None):
+    """reference: paddle.cartesian_prod over a list of 1-D tensors."""
+    xs = [ensure_tensor(t) for t in x]
+
+    def _cp(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return call_op(_cp, *xs)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """reference: paddle.combinations — r-combinations of a 1-D tensor
+    (host-side index enumeration, device gather)."""
+    import itertools
+    import numpy as _np
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    it = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = _np.asarray(list(it), dtype="int32").reshape(-1, r)
+    return call_op(lambda v: v[jnp.asarray(idx)], x)

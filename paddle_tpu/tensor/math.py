@@ -362,3 +362,50 @@ def polygamma(x, n, name=None):
             else jnp.float32
         return _pg(n, v.astype(ft)).astype(ft)
     return call_op(_poly, x)
+
+
+def gammaln(x, name=None):
+    from jax.scipy.special import gammaln as _g
+    return call_op(lambda v: _g(v.astype(
+        v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
+        else jnp.float32)), ensure_tensor(x))
+
+
+def gammainc(x, y, name=None):
+    """reference: paddle.gammainc — regularized lower incomplete gamma
+    P(x, y)."""
+    from jax.scipy.special import gammainc as _g
+    return call_op(lambda a, b: _g(a, b), ensure_tensor(x),
+                   ensure_tensor(y))
+
+
+def gammaincc(x, y, name=None):
+    from jax.scipy.special import gammaincc as _g
+    return call_op(lambda a, b: _g(a, b), ensure_tensor(x),
+                   ensure_tensor(y))
+
+
+def multigammaln(x, p, name=None):
+    from jax.scipy.special import multigammaln as _g
+    return call_op(lambda v: _g(v, int(p)), ensure_tensor(x))
+
+
+def positive(x, name=None):
+    x = ensure_tensor(x)
+    if not jnp.issubdtype(x._value.dtype, jnp.number):
+        raise TypeError("positive: boolean tensors are not supported")
+    return call_op(lambda v: +v, x)
+
+
+def isreal(x, name=None):
+    return call_op(lambda v: jnp.isreal(v), ensure_tensor(x))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return call_op(lambda a, b: jnp.isin(a, b, invert=invert),
+                   ensure_tensor(x), ensure_tensor(test_x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return call_op(lambda v: jnp.count_nonzero(
+        v, axis=axis, keepdims=keepdim), ensure_tensor(x))

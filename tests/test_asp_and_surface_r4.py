@@ -419,3 +419,64 @@ def test_lp_pool1d_and_embedding_bag():
                                rtol=1e-6)
     np.testing.assert_allclose(eb1.numpy()[1],
                                w.numpy()[[3, 4, 5]].sum(0), rtol=1e-6)
+
+
+def test_tensor_ops_round4b():
+    """take/select_scatter/slice_scatter/diagonal_scatter/stacks/splits/
+    atleast/block_diag/cartesian_prod/combinations/gamma family/isin/
+    count_nonzero (reference: paddle tensor op surface)."""
+    import scipy.special
+    t = paddle.to_tensor
+    a = np.arange(12, dtype="f4").reshape(3, 4)
+    assert paddle.take(t(a), t(np.asarray([0, 5, -1]))).numpy().tolist() \
+        == [0.0, 5.0, 11.0]
+    assert paddle.take(t(a), t(np.asarray([13])),
+                       mode="wrap").numpy().tolist() == [1.0]
+    ss = paddle.select_scatter(t(a), t(np.full(4, -1.0, "f4")), 0, 1)
+    assert (ss.numpy()[1] == -1).all() and (ss.numpy()[0] == a[0]).all()
+    sl = paddle.slice_scatter(t(a), t(np.zeros((3, 2), "f4")),
+                              [1], [1], [3], [1])
+    assert (sl.numpy()[:, 1:3] == 0).all()
+    ds = paddle.diagonal_scatter(t(a.copy()), t(np.full(3, 9.0, "f4")))
+    assert (np.diagonal(ds.numpy()) == 9).all()
+    assert paddle.column_stack([t(np.ones(3, "f4")),
+                                t(np.zeros(3, "f4"))]).shape == [3, 2]
+    assert paddle.row_stack([t(np.ones(3, "f4")),
+                             t(np.zeros(3, "f4"))]).shape == [2, 3]
+    assert len(paddle.hsplit(t(a), 2)) == 2
+    assert len(paddle.vsplit(t(a), 3)) == 3
+    assert len(paddle.tensor_split(t(np.arange(7)), 3)) == 3
+    assert paddle.atleast_2d(t(np.asarray(3.0))).shape == [1, 1]
+    assert paddle.atleast_3d(t(np.asarray([3.0]))).shape == [1, 1, 1]
+    bd = paddle.block_diag([t(np.ones((2, 2), "f4")),
+                            t(np.ones((1, 1), "f4"))])
+    assert bd.shape == [3, 3] and bd.numpy()[0, 2] == 0
+    cp = paddle.cartesian_prod([t(np.asarray([1, 2])),
+                                t(np.asarray([3, 4, 5]))])
+    assert cp.shape == [6, 2] and cp.numpy()[0].tolist() == [1, 3]
+    cb = paddle.combinations(t(np.asarray([10, 20, 30])), 2)
+    assert cb.numpy().tolist() == [[10, 20], [10, 30], [20, 30]]
+    cbr = paddle.combinations(t(np.asarray([1, 2])), 2,
+                              with_replacement=True)
+    assert cbr.numpy().tolist() == [[1, 1], [1, 2], [2, 2]]
+    np.testing.assert_allclose(
+        paddle.gammaln(t(np.asarray([4.0]))).numpy(),
+        scipy.special.gammaln(4.0), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.gammainc(t(np.asarray([2.0])),
+                        t(np.asarray([1.5]))).numpy(),
+        scipy.special.gammainc(2.0, 1.5), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.gammaincc(t(np.asarray([2.0])),
+                         t(np.asarray([1.5]))).numpy(),
+        scipy.special.gammaincc(2.0, 1.5), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.multigammaln(t(np.asarray([5.0])), 2).numpy(),
+        scipy.special.multigammaln(5.0, 2), rtol=1e-5)
+    assert paddle.isin(t(np.asarray([1, 2, 3])),
+                       t(np.asarray([2]))).numpy().tolist() == \
+        [False, True, False]
+    assert int(paddle.count_nonzero(
+        t(np.asarray([[0, 1], [2, 0]]))).numpy()) == 2
+    assert paddle.positive(t(np.asarray([1.0]))).numpy()[0] == 1.0
+    assert paddle.isreal(t(np.asarray([1.0]))).numpy().all()
