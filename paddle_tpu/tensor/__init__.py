@@ -283,3 +283,22 @@ Tensor.scatter_ = _inplace(_manip.scatter)
 Tensor.signbit = _math.signbit
 Tensor.polygamma = _math.polygamma
 Tensor.pdist = _linalg.pdist
+
+
+# round-4b additions as Tensor methods (reference: paddle binds the
+# tensor op surface onto Tensor)
+for _nm, _f in dict(
+    take=_manip.take, select_scatter=_manip.select_scatter,
+    slice_scatter=_manip.slice_scatter,
+    diagonal_scatter=_manip.diagonal_scatter,
+    tensor_split=_manip.tensor_split,
+    atleast_1d=_manip.atleast_1d, atleast_2d=_manip.atleast_2d,
+    atleast_3d=_manip.atleast_3d,
+    gammaln=_math.gammaln, gammainc=_math.gammainc,
+    gammaincc=_math.gammaincc, multigammaln=_math.multigammaln,
+    positive=_math.positive, isreal=_math.isreal, isin=_math.isin,
+    count_nonzero=_math.count_nonzero,
+    lu_unpack=None,   # linalg-level, not a method in the reference
+).items():
+    if _f is not None and not hasattr(Tensor, _nm):
+        setattr(Tensor, _nm, _f)

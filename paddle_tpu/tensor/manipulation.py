@@ -465,13 +465,26 @@ def take(x, index, mode="raise", name=None):
     x = ensure_tensor(x)
     index = ensure_tensor(index)
 
+    n_el = int(np.prod(x.shape)) if x.shape else 1
+    if mode == "raise" and not isinstance(index._value, jax.core.Tracer):
+        import numpy as _np
+        iv = _np.asarray(index._value)
+        if iv.size and (int(iv.min()) < -n_el or int(iv.max()) >= n_el):
+            raise ValueError(
+                f"paddle.take(mode='raise'): index out of range for "
+                f"{n_el} elements (got min {int(iv.min())}, max "
+                f"{int(iv.max())})")
+
     def _take(v, i):
         flat = v.reshape(-1)
         i = i.astype(jnp.int32)
         n = flat.shape[0]
         if mode == "wrap":
             i = ((i % n) + n) % n
-        else:                       # raise/clip: XLA clamps anyway
+        elif mode == "clip":
+            # reference clip mode: negatives clamp to 0 (no wrapping)
+            i = jnp.clip(i, 0, n - 1)
+        else:                       # raise (validated above when eager)
             i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
         return flat[i]
     return call_op(_take, x, index)
@@ -516,6 +529,10 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
         r0 = -offset if offset < 0 else 0
         c0 = offset if offset > 0 else 0
         k = min(m - r0, n - c0)
+        if k <= 0:
+            raise ValueError(
+                f"diagonal_scatter: offset {offset} has no diagonal in "
+                f"a ({m}, {n}) matrix (values would be dropped)")
         rows = jnp.arange(k) + r0
         cols = jnp.arange(k) + c0
         moved = moved.at[..., rows, cols].set(val.astype(v.dtype))
@@ -536,8 +553,8 @@ def row_stack(x, name=None):
 def _nsplit(fn):
     def _split(x, num_or_indices, name=None):
         x = ensure_tensor(x)
-        out = fn(x._value, num_or_indices)
-        return [Tensor(o) for o in out]
+        out = call_op(lambda v: tuple(fn(v, num_or_indices)), x)
+        return list(out)
     return _split
 
 
@@ -548,8 +565,9 @@ dsplit = _nsplit(jnp.dsplit)
 
 def tensor_split(x, num_or_indices, axis=0, name=None):
     x = ensure_tensor(x)
-    return [Tensor(o) for o in
-            jnp.array_split(x._value, num_or_indices, axis=axis)]
+    out = call_op(lambda v: tuple(
+        jnp.array_split(v, num_or_indices, axis=axis)), x)
+    return list(out)
 
 
 def atleast_1d(*inputs, name=None):

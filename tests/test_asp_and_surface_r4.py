@@ -480,3 +480,23 @@ def test_tensor_ops_round4b():
         t(np.asarray([[0, 1], [2, 0]]))).numpy()) == 2
     assert paddle.positive(t(np.asarray([1.0]))).numpy()[0] == 1.0
     assert paddle.isreal(t(np.asarray([1.0]))).numpy().all()
+
+
+def test_tensor_ops_round4b_review_regressions():
+    """Review regressions: grads flow through split family; take clip
+    clamps negatives to 0 and raise validates eagerly;
+    diagonal_scatter rejects out-of-range offsets."""
+    t = paddle.to_tensor
+    a = np.arange(12, dtype="f4").reshape(3, 4)
+    x = t(np.ones((2, 4), "f4"), stop_gradient=False)
+    paddle.hsplit(x, 2)[0].sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy()[:, :2], 1.0)
+    np.testing.assert_allclose(x.grad.numpy()[:, 2:], 0.0)
+    assert paddle.take(t(a), t(np.asarray([-5])),
+                       mode="clip").numpy().tolist() == [0.0]
+    with pytest.raises(ValueError, match="out of range"):
+        paddle.take(t(a), t(np.asarray([999])))
+    with pytest.raises(ValueError, match="no diagonal"):
+        paddle.diagonal_scatter(t(np.ones((2, 2), "f4")),
+                                t(np.ones(1, "f4")), offset=5)
