@@ -12,7 +12,8 @@ from .framework.dtypes import (  # noqa: F401
     uint8, bool, complex64, complex128,
     set_default_dtype, get_default_dtype)
 from .framework.core import (  # noqa: F401
-    Tensor, to_tensor, set_device, get_device, is_tensor)
+    Tensor, to_tensor, set_device, get_device, is_tensor,
+    set_printoptions)
 from .framework.autograd import no_grad, enable_grad, set_grad_enabled, \
     is_grad_enabled, grad  # noqa: F401
 from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401

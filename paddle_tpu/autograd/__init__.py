@@ -19,7 +19,32 @@ from ..framework.autograd import (  # noqa: F401
 
 __all__ = ["PyLayer", "PyLayerContext", "backward", "no_grad", "enable_grad",
            "is_grad_enabled", "set_grad_enabled", "grad", "hessian",
-           "jacobian"]
+           "jacobian", "saved_tensors_hooks"]
+
+# active (pack, unpack) hook pairs (reference: paddle.autograd
+# saved_tensors_hooks over the eager saved-tensor slots).  Scope note:
+# the implicit residuals of jnp ops live inside jax.vjp closures (XLA
+# manages them); the hookable surface — as in the reference for custom
+# ops — is PyLayer's explicit save_for_backward/saved_tensor.
+_SAVED_TENSOR_HOOKS = []
+
+
+class saved_tensors_hooks:
+    """Context manager: while active, PyLayer.save_for_backward routes
+    every tensor through ``pack_hook`` and ``saved_tensor`` routes the
+    packed value back through ``unpack_hook`` (e.g. offload-to-host /
+    reload, or fp8 compression)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        _SAVED_TENSOR_HOOKS.append(self.pair)
+        return self
+
+    def __exit__(self, *exc):
+        _SAVED_TENSOR_HOOKS.remove(self.pair)
+        return False
 
 
 class PyLayerContext:
@@ -33,9 +58,17 @@ class PyLayerContext:
         self._non_differentiable = set()
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        if _SAVED_TENSOR_HOOKS:
+            pack, unpack = _SAVED_TENSOR_HOOKS[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._unpack_hook = unpack
+        else:
+            self._saved = tuple(tensors)
+            self._unpack_hook = None
 
     def saved_tensor(self):
+        if getattr(self, "_unpack_hook", None) is not None:
+            return tuple(self._unpack_hook(t) for t in self._saved)
         return self._saved
 
     def mark_not_inplace(self, *tensors):

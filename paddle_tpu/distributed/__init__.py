@@ -7,7 +7,8 @@ import os
 
 from . import env as _env
 from .env import (get_rank, get_world_size, init_parallel_env,  # noqa: F401
-                  ParallelEnv, is_initialized, parallel_device_count)
+                  ParallelEnv, is_initialized, is_available,
+                  parallel_device_count)
 from .collective import (all_reduce, all_gather, all_gather_object,  # noqa: F401
                          reduce_scatter, alltoall, alltoall_single,
                          broadcast, reduce, scatter, send, recv, barrier,
@@ -18,7 +19,9 @@ from .collective import (all_reduce, all_gather, all_gather_object,  # noqa: F40
 from .parallel import DataParallel, split  # noqa: F401
 from .mesh import (ProcessMesh, get_mesh, set_mesh, auto_mesh,  # noqa: F401
                    shard_tensor, shard_op, Shard, Replicate, Partial,
-                   reshard, dtensor_from_fn)
+                   reshard, dtensor_from_fn, shard_layer)
+from .checkpoint import (save_state_dict,  # noqa: F401
+                         load_state_dict)
 from .store import TCPStore, MasterStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401

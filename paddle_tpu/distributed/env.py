@@ -66,6 +66,12 @@ def is_initialized():
     return _STATE["initialized"]
 
 
+def is_available():
+    """reference: paddle.distributed.is_available — collectives are
+    always compiled in (XLA ships them); True unconditionally."""
+    return True
+
+
 def get_rank(group=None):
     if group is not None:
         return group.get_group_rank(jax.process_index()) \

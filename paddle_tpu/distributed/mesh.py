@@ -209,3 +209,31 @@ def shard_op(op, mesh=None, in_placements=None, out_placements=None):
                 return shard_tensor(out, mesh, out_placements)
         return out
     return wrapper
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """reference: paddle.distributed.shard_layer — convert a Layer's
+    parameters to distributed tensors on ``process_mesh``.
+
+    ``shard_fn(name, layer, process_mesh)`` shards one sublayer's params
+    in place; default replicates every parameter.  ``input_fn``/
+    ``output_fn`` wrap forward to reshard activations at the boundary.
+    """
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is not None:
+                    sublayer._parameters[pname] = shard_tensor(
+                        p, mesh, [Replicate()] * p.ndim)
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lay, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lay, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer

@@ -16,7 +16,26 @@ from . import dtypes
 from . import autograd as _ag
 
 __all__ = ["Tensor", "to_tensor", "set_device", "get_device", "is_tensor",
-           "set_default_dtype", "get_default_dtype"]
+           "set_default_dtype", "get_default_dtype", "set_printoptions"]
+
+# repr formatting knobs (reference: paddle.set_printoptions)
+_PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+               "max_line_width": 80, "sci_mode": False}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: paddle.set_printoptions — configure Tensor repr."""
+    if precision is not None:
+        _PRINT_OPTS["precision"] = int(precision)
+    if threshold is not None:
+        _PRINT_OPTS["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _PRINT_OPTS["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        _PRINT_OPTS["max_line_width"] = int(linewidth)
+    if sci_mode is not None:
+        _PRINT_OPTS["sci_mode"] = bool(sci_mode)
 
 set_default_dtype = dtypes.set_default_dtype
 get_default_dtype = dtypes.get_default_dtype
@@ -183,8 +202,15 @@ class Tensor:
         return self._value.shape[0]
 
     def __repr__(self):
+        import numpy as _np
+        opts = dict(_PRINT_OPTS)
+        sci = opts.pop("sci_mode")
+        body = _np.array2string(
+            _np.asarray(self._value),
+            formatter={"float_kind": (lambda v: f"{v:e}") if sci else None},
+            **opts)
         return (f"Tensor(shape={self.shape}, dtype={self._value.dtype}, "
-                f"stop_gradient={self.stop_gradient},\n{self._value})")
+                f"stop_gradient={self.stop_gradient},\n{body})")
 
     def __hash__(self):
         return id(self)

@@ -8,6 +8,8 @@ from .nn.functional import flash_attention  # noqa: F401
 from .ops import (segment_sum, segment_mean, segment_max,  # noqa: F401
                   segment_min, graph_send_recv, softmax_mask_fuse,
                   softmax_mask_fuse_upper_triangle, identity_loss)
+from .graph import (graph_sample_neighbors, graph_reindex,  # noqa: F401
+                    graph_khop_sampler)
 
 
 class autograd:

@@ -1,0 +1,138 @@
+"""Graph neighbor sampling (reference: python/paddle/incubate/operators/
+graph_khop_sampler.py / graph_sample_neighbors.py / graph_reindex.py over
+CUDA sampling kernels).
+
+The graph lives in CSC form: node ``n``'s in-neighbors are
+``row[colptr[n]:colptr[n+1]]``.  Sampling sizes are data-dependent, so
+these run on host numpy (eager), like the reference's CPU kernels; the
+gathered subgraph tensors then feed the jit-compiled GNN step (the
+segment-reduce ladder in incubate/ops.py).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.random import next_key
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler"]
+
+
+def _np(x):
+    return np.asarray(ensure_tensor(x)._value)
+
+
+def _rng():
+    import jax
+    bits = np.asarray(jax.random.key_data(next_key())).reshape(-1)
+    return np.random.default_rng(int(bits[-1]))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to ``sample_size`` neighbors per input node.
+
+    Returns (out_neighbors, out_count[, out_eids]).
+    """
+    rowv, cp, nodes = _np(row), _np(colptr), _np(input_nodes).reshape(-1)
+    ev = _np(eids) if eids is not None else None
+    rng = _rng()
+    neigh, counts, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        else:
+            idx = lo + rng.choice(deg, size=sample_size, replace=False)
+        neigh.append(rowv[idx])
+        counts.append(len(idx))
+        if return_eids:
+            out_eids.append(ev[idx] if ev is not None else idx)
+    cat = np.concatenate(neigh) if neigh else np.empty(0, rowv.dtype)
+    out = (Tensor(jnp.asarray(cat)),
+           Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    if return_eids:
+        ecat = np.concatenate(out_eids) if out_eids else np.empty(0)
+        return out + (Tensor(jnp.asarray(ecat)),)
+    return out
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Compact (centers, sampled neighbors) into contiguous ids.
+
+    Returns (reindex_src, reindex_dst, out_nodes): out_nodes lists the
+    centers first then first-seen neighbors; reindex_src maps each
+    neighbor, reindex_dst repeats each center per its count.
+    """
+    xs, nb, ct = _np(x).reshape(-1), _np(neighbors).reshape(-1), \
+        _np(count).reshape(-1)
+    mapping = {}
+    out_nodes = []
+    for n in xs.tolist():
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    for n in nb.tolist():
+        if n not in mapping:
+            mapping[n] = len(out_nodes)
+            out_nodes.append(n)
+    src = np.asarray([mapping[n] for n in nb.tolist()], np.int64)
+    dst = np.repeat(np.asarray([mapping[n] for n in xs.tolist()], np.int64),
+                    ct.astype(np.int64))
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling: one ``graph_sample_neighbors`` round per hop,
+    frontier = newly-seen nodes, then a global reindex.
+
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes[, edge_eids]).
+    """
+    centers_all = _np(input_nodes).reshape(-1)
+    frontier = np.unique(centers_all)
+    visited = set(frontier.tolist())
+    all_src_nodes, all_dst_nodes, all_eids = [], [], []
+    for size in list(sample_sizes):
+        if frontier.size == 0:
+            break
+        res = graph_sample_neighbors(row, colptr, frontier,
+                                     eids=sorted_eids,
+                                     sample_size=int(size),
+                                     return_eids=return_eids)
+        nb, ct = _np(res[0]), _np(res[1])
+        all_src_nodes.append(nb)
+        all_dst_nodes.append(np.repeat(frontier, ct))
+        if return_eids:
+            all_eids.append(_np(res[2]))
+        fresh = [n for n in np.unique(nb).tolist() if n not in visited]
+        visited.update(fresh)
+        frontier = np.asarray(fresh, centers_all.dtype)
+    src_nodes = np.concatenate(all_src_nodes) if all_src_nodes else \
+        np.empty(0, centers_all.dtype)
+    dst_nodes = np.concatenate(all_dst_nodes) if all_dst_nodes else \
+        np.empty(0, centers_all.dtype)
+    mapping = {}
+    sample_index = []
+    for n in np.concatenate([centers_all, src_nodes, dst_nodes]).tolist():
+        if n not in mapping:
+            mapping[n] = len(sample_index)
+            sample_index.append(n)
+    edge_src = np.asarray([mapping[n] for n in src_nodes.tolist()], np.int64)
+    edge_dst = np.asarray([mapping[n] for n in dst_nodes.tolist()], np.int64)
+    reindex_nodes = np.asarray([mapping[n] for n in centers_all.tolist()],
+                               np.int64)
+    out = (Tensor(jnp.asarray(edge_src)), Tensor(jnp.asarray(edge_dst)),
+           Tensor(jnp.asarray(np.asarray(sample_index,
+                                         centers_all.dtype))),
+           Tensor(jnp.asarray(reindex_nodes)))
+    if return_eids:
+        ecat = np.concatenate(all_eids) if all_eids else np.empty(0)
+        return out + (Tensor(jnp.asarray(ecat)),)
+    return out

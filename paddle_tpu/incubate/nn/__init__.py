@@ -263,3 +263,83 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
             x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
             dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
             training=self.training)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: incubate.nn.FusedDropoutAdd — dropout(x) + y in one
+    fused region (XLA fuses the mask multiply into the add)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedEcMoe(Layer):
+    """reference: incubate.nn.FusedEcMoe — expert-choice MoE block
+    (experts pick tokens, arXiv:2202.09368) with the two FFN GEMMs
+    batched over the expert dimension.
+
+    TPU-native: routing is one softmax + per-expert top-capacity
+    ``lax.top_k`` (static shapes, no host sync); the expert FFNs run as
+    (E, capacity, H) x (E, H, I) batched einsums — one MXU pass per
+    projection, no scatter loop.
+    """
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None,
+                 capacity_factor=1.0):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type}")
+        self.hidden_size = hidden_size
+        self.inter_size = inter_size
+        self.num_experts = num_experts
+        self.act_type = act_type
+        self.capacity_factor = capacity_factor
+        E = num_experts
+        self.bmm0_weight = self.create_parameter(
+            [E, hidden_size, inter_size], attr=weight_attr)
+        self.bmm0_bias = self.create_parameter(
+            [E, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            [E, inter_size, hidden_size], attr=weight_attr)
+        self.bmm1_bias = self.create_parameter(
+            [E, 1, hidden_size], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate_logits):
+        """x: (B, S, H); gate_logits: (B, S, E) -> (B, S, H)."""
+        from ...framework.autograd import call_op
+        E = self.num_experts
+        act = jax.nn.gelu if self.act_type == "gelu" else jax.nn.relu
+        cf = float(self.capacity_factor)
+
+        def _ecmoe(xv, gv, w0, b0, w1, b1):
+            B, S, H = xv.shape
+            T = B * S
+            cap = max(1, int(cf * T / E))
+            xt = xv.reshape(T, H)
+            probs = jax.nn.softmax(gv.reshape(T, E), axis=-1)   # (T, E)
+            # expert choice: each expert takes its top-`cap` tokens
+            sel_p, sel_i = jax.lax.top_k(probs.T, cap)          # (E, cap)
+            tok = jnp.take(xt, sel_i.reshape(-1), axis=0) \
+                .reshape(E, cap, H)
+            h = act(jnp.einsum("ech,ehi->eci", tok, w0) + b0)
+            out = jnp.einsum("eci,eih->ech", h, w1) + b1        # (E, cap, H)
+            out = out * sel_p[..., None]
+            # combine: scatter-add in the accumulation dtype (f32 —
+            # params promote), cast back to the input dtype at the end
+            flat = jnp.zeros((T, H), out.dtype)
+            flat = flat.at[sel_i.reshape(-1)].add(
+                out.reshape(-1, H))
+            return flat.reshape(B, S, H).astype(xv.dtype)
+        return call_op(_ecmoe, x, gate_logits, self.bmm0_weight,
+                       self.bmm0_bias, self.bmm1_weight, self.bmm1_bias)

@@ -496,3 +496,23 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
 
 
 __all__ += ["masked_multihead_attention"]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """reference: incubate.nn.functional.fused_matmul_bias (cublasLt
+    epilogue); XLA fuses the bias add into the GEMM."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    args = [x, y] + ([ensure_tensor(bias)] if bias is not None else [])
+
+    def _fmb(xv, yv, *b):
+        a = jnp.swapaxes(xv, -1, -2) if transpose_x else xv
+        w = jnp.swapaxes(yv, -1, -2) if transpose_y else yv
+        out = jnp.dot(a, w, preferred_element_type=jnp.float32)
+        if b:
+            out = out + b[0]
+        return out.astype(xv.dtype)
+    return call_op(_fmb, *args)
+
+
+__all__ += ["fused_matmul_bias"]

@@ -17,10 +17,10 @@ from ..framework.core import Tensor
 from ..framework.random import get_seed
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-           "ChainDataset", "Subset", "random_split", "DataLoader",
-           "BatchSampler", "Sampler", "SequenceSampler", "RandomSampler",
-           "WeightedRandomSampler", "DistributedBatchSampler",
-           "get_worker_info"]
+           "ChainDataset", "ConcatDataset", "Subset", "random_split",
+           "DataLoader", "BatchSampler", "Sampler", "SequenceSampler",
+           "RandomSampler", "SubsetRandomSampler", "WeightedRandomSampler",
+           "DistributedBatchSampler", "get_worker_info"]
 
 
 class Dataset:
@@ -89,6 +89,32 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+class ConcatDataset(Dataset):
+    """reference: paddle.io.ConcatDataset — map-style concatenation."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self.cumulative_sizes = np.cumsum(
+            [len(d) for d in self.datasets]).tolist()
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(
+                f"ConcatDataset index {idx - n if idx < 0 else idx} out of "
+                f"range for length {n}")
+        ds = int(np.searchsorted(self.cumulative_sizes, idx, side="right"))
+        prev = self.cumulative_sizes[ds - 1] if ds else 0
+        return self.datasets[ds][idx - prev]
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+
 def random_split(dataset, lengths, generator=None):
     total = len(dataset)
     if all(isinstance(l, float) for l in lengths):
@@ -137,6 +163,20 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """reference: paddle.io.SubsetRandomSampler — permute a fixed index
+    subset each epoch."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(np.random.permutation(self.indices).tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):

@@ -2,6 +2,7 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from .layer.layers import (Layer, LayerList, Sequential, ParameterList,  # noqa: F401
                            LayerDict)
 from .layer.common import *  # noqa: F401,F403
@@ -10,6 +11,8 @@ from .layer.activation import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .decode import (Decoder, BeamSearchDecoder,  # noqa: F401
+                     dynamic_decode)
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue)
 

@@ -1,0 +1,232 @@
+"""Seq2seq decoding (reference: python/paddle/nn/decode.py —
+``Decoder``, ``BeamSearchDecoder``, ``dynamic_decode``).
+
+TPU-native notes: each decode step is a batched (batch*beam) cell
+evaluation — one fused GEMM on the MXU — and beam bookkeeping is pure
+jnp gather/topk.  The step loop runs in Python (decode length is
+data-dependent and the per-step graph is cached by jit elsewhere);
+back-pointer resolution reuses the ``lax.scan`` gather_tree op.
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+BeamSearchOutput = collections.namedtuple(
+    "BeamSearchOutput", ["scores", "predicted_ids", "parent_ids"])
+BeamSearchState = collections.namedtuple(
+    "BeamSearchState", ["cell_states", "log_probs", "finished", "lengths"])
+
+
+class Decoder:
+    """Abstract decode contract: ``initialize``/``step``/``finalize``
+    (reference: paddle.nn.decode.Decoder)."""
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+def _map_structure(fn, tree):
+    if isinstance(tree, (list, tuple)):
+        out = [_map_structure(fn, t) for t in tree]
+        return type(tree)(out) if not hasattr(tree, "_fields") \
+            else type(tree)(*out)
+    return fn(tree)
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search wrapper over an RNN cell (reference:
+    paddle.nn.BeamSearchDecoder).
+
+    ``cell`` maps (inputs, states) -> (outputs, new_states); logits come
+    from ``output_fn(outputs)`` (or the outputs themselves).  Finished
+    beams are constrained to extend only with ``end_token`` at
+    unchanged score, the standard seq2seq-library masking.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam reshaping helpers (all public in the reference) ------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(B, ...) -> (B*beam, ...) by repeating each batch row."""
+        x = ensure_tensor(x)
+        return call_op(
+            lambda v: jnp.repeat(v, beam_size, axis=0), x)
+
+    def _expand_to_beam_size(self, x):
+        x = ensure_tensor(x)
+        return call_op(
+            lambda v: jnp.broadcast_to(
+                v[:, None], (v.shape[0], self.beam_size) + v.shape[1:]), x)
+
+    def _merge_batch_beams(self, x):
+        x = ensure_tensor(x)
+        return call_op(
+            lambda v: jnp.reshape(v, (-1,) + v.shape[2:]), x)
+
+    def _split_batch_beams(self, x):
+        x = ensure_tensor(x)
+        return call_op(
+            lambda v: jnp.reshape(v, (-1, self.beam_size) + v.shape[1:]), x)
+
+    # -- decode contract --------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = _map_structure(
+            lambda s: self._merge_batch_beams(self._expand_to_beam_size(s)),
+            initial_cell_states)
+
+        def _first_leaf(tree):
+            while isinstance(tree, (list, tuple)):
+                tree = tree[0]
+            return tree
+        batch = _first_leaf(states).shape[0] // self.beam_size
+        log_probs = Tensor(jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1),
+                      dtype=jnp.float32), (batch, 1)))
+        finished = Tensor(jnp.zeros((batch, self.beam_size), dtype=bool))
+        lengths = Tensor(jnp.zeros((batch, self.beam_size), dtype=jnp.int32))
+        inputs = Tensor(jnp.full((batch * self.beam_size,), self.start_token,
+                                 dtype=jnp.int32))
+        init_state = BeamSearchState(states, log_probs, finished, lengths)
+        return inputs, init_state, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_in = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        cell_out, next_cell_states = self.cell(cell_in, states.cell_states,
+                                               **kwargs)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        V = logits.shape[-1]
+        K = self.beam_size
+        end = self.end_token
+
+        def _beam_step(lg, lp, fin, ln):
+            B = lp.shape[0]
+            step_lp = lg.reshape(B, K, V)
+            step_lp = step_lp - jnp.max(step_lp, -1, keepdims=True)
+            step_lp = step_lp - jnp.log(
+                jnp.sum(jnp.exp(step_lp), -1, keepdims=True))
+            # finished beams: only end_token, at zero added score
+            end_only = jnp.where(jnp.arange(V) == end, 0.0,
+                                 -1e9).astype(step_lp.dtype)
+            step_lp = jnp.where(fin[:, :, None], end_only[None, None, :],
+                                step_lp)
+            total = lp[:, :, None] + step_lp              # (B, K, V)
+            flat = total.reshape(B, K * V)
+            top_scores, top_idx = jax.lax.top_k(flat, K)
+            beam_idx = (top_idx // V).astype(jnp.int32)
+            token = (top_idx % V).astype(jnp.int32)
+            prev_fin = jnp.take_along_axis(fin, beam_idx, axis=1)
+            prev_len = jnp.take_along_axis(ln, beam_idx, axis=1)
+            new_fin = prev_fin | (token == end)
+            new_len = prev_len + (~prev_fin).astype(jnp.int32)
+            return top_scores, token, beam_idx, new_fin, new_len
+
+        out = call_op(_beam_step, ensure_tensor(logits), states.log_probs,
+                      states.finished, states.lengths)
+        scores, token, beam_idx, new_fin, new_len = out
+
+        # reindex cell states by parent beam on the merged batch*beam dim
+        def _gather_state(s):
+            s = ensure_tensor(s)
+
+            def _g(v, bi):
+                B = bi.shape[0]
+                vv = v.reshape((B, K) + v.shape[1:])
+                idx = bi.reshape(bi.shape + (1,) * (vv.ndim - 2))
+                vv = jnp.take_along_axis(
+                    vv, jnp.broadcast_to(idx, bi.shape + vv.shape[2:]),
+                    axis=1)
+                return vv.reshape((-1,) + vv.shape[2:])
+            return call_op(_g, s, beam_idx)
+
+        next_cell_states = _map_structure(_gather_state, next_cell_states)
+        beam_output = BeamSearchOutput(scores, token, beam_idx)
+        next_state = BeamSearchState(next_cell_states, scores, new_fin,
+                                     new_len)
+        next_inputs = self._merge_batch_beams(token)
+        return beam_output, next_state, next_inputs, next_state.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from .functional.common import gather_tree
+        predicted_ids = gather_tree(outputs.predicted_ids,
+                                    outputs.parent_ids)
+        return predicted_ids, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference: paddle.nn.dynamic_decode — run ``decoder`` until every
+    sequence finishes or ``max_step_num``; stack per-step outputs and
+    ``finalize``.
+
+    ``impute_finished`` is accepted for API parity but is a no-op here:
+    BeamSearchDecoder already freezes finished beams (end-token-only
+    extension at unchanged score), which is what imputation protects.
+    """
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    time = 0
+    limit = max_step_num if max_step_num is not None else float("inf")
+
+    def _all_done(f):
+        return bool(np.all(np.asarray(ensure_tensor(f)._value)))
+
+    while time < limit and not _all_done(finished):
+        outs, states, inputs, finished = decoder.step(time, inputs, states,
+                                                      **kwargs)
+        step_outputs.append(outs)
+        time += 1
+    if not step_outputs:
+        raise ValueError(
+            "dynamic_decode ran zero steps (all sequences were finished "
+            "at initialization, or max_step_num=0) — nothing to decode")
+
+    def _stack(field_vals):
+        ts = [ensure_tensor(v) for v in field_vals]
+        return call_op(lambda *vs: jnp.stack(vs, 0), *ts)
+
+    first = step_outputs[0]
+    if hasattr(first, "_fields"):
+        stacked = type(first)(*[
+            _stack([getattr(o, f) for o in step_outputs])
+            for f in first._fields])
+    else:
+        stacked = _stack(step_outputs)
+
+    seq_len = states.lengths if hasattr(states, "lengths") else None
+    final_outputs, final_states = decoder.finalize(stacked, states, seq_len)
+
+    if not output_time_major:
+        final_outputs = _map_structure(
+            lambda t: call_op(
+                lambda v: jnp.moveaxis(v, 0, 1), ensure_tensor(t)),
+            final_outputs)
+    if return_length:
+        return final_outputs, final_states, seq_len
+    return final_outputs, final_states

@@ -19,7 +19,7 @@ from ...framework.core import Tensor
 from ...framework.autograd import call_op
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "flash_attn_unpadded", "sdp_kernel"]
+           "flash_attn_unpadded", "sdp_kernel", "sparse_attention"]
 
 # Pallas kernel pays off past this seq length on TPU (short seqs fit XLA's
 # fused softmax just fine and avoid kernel-launch overhead)
@@ -177,3 +177,60 @@ class sdp_kernel:
 
     def __exit__(self, *exc):
         return False
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """reference: paddle.nn.functional.sparse_attention — attention
+    restricted to a per-(batch, head) CSR sparsity pattern.
+
+    q/k/v: (B, H, T, D); offset: (B, H, T+1) int; columns: (B, H, nnz).
+    TPU-native lowering: the CSR pattern becomes a dense (T, T) boolean
+    mask built with one scatter (nnz is static under jit; row ids come
+    from searchsorted over the offsets), then the masked softmax rides
+    the regular fused attention path — on TPU the MXU prefers the dense
+    masked form over gather/scatter per row unless sparsity is extreme.
+    """
+    from ...tensor._helpers import ensure_tensor
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    off = ensure_tensor(sparse_csr_offset).detach()
+    cols = ensure_tensor(sparse_csr_columns).detach()
+    ts = [q, k, v, off, cols]
+    if key_padding_mask is not None:
+        ts.append(ensure_tensor(key_padding_mask).detach())
+    if attn_mask is not None:
+        ts.append(ensure_tensor(attn_mask).detach())
+
+    def _sa(qv, kv, vv, offv, colv, *masks):
+        B, H, T, D = qv.shape
+        nnz = colv.shape[-1]
+        # row index of every nnz entry, per (B, H)
+        ar = jnp.arange(nnz)
+
+        def rows_of(o):            # o: (T+1,)
+            return jnp.searchsorted(o, ar, side="right") - 1
+        rows = jax.vmap(jax.vmap(rows_of))(offv)          # (B, H, nnz)
+        mask = jnp.zeros((B, H, T, T), bool)
+        bidx = jnp.arange(B)[:, None, None]
+        hidx = jnp.arange(H)[None, :, None]
+        mask = mask.at[bidx, hidx, rows, colv].set(True)
+        scale = 1.0 / math.sqrt(D)
+        scores = jnp.einsum("bhtd,bhsd->bhts", qv, kv) * scale
+        neg = jnp.asarray(-1e9, scores.dtype)
+        scores = jnp.where(mask, scores, neg)
+        mi = 0
+        if key_padding_mask is not None:
+            kpm = masks[mi]
+            mi += 1
+            scores = jnp.where(kpm[:, None, None, :] != 0, scores, neg)
+        if attn_mask is not None:
+            scores = scores + masks[mi].astype(scores.dtype)
+        probs = jax.nn.softmax(scores, axis=-1)
+        # rows with no live key (possible via padding) emit zeros
+        live = jnp.any(scores > neg / 2, axis=-1, keepdims=True)
+        probs = jnp.where(live, probs, 0.0)
+        return jnp.einsum("bhts,bhsd->bhtd", probs, vv)
+    return call_op(_sa, *ts)

@@ -550,3 +550,48 @@ def embedding_bag(input, weight, offsets=None, mode="mean",
             return jax.ops.segment_max(rows, seg, num_segments=nb)
         raise ValueError(f"unknown mode {mode!r}")
     return call_op(_eb1, args[0], args[1], off, *args[2:])
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """reference: paddle.nn.functional.class_center_sample — sample
+    ``num_samples`` class centers always containing every positive class
+    in ``label``; returns (remapped_label, sampled_class_center).
+
+    Data-dependent output size -> eager/host computation (documented
+    divergence: inside jit use a static num_samples path via
+    segment ops instead).  With a distributed ``group``, positives are
+    unioned across ranks through the collective allgather.
+    """
+    lab = np.asarray(ensure_tensor(label)._value).reshape(-1)
+    if group is not None:
+        from ...distributed.collective import all_gather_object
+        gathered = []
+        all_gather_object(gathered, lab.tolist(), group=group)
+        pos = np.unique(np.concatenate(
+            [np.asarray(g, lab.dtype) for g in gathered]))
+    else:
+        pos = np.unique(lab)
+    C, S = int(num_classes), int(num_samples)
+    if pos.size >= S:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(C, dtype=lab.dtype), pos,
+                            assume_unique=True)
+        if group is not None:
+            # every rank must sample the SAME negatives: derive the seed
+            # from the (already allgather-unioned) positives + the global
+            # seed, which is rank-invariant — not from the per-rank key
+            # stream, whose position can differ across ranks
+            from ...framework.random import get_seed
+            seed = (get_seed() * 1000003
+                    + hash(tuple(int(p) for p in pos))) & 0x7FFFFFFF
+            rng = np.random.default_rng(seed)
+        else:
+            key_bits = np.asarray(jax.random.key_data(next_key()))
+            rng = np.random.default_rng(int(key_bits.reshape(-1)[-1]))
+        extra = rng.choice(rest, size=S - pos.size, replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.searchsorted(sampled, lab)
+    return (Tensor(jnp.asarray(remap.astype(np.int64))),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))

@@ -671,3 +671,69 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
             return loss, jax.nn.softmax(z, -1)
         return loss
     return call_op(_mce, logits, label)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: paddle.nn.functional.hsigmoid_loss — hierarchical
+    sigmoid over a class tree.
+
+    Default tree: the complete binary heap with ``num_classes`` leaves
+    (leaf of class c at heap slot c + C - 1, internal nodes 0..C-2);
+    loss(x) = sum over root->leaf path of BCE-with-logits of
+    (w_node . x + b_node) against the branch bit.  Custom trees come in
+    as ``path_table`` (internal-node ids, -1 padded) + ``path_code``
+    (branch bits).  TPU-native: the padded path makes a static-shape
+    (N, D) gather + one (N, D, F)x(F,) batched dot — no per-sample
+    control flow.  Returns (N, 1) like the reference.
+    """
+    import math as _math
+    input = ensure_tensor(input)
+    label = ensure_tensor(label).detach()
+    C = int(num_classes)
+    weight = ensure_tensor(weight)
+    ts = [input, label, weight]
+    if bias is not None:
+        ts.append(ensure_tensor(bias))
+    custom = path_table is not None
+    if custom:
+        ts.append(ensure_tensor(path_table).detach())
+        ts.append(ensure_tensor(path_code).detach())
+
+    D = max(1, int(_math.ceil(_math.log2(max(C, 2)))))
+
+    def _hs(x, lab, w, *rest):
+        lab = lab.reshape(-1)          # accept (N,) or (N, 1) labels
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        if custom:
+            table, code = rest[0].astype(jnp.int32), rest[1]
+            mask = (table >= 0)
+            nodes = jnp.where(mask, table, 0)
+            bits = code.astype(x.dtype)
+        else:
+            # walk the heap from leaf to root, padded to depth D
+            node = lab.astype(jnp.int32) + C - 1      # leaf heap slot
+            nodes_l, bits_l, mask_l = [], [], []
+            for _ in range(D):
+                parent = (node - 1) // 2
+                bit = (node == 2 * parent + 2)
+                valid = node > 0
+                nodes_l.append(jnp.where(valid, parent, 0))
+                bits_l.append(bit & valid)
+                mask_l.append(valid)
+                node = jnp.where(valid, parent, node)
+            nodes = jnp.stack(nodes_l, -1)            # (N, D)
+            bits = jnp.stack(bits_l, -1).astype(x.dtype)
+            mask = jnp.stack(mask_l, -1)
+        wn = w[nodes]                                  # (N, D, F)
+        score = jnp.einsum("ndf,nf->nd", wn, x)
+        if b is not None:
+            score = score + b.reshape(-1)[nodes]
+        # BCE with logits against the branch bit
+        per = jnp.maximum(score, 0) - score * bits + \
+            jnp.log1p(jnp.exp(-jnp.abs(score)))
+        per = jnp.where(mask, per, 0.0)
+        return jnp.sum(per, -1, keepdims=True)
+    return call_op(_hs, *ts)

@@ -10,7 +10,7 @@ from ...framework import dtypes
 
 __all__ = ["Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierNormal", "XavierUniform", "KaimingNormal",
-           "KaimingUniform", "Assign", "Dirac", "Orthogonal",
+           "KaimingUniform", "Assign", "Bilinear", "Dirac", "Orthogonal",
            "calculate_gain", "set_global_initializer"]
 
 
@@ -154,6 +154,29 @@ class Dirac(Initializer):
         mid = tuple(s // 2 for s in shape[2:])
         for i in range(min(oc, ic * self.groups)):
             arr[(i, i % ic) + mid] = 1.0
+        return jnp.asarray(arr, dtype=dtype)
+
+
+class Bilinear(Initializer):
+    """reference: paddle.nn.initializer.Bilinear — bilinear-interpolation
+    kernel for transposed-conv upsampling layers."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Bilinear initializer needs a conv kernel "
+                             "shape (C_out, C_in, *spatial)")
+        arr = np.zeros(shape, dtype=np.float32)
+        spatial = shape[2:]
+        grids = []
+        for k in spatial:
+            f = int(np.ceil(k / 2.0))
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            grids.append(1 - np.abs(np.arange(k) / f - c))
+        filt = grids[0]
+        for g in grids[1:]:
+            filt = np.multiply.outer(filt, g)
+        for i in range(min(shape[0], shape[1])):
+            arr[i, i, ...] = filt
         return jnp.asarray(arr, dtype=dtype)
 
 

@@ -339,6 +339,16 @@ class PixelShuffle(Layer):
         return F.pixel_shuffle(x, self._r, self._df)
 
 
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r = downscale_factor
+        self._df = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._r, self._df)
+
+
 class Pad1D(Layer):
     def __init__(self, padding, mode="constant", value=0.0,
                  data_format="NCL", name=None):

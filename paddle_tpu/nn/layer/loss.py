@@ -257,3 +257,39 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
             input, label, self.head_weight,
             [list(p) for p in self.tail_weights], self.cutoffs,
             head_bias=self.head_bias)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(p=p, margin=margin, weight=weight,
+                        reduction=reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, **self._kw)
+
+
+class HSigmoidLoss(Layer):
+    """reference: paddle.nn.HSigmoidLoss — hierarchical sigmoid head
+    owning the (num_classes-1, feature_size) internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_classes - 1, 1), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and path_table is None:
+            raise ValueError("is_custom=True requires path_table/path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)

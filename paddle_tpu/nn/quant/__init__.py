@@ -1,0 +1,123 @@
+"""paddle.nn.quant (reference: python/paddle/nn/quant — quant layer
+variants, weight-only quantization helpers, llm.int8 linear).
+
+TPU-native layout decision: quantized weights keep the framework's
+(in_features, out_features) = (K, N) Linear layout with a per-output
+-channel fp32 scale (N,), mapping 1:1 onto the Pallas int8 epilogue
+kernel (ops/pallas/quant_matmul.py) — no arch-specific repacking like
+the reference's cutlass layouts.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.autograd import call_op
+from ...tensor._helpers import ensure_tensor
+from ..layer.layers import Layer
+from ...quantization import (  # noqa: F401 (re-export, reference parity)
+    QuantedLinear, QuantedConv2D, FakeQuanterWithAbsMaxObserver,
+    FakeQuanterChannelWiseAbsMaxObserver, quant_linear)
+
+__all__ = ["Stub", "weight_quantize", "weight_dequantize",
+           "weight_only_linear", "llm_int8_linear", "QuantedLinear",
+           "QuantedConv2D", "quant_linear"]
+
+_I8_BND = 127.0
+
+
+class Stub(Layer):
+    """reference: paddle.nn.quant.Stub — placeholder the QAT pass swaps
+    for a quanter; identity until converted."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+def weight_quantize(x, algo="weight_only_int8", group_size=-1):
+    """(K, N) float weight -> ((K, N) int8 tensor, (N,) fp32 scale).
+
+    ``algo``: weight_only_int8 | llm.int8 (same numeric layout here).
+    """
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo}")
+    w = ensure_tensor(x)
+
+    def _q(v):
+        # reference scale convention: scale = absmax / 127, dequant =
+        # q * scale — (q, scale) pairs interoperate with externally
+        # quantized weights
+        scale = jnp.max(jnp.abs(v.astype(jnp.float32)), axis=0) / _I8_BND
+        scale = jnp.maximum(scale, 1e-10)
+        q = jnp.clip(jnp.round(v.astype(jnp.float32) / scale),
+                     -128, 127).astype(jnp.int8)
+        return q, scale
+    out = call_op(_q, w.detach())
+    return out[0], out[1]
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float32"):
+    w, s = ensure_tensor(x), ensure_tensor(scale)
+    return call_op(
+        lambda q, sc: (q.astype(jnp.float32) * sc).astype(out_dtype),
+        w, s)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1, name=None):
+    """reference: paddle.nn.quant.weight_only_linear — weight stays int8
+    in HBM (the serving memory-bandwidth win); dequant happens in the
+    matmul epilogue which XLA fuses, activations stay in their float
+    dtype (no activation quantization)."""
+    if weight_dtype != "int8":
+        raise NotImplementedError("weight_only_linear: int8 only")
+    x = ensure_tensor(x)
+    w, s = ensure_tensor(weight), ensure_tensor(weight_scale)
+    ts = [x, w.detach(), s.detach()]
+    if bias is not None:
+        ts.append(ensure_tensor(bias))
+
+    def _wol(a, q, sc, *b):
+        acc = jnp.matmul(a, q.astype(a.dtype))
+        out = acc * sc.astype(a.dtype)
+        return out + b[0] if b else out
+    return call_op(_wol, *ts)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """reference: paddle.nn.quant.llm_int8_linear — LLM.int8 outlier
+    decomposition: activation columns whose absmax exceeds ``threshold``
+    run in float against dequantized weight rows; the rest runs int8x
+    int8.  Static shapes (outliers are where-masked, not gathered) so
+    the whole thing jits."""
+    x = ensure_tensor(x)
+    w, s = ensure_tensor(weight), ensure_tensor(weight_scale)
+    ts = [x, w.detach(), s.detach()]
+    if bias is not None:
+        ts.append(ensure_tensor(bias))
+
+    def _l8(a, q, sc, *b):
+        af = a.astype(jnp.float32)
+        lead = af.shape[:-1]
+        a2 = af.reshape(-1, af.shape[-1])
+        col_out = jnp.max(jnp.abs(a2), axis=0) > threshold      # (K,)
+        # float path: outlier columns only
+        wf = q.astype(jnp.float32) * sc
+        fp_part = jnp.matmul(jnp.where(col_out[None, :], a2, 0.0), wf)
+        # int8 path: remaining columns, per-tensor activation scale
+        a_in = jnp.where(col_out[None, :], 0.0, a2)
+        act_scale = jnp.maximum(jnp.max(jnp.abs(a_in)), 1e-8)
+        aq = jnp.clip(jnp.round(a_in / act_scale * _I8_BND),
+                      -128, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(aq, q, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        int_part = acc.astype(jnp.float32) * (act_scale / _I8_BND) * sc
+        out = (fp_part + int_part).reshape(*lead, q.shape[1])
+        out = out.astype(a.dtype)
+        return out + b[0] if b else out
+    return call_op(_l8, *ts)

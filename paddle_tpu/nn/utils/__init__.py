@@ -15,7 +15,7 @@ from ...framework.autograd import call_op
 from ..layer.layers import Layer
 
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
-           "clip_grad_norm_", "parameters_to_vector",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
            "vector_to_parameters"]
 
 
@@ -177,3 +177,14 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     layer._spectral_norm_state = {"name": name, "helper": helper}
     hook(layer, ())
     return layer
+
+
+def clip_grad_value_(parameters, clip_value):
+    """reference: paddle.nn.utils.clip_grad_value_ — clamp every grad
+    element into [-clip_value, clip_value] in place."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    cv = float(clip_value)
+    for p in params:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -cv, cv)

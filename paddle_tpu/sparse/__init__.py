@@ -23,7 +23,7 @@ from ..tensor._helpers import ensure_tensor
 
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
-    "sparse_csr_tensor", "is_same_shape",
+    "sparse_csr_tensor", "is_same_shape", "mask_as",
     "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
     "mv", "addmm", "transpose", "reshape", "sum", "coalesce", "to_dense",
     "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
@@ -553,3 +553,21 @@ def softmax(x, axis=-1, name=None):
 
 
 from . import nn  # noqa: E402,F401
+
+
+def mask_as(x, mask, name=None):
+    """reference: paddle.sparse.mask_as — take dense ``x``'s values at
+    ``mask``'s sparsity pattern, producing a sparse tensor with the same
+    layout as ``mask``."""
+    xv = ensure_tensor(x)
+    if isinstance(mask, SparseCooTensor):
+        iv = mask._indices
+        vals = call_op(lambda v: v[tuple(iv)], xv)
+        return SparseCooTensor(iv, vals, tuple(mask.shape))
+    if isinstance(mask, SparseCsrTensor):
+        crows, cols = mask._crows, mask._cols
+        nnz = cols.shape[0]
+        rows = jnp.searchsorted(crows, jnp.arange(nnz), side="right") - 1
+        vals = call_op(lambda v: v[rows, cols], xv)
+        return SparseCsrTensor(crows, cols, vals, tuple(mask.shape))
+    raise TypeError("mask_as expects a SparseCoo/CsrTensor mask")

@@ -367,6 +367,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 # imported last: static.nn pulls in jit.dy2static, which imports back into
 # this (by then fully-populated) module for InputSpec
 from . import nn  # noqa: E402
+from . import amp  # noqa: E402
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
@@ -568,3 +569,132 @@ class WeightNormParamAttr:
 __all__ += ["gradients", "append_backward", "py_func", "create_parameter",
             "ExponentialMovingAverage", "device_guard",
             "WeightNormParamAttr"]
+
+
+# -- Variable / global vars / program state (reference: paddle.static) ------
+
+# In the reference a static ``Variable`` is the graph symbol distinct from
+# an eager Tensor; our tape records real Tensors, so the symbol type IS the
+# Tensor facade (reference: python/paddle/base/framework.py Variable).
+from ..framework.core import Tensor as Variable  # noqa: E402,F401
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: paddle.static.create_global_var."""
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    from ..framework import dtypes as _dt
+    d = _dt.convert_dtype(dtype)
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value, d), name=name)
+    t.persistable = persistable
+    t.stop_gradient = True
+    global_scope().vars[name or f"global_var_{id(t)}"] = t
+    return t
+
+
+def _program_parameters(program):
+    """Named parameter/persistable leaves of a program's op tape."""
+    out = {}
+    for t in program._leaf_inputs():
+        if getattr(t, "is_parameter", False) or \
+                getattr(t, "persistable", False):
+            nm = getattr(t, "name", None) or f"param_{len(out)}"
+            out[nm] = t
+    return out
+
+
+def set_program_state(program, state_dict):
+    """reference: paddle.static.set_program_state — assign numpy state
+    into a program's parameters by name."""
+    import jax.numpy as jnp
+    params = _program_parameters(program)
+    for nm, val in state_dict.items():
+        if nm in params:
+            params[nm]._value = jnp.asarray(val)
+
+
+def save(program, path_prefix, protocol=4):
+    """reference: paddle.static.save — writes ``.pdparams`` (named
+    parameter state).  Optimizer state lives with the optimizer object in
+    this framework (documented envelope)."""
+    import pickle
+    import numpy as np
+    state = {nm: np.asarray(t._value)
+             for nm, t in _program_parameters(program).items()}
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    """reference: paddle.static.load — restore ``.pdparams`` into the
+    program's parameters."""
+    import pickle
+    with open(path_prefix + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference: paddle.static.accuracy — top-k accuracy tensor."""
+    import jax.numpy as jnp
+    from ..framework.autograd import call_op
+    from ..tensor._helpers import ensure_tensor
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _acc(p, l):
+        kk = min(int(k), p.shape[-1])
+        top = jnp.argsort(-p, axis=-1)[..., :kk]
+        hit = jnp.any(top == l.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return call_op(_acc, input.detach(), label.detach())
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """reference: paddle.static.auc — returns (auc_out, batch_auc_out,
+    states).  Computed exactly (ROC: Mann-Whitney with mid-ranks for
+    ties; PR: trapezoid over the precision-recall curve) instead of the
+    reference's thresholded histogram approximation."""
+    import jax.numpy as jnp
+    from ..framework.autograd import call_op
+    from ..tensor._helpers import ensure_tensor
+    if curve not in ("ROC", "PR"):
+        raise ValueError(f"auc: unknown curve {curve!r}")
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _roc(p, l):
+        score = (p[..., 1] if p.ndim == 2 else p).reshape(-1)
+        lab = l.reshape(-1).astype(jnp.float32)
+        srt = jnp.sort(score)
+        # mid-rank: average of 1-based left/right insertion positions
+        ranks = (jnp.searchsorted(srt, score, side="left")
+                 + jnp.searchsorted(srt, score, side="right")
+                 + 1).astype(jnp.float32) / 2.0
+        npos = jnp.sum(lab)
+        nneg = lab.size - npos
+        pos_rank_sum = jnp.sum(jnp.where(lab > 0, ranks, 0.0))
+        denom = jnp.maximum(npos * nneg, 1.0)
+        return (pos_rank_sum - npos * (npos + 1) / 2.0) / denom
+
+    def _pr(p, l):
+        score = (p[..., 1] if p.ndim == 2 else p).reshape(-1)
+        lab = l.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(-score)
+        lab_sorted = lab[order]
+        tp = jnp.cumsum(lab_sorted)
+        fp = jnp.cumsum(1.0 - lab_sorted)
+        npos = jnp.maximum(jnp.sum(lab), 1.0)
+        precision = tp / jnp.maximum(tp + fp, 1.0)
+        recall = tp / npos
+        prec = jnp.concatenate([jnp.ones((1,)), precision])
+        rec = jnp.concatenate([jnp.zeros((1,)), recall])
+        return jnp.sum((rec[1:] - rec[:-1]) * (prec[1:] + prec[:-1]) / 2.0)
+
+    out = call_op(_roc if curve == "ROC" else _pr,
+                  input.detach(), label.detach())
+    return out, out, []
+
+
+__all__ += ["Variable", "create_global_var", "set_program_state", "save",
+            "load", "accuracy", "auc"]

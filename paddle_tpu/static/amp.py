@@ -1,0 +1,45 @@
+"""paddle.static.amp (reference: python/paddle/static/amp — static-graph
+mixed precision: decorate() program rewrite, CustomOpLists, fp16_guard).
+
+TPU-native: static programs here replay through the same eager op layer
+that dygraph AMP hooks (amp/__init__.py's per-op autocast), so the
+"program rewrite" IS the dygraph policy — decorate() returns the same
+decorated optimizer, and the op lists configure the shared policy.
+"""
+from ..amp import (auto_cast, decorate, GradScaler,  # noqa: F401
+                   amp_guard)
+
+__all__ = ["decorate", "auto_cast", "GradScaler", "CustomOpLists",
+           "fp16_guard", "bf16"]
+
+
+class CustomOpLists:
+    """reference: paddle.static.amp.CustomOpLists / AutoMixedPrecisionLists
+    — custom white/black op-name lists fed to auto_cast."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+def fp16_guard(func=None):
+    """reference: paddle.static.amp.fp16_guard — region marker; under the
+    shared policy this is auto_cast(enable=True)."""
+    if callable(func):
+        def wrapped(*a, **kw):
+            with auto_cast(True):
+                return func(*a, **kw)
+        return wrapped
+    return auto_cast(True)
+
+
+class bf16:
+    """reference: paddle.static.amp.bf16 namespace (amp_utils/amp_lists);
+    bf16 is the native TPU compute dtype, so the guard simply enables
+    autocast at O1 with dtype bfloat16."""
+
+    @staticmethod
+    def amp_guard(enable=True):
+        return auto_cast(enable, dtype="bfloat16")

@@ -88,7 +88,13 @@ _METHODS = dict(
     atan2=_math.atan2, diff=_math.diff, nan_to_num=_math.nan_to_num,
     deg2rad=_math.deg2rad, rad2deg=_math.rad2deg, conj=_math.conj,
     real=_math.real, imag=_math.imag, angle=_math.angle, logit=_math.logit,
-    lgamma=_math.lgamma, digamma=_math.digamma,
+    lgamma=_math.lgamma, digamma=_math.digamma, fmod=_math.fmod,
+    i0e=_math.i0e, i1e=_math.i1e, sinc=_math.sinc,
+    isposinf=_math.isposinf, isneginf=_math.isneginf,
+    vecdot=_math.vecdot, negative=_math.neg,
+    is_complex=_logic.is_complex,
+    is_floating_point=_logic.is_floating_point,
+    is_integer=_logic.is_integer,
     # manipulation
     reshape=_manip.reshape, reshape_=_manip.reshape_,
     flatten=_manip.flatten, transpose=_manip.transpose,
@@ -106,6 +112,7 @@ _METHODS = dict(
     chunk=_manip.chunk, unbind=None, unstack=_manip.unstack,
     repeat_interleave=_manip.repeat_interleave, rot90=_manip.rot90,
     fill_diagonal=_manip.fill_diagonal, view=_manip.view,
+    unflatten=_manip.unflatten, strided_slice=_manip.strided_slice,
     view_as=_manip.view_as, tril=_creation.tril, triu=_creation.triu,
     diag=_creation.diag, diag_embed=_creation.diag_embed,
     # logic
@@ -302,3 +309,66 @@ for _nm, _f in dict(
 ).items():
     if _f is not None and not hasattr(Tensor, _nm):
         setattr(Tensor, _nm, _f)
+
+
+# round-4b: complete the in-place family + method aliases surfaced by the
+# upstream Tensor-method audit
+Tensor.divide_ = _inplace(_math.divide)
+Tensor.remainder_ = _inplace(_math.mod)
+Tensor.mod_ = _inplace(_math.mod)
+Tensor.pow_ = _inplace(_math.pow)
+Tensor.abs_ = _inplace(_math.abs)
+Tensor.neg_ = _inplace(_math.neg)
+Tensor.tanh_ = _inplace(_math.tanh)
+Tensor.sigmoid_ = _inplace(_math.sigmoid)
+Tensor.erfinv_ = _inplace(_math.erfinv)
+Tensor.lerp_ = _inplace(_math.lerp)
+Tensor.flatten_ = _inplace(_manip.flatten)
+Tensor.squeeze_ = _inplace(_manip.squeeze)
+Tensor.masked_fill_ = _inplace(_manip.masked_fill)
+Tensor.put_along_axis_ = _inplace(_manip.put_along_axis)
+Tensor.index_add_ = _inplace(_manip.index_add)
+Tensor.index_put_ = _inplace(_manip.index_put)
+
+
+def _copy_(self, other, blocking=True):
+    """reference: Tensor.copy_ — copy value (and nothing else) from
+    ``other`` into this tensor."""
+    src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+    self._value = jnp.asarray(src, dtype=self._value.dtype)
+    self._node = None
+    return self
+
+
+def _bernoulli_(self, p=0.5, name=None):
+    from ..framework.random import next_key
+    import jax
+    self._value = jax.random.bernoulli(
+        next_key(), p, tuple(self.shape)).astype(self._value.dtype)
+    self._node = None
+    return self
+
+
+Tensor.copy_ = _copy_
+Tensor.bernoulli_ = _bernoulli_
+Tensor.ndimension = lambda self: self._value.ndim
+Tensor.rank = lambda self: _manip.rank(self)
+Tensor.t = _manip.t
+
+for _nm, _f in dict(
+    frac=_math.frac, gcd=_math.gcd, lcm=_math.lcm,
+    nansum=_math.nansum, nanmean=_math.nanmean,
+    nanmedian=_stat.nanmedian, nanquantile=_stat.nanquantile,
+    histogram=_linalg.histogram, bincount=_linalg.bincount,
+    cov=_linalg.cov, corrcoef=_linalg.corrcoef,
+).items():
+    if not hasattr(Tensor, _nm):
+        setattr(Tensor, _nm, _f)
+
+
+def _multinomial_method(self, num_samples=1, replacement=False, name=None):
+    from .random import multinomial as _mn
+    return _mn(self, num_samples=num_samples, replacement=replacement)
+
+
+Tensor.multinomial = _multinomial_method

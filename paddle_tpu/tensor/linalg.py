@@ -7,7 +7,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from ._helpers import ensure_tensor
-from .math import matmul, mm, bmm, dot  # noqa: F401 (re-export)
+from .math import matmul, mm, bmm, dot, vecdot  # noqa: F401 (re-export)
 
 
 def mv(x, vec, name=None):

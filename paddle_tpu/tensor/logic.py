@@ -53,3 +53,15 @@ def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
 
 def is_empty(x, name=None):
     return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_complex(x, name=None):
+    return jnp.issubdtype(ensure_tensor(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x, name=None):
+    return jnp.issubdtype(ensure_tensor(x).dtype, jnp.floating)
+
+
+def is_integer(x, name=None):
+    return jnp.issubdtype(ensure_tensor(x).dtype, jnp.integer)

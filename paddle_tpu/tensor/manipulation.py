@@ -550,6 +550,50 @@ def row_stack(x, name=None):
     return call_op(lambda *vs: jnp.vstack(vs), *xs)
 
 
+def hstack(x, name=None):
+    xs = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.hstack(vs), *xs)
+
+
+vstack = row_stack
+
+
+def dstack(x, name=None):
+    xs = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.dstack(vs), *xs)
+
+
+def unflatten(x, axis, shape, name=None):
+    """reference: paddle.unflatten — expand ``axis`` into ``shape``
+    (one entry may be -1)."""
+    x = ensure_tensor(x)
+    shape = tuple(int(s) for s in shape)
+
+    def _uf(v):
+        ax = axis % v.ndim
+        return jnp.reshape(v, v.shape[:ax] + shape + v.shape[ax + 1:])
+    return call_op(_uf, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    """reference: paddle.strided_slice — python-slice semantics per axis,
+    negative strides included."""
+    x = ensure_tensor(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s) for s in starts]
+    ends = [int(e) for e in ends]
+    strides = [int(s) for s in strides]
+
+    import builtins
+
+    def _ss(v):
+        sl = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            sl[a % v.ndim] = builtins.slice(s, e, st)
+        return v[tuple(sl)]
+    return call_op(_ss, x)
+
+
 def _nsplit(fn):
     def _split(x, num_or_indices, name=None):
         x = ensure_tensor(x)
@@ -613,3 +657,22 @@ def combinations(x, r=2, with_replacement=False, name=None):
         if with_replacement else itertools.combinations(range(n), r)
     idx = _np.asarray(list(it), dtype="int32").reshape(-1, r)
     return call_op(lambda v: v[jnp.asarray(idx)], x)
+
+
+def shape(input, name=None):
+    """reference: paddle.shape — the shape as a 1-D int32 tensor (the
+    static-graph shape op; python list via Tensor.shape)."""
+    from ..framework.core import Tensor
+    v = ensure_tensor(input)._value
+    return Tensor(jnp.asarray(v.shape, dtype=jnp.int32))
+
+
+def rank(input, name=None):
+    from ..framework.core import Tensor
+    return Tensor(jnp.asarray(ensure_tensor(input)._value.ndim,
+                              dtype=jnp.int32))
+
+
+def tolist(x, name=None):
+    import numpy as np
+    return np.asarray(ensure_tensor(x)._value).tolist()

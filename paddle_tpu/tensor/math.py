@@ -41,10 +41,14 @@ erfinv = unary_op(jax.scipy.special.erfinv)
 reciprocal = unary_op(lambda v: 1.0 / v)
 square = unary_op(jnp.square)
 neg = unary_op(jnp.negative)
+negative = neg
 digamma = unary_op(jax.scipy.special.digamma)
 lgamma = unary_op(jax.scipy.special.gammaln)
 i0 = unary_op(jax.scipy.special.i0)
 i1 = unary_op(jax.scipy.special.i1)
+i0e = unary_op(jax.scipy.special.i0e)
+i1e = unary_op(jax.scipy.special.i1e)
+sinc = unary_op(jnp.sinc)
 angle = unary_op(jnp.angle)
 conj = unary_op(jnp.conj)
 real = unary_op(jnp.real)
@@ -68,6 +72,7 @@ maximum = binary_op(jnp.maximum)
 minimum = binary_op(jnp.minimum)
 fmax = binary_op(jnp.fmax)
 fmin = binary_op(jnp.fmin)
+fmod = binary_op(jnp.fmod)
 atan2 = binary_op(jnp.arctan2)
 hypot = binary_op(jnp.hypot)
 logaddexp = binary_op(jnp.logaddexp)
@@ -262,6 +267,21 @@ def isinf(x, name=None):
 
 def isnan(x, name=None):
     return call_op(jnp.isnan, ensure_tensor(x).detach())
+
+
+def isposinf(x, name=None):
+    return call_op(jnp.isposinf, ensure_tensor(x).detach())
+
+
+def isneginf(x, name=None):
+    return call_op(jnp.isneginf, ensure_tensor(x).detach())
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """reference: paddle.linalg.vecdot — dot product along ``axis`` with
+    broadcasting over the batch dims."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis), x, y)
 
 
 def broadcast_shape(x_shape, y_shape):

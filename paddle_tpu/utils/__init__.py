@@ -2,6 +2,7 @@
 import jax
 
 from . import cpp_extension  # noqa: F401
+from . import download  # noqa: F401
 
 __all__ = ["run_check", "try_import", "unique_name", "deprecated"]
 

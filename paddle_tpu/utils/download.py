@@ -1,0 +1,34 @@
+"""reference: python/paddle/utils/download.py (get_weights_path_from_url
+/ get_path_from_url over requests).
+
+This environment has no network egress, so downloads resolve strictly
+against the local cache (``~/.cache/paddle_tpu/weights`` or
+``$PADDLE_TPU_WEIGHTS_HOME``); a missing file raises with the exact
+path to pre-seed instead of hanging on a socket.
+"""
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_TPU_WEIGHTS_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/weights"))
+
+
+def _cached(url, root):
+    fname = url.split("/")[-1].split("?")[0]
+    return os.path.join(root, fname)
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
+                      decompress=True, method="get"):
+    path = _cached(url, root_dir or WEIGHTS_HOME)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"offline environment: cannot fetch {url!r}; place the file at "
+        f"{path!r} (or set PADDLE_TPU_WEIGHTS_HOME) and retry")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

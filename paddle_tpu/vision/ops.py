@@ -1,5 +1,6 @@
 """Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
 deform_conv2d CUDA kernels).  XLA-composable implementations."""
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -11,7 +12,8 @@ __all__ = ["nms", "roi_align", "box_coder", "yolo_box", "deform_conv2d",
            "roi_pool", "psroi_pool", "DeformConv2D",
            "prior_box", "distribute_fpn_proposals", "matrix_nms",
            "generate_proposals", "yolo_loss",
-           "RoIAlign", "RoIPool", "PSRoIPool"]
+           "RoIAlign", "RoIPool", "PSRoIPool",
+           "read_file", "decode_jpeg"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -853,3 +855,33 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                    + jnp.sum(lcls, axis=(1, 2, 3, 4)))
         return per_img
     return call_op(_yl, *args)
+
+
+def read_file(filename, name=None):
+    """reference: paddle.vision.ops.read_file — raw bytes as a 1-D uint8
+    tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.frombuffer(data, dtype=jnp.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: paddle.vision.ops.decode_jpeg — JPEG bytes -> CHW uint8.
+
+    Host-side decode (PIL) like the reference's CPU nvjpeg fallback;
+    the result lands on device as a regular Tensor.
+    """
+    import io as _io
+    from PIL import Image
+    buf = bytes(np.asarray(ensure_tensor(x)._value, dtype=np.uint8))
+    img = Image.open(_io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                       # (1, H, W)
+    else:
+        arr = np.transpose(arr, (2, 0, 1))    # (C, H, W)
+    return Tensor(jnp.asarray(arr))

@@ -1,0 +1,788 @@
+"""Round-4b surface additions: top-level tensor ops.
+
+Golden values via numpy/scipy (reference: python/paddle/tensor/{math,
+manipulation,logic}.py op semantics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_shape_rank_tolist():
+    x = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+    np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 3, 4])
+    assert paddle.shape(x).dtype == paddle.int32
+    assert int(paddle.rank(x)) == 3
+    assert paddle.tolist(paddle.to_tensor([[1, 2], [3, 4]])) == [[1, 2], [3, 4]]
+
+
+def test_stacks_match_numpy():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = a + 10
+    ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_allclose(paddle.hstack([ta, tb]).numpy(),
+                               np.hstack([a, b]))
+    np.testing.assert_allclose(paddle.vstack([ta, tb]).numpy(),
+                               np.vstack([a, b]))
+    np.testing.assert_allclose(paddle.dstack([ta, tb]).numpy(),
+                               np.dstack([a, b]))
+
+
+def test_unflatten_and_grad():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32), stop_gradient=False)
+    y = paddle.unflatten(x * 3.0, 0, [3, -1] if False else [3, 4])
+    assert y.shape == [3, 4]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(12, 3.0))
+
+
+def test_strided_slice():
+    x = np.arange(60).reshape(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    got = paddle.strided_slice(t, axes=[1, 2], starts=[3, 4],
+                               ends=[0, 0], strides=[-1, -2]).numpy()
+    np.testing.assert_array_equal(got, x[:, 3:0:-1, 4:0:-2])
+    got2 = paddle.strided_slice(t, axes=[0], starts=[0], ends=[3],
+                                strides=[2]).numpy()
+    np.testing.assert_array_equal(got2, x[::2])
+
+
+def test_bessel_exp_scaled_and_sinc():
+    from scipy import special
+    v = np.linspace(0.1, 5.0, 7).astype(np.float32)
+    t = paddle.to_tensor(v)
+    np.testing.assert_allclose(paddle.i0e(t).numpy(), special.i0e(v),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.i1e(t).numpy(), special.i1e(v),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.sinc(t).numpy(), np.sinc(v), rtol=1e-5)
+
+
+def test_fmod_c_semantics():
+    x = np.array([-7.0, 7.0, -5.5], np.float32)
+    y = np.array([3.0, -3.0, 2.0], np.float32)
+    got = paddle.fmod(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    np.testing.assert_allclose(got, np.fmod(x, y))
+
+
+def test_isposinf_isneginf():
+    v = np.array([np.inf, -np.inf, np.nan, 1.0], np.float32)
+    t = paddle.to_tensor(v)
+    np.testing.assert_array_equal(paddle.isposinf(t).numpy(),
+                                  np.isposinf(v))
+    np.testing.assert_array_equal(paddle.isneginf(t).numpy(),
+                                  np.isneginf(v))
+
+
+def test_vecdot_batched():
+    a = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    got = paddle.linalg.vecdot(paddle.to_tensor(a),
+                               paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, (a * b).sum(-1), rtol=1e-5)
+
+
+def test_dtype_predicates():
+    assert paddle.is_floating_point(paddle.to_tensor([1.0]))
+    assert not paddle.is_floating_point(paddle.to_tensor([1]))
+    assert paddle.is_integer(paddle.to_tensor([1]))
+    assert not paddle.is_complex(paddle.to_tensor([1.0]))
+    assert paddle.is_complex(paddle.to_tensor(np.array([1 + 2j],
+                                                       np.complex64)))
+
+
+def test_negative_alias():
+    x = paddle.to_tensor([1.0, -2.0])
+    np.testing.assert_allclose(paddle.negative(x).numpy(), [-1.0, 2.0])
+    np.testing.assert_allclose(x.negative().numpy(), [-1.0, 2.0])
+
+
+# -- nn additions -----------------------------------------------------------
+
+def _hsigmoid_ref(x, lab, w, b, C):
+    out = np.zeros((len(lab), 1))
+    for i, l in enumerate(lab):
+        node = l + C - 1
+        tot = 0.0
+        while node > 0:
+            parent = (node - 1) // 2
+            bit = 1.0 if node == 2 * parent + 2 else 0.0
+            s = w[parent] @ x[i] + b[parent, 0]
+            tot += max(s, 0) - s * bit + np.log1p(np.exp(-abs(s)))
+            node = parent
+        out[i, 0] = tot
+    return out
+
+
+def test_hsigmoid_loss_matches_tree_walk():
+    rs = np.random.RandomState(0)
+    N, F_, C = 5, 8, 6
+    x = rs.randn(N, F_).astype(np.float32)
+    lab = rs.randint(0, C, (N,))
+    w = rs.randn(C - 1, F_).astype(np.float32) * 0.1
+    b = rs.randn(C - 1, 1).astype(np.float32) * 0.1
+    out = paddle.nn.functional.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab), C,
+        paddle.to_tensor(w), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), _hsigmoid_ref(x, lab, w, b, C),
+                               rtol=1e-4)
+
+
+def test_hsigmoid_loss_grad_and_layer():
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32),
+                         stop_gradient=False)
+    lab = paddle.to_tensor(rs.randint(0, 10, (4,)))
+    layer = paddle.nn.HSigmoidLoss(8, 10)
+    loss = layer(x, lab).sum()
+    loss.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+    assert layer.weight.grad is not None
+
+
+def test_hsigmoid_custom_path():
+    # two-class custom tree: single internal node, code 0/1
+    x = np.array([[1.0, -1.0]], np.float32)
+    w = np.array([[0.5, 0.5]], np.float32)
+    table = np.array([[0]], np.int64)
+    code = np.array([[1]], np.int64)
+    out = paddle.nn.functional.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor([0]), 2,
+        paddle.to_tensor(w), path_table=paddle.to_tensor(table),
+        path_code=paddle.to_tensor(code))
+    s = 0.0  # w.x = 0
+    want = max(s, 0) - s * 1 + np.log1p(np.exp(-abs(s)))
+    np.testing.assert_allclose(out.numpy(), [[want]], rtol=1e-5)
+
+
+def test_class_center_sample():
+    lab = paddle.to_tensor(np.array([1, 3, 3, 9]))
+    remap, centers = paddle.nn.functional.class_center_sample(lab, 20, 6)
+    c = centers.numpy()
+    assert len(c) == 6 and len(set(c.tolist())) == 6
+    assert {1, 3, 9} <= set(c.tolist())
+    np.testing.assert_array_equal(c[remap.numpy()], [1, 3, 3, 9])
+
+
+def test_class_center_sample_all_positive():
+    lab = paddle.to_tensor(np.arange(8))
+    remap, centers = paddle.nn.functional.class_center_sample(lab, 8, 4)
+    np.testing.assert_array_equal(np.sort(centers.numpy()), np.arange(8))
+
+
+def test_pixel_unshuffle_layer():
+    x = np.random.RandomState(0).randn(1, 4, 8, 8).astype(np.float32)
+    y = paddle.nn.PixelUnshuffle(2)(paddle.to_tensor(x))
+    assert y.shape == [1, 16, 4, 4]
+    back = paddle.nn.PixelShuffle(2)(y)
+    np.testing.assert_allclose(back.numpy(), x)
+
+
+def test_multi_margin_loss_layer():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 5).astype(np.float32)
+    lab = rs.randint(0, 5, (4,))
+    got = paddle.nn.MultiMarginLoss()(paddle.to_tensor(x),
+                                      paddle.to_tensor(lab))
+    want = paddle.nn.functional.multi_margin_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab))
+    np.testing.assert_allclose(float(got), float(want))
+
+
+# -- weight-only / llm.int8 quant ------------------------------------------
+
+def test_weight_quantize_roundtrip():
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype(np.float32)
+    q, s = paddle.nn.quant.weight_quantize(paddle.to_tensor(w))
+    assert q.numpy().dtype == np.int8 and s.shape == [8]
+    wd = paddle.nn.quant.weight_dequantize(q, s)
+    assert np.abs(wd.numpy() - w).max() < np.abs(w).max() / 100
+
+
+def test_weight_only_linear():
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype(np.float32)
+    x = rs.randn(4, 16).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    q, s = paddle.nn.quant.weight_quantize(paddle.to_tensor(w))
+    y = paddle.nn.quant.weight_only_linear(
+        paddle.to_tensor(x), q, bias=paddle.to_tensor(b), weight_scale=s)
+    want = x @ (q.numpy().astype(np.float32) * s.numpy()) + b
+    # default matmul precision (bf16 passes) -> loose tolerance
+    np.testing.assert_allclose(y.numpy(), want, rtol=0.05, atol=0.05)
+
+
+def test_llm_int8_linear_outlier_decomposition():
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype(np.float32)
+    x = rs.randn(4, 16).astype(np.float32)
+    x[:, 3] *= 20.0   # outlier column
+    q, s = paddle.nn.quant.weight_quantize(paddle.to_tensor(w))
+    y = paddle.nn.quant.llm_int8_linear(paddle.to_tensor(x), q,
+                                        weight_scale=s, threshold=6.0)
+    want = x @ (q.numpy().astype(np.float32) * s.numpy())
+    np.testing.assert_allclose(y.numpy(), want, rtol=0.1, atol=0.2)
+
+
+def test_nn_quant_stub_identity():
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(paddle.nn.quant.Stub()(x).numpy(), [1, 2])
+
+
+# -- beam search decoding ---------------------------------------------------
+
+class _ToyCell:
+    """Deterministic 'cell': logits depend only on the input token via a
+    fixed table; state counts steps."""
+
+    def __init__(self, table):
+        self.table = paddle.to_tensor(table)
+
+    def __call__(self, inputs, states):
+        import paddle_tpu as P
+        logits = P.gather(self.table, inputs, axis=0)
+        return logits, states
+
+
+def _brute_force_beam(table, start, end, beam, steps):
+    """Enumerate all token sequences, score like beam search (sum of
+    log-softmax steps, sequences frozen at end token), return the best
+    final beam score set."""
+    from itertools import product
+    V = table.shape[1]
+
+    def logsoftmax(v):
+        v = v - v.max()
+        return v - np.log(np.exp(v).sum())
+
+    best = []
+    for seq in product(range(V), repeat=steps):
+        score, cur, finished = 0.0, start, False
+        valid = True
+        for tok in seq:
+            if finished:
+                if tok != end:
+                    valid = False
+                    break
+                continue
+            score += logsoftmax(table[cur])[tok]
+            cur = tok
+            if tok == end:
+                finished = True
+        if valid:
+            best.append((score, seq))
+    best.sort(key=lambda t: -t[0])
+    return best
+
+
+def test_beam_search_matches_brute_force():
+    rs = np.random.RandomState(7)
+    V = 5
+    table = rs.randn(V, V).astype(np.float32)
+    end = V - 1
+    cell = _ToyCell(table)
+    dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=end,
+                                      beam_size=3)
+    B = 2
+    init_state = paddle.to_tensor(np.zeros((B, 4), np.float32))
+    out, fstate = paddle.nn.dynamic_decode(dec, inits=[init_state],
+                                           max_step_num=4)
+    ids = out.numpy()            # (B, T, beam)
+    assert ids.shape[0] == B and ids.shape[2] == 3
+    scores = fstate.log_probs.numpy()      # (B, beam)
+    brute = _brute_force_beam(table, 0, end, 3, ids.shape[1])
+    # best beam score must equal the true best sequence score
+    np.testing.assert_allclose(scores[0, 0], brute[0][0], rtol=1e-4)
+    np.testing.assert_allclose(scores[1, 0], brute[0][0], rtol=1e-4)
+    # and the decoded top beam must be that sequence
+    np.testing.assert_array_equal(ids[0, :, 0], list(brute[0][1]))
+
+
+def test_dynamic_decode_stops_on_finish():
+    V = 4
+    # token 'end'=3 gets overwhelming logit from any input -> finishes fast
+    table = np.full((V, V), -5.0, np.float32)
+    table[:, 3] = 5.0
+    cell = _ToyCell(table)
+    dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=3,
+                                      beam_size=2)
+    init_state = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    out, fstate, lens = paddle.nn.dynamic_decode(
+        dec, inits=[init_state], max_step_num=50, return_length=True)
+    assert out.numpy().shape[1] < 50       # stopped early
+    assert fstate.finished.numpy().all()
+    assert (lens.numpy() >= 1).all()
+
+
+# -- static additions -------------------------------------------------------
+
+def test_static_save_load_roundtrip(tmp_path):
+    import paddle_tpu.static as static
+    import paddle_tpu.nn as nn
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            net = nn.Linear(4, 2)
+            out = net(x)
+        static.save(main, str(tmp_path / "model"))
+        # perturb, then restore
+        orig = net.weight.numpy().copy()
+        net.weight.set_value(np.zeros_like(orig))
+        static.load(main, str(tmp_path / "model"))
+        np.testing.assert_allclose(net.weight.numpy(), orig)
+    finally:
+        paddle.disable_static()
+
+
+def test_set_program_state(tmp_path):
+    import paddle_tpu.static as static
+    import paddle_tpu.nn as nn
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            net = nn.Linear(3, 3)
+            net(x)
+        params = static._program_parameters(main)
+        state = {nm: np.full(np.asarray(t._value).shape, 2.5, np.float32)
+                 for nm, t in params.items()}
+        static.set_program_state(main, state)
+        for t in params.values():
+            np.testing.assert_allclose(np.asarray(t._value), 2.5)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_variable_alias_and_global_var():
+    import paddle_tpu.static as static
+    from paddle_tpu.framework.core import Tensor
+    assert static.Variable is Tensor
+    g = static.create_global_var([2], 3.0, "float32", name="gv_t")
+    np.testing.assert_allclose(g.numpy(), [3.0, 3.0])
+    assert "gv_t" in static.global_scope().vars
+
+
+def test_static_accuracy_topk():
+    import paddle_tpu.static as static
+    pred = np.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]], np.float32)
+    lab = np.array([[2], [0]])
+    a1 = float(static.accuracy(paddle.to_tensor(pred),
+                               paddle.to_tensor(lab), k=1))
+    a2 = float(static.accuracy(paddle.to_tensor(pred),
+                               paddle.to_tensor(lab), k=2))
+    assert a1 == 0.5 and a2 == 1.0
+
+
+def test_static_auc_rank_statistic():
+    import paddle_tpu.static as static
+    # scores 0.8,0.4,0.7 labels 1,0,1 -> perfect separation auc=1
+    p = np.array([[0.2, 0.8], [0.6, 0.4], [0.3, 0.7]], np.float32)
+    lab = np.array([1, 0, 1])
+    a, _, _ = static.auc(paddle.to_tensor(p), paddle.to_tensor(lab))
+    np.testing.assert_allclose(float(a), 1.0)
+    # neg between the two pos: one of two pairs inverted -> auc=0.5
+    p2 = np.array([[0.2, 0.8], [0.6, 0.5], [0.3, 0.1]], np.float32)
+    a2, _, _ = static.auc(paddle.to_tensor(p2), paddle.to_tensor(lab))
+    np.testing.assert_allclose(float(a2), 0.5)
+
+
+# -- io additions -----------------------------------------------------------
+
+def test_concat_dataset():
+    a = paddle.io.TensorDataset([paddle.to_tensor(np.arange(3))])
+    b = paddle.io.TensorDataset([paddle.to_tensor(np.arange(10, 12))])
+    cd = paddle.io.ConcatDataset([a, b])
+    assert len(cd) == 5
+    assert int(cd[0][0]) == 0 and int(cd[3][0]) == 10
+    assert int(cd[-1][0]) == 11
+
+
+def test_subset_random_sampler():
+    s = paddle.io.SubsetRandomSampler([1, 5, 9])
+    got = sorted(iter(s))
+    assert got == [1, 5, 9] and len(s) == 3
+
+
+# -- distributed additions --------------------------------------------------
+
+def test_distributed_is_available_and_state_dict_reexport():
+    import paddle_tpu.distributed as dist
+    assert dist.is_available() is True
+    assert dist.save_state_dict is dist.checkpoint.save_state_dict
+    assert dist.load_state_dict is dist.checkpoint.load_state_dict
+
+
+def test_shard_layer_replicates_params():
+    import jax
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    mesh = dist.ProcessMesh(np.arange(len(jax.devices())), dim_names=["dp"])
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    calls = []
+
+    def shard_fn(name, sub, m):
+        calls.append(name)
+        for pname, p in list(sub._parameters.items()):
+            if p is not None:
+                sub._parameters[pname] = dist.shard_tensor(
+                    p, m, [dist.Replicate()] * p.ndim)
+
+    dist.shard_layer(net, mesh, shard_fn)
+    assert calls                       # visited sublayers
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4).astype(np.float32))
+    y = net(x)
+    assert y.shape == [2, 2]
+
+
+# -- utils.download ---------------------------------------------------------
+
+def test_download_offline_contract(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+    with pytest.raises(RuntimeError, match="offline"):
+        download.get_weights_path_from_url("http://host/w.pdparams")
+    # pre-seeded file resolves
+    f = tmp_path / "w.pdparams"
+    f.write_bytes(b"x")
+    got = download.get_path_from_url("http://host/w.pdparams",
+                                     root_dir=str(tmp_path))
+    assert got == str(f)
+
+
+# -- vision io ops ----------------------------------------------------------
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+    import io as _io
+    yy, xx = np.mgrid[0:10, 0:12]
+    img = np.stack([yy * 20, xx * 20, yy * 10 + xx * 10],
+                   -1).astype(np.uint8)
+    p = tmp_path / "t.jpg"
+    Image.fromarray(img).save(str(p), format="JPEG", quality=95)
+    raw = paddle.vision.ops.read_file(str(p))
+    assert raw.numpy().dtype == np.uint8 and raw.numpy().ndim == 1
+    dec = paddle.vision.ops.decode_jpeg(raw)
+    assert dec.shape == [3, 10, 12] and dec.numpy().dtype == np.uint8
+    # lossy codec: mean error small
+    assert np.abs(dec.numpy().astype(int)
+                  - img.transpose(2, 0, 1).astype(int)).mean() < 20
+    gray = paddle.vision.ops.decode_jpeg(raw, mode="gray")
+    assert gray.shape == [1, 10, 12]
+
+
+# -- incubate graph sampling ------------------------------------------------
+
+def _toy_csc():
+    # 4 nodes; in-neighbors: 0<-{1,2}, 1<-{0,2,3}, 2<-{0}, 3<-{1}
+    row = np.array([1, 2, 0, 2, 3, 0, 1])
+    colptr = np.array([0, 2, 5, 6, 7])
+    return row, colptr
+
+
+def test_graph_sample_neighbors_full_and_capped():
+    import paddle_tpu.incubate as inc
+    row, colptr = _toy_csc()
+    nb, ct = inc.graph_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([0, 1])))
+    np.testing.assert_array_equal(ct.numpy(), [2, 3])
+    np.testing.assert_array_equal(np.sort(nb.numpy()[:2]), [1, 2])
+    np.testing.assert_array_equal(np.sort(nb.numpy()[2:]), [0, 2, 3])
+    # capped at 2: per-node neighbor sets are subsets
+    nb2, ct2 = inc.graph_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([1])), sample_size=2)
+    assert ct2.numpy().tolist() == [2]
+    assert set(nb2.numpy().tolist()) <= {0, 2, 3}
+
+
+def test_graph_sample_neighbors_eids():
+    import paddle_tpu.incubate as inc
+    row, colptr = _toy_csc()
+    eids = np.arange(100, 107)
+    nb, ct, ei = inc.graph_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([2])), eids=paddle.to_tensor(eids),
+        return_eids=True)
+    np.testing.assert_array_equal(nb.numpy(), [0])
+    np.testing.assert_array_equal(ei.numpy(), [105])
+
+
+def test_graph_reindex():
+    import paddle_tpu.incubate as inc
+    src, dst, nodes = inc.graph_reindex(
+        paddle.to_tensor(np.array([10, 20])),
+        paddle.to_tensor(np.array([20, 30, 10, 40])),
+        paddle.to_tensor(np.array([2, 2])))
+    np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 40])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 0, 3])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+    # reconstruct original edges through out_nodes
+    np.testing.assert_array_equal(nodes.numpy()[src.numpy()],
+                                  [20, 30, 10, 40])
+
+
+def test_graph_khop_sampler_edges_valid():
+    import paddle_tpu.incubate as inc
+    row, colptr = _toy_csc()
+    es, ed, si, rn = inc.graph_khop_sampler(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([0])), [2, 2])
+    nodes = si.numpy()
+    # every reindexed edge maps back to a real CSC edge
+    true_edges = set()
+    for dst_node in range(4):
+        for i in range(colptr[dst_node], colptr[dst_node + 1]):
+            true_edges.add((row[i], dst_node))
+    for s, d in zip(es.numpy(), ed.numpy()):
+        assert (nodes[s], nodes[d]) in true_edges
+    np.testing.assert_array_equal(nodes[rn.numpy()], [0])
+
+
+# -- sparse attention -------------------------------------------------------
+
+def _dense_attn_ref(q, k, v, mask):
+    D = q.shape[-1]
+    s = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+    s = np.where(mask, s, -1e9)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhts,bhsd->bhtd", p, v)
+
+
+def test_sparse_attention_banded_matches_masked_dense():
+    rs = np.random.RandomState(3)
+    B, H, T, D = 2, 2, 6, 4
+    q, k, v = [rs.randn(B, H, T, D).astype(np.float32) for _ in range(3)]
+    # band: each row attends to {t-1, t}
+    offs, cols, mask = [], [], np.zeros((T, T), bool)
+    n = 0
+    offs.append(0)
+    for t in range(T):
+        for c in ([t] if t == 0 else [t - 1, t]):
+            cols.append(c)
+            mask[t, c] = True
+            n += 1
+        offs.append(n)
+    # ragged rows -> pad nnz arrays per (B,H) uniformly (same pattern)
+    offset = np.tile(np.asarray(offs, np.int32)[None, None], (B, H, 1))
+    columns = np.tile(np.asarray(cols, np.int32)[None, None], (B, H, 1))
+    out = paddle.nn.functional.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offset), paddle.to_tensor(columns))
+    ref = _dense_attn_ref(q, k, v, mask[None, None])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-2, atol=2e-2)
+
+
+def test_sparse_attention_key_padding_mask():
+    rs = np.random.RandomState(4)
+    B, H, T, D = 1, 1, 4, 4
+    q, k, v = [rs.randn(B, H, T, D).astype(np.float32) for _ in range(3)]
+    offset = np.arange(0, (T + 1) * T, T, dtype=np.int32).reshape(1, 1, -1)
+    cols = np.tile(np.arange(T, dtype=np.int32), T).reshape(1, 1, -1)
+    kpm = np.array([[1, 1, 0, 0]], np.float32)   # last two keys padded
+    out = paddle.nn.functional.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offset), paddle.to_tensor(cols),
+        key_padding_mask=paddle.to_tensor(kpm))
+    mask = np.zeros((1, 1, T, T), bool)
+    mask[..., :2] = True
+    ref = _dense_attn_ref(q, k, v, mask)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-2, atol=2e-2)
+
+
+def test_sparse_attention_grad_flows():
+    rs = np.random.RandomState(5)
+    B, H, T, D = 1, 1, 4, 4
+    q = paddle.to_tensor(rs.randn(B, H, T, D).astype(np.float32),
+                         stop_gradient=False)
+    k, v = [paddle.to_tensor(rs.randn(B, H, T, D).astype(np.float32))
+            for _ in range(2)]
+    offset = paddle.to_tensor(
+        np.arange(0, (T + 1) * T, T, dtype=np.int32).reshape(1, 1, -1))
+    cols = paddle.to_tensor(
+        np.tile(np.arange(T, dtype=np.int32), T).reshape(1, 1, -1))
+    out = paddle.nn.functional.sparse_attention(q, k, v, offset, cols)
+    out.sum().backward()
+    assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+
+
+# -- review-fix regressions -------------------------------------------------
+
+def test_hsigmoid_label_column_shape():
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4).astype(np.float32)
+    w = rs.randn(5, 4).astype(np.float32)
+    lab_flat = np.array([0, 2, 5])
+    a = paddle.nn.functional.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab_flat), 6,
+        paddle.to_tensor(w))
+    b = paddle.nn.functional.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab_flat.reshape(-1, 1)), 6,
+        paddle.to_tensor(w))
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_auc_ties_midrank():
+    import paddle_tpu.static as static
+    p = np.array([[0.5, 0.5], [0.5, 0.5]], np.float32)
+    lab = np.array([0, 1])
+    a, _, _ = static.auc(paddle.to_tensor(p), paddle.to_tensor(lab))
+    np.testing.assert_allclose(float(a), 0.5)
+
+
+def test_auc_pr_curve():
+    import paddle_tpu.static as static
+    # perfect ranking: PR AUC ~ 1
+    p = np.array([[0.1, 0.9], [0.2, 0.8], [0.9, 0.1], [0.8, 0.2]],
+                 np.float32)
+    lab = np.array([1, 1, 0, 0])
+    a, _, _ = static.auc(paddle.to_tensor(p), paddle.to_tensor(lab),
+                         curve="PR")
+    assert float(a) > 0.99
+    with pytest.raises(ValueError):
+        static.auc(paddle.to_tensor(p), paddle.to_tensor(lab), curve="XYZ")
+
+
+def test_khop_no_duplicate_edges_on_cycle():
+    import paddle_tpu.incubate as inc
+    # 0 <-> 1 cycle in CSC
+    row = np.array([1, 0])
+    colptr = np.array([0, 1, 2])
+    es, ed, si, rn = inc.graph_khop_sampler(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([0, 1])), [1, 1])
+    edges = list(zip(es.numpy().tolist(), ed.numpy().tolist()))
+    assert len(edges) == len(set(edges)) == 2
+
+
+def test_concat_dataset_index_errors():
+    a = paddle.io.TensorDataset([paddle.to_tensor(np.arange(3))])
+    cd = paddle.io.ConcatDataset([a, a])
+    with pytest.raises(IndexError):
+        cd[6]
+    with pytest.raises(IndexError):
+        cd[-7]
+    assert int(cd[-6][0]) == 0
+
+
+def test_beam_search_nested_cell_states():
+    # LSTM-style nested state [(h, c)] survives initialize/step
+    rs = np.random.RandomState(2)
+    V = 4
+    table = rs.randn(V, V).astype(np.float32)
+
+    class _NestCell:
+        def __call__(self, inputs, states):
+            logits = paddle.gather(paddle.to_tensor(table), inputs, axis=0)
+            return logits, states
+
+    dec = paddle.nn.BeamSearchDecoder(_NestCell(), start_token=0,
+                                      end_token=V - 1, beam_size=2)
+    h = paddle.to_tensor(np.zeros((1, 3), np.float32))
+    c = paddle.to_tensor(np.zeros((1, 3), np.float32))
+    out, fstate = paddle.nn.dynamic_decode(dec, inits=[(h, c)],
+                                           max_step_num=3)
+    assert out.numpy().shape[0] == 1
+
+
+def test_io_star_export_includes_new_names():
+    import paddle_tpu.io as pio
+    assert "ConcatDataset" in pio.__all__
+    assert "SubsetRandomSampler" in pio.__all__
+
+
+def test_class_center_sample_group_seed_rank_invariant(monkeypatch):
+    # with a group, the negative draw must depend only on the unioned
+    # positives + global seed, not the per-rank key stream position
+    import paddle_tpu.nn.functional as F
+
+    class _FakeGroup:
+        pass
+
+    def fake_allgather(out, obj, group=None):
+        out.extend([[1, 3], [3, 9]])
+
+    import paddle_tpu.distributed.collective as coll
+    monkeypatch.setattr(coll, "all_gather_object", fake_allgather)
+    paddle.seed(123)
+    _, c1 = F.class_center_sample(paddle.to_tensor(np.array([1, 3])), 20, 6,
+                                  group=_FakeGroup())
+    # advance the local key stream (simulates rank-divergent RNG use)
+    paddle.rand([4])
+    _, c2 = F.class_center_sample(paddle.to_tensor(np.array([1, 3])), 20, 6,
+                                  group=_FakeGroup())
+    np.testing.assert_array_equal(c1.numpy(), c2.numpy())
+
+
+# -- tensor method batch ----------------------------------------------------
+
+def test_inplace_method_family_r4b():
+    x = paddle.to_tensor([2.0, 8.0])
+    x.divide_(paddle.to_tensor(2.0))
+    np.testing.assert_allclose(x.numpy(), [1.0, 4.0])
+    y = paddle.to_tensor([-1.5, 2.5])
+    y.abs_()
+    np.testing.assert_allclose(y.numpy(), [1.5, 2.5])
+    z = paddle.to_tensor([[1.0, 2.0]])
+    z.squeeze_()
+    assert z.shape == [2]
+    m = paddle.to_tensor([1.0, 2.0])
+    m.masked_fill_(paddle.to_tensor([True, False]), 9.0)
+    np.testing.assert_allclose(m.numpy(), [9.0, 2.0])
+    p = paddle.to_tensor([1.0, 2.0])
+    p.pow_(paddle.to_tensor(2.0))
+    np.testing.assert_allclose(p.numpy(), [1.0, 4.0])
+
+
+def test_inplace_grad_flows_through_rebind():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    y.tanh_()
+    y.sum().backward()
+    want = 3.0 * (1.0 - np.tanh(np.array([3.0, 6.0])) ** 2)
+    np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-4, atol=1e-6)
+
+
+def test_copy_and_bernoulli_():
+    z = paddle.to_tensor([1.0, 2.0])
+    z.copy_(paddle.to_tensor([9.0, 8.0]))
+    np.testing.assert_allclose(z.numpy(), [9.0, 8.0])
+    b = paddle.to_tensor(np.zeros(2000, np.float32))
+    b.bernoulli_(0.3)
+    assert 0.2 < b.numpy().mean() < 0.4
+
+
+def test_method_aliases_r4b():
+    y = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(y.t().numpy(), [[1.0, 3.0], [2.0, 4.0]])
+    assert y.ndimension() == 2 and int(y.rank()) == 2
+    np.testing.assert_allclose(
+        paddle.to_tensor([1.5, -1.5]).frac().numpy(), [0.5, -0.5])
+    assert paddle.to_tensor([1.0, np.nan]).nanmean().numpy() == 1.0
+    g = paddle.to_tensor([12, 18]).gcd(paddle.to_tensor([8, 12]))
+    np.testing.assert_array_equal(g.numpy(), [4, 6])
+    s = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(5).astype(np.float32))
+    out = s.multinomial(3, replacement=True)
+    assert out.shape == [3]
+
+
+def test_static_amp_namespace():
+    import paddle_tpu.static as static
+    assert hasattr(static, "amp")
+    with static.amp.auto_cast(False):
+        pass
+    ol = static.amp.CustomOpLists(custom_white_list=["matmul"])
+    assert "matmul" in ol.white_list
+
+    @static.amp.fp16_guard
+    def f(x):
+        return x + 1
+
+    assert float(f(paddle.to_tensor(1.0))) == 2.0

@@ -1,0 +1,209 @@
+"""Round-4c surface additions: printoptions, Bilinear init,
+clip_grad_value_, saved_tensors_hooks, fused layers, sparse.mask_as."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_set_printoptions_roundtrip():
+    t = paddle.to_tensor([1.23456789])
+    paddle.set_printoptions(precision=2, sci_mode=True)
+    try:
+        r = repr(t)
+        assert "e" in r.lower()
+    finally:
+        paddle.set_printoptions(precision=8, sci_mode=False)
+    assert "1.2345679" in repr(t)
+
+
+def test_bilinear_initializer_kernel():
+    k = paddle.nn.initializer.Bilinear()((1, 1, 4, 4), "float32")
+    got = np.asarray(k)[0, 0]
+    # symmetric, separable bilinear weights for factor-2 upsampling
+    want1d = np.array([0.25, 0.75, 0.75, 0.25])
+    np.testing.assert_allclose(got, np.outer(want1d, want1d))
+    np.testing.assert_allclose(got, got.T)
+
+
+def test_bilinear_initializer_rejects_vector():
+    with pytest.raises(ValueError):
+        paddle.nn.initializer.Bilinear()((4,), "float32")
+
+
+def test_clip_grad_value_():
+    x = paddle.to_tensor([1.0, -2.0], stop_gradient=False)
+    (x * paddle.to_tensor([10.0, 10.0])).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])
+    paddle.nn.utils.clip_grad_value_([x], 3.0)
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+    events = []
+
+    def pack(t):
+        events.append("pack")
+        return t.numpy()
+
+    def unpack(v):
+        events.append("unpack")
+        return paddle.to_tensor(v)
+
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2.0 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    with saved_tensors_hooks(pack, unpack):
+        y = Sq.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    assert events == ["pack", "unpack"]
+    # outside the context the hooks are inactive
+    events.clear()
+    x2 = paddle.to_tensor([2.0], stop_gradient=False)
+    Sq.apply(x2).backward()
+    assert events == [] and x2.grad.numpy()[0] == 4.0
+
+
+def test_fused_matmul_bias_transposes():
+    F = paddle.incubate.nn.functional
+    rs = np.random.RandomState(0)
+    a = rs.randn(3, 4).astype(np.float32)
+    w = rs.randn(5, 4).astype(np.float32)      # transposed weight
+    b = rs.randn(5).astype(np.float32)
+    out = F.fused_matmul_bias(paddle.to_tensor(a), paddle.to_tensor(w),
+                              paddle.to_tensor(b), transpose_y=True)
+    np.testing.assert_allclose(out.numpy(), a @ w.T + b, rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_fused_dropout_add_layer():
+    fda = paddle.incubate.nn.FusedDropoutAdd(p=0.5)
+    fda.eval()
+    out = fda(paddle.to_tensor([1.0, 2.0]), paddle.to_tensor([3.0, 4.0]))
+    np.testing.assert_allclose(out.numpy(), [4.0, 6.0])
+    fda.train()
+    x = paddle.to_tensor(np.ones(1000, np.float32))
+    y = paddle.to_tensor(np.zeros(1000, np.float32))
+    o = fda(x, y).numpy()
+    # upscale_in_train: surviving entries are 1/keep_prob
+    assert set(np.round(np.unique(o), 3).tolist()) <= {0.0, 2.0}
+
+
+def test_fused_ec_moe_routing_and_grad():
+    rs = np.random.RandomState(1)
+    moe = paddle.incubate.nn.FusedEcMoe(8, 16, 4)
+    x = paddle.to_tensor(rs.randn(2, 6, 8).astype(np.float32),
+                         stop_gradient=False)
+    g = paddle.to_tensor(rs.randn(2, 6, 4).astype(np.float32))
+    y = moe(x, g)
+    assert y.shape == [2, 6, 8]
+    y.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert np.isfinite(moe.bmm0_weight.grad.numpy()).all()
+    # one-expert, capacity==tokens degenerates to a dense FFN on all
+    # tokens scaled by softmax prob 1.0
+    moe1 = paddle.incubate.nn.FusedEcMoe(4, 8, 1)
+    x1 = paddle.to_tensor(rs.randn(1, 5, 4).astype(np.float32))
+    g1 = paddle.to_tensor(np.zeros((1, 5, 1), np.float32))
+    out1 = moe1(x1, g1).numpy()
+    w0 = moe1.bmm0_weight.numpy()[0]
+    b0 = moe1.bmm0_bias.numpy()[0]
+    w1 = moe1.bmm1_weight.numpy()[0]
+    b1 = moe1.bmm1_bias.numpy()[0]
+    xx = x1.numpy().reshape(5, 4)
+    h = xx @ w0 + b0
+    # jax.nn.gelu default = tanh approximation
+    gelu = 0.5 * h * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (h + 0.044715 * h ** 3)))
+    want = gelu @ w1 + b1
+    np.testing.assert_allclose(out1.reshape(5, 4), want, rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_sparse_mask_as():
+    idx = paddle.to_tensor(np.array([[0, 1], [1, 0]]))
+    m = paddle.sparse.sparse_coo_tensor(idx, paddle.to_tensor([1.0, 1.0]),
+                                        [2, 2])
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    s = paddle.sparse.mask_as(x, m)
+    np.testing.assert_allclose(s.values().numpy(), [2.0, 3.0])
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               [[0.0, 2.0], [3.0, 0.0]])
+    m2 = paddle.sparse.sparse_csr_tensor([0, 1, 2], [1, 0],
+                                         paddle.to_tensor([1.0, 1.0]),
+                                         [2, 2])
+    s2 = paddle.sparse.mask_as(x, m2)
+    np.testing.assert_allclose(s2.values().numpy(), [2.0, 3.0])
+    with pytest.raises(TypeError):
+        paddle.sparse.mask_as(x, x)
+
+
+# -- review-fix regressions (r4c review) ------------------------------------
+
+def test_graph_reindex_duplicate_centers():
+    import paddle_tpu.incubate as inc
+    src, dst, nodes = inc.graph_reindex(
+        paddle.to_tensor(np.array([5, 5])),
+        paddle.to_tensor(np.array([7, 8])),
+        paddle.to_tensor(np.array([1, 1])))
+    np.testing.assert_array_equal(nodes.numpy(), [5, 7, 8])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0])
+    np.testing.assert_array_equal(src.numpy(), [1, 2])
+
+
+def test_fused_matmul_bias_batched_transpose():
+    F = paddle.incubate.nn.functional
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    y = rs.randn(3, 5).astype(np.float32)
+    out = F.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(y),
+                              transpose_x=True)
+    want = np.swapaxes(x, -1, -2) @ y
+    np.testing.assert_allclose(out.numpy(), want, rtol=2e-2, atol=2e-2)
+
+
+def test_fused_ec_moe_bf16():
+    rs = np.random.RandomState(2)
+    moe = paddle.incubate.nn.FusedEcMoe(8, 16, 2)
+    x = paddle.to_tensor(rs.randn(1, 4, 8).astype(np.float32)) \
+        .astype("bfloat16")
+    g = paddle.to_tensor(rs.randn(1, 4, 2).astype(np.float32))
+    y = moe(x, g)
+    assert str(y.dtype) in ("paddle.bfloat16", "bfloat16") or \
+        "bfloat16" in str(y.numpy().dtype)
+
+
+def test_weight_quantize_reference_scale_convention():
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype(np.float32)
+    q, s = paddle.nn.quant.weight_quantize(paddle.to_tensor(w))
+    # reference convention: dequant = q * scale (scale = absmax/127)
+    np.testing.assert_allclose(s.numpy(), np.abs(w).max(0) / 127.0,
+                               rtol=1e-5)
+    wd = paddle.nn.quant.weight_dequantize(q, s)
+    assert np.abs(wd.numpy() - w).max() < np.abs(w).max() / 100
+
+
+def test_dynamic_decode_zero_steps_raises():
+    class _DoneDecoder(paddle.nn.Decoder):
+        def initialize(self, inits):
+            f = paddle.to_tensor(np.array([[True]]))
+            return paddle.to_tensor([0]), None, f
+
+        def step(self, *a, **kw):
+            raise AssertionError("step must not run when all finished")
+
+    with pytest.raises(ValueError, match="zero steps"):
+        paddle.nn.dynamic_decode(_DoneDecoder(), inits=None,
+                                 max_step_num=5)
