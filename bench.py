@@ -71,11 +71,17 @@ def chip_calibration():
     def tiny(a):
         return jnp.sum(a[:8, :8].astype(jnp.float32))
 
+    # chain length must make COMPUTE dominate the dispatch latency, or
+    # the subtraction bottoms out and the frac reads nonsense (a 20-matmul
+    # chain is ~14ms — under one 90ms congested-tunnel round trip).
+    # 300 matmuls ~ 0.2s at peak: latency-robust within ~5%.
+    N_CHAIN = 300
+
     @jax.jit
     def chain(a, b):
-        o = a
-        for _ in range(20):
-            o = (o @ b).astype(jnp.bfloat16)
+        def body(_, o):
+            return (o @ b).astype(jnp.bfloat16)
+        o = jax.lax.fori_loop(0, N_CHAIN, body, a)
         return jnp.sum(o.astype(jnp.float32))
 
     _readback_sync(tiny(a))
@@ -86,11 +92,11 @@ def chip_calibration():
         lat = min(lat, time.perf_counter() - t0)
     _readback_sync(chain(a, b))
     best = 1e30
-    for _ in range(4):
+    for _ in range(3):
         t0 = time.perf_counter()
         _readback_sync(chain(a, b))
         best = min(best, time.perf_counter() - t0)
-    per = max(best - lat, 1e-6) / 20
+    per = max(best - lat, 1e-6) / N_CHAIN
     return {"dispatch_latency_ms": round(lat * 1e3, 1),
             "matmul_peak_frac": round(2 * 4096 ** 3 / per / 197e12, 4)}
 

@@ -66,6 +66,39 @@ class _CudaNamespace:
             d.block_until_ready()
             break
 
+    @staticmethod
+    def current_stream(device=None):
+        """PJRT owns streams; the module-level singleton keeps identity
+        checks working across calls."""
+        return current_stream(device)
+
+    @staticmethod
+    def stream_guard(stream):
+        from contextlib import nullcontext
+        return nullcontext(stream)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        import collections
+        dev = jax.devices()[0]
+        total = 0
+        try:
+            total = dev.memory_stats().get("bytes_limit", 0)
+        except Exception:
+            pass
+        Props = collections.namedtuple(
+            "_gpuDeviceProperties",
+            ["name", "major", "minor", "total_memory", "multi_processor_count"])
+        return Props(dev.device_kind, 0, 0, total, 1)
+
+    @staticmethod
+    def get_device_name(device=None):
+        return jax.devices()[0].device_kind
+
+    @staticmethod
+    def get_device_capability(device=None):
+        return (0, 0)
+
     class Event:
         def __init__(self, *a, **k):
             pass

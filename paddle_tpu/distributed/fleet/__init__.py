@@ -10,7 +10,12 @@ from .fleet import (Fleet, init, distributed_model,  # noqa: F401
                     distributed_optimizer, get_hybrid_communicate_group,
                     worker_num, worker_index, is_first_worker,
                     barrier_worker, save_persistables, stop_worker,
-                    register_ps_client)
+                    register_ps_client, is_worker, is_server, server_num,
+                    server_index, server_endpoints, worker_endpoints,
+                    init_worker, init_server, run_server,
+                    save_inference_model, UtilBase, util)
+from .base.role_maker import (PaddleCloudRoleMaker,  # noqa: F401
+                              UserDefinedRoleMaker, Role)
 from . import utils  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import elastic  # noqa: F401

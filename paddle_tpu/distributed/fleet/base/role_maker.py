@@ -1,0 +1,102 @@
+"""Role makers (reference: python/paddle/distributed/fleet/base/
+role_maker.py — PaddleCloudRoleMaker parses the launcher env;
+UserDefinedRoleMaker takes explicit placement).
+
+The collective path needs only rank/world (jax.distributed owns the
+actual bootstrap); the PS path carries worker/server roles + endpoint
+lists for the socket parameter server (distributed/ps).
+"""
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id if self.is_worker() else -1
+
+    def server_index(self):
+        return self._current_id if self.is_server() else -1
+
+    def worker_num(self):
+        return self._worker_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Parse the launcher environment (reference env contract:
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / TRAINING_ROLE /
+    PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_TRAINER_ENDPOINTS /
+    POD_IP + PADDLE_PORT)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._worker_endpoints = [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                      "").split(",") if e]
+        self._server_endpoints = [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        if role == "PSERVER":
+            self._role = Role.SERVER
+            ip = os.environ.get("POD_IP", "127.0.0.1")
+            port = os.environ.get("PADDLE_PORT", "0")
+            me = f"{ip}:{port}"
+            if self._server_endpoints and me not in self._server_endpoints:
+                raise ValueError(
+                    f"PSERVER endpoint {me!r} (POD_IP:PADDLE_PORT) is not "
+                    f"in PADDLE_PSERVERS_IP_PORT_LIST "
+                    f"{self._server_endpoints} — misconfigured env")
+            self._current_id = (self._server_endpoints.index(me)
+                                if me in self._server_endpoints else 0)
+        else:
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicit placement (reference: UserDefinedRoleMaker kwargs:
+    current_id, role, worker_num, server_endpoints)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__()
+        self._current_id = int(kwargs.get("current_id", 0))
+        self._role = kwargs.get("role", Role.WORKER)
+        self._worker_num = int(kwargs.get("worker_num", 1))
+        self._server_endpoints = list(kwargs.get("server_endpoints", []))
+        self._worker_endpoints = list(kwargs.get("worker_endpoints", []))

@@ -15,6 +15,54 @@ from ..env import init_parallel_env, get_rank, get_world_size
 _FLEET = {"strategy": None, "hcg": None, "initialized": False}
 
 
+class UtilBase:
+    """reference: fleet/base/util_factory.py UtilBase — small worker-group
+    utilities exposed as fleet.util."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ..collective import all_reduce as _ar, ReduceOp
+        import numpy as _np
+        from ...framework.core import Tensor
+        import jax.numpy as _jnp
+        t = input if isinstance(input, Tensor) else \
+            Tensor(_jnp.asarray(_np.asarray(input)))
+        op = {"sum": ReduceOp.SUM, "min": ReduceOp.MIN,
+              "max": ReduceOp.MAX}[mode]
+        _ar(t, op=op)
+        return _np.asarray(t._value)
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _b
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (reference
+        semantics: len%n remainder spread over the first ranks).  Uses
+        the registered role maker's placement when one exists (PS mode);
+        collective rank/world otherwise."""
+        rm = _FLEET.get("role_maker")
+        if rm is not None:
+            n = max(rm.worker_num(), 1)
+            rank = max(rm.worker_index(), 0)
+        else:
+            n = max(get_world_size(), 1)
+            rank = max(get_rank(), 0)
+        total = len(files)
+        base, rem = divmod(total, n)
+        start = rank * base + min(rank, rem)
+        return list(files[start:start + base + (1 if rank < rem else 0)])
+
+    def print_on_rank(self, message, rank_id=0):
+        if get_rank() == rank_id:
+            print(message)
+
+
 class Fleet:
     def __init__(self):
         pass
@@ -24,6 +72,14 @@ class Fleet:
         if strategy is None:
             strategy = DistributedStrategy()
         _FLEET["strategy"] = strategy
+        if role_maker is None and not is_collective:
+            from .base.role_maker import PaddleCloudRoleMaker
+            role_maker = PaddleCloudRoleMaker(is_collective=False)
+        _FLEET["role_maker"] = role_maker
+        if role_maker is not None and role_maker.is_server():
+            # PS server process: no collective mesh to build
+            _FLEET["initialized"] = True
+            return self
         init_parallel_env()
         h = strategy.hybrid_configs
         n_dev = jax.device_count()
@@ -60,20 +116,86 @@ class Fleet:
                                        _FLEET["hcg"],
                                        strategy or _FLEET["strategy"])
 
+    def _rm(self):
+        return _FLEET.get("role_maker")
+
     def worker_num(self):
-        return get_world_size()
+        rm = self._rm()
+        return rm.worker_num() if rm is not None else get_world_size()
 
     def worker_index(self):
-        return get_rank()
+        rm = self._rm()
+        return rm.worker_index() if rm is not None else get_rank()
 
     def is_first_worker(self):
-        return get_rank() == 0
+        rm = self._rm()
+        return rm.is_first_worker() if rm is not None else get_rank() == 0
 
     def is_worker(self):
-        return True
+        rm = self._rm()
+        return rm.is_worker() if rm is not None else True
 
     def is_server(self):
-        return False
+        rm = self._rm()
+        return rm.is_server() if rm is not None else False
+
+    def server_num(self):
+        rm = self._rm()
+        return rm.server_num() if rm is not None else 0
+
+    def server_index(self):
+        rm = self._rm()
+        return rm.server_index() if rm is not None else -1
+
+    def server_endpoints(self, to_string=False):
+        rm = self._rm()
+        eps = rm.get_pserver_endpoints() if rm is not None else []
+        return ",".join(eps) if to_string else eps
+
+    def worker_endpoints(self, to_string=False):
+        rm = self._rm()
+        eps = rm.get_trainer_endpoints() if rm is not None else []
+        return ",".join(eps) if to_string else eps
+
+    def init_worker(self, scopes=None):
+        """PS mode: connect this worker to the parameter servers
+        (reference: fleet.init_worker starts the brpc client)."""
+        eps = self.server_endpoints()
+        if not eps:
+            return           # collective mode: nothing to connect
+        from ..ps import PSClient
+        client = PSClient(eps)
+        _FLEET["ps_client"] = client
+        return client
+
+    def init_server(self, *args, **kwargs):
+        """PS mode: create this process's parameter-server shard
+        (reference: fleet.init_server loads tables before run)."""
+        from ..ps import PSServer
+        rm = self._rm()
+        host, port = "127.0.0.1", 0
+        if rm is not None and rm.server_index() >= 0 and \
+                rm.get_pserver_endpoints():
+            me = rm.get_pserver_endpoints()[rm.server_index()]
+            host, _, port_s = me.rpartition(":")
+            host, port = host or "127.0.0.1", int(port_s)
+        server = PSServer(port=port, host=host)
+        _FLEET["ps_server"] = server
+        return server
+
+    def run_server(self):
+        """PS mode: serve until stopped (reference: fleet.run_server
+        blocks in the brpc service loop).  PSServer already serves from
+        a daemon thread; block on it."""
+        server = _FLEET.get("ps_server")
+        if server is None:
+            if not self.server_endpoints():
+                raise RuntimeError(
+                    "run_server: no parameter-server endpoints configured "
+                    "(fleet.init with a PS role maker first) — refusing to "
+                    "serve an undiscoverable ephemeral port")
+            server = self.init_server()
+        server._thread.join()
 
     def barrier_worker(self):
         from ..collective import barrier
@@ -107,6 +229,33 @@ class Fleet:
         from ..checkpoint import save_state_dict
         save_state_dict(model.state_dict(), dirname)
 
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True, mode=0):
+        """reference: fleet.save_inference_model — rank-0 writes the
+        pruned inference program (adapter over static
+        save_inference_model; the path contract keeps dirname)."""
+        import os
+        from ...static import (save_inference_model as _sim,
+                               default_main_program)
+        if not self.is_first_worker():
+            return
+        prog = main_program or default_main_program()
+        unknown = [n for n in feeded_var_names
+                   if n not in prog._placeholders]
+        if unknown:
+            raise KeyError(
+                f"save_inference_model: feed names {unknown} are not "
+                f"placeholders of the program "
+                f"(have: {list(prog._placeholders)})")
+        feeds = [prog._placeholders[n] for n in feeded_var_names]
+        _sim(os.path.join(dirname, "model"), feeds, list(target_vars),
+             executor, program=prog)
+
+    @property
+    def util(self):
+        return UtilBase()
+
     def register_ps_client(self, client):
         """Attach a distributed.ps.PSClient so save_persistables /
         stop_worker drive the parameter-server runtime."""
@@ -132,3 +281,14 @@ get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 save_persistables = fleet.save_persistables
 stop_worker = fleet.stop_worker
 register_ps_client = fleet.register_ps_client
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+server_num = fleet.server_num
+server_index = fleet.server_index
+server_endpoints = fleet.server_endpoints
+worker_endpoints = fleet.worker_endpoints
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+save_inference_model = fleet.save_inference_model
+util = UtilBase()

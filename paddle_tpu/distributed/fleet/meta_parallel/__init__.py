@@ -11,6 +11,7 @@ from .parallel_layers import (  # noqa: F401
     ParallelCrossEntropy, get_rng_state_tracker, RNGStatesTracker,
     model_parallel_random_seed)
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
 
 
 def wrap_distributed_model(model, strategy, hcg):
@@ -98,3 +99,25 @@ class HybridParallelOptimizer:
 
     def set_state_dict(self, sd):
         return self._inner.set_state_dict(sd)
+
+
+class ShardingParallel(Layer):
+    """reference: meta_parallel.ShardingParallel — the sharding-axis
+    model wrapper.  Parameters/grads/opt-state shard via the engine's
+    NamedSharding plan (GSPMD inserts the reduce_scatter/allgather the
+    reference codes by hand); the wrapper is the API seam."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        if hcg is not None:
+            from ...engine import plan_from_hcg
+            stage = 1
+            if strategy is not None:
+                stage = (strategy.sharding_configs or {}).get("stage", 1)
+            level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os")
+            self._placement_plan = plan_from_hcg(hcg, level=level)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -13,3 +13,91 @@ def fused_allreduce_gradients(parameter_list, hcg):
         if p._grad is not None:
             g = multihost_utils.process_allgather(p._grad)
             p._grad = g.mean(axis=0)
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS — filesystem client with the
+    fleet checkpoint API shape."""
+
+    def ls_dir(self, fs_path):
+        import os
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        import os
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        import os
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        import os
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        import os
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        import os
+        import shutil
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        import os
+        os.rename(fs_src_path, fs_dst_path)
+
+    def need_upload_download(self):
+        return False
+
+    @staticmethod
+    def _copy(src, dst):
+        import os
+        import shutil
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy(src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        import os
+        if not exist_ok and os.path.exists(fs_path):
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def mv(self, src, dst, overwrite=False, test_exists=False):
+        import os
+        if not os.path.exists(src):
+            raise FileNotFoundError(src)
+        if not overwrite and os.path.exists(dst):
+            raise FileExistsError(dst)
+        os.replace(src, dst)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """reference: fleet/utils/fs.py HDFSClient (hadoop CLI wrapper).
+    No hadoop binary exists in this environment; constructing raises
+    with the documented alternative (LocalFS or a mounted path)."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **kw):
+        raise RuntimeError(
+            "HDFSClient needs a hadoop installation, which this "
+            "environment does not provide; use LocalFS (or mount the "
+            "remote store as a local path)")

@@ -205,9 +205,11 @@ class Tensor:
         import numpy as _np
         opts = dict(_PRINT_OPTS)
         sci = opts.pop("sci_mode")
+        prec = opts["precision"]
         body = _np.array2string(
             _np.asarray(self._value),
-            formatter={"float_kind": (lambda v: f"{v:e}") if sci else None},
+            formatter={"float_kind": (lambda v: f"{v:.{prec}e}")
+                       if sci else None},
             **opts)
         return (f"Tensor(shape={self.shape}, dtype={self._value.dtype}, "
                 f"stop_gradient={self.stop_gradient},\n{body})")

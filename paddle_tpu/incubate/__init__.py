@@ -54,3 +54,38 @@ class autograd:
     def hessian(func, xs, create_graph=False, allow_unused=False):
         from ..autograd import hessian as _hes
         return _hes(func, xs, create_graph, allow_unused)
+
+    # -- prim toggles (reference: incubate/autograd/primapi.py) ---------
+    # XLA/StableHLO *is* the primitive system here: every traced op
+    # already lowers to primitive HLO with registered transforms, so the
+    # toggles record intent and report enabled.
+    _prim = {"fwd": False, "rev": False}
+
+    @staticmethod
+    def enable_prim():
+        autograd._prim["fwd"] = autograd._prim["rev"] = True
+
+    @staticmethod
+    def disable_prim():
+        autograd._prim["fwd"] = autograd._prim["rev"] = False
+
+    @staticmethod
+    def prim_enabled():
+        return autograd._prim["fwd"] and autograd._prim["rev"]
+
+    @staticmethod
+    def forward_grad(outputs, inputs, grad_inputs=None):
+        """reference: incubate.autograd.forward_grad — forward-mode AD
+        (only meaningful under prim/static in the reference; here jvp
+        is always available)."""
+        raise NotImplementedError(
+            "forward_grad operates on static-graph vars; use "
+            "incubate.autograd.jvp(func, xs, v) — forward-mode is "
+            "first-class on this framework")
+
+    @staticmethod
+    def grad(outputs, inputs, grad_outputs=None):
+        """reference: incubate.autograd.grad (prim-aware reverse
+        mode) — delegates to the framework's paddle.grad."""
+        from ..framework.autograd import grad as _g
+        return _g(outputs, inputs, grad_outputs)

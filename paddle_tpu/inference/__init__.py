@@ -46,6 +46,18 @@ class Config:
         self._device = "tpu"
         self._device_id = 0
         self._precision = PrecisionType.Float32
+        # convert_to_mixed_precision leaves a sidecar naming the dtype;
+        # honor it so converted models load at the converted precision
+        if prog_file is not None:
+            import json
+            import os
+            side = prog_file + ".precision.json"
+            if os.path.exists(side):
+                try:
+                    with open(side) as f:
+                        self._precision = json.load(f)["mixed_precision"]
+                except (OSError, KeyError, ValueError):
+                    pass
         self._memory_optim = True
         self._ir_optim = True
         self._cpu_threads = 1
@@ -216,3 +228,39 @@ class Predictor:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+def get_version():
+    """reference: paddle.inference.get_version."""
+    from ..version import full_version
+    return f"paddle_tpu inference {full_version}"
+
+
+def convert_to_mixed_precision(src_model, src_params, dst_model,
+                               dst_params, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: paddle.inference.convert_to_mixed_precision — rewrite
+    a saved model's params to the mixed dtype.  Here the saved artifact
+    keeps f32 params and the predictor casts at load when the Config
+    asks for bf16/f16 (XLA folds the casts), so conversion = copying
+    the artifact and recording the precision in its sidecar."""
+    import json
+    import os
+    import shutil
+    for src, dst in ((src_model, dst_model), (src_params, dst_params)):
+        if src and dst and os.path.exists(src) and src != dst:
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            shutil.copy(src, dst)
+    if not dst_model:
+        raise ValueError("convert_to_mixed_precision needs dst_model to "
+                         "record the converted precision")
+    prefix = dst_model[:-len(".pdmodel")] \
+        if dst_model.endswith(".pdmodel") else dst_model
+    with open(prefix + ".precision.json", "w") as f:
+        json.dump({"mixed_precision": str(mixed_precision or "bfloat16"),
+                   "keep_io_types": bool(keep_io_types),
+                   "black_list": sorted(black_list or [])}, f)
+
+
+__all__ += ["get_version", "convert_to_mixed_precision"]

@@ -175,8 +175,10 @@ class Bilinear(Initializer):
         filt = grids[0]
         for g in grids[1:]:
             filt = np.multiply.outer(filt, g)
-        for i in range(min(shape[0], shape[1])):
-            arr[i, i, ...] = filt
+        # reference semantics: every (out, in) channel pair gets the
+        # filter — the canonical grouped Conv2DTranspose(C, C, k,
+        # groups=C) kernel is (C, 1, k, k) and each channel must upsample
+        arr[...] = filt
         return jnp.asarray(arr, dtype=dtype)
 
 

@@ -44,6 +44,10 @@ def weight_quantize(x, algo="weight_only_int8", group_size=-1):
     """
     if algo not in ("weight_only_int8", "llm.int8"):
         raise ValueError(f"unsupported algo {algo}")
+    if group_size != -1:
+        raise NotImplementedError(
+            "group-wise quantization (group_size != -1) is not "
+            "implemented; only per-output-channel scales")
     w = ensure_tensor(x)
 
     def _q(v):
@@ -75,6 +79,10 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     dtype (no activation quantization)."""
     if weight_dtype != "int8":
         raise NotImplementedError("weight_only_linear: int8 only")
+    if group_size != -1:
+        raise NotImplementedError(
+            "weight_only_linear: group-wise scales (group_size != -1) "
+            "are not implemented")
     x = ensure_tensor(x)
     w, s = ensure_tensor(weight), ensure_tensor(weight_scale)
     ts = [x, w.detach(), s.detach()]

@@ -12,7 +12,22 @@ from enum import Enum
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView"]
+
+
+class SummaryView(Enum):
+    """reference: paddle.profiler.SummaryView — which stats table
+    summary() renders."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
 
 
 class ProfilerTarget(Enum):
@@ -192,7 +207,10 @@ class Profiler:
                 f"(min {arr.min()*1000:.2f}, max {arr.max()*1000:.2f})")
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+                time_unit="ms", views=None):
+        # ``views`` (list of SummaryView) selects tables in the
+        # reference; this profiler renders the one merged host-event
+        # table regardless, so the parameter is accepted for API parity
         lines = ["------------------- Profiler Summary -------------------"]
         by_name = {}
         for e in _collect_events():

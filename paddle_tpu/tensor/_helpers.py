@@ -38,7 +38,22 @@ def unary_op(fn):
 
 
 def binary_op(fn):
+    """Python-scalar operands stay host-side closure constants instead of
+    device arrays: device-materializing a scalar costs an HBM upload, and
+    ops that inspect static ints (e.g. jnp.power's integer-exponent path
+    calling __index__) would otherwise force a blocking device READBACK
+    per call — ~dispatch-latency each through the axon tunnel.  Weak
+    scalar typing is also the correct jnp promotion (a float scalar must
+    not upcast a bf16 tensor)."""
     def op(x, y, name=None):
+        y_scalar = isinstance(y, (int, float, complex)) \
+            and not isinstance(y, bool)
+        x_scalar = isinstance(x, (int, float, complex)) \
+            and not isinstance(x, bool)
+        if y_scalar and not x_scalar:
+            return call_op(lambda v: fn(v, y), ensure_tensor(x))
+        if x_scalar and not y_scalar:
+            return call_op(lambda v: fn(x, v), ensure_tensor(y))
         return call_op(fn, ensure_tensor(x), ensure_tensor(y))
     return op
 
