@@ -74,8 +74,7 @@ class _CudaNamespace:
 
     @staticmethod
     def stream_guard(stream):
-        from contextlib import nullcontext
-        return nullcontext(stream)
+        return stream_guard(stream)   # module-level guard (sets current)
 
     @staticmethod
     def get_device_properties(device=None):

@@ -254,7 +254,7 @@ class Fleet:
 
     @property
     def util(self):
-        return UtilBase()
+        return util          # the module-level singleton (bottom of file)
 
     def register_ps_client(self, client):
         """Attach a distributed.ps.PSClient so save_persistables /

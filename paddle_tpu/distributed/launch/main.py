@@ -34,7 +34,15 @@ def _parse():
                    help="workers per host (1 on TPU: SPMD drives all chips)")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--log_dir", type=str, default="log")
-    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--run_mode", type=str, default="collective",
+                   help="collective | ps")
+    p.add_argument("--server_num", type=int, default=0,
+                   help="PS mode: number of parameter servers to spawn")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="PS mode: number of trainer workers to spawn")
+    p.add_argument("--servers", type=str, default="",
+                   help="PS mode: comma list of host:port server endpoints"
+                        " (default 127.0.0.1 with sequential ports)")
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    help="accepted for compat; chip selection is automatic")
     p.add_argument("script", type=str)
@@ -121,8 +129,136 @@ def _elastic_membership(elastic, args):
             "endpoints": [members[i] for i in ids]}
 
 
+def _launch_ps(args):
+    """PS-mode controller (reference: launch/controllers/ps.py): spawn
+    ``server_num`` PSERVER processes + ``trainer_num`` TRAINER processes
+    with the PADDLE_* role env, watch, restart trainers on failure
+    (servers are stateful — a dead server fails the job)."""
+    import socket
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    n_srv = args.server_num or 1
+    n_trn = args.trainer_num if args.trainer_num is not None else 1
+    if args.servers:
+        endpoints = [e for e in args.servers.split(",") if e]
+    else:
+        # hold every probe socket until all ports are drawn, or the
+        # kernel can hand the same ephemeral port out twice
+        probes = []
+        endpoints = []
+        for _ in range(n_srv):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            probes.append(s)
+            endpoints.append(f"127.0.0.1:{s.getsockname()[1]}")
+        for s in probes:
+            s.close()
+    ep_list = ",".join(endpoints)
+    procs, logs, restarts = {}, {}, {}
+
+    def start(kind, idx):
+        key = (kind, idx)
+        log_path = os.path.join(args.log_dir, f"{kind}log.{idx}")
+        if key in logs:
+            logs[key].close()        # restart: don't leak the old handle
+        logf = open(log_path, "ab", buffering=0)
+        logs[key] = logf
+        env = dict(os.environ)
+        # scrub any collective-mode env leaked from the parent (a PS
+        # worker inheriting PADDLE_MASTER/TRAINER_ENDPOINTS would try a
+        # collective rendezvous nobody is serving)
+        for stale in ("PADDLE_MASTER", "PADDLE_TRAINER_ENDPOINTS",
+                      "PADDLE_CURRENT_ENDPOINT", "PADDLE_NODE_RANK",
+                      "PADDLE_LOCAL_RANK", "PADDLE_TRAINER_ID",
+                      "TRAINING_ROLE", "POD_IP", "PADDLE_PORT"):
+            env.pop(stale, None)
+        env["PADDLE_PSERVERS_IP_PORT_LIST"] = ep_list
+        env["PADDLE_TRAINERS_NUM"] = str(n_trn)
+        if kind == "server":
+            host, _, port = endpoints[idx].rpartition(":")
+            env["TRAINING_ROLE"] = "PSERVER"
+            env["POD_IP"] = host or "127.0.0.1"
+            env["PADDLE_PORT"] = port
+        else:
+            env["TRAINING_ROLE"] = "TRAINER"
+            env["PADDLE_TRAINER_ID"] = str(idx)
+        cmd = [sys.executable, args.script] + args.script_args
+        p = subprocess.Popen(cmd, env=env, stdout=logf,
+                             stderr=subprocess.STDOUT)
+        procs[key] = p
+        restarts.setdefault(key, 0)
+        print(f"[launch] started {kind} {idx} pid={p.pid} log={log_path}",
+              flush=True)
+
+    def stop_all(code):
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        t0 = time.time()
+        while any(p.poll() is None for p in procs.values()) and \
+                time.time() - t0 < 10:
+            time.sleep(0.2)
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        sys.exit(code)
+
+    for i in range(n_srv):
+        start("server", i)
+    for i in range(n_trn):
+        start("trainer", i)
+
+    while True:
+        trainers_alive = 0
+        for (kind, idx), p in list(procs.items()):
+            ret = p.poll()
+            if ret is None:
+                if kind == "trainer":
+                    trainers_alive += 1
+                continue
+            if kind == "server":
+                # ANY server exit while trainers still run is fatal —
+                # rc==0 (script forgot run_server) strands trainers on a
+                # dead endpoint with a misleading eventual diagnosis
+                print(f"[launch] server {idx} exited rc={ret} before the "
+                      "trainers finished; aborting", flush=True)
+                stop_all(1)
+            if kind == "trainer" and ret != 0:
+                key = (kind, idx)
+                if restarts[key] < args.max_restart:
+                    restarts[key] += 1
+                    print(f"[launch] trainer {idx} exited rc={ret}; "
+                          f"restart {restarts[key]}/{args.max_restart}",
+                          flush=True)
+                    start("trainer", idx)
+                    trainers_alive += 1
+                else:
+                    print(f"[launch] trainer {idx} failed rc={ret}; "
+                          "giving up", flush=True)
+                    stop_all(1)
+        if trainers_alive == 0 and \
+                all(p.poll() is not None or k[0] == "server"
+                    for k, p in procs.items()):
+            # every trainer finished cleanly: job done, retire servers
+            print("[launch] all trainers finished; stopping servers",
+                  flush=True)
+            for (kind, _), p in procs.items():
+                if kind == "server" and p.poll() is None:
+                    p.terminate()
+            for p in procs.values():
+                if p.poll() is None:
+                    p.wait()
+            return
+        time.sleep(0.5)
+
+
 def main():
     args = _parse()
+    if args.run_mode == "ps" or args.server_num > 0:
+        _launch_ps(args)
+        return
     os.makedirs(args.log_dir, exist_ok=True)
     procs = {}
     restarts = {i: 0 for i in range(args.nproc_per_node)}
@@ -141,6 +277,8 @@ def main():
 
     def start(local_rank):
         log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        if local_rank in logs:
+            logs[local_rank].close()  # restart: don't leak the old handle
         logf = open(log_path, "ab", buffering=0)
         logs[local_rank] = logf
         cmd = [sys.executable, args.script] + args.script_args

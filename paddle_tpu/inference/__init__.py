@@ -241,17 +241,38 @@ def convert_to_mixed_precision(src_model, src_params, dst_model,
                                backend=None, keep_io_types=True,
                                black_list=None, **kwargs):
     """reference: paddle.inference.convert_to_mixed_precision — rewrite
-    a saved model's params to the mixed dtype.  Here the saved artifact
-    keeps f32 params and the predictor casts at load when the Config
-    asks for bf16/f16 (XLA folds the casts), so conversion = copying
-    the artifact and recording the precision in its sidecar."""
+    a saved model's params to the mixed dtype.
+
+    Envelope note (differs from the reference): a jax.export artifact's
+    EXECUTION dtypes are fixed at export time, so this converts the
+    stored params payload (disk / transfer size halves for bf16) and
+    jit.load casts back to the exported program's dtypes at load.  For
+    actual bf16 execution, export the model under ``amp.decorate`` —
+    on TPU that is the native precision path.
+    """
     import json
     import os
+    import pickle as _pkl
     import shutil
+    import numpy as _np
     for src, dst in ((src_model, dst_model), (src_params, dst_params)):
         if src and dst and os.path.exists(src) and src != dst:
             os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
             shutil.copy(src, dst)
+    target = str(mixed_precision or "bfloat16")
+    if dst_params and os.path.exists(dst_params):
+        import jax.numpy as _jnp
+        with open(dst_params, "rb") as f:
+            meta = _pkl.load(f)
+        black = set(black_list or [])
+        for group in ("params", "buffers"):
+            for name, arr in list(meta.get(group, {}).items()):
+                a = _np.asarray(arr)
+                if a.dtype == _np.float32 and name not in black:
+                    meta[group][name] = _np.asarray(
+                        _jnp.asarray(a).astype(target))
+        with open(dst_params, "wb") as f:
+            _pkl.dump(meta, f)
     if not dst_model:
         raise ValueError("convert_to_mixed_precision needs dst_model to "
                          "record the converted precision")

@@ -358,9 +358,30 @@ def save(layer, path, input_spec=None, **configs):
             for t, v in zip(params + buffers, olds):
                 t._value = v
 
-    arg_shapes = [jax.ShapeDtypeStruct(
-        tuple(1 if d is None else d for d in s.shape), s.dtype)
-        for s in specs]
+    # None dims export as SYMBOLIC dimensions (shape polymorphism): the
+    # loaded artifact then serves any batch size, like the reference's
+    # -1 dims in a saved program.  A leading None is the BATCH dim and
+    # shares one symbol across all inputs (multi-input models constrain
+    # their batches equal); non-leading Nones get their own variables.
+    n_sym = 0
+    scope = jax.export.SymbolicScope()   # one scope for every input
+    arg_shapes = []
+    for s in specs:
+        dims = []
+        has_sym = False
+        for i, d in enumerate(s.shape):
+            if d is None:
+                dims.append("batch" if i == 0 else f"d{n_sym}")
+                n_sym += i != 0
+                has_sym = True
+            else:
+                dims.append(str(int(d)))
+        if has_sym:
+            shape = jax.export.symbolic_shape(
+                "(" + ", ".join(dims) + ")", scope=scope)
+        else:
+            shape = tuple(int(d) for d in s.shape)
+        arg_shapes.append(jax.ShapeDtypeStruct(shape, s.dtype))
     pv = [p._value for p in params]
     bv = [b._value for b in buffers]
     # single trace: jax.export carries both the portable executable bytes
@@ -421,6 +442,22 @@ def load(path, params_path=None, **configs):
     params = [jnp.asarray(meta["params"][n]) for n in meta["param_names"]]
     buffers = [jnp.asarray(meta["buffers"][n]) for n in meta["buffer_names"]]
     blob = meta.get("exported")
+    if blob is not None:
+        # the exported program's input avals fix the execution dtypes;
+        # params stored in a different precision (e.g. a bf16-converted
+        # artifact — inference.convert_to_mixed_precision) cast back here
+        try:
+            avals = jax.export.deserialize(bytearray(blob)).in_avals
+            flat = list(avals)
+            n_p = len(params)
+            params = [p if p.dtype == flat[i].dtype
+                      else p.astype(flat[i].dtype)
+                      for i, p in enumerate(params)]
+            buffers = [b if b.dtype == flat[n_p + j].dtype
+                       else b.astype(flat[n_p + j].dtype)
+                       for j, b in enumerate(buffers)]
+        except Exception:
+            pass
     if blob is None:
         raise ValueError(
             f"{path}.pdiparams has no serialized executable — re-save the "

@@ -22,8 +22,8 @@ def _fail():
 
 
 class TestSpawn:
-    def test_inline_single(self, tmp_path):
-        os.environ["PADDLE_TRAINER_ID"] = "0"
+    def test_inline_single(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
         spawn(_write_rank, args=(str(tmp_path),), nprocs=1)
         assert (tmp_path / "rank0.txt").exists()
 
