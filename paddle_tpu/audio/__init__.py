@@ -9,5 +9,7 @@ constants (host-side, trace-free).
 from . import functional  # noqa: F401
 from . import datasets  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from .backends import load, save, info  # noqa: F401
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "load", "save", "info"]

@@ -1,0 +1,120 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends —
+soundfile-backed load/save/info with a pluggable backend registry).
+
+Offline environment: no soundfile/librosa, so the built-in backend is
+the stdlib ``wave`` module (PCM WAV, 16/24/32-bit int + float via
+scaling).  The registry API is kept so a soundfile backend can be
+registered when available.
+"""
+import wave as _wave
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["get_current_audio_backend", "list_available_backends",
+           "set_backend", "load", "save", "info", "AudioInfo"]
+
+_BACKEND = ["wave"]
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_audio_backend():
+    return _BACKEND[0]
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise ValueError(
+            f"unknown audio backend {backend_name!r}; available: "
+            f"{list_available_backends()} (soundfile is not installed "
+            "in this environment)")
+    _BACKEND[0] = backend_name
+
+
+class AudioInfo:
+    """reference: paddle.audio.backends AudioInfo record."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def info(filepath, format=None):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True, format=None):
+    """WAV -> (Tensor, sample_rate).  ``normalize`` scales ints to
+    [-1, 1] float32 (the reference default)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n_ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, dtype="<i2").astype(np.float32)
+        scale = 32768.0
+    elif width == 4:
+        data = np.frombuffer(raw, dtype="<i4").astype(np.float32)
+        scale = 2147483648.0
+    elif width == 1:
+        data = np.frombuffer(raw, dtype=np.uint8).astype(np.float32) - 128.0
+        scale = 128.0
+    elif width == 3:
+        b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3)
+        data = ((b[:, 0].astype(np.int32))
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = np.where(data >= 1 << 23, data - (1 << 24),
+                        data).astype(np.float32)
+        scale = float(1 << 23)
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    data = data.reshape(-1, n_ch)
+    if normalize:
+        data = data / scale
+    out = data.T if channels_first else data
+    return Tensor(jnp.asarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16, format=None, encoding=None):
+    """(Tensor|(C, T)/(T, C) array) -> PCM WAV."""
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        # 1-D waveform: one channel regardless of channels_first
+        arr = arr[None, :]
+    elif not channels_first:
+        arr = arr.T
+    if bits_per_sample != 16:
+        raise NotImplementedError("save: 16-bit PCM only")
+    if np.issubdtype(arr.dtype, np.floating):
+        pcm = np.clip(np.round(arr * 32767.0), -32768, 32767) \
+            .astype("<i2")
+    else:
+        pcm = arr.astype("<i2")
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(pcm.shape[0])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.T.tobytes())

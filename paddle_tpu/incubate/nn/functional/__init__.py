@@ -516,3 +516,127 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False,
 
 
 __all__ += ["fused_matmul_bias"]
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """reference: incubate.nn.functional.blha_get_max_len — max
+    encoder/decoder lengths feeding block_multihead_attention's
+    scheduling."""
+    enc = ensure_tensor(seq_lens_encoder).detach()
+    dec = ensure_tensor(seq_lens_decoder).detach()
+    mx = lambda v: jnp.max(v.reshape(-1)) if v.size else jnp.asarray(0)
+    return (call_op(mx, enc), call_op(mx, dec))
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets=None, cum_offsets=None,
+        cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
+        pre_key_cache=None, pre_value_cache=None, rope_emb=None,
+        mask=None, tgt_mask=None, max_seq_len=-1, block_size=64,
+        use_neox_style=False, name=None, **unsupported):
+    """reference: incubate.nn.functional.block_multihead_attention —
+    mixed prefill/decode attention over a PAGED (block) KV cache.
+
+    Contract implemented: qkv (total_tokens, 3*H*D) packs every batch
+    row's tokens this step; row b is a PREFILL of seq_lens_encoder[b]
+    tokens or a DECODE of one token over seq_lens_decoder[b] cached
+    ones; block_tables (B, max_blocks) maps logical KV positions into
+    key/value_cache (num_blocks, H, block_size, D).  Returns
+    (out, qkv, key_cache, value_cache) with the caches UPDATED.
+
+    Envelope: host-scheduled per-request attention (correctness-level
+    paged cache; the TPU fast paths are
+    variable_length_memory_efficient_attention for prefill and
+    masked_multihead_attention for decode).  Rope / neox / quant-cache
+    knobs raise.
+    """
+    import math as _math
+    if rope_emb is not None or use_neox_style:
+        raise NotImplementedError(
+            "block_multihead_attention: apply rotary embeddings to qkv "
+            "before the call")
+    extra = {k: v for k, v in unsupported.items() if v is not None}
+    if pre_key_cache is not None or extra:
+        raise NotImplementedError(
+            "block_multihead_attention: unsupported arguments "
+            f"{['pre_key_cache'] if pre_key_cache is not None else []}"
+            f"{sorted(extra)} (pre-cache / quantized-cache / scale knobs "
+            "are not implemented)")
+    if block_tables is None:
+        raise ValueError("block_multihead_attention needs block_tables")
+
+    qkv_t = ensure_tensor(qkv)
+    kc = ensure_tensor(key_cache)
+    vc = ensure_tensor(value_cache)
+    enc = np.asarray(ensure_tensor(seq_lens_encoder)._value).reshape(-1)
+    dec = np.asarray(ensure_tensor(seq_lens_decoder)._value).reshape(-1)
+    this = np.asarray(ensure_tensor(seq_lens_this_time)._value).reshape(-1)
+    bt = np.asarray(ensure_tensor(block_tables)._value)
+    B = bt.shape[0]
+    n_blocks, H, bs, D = kc.shape
+    mask_t = ensure_tensor(mask).detach() if mask is not None else None
+
+    def _run(qkv_v, kc_v, vc_v, *maybe_mask):
+        total = qkv_v.shape[0]
+        q3 = qkv_v.reshape(total, 3, H, D)
+        outs = []
+        tok = 0
+        kc_new, vc_new = kc_v, vc_v
+        for b in range(B):
+            n_this = int(this[b])
+            if n_this == 0:
+                continue
+            qb = q3[tok:tok + n_this, 0]          # (n, H, D)
+            kb = q3[tok:tok + n_this, 1]
+            vb = q3[tok:tok + n_this, 2]
+            start = 0 if int(enc[b]) else int(dec[b])
+            # write new k/v into the paged cache at [start, start+n):
+            # ONE batched scatter (per-token .at updates would be O(L)
+            # dispatches)
+            new_pos = np.arange(start, start + n_this)
+            nblk = jnp.asarray(bt[b, new_pos // bs].astype(np.int32))
+            noff = jnp.asarray((new_pos % bs).astype(np.int32))
+            kc_new = kc_new.at[nblk, :, noff, :].set(kb)
+            vc_new = vc_new.at[nblk, :, noff, :].set(vb)
+            # gather the full valid prefix [0, start+n) back out — one
+            # fancy-index gather
+            L = start + n_this
+            all_pos = np.arange(L)
+            blks = jnp.asarray(bt[b, all_pos // bs].astype(np.int32))
+            offs = jnp.asarray((all_pos % bs).astype(np.int32))
+            keys = kc_new[blks, :, offs, :]                    # (L, H, D)
+            vals = vc_new[blks, :, offs, :]
+            scores = jnp.einsum("nhd,lhd->hnl", qb, keys) \
+                / _math.sqrt(D)
+            # causal within this request: query i may see [0, start+i]
+            qpos = start + jnp.arange(n_this)[None, :, None]
+            kpos = jnp.arange(L)[None, None, :]
+            cm = kpos <= qpos
+            scores = jnp.where(cm, scores, -1e9)
+            if maybe_mask:
+                mv = maybe_mask[0]
+                if mv.ndim != 4:
+                    raise ValueError(
+                        "block_multihead_attention: mask must be "
+                        "(B, H|1, max_q, max_kv) additive")
+                scores = scores + mv[b, :, :n_this, :L].astype(
+                    scores.dtype)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ob = jnp.einsum("hnl,lhd->nhd", probs, vals)
+            outs.append(ob.reshape(n_this, H * D))
+            tok += n_this
+        out = jnp.concatenate(outs, 0) if outs else \
+            jnp.zeros((0, H * D), qkv_v.dtype)
+        return out.astype(qkv_v.dtype), kc_new, vc_new
+
+    args = [qkv_t, kc.detach(), vc.detach()]
+    if mask_t is not None:
+        args.append(mask_t)
+    res = call_op(_run, *args)
+    out, kc_out, vc_out = res
+    return out, qkv_t, kc_out, vc_out
+
+
+__all__ += ["blha_get_max_len", "block_multihead_attention"]

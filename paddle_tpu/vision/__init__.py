@@ -2,3 +2,30 @@ from . import models  # noqa: F401
 from . import datasets  # noqa: F401
 from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_IMAGE_BACKEND = ["pil"]
+
+
+def get_image_backend():
+    """reference: paddle.vision.get_image_backend."""
+    return _IMAGE_BACKEND[0]
+
+
+def set_image_backend(backend):
+    """reference: paddle.vision.set_image_backend — 'pil' or 'cv2';
+    only PIL ships in this environment."""
+    if backend not in ("pil",):
+        raise ValueError(
+            f"unsupported image backend {backend!r}: only 'pil' is "
+            "available here (cv2 is not installed)")
+    _IMAGE_BACKEND[0] = backend
+
+
+def image_load(path, backend=None):
+    """reference: paddle.vision.image_load — PIL.Image for the pil
+    backend."""
+    from PIL import Image
+    if backend not in (None, "pil"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    return Image.open(path)

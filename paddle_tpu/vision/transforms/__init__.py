@@ -14,7 +14,7 @@ __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "ColorJitter", "Grayscale", "RandomResizedCrop", "RandomErasing",
            "RandomAffine", "RandomPerspective", "perspective", "crop", "center_crop", "adjust_brightness",
            "adjust_contrast", "adjust_saturation", "adjust_hue",
-           "to_grayscale", "erase", "rotate"]
+           "to_grayscale", "erase", "rotate", "pad", "affine"]
 
 
 def _np_img(img):
@@ -777,3 +777,61 @@ class RandAugment(BaseTransform):
             op = ops[np.random.randint(0, len(ops))]
             out = op(out)
         return out.astype(np.asarray(arr).dtype)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """reference: paddle.vision.transforms.pad (functional).  padding:
+    int | (pad_lr, pad_tb) | (l, t, r, b)."""
+    arr = _np_img(img)
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l, t = int(padding[0]), int(padding[1])
+        r, b = l, t
+    else:
+        l, t, r, b = (int(v) for v in padding)
+    spec = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        if isinstance(fill, (tuple, list)):
+            # per-channel fill (reference supports a length-C tuple):
+            # pad each channel plane with its own constant
+            if arr.ndim != 3 or len(fill) != arr.shape[2]:
+                raise ValueError(
+                    f"tuple fill needs an HWC image with C == "
+                    f"{len(fill)}")
+            planes = [np.pad(arr[..., c], spec[:2],
+                             constant_values=fill[c])
+                      for c in range(arr.shape[2])]
+            return np.stack(planes, axis=2)
+        return np.pad(arr, spec, constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}.get(padding_mode)
+    if mode is None:
+        raise ValueError(f"unknown padding_mode {padding_mode}")
+    return np.pad(arr, spec, mode=mode)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference: paddle.vision.transforms.affine (functional) — apply
+    the composed rotation/translation/scale/shear by warping the four
+    corners through the shared homography helper (`perspective`)."""
+    arr = _np_img(img)
+    h, w = arr.shape[:2]
+    cx, cy = (w * 0.5, h * 0.5) if center is None else center
+    a = np.deg2rad(angle)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward RSS matrix (reference convention: +tan shear; the
+    # output->input inversion happens inside `perspective`, which takes
+    # these corners as startpoints)
+    rot = np.array([[np.cos(a), -np.sin(a)], [np.sin(a), np.cos(a)]])
+    shm = np.array([[1.0, np.tan(sx)], [np.tan(sy), 1.0]])
+    m = scale * (rot @ shm)
+    corners = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                       np.float64)
+    centered = corners - [cx, cy]
+    warped = centered @ m.T + [cx, cy] + np.asarray(translate, np.float64)
+    return perspective(arr, corners.tolist(), warped.tolist(),
+                       interpolation=interpolation, fill=fill)

@@ -1,0 +1,212 @@
+"""Round-4e: vision functional pad/affine, audio WAV IO, image backend,
+paged block attention serving ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_transforms_functional_pad():
+    import paddle_tpu.vision.transforms as T
+    img = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+    assert T.pad(img, 1).shape == (6, 6, 3)
+    assert T.pad(img, (1, 2)).shape == (8, 6, 3)
+    assert T.pad(img, (1, 2, 3, 4)).shape == (10, 8, 3)
+    np.testing.assert_array_equal(T.pad(img, 1, fill=7)[0, 0], [7, 7, 7])
+    edge = T.pad(img, 1, padding_mode="edge")
+    np.testing.assert_array_equal(edge[0, 1], img[0, 0])
+    with pytest.raises(ValueError):
+        T.pad(img, 1, padding_mode="weird")
+
+
+def test_transforms_functional_affine_rotation():
+    import paddle_tpu.vision.transforms as T
+    img = np.zeros((5, 5), np.float32)
+    img[1, 2] = 1.0                        # one pixel above center
+    out = T.affine(img, angle=0, translate=(1, 0), scale=1.0, shear=0)
+    assert out.shape == (5, 5)
+    # pure translation moves the pixel right by 1
+    assert out[1, 3] == 1.0
+    ident = T.affine(img, angle=0, translate=(0, 0), scale=1.0, shear=0)
+    np.testing.assert_allclose(ident, img)
+
+
+def test_audio_wav_roundtrip(tmp_path):
+    sr = 8000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)[None]
+    p = str(tmp_path / "a.wav")
+    paddle.audio.save(p, paddle.to_tensor(wav), sr)
+    info = paddle.audio.info(p)
+    assert info.sample_rate == sr and info.num_channels == 1
+    assert info.bits_per_sample == 16
+    loaded, sr2 = paddle.audio.load(p)
+    assert sr2 == sr
+    np.testing.assert_allclose(loaded.numpy(), wav, atol=1e-3)
+    # offset/num_frames window
+    part, _ = paddle.audio.load(p, frame_offset=100, num_frames=50)
+    np.testing.assert_allclose(part.numpy(), wav[:, 100:150], atol=1e-3)
+    # channels_first=False
+    tc, _ = paddle.audio.load(p, channels_first=False)
+    assert tc.shape == [sr, 1]
+
+
+def test_audio_backend_registry():
+    b = paddle.audio.backends
+    assert b.get_current_audio_backend() == "wave"
+    assert "wave" in b.list_available_backends()
+    with pytest.raises(ValueError):
+        b.set_backend("soundfile")
+
+
+def test_image_backend(tmp_path):
+    from PIL import Image
+    assert paddle.vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("cv2")
+    p = str(tmp_path / "i.png")
+    Image.fromarray(np.zeros((4, 6, 3), np.uint8)).save(p)
+    img = paddle.vision.image_load(p)
+    assert img.size == (6, 4)
+
+
+# -- paged block attention --------------------------------------------------
+
+def _dense_causal(q, k, v, D):
+    s = np.einsum("nhd,lhd->hnl", q, k) / np.sqrt(D)
+    n, L = s.shape[1], s.shape[2]
+    cm = np.arange(L)[None, None, :] <= \
+        (L - n + np.arange(n))[None, :, None]
+    s = np.where(cm, s, -1e9)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("hnl,lhd->nhd", p, v)
+
+
+def test_blha_get_max_len():
+    F = paddle.incubate.nn.functional
+    me, md = F.blha_get_max_len(paddle.to_tensor([5, 3]),
+                                paddle.to_tensor([0, 7]), 2)
+    assert int(me) == 5 and int(md) == 7
+
+
+def test_block_multihead_attention_prefill_then_decode():
+    F = paddle.incubate.nn.functional
+    rs = np.random.RandomState(0)
+    B, H, D, bs, n_blocks = 2, 2, 8, 4, 8
+    enc = np.array([5, 3])
+    dec = np.zeros(2, np.int64)
+    this = np.array([5, 3])
+    qkv = rs.randn(8, 3 * H * D).astype(np.float32)
+    kc = paddle.to_tensor(np.zeros((n_blocks, H, bs, D), np.float32))
+    vc = paddle.to_tensor(np.zeros((n_blocks, H, bs, D), np.float32))
+    bt = paddle.to_tensor(np.array([[0, 1, -1], [2, 3, -1]]))
+    out, _, kc, vc = F.block_multihead_attention(
+        paddle.to_tensor(qkv), kc, vc, paddle.to_tensor(enc),
+        paddle.to_tensor(dec), paddle.to_tensor(this),
+        block_tables=bt, block_size=bs)
+    q3 = qkv.reshape(8, 3, H, D)
+    ref0 = _dense_causal(q3[:5, 0], q3[:5, 1], q3[:5, 2], D) \
+        .reshape(5, H * D)
+    ref1 = _dense_causal(q3[5:, 0], q3[5:, 1], q3[5:, 2], D) \
+        .reshape(3, H * D)
+    np.testing.assert_allclose(out.numpy()[:5], ref0, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(out.numpy()[5:], ref1, rtol=2e-2, atol=2e-2)
+
+    # decode step: one new token per row over the paged cache
+    qkv2 = rs.randn(2, 3 * H * D).astype(np.float32)
+    out2, _, kc, vc = F.block_multihead_attention(
+        paddle.to_tensor(qkv2), kc, vc,
+        paddle.to_tensor(np.zeros(2, np.int64)),
+        paddle.to_tensor(np.array([5, 3])),
+        paddle.to_tensor(np.array([1, 1])),
+        block_tables=bt, block_size=bs)
+    q3b = qkv2.reshape(2, 3, H, D)
+    kall = np.concatenate([q3[:5, 1], q3b[0:1, 1]], 0)
+    vall = np.concatenate([q3[:5, 2], q3b[0:1, 2]], 0)
+    ref_d = _dense_causal(q3b[0:1, 0], kall, vall, D).reshape(1, H * D)
+    np.testing.assert_allclose(out2.numpy()[:1], ref_d, rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_block_multihead_attention_rejects_unsupported():
+    F = paddle.incubate.nn.functional
+    with pytest.raises(ValueError):
+        F.block_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 6), np.float32)), None, None,
+            paddle.to_tensor([1]), paddle.to_tensor([0]),
+            paddle.to_tensor([1]))
+    with pytest.raises(NotImplementedError):
+        F.block_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 6), np.float32)), None, None,
+            paddle.to_tensor([1]), paddle.to_tensor([0]),
+            paddle.to_tensor([1]),
+            block_tables=paddle.to_tensor([[0]]), rope_emb=object())
+
+
+# -- review-fix regressions (r4e review) ------------------------------------
+
+def test_block_mha_additive_mask_semantics():
+    F = paddle.incubate.nn.functional
+    rs = np.random.RandomState(1)
+    H, D, bs = 1, 4, 4
+    qkv = rs.randn(3, 3 * H * D).astype(np.float32)
+    kc = paddle.to_tensor(np.zeros((2, H, bs, D), np.float32))
+    vc = paddle.to_tensor(np.zeros((2, H, bs, D), np.float32))
+    bt = paddle.to_tensor(np.array([[0, 1]]))
+    args = (paddle.to_tensor(qkv), kc, vc, paddle.to_tensor([3]),
+            paddle.to_tensor([0]), paddle.to_tensor([3]))
+    out_nomask, _, _, _ = F.block_multihead_attention(
+        *args, block_tables=bt, block_size=bs)
+    # an all-zero ADDITIVE mask must be a no-op
+    zmask = paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32))
+    out_zmask, _, _, _ = F.block_multihead_attention(
+        *args, block_tables=bt, block_size=bs, mask=zmask)
+    np.testing.assert_allclose(out_nomask.numpy(), out_zmask.numpy(),
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="additive"):
+        F.block_multihead_attention(
+            *args, block_tables=bt, block_size=bs,
+            mask=paddle.to_tensor(np.zeros((3, 3), np.float32)))
+
+
+def test_block_mha_rejects_unknown_kwargs():
+    F = paddle.incubate.nn.functional
+    with pytest.raises(NotImplementedError, match="qkv_out_scale"):
+        F.block_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 12), np.float32)),
+            paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32)),
+            paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32)),
+            paddle.to_tensor([1]), paddle.to_tensor([0]),
+            paddle.to_tensor([1]),
+            block_tables=paddle.to_tensor([[0]]),
+            qkv_out_scale=1.0)
+
+
+def test_audio_save_1d_channels_last(tmp_path):
+    p = str(tmp_path / "m.wav")
+    paddle.audio.save(p, np.zeros(100, np.float32), 8000,
+                      channels_first=False)
+    info = paddle.audio.info(p)
+    assert info.num_channels == 1 and info.num_samples == 100
+
+
+def test_pad_per_channel_fill():
+    import paddle_tpu.vision.transforms as T
+    img = np.zeros((2, 2, 3), np.uint8)
+    out = T.pad(img, 1, fill=(255, 7, 3))
+    np.testing.assert_array_equal(out[0, 0], [255, 7, 3])
+    with pytest.raises(ValueError):
+        T.pad(np.zeros((2, 2), np.uint8), 1, fill=(1, 2, 3))
+
+
+def test_affine_shear_direction():
+    import paddle_tpu.vision.transforms as T
+    img = np.zeros((7, 7), np.float32)
+    img[1, 3] = 1.0                    # above center
+    out = T.affine(img, angle=0, translate=(0, 0), scale=1.0, shear=30.0)
+    ys, xs = np.nonzero(out > 0.25)
+    # +x shear moves content ABOVE center toward +x... reference
+    # convention: forward matrix [[1, tan], [0, 1]] maps (x, y)->(x+ty, y)
+    # with y measured from center (negative above) -> moves LEFT above
+    assert xs.min() < 3, (ys, xs)
