@@ -56,8 +56,10 @@ class AudioInfo:
 
 def info(filepath, format=None):
     with _wave.open(filepath, "rb") as f:
+        width = f.getsampwidth()
         return AudioInfo(f.getframerate(), f.getnframes(),
-                         f.getnchannels(), f.getsampwidth() * 8)
+                         f.getnchannels(), width * 8,
+                         encoding="PCM_U" if width == 1 else "PCM_S")
 
 
 def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
@@ -72,27 +74,31 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
         n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(n)
     if width == 2:
-        data = np.frombuffer(raw, dtype="<i2").astype(np.float32)
+        raw_i = np.frombuffer(raw, dtype="<i2")
         scale = 32768.0
     elif width == 4:
-        data = np.frombuffer(raw, dtype="<i4").astype(np.float32)
+        raw_i = np.frombuffer(raw, dtype="<i4")
         scale = 2147483648.0
     elif width == 1:
-        data = np.frombuffer(raw, dtype=np.uint8).astype(np.float32) - 128.0
+        raw_i = np.frombuffer(raw, dtype=np.uint8)
         scale = 128.0
     elif width == 3:
         b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3)
-        data = ((b[:, 0].astype(np.int32))
-                | (b[:, 1].astype(np.int32) << 8)
-                | (b[:, 2].astype(np.int32) << 16))
-        data = np.where(data >= 1 << 23, data - (1 << 24),
-                        data).astype(np.float32)
+        v = ((b[:, 0].astype(np.int32))
+             | (b[:, 1].astype(np.int32) << 8)
+             | (b[:, 2].astype(np.int32) << 16))
+        raw_i = np.where(v >= 1 << 23, v - (1 << 24), v).astype(np.int32)
         scale = float(1 << 23)
     else:
         raise ValueError(f"unsupported WAV sample width {width}")
-    data = data.reshape(-1, n_ch)
     if normalize:
-        data = data / scale
+        f = raw_i.astype(np.float32)
+        if width == 1:
+            f = f - 128.0
+        data = (f / scale).reshape(-1, n_ch)
+    else:
+        # native integer dtype, like the reference backends
+        data = raw_i.reshape(-1, n_ch)
     out = data.T if channels_first else data
     return Tensor(jnp.asarray(out)), sr
 
@@ -112,7 +118,8 @@ def save(filepath, src, sample_rate, channels_first=True,
         pcm = np.clip(np.round(arr * 32767.0), -32768, 32767) \
             .astype("<i2")
     else:
-        pcm = arr.astype("<i2")
+        # clip out-of-range ints instead of silently wrapping mod 2^16
+        pcm = np.clip(arr, -32768, 32767).astype("<i2")
     with _wave.open(filepath, "wb") as f:
         f.setnchannels(pcm.shape[0])
         f.setsampwidth(2)

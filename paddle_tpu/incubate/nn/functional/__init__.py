@@ -552,7 +552,6 @@ def block_multihead_attention(
     masked_multihead_attention for decode).  Rope / neox / quant-cache
     knobs raise.
     """
-    import math as _math
     if rope_emb is not None or use_neox_style:
         raise NotImplementedError(
             "block_multihead_attention: apply rotary embeddings to qkv "
@@ -596,6 +595,14 @@ def block_multihead_attention(
             # ONE batched scatter (per-token .at updates would be O(L)
             # dispatches)
             new_pos = np.arange(start, start + n_this)
+            if (new_pos // bs).max() >= bt.shape[1] or \
+                    (bt[b, new_pos // bs] < 0).any():
+                raise ValueError(
+                    f"block_multihead_attention: request {b} needs cache "
+                    f"positions up to {int(new_pos.max())} but its "
+                    "block_tables row has no allocated block there "
+                    "(-1/out of range) — the scatter would silently "
+                    "corrupt another request's blocks")
             nblk = jnp.asarray(bt[b, new_pos // bs].astype(np.int32))
             noff = jnp.asarray((new_pos % bs).astype(np.int32))
             kc_new = kc_new.at[nblk, :, noff, :].set(kb)
@@ -609,7 +616,7 @@ def block_multihead_attention(
             keys = kc_new[blks, :, offs, :]                    # (L, H, D)
             vals = vc_new[blks, :, offs, :]
             scores = jnp.einsum("nhd,lhd->hnl", qb, keys) \
-                / _math.sqrt(D)
+                / math.sqrt(D)
             # causal within this request: query i may see [0, start+i]
             qpos = start + jnp.arange(n_this)[None, :, None]
             kpos = jnp.arange(L)[None, None, :]

@@ -210,14 +210,15 @@ class BrightnessTransform(BaseTransform):
 class Pad(BaseTransform):
     def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
         super().__init__(keys)
-        self.padding = (padding, padding) if isinstance(padding, int) \
-            else padding
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
 
     def _apply_image(self, img):
-        arr = _np_img(img)
-        p = self.padding
-        return np.pad(arr, [(p[1], p[1]), (p[0], p[0])] +
-                      [(0, 0)] * (arr.ndim - 2))
+        # delegate to the functional pad (handles int/2-/4-tuple padding,
+        # every padding_mode, per-channel fill)
+        return pad(_np_img(img), self.padding, self.fill,
+                   self.padding_mode)
 
 
 class RandomRotation(BaseTransform):

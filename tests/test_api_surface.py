@@ -193,3 +193,76 @@ class TestRound3Surface:
         from paddle_tpu.distributed.auto_parallel import Engine, Strategy
         s = Strategy()
         assert hasattr(s, "pipeline") and hasattr(s, "pp_degree")
+
+
+class TestRound4AuditedSurface:
+    """Round-4 systematic audit lists — every symbol the sweeps added
+    must stay present (regression lock for the b/c/d/e batches)."""
+
+    def test_tensor_op_batch(self):
+        _has(paddle, "shape", "rank", "tolist", "strided_slice",
+             "unflatten", "hstack", "vstack", "dstack", "i0e", "i1e",
+             "sinc", "fmod", "vecdot", "isposinf", "isneginf",
+             "is_complex", "is_floating_point", "is_integer", "negative",
+             "set_printoptions")
+
+    def test_tensor_method_batch(self):
+        t = paddle.to_tensor([1.0])
+        for m in ("divide_", "tanh_", "sigmoid_", "flatten_", "squeeze_",
+                  "copy_", "masked_fill_", "lerp_", "remainder_", "mod_",
+                  "pow_", "abs_", "neg_", "erfinv_", "put_along_axis_",
+                  "index_add_", "index_put_", "bernoulli_", "ndimension",
+                  "rank", "t", "frac", "gcd", "lcm", "histogram",
+                  "bincount", "cov", "corrcoef", "nanmean", "nansum",
+                  "nanmedian", "nanquantile", "multinomial"):
+            assert hasattr(t, m), m
+
+    def test_nn_batch(self):
+        _has(paddle.nn, "BeamSearchDecoder", "dynamic_decode", "Decoder",
+             "HSigmoidLoss", "MultiMarginLoss", "PixelUnshuffle")
+        _has(paddle.nn.functional, "hsigmoid_loss", "class_center_sample",
+             "sparse_attention")
+        _has(paddle.nn.quant, "weight_quantize", "weight_dequantize",
+             "weight_only_linear", "llm_int8_linear", "Stub")
+        _has(paddle.nn.initializer, "Bilinear")
+        _has(paddle.nn.utils, "clip_grad_value_")
+
+    def test_static_io_dist_batch(self):
+        import paddle_tpu.static as static
+        _has(static, "save", "load", "set_program_state", "Variable",
+             "create_global_var", "accuracy", "auc", "amp")
+        _has(paddle.io, "ConcatDataset", "SubsetRandomSampler")
+        _has(paddle.distributed, "is_available", "shard_layer",
+             "save_state_dict", "load_state_dict")
+
+    def test_fleet_ps_batch(self):
+        fleet = paddle.distributed.fleet
+        _has(fleet, "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+             "Role", "UtilBase", "util", "is_worker", "is_server",
+             "server_num", "server_index", "server_endpoints",
+             "worker_endpoints", "init_worker", "init_server",
+             "run_server", "save_inference_model")
+        _has(fleet.meta_parallel, "PipelineParallel", "ShardingParallel")
+        _has(fleet.utils, "LocalFS", "HDFSClient")
+
+    def test_aux_batch(self):
+        _has(paddle.incubate, "graph_sample_neighbors", "graph_reindex",
+             "graph_khop_sampler")
+        _has(paddle.incubate.autograd, "enable_prim", "disable_prim",
+             "prim_enabled", "forward_grad", "grad")
+        _has(paddle.incubate.nn, "FusedDropoutAdd", "FusedEcMoe")
+        _has(paddle.incubate.nn.functional, "fused_matmul_bias",
+             "blha_get_max_len", "block_multihead_attention")
+        _has(paddle.autograd, "saved_tensors_hooks")
+        _has(paddle.profiler, "SummaryView")
+        _has(paddle.device.cuda, "current_stream", "stream_guard",
+             "get_device_properties", "get_device_name",
+             "get_device_capability")
+        _has(paddle.sparse, "mask_as")
+        _has(paddle.vision, "get_image_backend", "set_image_backend",
+             "image_load")
+        _has(paddle.vision.ops, "read_file", "decode_jpeg")
+        _has(paddle.vision.transforms, "pad", "affine")
+        _has(paddle.audio, "load", "save", "info", "backends")
+        _has(paddle.utils, "download")
+        _has(paddle.inference, "get_version", "convert_to_mixed_precision")

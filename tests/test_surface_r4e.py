@@ -210,3 +210,42 @@ def test_affine_shear_direction():
     # convention: forward matrix [[1, tan], [0, 1]] maps (x, y)->(x+ty, y)
     # with y measured from center (negative above) -> moves LEFT above
     assert xs.min() < 3, (ys, xs)
+
+
+def test_audio_load_native_int_and_save_clip(tmp_path):
+    p = str(tmp_path / "n.wav")
+    paddle.audio.save(p, np.array([[0.5, -0.5]], np.float32), 8000)
+    raw, _ = paddle.audio.load(p, normalize=False)
+    assert raw.numpy().dtype == np.int16        # native dtype, not float
+    # out-of-range int input clips instead of wrapping
+    p2 = str(tmp_path / "c.wav")
+    paddle.audio.save(p2, np.array([[40000, -40000]], np.int32), 8000)
+    back, _ = paddle.audio.load(p2, normalize=False)
+    np.testing.assert_array_equal(back.numpy(), [[32767, -32768]])
+
+
+def test_block_mha_rejects_unallocated_block():
+    F = paddle.incubate.nn.functional
+    rs = np.random.RandomState(0)
+    H, D, bs = 1, 4, 4
+    # 9 tokens need 3 blocks; the table only allocates 2 (then -1)
+    qkv = rs.randn(9, 3 * H * D).astype(np.float32)
+    kc = paddle.to_tensor(np.zeros((4, H, bs, D), np.float32))
+    vc = paddle.to_tensor(np.zeros((4, H, bs, D), np.float32))
+    bt = paddle.to_tensor(np.array([[0, 1, -1]]))
+    with pytest.raises(ValueError, match="no allocated block"):
+        F.block_multihead_attention(
+            paddle.to_tensor(qkv), kc, vc, paddle.to_tensor([9]),
+            paddle.to_tensor([0]), paddle.to_tensor([9]),
+            block_tables=bt, block_size=bs)
+
+
+def test_pad_class_delegates_to_functional():
+    import paddle_tpu.vision.transforms as T
+    img = np.zeros((2, 2, 3), np.uint8)
+    out = T.Pad(1, fill=9)(img)
+    np.testing.assert_array_equal(out[0, 0], [9, 9, 9])
+    out4 = T.Pad((1, 2, 3, 4))(img)
+    assert out4.shape == (8, 6, 3)
+    refl = T.Pad(1, padding_mode="edge")(img)
+    assert refl.shape == (4, 4, 3)
