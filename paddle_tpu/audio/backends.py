@@ -92,10 +92,10 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     else:
         raise ValueError(f"unsupported WAV sample width {width}")
     if normalize:
-        f = raw_i.astype(np.float32)
+        flt = raw_i.astype(np.float32)
         if width == 1:
-            f = f - 128.0
-        data = (f / scale).reshape(-1, n_ch)
+            flt = flt - 128.0
+        data = (flt / scale).reshape(-1, n_ch)
     else:
         # native integer dtype, like the reference backends
         data = raw_i.reshape(-1, n_ch)

@@ -93,9 +93,124 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                causal=causal, return_softmax=return_softmax)
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kw):
-    raise NotImplementedError(
-        "use paddle_tpu.nn.MultiHeadAttention; XLA fuses the composed ops")
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True,
+        num_heads=-1, transpose_qkv_wb=False, name=None):
+    """reference: incubate.nn.functional.fused_multi_head_attention —
+    residual + (pre|post)-LN self-attention with the qkv projection as
+    one packed GEMM (one MXU pass; XLA fuses the epilogues).
+
+    qkv_weight layouts: (3, H, Dh, C) reference-native, or (C, 3C) with
+    transpose_qkv_wb=True.  cache_kv / tensor-parallel ring_id are not
+    supported here (use the fleet TP layers / mmha for decode).
+    """
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: cache_kv decode path is not "
+            "supported; use masked_multihead_attention")
+    if ring_id != -1:
+        raise NotImplementedError(
+            "fused_multi_head_attention: tensor-parallel ring_id is not "
+            "supported; use fleet meta_parallel TP layers")
+    from ....framework.random import next_key
+    xt = ensure_tensor(x)
+    qkv_w = ensure_tensor(qkv_weight)
+    lin_w = ensure_tensor(linear_weight)
+    if transpose_qkv_wb:
+        C = qkv_w.shape[0]
+        H = num_heads
+        if H <= 0:
+            raise ValueError("transpose_qkv_wb=True needs num_heads")
+        Dh = C // H
+    else:
+        _, H, Dh, C = qkv_w.shape
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"unknown dropout mode {mode!r}")
+    attn_p = attn_dropout_rate if training else 0.0
+    out_p = dropout_rate if training else 0.0
+    # downscale_in_infer: train drops WITHOUT upscaling; infer scales
+    # the activations by (1-p) instead
+    upscale = mode == "upscale_in_train"
+    infer_scale_attn = (1.0 - attn_dropout_rate) \
+        if (not upscale and not training) else 1.0
+    infer_scale_out = (1.0 - dropout_rate) \
+        if (not upscale and not training) else 1.0
+    rng = next_key() if (attn_p > 0.0 or out_p > 0.0) else None
+    pre = bool(pre_layer_norm)
+
+    opt = {"qkv_b": qkv_bias, "lin_b": linear_bias,
+           "pls": pre_ln_scale, "plb": pre_ln_bias,
+           "lns": ln_scale, "lnb": ln_bias,
+           "mask": attn_mask}
+    names = [k for k, v in opt.items() if v is not None]
+    ts = [xt, qkv_w, lin_w] + [ensure_tensor(opt[k]) for k in names]
+
+    def impl(xv, wq, wl, *rest):
+        vals = dict(zip(names, rest))
+
+        def _lnorm(h, sc, bi, eps):
+            mu = jnp.mean(h, -1, keepdims=True)
+            var = jnp.var(h, -1, keepdims=True)
+            out = (h - mu) * jax.lax.rsqrt(var + eps)
+            if sc is not None:
+                out = out * sc
+            if bi is not None:
+                out = out + bi
+            return out
+
+        residual = xv
+        h = xv
+        if pre:
+            h = _lnorm(h, vals.get("pls"), vals.get("plb"), pre_ln_epsilon)
+        B, S, _ = h.shape
+        if transpose_qkv_wb:
+            qkv = h @ wq                                  # (B, S, 3C)
+            if "qkv_b" in vals:
+                qkv = qkv + vals["qkv_b"]
+            qkv = qkv.reshape(B, S, 3, H, Dh)
+        else:
+            # (3, H, Dh, C) reference layout: one einsum GEMM
+            qkv = jnp.einsum("bsc,thdc->bsthd", h, wq)
+            if "qkv_b" in vals:
+                qkv = qkv + vals["qkv_b"].reshape(1, 1, 3, H, Dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)             / math.sqrt(Dh)
+        if "mask" in vals:
+            mv = vals["mask"]
+            if jnp.issubdtype(mv.dtype, jnp.floating):
+                s = s + mv.astype(s.dtype)
+            else:
+                # bool/int mask: nonzero = attend, zero = masked
+                s = jnp.where(mv != 0, s, jnp.asarray(-1e9, s.dtype))
+        p = jax.nn.softmax(s, axis=-1)
+        if attn_p > 0.0:
+            keep = jax.random.bernoulli(jax.random.fold_in(rng, 0),
+                                        1.0 - attn_p, p.shape)
+            p = jnp.where(keep, p / (1.0 - attn_p) if upscale else p, 0.0)
+        elif infer_scale_attn != 1.0:
+            p = p * infer_scale_attn
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        o = o.reshape(B, S, H * Dh) @ wl
+        if "lin_b" in vals:
+            o = o + vals["lin_b"]
+        if out_p > 0.0:
+            keep = jax.random.bernoulli(jax.random.fold_in(rng, 1),
+                                        1.0 - out_p, o.shape)
+            o = jnp.where(keep, o / (1.0 - out_p) if upscale else o, 0.0)
+        elif infer_scale_out != 1.0:
+            o = o * infer_scale_out
+        out = residual + o if add_residual else o
+        if not pre:
+            out = _lnorm(out, vals.get("lns"), vals.get("lnb"),
+                         ln_epsilon)
+        return out
+    return call_op(impl, *ts)
 
 
 # -- fused norm / rotary / activation surface (reference:
