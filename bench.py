@@ -97,8 +97,15 @@ def chip_calibration():
         _readback_sync(chain(a, b))
         best = min(best, time.perf_counter() - t0)
     per = max(best - lat, 1e-6) / N_CHAIN
-    return {"dispatch_latency_ms": round(lat * 1e3, 1),
-            "matmul_peak_frac": round(2 * 4096 ** 3 / per / 197e12, 4)}
+    frac = 2 * 4096 ** 3 / per / 197e12
+    # frac slightly above 1.0 = latency jitter between the tiny probe
+    # and the chain run (the subtraction overcorrected), not >peak
+    # compute; keep the raw number but flag it
+    out = {"dispatch_latency_ms": round(lat * 1e3, 1),
+           "matmul_peak_frac": round(frac, 4)}
+    if frac > 1.0:
+        out["note"] = "frac>1 = latency jitter in the subtraction"
+    return out
 
 
 # ---------------------------------------------------------------------------

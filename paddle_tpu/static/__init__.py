@@ -230,6 +230,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     with _ag.suspend_tape():
         t = Tensor(jnp.zeros(concrete, d), name=name)
     t.is_placeholder = True
+    t._declared_shape = tuple(shape)    # keeps None/-1 dims visible
     t.stop_gradient = True
     _default_main[0]._placeholders[name] = t
     return t

@@ -277,16 +277,52 @@ def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
     return layer(input)
 
 
+class _ElemPReLU:
+    """Per-element PReLU used by prelu(mode='element'); defined lazily
+    the first time (nn import must stay function-local in this module)."""
+    _cls = None
+
+    def __new__(cls, shape, attr):
+        if cls._cls is None:
+            from .. import nn as _nn
+            from ..nn.initializer import Constant
+            from ..tensor.search import where
+
+            class Impl(_nn.Layer):
+                def __init__(self, shape, attr):
+                    super().__init__()
+                    self.weight = self.create_parameter(
+                        shape, attr=attr,
+                        default_initializer=Constant(0.25))
+
+                def forward(self, inp):
+                    return where(inp >= 0, inp, self.weight * inp)
+            cls._cls = Impl
+        return cls._cls(shape, attr)
+
+
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     from .. import nn as _nn
     if mode == "all":
         n = 1
     elif mode == "channel":
         n = x.shape[1] if data_format.startswith("NC") else x.shape[-1]
+    elif mode == "element":
+        # per-element alphas need CONCRETE non-batch dims — a None/-1
+        # dim would silently shrink the weight to a shared slope
+        declared = getattr(x, "_declared_shape", tuple(x.shape))
+        bad = [d for d in declared[1:] if d in (None, -1)]
+        if bad:
+            raise ValueError(
+                "static.nn.prelu(mode='element') needs concrete "
+                f"non-batch dims, got {declared} — per-element alphas "
+                "cannot size against a dynamic dimension")
+        shape = tuple(int(s) for s in x.shape[1:])
+        layer = _layer_for("prelu", name,
+                           lambda: _ElemPReLU(shape, param_attr))
+        return layer(x)
     else:
-        raise NotImplementedError(
-            "static.nn.prelu: mode='element' (per-element alphas) is "
-            "not supported; use nn.PReLU with an explicit weight shape")
+        raise ValueError(f"static.nn.prelu: unknown mode {mode!r}")
     layer = _layer_for("prelu", name, lambda: _nn.PReLU(
         num_parameters=n, weight_attr=param_attr,
         data_format=data_format))

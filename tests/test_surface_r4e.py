@@ -366,3 +366,44 @@ def test_fused_mha_bool_mask_and_dropout_mode():
         F.fused_multi_head_attention(
             paddle.to_tensor(x), paddle.to_tensor(wq),
             paddle.to_tensor(wl), mode="bogus")
+
+
+def test_static_nn_prelu_element_mode():
+    import paddle_tpu.static as static
+    import paddle_tpu.static.nn as snn
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2, 3], "float32")
+            out = snn.prelu(x, mode="element")
+        exe = static.Executor()
+        xv = np.array([[[1.0, -2.0, 3.0], [-4.0, 5.0, -6.0]]], np.float32)
+        got = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        want = np.where(xv >= 0, xv, 0.25 * xv)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+        with pytest.raises(ValueError):
+            snn.prelu(x, mode="bogus")
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_prelu_element_dynamic_dim_raises():
+    import paddle_tpu.static as static
+    import paddle_tpu.static.nn as snn
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("xd", [None, -1, 8], "float32")
+            with pytest.raises(ValueError, match="concrete"):
+                snn.prelu(x, mode="element")
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_prelu_element_single_class():
+    from paddle_tpu.static.nn import _ElemPReLU
+    a = _ElemPReLU((2,), None)
+    b = _ElemPReLU((3,), None)
+    assert type(a) is type(b)          # one class object, stable identity
