@@ -277,40 +277,43 @@ def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
     return layer(input)
 
 
-class _ElemPReLU:
-    """Per-element PReLU used by prelu(mode='element'); defined lazily
-    the first time (nn import must stay function-local in this module)."""
-    _cls = None
+def _elem_prelu(shape, attr):
+    """Per-element PReLU layer for prelu(mode='element') (plain factory;
+    the nn import must stay function-local in this module)."""
+    from .. import nn as _nn
+    from ..nn.initializer import Constant
+    from ..tensor.search import where
 
-    def __new__(cls, shape, attr):
-        if cls._cls is None:
-            from .. import nn as _nn
-            from ..nn.initializer import Constant
-            from ..tensor.search import where
+    class _ElemPReLULayer(_nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                shape, attr=attr, default_initializer=Constant(0.25))
 
-            class Impl(_nn.Layer):
-                def __init__(self, shape, attr):
-                    super().__init__()
-                    self.weight = self.create_parameter(
-                        shape, attr=attr,
-                        default_initializer=Constant(0.25))
-
-                def forward(self, inp):
-                    return where(inp >= 0, inp, self.weight * inp)
-            cls._cls = Impl
-        return cls._cls(shape, attr)
+        def forward(self, inp):
+            return where(inp >= 0, inp, self.weight * inp)
+    return _ElemPReLULayer()
 
 
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     from .. import nn as _nn
+    # dynamic-dim guard: alpha shapes are sized from the build-time
+    # stand-in, so declared None/-1 dims would silently shrink the
+    # weight to a shared slope.  _declared_shape exists on direct
+    # static.data placeholders; for derived tensors the stand-in is all
+    # we have (envelope: size element/channel alphas from placeholders
+    # or concrete-shaped inputs).
+    declared = getattr(x, "_declared_shape", tuple(x.shape))
     if mode == "all":
         n = 1
     elif mode == "channel":
-        n = x.shape[1] if data_format.startswith("NC") else x.shape[-1]
+        ch_axis = 1 if data_format.startswith("NC") else -1
+        if declared[ch_axis] in (None, -1):
+            raise ValueError(
+                "static.nn.prelu(mode='channel') needs a concrete "
+                f"channel dim, got declared shape {declared}")
+        n = x.shape[ch_axis]
     elif mode == "element":
-        # per-element alphas need CONCRETE non-batch dims — a None/-1
-        # dim would silently shrink the weight to a shared slope
-        declared = getattr(x, "_declared_shape", tuple(x.shape))
         bad = [d for d in declared[1:] if d in (None, -1)]
         if bad:
             raise ValueError(
@@ -319,7 +322,7 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
                 "cannot size against a dynamic dimension")
         shape = tuple(int(s) for s in x.shape[1:])
         layer = _layer_for("prelu", name,
-                           lambda: _ElemPReLU(shape, param_attr))
+                           lambda: _elem_prelu(shape, param_attr))
         return layer(x)
     else:
         raise ValueError(f"static.nn.prelu: unknown mode {mode!r}")

