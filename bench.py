@@ -395,60 +395,109 @@ def bench_bert(B, S, iters, peak):
 # same tiny model (VERDICT r1 weak #7 — make the eager path's cost known)
 # ---------------------------------------------------------------------------
 
-def bench_fp8_linear(M=32, K=4096, N=4096, layers=32, iters=20):
-    """Weight-only fp8 linear vs bf16 in the regime it targets: small-M
-    (decode-style serving) where the matmul is WEIGHT-bandwidth-bound.
-    Chains `layers` independent linears inside one jit (axon ~5ms
-    dispatch floor).  v5e has no MXU fp8 arithmetic, so the win is the
-    2x weight HBM traffic cut; at large M (training) fp8 ~ties bf16 —
-    that is why fp8_quantize targets deploy, not the train step.
+def bench_fp8_linear(M=32, K=4096, N=4096, layers=32, reps=1200):
+    """Quantized-weight linear vs bf16 in the regime quantization
+    targets: small-M (decode-style serving) where the matmul is
+    WEIGHT-bandwidth-bound.
+
+    r5 measurement fix (VERDICT r4 #1): every variant chains
+    ``layers * reps`` linears inside ONE dispatch via nested lax.scan.
+    r4 timed 20 *separate* async dispatches under the tunnel's ~95 ms
+    dispatch latency, which is why the artifact said fp8_speedup 0.72
+    at 85 GB/s while the README said 1.63x — both were latency noise.
+    Scan-chained, latency-subtracted, repeat-stable truth (r5, v5e,
+    this config at reps=1200): bf16 1.46 ms/pass (733 GB/s), weight-
+    only fp8 0.88 ms (**1.66x**, 609 GB/s), int8-MXU Pallas 1.11 ms
+    (1.32x).  v5e has no MXU fp8 arithmetic: the fp8 win is
+    purely the 2x weight-HBM-traffic cut (XLA fuses the upconvert into
+    its weight streaming); at large M (training) fp8 ~ties bf16 — that
+    is why fp8_quantize targets deploy, not the train step.
     """
     import time
     import jax
+    from jax import lax
     import jax.numpy as jnp
 
     from paddle_tpu.ops.pallas.quant_matmul import (fp8_matmul,
-                                                    fp8_quantize_weight)
+                                                    fp8_quantize_weight,
+                                                    int8_matmul)
 
     rng = np.random.RandomState(0)
-    ws = [jnp.asarray(rng.randn(K, N).astype("f4") * 0.02,
-                      dtype=jnp.bfloat16) for _ in range(layers)]
-    w8s = [fp8_quantize_weight(w) for w in ws]
+    Wf = rng.randn(layers, K, N).astype("f4") * 0.02
+    Wb = jnp.asarray(Wf, jnp.bfloat16)
+    w8s = [fp8_quantize_weight(Wf[i]) for i in range(layers)]
+    W8 = jnp.stack([w for w, _ in w8s])
+    S8 = jnp.stack([s for _, s in w8s])
+    sci = np.maximum(np.abs(Wf).max(axis=1) / 127.0, 1e-12)
+    Wi = jnp.asarray(np.clip(np.round(Wf / sci[:, None, :]), -127, 127),
+                     jnp.int8)
+    Si = jnp.asarray(sci * 127.0, jnp.float32)  # int8_matmul scale convention
     x = jnp.asarray(rng.randn(M, K).astype("f4"), dtype=jnp.bfloat16)
 
-    @jax.jit
-    def run_bf16(x, ws):
-        o = x
-        for w in ws:
-            o = (o @ w).astype(jnp.bfloat16) * 0.01
-        return o
+    def chained(layer_fn):
+        @jax.jit
+        def run(x, *stacked):
+            def rep(o, _):
+                def one(o, ws):
+                    return layer_fn(o, ws), None
+                o, _ = lax.scan(one, o, stacked if len(stacked) > 1
+                                else stacked[0])
+                return o, None
+            o, _ = lax.scan(rep, x, None, length=reps)
+            return jnp.sum(o.astype(jnp.float32))
+        return run
 
-    @jax.jit
-    def run_fp8(x, w8s):
-        o = x
-        for w8, sc in w8s:
-            o = fp8_matmul(o, w8, sc, out_dtype=jnp.bfloat16) * 0.01
-        return o
+    run_bf16 = chained(lambda o, w: ((o @ w).astype(jnp.bfloat16) * 0.01))
+    run_fp8 = chained(lambda o, ws: (fp8_matmul(
+        o, ws[0], ws[1], out_dtype=jnp.bfloat16) * 0.01))
+    run_i8 = chained(lambda o, ws: (int8_matmul(
+        o, ws[0], ws[1], act_scale=8.0,
+        out_dtype=jnp.bfloat16) * 0.01).astype(jnp.bfloat16))
 
-    def timed(f, wsa):
-        _readback_sync(jnp.sum(f(x, wsa).astype(jnp.float32)))
-        best = 1e30
+    # dispatch-latency calibration for the validity flag
+    @jax.jit
+    def _tiny(a):
+        return jnp.sum(a)
+    _readback_sync(_tiny(x))
+    lats = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _readback_sync(_tiny(x))
+        lats.append(time.perf_counter() - t0)
+    dispatch_ms = sorted(lats)[1] * 1e3
+
+    def timed(f, *stacked):
+        _readback_sync(f(x, *stacked))
+        ts = []
         for _ in range(3):
             t0 = time.perf_counter()
-            for _ in range(iters):
-                out = f(x, wsa)
-            _readback_sync(jnp.sum(out.astype(jnp.float32)))
-            best = min(best, (time.perf_counter() - t0) / iters)
-        return best
+            _readback_sync(f(x, *stacked))
+            ts.append((time.perf_counter() - t0) / reps)
+        # subtract the (separately calibrated) per-dispatch latency share
+        med = sorted(ts)[1] - dispatch_ms / 1e3 / reps
+        return med, max(ts) / min(ts)
 
-    t_bf16 = timed(run_bf16, ws)
-    t_fp8 = timed(run_fp8, w8s)
-    gbs = layers * K * N / t_fp8 / 1e9      # fp8 weight bytes/s
+    t_bf16, j_bf16 = timed(run_bf16, Wb)
+    t_fp8, j_fp8 = timed(run_fp8, W8, S8)
+    t_i8, j_i8 = timed(run_i8, Wi, Si)
+    latency_share = dispatch_ms / (reps * t_bf16 * 1e3 + dispatch_ms)
     return {"bf16_ms": round(t_bf16 * 1e3, 3),
             "fp8_ms": round(t_fp8 * 1e3, 3),
+            "int8_ms": round(t_i8 * 1e3, 3),
             "fp8_speedup": round(t_bf16 / t_fp8, 3),
-            "fp8_weight_gbps": round(gbs, 1),
-            "shape": f"M{M} K{K} N{N} x{layers}"}
+            "int8_speedup": round(t_bf16 / t_i8, 3),
+            "fp8_weight_gbps": round(layers * K * N / t_fp8 / 1e9, 1),
+            "bf16_weight_gbps": round(layers * K * N * 2 / t_bf16 / 1e9, 1),
+            "repeat_jitter": {"bf16": round(j_bf16, 3),
+                              "fp8": round(j_fp8, 3),
+                              "int8": round(j_i8, 3)},
+            "dispatch_latency_ms": round(dispatch_ms, 1),
+            "latency_share_of_timing": round(latency_share, 4),
+            # timings subtract the calibrated dispatch latency, so the
+            # residual error is the latency JITTER (~2%) times the share;
+            # <10% share keeps that under ~0.5% per-pass
+            "valid": latency_share < 0.10,
+            "shape": f"M{M} K{K} N{N} x{layers} reps{reps}"}
 
 
 def bench_eager_overhead(iters=5):
@@ -496,19 +545,29 @@ def bench_eager_overhead(iters=5):
         res = model.train_batch([x], [y])
     jit_dt = (time.perf_counter() - t0) / iters
     # through the axon tunnel EVERY op call pays dispatch latency, so
-    # under congestion this ratio measures the tunnel, not the tape:
-    # report the measured per-call latency next to the ratio and flag
-    # readings where even the jitted single-call step is latency-bound
+    # under congestion this ratio measures the tunnel, not the tape.
+    # r5 (VERDICT r4 #9): the ratio is GATED on a healthy tunnel —
+    # eager steps cannot be scan-chained (op-by-op dispatch is what
+    # "eager" means), so when dispatch latency is high the only honest
+    # output is the raw timings plus valid=False, never a ratio that
+    # would be read as tape overhead (r4's latency-masked "1.1x").
     try:
         lat_ms = chip_calibration()["dispatch_latency_ms"]
     except Exception:
         lat_ms = None
+    healthy = lat_ms is not None and lat_ms < 10.0 \
+        and jit_dt * 1e3 >= 3 * lat_ms
     out = {"eager_ms": round(eager_dt * 1e3, 2),
            "jit_ms": round(jit_dt * 1e3, 2),
-           "eager_over_jit": round(eager_dt / max(jit_dt, 1e-9), 1),
-           "dispatch_latency_ms": lat_ms}
-    if lat_ms is not None and jit_dt * 1e3 < 3 * lat_ms:
-        out["latency_bound"] = True   # ratio not comparable across runs
+           "eager_over_jit": (round(eager_dt / max(jit_dt, 1e-9), 1)
+                              if healthy else None),
+           "dispatch_latency_ms": lat_ms,
+           "valid": healthy}
+    if not healthy:
+        out["invalid_reason"] = (
+            "latency-bound: dispatch latency too high to attribute the "
+            "eager/jit delta to the tape (need <10ms and jit step >= 3x "
+            "latency); last trustworthy reading: 1.7x (r3)")
     return out
 
 
@@ -624,7 +683,8 @@ def main():
     start = time.perf_counter()
 
     def want(name, result_key=None):
-        named = which is None or name in which
+        named = (which is None or name in which
+                 or (result_key is not None and result_key in which))
         if not named:
             return False
         if name != "gpt125m" and time.perf_counter() - start > budget_s:
@@ -700,7 +760,37 @@ def main():
                     max_position_embeddings=4096)
                 # r4 scanned-bench B sweep: B=6 45.4%, 4 46.0%, 3 46.1%,
                 # 2 46.7%, 1 43.4% — smaller per-step HBM live set wins
-                # until B=1 under-fills the MXU
+                # until B=1 under-fills the MXU.
+                #
+                # Why ~47% is the ceiling at S=4096 (r5 physics note,
+                # VERDICT r4 #3; latency-subtracted tensor-carry chains,
+                # tools/s4096_analysis.py — beware: scalar-carry chains
+                # get their matmul hoisted by XLA's c*(A@B) rewrite and
+                # read >100% of peak):
+                #   step = 87.6 ms (B=2, 8192 tok, 46.9% MFU).  Budget:
+                #   - flash attention f+b: 3.12 ms/layer x 12 = 37.4 ms
+                #     = 43% of wall at 29% of MXU peak, carrying only
+                #     23% of credited FLOPs.  fwd alone 1.05 ms (25%).
+                #     Same class as the BERT note: VPU/exp-bound, not
+                #     schedule-bound — the (bq, bk) landscape re-swept
+                #     at S=4096 is flat (512/1024/2048 combos: 46.3,
+                #     46.9, 46.9, 46.9%), dense attention is 11x slower
+                #     (11.6 ms fwd), and remat is off so fwd is paid
+                #     once.
+                #   - lm head + fused xent f+b: 11.4 ms at 84% of peak
+                #     (50304-wide streaming, near its HBM roofline).
+                #   - proj+MLP matmuls reach 95% of peak in isolation;
+                #     the remaining 38.8 ms of layer-remainder (norms,
+                #     residual/cast traffic, AdamW's ~4 ms HBM sweep of
+                #     124M fp32 m/v/p) averages 55%.
+                #   With attention pinned at its measured floor and
+                #   every other component at its best measured
+                #   efficiency, the step bottoms at ~75 ms = ~53% MFU;
+                #   the 47->53 gap is the remainder's backward (55% vs
+                #   95% isolated), the same VPU-bound fused-norm + cast
+                #   overheads quantified in the BERT note below.  48%+
+                #   needs a faster flash-bwd class (e.g. fusing the
+                #   exp recompute differently), not block tuning.
                 configs["gpt125m_s4096"] = bench_gpt(gptlc, B=2, S=4096,
                                                      iters=10, peak=peak)
             except Exception as e:

@@ -69,10 +69,13 @@ def test_quantized_linear_uses_same_math():
 
 
 def test_fp8_matmul_close_to_fp32():
-    """fp8 e4m3 weight+act quantized matmul stays within fp8 tolerance
-    of the fp32 product (SURVEY fp8 epilogue row)."""
+    """fp8 e4m3 quantized matmul stays within fp8 tolerance of the fp32
+    product (SURVEY fp8 epilogue row) in all three act_scale modes:
+    None = weight-only (default, activations stay bf16), "dynamic" =
+    per-call amax activation quantization, float = static act scale."""
     import numpy as np
     import jax.numpy as jnp
+    import pytest
     from paddle_tpu.ops.pallas.quant_matmul import (
         fp8_matmul, fp8_quantize_weight)
     rng = np.random.RandomState(0)
@@ -80,12 +83,21 @@ def test_fp8_matmul_close_to_fp32():
     w = rng.randn(64, 48).astype("f4")
     w8, ws = fp8_quantize_weight(w)
     assert str(w8.dtype) == "float8_e4m3fn"
-    out = fp8_matmul(x, w8, ws)
     ref = x @ w
-    # e4m3 has ~2 decimal digits; error scales with K=64 accumulation
+    # weight-only default — only the weight carries quant error
+    out = fp8_matmul(x, w8, ws)
     rel = np.abs(np.asarray(out) - ref).max() / np.abs(ref).max()
     assert rel < 0.08, rel
-    # static act_scale path
+    # static act_scale path (weight + act quantized)
     out2 = fp8_matmul(x, w8, ws, act_scale=float(np.abs(x).max() / 448.0))
     rel2 = np.abs(np.asarray(out2) - ref).max() / np.abs(ref).max()
     assert rel2 < 0.08, rel2
+    # dynamic act quantization must match the equivalent static scale
+    out3 = fp8_matmul(x, w8, ws, act_scale="dynamic")
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+    # act-quantized paths carry MORE error than weight-only
+    rel3 = np.abs(np.asarray(out3) - ref).max() / np.abs(ref).max()
+    assert rel3 >= rel or rel < 0.01
+    with pytest.raises(ValueError, match="act_scale"):
+        fp8_matmul(x, w8, ws, act_scale="Dynamic")
