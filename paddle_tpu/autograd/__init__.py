@@ -95,13 +95,23 @@ class _PyLayerNode(_ag.Node):
         super().__init__(self._user_vjp, inputs, outputs, single_out)
         self.materialize_grads = ctx._materialize_grads
 
-    def _user_vjp(self, cots):
-        cot_list = [cots] if self.single_out else list(cots)
-        # with set_materialize_grads(False) unused outputs arrive as None
-        grads_in = tuple(None if c is None else Tensor(c, stop_gradient=True)
-                         for c in cot_list)
-        with _ag.no_grad():
+    def _call_user_backward(self, grads_in, taped):
+        """Run cls.backward and normalize its result.
+
+        ``taped=False``: tape off, returns raw jnp values (vjp path).
+        ``taped=True`` (create_graph): tape stays ON so the user
+        backward's computation is differentiable again, returns Tensors.
+        """
+        if self.cls is None:
+            raise RuntimeError(
+                "trying to backward through a graph that has already been "
+                "freed; call backward(retain_graph=True) if you need to "
+                "backward twice")
+        if taped:
             out = self.cls.backward(self.ctx, *grads_in)
+        else:
+            with _ag.no_grad():
+                out = self.cls.backward(self.ctx, *grads_in)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         if len(out) != self.n_tensor_inputs:
@@ -111,15 +121,33 @@ class _PyLayerNode(_ag.Node):
         vals = []
         for g, t in zip(out, self.inputs):
             if g is None:
-                vals.append(jnp.zeros(t._value.shape, t._value.dtype))
+                z = jnp.zeros(t._value.shape, t._value.dtype)
+                vals.append(Tensor(z, stop_gradient=True) if taped else z)
+            elif taped:
+                vals.append(g if isinstance(g, Tensor)
+                            else Tensor(jnp.asarray(g), stop_gradient=True))
             else:
-                vals.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+                vals.append(g._value if isinstance(g, Tensor)
+                            else jnp.asarray(g))
         return tuple(vals)
+
+    def _user_vjp(self, cots):
+        cot_list = [cots] if self.single_out else list(cots)
+        # with set_materialize_grads(False) unused outputs arrive as None
+        grads_in = tuple(None if c is None else Tensor(c, stop_gradient=True)
+                         for c in cot_list)
+        return self._call_user_backward(grads_in, taped=False)
 
     def release(self):
         self.ctx = None
         self.cls = None
         super().release()
+
+    def apply_vjp_taped(self, out_cots):
+        """create_graph path: run the user's ``backward`` with the tape ON
+        so its computation is differentiable again (the reference requires
+        PyLayer.backward to be differentiable for double-grad too)."""
+        return self._call_user_backward(tuple(out_cots), taped=True)
 
 
 class PyLayer:
@@ -145,6 +173,10 @@ class PyLayer:
             # user backward survives jax.grad of the traced function
             return cls._apply_traced(args, kwargs)
         ctx = PyLayerContext()
+        if _ag._JOURNAL[0] is not None:
+            # PyLayer records its own tape node, invisible to the op
+            # journal — block-level SOT replay would drop it
+            _ag._JOURNAL[0].unsupported = "PyLayer.apply in forward"
         tensor_inputs = tuple(
             a for a in list(args) + list(kwargs.values())
             if isinstance(a, Tensor))

@@ -17,6 +17,7 @@ pure functions and differentiates with ``jax.grad`` (see
 import weakref
 from contextlib import contextmanager
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -81,11 +82,12 @@ def suspend_tape():
 
 class Node:
     """One recorded op: holds the vjp closure and graph edges."""
-    __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "single_out",
+    __slots__ = ("vjp", "fn", "inputs", "out_refs", "out_avals", "single_out",
                  "materialize_grads", "__weakref__")
 
-    def __init__(self, vjp, inputs, outputs, single_out):
+    def __init__(self, vjp, inputs, outputs, single_out, fn=None):
         self.vjp = vjp
+        self.fn = fn                    # primal fn — kept for double-grad
         self.inputs = inputs            # tuple[Tensor] — keeps producers alive
         self.out_refs = [weakref.ref(o) for o in outputs]
         self.out_avals = [(o._value.shape, o._value.dtype) for o in outputs]
@@ -97,7 +99,38 @@ class Node:
 
     def release(self):
         self.vjp = None
+        self.fn = None
         self.inputs = ()
+
+    def apply_vjp_taped(self, out_cots):
+        """Backward step AS TAPED OPS (create_graph=True path).
+
+        Re-derives this op's vjp as a pure function of (primal inputs,
+        output cotangents) and runs it through ``call_op``, so the grad
+        computation itself lands on the tape and is differentiable again
+        — the tape analogue of the reference eager engine's higher-order
+        GradNodes (egr::Backward retain path, SURVEY §2.1).  Gradients
+        then flow both into the cotangents and into the primals captured
+        by the op (the term the raw ``vjp`` closure cannot provide).
+
+        ``out_cots`` is a list of Tensors (already materialized); returns
+        a tuple of input-cotangent Tensors.
+        """
+        if self.fn is None:
+            raise RuntimeError(
+                "trying to backward through a graph that has already been "
+                "freed; call backward(retain_graph=True) if you need to "
+                "backward twice")
+        n_in = len(self.inputs)
+        fn, single = self.fn, self.single_out
+
+        def grad_call(*vs):
+            ins, cts = vs[:n_in], vs[n_in:]
+            _, vjp_fn = jax.vjp(fn, *ins)
+            return vjp_fn(cts[0] if single else tuple(cts))
+
+        out = call_op(grad_call, *self.inputs, *out_cots)
+        return out if isinstance(out, tuple) else (out,)
 
 
 # paddle_tpu.static installs a Program recorder here while static-graph
@@ -105,6 +138,34 @@ class Node:
 # primal fn + tensor wiring so Executor.run can replay the graph as a pure
 # jit-compiled function of the feeds.
 _STATIC_RECORDER = [None]
+
+# jit.sot installs an op journal here during a graph-break recording run:
+# every call_op appends (fn, inputs, outputs) and every host
+# concretization (Tensor.__bool__/__int__/... ) appends a sync event, so
+# the run can afterwards be partitioned into jit-compiled segments around
+# the host interactions (SOT block-level graph breaks, VERDICT r4 #4).
+_JOURNAL = [None]
+
+
+class Journal:
+    __slots__ = ("entries", "rng_used", "unsupported")
+
+    def __init__(self):
+        self.entries = []        # ("op", f, in_tensors, out_tensors) |
+        #                          ("sync", tensor, np_value)
+        self.rng_used = False
+        self.unsupported = None  # reason string → refuse segmentation
+
+    def sync(self, tensor, value):
+        self.entries.append(("sync", tensor, np.asarray(value)))
+
+
+def journal_sync(tensor, value):
+    """Called from Tensor concretization points (bool/int/float/index/
+    item/numpy) — a host readback is a potential graph-break boundary."""
+    j = _JOURNAL[0]
+    if j is not None:
+        j.sync(tensor, value)
 
 
 def call_op(fn, *tensors, **kwargs):
@@ -130,18 +191,24 @@ def call_op(fn, *tensors, **kwargs):
             _STATIC_RECORDER[0].record(
                 f, tensors,
                 result if isinstance(result, tuple) else (result,))
+        if _JOURNAL[0] is not None and not _TAPE_SUSPENDED[0]:
+            _JOURNAL[0].entries.append(
+                ("op", f, tensors,
+                 result if isinstance(result, tuple) else (result,)))
         return result
 
     out_vals, vjp_fn = jax.vjp(f, *vals)
     single = not isinstance(out_vals, (tuple, list))
     outs_list = [out_vals] if single else list(out_vals)
     out_tensors = [Tensor(o, stop_gradient=False) for o in outs_list]
-    node = Node(vjp_fn, tensors, out_tensors, single)
+    node = Node(vjp_fn, tensors, out_tensors, single, fn=f)
     for i, o in enumerate(out_tensors):
         o._node = node
         o._out_idx = i
     if _STATIC_RECORDER[0] is not None and not _TAPE_SUSPENDED[0]:
         _STATIC_RECORDER[0].record(f, tensors, tuple(out_tensors))
+    if _JOURNAL[0] is not None and not _TAPE_SUSPENDED[0]:
+        _JOURNAL[0].entries.append(("op", f, tensors, tuple(out_tensors)))
     return out_tensors[0] if single else tuple(out_tensors)
 
 
@@ -228,12 +295,17 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
                   retain_graph, sink_map=None)
 
 
-def _run_backward(seeds, retain_graph, sink_map):
+def _run_backward(seeds, retain_graph, sink_map, taped=False):
     """seeds: {(node_id, out_idx): (node, cotangent)}.
 
     If sink_map is not None it is {id(Tensor): Tensor}; gradients for those
     tensors are collected into the returned dict instead of ``.grad``.
+
+    ``taped=True`` (create_graph): cotangents are Tensors and every grad
+    computation goes through ``Node.apply_vjp_taped`` / taped ``+``, so
+    the returned gradients carry a tape of their own.
     """
+    from .core import Tensor
     roots = {id(n): n for n, _ in seeds.values()}
     order, pending = _toposort(roots.values())
     cots = {id(n): [None] * len(n.out_refs) for n in order}
@@ -260,18 +332,31 @@ def _run_backward(seeds, retain_graph, sink_map):
             t = ref()
             if c is None:
                 if n.materialize_grads:
-                    c = jnp.zeros(aval[0], aval[1])
+                    c = (Tensor(jnp.zeros(aval[0], aval[1]),
+                                stop_gradient=True) if taped
+                         else jnp.zeros(aval[0], aval[1]))
             elif t is not None:
                 for h in t._hooks:
-                    new = h(t._wrap_grad(c))
+                    new = h(c if taped else t._wrap_grad(c))
                     if new is not None:
-                        c = new._value if hasattr(new, "_value") else new
+                        if taped:
+                            c = new if isinstance(new, Tensor) else Tensor(new)
+                        else:
+                            c = new._value if hasattr(new, "_value") else new
                 if t._retain_grads:
-                    t._grad = c if t._grad is None else t._grad + c
+                    cv = c._value if taped else c
+                    t._grad = cv if t._grad is None else t._grad + cv
                 if collected is not None and id(t) in sink_map:
                     prev = collected.get(id(t))
                     collected[id(t)] = c if prev is None else prev + c
             out_cots.append(c)
+        if taped:
+            in_cots = n.apply_vjp_taped(out_cots)
+            _finish_node(n, in_cots, cots, pending, ready, sink_map,
+                         collected, taped=True)
+            if not retain_graph:
+                n.release()
+            continue
         try:
             in_cots = n.vjp(out_cots[0] if n.single_out
                             else tuple(out_cots))
@@ -285,40 +370,51 @@ def _run_backward(seeds, retain_graph, sink_map):
                     "paddle.jit.bounded_loops(max_iters) to lower it to a "
                     "differentiable masked scan") from e
             raise
-        touched_producers = {}
-        for t, c in zip(n.inputs, in_cots):
-            if t.stop_gradient:
-                continue
-            p = t._node
-            if p is None:
-                if collected is not None:
-                    if id(t) in sink_map:
-                        prev = collected.get(id(t))
-                        collected[id(t)] = c if prev is None else prev + c
-                else:
-                    _accumulate(t, c)
-            else:
-                cur = cots[id(p)][t._out_idx]
-                cots[id(p)][t._out_idx] = c if cur is None else cur + c
-                touched_producers[id(p)] = p
-        # decrement once per unique producer, matching _toposort's counting
-        for pid, p in touched_producers.items():
-            pending[pid] -= 1
-            if pending[pid] == 0:
-                ready.append(p)
+        _finish_node(n, in_cots, cots, pending, ready, sink_map,
+                     collected, taped=False)
         if not retain_graph:
             n.release()
     return collected
 
 
+def _finish_node(n, in_cots, cots, pending, ready, sink_map, collected,
+                 taped):
+    """Route a node's input cotangents to producers / leaves / sinks."""
+    touched_producers = {}
+    for t, c in zip(n.inputs, in_cots):
+        if t.stop_gradient:
+            continue
+        p = t._node
+        if p is None:
+            if collected is not None:
+                if id(t) in sink_map:
+                    prev = collected.get(id(t))
+                    collected[id(t)] = c if prev is None else prev + c
+            else:
+                _accumulate(t, c._value if taped else c)
+        else:
+            cur = cots[id(p)][t._out_idx]
+            cots[id(p)][t._out_idx] = c if cur is None else cur + c
+            touched_producers[id(p)] = p
+    # decrement once per unique producer, matching _toposort's counting
+    for pid, p in touched_producers.items():
+        pending[pid] -= 1
+        if pending[pid] == 0:
+            ready.append(p)
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False):
-    """Functional gradient (paddle.grad).  create_graph is not yet supported."""
+    """Functional gradient (paddle.grad).
+
+    ``create_graph=True`` runs the backward pass as taped ops
+    (``Node.apply_vjp_taped``), so the returned gradients carry their own
+    tape and can be differentiated again — gradient penalties (WGAN-GP)
+    and ``paddle.grad(paddle.grad(...))`` work.  Reference: the eager
+    engine's higher-order grad nodes (egr::Backward retain_graph /
+    create_graph path, SURVEY §2.1 eager-autograd row).
+    """
     from .core import Tensor
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported in eager mode; "
-            "use paddle_tpu.incubate.autograd or jax transforms directly")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -328,10 +424,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if retain_graph is None:
         retain_graph = create_graph
 
+    def seed_for(o, go):
+        if not create_graph:
+            return jnp.ones_like(o._value) if go is None else go._value
+        # taped mode: keep the grad_output Tensor itself (its graph, if
+        # any, must flow into the higher-order result)
+        return (Tensor(jnp.ones_like(o._value), stop_gradient=True)
+                if go is None else go)
+
     seeds = {}
     trivial = {}
     for o, go in zip(outputs, grad_outputs):
-        g = jnp.ones_like(o._value) if go is None else go._value
+        g = seed_for(o, go)
         if o._node is None:
             prev = trivial.get(id(o))
             trivial[id(o)] = g if prev is None else prev + g
@@ -343,7 +447,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             seeds[key] = (o._node, g)
 
     sink_map = {id(t): t for t in inputs}
-    collected = _run_backward(seeds, retain_graph, sink_map) if seeds else {}
+    collected = (_run_backward(seeds, retain_graph, sink_map,
+                               taped=create_graph) if seeds else {})
     for oid, g in trivial.items():
         if oid in sink_map:
             prev = collected.get(oid)
@@ -352,6 +457,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     for t in inputs:
         g = collected.get(id(t))
         if g is None and not allow_unused:
-            g = jnp.zeros_like(t._value)
-        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+            g = (Tensor(jnp.zeros_like(t._value), stop_gradient=True)
+                 if create_graph else jnp.zeros_like(t._value))
+        if g is None:
+            results.append(None)
+        elif create_graph:
+            results.append(g)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
     return results

@@ -163,24 +163,36 @@ class Tensor:
         return self._node is None
 
     # -- conversion ---------------------------------------------------------
+    # each host readback reports to the jit.sot journal (when active):
+    # concretizations are the graph-break boundaries block-level SOT
+    # splits compiled segments around
     def numpy(self):
-        return np.asarray(self._value)
+        v = np.asarray(self._value)
+        _ag.journal_sync(self, v)
+        return v
 
     def item(self, *args):
-        return self._value.item(*args)
+        v = self._value.item(*args)
+        _ag.journal_sync(self, v)
+        return v
 
     def tolist(self):
         return self.numpy().tolist()
 
     def __array__(self, dtype=None):
         a = np.asarray(self._value)
+        _ag.journal_sync(self, a)
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
-        return float(self._value)
+        v = float(self._value)
+        _ag.journal_sync(self, v)
+        return v
 
     def __int__(self):
-        return int(self._value)
+        v = int(self._value)
+        _ag.journal_sync(self, v)
+        return v
 
     def __index__(self):
         # lets a concrete integer scalar Tensor drive range()/slicing
@@ -191,10 +203,14 @@ class Tensor:
             raise TypeError(
                 f"only integer tensors can be used as an index, got "
                 f"{self._value.dtype}")
-        return int(self._value)
+        v = int(self._value)
+        _ag.journal_sync(self, v)
+        return v
 
     def __bool__(self):
-        return bool(self._value)
+        v = bool(self._value)
+        _ag.journal_sync(self, v)
+        return v
 
     def __len__(self):
         if not self._value.shape:
@@ -256,6 +272,8 @@ class Tensor:
         return _ag.call_op(lambda v: v + 0, self)
 
     def set_value(self, value):
+        if _ag._JOURNAL[0] is not None:
+            _ag._JOURNAL[0].unsupported = "Tensor.set_value in forward"
         if isinstance(value, Tensor):
             value = value._value
         value = jnp.asarray(value)

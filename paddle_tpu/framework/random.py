@@ -45,6 +45,12 @@ def in_rng_scope():
 
 def next_key():
     """Draw a fresh PRNG key (eager: split global; scoped: fold counter)."""
+    from . import autograd as _ag
+    if _ag._JOURNAL[0] is not None:
+        # a journaled (graph-break recording) run consumed randomness:
+        # replaying jitted segments would freeze the recorded key, so
+        # the SOT segmenter must refuse this function
+        _ag._JOURNAL[0].rng_used = True
     if _SCOPES:
         scope = _SCOPES[-1]
         scope[1] += 1

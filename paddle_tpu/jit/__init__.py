@@ -124,16 +124,47 @@ class StaticFunction:
         self._cache = {}
         self.__name__ = getattr(function, "__name__", "forward")
 
+    def __set_name__(self, owner, name):
+        # the class-attribute name may differ from the wrapped
+        # function's __name__ (e.g. forward_static = to_static(forward));
+        # memoizing under __name__ would shadow the WRONG attribute
+        self._attr_name = name
+
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        return StaticFunction(self._function, self._input_spec,
-                              layer=instance,
-                              _transformed=self._transformed)
+        # memoize the bound wrapper ON the instance: a fresh wrapper per
+        # attribute access would discard the jit cache (recompile every
+        # call) and any SOT segment plans.  StaticFunction is a non-data
+        # descriptor, so the instance-dict entry shadows it on later
+        # lookups, and the cache dies with the instance (no global map
+        # pinning layers alive).  object.__setattr__ bypasses
+        # Layer.__setattr__'s parameter/sublayer bookkeeping.
+        bound = StaticFunction(self._function, self._input_spec,
+                               layer=instance,
+                               _transformed=self._transformed)
+        attr = getattr(self, "_attr_name", None)
+        if attr is not None:
+            try:
+                object.__setattr__(instance, attr, bound)
+            except (AttributeError, TypeError):
+                pass                  # __slots__ etc.: fall back unmemoized
+        return bound
 
     @property
     def _bound_layer(self):
         return self._layer
+
+    def __deepcopy__(self, memo):
+        # bound wrappers live in layer instance __dict__ (see __get__);
+        # the jit cache holds compiled executables that must not (and
+        # could not) be deep-copied — recreate empty against the copied
+        # layer
+        import copy as _copy
+        return StaticFunction(
+            self._function, self._input_spec,
+            layer=_copy.deepcopy(self._layer, memo),
+            _transformed=self._transformed)
 
     def _params_buffers(self):
         layer = self._layer
@@ -222,31 +253,54 @@ class StaticFunction:
         compiled = self._cache[key]
         if compiled is _GRAPH_BREAK:
             # guard-cached SOT-style fallback: this input spec hit an
-            # untraceable construct before; run eagerly without retracing
+            # untraceable construct before and could not be segmented;
+            # run eagerly without retracing
+            return self._eager_fallback(*args, use_transformed=True,
+                                        **kwargs)
+        from .sot import SegmentPlan
+        if isinstance(compiled, SegmentPlan):
+            # block-level graph break: replay the jitted segments with
+            # the host decisions guard-checked; a miss (the host would
+            # branch differently for these values) → whole eager call
+            ok, out = compiled.replay(args, kwargs)
+            if ok:
+                return out
             return self._eager_fallback(*args, use_transformed=True,
                                         **kwargs)
         if fresh:
             # first trace under this guard: an untraceable construct
             # (break/continue in a tensor loop, data-dependent python,
             # concretization of a tracer) triggers the SOT contract —
-            # graph-break to eager instead of failing (reference:
-            # python/paddle/jit/sot guard-and-fallback semantics at
-            # function granularity)
+            # graph-break instead of failing (reference:
+            # python/paddle/jit/sot guard-and-fallback).  r5: the
+            # fallback is BLOCK-level — the eager run is journaled and
+            # partitioned into jit-compiled segments around the host
+            # interaction; only unsegmentable functions stay eager at
+            # function granularity (the r4 behavior).
             try:
                 return self._run_compiled(compiled, args, kwargs)
             except _GRAPH_BREAK_ERRORS as e:
                 if not _SOT_ENABLED[0]:
                     raise
                 import warnings
-                self._cache[key] = _GRAPH_BREAK
+                from .sot import record_and_plan
+                _, buffers = self._params_buffers()
+                plan, out = record_and_plan(
+                    lambda: self._eager_fallback(
+                        *args, use_transformed=True, **kwargs),
+                    args, kwargs, buffers)
+                self._cache[key] = plan if plan is not None \
+                    else _GRAPH_BREAK
+                mode = (f"segmented into {plan.n_segments} compiled "
+                        f"blocks" if plan is not None
+                        else "falling back to eager")
                 warnings.warn(
                     f"to_static: graph break in "
                     f"{getattr(self._function, '__qualname__', '?')} — "
-                    f"falling back to eager for this input spec "
+                    f"{mode} for this input spec "
                     f"({type(e).__name__}: {str(e)[:120]})",
                     RuntimeWarning, stacklevel=2)
-                return self._eager_fallback(*args, use_transformed=True,
-                                            **kwargs)
+                return out
         return self._run_compiled(compiled, args, kwargs)
 
     def _run_compiled(self, compiled, args, kwargs):
@@ -360,19 +414,27 @@ def save(layer, path, input_spec=None, **configs):
 
     # None dims export as SYMBOLIC dimensions (shape polymorphism): the
     # loaded artifact then serves any batch size, like the reference's
-    # -1 dims in a saved program.  A leading None is the BATCH dim and
-    # shares one symbol across all inputs (multi-input models constrain
-    # their batches equal); non-leading Nones get their own variables.
+    # -1 dims in a saved program.  Every None gets its OWN symbol per
+    # input (the reference's -1 dims impose no cross-input equality;
+    # ADVICE r4 #1 — unequal-length multi-input calls must load).  Pass
+    # ``tie_batch_dims=True`` to share one "batch" symbol across every
+    # input's leading None (lets jax.export prove cross-input shape
+    # relations when the model combines inputs along the batch axis).
+    tie_batch = bool(configs.pop("tie_batch_dims", False))
     n_sym = 0
     scope = jax.export.SymbolicScope()   # one scope for every input
     arg_shapes = []
-    for s in specs:
+    for spec_idx, s in enumerate(specs):
         dims = []
         has_sym = False
         for i, d in enumerate(s.shape):
             if d is None:
-                dims.append("batch" if i == 0 else f"d{n_sym}")
-                n_sym += i != 0
+                if i == 0:
+                    dims.append("batch" if tie_batch
+                                else f"batch{spec_idx}")
+                else:
+                    dims.append(f"d{n_sym}")
+                    n_sym += 1
                 has_sym = True
             else:
                 dims.append(str(int(d)))

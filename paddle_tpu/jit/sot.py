@@ -1,0 +1,279 @@
+"""Block-level SOT graph breaks (VERDICT r4 #4).
+
+Reference: ``python/paddle/jit/sot/`` — bytecode capture keeps compiled
+subgraphs around an unsupported construct so one ``print``/``if
+tensor:`` does not un-jit the whole forward.
+
+TPU-native mechanism: when the whole-function trace graph-breaks, the
+function is re-run EAGERLY once under an op **journal** — every eager op
+already routes through ``autograd.call_op`` (the tape), so the journal
+is a faithful linear record of the dataflow, and every host
+concretization (``Tensor.__bool__``/``__int__``/``numpy()``/...) lands
+in it as a *sync event*.  The journal is then partitioned at the sync
+events into segments; each segment compiles to ONE ``jax.jit`` function
+and replays through ``call_op`` (so it is a single tape node —
+gradients flow exactly like any compiled block).
+
+Replay is guarded: the reference SOT guards the bytecode on the
+concrete values it branched on; here every sync event's journaled value
+is re-checked against the replayed value, and a mismatch (the host
+would have taken a different path) falls back to whole-function eager
+for that call.  Same trace-time semantics as ``jax.jit`` applies to
+host side effects inside the break region (they ran during recording).
+
+The segmenter REFUSES (returns None → function-granularity fallback,
+the r4 behavior) when replay could be unfaithful: randomness was drawn
+(keys would freeze), a PyLayer ran (its node bypasses the journal),
+a layer buffer was mutated in place (BN running stats), an in-place op
+or set_value ran, or an argument is a raw np.ndarray/jax.Array or a
+Tensor nested in a container (neither can be remapped per call).
+
+Convention for host-computing ops (nms host path, dynamic_decode, ...):
+read device values via ``t.numpy()`` / ``np.asarray(t)`` — those
+register a journal sync so the derived host decision is guarded — never
+via a raw ``t._value`` access, which is invisible to the journal and
+would bake the first call's result into the plan unguarded.
+"""
+import numpy as np
+
+import jax
+
+from ..framework.core import Tensor
+from ..framework import autograd as _ag
+
+__all__ = ["SegmentPlan", "record_and_plan"]
+
+
+class _Segment:
+    __slots__ = ("fn", "in_ids", "out_ids")
+
+    def __init__(self, ops, in_ids, out_ids):
+        self.in_ids = in_ids
+        self.out_ids = out_ids
+
+        def replay(*vals):
+            env = dict(zip(in_ids, vals))
+            for f, iids, oids in ops:
+                out = f(*[env[i] for i in iids])
+                outs = out if isinstance(out, tuple) else (out,)
+                for oid, ov in zip(oids, outs):
+                    env[oid] = ov
+            return tuple(env[i] for i in out_ids)
+
+        self.fn = jax.jit(replay)
+
+
+class SegmentPlan:
+    """Compiled replay schedule: jitted segments + value guards."""
+
+    def __init__(self, schedule, ext_map, out_treedef, out_leaves):
+        self.schedule = schedule          # ("seg", _Segment)|("guard", id, v)
+        self.ext_map = ext_map            # id -> ("pos",i)|("kw",k)|("cap",T)
+        self.out_treedef = out_treedef
+        self.out_leaves = out_leaves      # ("env", id) | ("const", value)
+        self.n_segments = sum(1 for s in schedule if s[0] == "seg")
+        self.replays = 0
+        self.guard_misses = 0
+
+    def replay(self, args, kwargs):
+        """Run the plan; returns (True, out) or (False, None) on guard
+        miss (caller falls back to whole-function eager)."""
+        env = {}
+        for eid, src in self.ext_map.items():
+            if src[0] == "pos":
+                a = args[src[1]]
+            elif src[0] == "kw":
+                a = kwargs[src[1]]
+            else:
+                a = src[1]                 # captured Tensor (params, consts)
+            env[eid] = a if isinstance(a, Tensor) else Tensor(a)
+        for item in self.schedule:
+            if item[0] == "guard":
+                _, tid, want = item
+                got = np.asarray(env[tid]._value)
+                if got.dtype.kind == "f" or want.dtype.kind == "f":
+                    # jit-fused segments may differ from the eager
+                    # recording in the last ulp; an exact compare would
+                    # permanently miss and degrade every call to
+                    # replay-then-eager (code-review r5 #5)
+                    same = got.shape == want.shape and np.allclose(
+                        got, want, rtol=1e-4, atol=1e-6)
+                else:
+                    same = np.array_equal(got, want)
+                if not same:
+                    self.guard_misses += 1
+                    return False, None
+            else:
+                seg = item[1]
+                outs = _ag.call_op(seg.fn, *[env[i] for i in seg.in_ids])
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                for oid, o in zip(seg.out_ids, outs):
+                    env[oid] = o
+        leaves = [env[ref[1]] if ref[0] == "env" else ref[1]
+                  for ref in self.out_leaves]
+        self.replays += 1
+        return True, jax.tree.unflatten(self.out_treedef, leaves)
+
+
+def record_and_plan(run_eager, args, kwargs, buffers):
+    """Run ``run_eager()`` under a journal; return (plan_or_None, out).
+
+    ``run_eager`` executes the original function eagerly (its result is
+    returned to the caller either way — recording IS the first
+    fallback call).  ``buffers`` are the layer buffers to watch for
+    in-place mutation.
+    """
+    journal = _ag.Journal()
+    buf_vals = [b._value for b in buffers]
+    _ag._JOURNAL[0] = journal
+    try:
+        out = run_eager()
+    finally:
+        _ag._JOURNAL[0] = None
+
+    if journal.rng_used:
+        return None, out
+    if journal.unsupported:
+        return None, out
+    if any(b._value is not v for b, v in zip(buffers, buf_vals)):
+        return None, out                   # buffer mutated (BN stats, ...)
+    if not any(e[0] == "sync" for e in journal.entries):
+        return None, out                   # no host boundary → no benefit
+
+    # external input map: positional / kw tensor args by identity.  Raw
+    # np.ndarray / jax.Array args are REFUSED: they convert to fresh
+    # Tensors inside the function, so the journal sees them as
+    # constants and replay would bake the first call's values while the
+    # cache key (shape/dtype only) still matches (code-review r5 #1).
+    ext_src = {}
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            ext_src[id(a)] = ("pos", i)
+        elif isinstance(a, (np.ndarray, jax.Array)):
+            return None, out
+        elif isinstance(a, (list, tuple, dict)):
+            if any(isinstance(x, (Tensor, np.ndarray, jax.Array))
+                   for x in jax.tree.leaves(
+                       a, is_leaf=lambda x: isinstance(x, Tensor))):
+                return None, out           # nested array: can't remap
+    for k, a in kwargs.items():
+        if isinstance(a, Tensor):
+            ext_src[id(a)] = ("kw", k)
+        elif isinstance(a, (np.ndarray, jax.Array)):
+            return None, out
+
+    produced = {}                          # id -> True once defined
+    schedule = []
+    cur_ops = []
+    cur_in = []                            # ordered external-to-segment ids
+    cur_in_seen = set()
+    cur_out = []                           # ids needed later
+
+    # pass 1: find, for each id, whether it is consumed after its
+    # producing position (or synced / returned) — those become segment
+    # outputs.  Build consumption order on the fly instead: simpler to
+    # post-compute the set of ids needed outside their own segment.
+    # First assign entries to segment indices.
+    seg_idx = []
+    s = 0
+    for e in journal.entries:
+        if e[0] == "sync":
+            s += 1
+            seg_idx.append(None)
+        else:
+            seg_idx.append(s)
+
+    prod_seg = set()                       # ids ever produced by an op
+    # order-aware cross-segment liveness: an id may be re-produced (the
+    # in-place op family reuses the same Tensor object), so compare each
+    # consumption against the segment of the LAST production before it
+    last_prod = {}
+    needed_across = set()                  # ids read outside producing seg
+    for e, si in zip(journal.entries, seg_idx):
+        if e[0] == "op":
+            for t in e[2]:
+                lp = last_prod.get(id(t))
+                if lp is not None and lp != si:
+                    needed_across.add(id(t))
+            for o in e[3]:
+                last_prod[id(o)] = si
+                prod_seg.add(id(o))
+        else:
+            tid = id(e[1])
+            if tid in last_prod:
+                needed_across.add(tid)
+
+    out_leaves_t, out_treedef = jax.tree.flatten(
+        out, is_leaf=lambda o: isinstance(o, Tensor))
+    for leaf in out_leaves_t:
+        if isinstance(leaf, Tensor) and id(leaf) in prod_seg:
+            needed_across.add(id(leaf))
+
+    def close_segment():
+        nonlocal cur_ops, cur_in, cur_in_seen, cur_out
+        if cur_ops:
+            schedule.append(("seg", _Segment(cur_ops, list(cur_in),
+                                             list(cur_out))))
+        cur_ops, cur_in, cur_out = [], [], []
+        cur_in_seen = set()
+
+    local = set()                          # ids produced in current segment
+    for e in journal.entries:
+        if e[0] == "sync":
+            close_segment()
+            local = set()
+            tid = id(e[1])
+            if tid in prod_seg or tid in ext_src:
+                schedule.append(("guard", tid, np.asarray(e[2])))
+            # else: sync of a tensor the journal never saw produced
+            # (constant) — its value cannot change, no guard needed
+            continue
+        _, f, in_ts, out_ts = e
+        iids, oids = [], []
+        for t in in_ts:
+            tid = id(t)
+            if tid not in local and tid not in cur_in_seen:
+                cur_in.append(tid)
+                cur_in_seen.add(tid)
+                if tid not in prod_seg and tid not in ext_src:
+                    # captured constant / parameter: read fresh at replay
+                    ext_src[tid] = ("cap", t)
+            iids.append(tid)
+        for t in out_ts:
+            tid = id(t)
+            local.add(tid)
+            oids.append(tid)
+            if tid in needed_across and tid not in cur_out:
+                cur_out.append(tid)
+        cur_ops.append((f, iids, oids))
+    close_segment()
+
+    # external map restricted to ids actually read: by a segment, a
+    # guard, or the function output (an arg returned unchanged must be
+    # remapped per call, never baked as the first call's tensor)
+    used_ext = set()
+    for item in schedule:
+        if item[0] == "seg":
+            for tid in item[1].in_ids:
+                if tid in ext_src:
+                    used_ext.add(tid)
+        else:
+            if item[1] in ext_src:
+                used_ext.add(item[1])
+    for leaf in out_leaves_t:
+        if isinstance(leaf, Tensor) and id(leaf) in ext_src:
+            used_ext.add(id(leaf))
+    ext_map = {tid: ext_src[tid] for tid in used_ext}
+
+    out_leaves = []
+    for leaf in out_leaves_t:
+        if isinstance(leaf, Tensor) and (id(leaf) in prod_seg
+                                         or id(leaf) in ext_map):
+            out_leaves.append(("env", id(leaf)))
+        else:
+            out_leaves.append(("const", leaf))
+
+    plan = SegmentPlan(schedule, ext_map, out_treedef, out_leaves)
+    if plan.n_segments < 1:
+        return None, out
+    return plan, out

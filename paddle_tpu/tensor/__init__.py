@@ -168,7 +168,16 @@ def _item_method(self, *args):
 # because the rebind carries the producing node, the same seam the
 # collective in-place ops use.
 
+def _journal_refuse(reason):
+    """In-place mutation is invisible to the SOT op journal — mark the
+    recording unsupported so segment replay is refused (jit/sot.py)."""
+    from ..framework.autograd import _JOURNAL
+    if _JOURNAL[0] is not None:
+        _JOURNAL[0].unsupported = reason
+
+
 def _rebind(dst, src):
+    _journal_refuse("in-place op in forward")
     dst._value = src._value
     dst._node = src._node
     dst._out_idx = src._out_idx
@@ -212,12 +221,14 @@ Tensor.reciprocal_ = _inplace(_math.reciprocal)
 
 
 def _zero_(self):
+    _journal_refuse("in-place op in forward")
     self._value = jnp.zeros_like(self._value)
     self._node = None
     return self
 
 
 def _fill_(self, value):
+    _journal_refuse("in-place op in forward")
     self._value = jnp.full_like(self._value, value)
     self._node = None
     return self
@@ -334,6 +345,7 @@ Tensor.index_put_ = _inplace(_manip.index_put)
 def _copy_(self, other, blocking=True):
     """reference: Tensor.copy_ — copy value (and nothing else) from
     ``other`` into this tensor."""
+    _journal_refuse("Tensor.copy_ in forward")
     src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
     self._value = jnp.asarray(src, dtype=self._value.dtype)
     self._node = None
@@ -372,3 +384,69 @@ def _multinomial_method(self, num_samples=1, replacement=False, name=None):
 
 
 Tensor.multinomial = _multinomial_method
+
+
+# Public surface (namespace hygiene, VERDICT r4 #8): tape/dispatch
+# helpers (call_op, ensure_tensor, unary_op, ...) are implementation
+# details — they stay importable for in-package use but are not part of
+# the API surface that `import *` / docs/API_REFERENCE.md expose.
+__all__ = [
+    "Tensor", "abs", "acos", "acosh", "add", "addmm", "all", "allclose",
+    "amax", "amin", "angle", "any", "arange", "argmax", "argmin",
+    "argsort", "as_complex", "as_real", "as_strided", "asin", "asinh",
+    "assign", "atan", "atan2", "atanh", "atleast_1d", "atleast_2d",
+    "atleast_3d", "bernoulli", "bernoulli_", "bincount", "binomial",
+    "bitwise_and", "bitwise_left_shift", "bitwise_not", "bitwise_or",
+    "bitwise_right_shift", "bitwise_xor", "block_diag", "bmm",
+    "broadcast_shape", "broadcast_tensors", "broadcast_to", "bucketize",
+    "cartesian_prod", "cast", "cauchy_", "cdist", "ceil", "cholesky",
+    "cholesky_solve", "chunk", "clip", "clone", "column_stack",
+    "combinations", "concat", "cond", "conj", "copysign", "corrcoef",
+    "cos", "cosh", "count_nonzero", "cov", "create_parameter", "crop",
+    "cross", "cummax", "cummin", "cumprod", "cumsum",
+    "cumulative_trapezoid", "deg2rad", "det", "diag", "diag_embed",
+    "diagflat", "diagonal", "diagonal_scatter", "diff", "digamma", "dist",
+    "divide", "dot", "dsplit", "dstack", "eig", "eigh", "eigvals",
+    "eigvalsh", "einsum", "empty", "empty_like", "equal", "equal_all",
+    "erf", "erfinv", "exp", "expand", "expand_as", "expm1",
+    "exponential_", "eye", "fill_diagonal", "flatten", "flip", "floor",
+    "floor_divide", "floor_mod", "fmax", "fmin", "fmod", "frac", "frexp",
+    "full", "full_like", "gammainc", "gammaincc", "gammaln", "gather",
+    "gather_nd", "gcd", "greater_equal", "greater_than", "heaviside",
+    "histogram", "histogramdd", "householder_product", "hsplit", "hstack",
+    "hypot", "i0", "i0e", "i1", "i1e", "imag", "increment", "index_add",
+    "index_fill", "index_put", "index_sample", "index_select", "inner",
+    "inv", "is_complex", "is_empty", "is_floating_point", "is_integer",
+    "isclose", "isfinite", "isin", "isinf", "isnan", "isneginf",
+    "isposinf", "isreal", "kron", "kthvalue", "lcm", "ldexp", "lerp",
+    "less_equal", "less_than", "lgamma", "linspace", "log", "log10",
+    "log1p", "log2", "log_normal", "logaddexp", "logcumsumexp",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "logspace", "logsumexp", "lstsq", "lu", "lu_unpack", "masked_fill",
+    "masked_scatter", "masked_select", "matmul", "matrix_exp",
+    "matrix_norm", "matrix_power", "matrix_rank", "max", "maximum",
+    "mean", "median", "meshgrid", "min", "minimum", "mm", "mod", "mode",
+    "moveaxis", "multi_dot", "multigammaln", "multinomial", "multiplex",
+    "multiply", "mv", "nan_to_num", "nanmean", "nanmedian", "nanquantile",
+    "nansum", "neg", "negative", "nextafter", "nonzero", "norm", "normal",
+    "normal_", "not_equal", "numel", "ones", "ones_like", "ormqr",
+    "outer", "pca_lowrank", "pdist", "pinv", "poisson", "polygamma",
+    "positive", "pow", "prod", "put_along_axis", "qr", "quantile",
+    "rad2deg", "rand", "rand_like", "randint", "randint_like", "randn",
+    "randn_like", "randperm", "rank", "real", "reciprocal", "remainder",
+    "renorm", "repeat_interleave", "reshape", "reshape_", "roll", "rot90",
+    "round", "row_stack", "rsqrt", "scale", "scatter", "scatter_nd",
+    "scatter_nd_add", "searchsorted", "select_scatter", "shape",
+    "sigmoid", "sign", "signbit", "sin", "sinc", "sinh", "slice",
+    "slice_scatter", "slogdet", "solve", "sort", "split", "sqrt",
+    "square", "squeeze", "stack", "standard_normal", "stanh", "std",
+    "strided_slice", "subtract", "sum", "svd", "svd_lowrank", "svdvals",
+    "swapaxes", "t", "take", "take_along_axis", "tan", "tanh",
+    "tensor_split", "tensordot", "tile", "to_tensor", "tolist", "topk",
+    "trace", "transpose", "trapezoid", "triangular_solve", "tril",
+    "tril_indices", "triu", "triu_indices", "trunc", "unbind",
+    "unflatten", "unfold_windows", "uniform", "uniform_", "unique",
+    "unique_consecutive", "unsqueeze", "unsqueeze_", "unstack", "vander",
+    "var", "vecdot", "vector_norm", "view", "view_as", "vsplit", "vstack",
+    "where", "zeros", "zeros_like",
+]

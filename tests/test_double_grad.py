@@ -1,0 +1,192 @@
+"""Eager double-grad: paddle.grad(create_graph=True).
+
+Reference contract: the eager engine's higher-order grad nodes
+(paddle/fluid/eager/backward.cc create_graph path) — gradient penalties
+(WGAN-GP) and grad-of-grad must work imperatively, not only through the
+functional autograd.hessian API.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestDoubleGradBasics:
+    def test_grad_of_grad_cubic(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "f4"),
+                             stop_gradient=False)
+        y = (x ** 3).sum()
+        g1, = paddle.grad(y, x, create_graph=True)
+        assert not g1.stop_gradient
+        np.testing.assert_allclose(g1.numpy(), [3.0, 12.0, 27.0], rtol=1e-6)
+        g2, = paddle.grad(g1.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), [6.0, 12.0, 18.0], rtol=1e-6)
+
+    def test_matches_functional_hessian(self):
+        # f(x) = sum(x^3) + x0*x1 — imperative grad-of-grad must equal
+        # autograd.hessian row by row
+        xv = np.array([0.7, -1.3, 2.1], "f4")
+
+        def f(x):
+            return (x ** 3).sum() + x[0] * x[1]
+
+        xh = paddle.to_tensor(xv, stop_gradient=False)
+        H = paddle.autograd.hessian(f, xh)
+        H = H.numpy() if hasattr(H, "numpy") else np.asarray(H)
+
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        y = f(x)
+        g1, = paddle.grad(y, x, create_graph=True)
+        rows = []
+        for i in range(3):
+            gi, = paddle.grad(g1[i], x, retain_graph=True)
+            rows.append(gi.numpy())
+        np.testing.assert_allclose(np.stack(rows), H, rtol=1e-5, atol=1e-5)
+
+    def test_mixed_partials(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        y = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        f = x * y * y
+        gx, = paddle.grad(f, x, create_graph=True)
+        gxy, = paddle.grad(gx, y)
+        np.testing.assert_allclose(gxy.numpy(), 6.0, rtol=1e-6)
+
+    def test_second_grad_backward_into_leaf(self):
+        x = paddle.to_tensor(np.array([2.0], "f4"), stop_gradient=False)
+        y = (x ** 4).sum()
+        g1, = paddle.grad(y, x, create_graph=True)
+        loss = (g1 ** 2).sum()                 # 16 x^6
+        loss.backward()                        # d/dx = 96 x^5
+        np.testing.assert_allclose(x.grad.numpy(), [96.0 * 2 ** 5],
+                                   rtol=1e-5)
+
+    def test_grad_outputs_graph_flows(self):
+        # the grad_outputs seed itself carries a graph; its contribution
+        # must appear in the second derivative
+        x = paddle.to_tensor(np.array([1.5], "f4"), stop_gradient=False)
+        y = x * x                              # dy/dx = 2x
+        seed = x * 3.0                         # seeded vjp: g1 = 2x * 3x = 6x^2
+        g1, = paddle.grad(y, x, grad_outputs=seed, create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), [6.0 * 1.5 ** 2], rtol=1e-6)
+        g2, = paddle.grad(g1, x)               # 12x
+        np.testing.assert_allclose(g2.numpy(), [18.0], rtol=1e-6)
+
+    def test_allow_unused_taped(self):
+        x = paddle.to_tensor(np.array([1.0], "f4"), stop_gradient=False)
+        z = paddle.to_tensor(np.array([1.0], "f4"), stop_gradient=False)
+        y = (x * x).sum()
+        gx, gz = paddle.grad(y, [x, z], create_graph=True, allow_unused=True)
+        assert gz is None
+        gx2, gz2 = paddle.grad(y, [x, z], create_graph=True)
+        np.testing.assert_allclose(gz2.numpy(), [0.0])
+
+    def test_triple_grad(self):
+        x = paddle.to_tensor(np.array([2.0], "f4"), stop_gradient=False)
+        y = (x ** 4).sum()
+        g1, = paddle.grad(y, x, create_graph=True)      # 4x^3
+        g2, = paddle.grad(g1, x, create_graph=True)     # 12x^2
+        g3, = paddle.grad(g2, x)                        # 24x
+        np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-5)
+
+
+class TestDoubleGradPyLayer:
+    def test_pylayer_double_grad(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a * a
+
+            @staticmethod
+            def backward(ctx, dy):
+                a, = ctx.saved_tensor()
+                return 3.0 * a * a * dy
+
+        x = paddle.to_tensor(np.array([2.0], "f4"), stop_gradient=False)
+        y = Cube.apply(x).sum()
+        g1, = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), [12.0], rtol=1e-6)
+        g2, = paddle.grad(g1, x)                        # 6x
+        np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)
+
+
+class TestWGANGP:
+    """The canonical double-grad workload: WGAN-GP gradient penalty."""
+
+    def _build(self):
+        paddle.seed(7)
+        return nn.Sequential(
+            nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+
+    @staticmethod
+    def _gp_loss(disc, real, fake, alpha):
+        interp = real * alpha + fake * (1.0 - alpha)
+        interp.stop_gradient = False
+        d_interp = disc(interp)
+        g, = paddle.grad(d_interp.sum(), interp, create_graph=True)
+        gnorm = (g * g).sum(axis=1).sqrt()
+        return ((gnorm - 1.0) ** 2).mean()
+
+    def test_gradient_penalty_step(self):
+        disc = self._build()
+        rng = np.random.RandomState(0)
+        real = paddle.to_tensor(rng.randn(4, 8).astype("f4"))
+        fake = paddle.to_tensor(rng.randn(4, 8).astype("f4"))
+        alpha = paddle.to_tensor(rng.rand(4, 1).astype("f4"))
+
+        d_loss = disc(fake).mean() - disc(real).mean()
+        gp = self._gp_loss(disc, real, fake, alpha)
+        loss = d_loss + 10.0 * gp
+        loss.backward()
+
+        grads = [p.grad for p in disc.parameters()]
+        assert all(g is not None for g in grads)
+        assert all(np.isfinite(g.numpy()).all() for g in grads)
+        # the penalty term must actually reach the weights: its
+        # contribution is second-order, absent without create_graph
+        assert any(np.abs(g.numpy()).max() > 1e-6 for g in grads)
+
+    def test_gradient_penalty_matches_finite_difference(self):
+        disc = self._build()
+        rng = np.random.RandomState(1)
+        real = paddle.to_tensor(rng.randn(3, 8).astype("f4"))
+        fake = paddle.to_tensor(rng.randn(3, 8).astype("f4"))
+        alpha = paddle.to_tensor(rng.rand(3, 1).astype("f4"))
+
+        gp = self._gp_loss(disc, real, fake, alpha)
+        gp.backward()
+        w0 = disc[0].weight
+        analytic = np.asarray(w0.grad.numpy(), "f8")
+
+        # FD on the first linear's weight, a handful of entries
+        eps = 1e-3
+        base = w0.numpy().copy()
+        for idx in [(0, 0), (3, 7), (5, 2)]:
+            for sgn, store in ((1, "p"), (-1, "m")):
+                pert = base.copy()
+                pert[idx] += sgn * eps
+                w0.set_value(pert)
+                for p in disc.parameters():
+                    p.clear_grad()
+                val = self._gp_loss(disc, real, fake, alpha)
+                if sgn == 1:
+                    fp = float(val.numpy())
+                else:
+                    fm = float(val.numpy())
+            w0.set_value(base)
+            fd = (fp - fm) / (2 * eps)
+            np.testing.assert_allclose(analytic[idx], fd, rtol=5e-2,
+                                       atol=5e-4)
+
+
+class TestDoubleGradErrors:
+    def test_freed_graph_raises(self):
+        x = paddle.to_tensor(np.array([1.0], "f4"), stop_gradient=False)
+        y = (x ** 3).sum()
+        g1, = paddle.grad(y, x, create_graph=True, retain_graph=False)
+        with pytest.raises(RuntimeError, match="freed"):
+            paddle.grad(g1, x)
+            paddle.grad(g1, x)

@@ -1,0 +1,218 @@
+"""Block-level SOT graph breaks (VERDICT r4 #4).
+
+Reference contract: python/paddle/jit/sot keeps compiled subgraphs
+around an unsupported construct — one host interaction must not un-jit
+the whole forward.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.sot import SegmentPlan
+
+
+def _plan(sf):
+    plans = [v for v in sf._cache.values() if isinstance(v, SegmentPlan)]
+    assert len(plans) == 1, f"expected one SegmentPlan, got {sf._cache}"
+    return plans[0]
+
+
+class TestSegmentedBreak:
+    def _make(self):
+        @paddle.jit.to_static
+        def f(x):
+            y = x * 2.0
+            k = int((y > 0.0).sum())     # host concretization: the break
+            z = y + float(k)
+            return z * 3.0
+        return f
+
+    def test_two_compiled_segments(self):
+        f = self._make()
+        x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], "f4"))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out1 = f(x)
+        assert any("segmented into 2 compiled blocks" in str(m.message)
+                   for m in w), [str(m.message) for m in w]
+        plan = _plan(f)
+        assert plan.n_segments == 2      # prefix + suffix, NOT whole-eager
+        # journal-run result is correct: y + count(y>0), times 3
+        expect = (np.array([2.0, -4.0, 6.0]) + 2.0) * 3.0
+        np.testing.assert_allclose(np.asarray(out1._value), expect,
+                                   rtol=1e-6)
+
+    def test_replay_hits_guard(self):
+        f = self._make()
+        x1 = paddle.to_tensor(np.array([1.0, -2.0, 3.0], "f4"))
+        f(x1)
+        plan = _plan(f)
+        # same values, fresh tensor: host decision identical → replay
+        x2 = paddle.to_tensor(np.array([1.0, -2.0, 3.0], "f4"))
+        out = f(x2)
+        assert plan.replays == 1 and plan.guard_misses == 0
+        expect = (np.array([2.0, -4.0, 6.0]) + 2.0) * 3.0
+        np.testing.assert_allclose(np.asarray(out._value), expect,
+                                   rtol=1e-6)
+
+    def test_guard_miss_falls_back_correctly(self):
+        f = self._make()
+        f(paddle.to_tensor(np.array([1.0, -2.0, 3.0], "f4")))
+        plan = _plan(f)
+        # all-negative input: int() sync reads 0 instead of 2 → miss →
+        # whole-function eager for THIS call, still the right answer
+        x = paddle.to_tensor(np.array([-1.0, -2.0, -3.0], "f4"))
+        out = f(x)
+        assert plan.guard_misses == 1 and plan.replays == 0
+        expect = (np.array([-2.0, -4.0, -6.0]) + 0.0) * 3.0
+        np.testing.assert_allclose(np.asarray(out._value), expect,
+                                   rtol=1e-6)
+
+    def test_gradients_flow_through_replay(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                h = self.fc(x)
+                k = int((h > 0.0).sum())
+                return (h * float(1 + k)).sum()
+
+        paddle.seed(3)
+        net = Net()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype("f4"))
+        net(x)                            # journal run
+        plan = _plan(net.forward)
+        loss = net(x)                     # replayed, same values
+        assert plan.replays == 1
+        loss.backward()
+        g_static = np.asarray(net.fc.weight.grad.numpy())
+
+        # eager reference on an identical net
+        paddle.seed(3)
+        ref = Net()
+        h = ref.fc(x)
+        k = int(np.asarray(((h > 0.0).sum())._value))
+        loss_ref = (h * float(1 + k)).sum()
+        loss_ref.backward()
+        g_eager = np.asarray(ref.fc.weight.grad.numpy())
+        np.testing.assert_allclose(g_static, g_eager, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_rng_refuses_segmentation(self):
+        @paddle.jit.to_static
+        def f(x):
+            k = int((x > 0.0).sum())
+            return x * paddle.rand(x.shape) + float(k)
+
+        from paddle_tpu.jit import _GRAPH_BREAK
+        x = paddle.to_tensor(np.array([0.5, -0.5], "f4"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(x)
+        assert _GRAPH_BREAK in f._cache.values()   # eager, not segmented
+
+    def test_returned_arg_remapped_per_call(self):
+        # code-review r5 regression: an arg returned unchanged (never
+        # consumed by a segment) must be the CURRENT call's tensor, not
+        # the first call's baked constant
+        @paddle.jit.to_static
+        def f(x, y):
+            k = int((y > 0.0).sum())
+            return x, y + float(k)
+
+        x1 = paddle.to_tensor(np.array([1.0], "f4"))
+        y = paddle.to_tensor(np.array([0.5], "f4"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(x1, y)
+        x2 = paddle.to_tensor(np.array([42.0], "f4"))
+        out_x, out_y = f(x2, y)           # replayed (same y → guard hit)
+        np.testing.assert_allclose(np.asarray(out_x._value), [42.0])
+
+    def test_inplace_op_refuses_segmentation(self):
+        # code-review r5 regression: the in-place rebind side effect is
+        # invisible to the journal → must stay whole-function eager
+        @paddle.jit.to_static
+        def f(x):
+            k = int((x > 0.0).sum())
+            h = x * 2.0
+            h.add_(1.0)
+            return h + float(k)
+
+        from paddle_tpu.jit import _GRAPH_BREAK
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = f(paddle.to_tensor(np.array([1.0, -1.0], "f4")))
+        assert _GRAPH_BREAK in f._cache.values()
+        np.testing.assert_allclose(np.asarray(out._value), [4.0, -0.0])
+
+    def test_ndarray_arg_refuses_segmentation(self):
+        # code-review r5 #1: raw array args can't be remapped per call —
+        # must stay whole-function eager (which re-reads them correctly)
+        @paddle.jit.to_static
+        def f(x, w):
+            k = int((x > 0.0).sum())
+            return x * paddle.to_tensor(w) + float(k)
+
+        from paddle_tpu.jit import _GRAPH_BREAK
+        x = paddle.to_tensor(np.array([1.0, -1.0], "f4"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out1 = f(x, np.full((2,), 10.0, "f4"))
+        assert _GRAPH_BREAK in f._cache.values()
+        out2 = f(x, np.full((2,), 99.0, "f4"))   # same spec key
+        np.testing.assert_allclose(np.asarray(out2._value),
+                                   [100.0, -98.0])
+
+    def test_host_path_op_guarded_via_numpy_sync(self):
+        # code-review r5 #2: a host-computing op (nms host path) reads
+        # via np.asarray(Tensor) which journals a sync — changed inputs
+        # must guard-miss, not replay stale indices
+        from paddle_tpu.vision.ops import nms
+
+        @paddle.jit.to_static
+        def f(x, boxes, scores):
+            k = int((x > 0.0).sum())        # the graph break
+            keep = nms(boxes, 0.5, scores=scores)
+            return x.sum() * 0.0 + scores[keep].sum() + float(k)
+
+        rs = np.random.RandomState(0)
+        xy = rs.rand(12, 2) * 50
+        b1 = np.concatenate([xy, xy + rs.rand(12, 2) * 20 + 1],
+                            1).astype("f4")
+        s1 = rs.rand(12).astype("f4")
+        x = paddle.to_tensor(np.array([1.0], "f4"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(x, paddle.to_tensor(b1), paddle.to_tensor(s1))
+        # different boxes/scores, same shapes: must NOT reuse plan
+        xy2 = rs.rand(12, 2) * 50
+        b2 = np.concatenate([xy2, xy2 + rs.rand(12, 2) * 20 + 1],
+                            1).astype("f4")
+        s2 = rs.rand(12).astype("f4")
+        out = f(x, paddle.to_tensor(b2), paddle.to_tensor(s2))
+        # golden: pure eager
+        keep = nms(paddle.to_tensor(b2), 0.5,
+                   scores=paddle.to_tensor(s2)).numpy()
+        expect = s2[keep].sum() + 1.0
+        np.testing.assert_allclose(float(np.asarray(out._value)), expect,
+                                   rtol=1e-5)
+
+    def test_sot_disabled_raises(self):
+        @paddle.jit.to_static
+        def f(x):
+            return x + float(int((x > 0.0).sum()))
+
+        paddle.jit.enable_sot(False)
+        try:
+            with pytest.raises(Exception):
+                f(paddle.to_tensor(np.array([1.0], "f4")))
+        finally:
+            paddle.jit.enable_sot(True)
