@@ -37,6 +37,11 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
 
     Returns (out_neighbors, out_count[, out_eids]).
     """
+    if return_eids and eids is None:
+        # reference requires eids here; silently substituting CSC
+        # positions would hand callers wrong edge features (ADVICE r4 #3)
+        raise ValueError(
+            "graph_sample_neighbors: return_eids=True requires eids")
     rowv, cp, nodes = _np(row), _np(colptr), _np(input_nodes).reshape(-1)
     ev = _np(eids) if eids is not None else None
     rng = _rng()
@@ -51,7 +56,7 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
         neigh.append(rowv[idx])
         counts.append(len(idx))
         if return_eids:
-            out_eids.append(ev[idx] if ev is not None else idx)
+            out_eids.append(ev[idx])
     cat = np.concatenate(neigh) if neigh else np.empty(0, rowv.dtype)
     out = (Tensor(jnp.asarray(cat)),
            Tensor(jnp.asarray(np.asarray(counts, np.int32))))

@@ -195,29 +195,54 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     limit = max_step_num if max_step_num is not None else float("inf")
 
     def _all_done(f):
-        return bool(np.all(np.asarray(ensure_tensor(f)._value)))
+        # .numpy() (not a raw ._value read) so the readback registers
+        # with the SOT journal: the decode trip count is a host decision
+        # that segment replay must guard on (jit/sot.py)
+        return bool(np.all(ensure_tensor(f).numpy()))
 
     while time < limit and not _all_done(finished):
         outs, states, inputs, finished = decoder.step(time, inputs, states,
                                                       **kwargs)
         step_outputs.append(outs)
         time += 1
-    if not step_outputs:
-        raise ValueError(
-            "dynamic_decode ran zero steps (all sequences were finished "
-            "at initialization, or max_step_num=0) — nothing to decode")
-
     def _stack(field_vals):
         ts = [ensure_tensor(v) for v in field_vals]
         return call_op(lambda *vs: jnp.stack(vs, 0), *ts)
 
-    first = step_outputs[0]
-    if hasattr(first, "_fields"):
-        stacked = type(first)(*[
-            _stack([getattr(o, f) for o in step_outputs])
-            for f in first._fields])
+    if not step_outputs:
+        # reference returns EMPTY (time-major length 0) outputs when no
+        # step runs (max_step_num=0 / everything finished at init) —
+        # serving loops must not crash (ADVICE r4 #5).  Probe one step
+        # with the initial state purely to learn the output structure;
+        # its states/inputs are discarded.  Decoders whose step is
+        # invalid once everything is finished keep the r4 behavior: a
+        # clear error instead of a silent wrong guess.
+        try:
+            probe, _, _, _ = decoder.step(time, inputs, states, **kwargs)
+        except Exception as e:
+            raise ValueError(
+                "dynamic_decode ran zero steps (all sequences were "
+                "finished at initialization, or max_step_num=0) and the "
+                "decoder's step could not be probed for the empty output "
+                "structure — nothing to decode") from e
+
+        def _empty(v):
+            t = ensure_tensor(v)
+            return call_op(lambda x: jnp.zeros((0,) + x.shape, x.dtype),
+                           t)
+        if hasattr(probe, "_fields"):
+            stacked = type(probe)(*[
+                _empty(getattr(probe, f)) for f in probe._fields])
+        else:
+            stacked = _empty(probe)
     else:
-        stacked = _stack(step_outputs)
+        first = step_outputs[0]
+        if hasattr(first, "_fields"):
+            stacked = type(first)(*[
+                _stack([getattr(o, f) for o in step_outputs])
+                for f in first._fields])
+        else:
+            stacked = _stack(step_outputs)
 
     seq_len = states.lengths if hasattr(states, "lengths") else None
     final_outputs, final_states = decoder.finalize(stacked, states, seq_len)

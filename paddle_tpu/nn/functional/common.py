@@ -485,6 +485,10 @@ def gather_tree(ids, parents, name=None):
     def _gt(idv, par):
         par = par.astype(jnp.int32)   # carry dtype stable under x64
         T = idv.shape[0]
+        if T == 0:
+            # zero decode steps: nothing to walk (scan would still trace
+            # idv[t] into the empty axis and fail)
+            return idv
         beams = jnp.arange(idv.shape[2])
 
         def step(carry, t):
@@ -573,6 +577,10 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     else:
         pos = np.unique(lab)
     C, S = int(num_classes), int(num_samples)
+    if S > C:
+        raise ValueError(
+            f"class_center_sample: num_samples ({S}) must not exceed "
+            f"num_classes ({C})")
     if pos.size >= S:
         sampled = pos
     else:

@@ -694,7 +694,15 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
 
     out = call_op(_roc if curve == "ROC" else _pr,
                   input.detach(), label.detach())
-    return out, out, []
+    # states tuple: the reference returns four histogram stat tensors
+    # [batch_stat_pos, batch_stat_neg, stat_pos, stat_neg] that callers
+    # commonly unpack/index; the exact (non-histogram) computation here
+    # does not need them, so they are zero-filled placeholders keeping
+    # the unpacking contract (ADVICE r4 #4)
+    from .. import zeros as _zeros
+    states = [_zeros([1, num_thresholds + 1], dtype="int64")
+              for _ in range(4)]
+    return out, out, states
 
 
 __all__ += ["Variable", "create_global_var", "set_program_state", "save",

@@ -490,7 +490,10 @@ def test_jit_save_polymorphic_batch(tmp_path):
             return self.l(a + b)
 
     net2 = TwoIn()
-    jit.save(net2, str(tmp_path / "m2"),
+    # r5 (ADVICE r4 #1): leading None dims are independent per input by
+    # default; a model that COMBINES inputs along batch ties them
+    # explicitly
+    jit.save(net2, str(tmp_path / "m2"), tie_batch_dims=True,
              input_spec=[InputSpec([None, 4], "float32"),
                          InputSpec([None, 4], "float32")])
     loaded2 = jit.load(str(tmp_path / "m2"))
