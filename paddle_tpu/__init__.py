@@ -21,6 +21,7 @@ from .framework.io import save, load  # noqa: F401
 from .framework import random as _random
 
 from .tensor import *  # noqa: F401,F403
+from .tensor import linalg  # noqa: F401  (paddle.linalg namespace)
 from .tensor import creation as _creation
 
 from . import nn  # noqa: F401

@@ -20,3 +20,45 @@ from .layer import common as _common
 from .layer import norm as _norm
 from .layer import activation as _activation
 from .layer import loss as _loss
+
+
+# Public surface (namespace hygiene, VERDICT r4 #8): tape/dispatch
+# helpers (call_op, ensure_tensor, unary_op, ...) are implementation
+# details — they stay importable for in-package use but are not part of
+# the API surface that `import *` / docs/API_REFERENCE.md expose.
+__all__ = [
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+    "AdaptiveLogSoftmaxWithLoss", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool2D", "AdaptiveMaxPool3D", "AlphaDropout", "AvgPool1D",
+    "AvgPool2D", "AvgPool3D", "BCELoss", "BCEWithLogitsLoss", "BatchNorm",
+    "BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "BeamSearchDecoder",
+    "BiRNN", "Bilinear", "CELU", "CTCLoss", "ChannelShuffle",
+    "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+    "Constant", "Conv1D", "Conv1DTranspose", "Conv2D", "Conv2DTranspose",
+    "Conv3D", "Conv3DTranspose", "CosineEmbeddingLoss",
+    "CosineSimilarity", "CrossEntropyLoss", "Decoder", "Dropout",
+    "Dropout2D", "Dropout3D", "ELU", "Embedding", "FeatureAlphaDropout",
+    "Flatten", "Fold", "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "GELU", "GLU", "GRU", "GRUCell", "GaussianNLLLoss", "GroupNorm",
+    "HSigmoidLoss", "Hardshrink", "Hardsigmoid", "Hardswish", "Hardtanh",
+    "HingeEmbeddingLoss", "HuberLoss", "Identity", "InstanceNorm1D",
+    "InstanceNorm2D", "InstanceNorm3D", "KLDivLoss", "KaimingUniform",
+    "L1Loss", "LSTM", "LSTMCell", "Layer", "LayerDict", "LayerList",
+    "LayerNorm", "LeakyReLU", "Linear", "LocalResponseNorm", "LogSigmoid",
+    "LogSoftmax", "MSELoss", "MarginRankingLoss", "MaxPool1D",
+    "MaxPool2D", "MaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "Maxout", "Mish", "MultiHeadAttention", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "NLLLoss", "Normal", "PReLU", "Pad1D", "Pad2D",
+    "Pad3D", "PairwiseDistance", "ParameterList", "PixelShuffle",
+    "PixelUnshuffle", "PoissonNLLLoss", "RMSNorm", "RNN", "RNNCellBase",
+    "RNNTLoss", "RReLU", "ReLU", "ReLU6", "SELU", "Sequential", "Sigmoid",
+    "Silu", "SimpleRNN", "SimpleRNNCell", "SmoothL1Loss",
+    "SoftMarginLoss", "Softmax", "Softmax2D", "Softplus", "Softshrink",
+    "Softsign", "SpectralNorm", "Swish", "SyncBatchNorm", "Tanh",
+    "Tanhshrink", "ThresholdedReLU", "Transformer", "TransformerDecoder",
+    "TransformerDecoderLayer", "TransformerEncoder",
+    "TransformerEncoderLayer", "TripletMarginLoss",
+    "TripletMarginWithDistanceLoss", "Unflatten", "Unfold", "Upsample",
+    "UpsamplingBilinear2D", "UpsamplingNearest2D", "XavierNormal",
+    "ZeroPad2D", "dynamic_decode",
+]

@@ -65,8 +65,6 @@ def empty_like(x, dtype=None, name=None):
 
 
 def arange(start=0, end=None, step=1, dtype=None, name=None):
-    for v in ("start", "end", "step"):
-        pass
     start = start.item() if isinstance(start, Tensor) else start
     end = end.item() if isinstance(end, Tensor) else end
     step = step.item() if isinstance(step, Tensor) else step
