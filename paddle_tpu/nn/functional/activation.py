@@ -50,6 +50,9 @@ def prelu(x, weight, data_format="NCHW", name=None):
     def _prelu(v, w):
         if w.size == 1:
             wb = w.reshape(())
+        elif tuple(w.shape) == tuple(v.shape[1:]):
+            # element mode: one alpha per element, broadcast over batch
+            wb = w.reshape((1,) + tuple(v.shape[1:]))
         elif data_format == "NCHW":
             wb = w.reshape((1, -1) + (1,) * (v.ndim - 2))
         else:

@@ -272,13 +272,34 @@ def lu(x, pivot=True, get_infos=False, name=None):
     return call_op(_lu, x)
 
 
+def _householder_q(a, t):
+    """Explicit reflector product Q = H_0 H_1 ... H_{k-1} (thin, m x k).
+
+    Used instead of lax.linalg.householder_product: the LAPACK-backed
+    primitive has no JAX differentiation rule, while this composition is
+    plain jnp ops — differentiable (check_grad in
+    tests/test_grad_checks_r5.py) and MXU-friendly (k small rank-1
+    updates on one (m, m) carrier).  Shared by householder_product and
+    ormqr."""
+    m, k = a.shape[-2], a.shape[-1]
+    rows = jnp.arange(m)
+    q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype),
+                         a.shape[:-2] + (m, m))
+    for i in range(k - 1, -1, -1):
+        v = a[..., :, i]
+        v = jnp.where(rows < i, jnp.zeros_like(v), v)
+        v = jnp.where(rows == i, jnp.ones_like(v), v)
+        vq = jnp.einsum("...m,...mn->...n", v, q)
+        q = q - t[..., i, None, None] * v[..., :, None] * vq[..., None, :]
+    return q[..., :, :k]
+
+
 def householder_product(x, tau, name=None):
     """Q from Householder reflectors (reference:
     paddle.linalg.householder_product; LAPACK orgqr)."""
     x = ensure_tensor(x)
     tau = ensure_tensor(tau)
-    return call_op(
-        lambda a, t: jax.lax.linalg.householder_product(a, t), x, tau)
+    return call_op(_householder_q, x, tau)
 
 
 def pdist(x, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
@@ -370,9 +391,10 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
 
     def _ormqr(a, t, other):
         # materialize Q from the householder reflectors (batched,
-        # LAPACK orgqr semantics), then one MXU matmul — the TPU-native
+        # LAPACK orgqr semantics, shared differentiable composition —
+        # see _householder_q), then one MXU matmul — the TPU-native
         # form of LAPACK's reflector application
-        Q = jax.lax.linalg.householder_product(a, t)
+        Q = _householder_q(a, t)
         Qm = jnp.swapaxes(Q, -1, -2) if transpose else Q
         return Qm @ other if left else other @ Qm
     return call_op(_ormqr, x, tau, y)

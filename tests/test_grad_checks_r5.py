@@ -1,0 +1,318 @@
+"""Finite-difference gradient checks (SURVEY §4 OpTest pattern) for the
+round-4 surface-sweep ops (VERDICT r4 #5: the sweep added dozens of
+differentiable ops with forward-only tests; check_grad is the OpTest
+default and is applied retroactively here).
+
+Index/mask arguments are closed over (not differentiable); every float
+input is finite-differenced.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+F = paddle.nn.functional
+
+
+class TestTakeScatterFamilyGrads(OpTest):
+    def test_take_grad(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 4)
+        idx = paddle.to_tensor(np.array([0, 5, 11, 3]))
+        self.check_grad(lambda xt: paddle.take(xt, idx), [x])
+
+    def test_take_along_axis_grad(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(3, 4)
+        idx = paddle.to_tensor(np.array([[0, 2, 1, 3]], dtype=np.int64))
+        self.check_grad(
+            lambda xt: paddle.take_along_axis(xt, idx, axis=1), [x])
+
+    def test_put_along_axis_assign_grad(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(3, 4)
+        v = rs.randn(1, 4)
+        idx = paddle.to_tensor(np.array([[0, 2, 1, 0]], dtype=np.int64))
+        self.check_grad(
+            lambda xt, vt: paddle.put_along_axis(xt, idx, vt, axis=0),
+            [x, v])
+
+    def test_put_along_axis_add_grad(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(3, 4)
+        v = rs.randn(1, 4)
+        idx = paddle.to_tensor(np.array([[1, 1, 2, 0]], dtype=np.int64))
+        self.check_grad(
+            lambda xt, vt: paddle.put_along_axis(xt, idx, vt, axis=0,
+                                                 reduce="add"), [x, v])
+
+    def test_scatter_grad(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 3)
+        u = rs.randn(2, 3)
+        idx = paddle.to_tensor(np.array([1, 3]))
+        self.check_grad(
+            lambda xt, ut: paddle.scatter(xt, idx, ut, overwrite=True),
+            [x, u])
+
+    def test_scatter_nd_grad(self):
+        rs = np.random.RandomState(5)
+        u = rs.randn(2, 3)
+        idx = paddle.to_tensor(np.array([[1], [3]], dtype=np.int64))
+        self.check_grad(
+            lambda ut: paddle.scatter_nd(idx, ut, [5, 3]), [u])
+
+    def test_scatter_nd_add_grad(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(5, 3)
+        u = rs.randn(2, 3)
+        idx = paddle.to_tensor(np.array([[1], [1]], dtype=np.int64))
+        self.check_grad(
+            lambda xt, ut: paddle.scatter_nd_add(xt, idx, ut), [x, u])
+
+    def test_index_add_grad(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(4, 3)
+        v = rs.randn(2, 3)
+        idx = paddle.to_tensor(np.array([0, 2]))
+        self.check_grad(
+            lambda xt, vt: paddle.index_add(xt, idx, 0, vt), [x, v])
+
+    def test_index_put_grad(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(4, 3)
+        v = rs.randn(2)
+        i0 = paddle.to_tensor(np.array([1, 3]))
+        i1 = paddle.to_tensor(np.array([0, 2]))
+        self.check_grad(
+            lambda xt, vt: paddle.index_put(xt, (i0, i1), vt), [x, v])
+
+    def test_index_fill_grad(self):
+        rs = np.random.RandomState(9)
+        x = rs.randn(4, 3)
+        idx = paddle.to_tensor(np.array([0, 2]))
+        self.check_grad(
+            lambda xt: paddle.index_fill(xt, idx, 0, 1.5), [x])
+
+    def test_masked_fill_grad(self):
+        rs = np.random.RandomState(10)
+        x = rs.randn(3, 4)
+        mask = paddle.to_tensor(rs.rand(3, 4) > 0.5)
+        self.check_grad(
+            lambda xt: paddle.masked_fill(xt, mask, 2.0), [x])
+
+    def test_masked_scatter_grad(self):
+        rs = np.random.RandomState(11)
+        x = rs.randn(3, 4)
+        v = rs.randn(12)
+        mask = paddle.to_tensor(rs.rand(3, 4) > 0.5)
+        self.check_grad(
+            lambda xt, vt: paddle.masked_scatter(xt, mask, vt), [x, v])
+
+    def test_select_scatter_grad(self):
+        rs = np.random.RandomState(12)
+        x = rs.randn(3, 4)
+        v = rs.randn(4)
+        self.check_grad(
+            lambda xt, vt: paddle.select_scatter(xt, vt, axis=0, index=1),
+            [x, v])
+
+    def test_slice_scatter_grad(self):
+        rs = np.random.RandomState(13)
+        x = rs.randn(4, 5)
+        v = rs.randn(4, 2)
+        self.check_grad(
+            lambda xt, vt: paddle.slice_scatter(
+                xt, vt, axes=[1], starts=[1], ends=[3], strides=[1]),
+            [x, v])
+
+    def test_diagonal_scatter_grad(self):
+        rs = np.random.RandomState(14)
+        x = rs.randn(4, 4)
+        v = rs.randn(4)
+        self.check_grad(
+            lambda xt, vt: paddle.diagonal_scatter(xt, vt), [x, v])
+
+
+class TestSplitFamilyGrads(OpTest):
+    def test_split_grad(self):
+        rs = np.random.RandomState(20)
+        x = rs.randn(6, 4)
+
+        def op(xt):
+            a, b, c = paddle.split(xt, 3, axis=0)
+            return a * 1.0 + b * 2.0 + c * 3.0
+        self.check_grad(op, [x])
+
+    def test_tensor_split_grad(self):
+        rs = np.random.RandomState(21)
+        x = rs.randn(7, 3)
+
+        def op(xt):
+            parts = paddle.tensor_split(xt, 3, axis=0)
+            return sum(paddle.sum(p) * (i + 1)
+                       for i, p in enumerate(parts))
+        self.check_grad(op, [x])
+
+    def test_hsplit_vsplit_dsplit_grad(self):
+        rs = np.random.RandomState(22)
+        x = rs.randn(4, 4, 4)
+
+        def op(xt):
+            h = paddle.hsplit(xt, 2)[0]
+            v = paddle.vsplit(xt, 2)[1]
+            d = paddle.dsplit(xt, 2)[0]
+            return paddle.sum(h) + 2.0 * paddle.sum(v) + 3.0 * paddle.sum(d)
+        self.check_grad(op, [x])
+
+    def test_chunk_grad(self):
+        rs = np.random.RandomState(23)
+        x = rs.randn(6, 2)
+
+        def op(xt):
+            a, b = paddle.chunk(xt, 2, axis=0)
+            return paddle.sum(a * a) + paddle.sum(b * 3.0)
+        self.check_grad(op, [x])
+
+    def test_unstack_grad(self):
+        rs = np.random.RandomState(24)
+        x = rs.randn(3, 4)
+
+        def op(xt):
+            parts = paddle.unstack(xt, axis=0)
+            return sum(paddle.sum(p) * (i + 1)
+                       for i, p in enumerate(parts))
+        self.check_grad(op, [x])
+
+
+class TestGammaFamilyGrads(OpTest):
+    def _pos(self, rs, *shape):
+        return rs.rand(*shape) * 2.0 + 0.5
+
+    def test_lgamma_gammaln_grad(self):
+        rs = np.random.RandomState(30)
+        x = self._pos(rs, 3, 4)
+        self.check_grad(paddle.lgamma, [x])
+        self.check_grad(paddle.gammaln, [x])
+
+    def test_digamma_grad(self):
+        rs = np.random.RandomState(31)
+        self.check_grad(paddle.digamma, [self._pos(rs, 3, 4)])
+
+    def test_polygamma_grad(self):
+        rs = np.random.RandomState(32)
+        self.check_grad(lambda xt: paddle.polygamma(xt, 1),
+                        [self._pos(rs, 3, 3)])
+
+    def test_multigammaln_grad(self):
+        rs = np.random.RandomState(33)
+        x = rs.rand(3, 3) * 2.0 + 3.0     # must exceed (p-1)/2
+        self.check_grad(lambda xt: paddle.multigammaln(xt, 2), [x])
+
+    def test_gammainc_grad_wrt_x(self):
+        rs = np.random.RandomState(34)
+        a = paddle.to_tensor(self._pos(rs, 3, 3))
+        x = self._pos(rs, 3, 3)
+        self.check_grad(lambda xt: paddle.gammainc(a, xt), [x])
+
+    def test_gammaincc_grad_wrt_x(self):
+        rs = np.random.RandomState(35)
+        a = paddle.to_tensor(self._pos(rs, 3, 3))
+        x = self._pos(rs, 3, 3)
+        self.check_grad(lambda xt: paddle.gammaincc(a, xt), [x])
+
+    def test_bessel_i_grad(self):
+        rs = np.random.RandomState(36)
+        x = rs.randn(3, 4)
+        for op in (paddle.i0, paddle.i0e, paddle.i1, paddle.i1e):
+            self.check_grad(op, [x])
+
+
+class TestLinalgGrads(OpTest):
+    def test_lu_unpack_grad(self):
+        rs = np.random.RandomState(40)
+        a = rs.randn(3, 3) + np.eye(3) * 3.0
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(a.astype("f4")))
+        lu_np = np.asarray(lu._value)
+
+        def op(lut):
+            p, l_mat, u = paddle.linalg.lu_unpack(lut, piv)
+            return paddle.sum(l_mat * 2.0) + paddle.sum(u * 3.0)
+        self.check_grad(op, [lu_np])
+
+    def test_ormqr_grad(self):
+        # grads wrt all three float inputs: reflectors, tau, other
+        rs = np.random.RandomState(41)
+        inp = rs.randn(4, 3) * 0.5
+        tau = rs.rand(3) * 0.5
+        other = rs.randn(3, 4)
+        self.check_grad(
+            lambda it, tt, ot: paddle.linalg.ormqr(it, tt, ot),
+            [inp, tau, other], rtol=3e-2, atol=3e-3)
+
+    def test_householder_product_grad(self):
+        rs = np.random.RandomState(42)
+        a = rs.randn(4, 3) * 0.5
+        tau = rs.rand(3) * 0.5
+        self.check_grad(paddle.householder_product, [a, tau],
+                        rtol=3e-2, atol=3e-3)
+
+
+class TestNNExtrasGrads(OpTest):
+    def test_embedding_bag_grads(self):
+        rs = np.random.RandomState(50)
+        w = rs.randn(10, 4) * 0.5
+        ids = paddle.to_tensor(np.array([[1, 2, 3], [4, 5, 6]]))
+        for mode in ("mean", "sum"):
+            self.check_grad(
+                lambda wt, m=mode: F.embedding_bag(ids, wt, mode=m), [w])
+
+    def test_prelu_element_mode_grads(self):
+        rs = np.random.RandomState(51)
+        x = rs.randn(2, 3, 4)
+        alpha = rs.rand(3, 4) * 0.5
+
+        def op(xt, at):
+            return F.prelu(xt, at)
+        self.check_grad(op, [x, alpha])
+
+    def test_glu_grad(self):
+        rs = np.random.RandomState(52)
+        self.check_grad(lambda xt: F.glu(xt, axis=-1), [rs.randn(3, 8)])
+
+    def test_fused_mha_with_mask_grad(self):
+        # fused op with an attention mask: mask is closed over, all
+        # float inputs checked (VERDICT "fused ops with masks" row)
+        rs = np.random.RandomState(53)
+        B, S, H, Dh = 1, 3, 1, 4
+        C = H * Dh
+        x = rs.randn(B, S, C) * 0.5
+        wq = rs.randn(3, H, Dh, C) * 0.2
+        wl = rs.randn(C, C) * 0.2
+        mask = np.zeros((B, H, S, S), "f4")
+        mask[..., 2] = -1e9               # mask out the last key
+        mask_t = paddle.to_tensor(mask)
+        Fi = paddle.incubate.nn.functional
+
+        def op(xt, wqt, wlt):
+            return Fi.fused_multi_head_attention(
+                xt, wqt, wlt, attn_mask=mask_t, dropout_rate=0.0,
+                attn_dropout_rate=0.0, training=False)
+        self.check_grad(op, [x, wq, wl], rtol=3e-2, atol=3e-3)
+
+    def test_softmax_mask_fuse_grad(self):
+        rs = np.random.RandomState(54)
+        Fi = paddle.incubate.nn.functional
+        if not hasattr(Fi, "softmax_mask_fuse"):
+            return
+        x = rs.randn(1, 1, 4, 4)
+        mask = paddle.to_tensor(
+            (rs.rand(1, 1, 4, 4) > 0.3).astype("f4") * -1e9)
+        self.check_grad(lambda xt: Fi.softmax_mask_fuse(xt, mask), [x],
+                        rtol=3e-2, atol=3e-3)
+
+    def test_gather_nd_grad(self):
+        rs = np.random.RandomState(55)
+        x = rs.randn(3, 4)
+        idx = paddle.to_tensor(np.array([[0, 1], [2, 3]], dtype=np.int64))
+        self.check_grad(lambda xt: paddle.gather_nd(xt, idx), [x])
