@@ -189,6 +189,57 @@ class Engine:
     def main_program(self):
         return None  # jaxpr/HLO is the program; kept for API parity
 
+    def tune(self, batch_size, seq_len=None, n_devices=None,
+             hbm_gb=16.0, stage=2, verbose=False):
+        """Auto-sharding tuner v1 (VERDICT r4 #7): choose
+        (dp, sharding, mp, pp) from the memory + collective-volume cost
+        model in ``tuner.py`` and write the winning degrees into this
+        Engine's Strategy.  Returns the chosen candidate dict.
+
+        Reference: the auto-parallel cost model + tuner
+        (auto_parallel/static/cost/, tuner/) that search the placement
+        space; here the space is the mesh factorization because GSPMD
+        owns per-op partitioning.
+        """
+        import jax as _jax
+        from .tuner import ModelStats, tune as _tune
+        n = n_devices or _jax.device_count()
+        net = self._network
+        cfg = getattr(net, "config", None)
+        if cfg is not None and hasattr(cfg, "hidden_size"):
+            stats = ModelStats.from_config(cfg, batch_size, seq_len)
+        else:
+            stats = ModelStats.from_layer(net, batch_size,
+                                          seq_len or 1024)
+        # pp candidates only for models the pipeline stepper can split
+        allow_pp = self._is_pipeline()
+        best, report = _tune(stats, n, allow_pp=allow_pp, stage=stage,
+                             hbm_gb=hbm_gb)
+        if verbose:
+            for c in report[:8]:
+                print(f"[tune] dp={c['dp']} sh={c['sharding']} "
+                      f"mp={c['mp']} pp={c['pp']} mem={c['mem_gb']}GB "
+                      f"cost={c['cost_s']*1e3:.2f}ms "
+                      f"feasible={c['feasible']}")
+        # write the WHOLE winning placement — including disabling axes a
+        # previous Strategy had on that the winner dropped, or _degrees()
+        # would over-subscribe the mesh
+        s = self._strategy
+        s.dp_degree = best["dp"]
+        s.mp_degree = best["mp"]
+        s.sharding.enable = best["sharding"] > 1
+        s.sharding.degree = best["sharding"]
+        if best["sharding"] > 1:
+            s.sharding.stage = best["stage"]
+        s.pipeline.enable = best["pp"] > 1
+        s.pp_degree = best["pp"]
+        self._model = None        # force plan rebuild with new degrees
+        if getattr(self._network, "_placement_plan", None) is not None:
+            # a prior fit() pinned a plan on the net; the tuned degrees
+            # must not be silently ignored
+            self._network._placement_plan = None
+        return best
+
     # -- user surface --------------------------------------------------------
     def _batches(self, data, batch_size, collate_fn, shuffle,
                  drop_last=False):

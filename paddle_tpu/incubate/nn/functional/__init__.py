@@ -762,3 +762,41 @@ def block_multihead_attention(
 
 
 __all__ += ["blha_get_max_len", "block_multihead_attention"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: paddle.incubate.softmax_mask_fuse — softmax(x + mask)
+    over the last axis in one pass (paddle/phi/kernels/fusion/gpu/
+    fused_softmax_mask_kernel.cu).  TPU-native: XLA fuses the add into
+    the softmax's streaming pass, so this is the jnp composition —
+    the fusion the CUDA kernel hand-writes is the compiler's default
+    here.  x: (B, H, S, S) scores; mask: additive, broadcastable
+    (typically (B, 1, S, S))."""
+    from ....framework.autograd import call_op
+    from ....tensor._helpers import ensure_tensor
+    import jax
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    return call_op(
+        lambda v, m: jax.nn.softmax(
+            v.astype(jnp.float32) + m.astype(jnp.float32),
+            axis=-1).astype(v.dtype), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: paddle.incubate.softmax_mask_fuse_upper_triangle —
+    causal (lower-triangular-visible) masked softmax of (B, H, S, S)
+    attention scores without materializing the mask tensor."""
+    from ....framework.autograd import call_op
+    from ....tensor._helpers import ensure_tensor
+    import jax
+    x = ensure_tensor(x)
+
+    def _f(v):
+        S = v.shape[-1]
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(causal, v.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return call_op(_f, x)
+
+
+__all__ += ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

@@ -289,7 +289,8 @@ def _householder_q(a, t):
         v = a[..., :, i]
         v = jnp.where(rows < i, jnp.zeros_like(v), v)
         v = jnp.where(rows == i, jnp.ones_like(v), v)
-        vq = jnp.einsum("...m,...mn->...n", v, q)
+        # H_i = I - tau_i v v^H (conjugate matters for complex inputs)
+        vq = jnp.einsum("...m,...mn->...n", jnp.conj(v), q)
         q = q - t[..., i, None, None] * v[..., :, None] * vq[..., None, :]
     return q[..., :, :k]
 

@@ -1,0 +1,155 @@
+"""Auto-sharding tuner v1 (VERDICT r4 #7): cost-model units + the
+e2e check that a tuner-picked config trains GPT-hybrid on the 8-device
+CPU mesh with the same loss as the hand-set plan."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel.tuner import (
+    ModelStats, estimate, tune)
+
+
+def _stats_13b(batch=32, seq=2048):
+    # GPT-3 13B-ish: the "needs parallelism" regime
+    return ModelStats(n_params=13_000_000_000, n_layers=40, hidden=5120,
+                      n_heads=40, vocab=50304, batch=batch, seq=seq)
+
+
+def _stats_tiny(batch=16, seq=128):
+    return ModelStats(n_params=1_000_000, n_layers=2, hidden=64,
+                      n_heads=4, vocab=1000, batch=batch, seq=seq)
+
+
+class TestCostModel:
+    def test_pure_dp_infeasible_for_13b(self):
+        # 13B * 18 bytes of p/g/opt alone = 234 GB per device under pure
+        # dp — the model must detect it
+        c = estimate(_stats_13b(), dp=16, sh=1, mp=1, pp=1,
+                     hbm_bytes=16e9)
+        assert not c["feasible"]
+        assert c["mem_gb"] > 100
+
+    def test_sharding_recovers_memory(self):
+        base = estimate(_stats_13b(), dp=16, sh=1, mp=1, pp=1,
+                        hbm_bytes=16e9)
+        shard = estimate(_stats_13b(), dp=1, sh=16, mp=1, pp=1,
+                         stage=3, hbm_bytes=16e9)
+        assert shard["mem_bytes"] < base["mem_bytes"] / 4
+
+    def test_mp_comm_grows_with_degree(self):
+        c2 = estimate(_stats_13b(), dp=8, sh=1, mp=2, pp=1)
+        c8 = estimate(_stats_13b(), dp=2, sh=1, mp=8, pp=1)
+        assert c8["comm_s"] > c2["comm_s"]
+
+    def test_pp_bubble(self):
+        c = estimate(_stats_13b(), dp=4, sh=1, mp=1, pp=4, n_micro=4)
+        assert c["bubble"] == pytest.approx(1.75)
+
+
+class TestTuneSearch:
+    def test_batch_heavy_model_prefers_pure_dp(self):
+        # big batch: TP's activation all-reduces cost more than the
+        # (small, fixed) gradient sync — plain dp must win
+        best, report = tune(_stats_tiny(batch=256), 8, hbm_gb=16.0)
+        assert best["feasible"]
+        assert (best["dp"], best["mp"], best["pp"]) == (8, 1, 1)
+
+    def test_13b_on_64_devices_finds_feasible_hybrid(self):
+        best, report = tune(_stats_13b(), 64, stage=3, hbm_gb=16.0)
+        assert best["feasible"], report[:3]
+        # pure dp can't fit — some model-state-splitting axis must be on
+        assert best["sharding"] > 1 or best["mp"] > 1 or best["pp"] > 1
+
+    def test_13b_on_16_v5e_is_honestly_infeasible(self):
+        # 18 bytes/param of p/g/opt state / 16 devices = 14.6 GB before
+        # a single activation: the tuner must NOT claim this fits
+        best, _ = tune(_stats_13b(), 16, stage=3, hbm_gb=16.0)
+        assert not best["feasible"]
+
+    def test_divisibility_constraints(self):
+        st = ModelStats(n_params=10_000_000, n_layers=3, hidden=96,
+                        n_heads=6, vocab=1000, batch=12, seq=64)
+        _, report = tune(st, 8)
+        for c in report:
+            assert st.n_heads % c["mp"] == 0
+            assert st.n_layers % c["pp"] == 0
+
+    def test_infeasible_everywhere_reports_lowest_memory(self):
+        best, _ = tune(_stats_13b(), 2, hbm_gb=1.0)
+        assert not best["feasible"]
+
+
+class TestEngineTune:
+    def test_engine_tune_writes_strategy(self):
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+        from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+        cfg = GPTConfig(vocab_size=512, hidden_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=64)
+        net = GPTForPretraining(cfg)
+        eng = Engine(net, strategy=Strategy())
+        best = eng.tune(batch_size=8, seq_len=64, n_devices=8)
+        assert best["feasible"]
+        assert eng._strategy.dp_degree == best["dp"]
+        assert eng._strategy.mp_degree == best["mp"]
+
+
+class TestTunedHybridLossParity:
+    """The VERDICT 'done' bar: tuner config runs GPT-hybrid on the
+    8-device mesh and its loss matches the hand-set plan."""
+
+    def _run_fleet(self, dp, mp, pp, n_micro=2):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear, RowParallelLinear, LayerDesc,
+            PipelineLayer)
+
+        paddle.seed(0)
+
+        class TPBlock(nn.Layer):
+            def __init__(self, h=32):
+                super().__init__()
+                self.up = ColumnParallelLinear(h, 2 * h,
+                                               gather_output=False)
+                self.down = RowParallelLinear(2 * h, h,
+                                              input_is_parallel=True)
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+                return self.down(F.gelu(self.up(x)))
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                                   "pp_degree": pp,
+                                   "sharding_degree": 1}
+        strategy.pipeline_configs = {"accumulate_steps": n_micro}
+        fleet.init(is_collective=True, strategy=strategy)
+        pl = PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 16, 32)] +
+                   [LayerDesc(TPBlock, 32) for _ in range(4)] +
+                   [LayerDesc(nn.Linear, 32, 8)],
+            num_stages=pp, loss_fn=nn.MSELoss())
+        model = fleet.distributed_model(pl)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=pl.parameters()))
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 16).astype("f4")
+        y = rng.rand(16, 8).astype("f4")
+        return float(model.train_batch([x, y], opt))
+
+    def test_tuned_config_loss_matches_hand_set(self):
+        # hand-set plan (the dryrun's): dp=2, mp=2, pp=2
+        hand = self._run_fleet(2, 2, 2)
+
+        # tuner choice for the same workload on 8 devices
+        st = ModelStats(n_params=10_000, n_layers=4, hidden=32,
+                        n_heads=4, vocab=16, batch=16, seq=1)
+        best, _ = tune(st, 8, hbm_gb=16.0, allow_sharding=False)
+        assert best["feasible"]
+        tuned = self._run_fleet(best["dp"], best["mp"], best["pp"])
+
+        assert np.isfinite(hand) and np.isfinite(tuned)
+        np.testing.assert_allclose(tuned, hand, rtol=2e-3, atol=2e-4)
