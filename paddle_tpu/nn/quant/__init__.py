@@ -38,17 +38,44 @@ class Stub(Layer):
 
 
 def weight_quantize(x, algo="weight_only_int8", group_size=-1):
-    """(K, N) float weight -> ((K, N) int8 tensor, (N,) fp32 scale).
+    """(K, N) float weight -> (quantized tensor, (N,) fp32 scale).
 
-    ``algo``: weight_only_int8 | llm.int8 (same numeric layout here).
+    ``algo``:
+      * weight_only_int8 | llm.int8 — (K, N) int8, scale = absmax/127.
+      * weight_only_int4 — (K/2, N) int8 holding two nibbles per byte
+        (even K rows in the low nibble, odd in the high; K must be
+        even), scale = absmax/7.  v5e reality: XLA's int4 dtype is
+        stored unpacked (1 byte/element) and the VPU nibble-unpack
+        costs more than fp8's upconvert, so int4 on this chip is a
+        CAPACITY feature (4x smaller checkpoints / HBM weights than
+        fp32, 2x vs int8-or-fp8), not a latency one — the serving
+        latency path is fp8 (1.66x) or int8-MXU (1.32x), see
+        bench.py fp8_linear.
     """
-    if algo not in ("weight_only_int8", "llm.int8"):
+    if algo not in ("weight_only_int8", "llm.int8", "weight_only_int4"):
         raise ValueError(f"unsupported algo {algo}")
     if group_size != -1:
         raise NotImplementedError(
             "group-wise quantization (group_size != -1) is not "
             "implemented; only per-output-channel scales")
     w = ensure_tensor(x)
+
+    if algo == "weight_only_int4":
+        if int(w.shape[0]) % 2:
+            raise ValueError(
+                "weight_only_int4 packs two K rows per byte: K must "
+                f"be even, got {int(w.shape[0])}")
+
+        def _q4(v):
+            vf = v.astype(jnp.float32)
+            scale = jnp.maximum(jnp.max(jnp.abs(vf), axis=0) / 7.0,
+                                1e-10)
+            q = jnp.clip(jnp.round(vf / scale), -8, 7).astype(jnp.int32)
+            lo = q[0::2] & 0xF
+            hi = (q[1::2] & 0xF) << 4
+            return (lo | hi).astype(jnp.int8), scale
+        out = call_op(_q4, w.detach())
+        return out[0], out[1]
 
     def _q(v):
         # reference scale convention: scale = absmax / 127, dequant =
@@ -63,9 +90,28 @@ def weight_quantize(x, algo="weight_only_int8", group_size=-1):
     return out[0], out[1]
 
 
+def _unpack_int4(q):
+    """(K/2, N) packed nibbles -> (K, N) int8 in [-8, 7]."""
+    qi = q.astype(jnp.int32)
+    lo = qi & 0xF
+    hi = (qi >> 4) & 0xF
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    K2, N = q.shape
+    out = jnp.zeros((K2 * 2, N), jnp.int8)
+    out = out.at[0::2].set(lo.astype(jnp.int8))
+    out = out.at[1::2].set(hi.astype(jnp.int8))
+    return out
+
+
 def weight_dequantize(x, scale, algo="weight_only_int8",
                       out_dtype="float32"):
     w, s = ensure_tensor(x), ensure_tensor(scale)
+    if algo == "weight_only_int4":
+        return call_op(
+            lambda q, sc: (_unpack_int4(q).astype(jnp.float32)
+                           * sc).astype(out_dtype), w, s)
     return call_op(
         lambda q, sc: (q.astype(jnp.float32) * sc).astype(out_dtype),
         w, s)
@@ -73,12 +119,16 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", group_size=-1, name=None):
-    """reference: paddle.nn.quant.weight_only_linear — weight stays int8
-    in HBM (the serving memory-bandwidth win); dequant happens in the
-    matmul epilogue which XLA fuses, activations stay in their float
-    dtype (no activation quantization)."""
-    if weight_dtype != "int8":
-        raise NotImplementedError("weight_only_linear: int8 only")
+    """reference: paddle.nn.quant.weight_only_linear — weight stays
+    int8 (or nibble-packed int4, weight_dtype="int4") in HBM; dequant
+    happens in the matmul epilogue which XLA fuses, activations stay in
+    their float dtype (no activation quantization).  int4 on v5e is a
+    capacity feature (see weight_quantize docstring): the unpack runs
+    before the dot, so at small M it is slower than fp8/int8 serving.
+    """
+    if weight_dtype not in ("int8", "int4"):
+        raise NotImplementedError(
+            "weight_only_linear: int8 and int4 only")
     if group_size != -1:
         raise NotImplementedError(
             "weight_only_linear: group-wise scales (group_size != -1) "
@@ -88,8 +138,11 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     ts = [x, w.detach(), s.detach()]
     if bias is not None:
         ts.append(ensure_tensor(bias))
+    int4 = weight_dtype == "int4"
 
     def _wol(a, q, sc, *b):
+        if int4:
+            q = _unpack_int4(q)
         acc = jnp.matmul(a, q.astype(a.dtype))
         out = acc * sc.astype(a.dtype)
         return out + b[0] if b else out

@@ -298,3 +298,48 @@ def test_fp8_linear_deploy_path():
     assert rel < 0.06, rel
     # weight HBM footprint halves vs bf16
     assert qnet[0].w_fp8.dtype.itemsize * 2 == jnp.dtype(jnp.bfloat16).itemsize
+
+
+def test_weight_only_int4_roundtrip_and_linear():
+    """r5: weight_only_int4 — nibble-packed storage (K/2, N), quantize/
+    dequantize round trip within int4 tolerance, and weight_only_linear
+    matches the dequantized matmul exactly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.quant import (weight_quantize, weight_dequantize,
+                                     weight_only_linear)
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype("f4")
+    q, s = weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    assert tuple(q.shape) == (8, 8)          # two K rows per byte
+    assert str(q._value.dtype) == "int8"
+    wd = weight_dequantize(q, s, algo="weight_only_int4")
+    # int4 has 15 levels: |err| <= scale/2 elementwise
+    err = np.abs(np.asarray(wd._value) - w)
+    assert (err <= np.asarray(s._value)[None, :] * 0.5 + 1e-6).all()
+
+    x = rs.randn(3, 16).astype("f4")
+    out = weight_only_linear(paddle.to_tensor(x), q, weight_scale=s,
+                             weight_dtype="int4")
+    ref = x @ np.asarray(wd._value)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5,
+                               atol=1e-5)
+    # odd K is rejected with a clear message
+    import pytest
+    with pytest.raises(ValueError, match="even"):
+        weight_quantize(paddle.to_tensor(rs.randn(15, 8).astype("f4")),
+                        algo="weight_only_int4")
+
+
+def test_weight_only_int4_grad_wrt_activation():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.quant import weight_quantize, weight_only_linear
+    rs = np.random.RandomState(1)
+    w = rs.randn(8, 6).astype("f4")
+    q, s = weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    x = paddle.to_tensor(rs.randn(2, 8).astype("f4"),
+                         stop_gradient=False)
+    out = weight_only_linear(x, q, weight_scale=s, weight_dtype="int4")
+    out.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
