@@ -99,10 +99,9 @@ def _unpack_int4(q):
     lo = jnp.where(lo >= 8, lo - 16, lo)
     hi = jnp.where(hi >= 8, hi - 16, hi)
     K2, N = q.shape
-    out = jnp.zeros((K2 * 2, N), jnp.int8)
-    out = out.at[0::2].set(lo.astype(jnp.int8))
-    out = out.at[1::2].set(hi.astype(jnp.int8))
-    return out
+    # one fused interleave (row 2i = lo[i], row 2i+1 = hi[i])
+    return jnp.stack([lo, hi], axis=1).reshape(K2 * 2, N) \
+        .astype(jnp.int8)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",

@@ -304,8 +304,6 @@ def test_weight_only_int4_roundtrip_and_linear():
     """r5: weight_only_int4 — nibble-packed storage (K/2, N), quantize/
     dequantize round trip within int4 tolerance, and weight_only_linear
     matches the dequantized matmul exactly."""
-    import numpy as np
-    import paddle_tpu as paddle
     from paddle_tpu.nn.quant import (weight_quantize, weight_dequantize,
                                      weight_only_linear)
     rs = np.random.RandomState(0)
@@ -325,15 +323,12 @@ def test_weight_only_int4_roundtrip_and_linear():
     np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5,
                                atol=1e-5)
     # odd K is rejected with a clear message
-    import pytest
     with pytest.raises(ValueError, match="even"):
         weight_quantize(paddle.to_tensor(rs.randn(15, 8).astype("f4")),
                         algo="weight_only_int4")
 
 
 def test_weight_only_int4_grad_wrt_activation():
-    import numpy as np
-    import paddle_tpu as paddle
     from paddle_tpu.nn.quant import weight_quantize, weight_only_linear
     rs = np.random.RandomState(1)
     w = rs.randn(8, 6).astype("f4")
