@@ -3,13 +3,15 @@ multiprocess workers + C++ blocking queue).
 
 TPU-native design: the loader is a host-side numpy pipeline; batches stay
 numpy until the train step device_puts them (hapi adds double-buffer
-prefetch so H2D overlaps compute).  Worker parallelism uses threads (numpy
-collation releases the GIL) rather than the reference's fork workers —
-subprocesses don't mix with a PJRT client.
+prefetch so H2D overlaps compute).  Worker parallelism uses fork'd
+subprocesses for both dataset kinds (workers touch only numpy, never the
+PJRT client — device collation happens in the parent); a threaded
+fallback covers fork-less platforms.
 """
 import itertools
 import queue as _queue
 import threading
+from collections import deque as _deque
 
 import numpy as np
 
@@ -287,6 +289,19 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+def _sliced_batches(it, batch_size, drop_last):
+    """Yield lists of up to ``batch_size`` samples from ``it`` — the one
+    batching loop shared by the single-process, threaded-fallback, and
+    fork'd-worker paths."""
+    while True:
+        batch = list(itertools.islice(it, batch_size))
+        if not batch:
+            return
+        if len(batch) < batch_size and drop_last:
+            return
+        yield batch
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
@@ -347,12 +362,11 @@ class DataLoader:
     def _iter_batches(self):
         if self._iterable_mode:
             it = iter(self.dataset)
-            while True:
-                batch = list(itertools.islice(it, self.batch_size))
-                if not batch:
-                    return
-                if len(batch) < self.batch_size and self.drop_last:
-                    return
+            if self.batch_size is None:  # auto-batching disabled:
+                yield from it            # samples pass through bare
+                return
+            for batch in _sliced_batches(it, self.batch_size,
+                                         self.drop_last):
                 yield self.collate_fn(batch)
         elif self.batch_sampler is None:
             for i in range(len(self.dataset)):
@@ -365,10 +379,35 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
-        # Map-style datasets go through the multiprocess path: fork'd
+        # Both dataset kinds go through the multiprocess path: fork'd
         # workers -> collector thread -> native C++ blocking queue
-        # (csrc/blocking_queue.cc) -> here.  Iterable datasets (stateful
-        # iterators don't split across processes) use threaded prefetch.
+        # (csrc/blocking_queue.cc) -> here.  Map-style workers are fed
+        # batch indices; iterable workers each iterate their own dataset
+        # copy (sharding via get_worker_info(), reference semantics) and
+        # batches are delivered round-robin in worker-id order.
+        if self._iterable_mode:
+            it = None
+            if self.batch_size is not None:  # batch_size=None: no
+                from .worker import IterableMultiProcessIter  # auto-batch,
+                try:                         # threaded per-sample path
+                    it = IterableMultiProcessIter(
+                        self.dataset, self.batch_size, self.drop_last,
+                        self.collate_fn, self.num_workers,
+                        prefetch_factor=self.prefetch_factor,
+                        timeout=self.timeout,
+                        worker_init_fn=self.worker_init_fn,
+                        use_shared_memory=self.use_shared_memory)
+                except (OSError, ValueError):
+                    # no fork on this platform (get_context raises it)
+                    it = None
+            if it is not None:
+                try:
+                    yield from it
+                finally:
+                    it._shutdown()  # consumer may abandon the loop early
+                return
+            yield from self._iter_threaded_iterable()
+            return
         if not self._iterable_mode and self.batch_sampler is not None:
             from .worker import MultiProcessIter
             batches = list(self.batch_sampler)  # sampler errors propagate
@@ -379,7 +418,8 @@ class DataLoader:
                     timeout=self.timeout,
                     worker_init_fn=self.worker_init_fn,
                     use_shared_memory=self.use_shared_memory)
-            except OSError:  # fork unavailable on this platform
+            except (OSError, ValueError):
+                # no fork on this platform (get_context raises ValueError)
                 it = None
             if it is not None:
                 try:
@@ -412,3 +452,69 @@ class DataLoader:
             if isinstance(item, BaseException):
                 raise item
             yield item
+
+    def _iter_threaded_iterable(self):
+        """Fork-less fallback for IterableDataset + num_workers: N producer
+        threads, each with its own iterator and correct
+        ``_WorkerInfo(i, N)`` (a self-sharding dataset covers all shards),
+        delivered round-robin in worker-id order like the fork path."""
+        n = self.num_workers
+        queues = [_queue.Queue(maxsize=max(1, self.prefetch_factor))
+                  for _ in range(n)]
+        sentinel = object()
+        stop = threading.Event()
+
+        def put(wid, item):
+            # bounded put that gives up when the consumer is gone, so an
+            # abandoned epoch can't strand producer threads forever
+            while not stop.is_set():
+                try:
+                    queues[wid].put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def produce(wid):
+            try:
+                _worker_info.info = _WorkerInfo(wid, n, self.dataset)
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+                it = iter(self.dataset)
+                if self.batch_size is None:  # auto-batching disabled
+                    batches = it
+                else:
+                    batches = (self.collate_fn(b) for b in _sliced_batches(
+                        it, self.batch_size, self.drop_last))
+                for b in batches:
+                    if stop.is_set() or not put(wid, b):
+                        return
+            except BaseException as e:  # surface in consumer
+                put(wid, e)
+            finally:
+                put(wid, sentinel)
+
+        threads = [threading.Thread(target=produce, args=(wid,), daemon=True)
+                   for wid in range(n)]
+        for t in threads:
+            t.start()
+        timeout = self.timeout if self.timeout and self.timeout > 0 else None
+        rotation = _deque(range(n))
+        try:
+            while rotation:
+                wid = rotation[0]
+                try:
+                    item = queues[wid].get(timeout=timeout)
+                except _queue.Empty:
+                    raise TimeoutError(
+                        f"DataLoader timed out after {timeout}s waiting "
+                        f"for worker {wid}")
+                if item is sentinel:
+                    rotation.popleft()
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+                rotation.rotate(-1)
+        finally:
+            stop.set()  # unblock + retire producers on early exit

@@ -7,6 +7,11 @@ Architecture, mirrored TPU-side:
   collector thread (reorders by batch index) --> native C++ BlockingQueue
   (bounded prefetch backpressure, csrc/blocking_queue.cc) --> train loop.
 
+Map-style workers are fed batch indices; iterable workers each iterate
+their own dataset copy (_DatasetKind.ITER — sharding is the dataset's
+job via ``get_worker_info()``) and their batches are delivered
+round-robin in worker-id order.
+
 Workers run only dataset indexing + numpy transforms — never JAX device
 ops (device state is not fork-safe; collation to device arrays happens in
 the parent).
@@ -22,7 +27,7 @@ import numpy as np
 from .blocking_queue import BlockingQueue
 from . import shm as _shm
 
-__all__ = ["MultiProcessIter"]
+__all__ = ["MultiProcessIter", "IterableMultiProcessIter"]
 
 # arrays under this many bytes ride the pickle pipe; larger batches go
 # through the csrc shm transport (reference: use_shared_memory default)
@@ -42,6 +47,10 @@ class _ShmBatch:
         self.meta = meta
 
 
+class _IterEnd:
+    """Queue marker: this worker's iterator is exhausted."""
+
+
 def _to_numpy(sample):
     # Strip framework tensors down to numpy for IPC.
     from ..framework.core import Tensor
@@ -54,6 +63,15 @@ def _to_numpy(sample):
     if isinstance(sample, dict):
         return {k: _to_numpy(v) for k, v in sample.items()}
     return sample
+
+
+def _pack_payload(samples, shm_tag):
+    if shm_tag is not None:
+        meta = _shm.write_batch(samples, min_bytes=_SHM_MIN_BYTES,
+                                name_prefix=shm_tag)
+        if meta is not None:
+            return _ShmBatch(meta)
+    return samples
 
 
 def _worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
@@ -74,53 +92,96 @@ def _worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
         batch_idx, indices = item
         try:
             samples = [_to_numpy(dataset[i]) for i in indices]
-            payload = samples
-            if shm_tag is not None:
-                meta = _shm.write_batch(samples, min_bytes=_SHM_MIN_BYTES,
-                                        name_prefix=shm_tag)
-                if meta is not None:
-                    payload = _ShmBatch(meta)
+            payload = _pack_payload(samples, shm_tag)
             blob = pickle.dumps((batch_idx, payload), protocol=4)
         except Exception as e:  # incl. unpicklable samples
             blob = pickle.dumps((batch_idx, _WorkerError(e)), protocol=4)
         result_queue.put(blob)
 
 
-class MultiProcessIter:
-    """Order-preserving multiprocess batch iterator over a map-style
-    dataset."""
+def _iterable_worker_loop(dataset, token_queue, result_queue, worker_id,
+                          num_workers, worker_init_fn, base_seed,
+                          batch_size, drop_last, shm_tag=None):
+    """One fork'd worker over an IterableDataset: owns its own iterator,
+    produces one collation-ready batch per granted token."""
+    from . import _worker_info, _WorkerInfo
+    np.random.seed((base_seed + worker_id) % (2 ** 32))
+    _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
 
-    def __init__(self, dataset, batch_indices, collate_fn, num_workers,
-                 prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 use_shared_memory=True):
+    def _report(seq, payload):
+        try:
+            blob = pickle.dumps((worker_id, seq, payload), protocol=4)
+        except Exception as e:  # unpicklable user exception/sample
+            blob = pickle.dumps((worker_id, seq, _WorkerError(e)), protocol=4)
+        result_queue.put(blob)
+
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception as e:
+            _report(-1, _WorkerError(e))
+            return
+    from . import _sliced_batches
+    try:
+        it = iter(dataset)
+    except Exception as e:
+        _report(-1, _WorkerError(e))
+        return
+    batches = _sliced_batches((_to_numpy(s) for s in it), batch_size,
+                              drop_last)
+    seq = 0
+    while True:
+        if token_queue.get() is None:
+            return
+        try:
+            samples = next(batches, None)
+            if samples is None:
+                _report(seq, _IterEnd())
+                return
+            payload = _pack_payload(samples, shm_tag)
+        except Exception as e:
+            _report(seq, _WorkerError(e))
+            return
+        _report(seq, payload)
+        seq += 1
+
+
+class _MultiProcessIterBase:
+    """Shared spawn/collect/consume/teardown plumbing.
+
+    Subclasses provide the worker target (via ``_spawn``), the collector
+    body (``_collect``), the blob that wakes a collector blocked in
+    ``result_queue.get()`` (``_wake_blob``), and an optional pre-terminate
+    worker notification (``_stop_workers``). Result blobs are tuples whose
+    LAST element is the payload; collector-made error blobs are
+    ``(-1, payload)``.
+    """
+
+    def _init_common(self, collate_fn, num_workers, prefetch_factor,
+                     timeout, use_shared_memory, shm_prefix):
         self._collate = collate_fn
         self._timeout = timeout if timeout and timeout > 0 else None
-        self._batches = list(batch_indices)
         self._num_workers = num_workers
-        # Outstanding dispatches are capped so workers can't run the whole
-        # epoch ahead of the consumer: the bounded native queue throttles
-        # the collector, and the collector only dispatches a new index
-        # batch after delivering one (reference: _outstanding_capacity in
-        # dataloader_iter.py).
         self._capacity = max(2, prefetch_factor * num_workers)
         import uuid as _uuid
-        self._shm_tag = f"pt_batch_{_uuid.uuid4().hex[:10]}" \
+        self._shm_tag = f"{shm_prefix}_{_uuid.uuid4().hex[:10]}" \
             if (use_shared_memory and _shm.available()) else None
-        ctx = multiprocessing.get_context("fork")
-        self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
-        self._result_queue = ctx.Queue()
+        # raises ValueError on fork-less platforms; DataLoader catches it
+        # and falls back to the threaded path
+        self._ctx = multiprocessing.get_context("fork")
+        self._result_queue = self._ctx.Queue()
         self._out = BlockingQueue(self._capacity)
-        base_seed = int.from_bytes(os.urandom(4), "little")
+        self._base_seed = int.from_bytes(os.urandom(4), "little")
         self._stopping = False
         self._workers = []
+        self._collector = None
+        self._done = False
+
+    def _spawn(self, target, args_for_wid):
         try:
-            for wid in range(num_workers):
-                p = ctx.Process(
-                    target=_worker_loop,
-                    args=(dataset, self._index_queues[wid],
-                          self._result_queue, wid, num_workers,
-                          worker_init_fn, base_seed, self._shm_tag),
-                    daemon=True)
+            for wid in range(self._num_workers):
+                p = self._ctx.Process(target=target, args=args_for_wid(wid),
+                                      daemon=True)
                 p.start()
                 self._workers.append(p)
         except BaseException:  # don't leak already-started workers
@@ -128,15 +189,112 @@ class MultiProcessIter:
                 if p.is_alive():
                     p.terminate()
             raise
+
+    def _start_collector(self):
+        self._collector = threading.Thread(target=self._collect, daemon=True)
+        self._collector.start()
+
+    def _emit_dead_worker_error(self):
+        # a worker died without reporting (segfault/OOM): surface instead
+        # of hanging the consumer forever
+        err = _WorkerError(RuntimeError("x"))
+        err.msg = "DataLoader worker(s) exited unexpectedly"
+        self._out.push(pickle.dumps((-1, err)))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        try:
+            blob = self._out.pop(timeout=self._timeout)
+        except TimeoutError:
+            # a timed-out epoch is dead (reference: DataLoader raises and
+            # the iterator is unusable); tear down rather than letting a
+            # retried next() race the closed queue into StopIteration
+            self._done = True
+            self._shutdown()
+            raise
+        if blob is None:
+            self._done = True
+            self._shutdown()
+            raise StopIteration
+        payload = pickle.loads(blob)[-1]
+        if isinstance(payload, _WorkerError):
+            self._shutdown()
+            raise RuntimeError(
+                "DataLoader worker raised:\n" + payload.msg)
+        if isinstance(payload, _ShmBatch):
+            payload = _shm.read_batch(payload.meta)
+        return self._collate(payload)
+
+    def _wake_blob(self):
+        raise NotImplementedError
+
+    def _stop_workers(self):
+        pass
+
+    def _shutdown(self):
+        self._stopping = True
+        self._out.close()  # wakes a blocked collector push; drain-then-end
+        try:  # wake a collector blocked in result_queue.get()
+            self._result_queue.put(self._wake_blob())
+        except (OSError, ValueError):
+            pass
+        self._stop_workers()
+        # terminate() below can SIGTERM a worker while its queue feeder
+        # holds the shared writelock; the orphaned lock would block the
+        # parent feeder forever and multiprocessing's atexit
+        # _finalize_join joins it without timeout — so never join this
+        # queue's feeder at exit (observed interpreter-exit hang)
+        self._result_queue.cancel_join_thread()
+        for p in self._workers:
+            if p.is_alive():
+                p.terminate()
+        for p in self._workers:
+            p.join(timeout=1.0)
+        if self._collector is not None and self._collector.is_alive():
+            self._collector.join(timeout=1.0)
+        if self._shm_tag is not None:
+            # sweep every segment this loader tagged: covers blobs lost in
+            # queue buffers and workers killed between create and put
+            _shm.unlink_prefix(self._shm_tag)
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+class MultiProcessIter(_MultiProcessIterBase):
+    """Order-preserving multiprocess batch iterator over a map-style
+    dataset."""
+
+    def __init__(self, dataset, batch_indices, collate_fn, num_workers,
+                 prefetch_factor=2, timeout=0, worker_init_fn=None,
+                 use_shared_memory=True):
+        self._init_common(collate_fn, num_workers, prefetch_factor, timeout,
+                          use_shared_memory, "pt_batch")
+        self._batches = list(batch_indices)
+        # Outstanding dispatches are capped so workers can't run the whole
+        # epoch ahead of the consumer: the bounded native queue throttles
+        # the collector, and the collector only dispatches a new index
+        # batch after delivering one (reference: _outstanding_capacity in
+        # dataloader_iter.py).
+        self._index_queues = [self._ctx.SimpleQueue()
+                              for _ in range(num_workers)]
+        self._spawn(_worker_loop, lambda wid: (
+            dataset, self._index_queues[wid], self._result_queue, wid,
+            num_workers, worker_init_fn, self._base_seed, self._shm_tag))
         self._next_dispatch = 0
         for _ in range(min(self._capacity + num_workers,
                            len(self._batches))):
             self._dispatch_one()
         if self._next_dispatch >= len(self._batches):
             self._send_sentinels()
-        self._collector = threading.Thread(target=self._collect, daemon=True)
-        self._collector.start()
-        self._done = False
+        self._start_collector()
 
     def _dispatch_one(self):
         i = self._next_dispatch
@@ -146,6 +304,9 @@ class MultiProcessIter:
     def _send_sentinels(self):
         for q in self._index_queues:
             q.put(None)
+
+    def _wake_blob(self):
+        return pickle.dumps((-2, None))
 
     def _collect(self):
         import queue as _pyq
@@ -158,12 +319,7 @@ class MultiProcessIter:
                     blob = self._result_queue.get(timeout=1.0)
                 except _pyq.Empty:
                     if not any(p.is_alive() for p in self._workers):
-                        # a worker died without reporting (segfault/OOM):
-                        # surface instead of hanging the consumer forever
-                        err = _WorkerError(RuntimeError(
-                            "DataLoader worker(s) exited unexpectedly"))
-                        err.msg = "DataLoader worker(s) exited unexpectedly"
-                        self._out.push(pickle.dumps((-1, err)))
+                        self._emit_dead_worker_error()
                         return
                     continue
                 batch_idx, payload = pickle.loads(blob)
@@ -186,55 +342,83 @@ class MultiProcessIter:
         finally:
             self._out.close()  # leftover shm swept by tag in _shutdown
 
-    def __iter__(self):
-        return self
 
-    def __next__(self):
-        if self._done:
-            raise StopIteration
+class IterableMultiProcessIter(_MultiProcessIterBase):
+    """Multiprocess batch iterator over an IterableDataset.
+
+    N fork'd workers each iterate their own copy of the dataset; batches
+    are delivered round-robin across workers in worker-id order, matching
+    the reference's in-order index-queue dispatch. A worker that exhausts
+    drops out of the rotation; the rest keep going.
+    """
+
+    def __init__(self, dataset, batch_size, drop_last, collate_fn,
+                 num_workers, prefetch_factor=2, timeout=0,
+                 worker_init_fn=None, use_shared_memory=True):
+        self._init_common(collate_fn, num_workers, prefetch_factor, timeout,
+                          use_shared_memory, "pt_itbatch")
+        self._token_queues = [self._ctx.SimpleQueue()
+                              for _ in range(num_workers)]
+        self._spawn(_iterable_worker_loop, lambda wid: (
+            dataset, self._token_queues[wid], self._result_queue, wid,
+            num_workers, worker_init_fn, self._base_seed, batch_size,
+            drop_last, self._shm_tag))
+        # each worker may run `prefetch_factor` batches ahead; a new token
+        # is granted only when one of its batches is delivered downstream
+        for tq in self._token_queues:
+            for _ in range(max(1, prefetch_factor)):
+                tq.put(1)
+        self._start_collector()
+
+    def _wake_blob(self):
+        return pickle.dumps((0, -2, None))
+
+    def _stop_workers(self):
+        for tq in self._token_queues:
+            try:
+                tq.put(None)
+            except (OSError, ValueError):
+                pass
+
+    def _collect(self):
+        import queue as _pyq
+        from collections import deque
+        pending = {wid: {} for wid in range(self._num_workers)}
+        next_seq = [0] * self._num_workers
+        rotation = deque(range(self._num_workers))
         try:
-            blob = self._out.pop(timeout=self._timeout)
-        except TimeoutError:
-            # a timed-out epoch is dead (reference: DataLoader raises and
-            # the iterator is unusable); tear down rather than letting a
-            # retried next() race the closed queue into StopIteration
-            self._done = True
-            self._shutdown()
-            raise
-        if blob is None:
-            self._done = True
-            self._shutdown()
-            raise StopIteration
-        batch_idx, payload = pickle.loads(blob)
-        if isinstance(payload, _WorkerError):
-            self._shutdown()
-            raise RuntimeError(
-                "DataLoader worker raised:\n" + payload.msg)
-        if isinstance(payload, _ShmBatch):
-            payload = _shm.read_batch(payload.meta)
-        return self._collate(payload)
-
-    def _shutdown(self):
-        self._stopping = True
-        self._out.close()  # wakes a blocked collector push; drain-then-end
-        try:  # wake a collector blocked in result_queue.get()
-            self._result_queue.put(pickle.dumps((-2, None)))
-        except (OSError, ValueError):
-            pass
-        for p in self._workers:
-            if p.is_alive():
-                p.terminate()
-        for p in self._workers:
-            p.join(timeout=1.0)
-        if self._collector.is_alive():
-            self._collector.join(timeout=1.0)
-        if self._shm_tag is not None:
-            # sweep every segment this loader tagged: covers blobs lost in
-            # queue buffers and workers killed between create and put
-            _shm.unlink_prefix(self._shm_tag)
-
-    def __del__(self):
-        try:
-            self._shutdown()
-        except Exception:
-            pass
+            while rotation and not self._stopping:
+                wid = rotation[0]
+                item = pending[wid].pop(next_seq[wid], None)
+                if item is None:
+                    try:
+                        blob = self._result_queue.get(timeout=1.0)
+                    except _pyq.Empty:
+                        if not any(p.is_alive() for p in self._workers):
+                            self._emit_dead_worker_error()
+                            return
+                        continue
+                    w2, seq2, payload2 = pickle.loads(blob)
+                    if seq2 == -2:  # shutdown sentinel
+                        return
+                    if isinstance(payload2, _WorkerError) or seq2 < 0:
+                        self._out.push(pickle.dumps((-1, payload2)))
+                        return
+                    pending[w2][seq2] = (payload2, blob)
+                    continue
+                payload, blob = item
+                if isinstance(payload, _IterEnd):
+                    rotation.popleft()
+                    continue
+                if not self._out.push(blob):
+                    return  # output queue closed under us
+                next_seq[wid] += 1
+                rotation.rotate(-1)
+                try:
+                    self._token_queues[wid].put(1)
+                except (OSError, ValueError):
+                    return  # torn down mid-epoch
+        except (EOFError, OSError):
+            pass  # torn down mid-epoch
+        finally:
+            self._out.close()  # leftover shm swept by tag in _shutdown

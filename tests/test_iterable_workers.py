@@ -1,0 +1,230 @@
+"""Multiprocess IterableDataset workers (reference:
+python/paddle/io/dataloader/worker.py _DatasetKind.ITER — each worker
+iterates its own dataset copy with worker_info(id, num_workers) set, so
+datasets shard themselves by worker id; unsharded datasets replicate).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, IterableDataset, get_worker_info
+
+
+class ShardedRange(IterableDataset):
+    """Yields its slice of range(n) based on get_worker_info()."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        wid = info.id if info is not None else 0
+        nw = info.num_workers if info is not None else 1
+        for i in range(wid, self.n, nw):
+            yield np.asarray([i], dtype=np.float32)
+
+
+class UnshardedRange(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.asarray([i], dtype=np.float32)
+
+
+def _values(loader):
+    out = []
+    for batch in loader:
+        out.extend(int(v) for v in np.asarray(batch._value).ravel())
+    return out
+
+
+class TestIterableMultiProcess:
+    def test_sharded_dataset_covers_all_data_once(self):
+        # the r4 threaded path ran ONE producer claiming worker 0 of N,
+        # silently dropping the other shards — the regression this guards
+        loader = DataLoader(ShardedRange(100), batch_size=5, num_workers=3)
+        vals = _values(loader)
+        assert sorted(vals) == list(range(100))
+
+    def test_unsharded_dataset_replicates_per_worker(self):
+        # reference semantics: each worker iterates its own full copy
+        loader = DataLoader(UnshardedRange(20), batch_size=4, num_workers=2)
+        vals = _values(loader)
+        assert len(vals) == 40
+        assert sorted(set(vals)) == list(range(20))
+        assert all(vals.count(v) == 2 for v in range(20))
+
+    def test_round_robin_order_is_deterministic(self):
+        loader1 = _values(DataLoader(ShardedRange(60), batch_size=5,
+                                     num_workers=2))
+        loader2 = _values(DataLoader(ShardedRange(60), batch_size=5,
+                                     num_workers=2))
+        assert loader1 == loader2
+        # worker 0's first batch (evens) precedes worker 1's (odds)
+        assert loader1[:5] == [0, 2, 4, 6, 8]
+        assert loader1[5:10] == [1, 3, 5, 7, 9]
+
+    def test_drop_last_applies_per_worker(self):
+        # 2 workers over 25 items: shards of 13 and 12; batch 4 ->
+        # worker0 drops 1 leftover (12 kept), worker1 keeps 12 = 24 items
+        loader = DataLoader(ShardedRange(25), batch_size=4, num_workers=2,
+                            drop_last=True)
+        vals = _values(loader)
+        assert len(vals) == 24
+
+    def test_uneven_exhaustion(self):
+        # worker 1 of 4 over range(10) yields 2 items far fewer than
+        # worker 0; remaining workers keep delivering after it drops out
+        loader = DataLoader(ShardedRange(10), batch_size=1, num_workers=4)
+        assert sorted(_values(loader)) == list(range(10))
+
+    def test_worker_exception_surfaces(self):
+        class Bad(IterableDataset):
+            def __iter__(self):
+                yield np.zeros(1, np.float32)
+                raise ValueError("boom in iterable worker")
+
+        with pytest.raises(RuntimeError, match="boom in iterable worker"):
+            for _ in DataLoader(Bad(), batch_size=1, num_workers=2):
+                pass
+
+    def test_worker_init_fn_runs_per_worker(self):
+        # init fn runs inside each subprocess; make its effect observable
+        # through what the dataset yields
+        class EnvEcho(IterableDataset):
+            def __iter__(self):
+                yield np.asarray([int(os.environ.get("PT_TEST_WID", -1))],
+                                 dtype=np.float32)
+
+        def init_fn(wid):
+            os.environ["PT_TEST_WID"] = str(wid)
+
+        loader = DataLoader(EnvEcho(), batch_size=1, num_workers=3,
+                            worker_init_fn=init_fn)
+        assert sorted(_values(loader)) == [0, 1, 2]
+
+    def test_early_break_shuts_down_cleanly(self):
+        import gc
+        import multiprocessing as mp
+        import threading as _threading
+        import time
+        before = _threading.active_count()
+        for _ in range(3):
+            loader = DataLoader(ShardedRange(1000), batch_size=2,
+                                num_workers=2)
+            for i, _ in enumerate(loader):
+                if i == 1:
+                    break
+        gc.collect()
+        deadline = time.monotonic() + 5.0
+        while ((_threading.active_count() > before + 1
+                or mp.active_children())
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert _threading.active_count() <= before + 1
+        assert not mp.active_children()
+
+    def test_threaded_fallback_matches_fork_semantics(self, monkeypatch):
+        # force the fork-less path the way it really fails:
+        # multiprocessing.get_context("fork") raises ValueError on
+        # spawn-only platforms
+        import paddle_tpu.io.worker as worker_mod
+
+        class NoFork:
+            def __init__(self, *a, **k):
+                raise ValueError("cannot find context for 'fork'")
+
+        monkeypatch.setattr(worker_mod, "IterableMultiProcessIter", NoFork)
+        loader = DataLoader(ShardedRange(60), batch_size=5, num_workers=2)
+        vals = _values(loader)
+        assert sorted(vals) == list(range(60))
+        assert vals[:5] == [0, 2, 4, 6, 8]
+        assert vals[5:10] == [1, 3, 5, 7, 9]
+
+    def test_threaded_fallback_exception_and_drop_last(self, monkeypatch):
+        import paddle_tpu.io.worker as worker_mod
+
+        class NoFork:
+            def __init__(self, *a, **k):
+                raise ValueError("cannot find context for 'fork'")
+
+        monkeypatch.setattr(worker_mod, "IterableMultiProcessIter", NoFork)
+        vals = _values(DataLoader(ShardedRange(25), batch_size=4,
+                                  num_workers=2, drop_last=True))
+        assert len(vals) == 24
+
+        class Bad(IterableDataset):
+            def __iter__(self):
+                raise ValueError("boom threaded")
+                yield
+
+        with pytest.raises(ValueError, match="boom threaded"):
+            for _ in DataLoader(Bad(), batch_size=1, num_workers=2):
+                pass
+
+    def test_batch_size_none_passes_samples_through(self):
+        # auto-batching disabled: samples yielded bare, no collation
+        vals = [int(np.asarray(s).ravel()[0])
+                for s in DataLoader(UnshardedRange(10), batch_size=None,
+                                    num_workers=0)]
+        assert vals == list(range(10))
+        # with workers it rides the threaded path (per-sample, replicated)
+        vals = [int(np.asarray(s).ravel()[0])
+                for s in DataLoader(UnshardedRange(10), batch_size=None,
+                                    num_workers=2)]
+        assert sorted(vals) == sorted(list(range(10)) * 2)
+
+    def test_threaded_fallback_early_break_retires_producers(
+            self, monkeypatch):
+        import gc
+        import threading as _threading
+        import time
+        import paddle_tpu.io.worker as worker_mod
+
+        class NoFork:
+            def __init__(self, *a, **k):
+                raise ValueError("cannot find context for 'fork'")
+
+        monkeypatch.setattr(worker_mod, "IterableMultiProcessIter", NoFork)
+        before = _threading.active_count()
+        for _ in range(3):
+            loader = DataLoader(ShardedRange(10000), batch_size=2,
+                                num_workers=2)
+            for i, _ in enumerate(loader):
+                if i == 1:
+                    break
+        gc.collect()  # abandoned generators run their finally -> stop.set()
+        deadline = time.monotonic() + 5.0
+        while (_threading.active_count() > before
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert _threading.active_count() <= before
+
+    def test_timeout_fork_and_threaded(self, monkeypatch):
+        import time
+
+        class Hang(IterableDataset):
+            def __iter__(self):
+                yield np.zeros(1, np.float32)
+                time.sleep(60)
+                yield np.zeros(1, np.float32)
+
+        loader = DataLoader(Hang(), batch_size=2, num_workers=1, timeout=0.5)
+        with pytest.raises(TimeoutError):
+            for _ in loader:
+                pass
+
+        import paddle_tpu.io.worker as worker_mod
+
+        class NoFork:
+            def __init__(self, *a, **k):
+                raise ValueError("cannot find context for 'fork'")
+
+        monkeypatch.setattr(worker_mod, "IterableMultiProcessIter", NoFork)
+        loader = DataLoader(Hang(), batch_size=2, num_workers=1, timeout=0.5)
+        with pytest.raises(TimeoutError):
+            for _ in loader:
+                pass
