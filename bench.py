@@ -667,6 +667,109 @@ def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
             "dp": dp, "mp": mp, "batch": B, "seq": S}
 
 
+# ---------------------------------------------------------------------------
+# GPT-MoE: GShard-pattern sparse FFNs (every other layer 8-expert top-2),
+# single chip.  MFU is computed over ACTIVE FLOPs (top_k of E experts per
+# token), the standard sparse-model accounting.
+# ---------------------------------------------------------------------------
+
+def bench_gpt_moe(B=8, S=1024, iters=6, peak=197e12):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import autograd as _ag
+    from paddle_tpu.framework.random import rng_scope
+    from paddle_tpu.models import GPTMoEForPretraining, gpt_moe_small
+
+    cfg = gpt_moe_small(vocab_size=50304)
+    paddle.seed(0)
+    net = GPTMoEForPretraining(cfg)
+    net.eval()
+    params = [p for _, p in net.named_parameters()]
+    pvals = [p._value for p in params]
+    moes = net.gpt.moe_layers()
+
+    def loss_fn(pv, ids, labels):
+        from paddle_tpu.ops.pallas.fused_xent import fused_softmax_xent
+        olds = [p._value for p in params]
+        for p, v in zip(params, pv):
+            p._value = v.astype(jnp.bfloat16) \
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+        try:
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                logits = net(paddle.Tensor(ids))._value
+                aux = net.aux_loss()._value
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+        Bv, Sv, V = logits.shape
+        lb = jnp.concatenate([labels[:, 1:],
+                              jnp.full((Bv, 1), -1, labels.dtype)], 1)
+        row = fused_softmax_xent(logits.reshape(Bv * Sv, V),
+                                 lb.reshape(-1).astype(jnp.int32))
+        ce = jnp.sum(row) / (Bv * (Sv - 1))
+        return ce + cfg.aux_loss_weight * aux.astype(jnp.float32)
+
+    b1, b2, eps, lr, wd = 0.9, 0.95, 1e-8, 1e-4, 0.01
+
+    def step(pv, m, v, t, ids, labels):
+        loss, g = jax.value_and_grad(loss_fn)(pv, ids, labels)
+        t = t + 1
+        new_p, new_m, new_v = [], [], []
+        for p, gi, mi, vi in zip(pv, g, m, v):
+            nmi = b1 * mi + (1 - b1) * gi
+            nvi = b2 * vi + (1 - b2) * gi * gi
+            np_ = p - lr * ((nmi / (1 - b1 ** t)) /
+                            (jnp.sqrt(nvi / (1 - b2 ** t)) + eps) + wd * p)
+            new_p.append(np_)
+            new_m.append(nmi)
+            new_v.append(nvi)
+        return loss, new_p, new_m, new_v, t
+
+    K = int(os.environ.get("BENCH_STEPS_PER_CALL", "5"))
+
+    def scan_steps(pv, m, v, t, ids, labels):
+        def body(carry, _):
+            pv, m, v, t = carry
+            loss, pv, m, v, t = step(pv, m, v, t, ids, labels)
+            return (pv, m, v, t), loss
+        (pv, m, v, t), losses = jax.lax.scan(
+            body, (pv, m, v, t), None, length=K)
+        return losses[-1], pv, m, v, t
+
+    step_jit = jax.jit(scan_steps, donate_argnums=(0, 1, 2))
+    m0 = [jnp.zeros_like(v) for v in pvals]
+    v0 = [jnp.zeros_like(v) for v in pvals]
+    t0 = jnp.zeros((), jnp.int32)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                  (B, S)).astype("int32"))
+
+    def run(pv, m, v, t):
+        loss, pv, m, v, t = step_jit(pv, m, v, t, ids, ids)
+        return loss, pv, m, v, t
+
+    loss, pvals, m0, v0, t0 = run(pvals, m0, v0, t0)
+    _readback_sync(loss)
+    dt, final_loss, _ = _timeit(run, iters, pvals, m0, v0, t0)
+    tokens_per_sec = iters * K * B * S / dt
+
+    n_params = sum(int(np.prod(p.shape)) for p in params)
+    expert_params = sum(
+        int(np.prod(getattr(m, nm).shape))
+        for m in moes for nm in ("expert_w1", "expert_b1",
+                                 "expert_w2", "expert_b2"))
+    active = n_params - expert_params * (1 - cfg.top_k / cfg.num_experts)
+    fpt = 6 * active + 6 * cfg.num_hidden_layers * S * cfg.hidden_size
+    return {"tokens_per_sec": round(tokens_per_sec, 1),
+            "active_mfu": round(tokens_per_sec * fpt / peak, 4),
+            "loss": round(final_loss, 4), "params": n_params,
+            "active_params": int(active),
+            "num_experts": cfg.num_experts, "top_k": cfg.top_k,
+            "moe_layers": len(moes), "batch": B, "seq": S}
+
+
 def main():
     import jax
 
@@ -810,6 +913,11 @@ def main():
                 configs["fp8_linear"] = bench_fp8_linear()
             except Exception as e:
                 configs["fp8_linear"] = {"error": repr(e)[:200]}
+        if want("moe", "gpt_moe"):
+            try:
+                configs["gpt_moe"] = bench_gpt_moe(peak=peak)
+            except Exception as e:
+                configs["gpt_moe"] = {"error": repr(e)[:200]}
     else:
         tiny = GPTConfig(vocab_size=1024, hidden_size=128,
                          num_hidden_layers=2, num_attention_heads=4,

@@ -11,3 +11,6 @@ from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
                    bert_base, bert_tiny)
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
                     llama_7b, llama_tiny)
+from .gpt_moe import (GPTMoEConfig, GPTMoEModel,  # noqa: F401
+                      GPTMoEForPretraining, GPTMoEPretrainingCriterion,
+                      gpt_moe_tiny, gpt_moe_small)

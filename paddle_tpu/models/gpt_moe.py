@@ -1,0 +1,162 @@
+"""GPT-MoE family: GPT blocks with mixture-of-experts FFNs (reference
+workload: PaddleNLP GPT-MoE / incubate moe.MoELayer over
+global_scatter-dispatched experts; structure follows the GShard/Mixtral
+pattern of interleaving dense and MoE FFN layers).
+
+TPU-first choices:
+- expert parallelism is a *sharding*: MoELayer stacks expert weights into
+  (E, ...) arrays carrying a PartitionSpec on ``expert_axis``, so GSPMD
+  emits the all-to-all dispatch the reference implements as
+  global_scatter/global_gather CUDA collectives;
+- capacity-bucketed top-k routing keeps every shape static for XLA;
+- the load-balancing auxiliary loss is summed across MoE layers via
+  ``aux_loss()`` and added to the LM loss by the criterion, matching the
+  reference's gate.get_loss() accumulation.
+"""
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import call_op
+from .. import nn
+from ..nn import functional as F
+from ..incubate.distributed.models.moe import MoELayer, ExpertLayer
+from .gpt import (GPTConfig, GPTAttention, GPTDecoderLayer, GPTEmbeddings,
+                  GPTPretrainingCriterion, _init_gpt_weights, _remat_block)
+
+__all__ = ["GPTMoEConfig", "GPTMoEModel", "GPTMoEForPretraining",
+           "GPTMoEPretrainingCriterion", "gpt_moe_tiny", "gpt_moe_small"]
+
+
+@dataclass
+class GPTMoEConfig(GPTConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    moe_every: int = 2            # every moe_every-th block is MoE (GShard)
+    aux_loss_weight: float = 0.01
+    expert_axis: str = "model"    # mesh axis the (E, ...) weights shard on
+    gate: str = "gshard"
+
+
+def gpt_moe_tiny(**kw):
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 128)
+    kw.setdefault("num_experts", 4)
+    return GPTMoEConfig(**kw)
+
+
+def gpt_moe_small(**kw):
+    """~8-expert small config for the single-chip bench: dense-125M-class
+    attention with 8x experts in every other FFN."""
+    kw.setdefault("hidden_size", 768)
+    kw.setdefault("num_hidden_layers", 12)
+    kw.setdefault("num_attention_heads", 12)
+    kw.setdefault("num_experts", 8)
+    return GPTMoEConfig(**kw)
+
+
+class GPTMoEDecoderLayer(nn.Layer):
+    """Pre-LN block whose FFN is an MoELayer (dense blocks reuse GPTMLP)."""
+
+    def __init__(self, config):
+        super().__init__()
+        H = config.hidden_size
+        self.ln1 = nn.LayerNorm(H, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(H, epsilon=config.layer_norm_epsilon)
+        self.moe = MoELayer(
+            d_model=H,
+            experts=[ExpertLayer(H, config.intermediate_size)
+                     for _ in range(config.num_experts)],
+            gate={"type": config.gate, "top_k": config.top_k},
+            expert_axis=config.expert_axis)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.moe(self.ln2(x)))
+        return x
+
+
+class GPTMoEModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        blocks = []
+        for i in range(config.num_hidden_layers):
+            if (i + 1) % config.moe_every == 0:
+                blocks.append(GPTMoEDecoderLayer(config))
+            else:
+                blocks.append(GPTDecoderLayer(config))
+        self.layers = nn.LayerList(blocks)
+        self.final_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        for blk in self.layers:
+            if self.config.remat:
+                x = _remat_block(blk, x)
+            else:
+                x = blk(x)
+        return self.final_norm(x)
+
+    def moe_layers(self):
+        return [blk.moe for blk in self.layers
+                if isinstance(blk, GPTMoEDecoderLayer)]
+
+
+class GPTMoEForPretraining(nn.Layer):
+    """LM head tied to the input embedding; ``aux_loss()`` sums the
+    load-balancing losses the gates recorded during the last forward."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.gpt = GPTMoEModel(config)
+        self.config = config
+        _init_gpt_weights(self, config.initializer_range)
+        for name, p in self.named_parameters():
+            # stacked expert biases don't end in ".bias"; zero them too
+            if ".expert_b" in name or name.endswith("expert_b1") \
+                    or name.endswith("expert_b2"):
+                p._value = jnp.zeros(tuple(p.shape), p.dtype)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return call_op(lambda h, wv: h @ wv.T, x, w)
+
+    def aux_loss(self):
+        losses = [m.gate.loss for m in self.gpt.moe_layers()
+                  if getattr(m.gate, "loss", None) is not None]
+        if not losses:
+            return Tensor(jnp.zeros((), "float32"))
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total
+
+
+class GPTMoEPretrainingCriterion(nn.Layer):
+    """Shifted LM cross-entropy + aux_loss_weight * sum of gate losses.
+    Pass the model so the criterion can read the recorded gate losses
+    (reference: gate.get_loss() accumulated into the training loss)."""
+
+    def __init__(self, config, model=None):
+        super().__init__()
+        self.aux_weight = config.aux_loss_weight
+        # plain attr set: Layer.__setattr__ would register the model as a
+        # sublayer, duplicating every parameter in parameters()/state_dict
+        object.__setattr__(self, "_model", model)
+        self._ce = GPTPretrainingCriterion(config)
+
+    def forward(self, logits, labels):
+        loss = self._ce(logits, labels)
+        if self._model is not None and self.aux_weight:
+            loss = loss + self._model.aux_loss() * self.aux_weight
+        return loss

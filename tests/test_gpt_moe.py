@@ -1,0 +1,130 @@
+"""GPT-MoE model family (reference pattern: PaddleNLP GPT-MoE pretrain
+loop over incubate moe.MoELayer; loss = LM CE + gate aux loss)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.models import (GPTMoEForPretraining,
+                               GPTMoEPretrainingCriterion, gpt_moe_tiny)
+from paddle_tpu.models.gpt_moe import GPTMoEDecoderLayer
+
+
+def _batch(rng, B=4, S=32, V=1024):
+    ids = rng.randint(0, V, size=(B, S)).astype("int64")
+    return paddle.to_tensor(ids)
+
+
+class TestGPTMoE:
+    def test_structure_interleaves_moe_and_dense(self):
+        cfg = gpt_moe_tiny(num_hidden_layers=4, moe_every=2)
+        model = GPTMoEForPretraining(cfg)
+        kinds = [isinstance(b, GPTMoEDecoderLayer)
+                 for b in model.gpt.layers]
+        assert kinds == [False, True, False, True]
+        assert len(model.gpt.moe_layers()) == 2
+
+    def test_forward_shapes_and_aux_loss(self):
+        cfg = gpt_moe_tiny()
+        model = GPTMoEForPretraining(cfg)
+        rng = np.random.RandomState(0)
+        ids = _batch(rng, B=2, S=16, V=cfg.vocab_size)
+        logits = model(ids)
+        assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+        aux = model.aux_loss()
+        assert np.isfinite(float(aux))
+        assert float(aux) > 0  # gshard gate always records a balance loss
+
+    def test_train_step_decreases_loss_and_flows_expert_grads(self):
+        cfg = gpt_moe_tiny()
+        model = GPTMoEForPretraining(cfg)
+        crit = GPTMoEPretrainingCriterion(cfg, model)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        rng = np.random.RandomState(1)
+        ids = _batch(rng, B=4, S=32, V=cfg.vocab_size)  # one memorized batch
+        losses = []
+        for step in range(8):
+            logits = model(ids)
+            loss = crit(logits, ids)
+            loss.backward()
+            if step == 0:
+                moe = model.gpt.moe_layers()[0]
+                for nm in ("expert_w1", "expert_w2"):
+                    g = getattr(moe, nm).grad
+                    assert g is not None
+                    assert float(jnp.abs(g._value).sum()) > 0, nm
+                assert moe.gate.weight.grad is not None
+                # aux loss reaches the gate: zero its weight's grad from CE
+                # alone is impossible to isolate here, but the gate grad
+                # must be finite
+                assert np.all(np.isfinite(np.asarray(
+                    moe.gate.weight.grad._value)))
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_criterion_does_not_adopt_model_params(self):
+        # passing the model to the criterion must not register it as a
+        # sublayer — otherwise parameters()/state_dict() double-count
+        # every weight and the common AdamW(model+crit params) pattern
+        # applies each update twice
+        cfg = gpt_moe_tiny()
+        model = GPTMoEForPretraining(cfg)
+        crit = GPTMoEPretrainingCriterion(cfg, model)
+        assert list(crit.parameters()) == []
+        assert crit.state_dict() == {}
+
+    def test_aux_weight_zero_drops_gate_term(self):
+        cfg = gpt_moe_tiny(aux_loss_weight=0.0)
+        model = GPTMoEForPretraining(cfg)
+        crit = GPTMoEPretrainingCriterion(cfg, model)
+        rng = np.random.RandomState(2)
+        ids = _batch(rng, B=2, S=16, V=cfg.vocab_size)
+        logits = model(ids)
+        loss_with = crit(logits, ids)
+        from paddle_tpu.models.gpt import GPTPretrainingCriterion
+        ce_only = GPTPretrainingCriterion(cfg)(logits, ids)
+        np.testing.assert_allclose(float(loss_with), float(ce_only),
+                                   rtol=1e-6)
+
+    def test_ep_sharded_step_matches_unsharded(self):
+        """EP as GSPMD: the jitted loss over a (data, model) mesh with
+        expert weights sharded on the model axis equals the eager run."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        cfg = gpt_moe_tiny(num_experts=4, num_hidden_layers=2)
+        model = GPTMoEForPretraining(cfg)
+        crit = GPTMoEPretrainingCriterion(cfg, model)
+        rng = np.random.RandomState(3)
+        ids = _batch(rng, B=4, S=16, V=cfg.vocab_size)
+        eager_loss = float(crit(model(ids), ids))
+
+        params = [p for _, p in model.named_parameters()]
+        devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("data", "model"))
+        sharded = []
+        for p in params:
+            spec = getattr(p, "pspec", None) or (None,) * len(p.shape)
+            sharded.append(jax.device_put(
+                p._value, NamedSharding(mesh, P(*spec))))
+
+        def loss_fn(idv, *pvals):
+            olds = [p._value for p in params]
+            for p, v in zip(params, pvals):
+                p._value = v
+            try:
+                from paddle_tpu.framework import autograd as _ag
+                with _ag.suspend_tape():
+                    logits = model(Tensor(idv))
+                    return crit(logits, Tensor(idv))._value
+            finally:
+                for p, v in zip(params, olds):
+                    p._value = v
+
+        with mesh:
+            sharded_loss = float(jax.jit(loss_fn)(
+                ids._value, *sharded))
+        np.testing.assert_allclose(sharded_loss, eager_loss,
+                                   rtol=2e-4, atol=1e-5)
