@@ -668,12 +668,51 @@ def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
 
 
 # ---------------------------------------------------------------------------
+# Autoregressive decode (serving): GPT-125M bf16 greedy generation with the
+# static KV cache — prefill + the whole token-by-token scan is ONE compiled
+# dispatch, so the number is latency-robust by construction.
+# ---------------------------------------------------------------------------
+
+def bench_decode(B=8, P=128, N=128, iters=3):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                    num_hidden_layers=12, num_attention_heads=12,
+                    max_position_embeddings=P + N)
+    paddle.seed(0)
+    net = GPTForPretraining(cfg)
+    net.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, P)).astype("int32"))
+    out, _ = net.generate(ids, max_new_tokens=N, dtype="bfloat16")
+    _readback_sync(out._value[:, -1].astype("float32").sum())  # warmup
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out, _ = net.generate(ids, max_new_tokens=N, dtype="bfloat16",
+                              seed=i)
+        _readback_sync(out._value[:, -1].astype("float32").sum())
+    dt = time.perf_counter() - t0
+    decode_tps = iters * B * N / dt
+    return {"decode_tokens_per_sec": round(decode_tps, 1),
+            "ms_per_step": round(dt / (iters * N) * 1e3, 3),
+            "batch": B, "prompt": P, "new_tokens": N,
+            "model": "gpt125m", "dtype": "bfloat16"}
+
+
+# ---------------------------------------------------------------------------
 # GPT-MoE: GShard-pattern sparse FFNs (every other layer 8-expert top-2),
 # single chip.  MFU is computed over ACTIVE FLOPs (top_k of E experts per
 # token), the standard sparse-model accounting.
 # ---------------------------------------------------------------------------
 
-def bench_gpt_moe(B=8, S=1024, iters=6, peak=197e12):
+def bench_gpt_moe(B=12, S=1024, iters=6, peak=197e12):
+    # B sweep (r5, scanned): 8 -> 76.2k tok/s (37.6%), 12 -> 77.8k
+    # (38.5%), 16 -> 76.0k (37.5%); capacity-bucket padding waste peaks
+    # at small B, HBM pressure at large
     import jax
     import jax.numpy as jnp
 
@@ -918,6 +957,11 @@ def main():
                 configs["gpt_moe"] = bench_gpt_moe(peak=peak)
             except Exception as e:
                 configs["gpt_moe"] = {"error": repr(e)[:200]}
+        if want("decode"):
+            try:
+                configs["decode"] = bench_decode()
+            except Exception as e:
+                configs["decode"] = {"error": repr(e)[:200]}
     else:
         tiny = GPTConfig(vocab_size=1024, hidden_size=128,
                          num_hidden_layers=2, num_attention_heads=4,
@@ -933,10 +977,14 @@ def main():
         for name, cfg in configs.items():
             if not isinstance(cfg, dict):
                 continue
-            rate = cfg.get("tokens_per_sec") or cfg.get("images_per_sec")
-            if rate:
-                metric = f"{name}_{'tokens' if 'tokens_per_sec' in cfg else 'images'}_per_sec"
-                primary = cfg
+            for key in ("tokens_per_sec", "images_per_sec",
+                        "decode_tokens_per_sec"):
+                if cfg.get(key):
+                    metric = f"{name}_{key}"
+                    rate = cfg[key]
+                    primary = cfg
+                    break
+            if primary is not None:
                 break
         else:
             raise SystemExit("no benchmark config produced a number: "

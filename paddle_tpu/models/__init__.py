@@ -14,3 +14,4 @@ from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
 from .gpt_moe import (GPTMoEConfig, GPTMoEModel,  # noqa: F401
                       GPTMoEForPretraining, GPTMoEPretrainingCriterion,
                       gpt_moe_tiny, gpt_moe_small)
+from .generation import generate  # noqa: F401

@@ -1,0 +1,192 @@
+"""Autoregressive generation (reference: PaddleNLP GenerationMixin
+``model.generate`` with decode_strategy greedy_search/sampling, and the
+inference fused_multi_transformer cache_kv decode path).
+
+TPU-native design: ONE jitted function runs prefill plus a ``lax.scan``
+over single-token steps against preallocated static-shape KV caches
+(``jax.lax.dynamic_update_slice`` writes, additive prefix masks) — no
+per-token dispatch, no growing shapes, so the whole decode is a single
+compiled program. Sampling uses counter-based keys split per step;
+finished rows emit ``pad_token_id`` (scan has no early exit — the
+standard masked-finish formulation).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import autograd as _ag
+from ..framework.random import rng_scope
+
+__all__ = ["generate"]
+
+_STRATEGIES = ("greedy_search", "sampling")
+
+
+def _top_k_top_p_filter(logits, top_k, top_p):
+    """Mask logits outside the top-k set / top-p nucleus to -inf.
+    (B, V) fp32; always keeps at least the argmax."""
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p       # first column is always kept
+        kept_min = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                           keepdims=True)
+        logits = jnp.where(logits < kept_min, -jnp.inf, logits)
+    return logits
+
+
+def generate(model, input_ids, max_new_tokens=32,
+             decode_strategy="greedy_search", temperature=1.0, top_k=0,
+             top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0,
+             dtype=None):
+    """Generate ``max_new_tokens`` continuations of ``input_ids``.
+
+    Returns ``(ids, scores)``: the generated tokens (B, max_new_tokens)
+    and their selected-token log-probabilities, matching the reference's
+    ``GenerationMixin.generate`` return contract (generated portion only,
+    prompt excluded). The model must expose ``kv_cache_spec()`` and a
+    ``forward(input_ids, caches=..., pos=...)`` cached mode (GPT and
+    LLaMA families do). ``dtype="bfloat16"`` runs the whole decode in
+    bf16 weights/caches (serving mode; token picks stay fp32).
+
+    The compiled prefill+scan program is cached on the model per
+    (shapes, strategy, knobs) signature, so repeated serving calls pay
+    tracing once.
+    """
+    if decode_strategy not in _STRATEGIES:
+        raise ValueError(
+            f"decode_strategy {decode_strategy!r} not in {_STRATEGIES}; "
+            "beam search lives in paddle.nn.BeamSearchDecoder + "
+            "dynamic_decode")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    ids_np = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
+                        else input_ids).astype("int32")
+    if ids_np.ndim != 2:
+        raise ValueError("input_ids must be (batch, prompt_len)")
+    B, P = ids_np.shape
+    MAX = P + max_new_tokens
+    cfg = getattr(model, "config", None) \
+        or getattr(getattr(model, "model", None), "config", None)
+    limit = getattr(cfg, "max_position_embeddings", None)
+    if limit is not None and MAX > limit:
+        # past the table, position lookups would clamp and silently
+        # produce degenerate logits — refuse instead
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {MAX} exceeds the model's "
+            f"max_position_embeddings = {limit}")
+    spec = model.kv_cache_spec()
+    params = [p for _, p in model.named_parameters()]
+    pvals = [p._value for p in params]
+    cache_dtype = jnp.float32
+    if dtype is not None:
+        cache_dtype = jnp.dtype(dtype)
+        # cast once per (dtype, weight identity): repeated serving calls
+        # must not re-materialize a full low-precision weight copy.
+        # Identity is checked by `is` against strongly-held originals,
+        # so a train step (new _value arrays) recasts automatically.
+        cast = model.__dict__.get("_generation_cast")
+        if (cast is not None and cast[0] == str(cache_dtype)
+                and len(cast[1]) == len(pvals)
+                and all(a is b for a, b in zip(cast[1], pvals))):
+            pvals = cast[2]
+        else:
+            originals = pvals
+            pvals = [v.astype(cache_dtype)
+                     if jnp.issubdtype(v.dtype, jnp.floating) else v
+                     for v in pvals]
+            # plain attr set: Layer.__setattr__ would try to register it
+            object.__setattr__(model, "_generation_cast",
+                               (str(cache_dtype), originals, pvals))
+    greedy = decode_strategy == "greedy_search"
+    eos = None if eos_token_id is None else int(eos_token_id)
+    pad = int(pad_token_id)
+
+    was_training = model.training
+    model.eval()
+
+    def apply(pv, ids, caches, pos):
+        olds = [p._value for p in params]
+        for p, v in zip(params, pv):
+            p._value = v
+        try:
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                logits, new_caches = model(
+                    Tensor(ids),
+                    caches=[(Tensor(k), Tensor(v)) for k, v in caches],
+                    pos=Tensor(pos))
+            return logits._value, [(k._value, v._value)
+                                   for k, v in new_caches]
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+
+    def pick(logits, key):
+        lg = logits.astype(jnp.float32)
+        if not greedy and temperature != 1.0:
+            lg = lg / max(float(temperature), 1e-6)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        if greedy:
+            nxt = jnp.argmax(lg, axis=-1)
+        else:
+            nxt = jax.random.categorical(
+                key, _top_k_top_p_filter(lg, top_k, top_p), axis=-1)
+        score = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+        return nxt.astype(jnp.int32), score
+
+    def run(pv, prompt, key):
+        caches = [(jnp.zeros((B, MAX, nh, d), cache_dtype),
+                   jnp.zeros((B, MAX, nh, d), cache_dtype))
+                  for nh, d in spec]
+        logits, caches = apply(pv, prompt, caches,
+                               jnp.zeros((), jnp.int32))
+        k0, key = jax.random.split(key)
+        tok0, sc0 = pick(logits[:, -1, :], k0)
+        finished = jnp.zeros((B,), bool) if eos is None else (tok0 == eos)
+
+        def body(carry, step_key):
+            tok, caches, pos, finished = carry
+            logits, caches = apply(pv, tok[:, None], caches, pos)
+            nxt, score = pick(logits[:, 0, :], step_key)
+            nxt = jnp.where(finished, pad, nxt)
+            score = jnp.where(finished, 0.0, score)
+            if eos is not None:
+                new_fin = finished | (nxt == eos)
+            else:
+                new_fin = finished
+            return (nxt, caches, pos + 1, new_fin), (nxt, score)
+
+        if max_new_tokens > 1:
+            keys = jax.random.split(key, max_new_tokens - 1)
+            _, (toks, scores) = jax.lax.scan(
+                body, (tok0, caches, jnp.full((), P, jnp.int32), finished),
+                keys)
+            out_ids = jnp.concatenate([tok0[:, None], toks.T], axis=1)
+            out_sc = jnp.concatenate([sc0[:, None], scores.T], axis=1)
+        else:
+            out_ids, out_sc = tok0[:, None], sc0[:, None]
+        return out_ids, out_sc
+
+    sig = (B, P, max_new_tokens, decode_strategy, float(temperature),
+           int(top_k or 0), float(top_p if top_p is not None else 1.0),
+           eos, pad, str(cache_dtype))
+    jit_cache = model.__dict__.get("_generation_cache")
+    if jit_cache is None:
+        jit_cache = {}
+        # plain attr set: Layer.__setattr__ would try to register it
+        object.__setattr__(model, "_generation_cache", jit_cache)
+    fn = jit_cache.get(sig)
+    if fn is None:
+        fn = jit_cache[sig] = jax.jit(run)
+    try:
+        out_ids, out_sc = fn(pvals, jnp.asarray(ids_np),
+                             jax.random.key(int(seed)))
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out_ids), Tensor(out_sc)

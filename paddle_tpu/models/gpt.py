@@ -82,12 +82,19 @@ class GPTAttention(nn.Layer):
             self.qkv_proj = nn.Linear(H, 3 * H)
             self.out_proj = nn.Linear(H, H)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, pos=None):
         from ..tensor.manipulation import reshape, concat
         B, S, H = x.shape
         qkv = self.qkv_proj(x)
         qkv = reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if pos is not None:
+            # static-shape decode: write this chunk's k/v at offset `pos`
+            # into the preallocated (B, MAX, nH, D) buffers and attend
+            # over the masked prefix — the jit/scan-friendly KV cache
+            # (reference: cache_kv in fused multi_transformer inference)
+            return _cached_attention(self.out_proj, q, k, v, cache, pos,
+                                     B, S, H)
         if cache is not None:
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
@@ -100,6 +107,32 @@ class GPTAttention(nn.Layer):
         if cache is not None:
             return out, cache
         return out
+
+
+def _cached_attention(out_proj, q, k, v, cache, pos, B, S, H):
+    """Shared fixed-buffer KV attention for compiled decode: k/v land at
+    offset ``pos`` (traced scalar) via dynamic_update_slice; queries at
+    absolute positions pos..pos+S-1 attend to prefix positions <= theirs
+    through an additive mask. Returns (out, (k_buf, v_buf))."""
+    from ..tensor.manipulation import reshape
+    k_buf, v_buf = cache
+    MAX = k_buf.shape[1]
+
+    def write(buf, new, p):
+        return jax.lax.dynamic_update_slice(
+            buf, new.astype(buf.dtype), (0, p.astype(jnp.int32), 0, 0))
+    k_buf = call_op(write, k_buf, k, pos)
+    v_buf = call_op(write, v_buf, v, pos)
+
+    def mask_fn(p):
+        valid = jnp.arange(MAX)[None, :] <= \
+            (p.astype(jnp.int32) + jnp.arange(S))[:, None]
+        return jnp.where(valid, 0.0, -1e30)[None, None]  # (1,1,S,MAX)
+    mask = call_op(mask_fn, pos)
+    out = F.scaled_dot_product_attention(q, k_buf, v_buf, attn_mask=mask,
+                                         is_causal=False, training=False)
+    out = reshape(out, [B, S, H])
+    return out_proj(out), (k_buf, v_buf)
 
 
 class GPTMLP(nn.Layer):
@@ -129,7 +162,12 @@ class GPTDecoderLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._remat = config.remat
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if pos is not None:
+            a, cache = self.attn(self.ln1(x), cache=cache, pos=pos)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return x
@@ -168,7 +206,17 @@ class GPTModel(nn.Layer):
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
+        if pos is not None:
+            S = input_ids.shape[1]
+            position_ids = call_op(
+                lambda p: p.astype(jnp.int32) + jnp.arange(S), pos)
+            x = self.embeddings(input_ids, position_ids)
+            new_caches = []
+            for blk, cache in zip(self.layers, caches):
+                x, cache = blk(x, cache=cache, pos=pos)
+                new_caches.append(cache)
+            return self.final_norm(x), new_caches
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
             if self.config.remat:
@@ -225,10 +273,24 @@ class GPTForPretraining(nn.Layer):
         self.config = config
         _init_gpt_weights(self, config.initializer_range)
 
-    def forward(self, input_ids, position_ids=None):
-        x = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
         w = self.gpt.embeddings.word_embeddings.weight
+        if pos is not None:
+            x, caches = self.gpt(input_ids, caches=caches, pos=pos)
+            return call_op(lambda h, wv: h @ wv.T, x, w), caches
+        x = self.gpt(input_ids, position_ids)
         return call_op(lambda h, wv: h @ wv.T, x, w)
+
+    def kv_cache_spec(self):
+        """Per-layer (num_kv_heads, head_dim) for generation's
+        preallocated cache buffers."""
+        H = self.config.hidden_size
+        nh = self.config.num_attention_heads
+        return [(nh, H // nh)] * self.config.num_hidden_layers
+
+    def generate(self, input_ids, **kw):
+        from .generation import generate
+        return generate(self, input_ids, **kw)
 
 
 class GPTPretrainingCriterion(nn.Layer):

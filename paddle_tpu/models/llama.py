@@ -91,12 +91,24 @@ class LlamaAttention(nn.Layer):
             self.v_proj = nn.Linear(H, kv_out, bias_attr=False)
             self.o_proj = nn.Linear(H, H, bias_attr=False)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         from ..tensor.manipulation import reshape
         B, S, H = x.shape
         q = reshape(self.q_proj(x), [B, S, self.num_heads, self.head_dim])
         k = reshape(self.k_proj(x), [B, S, self.num_kv, self.head_dim])
         v = reshape(self.v_proj(x), [B, S, self.num_kv, self.head_dim])
+        if pos is not None:
+            # absolute rotary positions pos..pos+S-1, then the shared
+            # fixed-buffer cached attention (see gpt._cached_attention)
+            from .gpt import _cached_attention
+
+            def roped(t, p):
+                ids = p.astype(jnp.int32) + jnp.arange(S)
+                return _rope(t, self.theta, position_ids=ids)
+            q = call_op(roped, q, pos)
+            k = call_op(roped, k, pos)
+            return _cached_attention(self.o_proj, q, k, v, cache, pos,
+                                     B, S, H)
         q = call_op(lambda t: _rope(t, self.theta), q)
         k = call_op(lambda t: _rope(t, self.theta), k)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
@@ -134,7 +146,13 @@ class LlamaDecoderLayer(nn.Layer):
             config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if pos is not None:
+            a, cache = self.self_attn(self.input_layernorm(x),
+                                      cache=cache, pos=pos)
+            x = x + a
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, cache
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -156,8 +174,14 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size,
                                epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         x = self.embed_tokens(input_ids)
+        if pos is not None:
+            new_caches = []
+            for blk, cache in zip(self.layers, caches):
+                x, cache = blk(x, cache=cache, pos=pos)
+                new_caches.append(cache)
+            return self.norm(x), new_caches
         for blk in self.layers:
             if self.config.remat:
                 from .gpt import _remat_block
@@ -179,5 +203,20 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
+        if pos is not None:
+            x, caches = self.model(input_ids, caches=caches, pos=pos)
+            return self.lm_head(x), caches
         return self.lm_head(self.model(input_ids))
+
+    def kv_cache_spec(self):
+        """Per-layer (num_kv_heads, head_dim) for generation's
+        preallocated cache buffers (GQA: kv heads < query heads)."""
+        c = self.model.config
+        return [(c.num_key_value_heads,
+                 c.hidden_size // c.num_attention_heads)] * \
+            c.num_hidden_layers
+
+    def generate(self, input_ids, **kw):
+        from .generation import generate
+        return generate(self, input_ids, **kw)
