@@ -18,9 +18,32 @@ from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework.random import rng_scope
 
-__all__ = ["generate"]
+__all__ = ["generate", "GenerationMixin"]
 
 _STRATEGIES = ("greedy_search", "sampling")
+
+
+class GenerationMixin:
+    """Shared generation protocol for the causal-LM families: a
+    ``generate()`` entry and the default per-layer KV-cache spec derived
+    from the model config (GQA-aware via ``num_key_value_heads``)."""
+
+    def _gen_config(self):
+        cfg = getattr(self, "config", None)
+        if cfg is None:
+            cfg = self.model.config
+        return cfg
+
+    def kv_cache_spec(self):
+        """Per-layer (num_kv_heads, head_dim) for generation's
+        preallocated cache buffers."""
+        c = self._gen_config()
+        kv = getattr(c, "num_key_value_heads", 0) or c.num_attention_heads
+        return [(kv, c.hidden_size // c.num_attention_heads)] * \
+            c.num_hidden_layers
+
+    def generate(self, input_ids, **kw):
+        return generate(self, input_ids, **kw)
 
 
 def _top_k_top_p_filter(logits, top_k, top_p):
@@ -50,9 +73,15 @@ def generate(model, input_ids, max_new_tokens=32,
     and their selected-token log-probabilities, matching the reference's
     ``GenerationMixin.generate`` return contract (generated portion only,
     prompt excluded). The model must expose ``kv_cache_spec()`` and a
-    ``forward(input_ids, caches=..., pos=...)`` cached mode (GPT and
-    LLaMA families do). ``dtype="bfloat16"`` runs the whole decode in
-    bf16 weights/caches (serving mode; token picks stay fp32).
+    ``forward(input_ids, caches=..., pos=...)`` cached mode (the GPT,
+    LLaMA and GPT-MoE families do). ``dtype="bfloat16"`` runs the whole
+    decode in bf16 weights/caches (serving mode; token picks stay fp32).
+
+    MoE note: expert routing runs per decode step, so capacity is
+    competed among that step's B tokens only — the well-defined causal
+    semantics. A capacity-dropping full re-forward (teacher forcing)
+    routes batch-globally and may drop differently; exact parity holds
+    when capacity never binds.
 
     The compiled prefill+scan program is cached on the model per
     (shapes, strategy, knobs) signature, so repeated serving calls pay
@@ -183,10 +212,20 @@ def generate(model, input_ids, max_new_tokens=32,
     fn = jit_cache.get(sig)
     if fn is None:
         fn = jit_cache[sig] = jax.jit(run)
+    # MoE gates record their aux loss as a side-effect attribute during
+    # forward; inside the jitted scan that value is a tracer, and leaving
+    # it behind would crash the next aux_loss()/get_loss() read — restore
+    # the pre-generate values after the compiled call
+    from ..incubate.distributed.models.moe.gate import BaseGate
+    gates = [m for _, m in model.named_sublayers()
+             if isinstance(m, BaseGate)]
+    saved_losses = [g.loss for g in gates]
     try:
         out_ids, out_sc = fn(pvals, jnp.asarray(ids_np),
                              jax.random.key(int(seed)))
     finally:
+        for g, l in zip(gates, saved_losses):
+            object.__setattr__(g, "loss", l)
         if was_training:
             model.train()
     return Tensor(out_ids), Tensor(out_sc)

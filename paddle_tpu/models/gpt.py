@@ -25,6 +25,7 @@ from .. import nn
 from ..nn import functional as F
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from .generation import GenerationMixin
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForPretraining",
            "GPTPretrainingCriterion", "gpt3_tiny", "gpt3_125m", "gpt3_1p3b"]
@@ -135,6 +136,25 @@ def _cached_attention(out_proj, q, k, v, cache, pos, B, S, H):
     return out_proj(out), (k_buf, v_buf)
 
 
+def _cached_block(ln1, attn, ln2, ffn, x, cache, pos):
+    """One decode step of a pre-LN block: cached attention + FFN with
+    residuals — shared by the GPT/GPT-MoE/LLaMA decoder layers."""
+    a, cache = attn(ln1(x), cache=cache, pos=pos)
+    x = x + a
+    x = x + ffn(ln2(x))
+    return x, cache
+
+
+def _cached_layers(layers, caches, pos, x, final_norm):
+    """Thread per-layer KV caches through the block stack and apply the
+    final norm — the model-level cached forward shared by the families."""
+    new_caches = []
+    for blk, cache in zip(layers, caches):
+        x, cache = blk(x, cache=cache, pos=pos)
+        new_caches.append(cache)
+    return final_norm(x), new_caches
+
+
 class GPTMLP(nn.Layer):
     def __init__(self, config):
         super().__init__()
@@ -164,10 +184,8 @@ class GPTDecoderLayer(nn.Layer):
 
     def forward(self, x, cache=None, pos=None):
         if pos is not None:
-            a, cache = self.attn(self.ln1(x), cache=cache, pos=pos)
-            x = x + a
-            x = x + self.mlp(self.ln2(x))
-            return x, cache
+            return _cached_block(self.ln1, self.attn, self.ln2, self.mlp,
+                                 x, cache, pos)
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return x
@@ -212,11 +230,8 @@ class GPTModel(nn.Layer):
             position_ids = call_op(
                 lambda p: p.astype(jnp.int32) + jnp.arange(S), pos)
             x = self.embeddings(input_ids, position_ids)
-            new_caches = []
-            for blk, cache in zip(self.layers, caches):
-                x, cache = blk(x, cache=cache, pos=pos)
-                new_caches.append(cache)
-            return self.final_norm(x), new_caches
+            return _cached_layers(self.layers, caches, pos, x,
+                                  self.final_norm)
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
             if self.config.remat:
@@ -263,7 +278,7 @@ def _init_gpt_weights(root, std):
                 rng.normal(0.0, std, shape).astype("float32"))
 
 
-class GPTForPretraining(nn.Layer):
+class GPTForPretraining(nn.Layer, GenerationMixin):
     """LM head tied to the input embedding (reference: shared weights via
     SharedLayerDesc in PP; here the tie is literal reuse)."""
 
@@ -280,17 +295,6 @@ class GPTForPretraining(nn.Layer):
             return call_op(lambda h, wv: h @ wv.T, x, w), caches
         x = self.gpt(input_ids, position_ids)
         return call_op(lambda h, wv: h @ wv.T, x, w)
-
-    def kv_cache_spec(self):
-        """Per-layer (num_kv_heads, head_dim) for generation's
-        preallocated cache buffers."""
-        H = self.config.hidden_size
-        nh = self.config.num_attention_heads
-        return [(nh, H // nh)] * self.config.num_hidden_layers
-
-    def generate(self, input_ids, **kw):
-        from .generation import generate
-        return generate(self, input_ids, **kw)
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -24,6 +24,7 @@ from ..nn import functional as F
 from ..incubate.distributed.models.moe import MoELayer, ExpertLayer
 from .gpt import (GPTConfig, GPTAttention, GPTDecoderLayer, GPTEmbeddings,
                   GPTPretrainingCriterion, _init_gpt_weights, _remat_block)
+from .generation import GenerationMixin
 
 __all__ = ["GPTMoEConfig", "GPTMoEModel", "GPTMoEForPretraining",
            "GPTMoEPretrainingCriterion", "gpt_moe_tiny", "gpt_moe_small"]
@@ -76,7 +77,11 @@ class GPTMoEDecoderLayer(nn.Layer):
             expert_axis=config.expert_axis)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if pos is not None:
+            from .gpt import _cached_block
+            return _cached_block(self.ln1, self.attn, self.ln2, self.moe,
+                                 x, cache, pos)
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.moe(self.ln2(x)))
         return x
@@ -97,7 +102,15 @@ class GPTMoEModel(nn.Layer):
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
+        if pos is not None:
+            from .gpt import _cached_layers
+            S = input_ids.shape[1]
+            position_ids = call_op(
+                lambda p: p.astype(jnp.int32) + jnp.arange(S), pos)
+            x = self.embeddings(input_ids, position_ids)
+            return _cached_layers(self.layers, caches, pos, x,
+                                  self.final_norm)
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
             if self.config.remat:
@@ -111,7 +124,7 @@ class GPTMoEModel(nn.Layer):
                 if isinstance(blk, GPTMoEDecoderLayer)]
 
 
-class GPTMoEForPretraining(nn.Layer):
+class GPTMoEForPretraining(nn.Layer, GenerationMixin):
     """LM head tied to the input embedding; ``aux_loss()`` sums the
     load-balancing losses the gates recorded during the last forward."""
 
@@ -126,9 +139,12 @@ class GPTMoEForPretraining(nn.Layer):
                     or name.endswith("expert_b2"):
                 p._value = jnp.zeros(tuple(p.shape), p.dtype)
 
-    def forward(self, input_ids, position_ids=None):
-        x = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
         w = self.gpt.embeddings.word_embeddings.weight
+        if pos is not None:
+            x, caches = self.gpt(input_ids, caches=caches, pos=pos)
+            return call_op(lambda h, wv: h @ wv.T, x, w), caches
+        x = self.gpt(input_ids, position_ids)
         return call_op(lambda h, wv: h @ wv.T, x, w)
 
     def aux_loss(self):

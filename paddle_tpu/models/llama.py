@@ -16,6 +16,7 @@ from .. import nn
 from ..nn import functional as F
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from .generation import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_7b",
            "llama_tiny"]
@@ -148,11 +149,10 @@ class LlamaDecoderLayer(nn.Layer):
 
     def forward(self, x, cache=None, pos=None):
         if pos is not None:
-            a, cache = self.self_attn(self.input_layernorm(x),
-                                      cache=cache, pos=pos)
-            x = x + a
-            x = x + self.mlp(self.post_attention_layernorm(x))
-            return x, cache
+            from .gpt import _cached_block
+            return _cached_block(self.input_layernorm, self.self_attn,
+                                 self.post_attention_layernorm, self.mlp,
+                                 x, cache, pos)
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -177,11 +177,8 @@ class LlamaModel(nn.Layer):
     def forward(self, input_ids, caches=None, pos=None):
         x = self.embed_tokens(input_ids)
         if pos is not None:
-            new_caches = []
-            for blk, cache in zip(self.layers, caches):
-                x, cache = blk(x, cache=cache, pos=pos)
-                new_caches.append(cache)
-            return self.norm(x), new_caches
+            from .gpt import _cached_layers
+            return _cached_layers(self.layers, caches, pos, x, self.norm)
         for blk in self.layers:
             if self.config.remat:
                 from .gpt import _remat_block
@@ -191,7 +188,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config):
         super().__init__()
         self.model = LlamaModel(config)
@@ -208,15 +205,3 @@ class LlamaForCausalLM(nn.Layer):
             x, caches = self.model(input_ids, caches=caches, pos=pos)
             return self.lm_head(x), caches
         return self.lm_head(self.model(input_ids))
-
-    def kv_cache_spec(self):
-        """Per-layer (num_kv_heads, head_dim) for generation's
-        preallocated cache buffers (GQA: kv heads < query heads)."""
-        c = self.model.config
-        return [(c.num_key_value_heads,
-                 c.hidden_size // c.num_attention_heads)] * \
-            c.num_hidden_layers
-
-    def generate(self, input_ids, **kw):
-        from .generation import generate
-        return generate(self, input_ids, **kw)
