@@ -13,8 +13,7 @@ TPU-first choices:
 - ``remat`` toggles jax.checkpoint per block (the reference's
   recompute_interval).
 """
-import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,6 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from .. import nn
-from ..nn import functional as F
 from ..incubate.distributed.models.moe import MoELayer, ExpertLayer
 from .gpt import (GPTConfig, GPTAttention, GPTDecoderLayer, GPTEmbeddings,
                   GPTPretrainingCriterion, _init_gpt_weights, _remat_block)

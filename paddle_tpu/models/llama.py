@@ -4,13 +4,9 @@ RMSNorm + SwiGLU + rotary embeddings + GQA; TP via the same mp_layers
 annotations as GPT.  RoPE is applied in fp32 (bf16 rotation loses phase
 accuracy at long context).
 """
-import math
 from dataclasses import dataclass
 
-import jax
 import jax.numpy as jnp
-
-from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from .. import nn
 from ..nn import functional as F
