@@ -220,6 +220,9 @@ def bench_resnet50(B, iters):
     """r3 analysis vs BASELINE's 2.5-3.7k img/s/chip public anchor:
     measured v5e-1 ceiling here is ~2.4k at B=256 (2.1k in r2; the gain
     came from folding BN into one fused E[x]/E[x^2] pass + bf16 apply).
+    r5 B-sweep re-check: 256 -> 2447, 320 -> 2174, 384 -> 2271,
+    512 -> 2280 img/s — larger batches LOSE (activation HBM pressure),
+    so B=256 stays the operating point.
     Why it tops out: ResNet-50's 1x1 bottleneck convs are HBM-bound
     (arith intensity ~Cout flops/byte -> roofline ~26% of bf16 peak),
     and the 3x3 convs reach only 16-25% of peak under the XLA conv

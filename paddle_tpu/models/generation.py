@@ -18,6 +18,25 @@ from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework.random import rng_scope
 
+
+def _caches_for(model):
+    """Per-model generation caches (compiled programs + cast weights),
+    stored on the instance so the model→cache→closure→model cycle stays
+    collectible by the GC (a module-global registry would pin every
+    model forever through the jit closures). The ``owner_id`` token
+    invalidates entries that rode along a deepcopy (e.g.
+    quantization.fp8_quantize): a copied entry's closures capture the
+    ORIGINAL model's parameter list and would crash with shape errors.
+    id() collision with a dead original is impossible while the stale
+    entry exists — its closures keep the original alive.
+    """
+    entry = model.__dict__.get("_generation_caches")
+    if entry is None or entry.get("owner_id") != id(model):
+        entry = {"owner_id": id(model), "jit": {}, "cast": None}
+        # plain attr set: Layer.__setattr__ would try to register it
+        object.__setattr__(model, "_generation_caches", entry)
+    return entry
+
 __all__ = ["generate", "GenerationMixin"]
 
 _STRATEGIES = ("greedy_search", "sampling")
@@ -119,7 +138,8 @@ def generate(model, input_ids, max_new_tokens=32,
         # must not re-materialize a full low-precision weight copy.
         # Identity is checked by `is` against strongly-held originals,
         # so a train step (new _value arrays) recasts automatically.
-        cast = model.__dict__.get("_generation_cast")
+        caches = _caches_for(model)
+        cast = caches["cast"]
         if (cast is not None and cast[0] == str(cache_dtype)
                 and len(cast[1]) == len(pvals)
                 and all(a is b for a, b in zip(cast[1], pvals))):
@@ -129,9 +149,7 @@ def generate(model, input_ids, max_new_tokens=32,
             pvals = [v.astype(cache_dtype)
                      if jnp.issubdtype(v.dtype, jnp.floating) else v
                      for v in pvals]
-            # plain attr set: Layer.__setattr__ would try to register it
-            object.__setattr__(model, "_generation_cast",
-                               (str(cache_dtype), originals, pvals))
+            caches["cast"] = (str(cache_dtype), originals, pvals)
     greedy = decode_strategy == "greedy_search"
     eos = None if eos_token_id is None else int(eos_token_id)
     pad = int(pad_token_id)
@@ -204,11 +222,7 @@ def generate(model, input_ids, max_new_tokens=32,
     sig = (B, P, max_new_tokens, decode_strategy, float(temperature),
            int(top_k or 0), float(top_p if top_p is not None else 1.0),
            eos, pad, str(cache_dtype))
-    jit_cache = model.__dict__.get("_generation_cache")
-    if jit_cache is None:
-        jit_cache = {}
-        # plain attr set: Layer.__setattr__ would try to register it
-        object.__setattr__(model, "_generation_cache", jit_cache)
+    jit_cache = _caches_for(model)["jit"]
     fn = jit_cache.get(sig)
     if fn is None:
         fn = jit_cache[sig] = jax.jit(run)

@@ -173,15 +173,29 @@ class TestEosAndErrors:
     def test_compiled_program_cached_across_calls(self, gpt):
         ids = paddle.to_tensor(np.asarray([[1, 2, 3]], dtype="int32"))
         gpt.generate(ids, max_new_tokens=2)
-        n0 = len(gpt._generation_cache)
+        jit_cache = gpt.__dict__["_generation_caches"]["jit"]
+        n0 = len(jit_cache)
         gpt.generate(ids, max_new_tokens=2, seed=5)   # same signature
-        assert len(gpt._generation_cache) == n0
+        assert len(jit_cache) == n0
         gpt.generate(ids, max_new_tokens=3)           # new signature
-        assert len(gpt._generation_cache) == n0 + 1
-        # the cache must not have been registered as a sublayer/param
-        assert "_generation_cache" not in dict(gpt.named_sublayers())
-        assert all(n != "_generation_cache"
+        assert len(jit_cache) == n0 + 1
+        # the cache is a plain instance attr: never a sublayer/param
+        assert "_generation_caches" not in dict(gpt.named_sublayers())
+        assert all(n != "_generation_caches"
                    for n, _ in gpt.named_parameters())
+
+    def test_model_with_caches_is_garbage_collectible(self):
+        # the model→cache→jit-closure→model cycle must stay collectible:
+        # a serving process that drops transient models can't leak them
+        import gc
+        import weakref
+        net = GPTForPretraining(gpt3_tiny())
+        net.generate(paddle.to_tensor(
+            np.asarray([[1, 2]], dtype="int32")), max_new_tokens=2)
+        ref = weakref.ref(net)
+        del net
+        gc.collect()
+        assert ref() is None
 
     def test_bf16_serving_mode(self, gpt):
         ids = paddle.to_tensor(np.asarray([[4, 5, 6, 7]], dtype="int32"))
@@ -192,13 +206,13 @@ class TestEosAndErrors:
         assert np.all(np.isfinite(np.asarray(sc._value)))
         # the bf16 weight copy is cached by identity: a second call reuses
         # it, a weight update invalidates it
-        cast1 = gpt._generation_cast[2]
+        cast1 = gpt.__dict__["_generation_caches"]["cast"][2]
         gpt.generate(ids, max_new_tokens=6, dtype="bfloat16", seed=1)
-        assert gpt._generation_cast[2] is cast1
+        assert gpt.__dict__["_generation_caches"]["cast"][2] is cast1
         p = next(v for _, v in gpt.named_parameters())
         p._value = p._value + 0.0   # new array identity
         gpt.generate(ids, max_new_tokens=6, dtype="bfloat16", seed=2)
-        assert gpt._generation_cast[2] is not cast1
+        assert gpt.__dict__["_generation_caches"]["cast"][2] is not cast1
 
     def test_overlong_decode_refused(self, gpt):
         # gpt3_tiny has max_position_embeddings=128
@@ -206,6 +220,29 @@ class TestEosAndErrors:
             np.zeros((1, 120), dtype="int32"))
         with pytest.raises(ValueError, match="max_position_embeddings"):
             gpt.generate(ids, max_new_tokens=20)
+
+    def test_fp8_quantized_model_generates(self):
+        # deepcopy-based quantization after a generate() must not drag
+        # stale compiled closures along (caches are keyed by identity
+        # outside the model) — regression for a real shape-mismatch crash
+        from paddle_tpu.quantization import fp8_quantize
+        paddle.seed(0)
+        net = GPTForPretraining(gpt3_tiny())
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, (2, 6))
+            .astype("int32"))
+        net.generate(ids, max_new_tokens=4)   # populate identity caches
+        qnet = fp8_quantize(net)
+        out, sc = qnet.generate(ids, max_new_tokens=4)
+        toks = np.asarray(out._value)
+        assert toks.shape == (2, 4)
+        assert toks.min() >= 0 and toks.max() < 1024
+        assert np.all(np.isfinite(np.asarray(sc._value)))
+        # quantized logits stay close to the fp32 model's (weight-only
+        # e4m3, per-channel scales)
+        lq = np.asarray(qnet(ids)._value, np.float32)
+        lr = np.asarray(net(ids)._value, np.float32)
+        assert np.max(np.abs(lq - lr)) < 0.2 * np.max(np.abs(lr))
 
     def test_training_mode_restored(self, gpt):
         gpt.train()
