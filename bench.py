@@ -677,6 +677,17 @@ def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
 # ---------------------------------------------------------------------------
 
 def bench_decode(B=8, P=128, N=128, iters=3):
+    """Measured r5: bf16 1.22-1.44 ms/step.  fp8-quantizing the model
+    (quantization.fp8_quantize + generate, measured directly) TIES bf16
+    here (1.25 vs 1.22 ms/step): at 768-wide layers the decode step is
+    not weight-bandwidth-dominated, so halving matmul weight bytes
+    doesn't move it — the fp8 serving win needs the K=N=4096-class
+    layers the fp8_linear config measures (1.66x there).  A 1.3B-scale
+    decode (where the weight stream WOULD dominate) could not be
+    measured: the 24-layer x 128-step scan program exceeds what the
+    axon remote-compile tunnel will take (broken pipe both attempts);
+    single-op compiles still work after, so it is program size, not
+    chip state."""
     import jax
 
     import paddle_tpu as paddle

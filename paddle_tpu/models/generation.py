@@ -19,20 +19,33 @@ from ..framework import autograd as _ag
 from ..framework.random import rng_scope
 
 
+class _GenCaches(dict):
+    """Cache holder that refuses to travel: deepcopy (e.g.
+    quantization.fp8_quantize) gets None instead of a copy — a copied
+    entry's jit closures would capture the ORIGINAL model's parameter
+    list (shape crashes) and pin that model plus its cast weight sets in
+    memory; pickling degrades to an empty plain dict (jit functions
+    aren't picklable)."""
+
+    def __deepcopy__(self, memo):
+        return None
+
+    def __reduce__(self):
+        return (dict, ())
+
+
 def _caches_for(model):
     """Per-model generation caches (compiled programs + cast weights),
     stored on the instance so the model→cache→closure→model cycle stays
     collectible by the GC (a module-global registry would pin every
-    model forever through the jit closures). The ``owner_id`` token
-    invalidates entries that rode along a deepcopy (e.g.
-    quantization.fp8_quantize): a copied entry's closures capture the
-    ORIGINAL model's parameter list and would crash with shape errors.
-    id() collision with a dead original is impossible while the stale
+    model forever through the jit closures). The ``owner_id`` token is a
+    second line of defense against entries that arrive by shallow copy.
+    id() collision with a dead original is impossible while a stale
     entry exists — its closures keep the original alive.
     """
     entry = model.__dict__.get("_generation_caches")
     if entry is None or entry.get("owner_id") != id(model):
-        entry = {"owner_id": id(model), "jit": {}, "cast": None}
+        entry = _GenCaches(owner_id=id(model), jit={}, cast=None)
         # plain attr set: Layer.__setattr__ would try to register it
         object.__setattr__(model, "_generation_caches", entry)
     return entry
@@ -131,7 +144,14 @@ def generate(model, input_ids, max_new_tokens=32,
     spec = model.kv_cache_spec()
     params = [p for _, p in model.named_parameters()]
     pvals = [p._value for p in params]
-    cache_dtype = jnp.float32
+    # KV caches follow the model's dominant floating dtype by element
+    # count (a bf16-weight model gets bf16 caches; a stray fp32 norm or
+    # embedding doesn't flip the choice) unless `dtype` overrides
+    sizes = {}
+    for v in pvals:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            sizes[v.dtype] = sizes.get(v.dtype, 0) + int(v.size)
+    cache_dtype = max(sizes, key=sizes.get) if sizes else jnp.float32
     if dtype is not None:
         cache_dtype = jnp.dtype(dtype)
         # cast once per (dtype, weight identity): repeated serving calls

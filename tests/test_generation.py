@@ -184,6 +184,24 @@ class TestEosAndErrors:
         assert all(n != "_generation_caches"
                    for n, _ in gpt.named_parameters())
 
+    def test_quantized_copy_does_not_pin_original(self):
+        # deepcopy must not carry the caches at all: otherwise the copy's
+        # entry pins the original model (jit closures) until the copy
+        # happens to generate — or forever if it never does
+        import gc
+        import weakref
+        from paddle_tpu.quantization import fp8_quantize
+        net = GPTForPretraining(gpt3_tiny())
+        net.generate(paddle.to_tensor(
+            np.asarray([[1, 2]], dtype="int32")), max_new_tokens=2,
+            dtype="bfloat16")
+        qnet = fp8_quantize(net)
+        assert qnet.__dict__.get("_generation_caches") is None
+        ref = weakref.ref(net)
+        del net
+        gc.collect()
+        assert ref() is None
+
     def test_model_with_caches_is_garbage_collectible(self):
         # the model→cache→jit-closure→model cycle must stay collectible:
         # a serving process that drops transient models can't leak them
