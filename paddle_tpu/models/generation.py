@@ -239,9 +239,14 @@ def generate(model, input_ids, max_new_tokens=32,
             out_ids, out_sc = tok0[:, None], sc0[:, None]
         return out_ids, out_sc
 
+    # the param structure is part of the key: in-place structural
+    # mutation (e.g. fp8_quantize(model, inplace=True) turning Linear
+    # weights into buffers) must retrace — the cached closure's
+    # parameter list would otherwise misalign with the new pvals
+    struct = tuple((tuple(v.shape), str(v.dtype)) for v in pvals)
     sig = (B, P, max_new_tokens, decode_strategy, float(temperature),
            int(top_k or 0), float(top_p if top_p is not None else 1.0),
-           eos, pad, str(cache_dtype))
+           eos, pad, str(cache_dtype), struct)
     jit_cache = _caches_for(model)["jit"]
     fn = jit_cache.get(sig)
     if fn is None:

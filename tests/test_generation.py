@@ -202,6 +202,20 @@ class TestEosAndErrors:
         gc.collect()
         assert ref() is None
 
+    def test_inplace_quantize_retraces_stale_program(self):
+        # in-place quantization shrinks named_parameters() without
+        # changing the model's identity; the same-shape generate after
+        # it must not reuse the old compiled closure (param misalign)
+        from paddle_tpu.quantization import fp8_quantize
+        net = GPTForPretraining(gpt3_tiny())
+        ids = paddle.to_tensor(np.asarray([[3, 4, 5]], dtype="int32"))
+        net.generate(ids, max_new_tokens=3)
+        fp8_quantize(net, inplace=True)
+        out, _ = net.generate(ids, max_new_tokens=3)
+        toks = np.asarray(out._value)
+        assert toks.shape == (1, 3)
+        assert toks.min() >= 0 and toks.max() < 1024
+
     def test_model_with_caches_is_garbage_collectible(self):
         # the model→cache→jit-closure→model cycle must stay collectible:
         # a serving process that drops transient models can't leak them
