@@ -52,7 +52,7 @@ def _caches_for(model):
 
 __all__ = ["generate", "GenerationMixin"]
 
-_STRATEGIES = ("greedy_search", "sampling")
+_STRATEGIES = ("greedy_search", "sampling", "beam_search")
 
 
 class GenerationMixin:
@@ -97,8 +97,8 @@ def _top_k_top_p_filter(logits, top_k, top_p):
 
 def generate(model, input_ids, max_new_tokens=32,
              decode_strategy="greedy_search", temperature=1.0, top_k=0,
-             top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0,
-             dtype=None):
+             top_p=1.0, num_beams=1, length_penalty=0.0,
+             eos_token_id=None, pad_token_id=0, seed=0, dtype=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``.
 
     Returns ``(ids, scores)``: the generated tokens (B, max_new_tokens)
@@ -109,11 +109,26 @@ def generate(model, input_ids, max_new_tokens=32,
     LLaMA and GPT-MoE families do). ``dtype="bfloat16"`` runs the whole
     decode in bf16 weights/caches (serving mode; token picks stay fp32).
 
+    ``decode_strategy="beam_search"`` carries ``num_beams`` hypotheses
+    per row through the same single compiled scan: KV caches live at
+    (B*K, ...) and are re-gathered by parent beam each step; a beam that
+    emits eos is frozen (only an eos continuation at +0 score); the
+    winner is picked by GNMT length-penalised score
+    ``sum_logp / ((5+len)/6)**length_penalty`` (``length_penalty=0`` =
+    pure sum). Returned scores are the winning beam's per-token
+    log-probs.
+
     MoE note: expert routing runs per decode step, so capacity is
-    competed among that step's B tokens only — the well-defined causal
-    semantics. A capacity-dropping full re-forward (teacher forcing)
-    routes batch-globally and may drop differently; exact parity holds
-    when capacity never binds.
+    competed among that step's tokens only (B of them; B*num_beams
+    under beam search, where sibling hypotheses of a row route
+    together) — the well-defined causal semantics. A capacity-dropping
+    full re-forward (teacher forcing) routes batch-globally and may
+    drop differently; exact parity holds when capacity never binds.
+
+    Strategy knobs are per-strategy: temperature/top_k/top_p/seed apply
+    to sampling only, num_beams/length_penalty to beam search only;
+    knobs of the other strategy are ignored (and canonicalized out of
+    the compiled-program cache key, so they never force a retrace).
 
     The compiled prefill+scan program is cached on the model per
     (shapes, strategy, knobs) signature, so repeated serving calls pay
@@ -121,11 +136,12 @@ def generate(model, input_ids, max_new_tokens=32,
     """
     if decode_strategy not in _STRATEGIES:
         raise ValueError(
-            f"decode_strategy {decode_strategy!r} not in {_STRATEGIES}; "
-            "beam search lives in paddle.nn.BeamSearchDecoder + "
-            "dynamic_decode")
+            f"decode_strategy {decode_strategy!r} not in {_STRATEGIES}")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    if num_beams < 1:
+        raise ValueError("num_beams must be >= 1")
+    beam = decode_strategy == "beam_search"
     ids_np = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
                         else input_ids).astype("int32")
     if ids_np.ndim != 2:
@@ -206,12 +222,14 @@ def generate(model, input_ids, max_new_tokens=32,
         score = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
         return nxt.astype(jnp.int32), score
 
-    def run(pv, prompt, key):
+    def prefill(pv, prompt):
         caches = [(jnp.zeros((B, MAX, nh, d), cache_dtype),
                    jnp.zeros((B, MAX, nh, d), cache_dtype))
                   for nh, d in spec]
-        logits, caches = apply(pv, prompt, caches,
-                               jnp.zeros((), jnp.int32))
+        return apply(pv, prompt, caches, jnp.zeros((), jnp.int32))
+
+    def run(pv, prompt, key):
+        logits, caches = prefill(pv, prompt)
         k0, key = jax.random.split(key)
         tok0, sc0 = pick(logits[:, -1, :], k0)
         finished = jnp.zeros((B,), bool) if eos is None else (tok0 == eos)
@@ -239,18 +257,100 @@ def generate(model, input_ids, max_new_tokens=32,
             out_ids, out_sc = tok0[:, None], sc0[:, None]
         return out_ids, out_sc
 
+    def beam_run(pv, prompt, key):
+        K, N = num_beams, max_new_tokens
+        logits, caches = prefill(pv, prompt)
+        logp0 = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32), axis=-1)      # (B, V)
+        V = logp0.shape[-1]
+        beam_scores, tok0 = jax.lax.top_k(logp0, K)             # (B, K)
+        tok0 = tok0.astype(jnp.int32)
+        # every beam shares the prompt prefix: replicate the prefill
+        # caches to the (B*K) beam batch
+        caches = [(jnp.repeat(k, K, axis=0), jnp.repeat(v, K, axis=0))
+                  for k, v in caches]
+        seqs = jnp.zeros((B, K, N), jnp.int32).at[:, :, 0].set(tok0)
+        steplp = jnp.zeros((B, K, N), jnp.float32) \
+            .at[:, :, 0].set(beam_scores)
+        finished = (tok0 == eos) if eos is not None \
+            else jnp.zeros((B, K), bool)
+        bidx = jnp.arange(B)[:, None]
+
+        def body(carry, _):
+            tok, caches, pos, t, beam_scores, seqs, steplp, fin = carry
+            logits, caches = apply(pv, tok.reshape(B * K, 1), caches, pos)
+            logp = jax.nn.log_softmax(
+                logits[:, 0, :].astype(jnp.float32), -1).reshape(B, K, V)
+            if eos is not None:
+                # frozen beams may only continue with eos at +0, so they
+                # compete with live beams at their final score
+                frozen = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+                logp = jnp.where(fin[:, :, None], frozen[None, None, :],
+                                 logp)
+            total = beam_scores[:, :, None] + logp              # (B,K,V)
+            new_scores, flat = jax.lax.top_k(total.reshape(B, K * V), K)
+            parent = flat // V                                   # (B, K)
+            token = (flat % V).astype(jnp.int32)
+            tok_lp = new_scores - beam_scores[bidx, parent]
+            seqs = seqs[bidx, parent].at[:, :, t].set(token)
+            steplp = steplp[bidx, parent].at[:, :, t].set(tok_lp)
+            fin = fin[bidx, parent]
+            flat_parent = (bidx * K + parent).reshape(-1)        # (B*K,)
+            caches = [(kc[flat_parent], vc[flat_parent])
+                      for kc, vc in caches]
+            if eos is not None:
+                fin = fin | (token == eos)
+            return (token, caches, pos + 1, t + 1, new_scores, seqs,
+                    steplp, fin), None
+
+        if N > 1:
+            init = (tok0, caches, jnp.full((), P, jnp.int32),
+                    jnp.ones((), jnp.int32), beam_scores, seqs, steplp,
+                    finished)
+            (_, caches, _, _, beam_scores, seqs, steplp,
+             finished), _ = jax.lax.scan(body, init, None, length=N - 1)
+        # GNMT length penalty over the generated length (up to and
+        # including the first eos); length_penalty=0 -> pure logp sum
+        if eos is not None:
+            iseos = seqs == eos
+            length = jnp.where(iseos.any(-1),
+                               jnp.argmax(iseos, -1) + 1, N)
+        else:
+            length = jnp.full((B, K), N)
+        lp = ((5.0 + length.astype(jnp.float32)) / 6.0) \
+            ** float(length_penalty)
+        best = jnp.argmax(beam_scores / lp, axis=1)              # (B,)
+        bid = jnp.arange(B)
+        out_ids = seqs[bid, best]
+        out_sc = steplp[bid, best]
+        if eos is not None:
+            # positions strictly after the first eos become pad
+            cum = jnp.cumsum((out_ids == eos).astype(jnp.int32), axis=1)
+            after = jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.int32), cum[:, :-1]], axis=1) >= 1
+            out_ids = jnp.where(after, pad, out_ids)
+            out_sc = jnp.where(after, 0.0, out_sc)
+        return out_ids, out_sc
+
     # the param structure is part of the key: in-place structural
     # mutation (e.g. fp8_quantize(model, inplace=True) turning Linear
     # weights into buffers) must retrace — the cached closure's
     # parameter list would otherwise misalign with the new pvals
     struct = tuple((tuple(v.shape), str(v.dtype)) for v in pvals)
-    sig = (B, P, max_new_tokens, decode_strategy, float(temperature),
-           int(top_k or 0), float(top_p if top_p is not None else 1.0),
+    # knobs that don't apply to the chosen strategy are canonicalized so
+    # they can't force a spurious retrace (they're ignored by the math)
+    sampling = decode_strategy == "sampling"
+    sig = (B, P, max_new_tokens, decode_strategy,
+           float(temperature) if sampling else 1.0,
+           int(top_k or 0) if sampling else 0,
+           float(top_p if top_p is not None else 1.0) if sampling else 1.0,
+           int(num_beams) if beam else 1,
+           float(length_penalty) if beam else 0.0,
            eos, pad, str(cache_dtype), struct)
     jit_cache = _caches_for(model)["jit"]
     fn = jit_cache.get(sig)
     if fn is None:
-        fn = jit_cache[sig] = jax.jit(run)
+        fn = jit_cache[sig] = jax.jit(beam_run if beam else run)
     # MoE gates record their aux loss as a side-effect attribute during
     # forward; inside the jitted scan that value is a tracer, and leaving
     # it behind would crash the next aux_loss()/get_loss() read — restore

@@ -149,6 +149,154 @@ class TestSampling:
                                   np.asarray(greedy._value))
 
 
+def _logp_next(model, seq2d):
+    """Full uncached forward -> fp32 log-probs of the next token."""
+    logits = model(paddle.to_tensor(seq2d.astype("int64")))
+    lg = np.asarray(logits._value)[:, -1, :].astype(np.float32)
+    lg = lg - lg.max(-1, keepdims=True)
+    return lg - np.log(np.exp(lg).sum(-1, keepdims=True))
+
+
+def _golden_beam(model, ids_np, n, K, eos=None, length_penalty=0.0):
+    """Naive beam search via repeated full forwards (no cache)."""
+    B = ids_np.shape[0]
+    out = []
+    for b in range(B):
+        prompt = ids_np[b:b + 1]
+        lp = _logp_next(model, prompt)[0]
+        top = np.argsort(-lp)[:K]
+        beams = [([int(t)], float(lp[t]), int(t) == eos) for t in top]
+        for _ in range(n - 1):
+            cand = []
+            for seq, score, fin in beams:
+                if fin:
+                    cand.append((seq + [eos], score, True))
+                    continue
+                cur = np.concatenate([prompt[0], np.asarray(seq)])[None, :]
+                lp = _logp_next(model, cur.astype("int32"))[0]
+                for t in np.argsort(-lp)[:K]:
+                    cand.append((seq + [int(t)], score + float(lp[t]),
+                                 eos is not None and int(t) == eos))
+            cand.sort(key=lambda c: -c[1])
+            beams = cand[:K]
+        def norm(c):
+            seq, score, _ = c
+            if eos is not None and eos in seq:
+                ln = seq.index(eos) + 1
+            else:
+                ln = n
+            return score / (((5.0 + ln) / 6.0) ** length_penalty)
+        seq, _, _ = max(beams, key=norm)
+        if eos is not None and eos in seq:
+            i = seq.index(eos)
+            seq = seq[:i + 1] + [0] * (n - i - 1)
+        out.append(seq)
+    return np.asarray(out, dtype="int32")
+
+
+class TestBeamSearch:
+    def test_matches_naive_beam(self, gpt):
+        rng = np.random.RandomState(7)
+        ids = rng.randint(0, 1024, (2, 5)).astype("int32")
+        got, sc = gpt.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                               decode_strategy="beam_search", num_beams=3)
+        golden = _golden_beam(gpt, ids, 4, 3)
+        np.testing.assert_array_equal(np.asarray(got._value), golden)
+        assert np.all(np.isfinite(np.asarray(sc._value)))
+
+    def test_one_beam_is_greedy(self, gpt):
+        ids = np.asarray([[11, 12, 13]], dtype="int32")
+        greedy, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=6)
+        beam1, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                                decode_strategy="beam_search", num_beams=1)
+        np.testing.assert_array_equal(np.asarray(greedy._value),
+                                      np.asarray(beam1._value))
+
+    def test_eos_freezes_and_pads(self, gpt):
+        ids = np.asarray([[2, 4, 6]], dtype="int32")
+        ref, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              decode_strategy="beam_search", num_beams=2)
+        eos = int(np.asarray(ref._value)[0, 0])
+        got, sc = gpt.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                               decode_strategy="beam_search", num_beams=2,
+                               eos_token_id=eos, pad_token_id=9)
+        got = np.asarray(got._value)
+        # a frozen winner carries eos then pad; either the winner ends
+        # early or it never emitted eos — if it did, padding must follow
+        row = got[0]
+        if eos in row.tolist():
+            i = row.tolist().index(eos)
+            assert np.all(row[i + 1:] == 9)
+            assert np.all(np.asarray(sc._value)[0, i + 1:] == 0.0)
+
+    def test_llama_matches_naive_beam(self, llama):
+        # GQA/rope cache layout under the per-step (B*K, ...) parent
+        # re-gather
+        rng = np.random.RandomState(21)
+        ids = rng.randint(0, 512, (2, 4)).astype("int32")
+        got, _ = llama.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                decode_strategy="beam_search",
+                                num_beams=2)
+        golden = _golden_beam(llama, ids, 4, 2)
+        np.testing.assert_array_equal(np.asarray(got._value), golden)
+
+    def test_gpt_moe_matches_naive_beam(self):
+        # MoE routing under beams: B*K hypotheses route together, so
+        # parity needs drop-free capacity (same reasoning as greedy)
+        from paddle_tpu.models import GPTMoEForPretraining, gpt_moe_tiny
+        paddle.seed(0)
+        cfg = gpt_moe_tiny(num_hidden_layers=2)
+        moe = GPTMoEForPretraining(cfg)
+        for m in moe.gpt.moe_layers():
+            m.gate.capacity_factor = float(cfg.num_experts * cfg.top_k
+                                           * 4)
+        rng = np.random.RandomState(22)
+        ids = rng.randint(0, 1024, (1, 4)).astype("int32")
+        got, _ = moe.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                              decode_strategy="beam_search", num_beams=2)
+        golden = _golden_beam(moe, ids, 3, 2)
+        np.testing.assert_array_equal(np.asarray(got._value), golden)
+
+    def test_irrelevant_knobs_do_not_retrace(self, gpt):
+        ids = paddle.to_tensor(np.asarray([[5, 6, 7]], dtype="int32"))
+        gpt.generate(ids, max_new_tokens=2, decode_strategy="beam_search",
+                     num_beams=2)
+        jit_cache = gpt.__dict__["_generation_caches"]["jit"]
+        n0 = len(jit_cache)
+        # sampling knobs are ignored by beam search: same compiled program
+        gpt.generate(ids, max_new_tokens=2, decode_strategy="beam_search",
+                     num_beams=2, temperature=0.7, top_k=50, top_p=0.9)
+        assert len(jit_cache) == n0
+        # beam knobs are ignored by greedy: no retrace either
+        gpt.generate(ids, max_new_tokens=2)
+        n1 = len(jit_cache)
+        gpt.generate(ids, max_new_tokens=2, num_beams=8,
+                     length_penalty=2.0)
+        assert len(jit_cache) == n1
+
+    def test_length_penalty_runs(self, gpt):
+        ids = np.asarray([[8, 9]], dtype="int32")
+        out, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                              decode_strategy="beam_search", num_beams=2,
+                              length_penalty=1.0, eos_token_id=0)
+        assert np.asarray(out._value).shape == (1, 4)
+
+    def test_golden_beam_with_eos(self, gpt):
+        # pick an eos that actually fires mid-generation (the greedy
+        # token at step 1), then check full parity including freezing
+        rng = np.random.RandomState(9)
+        ids = rng.randint(0, 1024, (1, 4)).astype("int32")
+        probe, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                                decode_strategy="beam_search",
+                                num_beams=2)
+        eos = int(np.asarray(probe._value)[0, 1])
+        got, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                              decode_strategy="beam_search", num_beams=2,
+                              eos_token_id=eos, pad_token_id=0)
+        golden = _golden_beam(gpt, ids, 5, 2, eos=eos)
+        np.testing.assert_array_equal(np.asarray(got._value), golden)
+
+
 class TestEosAndErrors:
     def test_eos_masks_finished_rows(self, gpt):
         # the eos token itself is emitted, then every later step pads
@@ -166,9 +314,11 @@ class TestEosAndErrors:
     def test_bad_args_raise(self, gpt):
         ids = paddle.to_tensor(np.asarray([[1, 2]], dtype="int32"))
         with pytest.raises(ValueError, match="decode_strategy"):
-            gpt.generate(ids, decode_strategy="beam_search")
+            gpt.generate(ids, decode_strategy="contrastive_search")
         with pytest.raises(ValueError, match="max_new_tokens"):
             gpt.generate(ids, max_new_tokens=0)
+        with pytest.raises(ValueError, match="num_beams"):
+            gpt.generate(ids, decode_strategy="beam_search", num_beams=0)
 
     def test_compiled_program_cached_across_calls(self, gpt):
         ids = paddle.to_tensor(np.asarray([[1, 2, 3]], dtype="int32"))
