@@ -23,6 +23,7 @@ __all__ = [
     "BaseQuanter", "BaseObserver", "quant_linear",
     "QuantedLinear", "QuantedConv2D", "LinearQuanterDequanter",
     "FP8Linear", "fp8_quantize",
+    "WeightOnlyLinear", "weight_only_quantize",
 ]
 
 
@@ -595,6 +596,41 @@ class FP8Linear(Layer):
 def fp8_quantize(model, inplace=False, config=None):
     """PTQ-style one-shot conversion: replace every nn.Linear (or those
     selected by ``config``) with a weight-only FP8Linear."""
+    return _linear_swap_convert(model, inplace, config, FP8Linear)
+
+
+class WeightOnlyLinear(Layer):
+    """Deploy-form weight-only int8/int4 linear: the packed weight and
+    per-output-channel scale ride as buffers (state_dict round-trips),
+    forward goes through ``nn.quant.weight_only_linear``. int4 halves
+    HBM weight bytes vs int8/fp8 — a CAPACITY feature on v5e (the
+    nibble unpack costs latency; the fast serving path is FP8Linear,
+    see its docstring)."""
+
+    def __init__(self, layer, algo="weight_only_int8"):
+        from ..nn.quant import weight_quantize
+        super().__init__()
+        if algo not in ("weight_only_int8", "weight_only_int4"):
+            raise ValueError(f"unsupported algo {algo!r}")
+        self.algo = algo
+        qw, scale = weight_quantize(layer.weight, algo=algo)
+        self.register_buffer("qweight", Tensor(qw._value,
+                                               stop_gradient=True))
+        self.register_buffer("w_scale", Tensor(scale._value,
+                                               stop_gradient=True))
+        self.bias = layer.bias
+
+    def forward(self, x):
+        from ..nn.quant import weight_only_linear
+        return weight_only_linear(
+            x, self.qweight, self.bias, self.w_scale,
+            weight_dtype="int4" if self.algo == "weight_only_int4"
+            else "int8")
+
+
+def _linear_swap_convert(model, inplace, config, factory):
+    """Shared one-shot-conversion driver: optional deepcopy, then swap
+    every (config-selected) nn.Linear for ``factory(layer)``."""
     if not inplace:
         import copy
         model = copy.deepcopy(model)
@@ -604,8 +640,21 @@ def fp8_quantize(model, inplace=False, config=None):
             return None
         if config is not None and config._config_for(layer) is None:
             return None
-        return FP8Linear(layer)
+        return factory(layer)
     return _swap_layers(model, config, wrap)
+
+
+def weight_only_quantize(model, algo="weight_only_int8", inplace=False,
+                         config=None):
+    """PTQ-style one-shot conversion: replace every nn.Linear (or those
+    selected by ``config``) with a WeightOnlyLinear — the int8/int4
+    sibling of ``fp8_quantize``. int4 requires even in_features per
+    converted layer (nibble packing)."""
+    if algo not in ("weight_only_int8", "weight_only_int4"):
+        # validate before the deepcopy, and even when nothing converts
+        raise ValueError(f"unsupported algo {algo!r}")
+    return _linear_swap_convert(model, inplace, config,
+                                lambda l: WeightOnlyLinear(l, algo=algo))
 
 
 def quant_linear(x, weight, scale, bias=None, bit_length=8):

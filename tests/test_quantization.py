@@ -338,3 +338,58 @@ def test_weight_only_int4_grad_wrt_activation():
     out = weight_only_linear(x, q, weight_scale=s, weight_dtype="int4")
     out.sum().backward()
     assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_weight_only_quantize_module_swap():
+    """weight_only_quantize: int8/int4 sibling of fp8_quantize — swaps
+    every nn.Linear for a WeightOnlyLinear whose output matches the
+    dense layer within quantization error; state rides as buffers."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import (WeightOnlyLinear,
+                                         weight_only_quantize)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 32))
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 64).astype("f4"))
+    ref = net(x).numpy()
+
+    for algo, tol in (("weight_only_int8", 0.03), ("weight_only_int4",
+                                                   0.25)):
+        qnet = weight_only_quantize(net, algo=algo)
+        assert isinstance(qnet[0], WeightOnlyLinear)
+        assert isinstance(net[0], nn.Linear)     # original untouched
+        out = qnet(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < tol, (algo, rel)
+        # quantized weights are buffers (in state_dict, not parameters)
+        assert "0.qweight" in qnet.state_dict()
+        assert all("qweight" not in n for n, _ in qnet.named_parameters())
+    # int4 packs two K rows per byte
+    q4 = weight_only_quantize(net, algo="weight_only_int4")
+    assert tuple(q4[0].qweight.shape) == (32, 128)
+    assert q4[0].qweight.dtype == jnp.int8
+
+
+def test_weight_only_quantized_model_generates():
+    """generate() on an int8/int4 weight-only model (packed weights ride
+    as buffers through the compiled decode)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForPretraining, gpt3_tiny
+    from paddle_tpu.quantization import weight_only_quantize
+
+    paddle.seed(0)
+    net = GPTForPretraining(gpt3_tiny())
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 1024, (2, 5)).astype("int32"))
+    for algo in ("weight_only_int8", "weight_only_int4"):
+        qnet = weight_only_quantize(net, algo=algo)
+        out, sc = qnet.generate(ids, max_new_tokens=4)
+        toks = np.asarray(out._value)
+        assert toks.shape == (2, 4)
+        assert toks.min() >= 0 and toks.max() < 1024
+        assert np.all(np.isfinite(np.asarray(sc._value)))
