@@ -1,0 +1,165 @@
+"""Finite-difference gradient checks, second r5 sweep (SURVEY §4 OpTest
+pattern): differentiable ops that until now carried forward-only tests —
+pointwise/binary factories (erfinv, logit, atan2, hypot, copysign),
+reductions and shaping (logsumexp, trapezoid, diff, kron, outer, lerp,
+cross, renorm, cdist, kthvalue), and the linalg ladder (cholesky,
+triangular_solve, matrix_power, pinv, det/slogdet, qr, svd, lu).
+
+Domain handling: inputs are kept away from non-differentiable points
+(|x|<1 for erfinv, (0,1) for logit, SPD/well-conditioned matrices for
+the linalg ops, nonzero rows for norms/distances).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestPointwiseGrads(OpTest):
+    def test_erfinv_grad(self):
+        rs = np.random.RandomState(0)
+        x = rs.uniform(-0.9, 0.9, (3, 4))
+        self.check_grad(lambda t: paddle.erfinv(t), [x])
+
+    def test_logit_grad(self):
+        rs = np.random.RandomState(1)
+        x = rs.uniform(0.1, 0.9, (3, 4))
+        self.check_grad(lambda t: paddle.logit(t), [x])
+
+    def test_atan2_grad(self):
+        rs = np.random.RandomState(2)
+        y = rs.uniform(0.5, 2.0, (3, 4)) * np.sign(rs.randn(3, 4))
+        x = rs.uniform(0.5, 2.0, (3, 4))
+        self.check_grad(lambda a, b: paddle.atan2(a, b), [y, x])
+
+    def test_hypot_grad(self):
+        rs = np.random.RandomState(3)
+        a = rs.uniform(0.5, 2.0, (3, 4))
+        b = rs.uniform(0.5, 2.0, (3, 4))
+        self.check_grad(lambda x, y: paddle.hypot(x, y), [a, b])
+
+    def test_copysign_grad_wrt_magnitude(self):
+        rs = np.random.RandomState(4)
+        x = rs.uniform(0.5, 2.0, (3, 4)) * np.sign(rs.randn(3, 4))
+        y = rs.uniform(0.5, 2.0, (3, 4)) * np.sign(rs.randn(3, 4))
+        # d/dy is 0 a.e. (sign is piecewise constant) — checked too
+        self.check_grad(lambda a, b: paddle.copysign(a, b), [x, y])
+
+    def test_lerp_grad_all_inputs(self):
+        rs = np.random.RandomState(5)
+        x, y = rs.randn(3, 4), rs.randn(3, 4)
+        w = rs.uniform(0.2, 0.8, (3, 4))
+        self.check_grad(lambda a, b, c: paddle.lerp(a, b, c), [x, y, w])
+
+
+class TestReductionShapingGrads(OpTest):
+    def test_logsumexp_grad(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(3, 5)
+        self.check_grad(lambda t: paddle.logsumexp(t, axis=1), [x])
+
+    def test_trapezoid_grad(self):
+        rs = np.random.RandomState(7)
+        y = rs.randn(4, 6)
+        self.check_grad(lambda t: paddle.trapezoid(t, dx=0.5, axis=1),
+                        [y])
+
+    def test_diff_grad(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(3, 6)
+        self.check_grad(lambda t: paddle.diff(t, axis=1), [x])
+
+    def test_kron_grad(self):
+        rs = np.random.RandomState(9)
+        a, b = rs.randn(2, 3), rs.randn(3, 2)
+        self.check_grad(lambda x, y: paddle.kron(x, y), [a, b])
+
+    def test_outer_grad(self):
+        rs = np.random.RandomState(10)
+        a, b = rs.randn(4), rs.randn(5)
+        self.check_grad(lambda x, y: paddle.outer(x, y), [a, b])
+
+    def test_cross_grad(self):
+        rs = np.random.RandomState(11)
+        a, b = rs.randn(4, 3), rs.randn(4, 3)
+        self.check_grad(lambda x, y: paddle.cross(x, y, axis=1), [a, b])
+
+    def test_renorm_grad(self):
+        rs = np.random.RandomState(12)
+        # every row norm well above maxnorm: smooth scaling regime
+        x = rs.randn(4, 6) * 5.0 + np.sign(rs.randn(4, 6)) * 2.0
+        self.check_grad(
+            lambda t: paddle.renorm(t, p=2.0, axis=0, max_norm=1.0), [x])
+
+    def test_cdist_grad(self):
+        rs = np.random.RandomState(13)
+        a, b = rs.randn(4, 3), rs.randn(5, 3) + 3.0  # no zero distances
+        self.check_grad(lambda x, y: paddle.cdist(x, y), [a, b])
+
+    def test_kthvalue_grad(self):
+        rs = np.random.RandomState(14)
+        x = rs.randn(3, 7)
+        self.check_grad(lambda t: paddle.kthvalue(t, k=3, axis=1)[0], [x])
+
+
+def _well_conditioned(rs, n):
+    return rs.randn(n, n) + n * np.eye(n)
+
+
+class TestLinalgGrads(OpTest):
+    def test_cholesky_grad(self):
+        rs = np.random.RandomState(15)
+        m = rs.randn(3, 3)
+
+        def fn(t):
+            spd = t @ t.t() + paddle.eye(3) * 3.0
+            return paddle.linalg.cholesky(spd)
+        self.check_grad(fn, [m])
+
+    def test_triangular_solve_grad(self):
+        rs = np.random.RandomState(16)
+        lo = np.tril(rs.randn(3, 3)) + 3.0 * np.eye(3)
+        b = rs.randn(3, 2)
+        self.check_grad(
+            lambda a, y: paddle.linalg.triangular_solve(a, y, upper=False),
+            [lo, b])
+
+    def test_matrix_power_grad(self):
+        rs = np.random.RandomState(17)
+        m = _well_conditioned(rs, 3)
+        self.check_grad(lambda t: paddle.linalg.matrix_power(t, 3), [m])
+
+    def test_matrix_power_negative_grad(self):
+        rs = np.random.RandomState(18)
+        m = _well_conditioned(rs, 3)
+        self.check_grad(lambda t: paddle.linalg.matrix_power(t, -1), [m])
+
+    def test_pinv_grad(self):
+        rs = np.random.RandomState(19)
+        m = rs.randn(4, 3)  # full column rank a.s.
+        self.check_grad(lambda t: paddle.linalg.pinv(t), [m],
+                        rtol=2e-2, atol=2e-3)
+
+    def test_det_and_slogdet_grad(self):
+        rs = np.random.RandomState(20)
+        m = _well_conditioned(rs, 3)
+        self.check_grad(lambda t: paddle.linalg.det(t), [m])
+        self.check_grad(lambda t: paddle.linalg.slogdet(t)[1], [m])
+
+    def test_qr_grad(self):
+        rs = np.random.RandomState(21)
+        m = _well_conditioned(rs, 3)
+        self.check_grad(lambda t: paddle.linalg.qr(t)[1], [m],
+                        rtol=2e-2, atol=2e-3)
+
+    def test_svd_singular_values_grad(self):
+        rs = np.random.RandomState(22)
+        m = rs.randn(4, 3)
+        self.check_grad(
+            lambda t: paddle.linalg.svd(t, full_matrices=False)[1], [m])
+
+    def test_lu_grad(self):
+        rs = np.random.RandomState(23)
+        m = _well_conditioned(rs, 3)
+        self.check_grad(lambda t: paddle.linalg.lu(t)[0], [m],
+                        rtol=2e-2, atol=2e-3)
