@@ -16,22 +16,44 @@ TP→FSDP regrouping) is the same code path as same-mesh load.
 and writes to disk on a background thread, returning a waitable handle —
 the orbax/tensorstore pattern.
 """
+import atexit
 import json
+import logging
 import os
 import re
+import shutil
 import threading
 import time
 import uuid
+import zlib
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...framework import failpoints as _fp
 from ...framework.core import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle"]
+__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
+           "save_checkpoint", "latest_checkpoint", "CheckpointCorruptError"]
+
+_logger = logging.getLogger("paddle_tpu.checkpoint")
 
 _META = "checkpoint.metadata.json"
+_SENTINEL = "COMMITTED"               # written LAST: its presence == commit
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+# failpoint sites (framework/failpoints.py): shard write, metadata write,
+# and the commit sentinel — `ckpt.commit_sentinel=skip` simulates a kill
+# between the last shard write and the commit
+_FP_WRITE_SHARD = _fp.register("ckpt.write_shard")
+_FP_WRITE_META = _fp.register("ckpt.write_meta")
+_FP_COMMIT = _fp.register("ckpt.commit_sentinel", skippable=True)
+
+
+class CheckpointCorruptError(ValueError):
+    """A shard file failed its recorded CRC32 — the checkpoint is torn or
+    bit-rotted and must not be restored from."""
 
 
 def _flatten(d, prefix=""):
@@ -55,30 +77,96 @@ def _as_array(v):
     return v
 
 
+_pending_handles = []                 # unwaited AsyncSaveHandles
+_pending_lock = threading.Lock()
+
+_active_saves = set()                 # abspaths with an in-flight writer
+_active_lock = threading.Lock()       # (protects the retention sweep)
+
+
 class AsyncSaveHandle:
     """Returned by save_state_dict(async_save=True).  The checkpoint is not
     loadable until the write completes (metadata is committed last, via
-    atomic rename) — call ``wait()`` before relying on it."""
+    atomic rename) — call ``wait()`` before relying on it.
 
-    def __init__(self, target):
+    A background-writer exception is never silently lost: ``wait()``
+    re-raises it, ``done()`` logs it once and marks the handle
+    ``failed``, and an atexit drain joins + warns about any handle that
+    was never waited on (an unwaited failed save means the job believes
+    it has a checkpoint it does not have).
+    """
+
+    def __init__(self, target, label="checkpoint"):
         self.exception = None
+        self.label = label
+        self._waited = False
+        self._logged = False
 
         def runner():
             try:
                 target()
-            except Exception as e:      # surfaced at wait()
+            except Exception as e:      # surfaced at wait()/done()/atexit
                 self.exception = e
         self._thread = threading.Thread(target=runner, daemon=True)
         self._thread.start()
+        with _pending_lock:
+            _pending_handles.append(self)
 
     def wait(self):
         self._thread.join()
+        self._waited = True
+        with _pending_lock:
+            if self in _pending_handles:
+                _pending_handles.remove(self)
         if self.exception is not None:
             raise self.exception
         return True
 
     def done(self):
-        return not self._thread.is_alive()
+        finished = not self._thread.is_alive()
+        if finished:
+            # observing completion counts as draining: done()-polling
+            # jobs must not pile handles up for the atexit sweep
+            with _pending_lock:
+                if self in _pending_handles:
+                    _pending_handles.remove(self)
+            # no log if wait() already re-raised — the caller saw it
+            if self.exception is not None and not self._logged \
+                    and not self._waited:
+                self._logged = True
+                _logger.error(
+                    "async save %r failed in the background writer: %r "
+                    "(the checkpoint was NOT committed)",
+                    self.label, self.exception)
+        return finished
+
+    @property
+    def failed(self):
+        """True once the writer has finished with an exception."""
+        return not self._thread.is_alive() and self.exception is not None
+
+
+def _drain_pending_handles():
+    with _pending_lock:
+        leftovers = list(_pending_handles)
+        _pending_handles.clear()
+    for h in leftovers:
+        h._thread.join(timeout=10.0)
+        if h._thread.is_alive():
+            _logger.warning(
+                "async save %r still writing at interpreter exit; its "
+                "checkpoint may be left uncommitted", h.label)
+        elif h.exception is not None:
+            _logger.warning(
+                "async save %r failed and wait() was never called: %r "
+                "(the checkpoint was NOT committed)", h.label, h.exception)
+        else:
+            _logger.warning(
+                "async save %r completed but wait() was never called; "
+                "call wait() before relying on the checkpoint", h.label)
+
+
+atexit.register(_drain_pending_handles)
 
 
 def _default_generation():
@@ -100,7 +188,7 @@ def _default_generation():
 
 
 def save_state_dict(state_dict, path, process_index=None, async_save=False,
-                    generation=None):
+                    generation=None, _on_commit=None):
     """Write this process's addressable shards of every array leaf.
 
     Layout::
@@ -163,39 +251,101 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False,
                 - (s.start or 0) for d, s in enumerate(idx)]
             fname = (f"{_safe(key)}/shard_" +
                      "_".join(str(s) for s in starts) + ".npy")
-            entry["shards"].append({"starts": list(starts), "sizes": sizes,
-                                    "file": fname})
             # D2H snapshot now; disk write possibly async.  bf16 has no
             # stable npy representation — store the uint16 bit pattern.
             data = np.asarray(shard.data)
             if is_bf16:
                 data = data.view(np.uint16)
-            jobs.append((os.path.join(path, fname), data))
+            # crc32 is filled in by write_all (possibly on the background
+            # thread): an async save must not pay a foreground CRC pass
+            rec = {"starts": list(starts), "sizes": sizes, "file": fname}
+            entry["shards"].append(rec)
+            jobs.append((os.path.join(path, fname), data, rec))
         meta["arrays"][key] = entry
 
     meta_path = os.path.join(path, f"checkpoint.metadata.rank"
                                    f"{process_index}.json")
 
     def write_all():
-        for fpath, data in jobs:
+        try:
+            _write_body()
+        finally:
+            with _active_lock:
+                _active_saves.discard(os.path.abspath(path))
+
+    def _write_body():
+        for fpath, data, rec in jobs:
+            if _fp._ACTIVE:
+                _fp.fire(_FP_WRITE_SHARD)
+            # integrity record: CRC32 of the array payload (the bytes the
+            # loader will hand back), verified at load time.  Computed
+            # here so it lands before the metadata commit below, off the
+            # training loop for async saves.
+            rec["crc32"] = _crc32_of_array(data)
             os.makedirs(os.path.dirname(fpath), exist_ok=True)
             tmp_f = f"{fpath}.tmp.{process_index}"
             with open(tmp_f, "wb") as f:   # file-object save: no .npy suffix
                 np.save(f, data)
             os.replace(tmp_f, fpath)
         # commit: metadata appears only after every shard is on disk
+        if _fp._ACTIVE:
+            _fp.fire(_FP_WRITE_META)
         tmp = meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, meta_path)
+        if _on_commit is not None:
+            _on_commit()
 
+    # registered BEFORE the writer can run: a concurrent retention sweep
+    # (an overlapping save committing out of order) must not rmtree a
+    # directory this process is still writing into
+    with _active_lock:
+        _active_saves.add(os.path.abspath(path))
     if async_save:
-        return AsyncSaveHandle(write_all)
+        return AsyncSaveHandle(write_all, label=path)
     write_all()
     return None
 
 
-def _read_region(path, shard_rec, region, is_bf16=False):
+def _crc32_of_array(arr):
+    """CRC32 of an array's C-order payload, fed to zlib in bounded chunks
+    so an mmap'd multi-GB shard never needs a full in-memory copy."""
+    flat = np.ravel(arr, order="C")     # view for C-contiguous (the save
+    try:                                # layout); copies only exotic cases
+        byts = flat.view(np.uint8)
+    except ValueError:
+        return zlib.crc32(flat.tobytes())
+    crc = 0
+    step = 1 << 24                      # 16 MiB per crc call
+    for off in range(0, byts.size, step):
+        crc = zlib.crc32(byts[off:off + step], crc)
+    return crc
+
+
+def _verify_shard_crc(path, shard_rec, vcache):
+    """Check a shard file against its recorded CRC32, once per file per
+    load (vcache).  Pre-CRC checkpoints (no ``crc32`` record) pass.
+    Disable wholesale with ``PADDLE_CKPT_VERIFY=0``."""
+    crc_want = shard_rec.get("crc32")
+    if crc_want is None or vcache is None or \
+            os.environ.get("PADDLE_CKPT_VERIFY", "1") == "0":
+        return
+    cached = vcache.get(path)
+    if cached is None:
+        try:
+            cached = _crc32_of_array(np.load(path, mmap_mode="r"))
+        except (OSError, ValueError) as e:   # torn/truncated npy
+            raise CheckpointCorruptError(
+                f"checkpoint shard {path} is unreadable: {e}") from e
+        vcache[path] = cached
+    if cached != crc_want:
+        raise CheckpointCorruptError(
+            f"checkpoint shard {path} failed CRC32 verification "
+            f"(recorded {crc_want:#010x}, computed {cached:#010x})")
+
+
+def _read_region(path, shard_rec, region, is_bf16=False, vcache=None):
     """Read the intersection of one saved shard with a target region.
 
     region: list of (start, stop) in global coords.  Returns (slab_slices,
@@ -210,6 +360,7 @@ def _read_region(path, shard_rec, region, is_bf16=False):
             return None, None
         inter_src.append(slice(lo - s0, hi - s0))
         inter_dst.append(slice(lo - rs, hi - rs))
+    _verify_shard_crc(path, shard_rec, vcache)
     data = np.load(path, mmap_mode="r")[tuple(inter_src)]
     data = np.ascontiguousarray(data)
     if is_bf16:   # stored as uint16 bit pattern (see save_state_dict)
@@ -217,13 +368,13 @@ def _read_region(path, shard_rec, region, is_bf16=False):
     return tuple(inter_dst), data
 
 
-def _assemble_region(ckpt_path, entry, region, dtype):
+def _assemble_region(ckpt_path, entry, region, dtype, vcache=None):
     is_bf16 = entry["dtype"] == "bfloat16"
     slab = np.zeros([hi - lo for lo, hi in region], dtype)
     for shard_rec in entry["shards"]:
         dst, data = _read_region(
             os.path.join(ckpt_path, shard_rec["file"]), shard_rec, region,
-            is_bf16)
+            is_bf16, vcache)
         if dst is not None:
             slab[dst] = np.asarray(data).reshape(slab[dst].shape)
     return slab
@@ -290,7 +441,18 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
     arrays/Tensors laid out how the caller wants them), or
     fully-replicated on ``mesh``/default device.  Loading into a different
     mesh shape than the save ran on is the normal case, not an error.
+
+    Integrity: every shard file read is checked against the CRC32 the
+    saver recorded; a mismatch raises :class:`CheckpointCorruptError`.
+    When ``path`` is a checkpoint ROOT (holding ``step_NNNN`` children
+    from :func:`save_checkpoint` rather than metadata itself), the
+    newest committed step is loaded, falling back step by step past any
+    torn or corrupt checkpoint until one restores cleanly.
     """
+    if _is_checkpoint_root(path):
+        return _load_latest_valid(path, template=template,
+                                  shardings=shardings, mesh=mesh)
+    vcache = {}
     meta = _merged_meta(path)
     tmpl_flat = ({k: _as_array(v) for k, v in _flatten(template).items()}
                  if template is not None else {})
@@ -306,7 +468,7 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
             target = tmpl_flat[key].sharding
         if target is None:
             full = _assemble_region(path, entry,
-                                    [(0, s) for s in shape], dtype)
+                                    [(0, s) for s in shape], dtype, vcache)
             arr = jnp.asarray(full)
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
@@ -328,8 +490,174 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
             rkey = tuple(region)
             if rkey not in slab_cache:
                 slab_cache[rkey] = _assemble_region(path, entry, region,
-                                                    dtype)
+                                                    dtype, vcache)
             slabs.append(jax.device_put(slab_cache[rkey], dev))
         out[key] = jax.make_array_from_single_device_arrays(
             shape, target, slabs)
     return out
+
+
+# -- step-directory commit protocol (save_checkpoint / latest) ----------
+#
+# Layout under a checkpoint ROOT::
+#
+#     root/step_00000042/<shards + rank metadata>   (save_state_dict)
+#     root/step_00000042/COMMITTED                  (sentinel, written LAST)
+#
+# A step directory without the sentinel is torn (the writer died between
+# shard write and commit) and is never restored from.  Retention keeps
+# the newest K committed steps; older ones — and torn directories older
+# than the newest commit — are swept after each successful commit.
+
+def _step_path(root, step):
+    return os.path.join(root, f"step_{int(step):08d}")
+
+
+def _iter_steps(root):
+    """[(step, dirpath, committed)] sorted by step ascending."""
+    try:
+        names = os.listdir(root)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    out = []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        d = os.path.join(root, name)
+        if os.path.isdir(d):
+            out.append((int(m.group(1)), d,
+                        os.path.exists(os.path.join(d, _SENTINEL))))
+    out.sort()
+    return out
+
+
+def _is_checkpoint_root(path):
+    """A directory holding step_NNNN children but no metadata of its own."""
+    if os.path.exists(os.path.join(path, _META)):
+        return False
+    import glob
+    if glob.glob(os.path.join(path, "checkpoint.metadata.rank*.json")):
+        return False
+    return bool(_iter_steps(path))
+
+
+def latest_checkpoint(root):
+    """Path of the newest COMMITTED step directory under ``root``, or
+    None.  Torn (uncommitted) directories are skipped — they are the
+    debris of a writer that died mid-save."""
+    for step, d, committed in reversed(_iter_steps(root)):
+        if committed:
+            return d
+    return None
+
+
+def _load_latest_valid(root, **kw):
+    """Newest committed checkpoint that actually restores; fall back past
+    corrupt ones (CRC mismatch, lost shard/metadata files)."""
+    steps = [(s, d) for s, d, committed in reversed(_iter_steps(root))
+             if committed]
+    if not steps:
+        raise FileNotFoundError(
+            f"no committed checkpoint under {root} — nothing to resume "
+            "from (torn step directories, if any, were skipped)")
+    last_err = None
+    for step, d in steps:
+        try:
+            return load_state_dict(d, **kw)
+        # only integrity failures trigger fallback: CRC mismatch, files
+        # lost from under the sentinel, truncated metadata.  A user error
+        # (wrong template/sharding) raises through immediately rather
+        # than being masked as K successive "corrupt" checkpoints.
+        except (CheckpointCorruptError, FileNotFoundError, OSError,
+                json.JSONDecodeError) as e:
+            _logger.warning(
+                "checkpoint %s is unusable (%s); falling back to the "
+                "previous one", d, e)
+            last_err = e
+    raise CheckpointCorruptError(
+        f"every committed checkpoint under {root} failed to restore "
+        f"(last error: {last_err})") from last_err
+
+
+def _retention_sweep(root, keep_last):
+    """Delete all but the newest ``keep_last`` committed steps, plus torn
+    directories older than the newest commit (debris of dead writers).
+    Directories this process is still writing into (overlapping async
+    saves, which can commit out of order) are exempt via the
+    ``_active_saves`` registry; torn dirs newer than the commit are left
+    alone too — another host's save may be filling them."""
+    if not keep_last or keep_last <= 0:
+        return
+    steps = _iter_steps(root)
+    committed = [(s, d) for s, d, ok in steps if ok]
+    doomed = [d for s, d in committed[:-keep_last]]
+    if committed:
+        newest_committed = committed[-1][0]
+        doomed += [d for s, d, ok in steps
+                   if not ok and s < newest_committed]
+    with _active_lock:
+        doomed = [d for d in doomed
+                  if os.path.abspath(d) not in _active_saves]
+    for d in doomed:
+        try:
+            shutil.rmtree(d)
+        except OSError as e:
+            _logger.warning("retention sweep could not remove %s: %s", d, e)
+
+
+def save_checkpoint(state_dict, root, step, process_index=None,
+                    async_save=False, keep_last=None):
+    """Save into ``root/step_NNNN`` with crash-safe commit + retention.
+
+    The commit sentinel is written by process 0 only, strictly after its
+    shards and metadata are on disk (multi-host note: process 0 commits
+    for the job, so call this after a cross-host barrier if stragglers
+    are possible).  ``keep_last`` (default: env ``PADDLE_CKPT_KEEP_LAST``,
+    else 5; 0 disables) sweeps older committed steps after the commit.
+    Returns the step directory path (sync) or an :class:`AsyncSaveHandle`
+    whose ``wait()`` completes after commit + sweep (async).
+    """
+    if keep_last is None:
+        keep_last = int(os.environ.get("PADDLE_CKPT_KEEP_LAST", "5"))
+    path = _step_path(root, step)
+    pidx = (jax.process_index() if process_index is None else process_index)
+
+    def commit():
+        if pidx != 0:
+            return
+        if _fp._ACTIVE and _fp.fire(_FP_COMMIT) == "skip":
+            return          # simulated kill between shard write and commit
+        # overlapping async saves can commit out of order, and the later
+        # step's retention sweep may then remove this still-uncommitted
+        # directory mid-write; never stamp COMMITTED unless everything we
+        # just wrote is actually present
+        meta_p = os.path.join(
+            path, f"checkpoint.metadata.rank{pidx}.json")
+        try:
+            with open(meta_p) as f:
+                written = json.load(f)
+            missing = [
+                s["file"] for e in written["arrays"].values()
+                for s in e["shards"]
+                if not os.path.exists(os.path.join(path, s["file"]))]
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorruptError(
+                f"refusing to commit {path}: metadata unreadable ({e}) — "
+                "was the directory swept by a concurrent save?") from e
+        if missing:
+            raise CheckpointCorruptError(
+                f"refusing to commit {path}: shard file(s) {missing} "
+                "vanished before the sentinel write (swept by a "
+                "concurrent save?)")
+        tmp = os.path.join(path, _SENTINEL + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step),
+                       "committed_at_ns": time.time_ns()}, f)
+        os.replace(tmp, os.path.join(path, _SENTINEL))
+        _retention_sweep(root, keep_last)
+
+    handle = save_state_dict(state_dict, path, process_index=process_index,
+                             async_save=async_save,
+                             generation=str(int(step)), _on_commit=commit)
+    return handle if async_save else path

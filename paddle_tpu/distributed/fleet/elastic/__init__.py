@@ -16,9 +16,27 @@ import os
 import threading
 import time
 
+from ....framework import failpoints as _fp
 from ...store import TCPStore
 
 __all__ = ["ElasticStatus", "ElasticLevel", "ElasticManager"]
+
+_FP_HEARTBEAT = _fp.register("elastic.heartbeat")
+
+
+class _NpWaitResult(int):
+    """Result of :meth:`ElasticManager.wait_for_np`: the observed member
+    count, truthy only when the count reached quorum — so
+    ``if not mgr.wait_for_np():`` keeps working while error messages can
+    say how many nodes actually showed up."""
+
+    def __new__(cls, count, ok):
+        obj = super().__new__(cls, count)
+        obj.ok = ok
+        return obj
+
+    def __bool__(self):
+        return self.ok
 
 
 class ElasticStatus:
@@ -73,6 +91,8 @@ class ElasticManager:
         self._hb_thread = None
         self._stopped = threading.Event()
         self._last_members = None
+        self._last_full_round = 0.0   # when a complete probe round ran
+        self._store_lost = False      # cache expired with store still down
         # ids with no readable record get backoff deadlines instead of a
         # permanent blacklist: transient store slowness must not evict a
         # live peer (they are re-probed after the backoff lapses)
@@ -95,26 +115,67 @@ class ElasticManager:
         return self._node_id
 
     def _beat(self):
+        if _fp._ACTIVE:
+            _fp.fire(_FP_HEARTBEAT)
         rec = {"endpoint": self._endpoint, "ts": time.time(), "alive": True}
+        # short retry budget: a stale beat is worthless, and a beat
+        # parked in the client's full resilience envelope would pin the
+        # loop; fail fast, the next interval retries
         self._store.set(self._k("node", str(self._node_id)),
-                        json.dumps(rec).encode())
+                        json.dumps(rec).encode(),
+                        retry_budget=max(self.heartbeat_interval, 2.0))
 
     def _hb_loop(self):
+        # the lease loop NEVER gives up on store trouble: during an
+        # outage peers evict this node by lease expiry anyway, and the
+        # first beat after the store returns re-registers the record —
+        # rejoin is exactly the elastic behavior wanted.  Each failed
+        # beat is bounded by _beat's short retry budget.
         while not self._stopped.wait(self.heartbeat_interval):
             try:
                 self._beat()
             except Exception:
-                return
+                pass
+        # stop() raced an in-flight beat that may have been parked in the
+        # store client's retry envelope longer than stop()'s bounded
+        # join: re-write the tombstone on the way out so the last word
+        # in the store is always "dead", never a stale "alive" beat
+        self._write_tombstone()
+
+    def _write_tombstone(self):
+        if self._node_id is None:
+            return
+
+        # best-effort parting word of a dying node: it must never stall
+        # the launcher's SIGTERM grace.  retry_budget bounds the Python
+        # client; the native client ignores it (its C API has no budget
+        # knob), so the write also runs on a daemon thread with a
+        # bounded join — wall time is capped for both client types.
+        def _do():
+            try:
+                rec = {"endpoint": self._endpoint, "ts": 0,
+                       "alive": False}
+                self._store.set(self._k("node", str(self._node_id)),
+                                json.dumps(rec).encode(),
+                                retry_budget=2.0)
+            except Exception:
+                pass
+        t = threading.Thread(target=_do, daemon=True)
+        t.start()
+        t.join(timeout=3.0)
 
     def stop(self):
         self._stopped.set()
-        if self._node_id is not None:
-            try:
-                rec = {"endpoint": self._endpoint, "ts": 0, "alive": False}
-                self._store.set(self._k("node", str(self._node_id)),
-                                json.dumps(rec).encode())
-            except Exception:
-                pass
+        # join the heartbeat thread (bounded) BEFORE writing the
+        # tombstone: an in-flight beat racing the tombstone could
+        # re-mark this dying node "alive" and stall the peers' RESTART
+        # detection for a full lease window.  If the join times out
+        # (beat parked in store retry), _hb_loop re-writes the tombstone
+        # itself when that beat finally returns.
+        t = self._hb_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=self.heartbeat_interval * 2 + 1.0)
+        self._write_tombstone()
 
     def exit(self, completed=True):
         self.stop()
@@ -129,24 +190,41 @@ class ElasticManager:
         rank to someone else.  Ids that repeatedly have no record (died
         between registration and first heartbeat) are remembered as dead
         and skipped, keeping watch() latency flat."""
+        truncated = False
         try:
             seq = self._store.add(self._k("seq"), 0)
         except Exception:
+            # store unreachable before a single probe ran: this round is
+            # as incomplete as one truncated mid-probe — fall through to
+            # the last-known-good fallback, not an empty membership
             seq = 0
+            truncated = True
         now = time.time()
         lease = max(self.heartbeat_interval * 3, 6.0)
         members = {}
         for nid in range(seq):
+            if self._stopped.is_set():
+                truncated = True
+                break              # stop() mid-round: bail out promptly
             if self._dead_until.get(nid, 0) > now:
                 continue
             try:
                 raw = self._store.get(self._k("node", str(nid)),
                                       timeout=1.0)
-            except Exception:
+            except KeyError:       # store healthy, record absent: a miss
                 self._miss_counts[nid] = self._miss_counts.get(nid, 0) + 1
                 if self._miss_counts[nid] >= 3:
                     self._dead_until[nid] = now + 10 * lease
                 continue
+            except Exception:
+                # store-level trouble (connect/retry budget burned): one
+                # failed probe already cost a full client retry envelope,
+                # so probing the remaining ids would stack envelopes and
+                # make this round — and wait_for_np's timeout — minutes
+                # long.  Abort the round; nobody gets a miss charged for
+                # store downtime.
+                truncated = True
+                break
             self._miss_counts.pop(nid, None)
             self._dead_until.pop(nid, None)
             try:
@@ -155,6 +233,26 @@ class ElasticManager:
                 continue
             if rec.get("alive") and now - rec["ts"] <= lease:
                 members[nid] = rec["endpoint"]
+        self._store_lost = False
+        if truncated and self._last_members and \
+                now - self._last_full_round <= 3 * lease:
+            # an incomplete probe round must not masquerade as a
+            # membership CHANGE — watch() would force a spurious full
+            # relaunch over a transient store fault.  Report the last
+            # complete round instead (this node re-added from local
+            # knowledge, as below).  Bounded: once the cache outlives
+            # three lease windows the store is not "flapping", it is
+            # gone — watch() then reports HOLD (see _store_lost) so the
+            # launcher's hold-timeout give-up path engages.
+            print("[elastic] store unreachable; serving last-known "
+                  "membership", flush=True)
+            members = dict(self._last_members)
+        elif truncated and self._last_members:
+            print("[elastic] store unreachable beyond the lease window; "
+                  "last-known membership expired", flush=True)
+            self._store_lost = True
+        elif not truncated:
+            self._last_full_round = now
         if self._node_id is not None and not self._stopped.is_set():
             members.setdefault(self._node_id, getattr(self, "_endpoint",
                                                       f"{self.host}:0"))
@@ -172,6 +270,13 @@ class ElasticManager:
     def watch(self):
         """One membership poll → status for the launcher loop."""
         members = self._members()
+        if self._store_lost:
+            # the registry is gone, not flapping: with no control plane
+            # there is nothing trustworthy to RESTART onto — a partial
+            # view here could relaunch every node as its own singleton
+            # job (split brain).  HOLD until the store returns or the
+            # launcher's hold timeout gives up.
+            return ElasticStatus.HOLD
         n = len(members)
         if self._last_members is None:
             self._last_members = members
@@ -183,12 +288,21 @@ class ElasticManager:
         return ElasticStatus.NORMAL
 
     def wait_for_np(self, timeout=None):
-        """Block until member count is within [min_np, max_np]."""
+        """Block until member count is within [min_np, max_np].
+
+        Returns an int-like result: the observed member count, truthy
+        only when quorum was reached — callers can both test success and
+        report how many nodes actually showed up.  Polls on the stop
+        event (not a bare sleep) so :meth:`stop` interrupts the wait
+        promptly."""
         timeout = timeout if timeout is not None else self.elastic_timeout
-        t0 = time.time()
-        while time.time() - t0 < timeout:
+        deadline = time.time() + timeout
+        while True:
             n = len(self._members())
             if self.min_np <= n <= self.max_np:
-                return True
-            time.sleep(self.heartbeat_interval / 2)
-        return False
+                return _NpWaitResult(n, True)
+            if time.time() >= deadline or self._stopped.is_set():
+                return _NpWaitResult(n, False)
+            if self._stopped.wait(min(self.heartbeat_interval / 2,
+                                      max(0.0, deadline - time.time()))):
+                return _NpWaitResult(n, False)

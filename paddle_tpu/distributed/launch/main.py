@@ -19,7 +19,105 @@ import subprocess
 import sys
 import time
 
+from ...framework.backoff import jittered_delay
+from ...framework.preemption import PREEMPTED_EXIT_CODE
 from ..fleet.elastic import ElasticManager, ElasticStatus
+
+# restart hygiene: sleep with exponential backoff between restarts of the
+# same worker (a crash-looping script must not spin the host), and forgive
+# the restart budget once a worker has run stably for this long — a job
+# that hiccups once a day should never exhaust max_restart
+_RESTART_BACKOFF_BASE = 1.0
+_RESTART_BACKOFF_CAP = 60.0
+_STABLE_WINDOW_S = float(os.environ.get("PADDLE_STABLE_WINDOW", "60"))
+
+
+def _restart_backoff(n_restarts):
+    """Jittered exponential backoff (seconds) before restart N."""
+    return jittered_delay(max(n_restarts - 1, 0),
+                          _RESTART_BACKOFF_BASE, _RESTART_BACKOFF_CAP)
+
+
+class _RestartPolicy:
+    """Per-worker restart accounting shared by the collective and PS
+    watch loops: backoff deadlines (never blocking the loop),
+    stable-window budget forgiveness, and a preemption budget separate
+    from (and more generous than) the crash budget."""
+
+    def __init__(self, max_restart):
+        self.max_restart = max_restart
+        self.restarts = {}
+        self.preempts = {}
+        self.started_at = {}
+        self.pending = {}       # key -> earliest restart time
+
+    def note_start(self, key):
+        self.started_at[key] = time.time()
+
+    def is_pending(self, key):
+        return key in self.pending
+
+    def has_pending(self):
+        return bool(self.pending)
+
+    def pop_due(self, now):
+        """Keys whose backoff has elapsed; removed from pending."""
+        due = [k for k, t in self.pending.items() if now >= t]
+        for k in due:
+            del self.pending[k]
+        return due
+
+    def reset_all(self):
+        self.pending.clear()
+        self.restarts.clear()
+        self.preempts.clear()
+
+    def on_exit(self, key, ret, now, label):
+        """Handle a non-zero exit: schedule a restart (returns
+        ``"restart"``, key parked in ``pending``) or ``"give_up"``."""
+        # stable-window forgiveness, with the bar rising per CRASH on
+        # record: a fixed window would let a worker that deterministically
+        # crashes just past it restart forever, never exhausting
+        # max_restart — scaling by crash count guarantees any fixed
+        # crash interval eventually stops qualifying.  Preemptions do
+        # NOT raise the bar: a pool legitimately evicting workers every
+        # few minutes must keep qualifying for forgiveness, or a healthy
+        # checkpoint-and-resume job would exhaust the preempt budget.
+        crash_history = self.restarts.get(key, 0)
+        window = _STABLE_WINDOW_S * (1 + crash_history)
+        if (crash_history or self.preempts.get(key)) and \
+                now - self.started_at.get(key, 0) >= window:
+            print(f"[launch] {label} was stable for >{window:.0f}s; "
+                  "resetting its restart budget", flush=True)
+            self.restarts[key] = 0
+            self.preempts[key] = 0
+        if ret == PREEMPTED_EXIT_CODE:
+            # the worker saved an emergency checkpoint and asked to be
+            # relaunched (framework/preemption.py contract): restart
+            # with resume, without charging the crash budget — but a
+            # worker that does nothing except exit 71 is a bug, so a
+            # generous separate budget still bounds the loop
+            self.preempts[key] = self.preempts.get(key, 0) + 1
+            if self.preempts[key] > max(3 * self.max_restart, 10):
+                print(f"[launch] {label} preempted {self.preempts[key]} "
+                      "times without a stable run; giving up", flush=True)
+                return "give_up"
+            backoff = _restart_backoff(min(self.preempts[key], 3))
+            print(f"[launch] {label} preempted (rc={ret}); restarting "
+                  f"with resume from its latest checkpoint in "
+                  f"{backoff:.1f}s", flush=True)
+        else:
+            if self.restarts.get(key, 0) >= self.max_restart:
+                print(f"[launch] {label} failed rc={ret}; giving up",
+                      flush=True)
+                return "give_up"
+            self.restarts[key] = self.restarts.get(key, 0) + 1
+            backoff = _restart_backoff(self.restarts[key])
+            print(f"[launch] {label} exited rc={ret}; restart "
+                  f"{self.restarts[key]}/{self.max_restart} in "
+                  f"{backoff:.1f}s", flush=True)
+        self.pending[key] = now + backoff
+        return "restart"
 
 
 def _parse():
@@ -107,9 +205,11 @@ def _setup_elastic(args):
           f"{mgr._node_id}", flush=True)
     # gate the first launch on quorum: starting below min_np would train
     # with the wrong world size
-    if not mgr.wait_for_np():
+    got = mgr.wait_for_np()
+    if not got:
         print(f"[launch] elastic: quorum of {mgr.min_np} nodes not reached "
-              f"within {mgr.elastic_timeout}s; aborting", flush=True)
+              f"within {mgr.elastic_timeout}s (observed {int(got)} "
+              f"member(s)); aborting", flush=True)
         mgr.stop()
         sys.exit(1)
     return mgr
@@ -155,7 +255,8 @@ def _launch_ps(args):
         for s in probes:
             s.close()
     ep_list = ",".join(endpoints)
-    procs, logs, restarts = {}, {}, {}
+    procs, logs = {}, {}
+    policy = _RestartPolicy(args.max_restart)
 
     def start(kind, idx):
         key = (kind, idx)
@@ -187,7 +288,7 @@ def _launch_ps(args):
         p = subprocess.Popen(cmd, env=env, stdout=logf,
                              stderr=subprocess.STDOUT)
         procs[key] = p
-        restarts.setdefault(key, 0)
+        policy.note_start(key)
         print(f"[launch] started {kind} {idx} pid={p.pid} log={log_path}",
               flush=True)
 
@@ -212,7 +313,14 @@ def _launch_ps(args):
 
     while True:
         trainers_alive = 0
+        now = time.time()
+        for kind, idx in policy.pop_due(now):   # backoff elapsed
+            start(kind, idx)
         for (kind, idx), p in list(procs.items()):
+            key = (kind, idx)
+            if policy.is_pending(key):
+                trainers_alive += 1      # restart-pending counts as live
+                continue
             ret = p.poll()
             if ret is None:
                 if kind == "trainer":
@@ -226,18 +334,10 @@ def _launch_ps(args):
                       "trainers finished; aborting", flush=True)
                 stop_all(1)
             if kind == "trainer" and ret != 0:
-                key = (kind, idx)
-                if restarts[key] < args.max_restart:
-                    restarts[key] += 1
-                    print(f"[launch] trainer {idx} exited rc={ret}; "
-                          f"restart {restarts[key]}/{args.max_restart}",
-                          flush=True)
-                    start("trainer", idx)
-                    trainers_alive += 1
-                else:
-                    print(f"[launch] trainer {idx} failed rc={ret}; "
-                          "giving up", flush=True)
+                if policy.on_exit(key, ret, now,
+                                  f"trainer {idx}") == "give_up":
                     stop_all(1)
+                trainers_alive += 1
         if trainers_alive == 0 and \
                 all(p.poll() is not None or k[0] == "server"
                     for k, p in procs.items()):
@@ -261,7 +361,7 @@ def main():
         return
     os.makedirs(args.log_dir, exist_ok=True)
     procs = {}
-    restarts = {i: 0 for i in range(args.nproc_per_node)}
+    policy = _RestartPolicy(args.max_restart)
     logs = {}
     elastic = _setup_elastic(args)
     membership = {"node_index": args.node_rank,
@@ -286,6 +386,7 @@ def main():
                                                   membership),
                              stdout=logf, stderr=subprocess.STDOUT)
         procs[local_rank] = p
+        policy.note_start(local_rank)
         print(f"[launch] started worker {local_rank} pid={p.pid} "
               f"rank={membership['node_index'] * args.nproc_per_node + local_rank} "
               f"world={membership['n_nodes'] * args.nproc_per_node} "
@@ -335,8 +436,11 @@ def main():
                 print("[launch] elastic: membership never recovered; "
                       "giving up", flush=True)
                 shutdown(code=1)
-            # still reap finished workers so a completed job can exit
-            if all(p.poll() is not None for p in procs.values()):
+            # still reap finished workers so a completed job can exit —
+            # but a worker parked awaiting its restart-backoff deadline
+            # is dead-by-design, not "done"
+            if not policy.has_pending() and \
+                    all(p.poll() is not None for p in procs.values()):
                 rcs = [p.returncode for p in procs.values()]
                 code = 0 if all(r == 0 for r in rcs) else 1
                 print(f"[launch] workers done during hold rcs={rcs}",
@@ -362,26 +466,26 @@ def main():
                   f"{membership['n_nodes']}: {membership['endpoints']}",
                   flush=True)
             stop_workers()
+            policy.reset_all()           # fresh budget for the new epoch
             for i in range(args.nproc_per_node):
-                restarts[i] = 0          # fresh budget for the new epoch
                 start(i)
 
         alive = 0
+        now = time.time()
+        for i in policy.pop_due(now):    # backoff elapsed: relaunch
+            start(i)
         for i, p in list(procs.items()):
+            if policy.is_pending(i):
+                alive += 1               # restart-pending counts as live
+                continue
             ret = p.poll()
             if ret is None:
                 alive += 1
             elif ret != 0:
-                if restarts[i] < args.max_restart:
-                    restarts[i] += 1
-                    print(f"[launch] worker {i} exited rc={ret}; restart "
-                          f"{restarts[i]}/{args.max_restart}", flush=True)
-                    start(i)
-                    alive += 1
-                else:
-                    print(f"[launch] worker {i} failed rc={ret}; giving up",
-                          flush=True)
+                if policy.on_exit(i, ret, now,
+                                  f"worker {i}") == "give_up":
                     shutdown(code=1)
+                alive += 1
         if alive == 0:
             break
         time.sleep(1)

@@ -19,11 +19,41 @@ import struct
 import threading
 import time
 
+from ..framework import failpoints as _fp
 from ..framework import native
+from ..framework.backoff import jittered_delay
 
 __all__ = ["TCPStore", "MasterStore"]
 
 _SET, _GET, _ADD, _WAIT, _DEL, _NUMKEYS = 1, 2, 3, 4, 5, 6
+
+# failpoint sites (see framework/failpoints.py; armed via
+# PADDLE_FAILPOINTS="store.get=error*2;..." or set_failpoint).
+# store.<op> sites fire in the TCPStore facade — the CALLER sees the
+# fault (elastic watch flap tests).  store.connect and store.io fire
+# INSIDE the Python client's retry envelope, so those faults are
+# retried like real network errors.
+_FP_CONNECT = _fp.register("store.connect")
+_FP_IO = _fp.register("store.io")
+_FP_SET = _fp.register("store.set")
+_FP_GET = _fp.register("store.get")
+_FP_ADD = _fp.register("store.add")
+_FP_WAIT = _fp.register("store.wait")
+
+# retry envelope for the Python client: reconnect attempts back off
+# exponentially with jitter up to _BACKOFF_CAP between tries, bounded
+# overall by the store timeout (the "deadline")
+_BACKOFF_BASE = 0.05
+_BACKOFF_CAP = 2.0
+
+
+def _backoff_sleep(attempt, deadline=None):
+    """Exponential backoff with jitter, never sleeping past deadline."""
+    delay = jittered_delay(attempt, _BACKOFF_BASE, _BACKOFF_CAP)
+    if deadline is not None:
+        delay = min(delay, max(0.0, deadline - time.monotonic()))
+    if delay > 0:
+        time.sleep(delay)
 
 
 class _PyStoreServer:
@@ -39,6 +69,15 @@ class _PyStoreServer:
             def handle(self):
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with outer._conns_mu:
+                    outer._conns.add(sock)
+                try:
+                    self._serve(sock)
+                finally:
+                    with outer._conns_mu:
+                        outer._conns.discard(sock)
+
+            def _serve(self, sock):
                 while True:
                     hdr = _recv_full(sock, 5)
                     if hdr is None:
@@ -102,6 +141,8 @@ class _PyStoreServer:
 
         self._stopped = False
         self._cond = cond
+        self._conns = set()
+        self._conns_mu = threading.Lock()
         self._server = Server(("0.0.0.0", port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -114,6 +155,21 @@ class _PyStoreServer:
             self._cond.notify_all()
         self._server.shutdown()
         self._server.server_close()
+        # sever live connections so clients see a dead server (EOF/RST)
+        # instead of being silently served by zombie handler threads — a
+        # stopped server must look stopped, or restart/reconnect logic
+        # can never be exercised honestly
+        with self._conns_mu:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 def _recv_full(sock, n):
@@ -127,39 +183,166 @@ def _recv_full(sock, n):
 
 
 class _PyStoreClient:
+    """Wire-protocol client with resilience: connect (and reconnect after
+    a lost peer) retries with exponential backoff + jitter under an
+    overall per-call deadline, and each request is retried over a fresh
+    connection when the socket dies mid-flight.
+
+    Idempotent ops (SET/GET/WAIT/DEL/NUMKEYS) are at-least-once — a
+    replayed SET is harmless.  ADD is at-most-once: once any request
+    bytes may have reached the server, a failure raises instead of
+    retrying, because a double-applied ADD would skip counter values and
+    strand ``barrier()`` waiters on a release epoch nobody sets.  An ADD
+    that fails before the first byte (connect refused, injected
+    store.connect/store.io fault) is still retried safely.
+    """
+
     def __init__(self, host, port, timeout_ms):
-        deadline = time.monotonic() + timeout_ms / 1e3
+        self._host, self._port = host, port
+        self._timeout_s = (timeout_ms / 1e3 if timeout_ms is not None
+                           and timeout_ms >= 0 else 30.0)
+        self._sock = None
+        self._closed = False
+        self._mu = threading.Lock()
+        self._connect(time.monotonic() + self._timeout_s)
+
+    def _connect_once(self):
+        """One connection attempt (no retry — callers own the backoff)."""
+        if _fp._ACTIVE:
+            _fp.fire(_FP_CONNECT)
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=5)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        return sock
+
+    def _connect(self, deadline):
+        """Initial connect: retry with backoff until deadline."""
+        attempt = 0
         while True:
+            if self._closed:   # outside the try: must not be retried
+                raise ConnectionError("TCPStore client is closed")
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
-                self._sock.settimeout(None)
-                self._sock.setsockopt(
-                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                break
-            except OSError:
+                return self._connect_once()
+            except OSError as e:
                 if time.monotonic() >= deadline:
                     raise TimeoutError(
-                        f"TCPStore: cannot reach {host}:{port}")
-                time.sleep(0.05)
-        self._mu = threading.Lock()
+                        f"TCPStore: cannot reach {self._host}:{self._port} "
+                        f"within {self._timeout_s:.1f}s "
+                        f"(last error: {e})") from e
+                _backoff_sleep(attempt, deadline)
+                attempt += 1
 
-    def request(self, op, key, payload):
-        with self._mu:
-            msg = struct.pack("<BI", op, len(key)) + key + \
-                struct.pack("<Q", len(payload)) + payload
-            self._sock.sendall(msg)
-            hdr = _recv_full(self._sock, 9)
-            if hdr is None:
-                raise ConnectionError("TCPStore connection lost")
-            status, outlen = struct.unpack("<BQ", hdr)
-            out = _recv_full(self._sock, outlen) if outlen else b""
-            return status, out
+    def _close_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def request(self, op, key, payload, op_timeout_s=0.0, budget_s=None):
+        """``op_timeout_s``: how long the server may legitimately park
+        this op (GET/WAIT); the retry deadline must outlast it or a flap
+        late in the park window would get zero retries.  ``None`` means
+        the op waits indefinitely server-side — the client then waits
+        (and retries) indefinitely too, matching the native client.
+
+        ``self._mu`` serializes socket use.  It is NOT held across the
+        backoff sleeps between attempts, so a flap-stalled op cannot
+        head-of-line-block other threads for the whole retry budget —
+        but it IS held while a GET/WAIT is parked server-side (one
+        socket, one in-flight request).  Threads sharing a client should
+        keep their blocking waits short (the framework's own probes use
+        ~1s); give long barrier-style waits their own TCPStore.
+
+        ``budget_s`` overrides the client's retry budget for this call
+        (shutdown paths that must fail fast, e.g. the elastic tombstone).
+
+        Replay caveat: retried ops are at-least-once, and while SET/GET/
+        WAIT results are replay-stable, a DEL whose first attempt was
+        applied but whose reply was lost reports "not found" on replay —
+        treat delete_key()'s return value as best-effort."""
+        # delta-0 ADD is a pure read (the elastic seq probe): replaying
+        # it cannot double-count, so it keeps the idempotent retry path
+        idempotent = op != _ADD or payload == struct.pack("<q", 0)
+        extra = (float("inf") if op_timeout_s is None
+                 else max(op_timeout_s, 0))
+        base_budget = self._timeout_s if budget_s is None else budget_s
+        deadline = time.monotonic() + base_budget + extra
+        attempt = 0
+        while True:
+            if self._closed:   # outside the try: must not be retried
+                raise ConnectionError("TCPStore client is closed")
+            risky = False      # True once request bytes may be out
+            connecting = False
+            sock = None
+            try:
+                with self._mu:
+                    # local ref: a concurrent close() nulls self._sock,
+                    # and None.sendall would escape the OSError retry net
+                    sock = self._sock
+                    if sock is None:
+                        connecting = True
+                        sock = self._connect_once()
+                        connecting = False
+                    # bound the blocking send/recv by the remaining
+                    # deadline: a half-open peer (power loss, partition
+                    # with no FIN/RST) must surface as a timeout, not
+                    # hang this call forever
+                    rem = deadline - time.monotonic()
+                    sock.settimeout(None if rem == float("inf")
+                                    else max(0.5, rem))
+                    if _fp._ACTIVE:
+                        _fp.fire(_FP_IO)   # in-envelope fault: retried
+                    msg = struct.pack("<BI", op, len(key)) + key + \
+                        struct.pack("<Q", len(payload)) + payload
+                    risky = True
+                    sock.sendall(msg)
+                    hdr = _recv_full(sock, 9)
+                    if hdr is None:
+                        raise ConnectionError("TCPStore connection lost")
+                    status, outlen = struct.unpack("<BQ", hdr)
+                    out = _recv_full(sock, outlen) if outlen else b""
+                    if out is None:   # connection died mid-body
+                        raise ConnectionError("TCPStore connection lost")
+                    return status, out
+            except OSError as e:  # incl. Connection/TimeoutError
+                with self._mu:
+                    # close only the socket that failed: another thread
+                    # may have already reconnected self._sock to a
+                    # healthy replacement while we waited for the lock
+                    if self._sock is sock:
+                        self._close_sock()
+                    elif sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                if not idempotent and risky:
+                    # the server may or may not have applied the ADD;
+                    # replaying could double-count — surface instead
+                    raise ConnectionError(
+                        "TCPStore: connection lost mid-ADD; the "
+                        "increment may or may not have been applied "
+                        f"({e})") from e
+                if time.monotonic() >= deadline:
+                    if connecting:
+                        raise TimeoutError(
+                            f"TCPStore: cannot reach "
+                            f"{self._host}:{self._port} within the "
+                            f"{base_budget + extra:.1f}s retry "
+                            f"budget (last error: {e})") from e
+                    raise ConnectionError(
+                        f"TCPStore: request failed after its "
+                        f"{base_budget + extra:.1f}s retry "
+                        f"budget ({e})") from e
+                _backoff_sleep(attempt, deadline)
+                attempt += 1
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._closed = True     # in-flight retries turn into clean errors
+        self._close_sock()
 
 
 class TCPStore:
@@ -167,11 +350,18 @@ class TCPStore:
 
     API mirrors the reference: set/get/add/wait/delete_key, plus a
     counter-based ``barrier``.
+
+    ``timeout`` doubles as the resilience deadline: connect, reconnect
+    and per-op retry (Python client) give up once it lapses.
+    ``use_native=False`` forces the pure-Python client/server even when
+    the C++ library is available (tests, failpoint injection).
     """
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=30.0):
-        self._lib = native.get_lib()
+                 world_size=1, timeout=30.0, use_native=None):
+        if use_native is None:
+            use_native = os.environ.get("PADDLE_STORE_NATIVE", "1") != "0"
+        self._lib = native.get_lib() if use_native else None
         self._server = None
         self._server_h = None
         self.world_size = world_size
@@ -196,7 +386,12 @@ class TCPStore:
             self._client = _PyStoreClient(host, port, timeout_ms)
 
     # -- core ops ---------------------------------------------------
-    def set(self, key, value):
+    def set(self, key, value, retry_budget=None):
+        """``retry_budget`` (seconds, Python client only) caps this
+        call's reconnect/retry envelope below the store timeout — for
+        shutdown-path writes that must fail fast, not resiliently."""
+        if _fp._ACTIVE:
+            _fp.fire(_FP_SET)
         if isinstance(value, str):
             value = value.encode()
         if self._lib is not None:
@@ -207,9 +402,12 @@ class TCPStore:
             if rc != 0:
                 raise ConnectionError("TCPStore set failed")
         else:
-            self._client.request(_SET, key.encode(), value)
+            self._client.request(_SET, key.encode(), value,
+                                 budget_s=retry_budget)
 
     def get(self, key, timeout=30.0):
+        if _fp._ACTIVE:
+            _fp.fire(_FP_GET)
         tmo = int(timeout * 1000) if timeout is not None else -1
         if self._lib is not None:
             import ctypes
@@ -222,12 +420,15 @@ class TCPStore:
                 raise ConnectionError("TCPStore get failed")
             return native.take_buffer(self._lib, out, n)
         status, out = self._client.request(
-            _GET, key.encode(), struct.pack("<q", tmo))
+            _GET, key.encode(), struct.pack("<q", tmo),
+            op_timeout_s=timeout)
         if status != 0:
             raise KeyError(key)
         return out
 
     def add(self, key, delta=1):
+        if _fp._ACTIVE:
+            _fp.fire(_FP_ADD)
         if self._lib is not None:
             v = self._lib.pt_store_add(self._client, key.encode(), delta)
             if v == -(2 ** 63):
@@ -240,6 +441,8 @@ class TCPStore:
         return struct.unpack("<q", out)[0]
 
     def wait(self, keys, timeout=30.0):
+        if _fp._ACTIVE:
+            _fp.fire(_FP_WAIT)
         if isinstance(keys, str):
             keys = [keys]
         tmo = int(timeout * 1000) if timeout is not None else -1
@@ -247,14 +450,21 @@ class TCPStore:
             if self._lib is not None:
                 rc = self._lib.pt_store_wait(self._client, key.encode(), tmo)
                 if rc == 1:
-                    raise TimeoutError(f"TCPStore: wait({key}) timed out")
+                    raise TimeoutError(
+                        f"TCPStore: wait({key!r}) expired after {timeout}s "
+                        "without the key being set")
                 if rc != 0:
                     raise ConnectionError("TCPStore wait failed")
             else:
                 status, _ = self._client.request(
-                    _WAIT, key.encode(), struct.pack("<q", tmo))
+                    _WAIT, key.encode(), struct.pack("<q", tmo),
+                    op_timeout_s=timeout)
                 if status != 0:
-                    raise TimeoutError(f"TCPStore: wait({key}) timed out")
+                    # status byte 1 == server-side expiry (or the server
+                    # shut down while we were parked on the key)
+                    raise TimeoutError(
+                        f"TCPStore: wait({key!r}) expired after {timeout}s "
+                        "without the key being set")
 
     def delete_key(self, key):
         if self._lib is not None:

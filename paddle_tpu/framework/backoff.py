@@ -1,0 +1,15 @@
+"""Shared full-jitter exponential backoff (store reconnects, launcher
+worker restarts) — one formula so retry tuning cannot silently diverge
+between subsystems."""
+import random
+
+__all__ = ["jittered_delay"]
+
+
+def jittered_delay(attempt, base, cap):
+    """``min(cap, base * 2**attempt) * U[0.5, 1.0)`` seconds.
+
+    Full jitter halves thundering herds (many clients reconnecting to
+    one master in lockstep) while keeping the expected doubling."""
+    delay = min(cap, base * (2 ** max(attempt, 0)))
+    return delay * (0.5 + random.random() / 2)

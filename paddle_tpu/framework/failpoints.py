@@ -1,0 +1,203 @@
+"""Deterministic failpoint injection (reference: the FLAGS_-gated fault
+hooks scattered through paddle/fluid — unified here into one registry the
+way tikv/failpoint or absl's fault-injection hooks work).
+
+A *failpoint* is a named site in framework code (store I/O, checkpoint
+shard writes, elastic heartbeat, dataloader worker loop) where a test or
+an operator can inject a fault without touching the code under test.
+
+Configuration — either programmatic::
+
+    from paddle_tpu.framework import failpoints
+    failpoints.set_failpoint("store.get", "error*2")   # fail twice, then OK
+
+or via the environment (read once at import; fork'd dataloader workers
+inherit the parsed state)::
+
+    PADDLE_FAILPOINTS="store.get=error*2;ckpt.write_shard=delay:0.5"
+
+Action grammar (``kind[:arg][*count]``):
+
+=================  =====================================================
+``error``          raise :class:`FailpointError` (a ``ConnectionError``,
+                   so store retry paths treat it as a network fault)
+``error:Name``     raise builtin exception ``Name`` instead
+``delay:S``        sleep S seconds, then continue
+``skip``           make the hook site skip the guarded operation
+                   (``fire`` returns ``"skip"``) — only valid at sites
+                   registered as skippable (e.g. ``ckpt.commit_sentinel``);
+                   arming it elsewhere raises, because a site that
+                   ignores the return value would silently test nothing
+=================  =====================================================
+
+``*N`` arms the failpoint for its first N firings only; once drained it
+is removed from the active set, so ``error*2`` means "fail twice, then
+behave" — the building block for retry/flap tests.  Without a count the
+action fires every time.
+
+Zero cost when unset: hook sites guard with a single module-level dict
+check (``if failpoints._ACTIVE: failpoints.fire(name)``); with no
+failpoints configured the hot path pays one attribute load + falsy test.
+
+Every hook site declares its name with :func:`register` at import time;
+``tools/check_failpoints.py`` lints test references against that
+registry so a renamed site cannot silently orphan a chaos test.
+"""
+import os
+import threading
+import time
+
+__all__ = ["FailpointError", "register", "registered", "configure",
+           "set_failpoint", "clear", "fire", "active"]
+
+
+class FailpointError(ConnectionError):
+    """Raised by an ``error`` action.  Subclasses ConnectionError so the
+    store's retry machinery handles an injected fault exactly like a real
+    network one."""
+
+
+_ACTIVE = {}        # name -> [action_kind, arg, remaining_count|None]
+_REGISTRY = set()   # every name a hook site has declared
+_SKIPPABLE = set()  # sites that honor fire()'s "skip" return value
+_lock = threading.Lock()
+
+
+def register(name, skippable=False):
+    """Declare a failpoint site (module import time).  Returns the name so
+    sites can do ``_FP_GET = failpoints.register("store.get")``.  Pass
+    ``skippable=True`` only if the site acts on ``fire()`` returning
+    ``"skip"``."""
+    _REGISTRY.add(name)
+    if skippable:
+        _SKIPPABLE.add(name)
+    return name
+
+
+def registered():
+    """Frozen view of all declared sites (for the lint tool and docs)."""
+    return frozenset(_REGISTRY)
+
+
+def _parse_action(text):
+    """``kind[:arg][*count]`` -> (kind, arg, count|None)."""
+    count = None
+    if "*" in text:
+        text, _, n = text.rpartition("*")
+        count = int(n)
+        if count <= 0:
+            raise ValueError(f"failpoint count must be positive: *{n}")
+    kind, _, arg = text.partition(":")
+    kind = kind.strip()
+    if kind not in ("error", "delay", "skip"):
+        raise ValueError(f"unknown failpoint action {kind!r} "
+                         "(want error|delay|skip)")
+    if kind == "delay":
+        arg = float(arg or 0.0)
+    elif kind == "error":
+        arg = arg or None
+    else:
+        arg = None
+    return kind, arg, count
+
+
+def parse_spec(spec):
+    """Parse ``name=action;name=action`` into {name: (kind, arg, count)}.
+    Exposed for the lint tool."""
+    out = {}
+    for item in (spec or "").split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, action = item.partition("=")
+        if not sep:
+            raise ValueError(f"malformed failpoint spec item {item!r} "
+                             "(want name=action)")
+        out[name.strip()] = _parse_action(action.strip())
+    return out
+
+
+def _check_skippable(name, kind):
+    """Arming ``skip`` on a site that ignores fire()'s return value would
+    silently test nothing — reject it.  Sites not yet registered (env
+    config parsed before the hooked module imports) are re-checked at
+    fire() time."""
+    if kind == "skip" and name in _REGISTRY and name not in _SKIPPABLE:
+        raise ValueError(
+            f"failpoint {name!r} does not honor the skip action "
+            f"(skippable sites: {sorted(_SKIPPABLE) or 'none yet'})")
+
+
+def configure(spec):
+    """Replace the active set from a ``PADDLE_FAILPOINTS``-style spec."""
+    parsed = parse_spec(spec)
+    with _lock:
+        for name, (kind, arg, count) in parsed.items():
+            _check_skippable(name, kind)
+        _ACTIVE.clear()
+        for name, (kind, arg, count) in parsed.items():
+            _ACTIVE[name] = [kind, arg, count]
+
+
+def set_failpoint(name, action):
+    """Arm one failpoint: ``set_failpoint("store.get", "error*2")``."""
+    kind, arg, count = _parse_action(action)
+    with _lock:
+        _check_skippable(name, kind)
+        _ACTIVE[name] = [kind, arg, count]
+
+
+def clear(name=None):
+    """Disarm one failpoint, or all of them when ``name`` is None."""
+    with _lock:
+        if name is None:
+            _ACTIVE.clear()
+        else:
+            _ACTIVE.pop(name, None)
+
+
+def active():
+    """Snapshot of currently-armed failpoints {name: action_kind}."""
+    with _lock:
+        return {k: v[0] for k, v in _ACTIVE.items()}
+
+
+def _resolve_exc(name):
+    if not name:
+        return FailpointError
+    import builtins
+    exc = getattr(builtins, name, None)
+    if not (isinstance(exc, type) and issubclass(exc, BaseException)):
+        raise ValueError(f"failpoint error class {name!r} is not a "
+                         "builtin exception")
+    return exc
+
+
+def fire(name):
+    """Hook-site entry.  Returns None (proceed) or ``"skip"``; raises for
+    ``error`` actions.  A drained counted action is removed, so the site
+    returns to the zero-cost path."""
+    with _lock:
+        ent = _ACTIVE.get(name)
+        if ent is None:
+            return None
+        kind, arg, count = ent
+        if count is not None:
+            if count <= 1:
+                del _ACTIVE[name]
+            else:
+                ent[2] = count - 1
+    if kind == "delay":
+        time.sleep(arg)
+        return None
+    if kind == "skip":
+        if name not in _SKIPPABLE:   # env-configured before registration
+            raise ValueError(
+                f"failpoint {name!r} does not honor the skip action")
+        return "skip"
+    raise _resolve_exc(arg)(f"failpoint {name!r} injected error")
+
+
+_env_spec = os.environ.get("PADDLE_FAILPOINTS", "")
+if _env_spec:
+    configure(_env_spec)

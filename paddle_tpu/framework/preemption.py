@@ -1,0 +1,114 @@
+"""Preemption-aware shutdown (reference: the elastic manager's SIGTERM
+handling in python/paddle/distributed/fleet/elastic/manager.py, adapted to
+preemptible TPU fleets where eviction notice arrives as SIGTERM).
+
+Contract between trainer and launcher:
+
+1. The trainer installs :func:`install` (``hapi.Model.fit`` does this on
+   entry).  SIGTERM only sets a flag — no work happens in signal context.
+2. The training loop polls :func:`preempted` between steps.  When set, it
+   writes a final checkpoint and raises :class:`PreemptedExit`, a
+   ``SystemExit`` carrying :data:`PREEMPTED_EXIT_CODE`.
+3. The launcher treats a worker exiting with :data:`PREEMPTED_EXIT_CODE`
+   as *restart-with-resume*: relaunch (checkpoint resume is the trainer
+   script's job via ``load_state_dict``/``latest_checkpoint``) without
+   charging the crash-restart budget.
+
+Code 71 was chosen clear of the shells' 126+ range and sysexits' EX_OSERR
+is acceptable to shadow — any unique value works as long as trainer and
+launcher agree, and both sides import it from here.
+"""
+import signal
+import threading
+
+__all__ = ["PREEMPTED_EXIT_CODE", "PreemptedExit", "install", "uninstall",
+           "preempted", "request", "reset", "exit_if_preempted"]
+
+PREEMPTED_EXIT_CODE = 71
+
+_flag = threading.Event()
+_installed = False
+_prev_handler = None
+_prev_disposition = None
+
+
+class PreemptedExit(SystemExit):
+    """SystemExit with the preemption exit code: the launcher's signal to
+    relaunch this worker pointed at its latest checkpoint."""
+
+    def __init__(self, msg=None):
+        super().__init__(PREEMPTED_EXIT_CODE)
+        self.msg = msg or "preempted (SIGTERM): emergency checkpoint saved"
+
+
+def _on_sigterm(signum, frame):
+    _flag.set()
+    # chain a pre-existing python-level handler (e.g. the launcher's own)
+    if callable(_prev_handler):
+        _prev_handler(signum, frame)
+
+
+def install():
+    """Install the SIGTERM flag-setter.  Idempotent; a no-op off the main
+    thread (signal.signal would raise) and on platforms without SIGTERM.
+    Returns True only for the call that actually installed — that caller
+    owns the matching :func:`uninstall`."""
+    global _installed, _prev_handler, _prev_disposition
+    if _installed or threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError, AttributeError):
+        return False
+    _prev_disposition = prev
+    if prev not in (signal.SIG_DFL, signal.SIG_IGN, _on_sigterm):
+        _prev_handler = prev
+    _installed = True
+    return True
+
+
+def uninstall():
+    """Restore the pre-:func:`install` SIGTERM disposition.  Without
+    this, a process that has left its training loop would swallow
+    SIGTERM into a flag nobody polls — the launcher's terminate() would
+    burn its full grace period and escalate to SIGKILL.  No-op if our
+    handler is no longer the installed one (the app replaced it)."""
+    global _installed, _prev_handler, _prev_disposition
+    if not _installed or \
+            threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        if signal.getsignal(signal.SIGTERM) is _on_sigterm:
+            signal.signal(signal.SIGTERM,
+                          _prev_disposition if _prev_disposition
+                          is not None else signal.SIG_DFL)
+    except (ValueError, OSError, AttributeError):
+        return False
+    _installed = False
+    _prev_handler = None
+    _prev_disposition = None
+    return True
+
+
+def preempted():
+    """True once SIGTERM has been received (or :func:`request` called)."""
+    return _flag.is_set()
+
+
+def request():
+    """Set the preemption flag programmatically (tests, cluster agents
+    with out-of-band eviction notice)."""
+    _flag.set()
+
+
+def reset():
+    """Clear the flag (tests; a relaunched worker starts clean anyway)."""
+    _flag.clear()
+
+
+def exit_if_preempted(msg=None):
+    """Raise :class:`PreemptedExit` if the flag is set — for custom
+    training loops that want the one-liner."""
+    if _flag.is_set():
+        raise PreemptedExit(msg)

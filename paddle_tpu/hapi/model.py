@@ -8,8 +8,10 @@ step (fwd+bwd+optimizer) is a single XLA executable; the reference needed
 the static-graph adapter + fused optimizer kernels to get this.  Eager
 (per-op) execution is kept as a debug mode (``Model.prepare(jit=False)``).
 """
+import json
 import os
 import time
+import zlib
 
 import numpy as np
 import jax
@@ -17,6 +19,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework import autograd as _ag
+from ..framework import preemption as _preemption
 from ..framework.random import rng_scope, next_key
 from ..framework.io import save as _save, load as _load
 from ..metric import Metric
@@ -26,6 +29,21 @@ from ..io import DataLoader, Dataset, DistributedBatchSampler
 from . import callbacks as cbks_mod
 
 __all__ = ["Model"]
+
+
+def _file_stamp(path):
+    """Content identity [size, crc32] for the emergency-checkpoint
+    COMMITTED sentinel — survives copy/rsync, unlike mtimes."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            size += len(chunk)
+            crc = zlib.crc32(chunk, crc)
+    return [size, crc]
 
 
 def _to_jnp(x):
@@ -477,6 +495,25 @@ class Model:
             metrics=["loss"] + self._metric_names())
         cbks.on_begin("train")
         self.stop_training = False
+        # preemption-aware: SIGTERM sets a flag we poll between steps so a
+        # preempted worker exits through one final checkpoint, and the
+        # launcher relaunches it with resume (framework/preemption.py).
+        # The previous disposition is restored on exit — a process that
+        # has left fit() must die normally on SIGTERM, not swallow it
+        # into a flag nobody polls.
+        _preempt_installed = _preemption.install()
+        try:
+            self._fit_epochs(epochs, eval_freq, save_dir, cbks,
+                             train_loader, eval_loader, num_iters,
+                             accumulate_grad_batches, batch_size)
+        finally:
+            if _preempt_installed:
+                _preemption.uninstall()
+
+    def _fit_epochs(self, epochs, eval_freq, save_dir, cbks, train_loader,
+                    eval_loader, num_iters, accumulate_grad_batches,
+                    batch_size):
+        logs = {}            # bound even when epochs == 0
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             self._reset_metrics()
@@ -496,6 +533,10 @@ class Model:
                     ins[0].shape[0] if ins and hasattr(ins[0], "shape")
                     else batch_size)
                 cbks.on_batch_end("train", step, logs)
+                if _preemption.preempted():
+                    self._emergency_save(save_dir, epoch, step)
+                    cbks.on_end("train", logs)
+                    raise _preemption.PreemptedExit()
                 if self.stop_training:
                     break
             if eval_loader is not None and \
@@ -503,6 +544,13 @@ class Model:
                 eval_logs = self._run_eval(eval_loader, cbks)
                 logs.update({"eval_" + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
+            # SIGTERM during the eval pass or at the epoch boundary must
+            # not wait for the next train batch to be honored — the
+            # platform's kill grace may lapse first
+            if _preemption.preempted():
+                self._emergency_save(save_dir, epoch, step="epoch-end")
+                cbks.on_end("train", logs)
+                raise _preemption.PreemptedExit()
             if self.stop_training:
                 break
         cbks.on_end("train", logs)
@@ -527,6 +575,8 @@ class Model:
         for step, batch in enumerate(loader):
             if num_iters is not None and step >= num_iters:
                 break
+            if _preemption.preempted():
+                break    # cut eval short; fit's epoch loop handles exit
             cbks.on_batch_begin("eval", step, logs)
             ins, labs = self._split_batch(batch)
             res = self.eval_batch(ins, labs)
@@ -605,6 +655,66 @@ class Model:
                               drop_last=drop_last, num_workers=num_workers)
         return data  # assume iterable of batches
 
+    def _emergency_save(self, save_dir, epoch, step):
+        """Final checkpoint on preemption: params + optimizer state under
+        ``save_dir/preempted`` (the resume target for the relaunched
+        worker).  Failures are logged, not raised — exiting with the
+        preemption code matters more than a perfect save."""
+        if not save_dir:
+            return
+        try:
+            # Deliberately NOT built on distributed/checkpoint's step-dir
+            # protocol: hapi checkpoints are pickles of the full
+            # state_dict (optimizer hyperstate and all, the .pdparams
+            # format Model.load speaks), while that module stores flat
+            # array trees with sharding metadata — bridging the two here
+            # would couple the emergency path to reshard semantics it
+            # doesn't need.  The commit IDEA is the same, though: each
+            # save writes a FRESH generation-suffixed pair
+            # (preempted.g<ns>.pdparams/.pdopt), then atomically swaps
+            # the COMMITTED sentinel to point at it.  The sentinel swap
+            # is the single commit point, so the previous pair stays
+            # valid through the entire window — a kill at any moment
+            # leaves either the old checkpoint (sentinel untouched) or
+            # the new one (sentinel swapped); never nothing.  Old
+            # generations are swept only after the swap.  Resume via
+            # ``Model.load(save_dir + "/preempted")``, which follows the
+            # sentinel; scripts should key on ``preempted.COMMITTED``.
+            base = os.path.join(save_dir, "preempted")
+            # the pid lands in the generation token so co-located workers
+            # sharing one save_dir can never sweep each other's pair out
+            # from under the (last-writer-wins) sentinel
+            gen = f"{time.time_ns()}p{os.getpid()}"
+            gbase = f"{base}.g{gen}"
+            self.save(gbase)
+            exts = [ext for ext in (".pdopt", ".pdparams")
+                    if os.path.exists(gbase + ext)]
+            # content identity (size + CRC32), not mtime: a checkpoint
+            # rsync'd/staged to the replacement node must still validate
+            stamp = {"gen": gen,
+                     "files": {ext: _file_stamp(gbase + ext)
+                               for ext in exts}}
+            with open(base + ".COMMITTED.tmp", "w") as f:
+                json.dump(stamp, f)
+            os.replace(base + ".COMMITTED.tmp", base + ".COMMITTED")
+            # sweep THIS process's older generations only — other
+            # workers' files may be what the final sentinel points at
+            mine = f"p{os.getpid()}"
+            for fn in os.listdir(save_dir):
+                if fn.startswith("preempted.g") and \
+                        not fn.startswith(f"preempted.g{gen}"):
+                    token = fn[len("preempted.g"):].split(".", 1)[0]
+                    if token.endswith(mine):
+                        try:
+                            os.remove(os.path.join(save_dir, fn))
+                        except OSError:
+                            pass
+            print(f"[hapi] preempted at epoch {epoch} step {step}: "
+                  f"emergency checkpoint saved to {gbase}", flush=True)
+        except Exception as e:
+            print(f"[hapi] preempted but emergency save failed: {e!r}",
+                  flush=True)
+
     # -- persistence --------------------------------------------------------
     def save(self, path, training=True):
         if training:
@@ -618,6 +728,25 @@ class Model:
             _jit.save(self.network, path, input_spec=specs)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        # an emergency save commits via a COMMITTED sentinel naming a
+        # generation-suffixed pair and recording its content identity;
+        # loading ``<save_dir>/preempted`` follows the sentinel.  A pair
+        # that contradicts it (corrupted or half-staged copy) fails
+        # loudly rather than resuming mismatched params/optimizer state.
+        sentinel = path + ".COMMITTED"
+        if os.path.exists(sentinel):
+            with open(sentinel) as f:
+                stamp = json.load(f)
+            real = f"{path}.g{stamp['gen']}" if "gen" in stamp else path
+            for ext, want in stamp.get("files", {}).items():
+                p = real + ext
+                if not os.path.exists(p) or _file_stamp(p) != want:
+                    raise RuntimeError(
+                        f"torn emergency checkpoint at {path}: {p} does "
+                        "not match its COMMITTED sentinel — the files "
+                        "were corrupted or half-staged; fall back to an "
+                        "older checkpoint")
+            path = real
         sd = _load(path + ".pdparams")
         self.network.set_state_dict(sd)
         opt_path = path + ".pdopt"

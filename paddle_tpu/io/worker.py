@@ -24,10 +24,17 @@ import traceback
 
 import numpy as np
 
+from ..framework import failpoints as _fp
 from .blocking_queue import BlockingQueue
 from . import shm as _shm
 
 __all__ = ["MultiProcessIter", "IterableMultiProcessIter"]
+
+# failpoint site fired once per produced batch inside the fork'd worker
+# (workers inherit the parent's armed failpoints through fork); an
+# ``error`` action surfaces through the normal _WorkerError path, which
+# is exactly the machinery chaos tests want to exercise
+_FP_WORKER = _fp.register("dataloader.worker_loop")
 
 # arrays under this many bytes ride the pickle pipe; larger batches go
 # through the csrc shm transport (reference: use_shared_memory default)
@@ -91,6 +98,8 @@ def _worker_loop(dataset, index_queue, result_queue, worker_id, num_workers,
             return
         batch_idx, indices = item
         try:
+            if _fp._ACTIVE:
+                _fp.fire(_FP_WORKER)
             samples = [_to_numpy(dataset[i]) for i in indices]
             payload = _pack_payload(samples, shm_tag)
             blob = pickle.dumps((batch_idx, payload), protocol=4)
@@ -134,6 +143,8 @@ def _iterable_worker_loop(dataset, token_queue, result_queue, worker_id,
         if token_queue.get() is None:
             return
         try:
+            if _fp._ACTIVE:
+                _fp.fire(_FP_WORKER)
             samples = next(batches, None)
             if samples is None:
                 _report(seq, _IterEnd())

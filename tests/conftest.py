@@ -24,3 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 
 # CPU matmuls default to a bf16-ish fast path; tests compare against numpy
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_configure(config):
+    # registered here (no pytest.ini): `chaos` = failpoint-driven
+    # fault-injection tests — fast ones run in tier-1 (`-m 'not slow'`);
+    # anything over ~5s must ALSO carry `slow` to stay out of tier-1
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection test driven by failpoints")
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1")

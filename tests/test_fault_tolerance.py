@@ -1,0 +1,470 @@
+"""Chaos suite: failpoint-driven fault injection across store, checkpoint,
+elastic, dataloader and the preemption path (ISSUE 1 tentpole harness).
+
+Each scenario injects a deterministic fault (framework/failpoints.py) and
+asserts the system ends in a correct resume: store ops survive connection
+flaps, checkpoint restore falls back past torn/corrupt steps to the
+newest valid one with bitwise-identical params, and a SIGTERM mid-fit
+exits through an emergency save that a fresh model resumes from.
+"""
+import os
+import signal
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework import failpoints, preemption
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus)
+from paddle_tpu.hapi import callbacks as cbks_mod
+from paddle_tpu.static import InputSpec
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    preemption.reset()
+    yield
+    failpoints.clear()
+    preemption.reset()
+
+
+# -- failpoint registry ---------------------------------------------------
+
+class TestFailpoints:
+    def test_parse_spec_roundtrip(self):
+        spec = "store.get=error*2;ckpt.write_shard=delay:0.5"
+        parsed = failpoints.parse_spec(spec)
+        assert parsed["store.get"] == ("error", None, 2)
+        assert parsed["ckpt.write_shard"] == ("delay", 0.5, None)
+
+    def test_configure_and_drain(self):
+        failpoints.configure("store.get=error*2")
+        with pytest.raises(ConnectionError):
+            failpoints.fire("store.get")
+        with pytest.raises(ConnectionError):
+            failpoints.fire("store.get")
+        assert failpoints.fire("store.get") is None   # drained
+        assert "store.get" not in failpoints.active()
+
+    def test_error_class_override(self):
+        failpoints.set_failpoint("store.get", "error:KeyError*1")
+        with pytest.raises(KeyError):
+            failpoints.fire("store.get")
+
+    def test_skip_action(self):
+        failpoints.set_failpoint("ckpt.commit_sentinel", "skip")
+        assert failpoints.fire("ckpt.commit_sentinel") == "skip"
+
+    def test_skip_rejected_on_non_skippable_site(self):
+        # store.set ignores fire()'s return value: arming skip there
+        # would silently test nothing, so the registry refuses it
+        with pytest.raises(ValueError, match="skip"):
+            failpoints.set_failpoint("store.set", "skip")
+
+    def test_delay_action(self):
+        failpoints.set_failpoint("store.set", "delay:0.05*1")
+        t0 = time.monotonic()
+        assert failpoints.fire("store.set") is None
+        assert time.monotonic() - t0 >= 0.05
+        assert failpoints.fire("store.set") is None   # drained: no delay
+
+    def test_unset_is_inert_dict(self):
+        # the zero-cost guard contract: hook sites gate on _ACTIVE truthiness
+        assert not failpoints._ACTIVE
+        failpoints.set_failpoint("store.get", "error")
+        assert failpoints._ACTIVE
+        failpoints.clear("store.get")
+        assert not failpoints._ACTIVE
+
+    def test_malformed_specs_rejected(self):
+        with pytest.raises(ValueError):
+            failpoints.parse_spec("store.get")          # no '='
+        with pytest.raises(ValueError):
+            failpoints.parse_spec("store.get=explode")  # unknown action
+        with pytest.raises(ValueError):
+            failpoints.set_failpoint("store.get", "error*0")
+
+
+# -- store resilience -----------------------------------------------------
+
+class TestStoreResilience:
+    def test_connect_refused_thrice_then_success(self):
+        # acceptance (a): connection refused x3, then the backoff loop wins
+        master = TCPStore("127.0.0.1", 0, is_master=True, use_native=False)
+        try:
+            failpoints.set_failpoint("store.connect", "error*3")
+            client = TCPStore(master.host, master.port, use_native=False,
+                              timeout=10.0)
+            client.set("k", b"v")
+            assert client.get("k") == b"v"
+            assert "store.connect" not in failpoints.active()  # all 3 burned
+            client.close()
+        finally:
+            master.close()
+
+    def test_per_request_retry_via_io_failpoint(self):
+        # store.io fires INSIDE the retry envelope: two injected I/O
+        # faults are reconnected-through and the op still succeeds
+        master = TCPStore("127.0.0.1", 0, is_master=True, use_native=False)
+        try:
+            client = TCPStore(master.host, master.port, use_native=False,
+                              timeout=10.0)
+            failpoints.set_failpoint("store.io", "error*2")
+            client.set("k", b"v")                  # retried under the hood
+            assert client.get("k") == b"v"
+            assert "store.io" not in failpoints.active()
+            client.close()
+        finally:
+            master.close()
+
+    def test_connect_gives_up_at_deadline(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, use_native=False)
+        try:
+            failpoints.set_failpoint("store.connect", "error")  # forever
+            with pytest.raises(TimeoutError):
+                TCPStore(master.host, master.port, use_native=False,
+                         timeout=0.5)
+        finally:
+            master.close()
+
+    def test_store_flap_during_elastic_watch(self):
+        # acceptance: store flaps during elastic watch — the node must not
+        # lose its own membership (local knowledge) nor evict live peers
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m = ElasticManager(np="1:3", store=store, heartbeat_interval=0.2,
+                           job_id="flap")
+        try:
+            m.start("host1:6170")
+            assert m.watch() == ElasticStatus.NORMAL
+            failpoints.set_failpoint("store.get", "error*2")
+            assert m.watch() == ElasticStatus.NORMAL   # flap 1: self kept
+            assert m.watch() == ElasticStatus.NORMAL   # flap 2
+            assert "store.get" not in failpoints.active()
+            assert m.watch() == ElasticStatus.NORMAL   # store healthy again
+            assert m.endpoints() == ["host1:6170"]
+        finally:
+            m.stop()
+            store.close()
+
+
+# -- checkpoint integrity + last-good resume ------------------------------
+
+def _sd(seed):
+    rng = np.random.RandomState(seed)
+    return {"linear": {"w": jnp.asarray(rng.randn(8, 4).astype("float32"))},
+            "b": jnp.asarray(rng.randn(4).astype("float32"))}
+
+
+def _assert_restored(out, sd):
+    np.testing.assert_array_equal(np.asarray(out["linear.w"]),
+                                  np.asarray(sd["linear"]["w"]))
+    np.testing.assert_array_equal(np.asarray(out["b"]),
+                                  np.asarray(sd["b"]))
+
+
+def _corrupt_one_shard(step_dir):
+    """Flip bytes near the end of one shard file (payload, not header)."""
+    for dirpath, _, files in os.walk(step_dir):
+        for fn in files:
+            if fn.endswith(".npy"):
+                path = os.path.join(dirpath, fn)
+                with open(path, "r+b") as f:
+                    f.seek(-4, os.SEEK_END)
+                    old = f.read(4)
+                    f.seek(-4, os.SEEK_END)
+                    f.write(bytes(b ^ 0xFF for b in old))
+                return path
+    raise AssertionError(f"no shard file under {step_dir}")
+
+
+class TestCheckpointIntegrity:
+    def test_commit_protocol_and_latest(self, tmp_path):
+        root = str(tmp_path)
+        sd1, sd2 = _sd(1), _sd(2)
+        ckpt.save_checkpoint(sd1, root, step=1)
+        p2 = ckpt.save_checkpoint(sd2, root, step=2)
+        assert os.path.exists(os.path.join(p2, "COMMITTED"))
+        assert ckpt.latest_checkpoint(root) == p2
+        _assert_restored(ckpt.load_state_dict(root), sd2)
+
+    def test_corrupt_shard_falls_back_to_last_good(self, tmp_path):
+        # acceptance (b): one corrupt shard CRC → resume from step 1 with
+        # bitwise-identical params
+        root = str(tmp_path)
+        sd1, sd2 = _sd(1), _sd(2)
+        ckpt.save_checkpoint(sd1, root, step=1)
+        p2 = ckpt.save_checkpoint(sd2, root, step=2)
+        _corrupt_one_shard(p2)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_state_dict(p2)          # direct load: loud failure
+        _assert_restored(ckpt.load_state_dict(root), sd1)  # root: fallback
+
+    def test_missing_sentinel_falls_back(self, tmp_path):
+        # acceptance (c): writer killed between shard write and sentinel —
+        # the torn step is invisible to resume
+        root = str(tmp_path)
+        sd1, sd2 = _sd(1), _sd(2)
+        p1 = ckpt.save_checkpoint(sd1, root, step=1)
+        failpoints.set_failpoint("ckpt.commit_sentinel", "skip")
+        p2 = ckpt.save_checkpoint(sd2, root, step=2)
+        assert not os.path.exists(os.path.join(p2, "COMMITTED"))
+        assert ckpt.latest_checkpoint(root) == p1
+        _assert_restored(ckpt.load_state_dict(root), sd1)
+
+    def test_crash_during_commit_write(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(_sd(1), root, step=1)
+        failpoints.set_failpoint("ckpt.commit_sentinel", "error")
+        with pytest.raises(ConnectionError):
+            ckpt.save_checkpoint(_sd(2), root, step=2)
+        _assert_restored(ckpt.load_state_dict(root), _sd(1))
+
+    def test_nothing_committed_is_loud(self, tmp_path):
+        root = str(tmp_path)
+        failpoints.set_failpoint("ckpt.commit_sentinel", "skip")
+        ckpt.save_checkpoint(_sd(1), root, step=1)
+        with pytest.raises(FileNotFoundError):
+            ckpt.load_state_dict(root)
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        root = str(tmp_path)
+        for step in range(1, 6):
+            ckpt.save_checkpoint(_sd(step), root, step=step, keep_last=2)
+        kept = sorted(d for d in os.listdir(root) if d.startswith("step_"))
+        assert kept == ["step_00000004", "step_00000005"]
+
+    def test_retention_sweeps_old_torn_dirs(self, tmp_path):
+        root = str(tmp_path)
+        failpoints.set_failpoint("ckpt.commit_sentinel", "skip*1")
+        ckpt.save_checkpoint(_sd(1), root, step=1)     # torn
+        ckpt.save_checkpoint(_sd(2), root, step=2, keep_last=2)
+        kept = sorted(d for d in os.listdir(root) if d.startswith("step_"))
+        assert kept == ["step_00000002"]               # torn debris swept
+
+    def test_shard_write_failure_async_surfaces(self, tmp_path):
+        # satellite: AsyncSaveHandle must not swallow writer exceptions
+        root = str(tmp_path / "c")
+        failpoints.set_failpoint("ckpt.write_shard", "error")
+        h = ckpt.save_state_dict(_sd(1), root, async_save=True)
+        deadline = time.monotonic() + 10
+        while not h.done() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.done() and h.failed
+        with pytest.raises(ConnectionError):
+            h.wait()
+
+    def test_unwaited_failed_handle_drained_at_exit(self, tmp_path, caplog):
+        failpoints.set_failpoint("ckpt.write_shard", "error")
+        h = ckpt.save_state_dict(_sd(1), str(tmp_path / "c"),
+                                 async_save=True)
+        h._thread.join(5)
+        with caplog.at_level("WARNING", logger="paddle_tpu.checkpoint"):
+            ckpt._drain_pending_handles()     # what atexit runs
+        assert any("wait() was never called" in r.message
+                   for r in caplog.records)
+        assert h not in ckpt._pending_handles
+
+    def test_crc_verification_can_be_disabled(self, tmp_path, monkeypatch):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(_sd(2), root, step=2)
+        _corrupt_one_shard(ckpt.latest_checkpoint(root))
+        monkeypatch.setenv("PADDLE_CKPT_VERIFY", "0")
+        out = ckpt.load_state_dict(ckpt.latest_checkpoint(root))
+        assert "linear.w" in out              # loads, garbage and all
+
+
+# -- elastic hygiene ------------------------------------------------------
+
+class TestElasticHygiene:
+    def _mgr(self, store, **kw):
+        kw.setdefault("heartbeat_interval", 0.1)
+        kw.setdefault("job_id", "hyg")
+        return ElasticManager(np="1:3", store=store, **kw)
+
+    def test_stop_joins_heartbeat_before_tombstone(self):
+        # satellite: a dying node's stale beat must not race its tombstone
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m = self._mgr(store)
+        try:
+            m.start("host1:6170")
+            t = m._hb_thread
+            m.stop()
+            assert not t.is_alive()
+            import json
+            rec = json.loads(store.get(m._k("node", "0")).decode())
+            assert rec["alive"] is False
+        finally:
+            store.close()
+
+    def test_heartbeat_survives_store_flap(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m = self._mgr(store)
+        try:
+            m.start("host1:6170")
+            failpoints.set_failpoint("elastic.heartbeat", "error*2")
+            time.sleep(0.5)                    # several beat intervals
+            assert m._hb_thread.is_alive()     # flap tolerated
+            assert m.watch() == ElasticStatus.NORMAL
+        finally:
+            m.stop()
+            store.close()
+
+    def test_wait_for_np_reports_observed_count(self):
+        # satellite: timeout result carries the member count (falsy)
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m = ElasticManager(np="3:4", store=store, heartbeat_interval=0.1,
+                           job_id="cnt")
+        try:
+            m.start("host1:6170")
+            res = m.wait_for_np(timeout=0.4)
+            assert not res                     # quorum of 3 not reached
+            assert int(res) == 1               # ...but one node was seen
+        finally:
+            m.stop()
+            store.close()
+
+    def test_wait_for_np_interrupted_by_stop(self):
+        # satellite: shutdown during quorum-wait is prompt (event, not sleep)
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m = ElasticManager(np="3:4", store=store, heartbeat_interval=2.0,
+                           job_id="stp")
+        try:
+            m.start("host1:6170")
+            done = threading.Event()
+            out = []
+
+            def waiter():
+                out.append(m.wait_for_np(timeout=30.0))
+                done.set()
+
+            threading.Thread(target=waiter, daemon=True).start()
+            time.sleep(0.2)
+            m.stop()
+            assert done.wait(3.0), "wait_for_np did not exit promptly"
+            assert not out[0]
+        finally:
+            store.close()
+
+
+# -- dataloader worker failpoint ------------------------------------------
+
+class TestDataloaderChaos:
+    def test_worker_failpoint_surfaces_as_loader_error(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.float32(i)
+
+            def __len__(self):
+                return 16
+
+        failpoints.set_failpoint("dataloader.worker_loop", "error")
+        loader = DataLoader(DS(), batch_size=4, num_workers=2)
+        with pytest.raises(RuntimeError, match="failpoint"):
+            list(loader)
+
+
+# -- preemption: SIGTERM mid-fit → emergency save → resume ----------------
+
+def _reg_model():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle.Model(net, inputs=[InputSpec([None, 4], "float32", "x")],
+                         labels=[InputSpec([None, 2], "float32", "y")])
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.MSELoss())
+    return model
+
+
+def _batches(n=64):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(8, 4).astype("float32"),
+             rng.randn(8, 2).astype("float32")) for _ in range(n)]
+
+
+class _SigtermAt(cbks_mod.Callback):
+    def __init__(self, at_step):
+        super().__init__()
+        self.at_step = at_step
+
+    def on_train_batch_end(self, step, logs=None):
+        if step == self.at_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+class TestPreemption:
+    def test_sigterm_mid_fit_saves_and_resumes(self, tmp_path):
+        # acceptance (d): SIGTERM mid-fit → emergency checkpoint + the
+        # restart-with-resume exit code; a fresh model restores bitwise
+        save_dir = str(tmp_path)
+        paddle.seed(3)
+        model = _reg_model()
+        with pytest.raises(SystemExit) as exc_info:
+            model.fit(_batches(), epochs=4, save_dir=save_dir, verbose=0,
+                      callbacks=[_SigtermAt(at_step=2)])
+        assert exc_info.value.code == preemption.PREEMPTED_EXIT_CODE
+        # the atomically-swapped sentinel is the resume script's signal
+        assert os.path.exists(os.path.join(save_dir,
+                                           "preempted.COMMITTED"))
+
+        at_exit = {k: np.asarray(v._value)
+                   for k, v in model.network.state_dict().items()}
+        paddle.seed(4)                         # different init on purpose
+        resumed = _reg_model()
+        resumed.load(os.path.join(save_dir, "preempted"))
+        for k, v in resumed.network.state_dict().items():
+            np.testing.assert_array_equal(np.asarray(v._value), at_exit[k])
+
+    def test_programmatic_preemption_request(self, tmp_path):
+        # cluster agents with out-of-band notice use request() directly
+        model = _reg_model()
+        preemption.request()
+        with pytest.raises(SystemExit) as exc_info:
+            model.fit(_batches(8), epochs=1, save_dir=str(tmp_path),
+                      verbose=0)
+        assert exc_info.value.code == preemption.PREEMPTED_EXIT_CODE
+
+    def test_torn_emergency_pair_detected(self, tmp_path):
+        # a pair contradicting its COMMITTED sentinel (saver killed
+        # between the two renames) must fail loudly, not resume params
+        # with mismatched optimizer moments
+        model = _reg_model()
+        preemption.request()
+        with pytest.raises(SystemExit):
+            model.fit(_batches(8), epochs=1, save_dir=str(tmp_path),
+                      verbose=0)
+        base = os.path.join(str(tmp_path), "preempted")
+        opt_files = [f for f in os.listdir(str(tmp_path))
+                     if f.startswith("preempted.g") and
+                     f.endswith(".pdopt")]
+        assert len(opt_files) == 1
+        with open(os.path.join(str(tmp_path), opt_files[0]),
+                  "r+b") as f:                    # simulate a torn pair
+            f.seek(-1, os.SEEK_END)
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+        fresh = _reg_model()
+        with pytest.raises(RuntimeError, match="torn"):
+            fresh.load(base)
+
+    def test_exit_code_contract_with_launcher(self):
+        # trainer and launcher must agree on the restart-with-resume code
+        import importlib
+        launch_main = importlib.import_module(
+            "paddle_tpu.distributed.launch.main")
+        assert launch_main.PREEMPTED_EXIT_CODE == \
+            preemption.PREEMPTED_EXIT_CODE
+        assert preemption.PREEMPTED_EXIT_CODE not in (0, 1)

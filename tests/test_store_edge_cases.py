@@ -1,0 +1,112 @@
+"""TCPStore edge cases the retry/backoff work makes reachable: server
+death mid-WAIT, ADD on non-integer bytes, reconnect across a server
+restart (ISSUE 1 satellite).
+
+All tests force the pure-Python client (``use_native=False``) — the
+retry/reconnect machinery under test lives there; the native C++ client
+keeps its own behavior.
+"""
+import struct
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, _PyStoreServer
+
+
+def _master():
+    return TCPStore("127.0.0.1", 0, is_master=True, use_native=False)
+
+
+class TestWaitEdges:
+    def test_wait_expiry_is_clear_timeout(self):
+        store = _master()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError, match="expired"):
+                store.wait("never-set", timeout=0.3)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            store.close()
+
+    def test_server_stopped_mid_wait(self):
+        master = _master()
+        client = TCPStore(master.host, master.port, use_native=False,
+                          timeout=2.0)
+        errs = []
+        done = threading.Event()
+
+        def waiter():
+            try:
+                client.wait("never-set", timeout=3.0)
+            except (TimeoutError, ConnectionError) as e:
+                errs.append(e)
+            done.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        time.sleep(0.3)             # let the WAIT park server-side
+        master.close()              # server dies under the parked WAIT
+        # the client must surface a clear error within its retry budget
+        # (store timeout 2s + op timeout 3s): either the server's parting
+        # status byte (TimeoutError) or reconnect exhaustion
+        assert done.wait(10.0), "client hung after server death mid-WAIT"
+        assert len(errs) == 1
+        client.close()
+
+
+class TestAddEdges:
+    def test_add_on_non_integer_value_starts_from_zero(self):
+        store = _master()
+        try:
+            store.set("k", b"not-an-int64")     # len != 8: counter resets
+            assert store.add("k", 5) == 5
+            # and the key now holds a proper little-endian int64
+            assert struct.unpack("<q", store.get("k"))[0] == 5
+            assert store.add("k", 2) == 7
+        finally:
+            store.close()
+
+    def test_add_on_eight_stray_bytes_reinterprets(self):
+        store = _master()
+        try:
+            store.set("k", struct.pack("<q", 40))
+            assert store.add("k", 2) == 42      # SET then ADD interoperate
+        finally:
+            store.close()
+
+
+class TestReconnect:
+    def test_client_survives_server_restart(self):
+        srv1 = _PyStoreServer(0)
+        port = srv1.port
+        client = TCPStore("127.0.0.1", port, use_native=False, timeout=10.0)
+        client.set("before", b"1")
+        srv1.stop()
+        # restart on the SAME port (new empty KV — a real master restart)
+        srv2 = None
+        deadline = time.monotonic() + 5.0
+        while srv2 is None:
+            try:
+                srv2 = _PyStoreServer(port)
+            except OSError:         # TIME_WAIT straggler
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        try:
+            client.set("after", b"2")           # reconnects under the hood
+            assert client.get("after") == b"2"
+            with pytest.raises(KeyError):
+                client.get("before", timeout=0.2)   # state did NOT survive
+        finally:
+            client.close()
+            srv2.stop()
+
+    def test_ops_fail_cleanly_while_server_down(self):
+        srv = _PyStoreServer(0)
+        client = TCPStore("127.0.0.1", srv.port, use_native=False,
+                          timeout=0.5)
+        srv.stop()
+        with pytest.raises((ConnectionError, TimeoutError)):
+            client.set("k", b"v")
+        client.close()
