@@ -143,6 +143,10 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        # data-parallel group whose ranks must agree on found_inf (set by
+        # DP wrappers / users); None = local verdict (world of 1, or
+        # GSPMD where grads are already global arrays)
+        self._dp_group = None
 
     def scale(self, var):
         if not self._enable:
@@ -150,19 +154,33 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
+        """Unscale grads and compute ``found_inf`` with ONE fused
+        device-side finite-check over the whole grad tree and ONE host
+        sync — never a per-param ``bool(jnp.all(...))`` loop.  The
+        verdict is all-reduced (AND) across ``_dp_group``'s ranks so
+        every data-parallel replica skips in lockstep rather than
+        deadlocking/diverging on a locally-NaN grad."""
         if not self._enable:
             return
+        from ..framework import guardian as _guardian
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
-        found = False
+        grads = []
         for p in params:
             if p._grad is not None:
-                g = p._grad * inv
-                finite = bool(jnp.all(jnp.isfinite(g)))
-                if not finite:
-                    found = True
-                p._grad = g
-        self._found_inf = found
+                p._grad = p._grad * inv
+                grads.append(p._grad)
+        if grads:
+            finite = _guardian.tree_all_finite(grads)
+            finite = _guardian.all_reduce_finite(finite, self._dp_group)
+            self._found_inf = not _guardian._host_bool(finite)
+        else:
+            self._found_inf = False
+        if _guardian._SENTINEL is not None:
+            # hand the verdict to the guardian sentinel so the paired
+            # Optimizer.step does not re-check the same grads (one host
+            # sync per step even with both active)
+            _guardian._SENTINEL.note_verdict(not self._found_inf)
         self._unscaled = True
 
     def step(self, optimizer):
@@ -243,18 +261,33 @@ class debugging:
     def check_numerics(tensor, op_type="", var_name="",
                        debug_mode=None):
         """NaN/Inf check on a tensor; raises on hit (the reference's
-        check_numerics op semantics)."""
-        import jax.numpy as jnp
+        check_numerics op semantics).  Findings go through the guardian
+        log (event ``check_numerics``); the ``guardian.check_numerics``
+        failpoint (action ``skip`` = skip trusting the tensor) forces a
+        trip on clean data so chaos tests can drive this path
+        deterministically."""
+        from ..framework import failpoints as _fp
+        from ..framework import guardian as _guardian
         from ..framework.core import Tensor
         v = tensor._value if isinstance(tensor, Tensor) else tensor
-        import numpy as np
         arr = np.asarray(v)
+        if arr.dtype not in (np.float16, np.float32, np.float64):
+            # bf16/fp8: cast through f32 for numpy's isnan/isinf.  Never
+            # cast native numpy floats — finite f64 above f32-max must
+            # not be misreported as Inf.
+            arr = np.asarray(jnp.asarray(v).astype(jnp.float32))
         n_nan = int(np.isnan(arr).sum())
         n_inf = int(np.isinf(arr).sum())
-        if n_nan or n_inf:
+        forced = bool(_fp._ACTIVE and
+                      _fp.fire(_guardian.FP_CHECK_NUMERICS) == "skip")
+        if n_nan or n_inf or forced:
+            _guardian.emit("check_numerics", op_type=str(op_type),
+                           var_name=str(var_name), nan_count=n_nan,
+                           inf_count=n_inf, forced=forced)
             raise FloatingPointError(
                 f"check_numerics: {op_type}/{var_name}: {n_nan} NaN, "
-                f"{n_inf} Inf")
+                f"{n_inf} Inf" + (" (failpoint-forced trip)" if forced
+                                  else ""))
         return tensor
 
     @staticmethod

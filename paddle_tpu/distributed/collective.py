@@ -17,7 +17,13 @@ from jax import lax
 
 from ..framework.core import Tensor
 from ..framework.autograd import call_op
+from ..framework import failpoints as _fp
+from ..framework import guardian as _guardian
 from .env import get_world_size
+
+# failpoint inside the watchdog-guarded barrier body: `delay:T` with a
+# smaller barrier timeout simulates a straggler deterministically
+_FP_BARRIER = _fp.register("collective.barrier")
 
 
 class ReduceOp:
@@ -29,13 +35,17 @@ class ReduceOp:
 
 
 class Group:
-    """A communication group ≙ one or more mesh axis names."""
+    """A communication group ≙ one or more mesh axis names.  ``timeout``
+    (seconds) is the watchdog deadline for this group's blocking
+    host-level ops (``barrier``, value ``wait``); None = unmonitored."""
 
-    def __init__(self, axis_name=None, ranks=None, group_id=0):
+    def __init__(self, axis_name=None, ranks=None, group_id=0,
+                 timeout=None):
         self.axis_name = axis_name
         self.ranks = ranks or []
         self.id = group_id
         self.nranks = len(self.ranks) if self.ranks else None
+        self.timeout = timeout
 
     @property
     def world_size(self):
@@ -73,9 +83,13 @@ def _in_named_trace(axis):
 
 
 def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    # timeout lands on the Group (it used to be accepted and silently
+    # dropped) and is honored by the guardian watchdog in barrier()/wait()
+    if timeout is not None and hasattr(timeout, "total_seconds"):
+        timeout = timeout.total_seconds()    # datetime.timedelta compat
     _GROUP_COUNTER[0] += 1
     g = Group(axis_name=axis_name, ranks=ranks,
-              group_id=_GROUP_COUNTER[0])
+              group_id=_GROUP_COUNTER[0], timeout=timeout)
     _GROUPS[g.id] = g
     return g
 
@@ -108,6 +122,8 @@ def _apply(x, fn):
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("all_reduce", f"op={op} axis={_axis_of(group)}")
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         red = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
@@ -132,6 +148,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("all_gather", f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         out = _apply(tensor, lambda v: lax.all_gather(v, axis))
@@ -152,6 +170,9 @@ def all_gather_object(object_list, obj, group=None):
 
 def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True,
                            concat_axis=0):
+    if _guardian._TRACK:
+        _guardian.record_op("all_gather_into_tensor",
+                            f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         out = _apply(tensor, lambda v: lax.all_gather(
@@ -167,6 +188,8 @@ def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True,
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("reduce_scatter", f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
@@ -185,6 +208,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("alltoall", f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     from ..tensor.manipulation import stack
     x = stack(list(in_tensor_list), axis=0)
@@ -201,6 +226,8 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("alltoall_single", f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         out = _apply(in_tensor, lambda v: lax.all_to_all(
@@ -215,6 +242,8 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("broadcast", f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         # select src rank's shard everywhere via all_gather + index
@@ -227,6 +256,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _guardian._TRACK:
+        _guardian.record_op("scatter", f"axis={_axis_of(group)}")
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis) and tensor_list:
         from ..tensor.manipulation import stack
@@ -262,21 +293,49 @@ def ppermute(tensor, perm, group=None):
     return _apply(tensor, lambda v: lax.ppermute(v, axis, perm))
 
 
-def barrier(group=None):
-    # XLA programs are bulk-synchronous; an explicit barrier is only
-    # meaningful across processes.
-    import jax
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+def barrier(group=None, timeout=None):
+    """Cross-process barrier.  ``timeout`` (seconds; default: the
+    group's ``new_group(timeout=...)``) runs the wait under the guardian
+    watchdog — on expiry a ``watchdog_timeout`` guardian-log event dumps
+    the last-op-seen ring and a clear ``TimeoutError``
+    (:class:`guardian.CollectiveTimeout`) is raised instead of a silent
+    hang."""
+    if timeout is None and group is not None:
+        timeout = getattr(group, "timeout", None)
+
+    def _body():
+        # XLA programs are bulk-synchronous; an explicit barrier is only
+        # meaningful across processes.
+        if _fp._ACTIVE:
+            _fp.fire(_FP_BARRIER)
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+    if timeout is not None:
+        _guardian.run_with_deadline(_body, timeout, "barrier",
+                                    f"group={getattr(group, 'id', 0)}")
+        return
+    if _guardian._TRACK:
+        _guardian.record_op("barrier", f"group={getattr(group, 'id', 0)}")
+    _body()
 
 
-def wait(tensor, group=None, use_calc_stream=True):
+def wait(tensor, group=None, use_calc_stream=True, timeout=None):
+    if timeout is None and group is not None:
+        timeout = getattr(group, "timeout", None)
     if isinstance(tensor, Tensor):
-        try:
-            tensor._value.block_until_ready()
-        except Exception:
-            pass
+        def _body():
+            try:
+                tensor._value.block_until_ready()
+            except Exception:
+                pass
+        if timeout is not None:
+            _guardian.run_with_deadline(_body, timeout, "wait",
+                                        f"shape={tuple(tensor.shape)}")
+        else:
+            _body()
 
 
 class _Task:

@@ -58,6 +58,16 @@ class DistributedStrategy:
         self.asp = False
         self.qat = False
         self.qat_configs = {}
+        # training guardian (framework/guardian.py): numeric sentinel +
+        # skip-and-rollback ladder + collective watchdog.  Keys mirror
+        # GuardianConfig's constructor; Model.fit picks this up via
+        # GuardianConfig.from_strategy when fleet.init ran with it on.
+        self.guardian = False
+        self.guardian_configs = {"check_grads": True, "loss_spike": True,
+                                 "spike_zscore": 6.0, "spike_warmup": 20,
+                                 "skip_limit": 3, "skip_window": 2,
+                                 "max_rollbacks": 2, "ckpt_every": 50,
+                                 "ckpt_root": None}
 
     def to_dict(self):
         return {k: copy.deepcopy(v) for k, v in self.__dict__.items()}

@@ -1,5 +1,6 @@
 from . import dtypes  # noqa: F401
 from . import failpoints  # noqa: F401
+from . import guardian  # noqa: F401
 from . import preemption  # noqa: F401
 from .core import Tensor, to_tensor, set_device, get_device  # noqa: F401
 from . import autograd  # noqa: F401

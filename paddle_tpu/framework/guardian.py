@@ -1,0 +1,730 @@
+"""Training guardian: numeric anomaly sentinel, skip-and-rollback policy,
+collective watchdog (reference: FLAGS_check_nan_inf + the check_numerics
+op + paddle.amp.debugging, unified into ONE subsystem the way PR 1's
+failpoints unified the FLAGS_-gated fault hooks).
+
+PR 1 made the stack survive *infrastructure* failures; this module covers
+*numerical* ones — NaN/Inf blowups, loss spikes, hung collectives — which
+low-precision training makes routine rather than exceptional.  Four
+coordinated pieces:
+
+- **Numeric sentinel** — one fused device-side ``isfinite`` reduction per
+  tree (:func:`tree_all_finite`), never a per-param host sync; on trip,
+  per-tensor *attribution* (:func:`attribute_nonfinite`) reports which
+  tensor, which step and summary stats through the guardian log.
+- **Guardian log** — structured events (:data:`EVENT_SCHEMA`) kept in a
+  ring buffer (:func:`events`) and appended as JSONL to
+  ``PADDLE_GUARDIAN_LOG`` when set.  ``tools/check_guardian_log.py``
+  lints that events referenced by tests/docs match this schema.
+- **Skip-and-rollback ladder** — :class:`TrainingGuardian` (driven by
+  ``hapi.Model.fit``): skip the tripped step (GradScaler-style; the
+  compiled stepper keeps old params on device), on repeated trips roll
+  back to the last COMMITTED checkpoint written through PR 1's
+  ``distributed.checkpoint`` protocol and skip the poisoned data window.
+  A loss-spike detector (EMA + z-score) feeds the same ladder before
+  NaNs even appear.
+- **Collective watchdog** — :func:`run_with_deadline` runs blocking
+  host-level collectives (``barrier``, value waits) under a monitored
+  deadline; on expiry it dumps the "last op seen" ring
+  (:func:`record_op`) to the guardian log so stragglers are attributable
+  instead of silent hangs.  ``new_group(timeout=...)`` now lands on
+  ``Group.timeout`` and is honored here.
+
+Zero cost when disabled (the failpoints contract): every hook site pays
+one truthiness check — ``if _SENTINEL is not None`` in the optimizer,
+``if guard:`` at stepper build time (trace-time constant), ``if _TRACK:``
+in the collective layer.
+
+Knobs flow through the environment (``PADDLE_GUARDIAN=1`` enables the
+default config; ``PADDLE_GUARDIAN_LOG``, ``PADDLE_GUARDIAN_CKPT_ROOT``)
+and through ``fleet.DistributedStrategy.guardian`` /
+``guardian_configs`` (:meth:`GuardianConfig.from_strategy`).
+"""
+import collections
+import json
+import logging
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import failpoints as _fp
+
+__all__ = [
+    "EVENT_SCHEMA", "emit", "events", "clear_events",
+    "tree_all_finite", "all_reduce_finite", "attribute_nonfinite",
+    "host_sync_count",
+    "LossSpikeDetector", "NumericSentinel", "GuardianConfig",
+    "TrainingGuardian", "install_sentinel", "uninstall_sentinel",
+    "record_op", "last_ops", "track_collectives", "run_with_deadline",
+    "CollectiveTimeout",
+]
+
+_logger = logging.getLogger("paddle_tpu.guardian")
+
+# failpoint sites (framework/failpoints.py).  Both are *skippable*: the
+# "skip" action means "skip trusting the data" — poison_batch replaces
+# the clean batch with NaNs, check_numerics reports a forced trip on a
+# clean tensor — so chaos tests can force every trip path
+# deterministically without a model that actually diverges.
+FP_POISON_BATCH = _fp.register("guardian.poison_batch", skippable=True)
+FP_CHECK_NUMERICS = _fp.register("guardian.check_numerics", skippable=True)
+
+
+# -- guardian log ---------------------------------------------------------
+#
+# One event = one dict.  Common fields stamped by emit(): "event",
+# "ts_ns", "rank".  EVENT_SCHEMA maps event name -> the event-specific
+# field set; emit() enforces it, and tools/check_guardian_log.py lints
+# that names referenced in tests/docs exist here and that the docs table
+# matches field-for-field.
+
+EVENT_SCHEMA = {
+    # sentinel attribution: one event per offending tensor on a trip
+    "sentinel_trip": {"step", "kind", "tensor", "nan_count", "inf_count",
+                      "finite_absmax"},
+    # EMA + z-score loss-spike detector fired
+    "loss_spike": {"step", "loss", "ema", "zscore"},
+    # one step of the escalation ladder was skipped
+    "skip_step": {"step", "reason", "consecutive"},
+    # rolled back to the last COMMITTED checkpoint
+    "rollback": {"step", "ckpt_root", "restored_step", "rollbacks",
+                 "skip_window"},
+    # a known-good checkpoint was committed for future rollbacks
+    "good_checkpoint": {"step", "path"},
+    # a monitored collective blew its deadline
+    "watchdog_timeout": {"op", "timeout", "last_ops"},
+    # amp.debugging.check_numerics hit (or was failpoint-forced)
+    "check_numerics": {"op_type", "var_name", "nan_count", "inf_count",
+                       "forced"},
+}
+
+_EVENTS = collections.deque(maxlen=256)
+_events_lock = threading.Lock()
+
+
+def _rank():
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    except ValueError:
+        return 0
+
+
+def emit(event, **fields):
+    """Append one structured event to the guardian log (ring buffer +
+    optional ``PADDLE_GUARDIAN_LOG`` JSONL file).  Fields must match
+    :data:`EVENT_SCHEMA` exactly — the schema is a contract tests and
+    dashboards parse, not a suggestion."""
+    want = EVENT_SCHEMA.get(event)
+    if want is None:
+        raise ValueError(f"unknown guardian event {event!r} "
+                         f"(known: {sorted(EVENT_SCHEMA)})")
+    got = set(fields)
+    if got != want:
+        raise ValueError(
+            f"guardian event {event!r} fields {sorted(got)} do not match "
+            f"schema {sorted(want)}")
+    rec = {"event": event, "ts_ns": time.time_ns(), "rank": _rank()}
+    rec.update(fields)
+    with _events_lock:
+        _EVENTS.append(rec)
+    path = os.environ.get("PADDLE_GUARDIAN_LOG")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            _logger.warning("guardian log write to %s failed: %s", path, e)
+    _logger.info("guardian: %s %s", event, fields)
+    return rec
+
+
+def events(event=None):
+    """Snapshot of recent guardian events, newest last; filter by name."""
+    with _events_lock:
+        snap = list(_EVENTS)
+    if event is None:
+        return snap
+    return [r for r in snap if r["event"] == event]
+
+
+def clear_events():
+    with _events_lock:
+        _EVENTS.clear()
+
+
+# -- numeric sentinel primitives ------------------------------------------
+
+HOST_SYNC_COUNT = 0      # incremented by _host_bool; tests assert on it
+
+
+def _host_bool(x):
+    """THE host sync point for finite-checks.  Every device→host readback
+    of a sentinel verdict funnels through here so tests can count syncs
+    (the unscale_ contract: exactly one per step, any parameter count)."""
+    global HOST_SYNC_COUNT
+    HOST_SYNC_COUNT += 1
+    return bool(x)
+
+
+def host_sync_count():
+    return HOST_SYNC_COUNT
+
+
+def tree_all_finite(leaves):
+    """ONE fused device-side finite-check over a list of arrays/Tensors.
+
+    Returns a 0-d bool array (do NOT ``bool()`` it yourself on a hot
+    path — pass it to ``_host_bool`` once, or keep it on device inside a
+    jit).  Non-floating leaves and Nones pass vacuously."""
+    flags = []
+    for v in leaves:
+        if v is None:
+            continue
+        v = getattr(v, "_value", v)
+        v = jnp.asarray(v)
+        if not jnp.issubdtype(v.dtype, jnp.inexact):
+            continue
+        flags.append(jnp.isfinite(v).all())
+    if not flags:
+        return jnp.asarray(True)
+    if len(flags) == 1:
+        return flags[0]
+    return jnp.stack(flags).all()
+
+
+def all_reduce_finite(flag, group=None):
+    """AND a finite-verdict across data-parallel ranks so every replica
+    skips/rolls back in lockstep.  Inside a shard_map/pmap trace on the
+    group's mesh axis this is a ``pmin`` over the axis; outside a named
+    trace (world of 1, or GSPMD where grads are already global arrays)
+    it is the identity."""
+    axis = getattr(group, "axis_name", None) if group is not None else None
+    if axis is None:
+        return flag
+    from ..distributed.collective import _in_named_trace
+    if not _in_named_trace(axis):
+        return flag
+    return lax.pmin(jnp.asarray(flag).astype(jnp.int32), axis) > 0
+
+
+def attribute_nonfinite(named_leaves, step, kind="grad"):
+    """Per-tensor attribution on a sentinel trip: which tensor, how many
+    NaN/Inf, the absmax of what stayed finite.  Emits one
+    ``sentinel_trip`` event per offender and returns their names.  Host-
+    side and O(params) — called only on the (rare) trip path."""
+    offenders = []
+    for name, v in named_leaves:
+        if v is None:
+            continue
+        v = getattr(v, "_value", v)
+        arr = jnp.asarray(v)
+        if not jnp.issubdtype(arr.dtype, jnp.inexact):
+            continue
+        host = np.asarray(arr.astype(jnp.float32))
+        n_nan = int(np.isnan(host).sum())
+        n_inf = int(np.isinf(host).sum())
+        if not (n_nan or n_inf):
+            continue
+        finite = host[np.isfinite(host)]
+        emit("sentinel_trip", step=int(step), kind=kind, tensor=str(name),
+             nan_count=n_nan, inf_count=n_inf,
+             finite_absmax=float(np.abs(finite).max()) if finite.size
+             else 0.0)
+        offenders.append(name)
+    return offenders
+
+
+# -- loss-spike detector --------------------------------------------------
+
+class LossSpikeDetector:
+    """EMA + z-score over recent losses.  ``update(loss)`` returns True
+    on a spike; spiking losses are NOT absorbed into the EMA (a blowup
+    must not normalize itself away).  Non-finite losses always trip."""
+
+    def __init__(self, alpha=0.05, zscore=6.0, warmup=20, min_rel=1e-3):
+        self.alpha = float(alpha)
+        self.zscore = float(zscore)
+        self.warmup = int(warmup)
+        # std floor as a fraction of |ema|: a perfectly plateaued loss
+        # has var≈0, and without a floor the z-score of numerically
+        # negligible noise (1e-7 on a loss of 1.0) explodes past any
+        # threshold — a spike must be meaningful relative to the loss
+        self.min_rel = float(min_rel)
+        self.reset()
+
+    def reset(self):
+        self.ema = None
+        self.var = 0.0
+        self.n = 0
+
+    def _absorb(self, loss):
+        if self.ema is None:
+            self.ema = loss
+        else:
+            d = loss - self.ema
+            self.ema += self.alpha * d
+            self.var = (1 - self.alpha) * (self.var + self.alpha * d * d)
+        self.n += 1
+
+    def update(self, loss):
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return True
+        if self.n < self.warmup or self.ema is None:
+            self._absorb(loss)
+            return False
+        std = math.sqrt(self.var) if self.var > 0 else 0.0
+        floor = self.min_rel * max(abs(self.ema), 1e-12)
+        z = (loss - self.ema) / max(std, floor)
+        if z > self.zscore:
+            self.last_zscore = z
+            return True
+        self._absorb(loss)
+        return False
+
+
+# -- sentinel (the optimizer/eager hook) ----------------------------------
+
+_SENTINEL = None     # installed NumericSentinel; gate is a None-check
+
+
+class NumericSentinel:
+    """Grad-tree finite-check with attribution.  Installed module-wide
+    while a :class:`TrainingGuardian` is active, so ``Optimizer.step``
+    (eager) consults it with a single None-check when disabled."""
+
+    def __init__(self, config, dp_group=None):
+        self.config = config
+        self.dp_group = dp_group
+        self.tripped = None       # {"step", "offenders"} of the last trip
+        self._external = None     # consume-once verdict from GradScaler
+
+    def note_verdict(self, ok):
+        """A caller that already paid the fused finite-check + host sync
+        for THESE grads (GradScaler.unscale_) hands the verdict over so
+        the immediately-following ``Optimizer.step`` does not recompute
+        it — keeping eager AMP + guardian at one sync per step.
+        Consume-once: overwritten by the next unscale_."""
+        self._external = bool(ok)
+
+    def grads_ok(self, named_grads, step):
+        """One fused device check + ONE host sync (or a handed-over
+        verdict); on trip, attribute and record.  Returns the host
+        bool."""
+        ext, self._external = self._external, None
+        if ext is not None:
+            ok = ext
+        else:
+            flag = tree_all_finite([g for _, g in named_grads])
+            flag = all_reduce_finite(flag, self.dp_group)
+            ok = _host_bool(flag)
+        if not ok:
+            offenders = attribute_nonfinite(named_grads, step)
+            self.tripped = {"step": int(step), "offenders": offenders}
+        return ok
+
+    def consume_trip(self):
+        t, self.tripped = self.tripped, None
+        return t
+
+
+def install_sentinel(sentinel):
+    global _SENTINEL
+    _SENTINEL = sentinel
+
+
+def uninstall_sentinel():
+    global _SENTINEL
+    _SENTINEL = None
+
+
+# -- collective watchdog --------------------------------------------------
+
+_TRACK = False                               # gate for record_op sites
+_LAST_OPS = collections.deque(maxlen=32)     # (ts_ns, rank, op, detail)
+_ops_lock = threading.Lock()
+
+
+class CollectiveTimeout(TimeoutError):
+    """A monitored collective blew its deadline.  The guardian log holds
+    a ``watchdog_timeout`` event with the last-op ring for attribution."""
+
+
+def track_collectives(on=True):
+    """Enable/disable last-op recording at collective call sites (their
+    gate is ``if guardian._TRACK:`` — one truthiness check)."""
+    global _TRACK
+    _TRACK = bool(on)
+
+
+def record_op(op, detail=""):
+    """Record a collective entry into the last-op ring (watchdog
+    diagnostics).  Call sites gate on ``_TRACK`` themselves."""
+    with _ops_lock:
+        _LAST_OPS.append({"ts_ns": time.time_ns(), "rank": _rank(),
+                          "op": str(op), "detail": str(detail)})
+
+
+def last_ops():
+    with _ops_lock:
+        return list(_LAST_OPS)
+
+
+def run_with_deadline(fn, timeout, op, detail=""):
+    """Run a blocking host-level collective under a monitored deadline.
+
+    The op runs on a worker thread; if it has not returned within
+    ``timeout`` seconds, a ``watchdog_timeout`` event (with the last-op
+    ring) is emitted and :class:`CollectiveTimeout` raised.  The stuck
+    worker thread is daemonic and left to its fate — the point is that
+    the *training process* gets an attributable error instead of a
+    silent hang."""
+    record_op(op, detail)
+    result = []
+    error = []
+
+    def runner():
+        try:
+            result.append(fn())
+        except BaseException as e:        # re-raised on the caller thread
+            error.append(e)
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"guardian-watchdog-{op}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        emit("watchdog_timeout", op=str(op), timeout=float(timeout),
+             last_ops=last_ops())
+        raise CollectiveTimeout(
+            f"collective {op!r} ({detail or 'no detail'}) did not "
+            f"complete within {timeout}s; guardian log holds the "
+            "last-op-seen ring for straggler attribution")
+    if error:
+        raise error[0]
+    return result[0] if result else None
+
+
+# -- config ---------------------------------------------------------------
+
+def _env_truthy(name):
+    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
+
+
+class GuardianConfig:
+    """Knobs for the escalation ladder.  Sources, in priority order:
+    explicit ``Model.fit(guardian=...)`` (config / dict / True), then
+    ``fleet.DistributedStrategy.guardian(_configs)``, then the
+    ``PADDLE_GUARDIAN*`` environment."""
+
+    def __init__(self, check_grads=True, loss_spike=True, spike_zscore=6.0,
+                 spike_warmup=20, spike_alpha=0.05, skip_limit=3,
+                 skip_window=2, max_rollbacks=2, ckpt_every=50,
+                 ckpt_root=None, keep_ckpts=2, lr_backoff=1.0,
+                 dp_group=None):
+        self.check_grads = bool(check_grads)
+        self.loss_spike = bool(loss_spike)
+        self.spike_zscore = float(spike_zscore)
+        self.spike_warmup = int(spike_warmup)
+        self.spike_alpha = float(spike_alpha)
+        self.skip_limit = int(skip_limit)      # consecutive trips → rollback
+        self.skip_window = int(skip_window)    # batches skipped post-rollback
+        self.max_rollbacks = int(max_rollbacks)
+        self.ckpt_every = int(ckpt_every)        # steps between good ckpts
+        self.ckpt_root = ckpt_root               # None disables rollback
+        self.keep_ckpts = int(keep_ckpts)
+        self.lr_backoff = float(lr_backoff)      # lr *= this on rollback
+        self.dp_group = dp_group
+
+    @classmethod
+    def from_env(cls):
+        """None unless ``PADDLE_GUARDIAN`` is truthy."""
+        if not _env_truthy("PADDLE_GUARDIAN"):
+            return None
+        cfg = cls()
+        root = os.environ.get("PADDLE_GUARDIAN_CKPT_ROOT")
+        if root:
+            cfg.ckpt_root = root
+        return cfg
+
+    @classmethod
+    def from_strategy(cls, strategy):
+        """None unless ``strategy.guardian`` is on; fields come from
+        ``strategy.guardian_configs`` (unknown keys rejected)."""
+        if strategy is None or not getattr(strategy, "guardian", False):
+            return None
+        return cls(**getattr(strategy, "guardian_configs", {}))
+
+    @classmethod
+    def normalize(cls, value):
+        """fit(guardian=...) coercion: None → strategy (if fleet.init ran
+        with guardian on) → env; True → defaults; dict → defaults
+        overridden; GuardianConfig → itself; False → disabled."""
+        if isinstance(value, cls):
+            return value
+        if value is True:
+            return cls()
+        if isinstance(value, dict):
+            return cls(**value)
+        if value is False:
+            return None
+        from ..distributed.fleet.fleet import _FLEET
+        cfg = cls.from_strategy(_FLEET.get("strategy"))
+        if cfg is not None:
+            return cfg
+        return cls.from_env()
+
+
+# -- the escalation ladder ------------------------------------------------
+
+class TrainingGuardian:
+    """Drives the skip → rollback ladder for one ``Model.fit`` run.
+
+    The *device-side* skip already happened by the time ``after_step``
+    runs (the compiled stepper keeps old params/opt-state when the fused
+    finite-check trips); this class owns the host-side policy: counting
+    consecutive trips, the loss-spike detector, periodic good
+    checkpoints, rollback + poisoned-window skipping."""
+
+    OK, SKIP, ROLLBACK = "ok", "skip", "rollback"
+
+    def __init__(self, config, model):
+        self.config = config
+        self.model = model
+        self.sentinel = NumericSentinel(config, dp_group=config.dp_group)
+        self.spikes = (LossSpikeDetector(config.spike_alpha,
+                                         config.spike_zscore,
+                                         config.spike_warmup)
+                       if config.loss_spike else None)
+        self.consecutive = 0
+        self.rollbacks = 0
+        self._skip_left = 0
+        self._steps_since_ckpt = 0
+        self._have_ckpt = False
+        self._step = 0
+
+    # -- fit-lifecycle ----------------------------------------------------
+    def start(self):
+        if self.config.check_grads:     # honored on BOTH jit/eager rungs
+            install_sentinel(self.sentinel)
+        track_collectives(True)
+
+    def stop(self):
+        uninstall_sentinel()
+        track_collectives(False)
+
+    # -- batch hooks ------------------------------------------------------
+    def skip_batch(self):
+        """True while inside the post-rollback poisoned-data window."""
+        if self._skip_left <= 0:
+            return False
+        self._skip_left -= 1
+        self._step += 1
+        emit("skip_step", step=self._step, reason="poisoned_window",
+             consecutive=0)
+        return True
+
+    def filter_batch(self, inputs):
+        """Chaos hook: the ``guardian.poison_batch`` failpoint (action
+        ``skip`` = skip delivering the clean batch) replaces every
+        floating input with NaNs, making the natural NaN-grad path fire
+        deterministically."""
+        if _fp._ACTIVE and _fp.fire(FP_POISON_BATCH) == "skip":
+            poisoned = []
+            for x in inputs:
+                arr = jnp.asarray(getattr(x, "_value", x))
+                if jnp.issubdtype(arr.dtype, jnp.inexact):
+                    arr = jnp.full_like(arr, jnp.nan)
+                poisoned.append(arr)
+            return poisoned
+        return inputs
+
+    # -- the ladder -------------------------------------------------------
+    def after_step(self, loss, ok_flag=None, batch=None):
+        """Feed one finished train step into the ladder.
+
+        ``ok_flag``: device 0-d bool from the compiled stepper's fused
+        finite-check (one host sync happens here), or None on the eager
+        path (the optimizer's sentinel check already recorded any trip).
+        ``batch``: the (inputs, labels) just trained on — used to re-run
+        the grad step for attribution when the fused path trips.
+        Returns OK | SKIP | ROLLBACK (rollback already performed)."""
+        self._step += 1
+        step = self._step
+        reason = None
+        if ok_flag is not None:
+            if not _host_bool(all_reduce_finite(ok_flag,
+                                                self.config.dp_group)):
+                reason = "nonfinite"
+                if batch is not None:
+                    self.attribute_jit_trip(*batch)
+        elif self.sentinel.consume_trip() is not None:
+            reason = "nonfinite"
+        if reason is None and self.spikes is not None:
+            if self.spikes.update(loss):
+                z = getattr(self.spikes, "last_zscore", float("inf"))
+                ema = self.spikes.ema
+                emit("loss_spike", step=step, loss=float(loss),
+                     ema=float(ema) if ema is not None else float("nan"),
+                     zscore=float(z) if math.isfinite(float(loss))
+                     else float("inf"))
+                reason = "loss_spike"
+        if reason is None:
+            self.consecutive = 0
+            self._maybe_save_good()
+            return self.OK
+        self.consecutive += 1
+        emit("skip_step", step=step, reason=reason,
+             consecutive=self.consecutive)
+        if self.consecutive > self.config.skip_limit and self._can_rollback():
+            self._rollback(step)
+            return self.ROLLBACK
+        return self.SKIP
+
+    def attribute_jit_trip(self, inputs, labels):
+        """jit-path attribution: re-run the grad-only step (trip path is
+        rare; one extra bwd is the price of knowing WHICH tensor) and
+        emit per-offender events."""
+        st = self.model._stepper
+        if st is None:
+            return []
+        try:
+            grads = st.debug_grads(inputs, labels)
+        except Exception as e:       # attribution must never kill training
+            _logger.warning("guardian attribution failed: %r", e)
+            return []
+        names = [st.param_names[i] for i in st.t_idx]
+        return attribute_nonfinite(list(zip(names, grads)), self._step)
+
+    # -- good checkpoints + rollback --------------------------------------
+    def _can_rollback(self):
+        return (self.config.ckpt_root is not None and self._have_ckpt
+                and self.rollbacks < self.config.max_rollbacks)
+
+    def _maybe_save_good(self):
+        if self.config.ckpt_root is None:
+            return
+        self._steps_since_ckpt += 1
+        if self._steps_since_ckpt < self.config.ckpt_every \
+                and self._have_ckpt:
+            return
+        self._steps_since_ckpt = 0
+        self.save_good(self._step)
+
+    def save_good(self, step):
+        """Commit the current (known-good) training state through PR 1's
+        crash-safe step-dir protocol."""
+        from ..distributed import checkpoint as ckpt
+        flat = _capture_state(self.model)
+        flat["meta.step"] = jnp.asarray(int(step), jnp.int32)
+        path = ckpt.save_checkpoint(flat, self.config.ckpt_root, step,
+                                    keep_last=self.config.keep_ckpts)
+        self._have_ckpt = True
+        emit("good_checkpoint", step=int(step), path=str(path))
+        return path
+
+    def _rollback(self, step):
+        from ..distributed import checkpoint as ckpt
+        flat = ckpt.load_state_dict(self.config.ckpt_root)
+        restored_step = int(np.asarray(flat.pop("meta.step", -1)))
+        _restore_state(self.model, flat)
+        st = getattr(self.model, "_stepper", None)
+        if st is not None:
+            # grads accumulated against the pre-rollback weights must
+            # not be applied to the restored ones
+            st._accum_grads = None
+            st._accum_count = 0
+        self.rollbacks += 1
+        self.consecutive = 0
+        self._skip_left = self.config.skip_window
+        if self.spikes is not None:
+            self.spikes.reset()
+        opt = self.model._optimizer
+        if self.config.lr_backoff != 1.0 and opt is not None \
+                and opt._lr_scheduler is None:
+            opt.set_lr(opt.get_lr() * self.config.lr_backoff)
+        emit("rollback", step=int(step), ckpt_root=str(self.config.ckpt_root),
+             restored_step=restored_step, rollbacks=self.rollbacks,
+             skip_window=self.config.skip_window)
+
+
+# -- model state capture/restore (params + buffers + optimizer state) -----
+
+def _capture_state(model):
+    """Flatten a hapi Model's full training state into an array dict the
+    checkpoint subsystem can shard: ``param.<name>``, ``buf.<name>``,
+    ``opt.<i>.<slot>`` (functional stepper state) or ``eopt.<i>.<slot>``
+    (eager accumulator state)."""
+    flat = {}
+    net = model.network
+    for n, p in net.named_parameters():
+        flat[f"param.{n}"] = p._value
+    for n, b in net.named_buffers():
+        flat[f"buf.{n}"] = b._value
+    st = getattr(model, "_stepper", None)
+    if st is not None and st.opt_state is not None:
+        for i, d in enumerate(st.opt_state):
+            for k, v in d.items():
+                flat[f"opt.{i}.{k}"] = v
+    elif model._optimizer is not None:
+        opt = model._optimizer
+        for i, p in enumerate(opt._parameter_list or []):
+            acc = opt._accumulators.get(id(p))
+            if acc:
+                for k, v in acc.items():
+                    flat[f"eopt.{i}.{k}"] = v
+    return flat
+
+
+def _put_like(value, current):
+    """Restore a loaded array preserving the live array's sharding (the
+    plan/GSPMD case) and dtype."""
+    arr = jnp.asarray(value)
+    if arr.dtype != current.dtype:
+        arr = arr.astype(current.dtype)
+    sharding = getattr(current, "sharding", None)
+    if sharding is not None:
+        arr = jax.device_put(arr, sharding)
+    return arr
+
+
+def _restore_state(model, flat):
+    net = model.network
+    for n, p in net.named_parameters():
+        key = f"param.{n}"
+        if key in flat:
+            p._value = _put_like(flat[key], p._value)
+    for n, b in net.named_buffers():
+        key = f"buf.{n}"
+        if key in flat:
+            b._value = _put_like(flat[key], b._value)
+    st = getattr(model, "_stepper", None)
+    opt_entries = {}
+    for key, v in flat.items():
+        if key.startswith("opt."):
+            _, i, slot = key.split(".", 2)
+            opt_entries.setdefault(int(i), {})[slot] = v
+    if st is not None and opt_entries and st.opt_state is not None:
+        new_state = []
+        for i, cur in enumerate(st.opt_state):
+            d = dict(cur)
+            for k, v in opt_entries.get(i, {}).items():
+                d[k] = _put_like(v, cur[k]) if k in cur else jnp.asarray(v)
+            new_state.append(d)
+        st.opt_state = new_state
+    if model._optimizer is not None:
+        opt = model._optimizer
+        for key, v in flat.items():
+            if key.startswith("eopt."):
+                _, i, slot = key.split(".", 2)
+                params = opt._parameter_list or []
+                i = int(i)
+                if i < len(params):
+                    acc = opt._accumulators.setdefault(id(params[i]), {})
+                    acc[slot] = jnp.asarray(v)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework import autograd as _ag
+from ..framework import guardian as _guardian
 from ..framework import preemption as _preemption
 from ..framework.random import rng_scope, next_key
 from ..framework.io import save as _save, load as _load
@@ -83,6 +84,12 @@ class _CompiledStepper:
         self.opt_state = None
         self._accum_grads = None
         self._accum_count = 0
+        # guardian sentinel: when True the compiled step carries a fused
+        # finite-check and skips the update on device (params/opt state
+        # kept) — toggled by Model.fit, which clears the step caches
+        self.guard_numerics = False
+        self.last_ok = None
+        self._last_rng = None
         if self.plan is not None:
             self._apply_plan()
 
@@ -157,6 +164,7 @@ class _CompiledStepper:
         opt = self.optimizer
         t_idx = self.t_idx
         amp = self.amp_level
+        guard = self.guard_numerics   # trace-time constant: zero cost off
         pnames = [self.param_names[i] for i in t_idx]
 
         def step(train_vals, frozen_vals, buffer_vals, opt_state, lr, key,
@@ -195,6 +203,18 @@ class _CompiledStepper:
                 loss_f, has_aux=True)(train_vals)
             new_train, new_opt = apply_functional_with_clip(
                 opt, train_vals, grads, opt_state, lr, param_names=pnames)
+            if guard:
+                # guardian sentinel: ONE fused finite reduction over the
+                # whole grad tree + loss, then a device-side select that
+                # keeps the old params/buffers/opt state on trip — the
+                # skip costs no recompile and no host round-trip here
+                ok = _guardian.tree_all_finite(list(grads) + [loss])
+                sel = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+                new_train = [sel(n, o) for n, o in zip(new_train,
+                                                       train_vals)]
+                new_opt = jax.tree_util.tree_map(sel, new_opt, opt_state)
+                new_buf = [sel(n, o) for n, o in zip(new_buf, buffer_vals)]
+                return loss, out_vals, new_train, new_buf, new_opt, ok
             return loss, out_vals, new_train, new_buf, new_opt
 
         if self.plan is None:
@@ -206,11 +226,12 @@ class _CompiledStepper:
         b_sh = list(self._buffer_shardings)
         o_sh = self._opt_shardings_for(self.opt_state)
         rep = plan.replicated()
+        out_sh = (rep, None, t_sh, b_sh, o_sh) + ((rep,) if guard else ())
         return jax.jit(
             step, donate_argnums=(0, 2, 3),
             in_shardings=(t_sh, f_sh, b_sh, o_sh, rep, rep,
                           self._input_shardings, self._label_shardings),
-            out_shardings=(rep, None, t_sh, b_sh, o_sh))
+            out_shardings=out_sh)
 
     def _build_grad(self):
         """Gradient-only step (no optimizer apply) for accumulation."""
@@ -287,6 +308,7 @@ class _CompiledStepper:
                     for st, s in zip(self.opt_state, o_sh)]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = next_key()
+        self._last_rng = rng     # guardian attribution replays this key
 
         accumulating = (not update) or self._accum_count > 0
         if not accumulating:
@@ -294,10 +316,15 @@ class _CompiledStepper:
             if key not in self._train_cache:
                 self._train_cache[key] = self._build_train(len(inputs),
                                                            len(labels))
-            loss, out_vals, new_train, new_buf, new_opt = \
-                self._train_cache[key](train_vals, frozen_vals, buffer_vals,
-                                       self.opt_state, lr, rng, inputs,
-                                       labels)
+            out = self._train_cache[key](train_vals, frozen_vals,
+                                         buffer_vals, self.opt_state, lr,
+                                         rng, inputs, labels)
+            if self.guard_numerics:
+                loss, out_vals, new_train, new_buf, new_opt, ok = out
+                self.last_ok = ok
+            else:
+                loss, out_vals, new_train, new_buf, new_opt = out
+                self.last_ok = None
             for i, v in zip(self.t_idx, new_train):
                 self.params[i]._value = v
             for b, v in zip(self.buffers, new_buf):
@@ -311,6 +338,16 @@ class _CompiledStepper:
             self._grad_cache[key] = self._build_grad()
         loss, out_vals, new_buf, grads = self._grad_cache[key](
             train_vals, frozen_vals, buffer_vals, rng, inputs, labels)
+        if self.guard_numerics:
+            # accumulation: a poisoned microbatch must not contaminate
+            # the running grad sum — drop it here (host check; this path
+            # already syncs per microbatch) and report the trip
+            ok = _guardian.tree_all_finite(list(grads) + [loss])
+            self.last_ok = ok
+            if not _guardian._host_bool(ok):
+                return loss, out_vals   # buffers kept pre-batch too
+        else:
+            self.last_ok = None
         for b, v in zip(self.buffers, new_buf):
             b._value = v
         if self._accum_grads is None:
@@ -347,6 +384,28 @@ class _CompiledStepper:
         buffer_vals = [b._value for b in self.buffers]
         return fn(param_vals, buffer_vals, next_key(), inputs)
 
+    def debug_grads(self, inputs, labels):
+        """Recompute this batch's gradients without applying them —
+        guardian attribution re-runs the bwd pass on the (rare) trip
+        path to name the offending tensors.  Replays the tripped step's
+        rng key (stochastic layers must see the same mask, and the
+        global key stream must not be perturbed by a replay)."""
+        inputs = [_to_jnp(x) for x in _as_list(inputs)]
+        labels = [_to_jnp(x) for x in _as_list(labels)]
+        key = (self._shape_key(inputs), self._shape_key(labels))
+        if key not in self._grad_cache:
+            self._grad_cache[key] = self._build_grad()
+        train_vals = [self.params[i]._value for i in self.t_idx]
+        frozen_vals = [p._value for i, p in enumerate(self.params)
+                       if i not in set(self.t_idx)]
+        buffer_vals = [b._value for b in self.buffers]
+        rng = getattr(self, "_last_rng", None)
+        if rng is None:
+            rng = next_key()
+        _, _, _, grads = self._grad_cache[key](
+            train_vals, frozen_vals, buffer_vals, rng, inputs, labels)
+        return list(grads)
+
     def sync_opt_state_to_optimizer(self):
         if self.opt_state is not None:
             trainable = [self.params[i] for i in self.t_idx]
@@ -364,6 +423,7 @@ class Model:
         self._metrics = []
         self._stepper = None
         self._jit = True
+        self._guardian = None
         self.stop_training = False
 
     # -- prepare ------------------------------------------------------------
@@ -478,7 +538,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            guardian=None):
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
@@ -502,11 +563,33 @@ class Model:
         # has left fit() must die normally on SIGTERM, not swallow it
         # into a flag nobody polls.
         _preempt_installed = _preemption.install()
+        # training guardian (framework/guardian.py): numeric sentinel +
+        # skip-and-rollback ladder.  guardian= (config/dict/True) wins,
+        # else fleet.DistributedStrategy.guardian, else PADDLE_GUARDIAN
+        # env.  Default-off: the per-step cost is this one None-check.
+        gcfg = _guardian.GuardianConfig.normalize(guardian)
+        self._guardian = (_guardian.TrainingGuardian(gcfg, self)
+                          if gcfg is not None else None)
+        guard_jit = (self._guardian is not None and gcfg.check_grads
+                     and self._jit and self._stepper is not None)
+        if self._guardian is not None:
+            self._guardian.start()
+            if guard_jit:
+                self._stepper.guard_numerics = True
+                self._stepper._train_cache.clear()
         try:
             self._fit_epochs(epochs, eval_freq, save_dir, cbks,
                              train_loader, eval_loader, num_iters,
                              accumulate_grad_batches, batch_size)
         finally:
+            if self._guardian is not None:
+                self._guardian.stop()
+                self._guardian = None
+                if guard_jit:
+                    # un-instrumented steppers must not keep paying the
+                    # guarded executable's select ops
+                    self._stepper.guard_numerics = False
+                    self._stepper._train_cache.clear()
             if _preempt_installed:
                 _preemption.uninstall()
 
@@ -524,9 +607,22 @@ class Model:
                     break
                 cbks.on_batch_begin("train", step, logs)
                 ins, labs = self._split_batch(batch)
+                guard = self._guardian
+                if guard is not None:
+                    if guard.skip_batch():   # post-rollback poisoned window
+                        cbks.on_batch_end("train", step, logs)
+                        continue
+                    ins = guard.filter_batch(ins)
                 do_update = (step + 1) % max(accumulate_grad_batches,
                                              1) == 0
                 res = self.train_batch(ins, labs, update=do_update)
+                if guard is not None:
+                    loss_v = res[0][0] if isinstance(res, tuple) else res[0]
+                    ok = (self._stepper.last_ok
+                          if self._jit and self._stepper is not None
+                          else None)
+                    guard.after_step(loss_v, ok_flag=ok,
+                                     batch=(ins, labs))
                 logs = self._make_logs(res)
                 logs["step"] = step
                 logs["batch_size"] = (

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework.autograd import no_grad
+from ..framework import guardian as _guardian
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
@@ -130,6 +131,13 @@ class Optimizer:
                  for i, p in enumerate(params)}
         pairs = [(p, p._grad) for p in params
                  if not p.stop_gradient and p._grad is not None]
+        # guardian sentinel (eager escalation-ladder rung): one fused
+        # finite-check over the raw grads, skip the whole update on trip.
+        # Cost when no guardian is active: this single None-check.
+        if _guardian._SENTINEL is not None:
+            named = [(names[id(p)], g) for p, g in pairs]
+            if not _guardian._SENTINEL.grads_ok(named, self._global_step):
+                return
         if self._grad_clip is not None:
             clipped = self._grad_clip([(p, g) for p, g in pairs])
             pairs = [(p, g._value if isinstance(g, Tensor) else g)

@@ -34,3 +34,6 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection test driven by failpoints")
     config.addinivalue_line(
         "markers", "slow: long-running test, excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "guardian: training-guardian (sentinel/ladder/"
+        "watchdog) test — select with -m guardian")

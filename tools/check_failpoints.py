@@ -17,8 +17,10 @@ sys.path.insert(0, REPO)
 
 # importing the hooked modules populates the registry
 from paddle_tpu.framework import failpoints  # noqa: E402
+import paddle_tpu.framework.guardian  # noqa: F401,E402
 import paddle_tpu.distributed.store  # noqa: F401,E402
 import paddle_tpu.distributed.checkpoint  # noqa: F401,E402
+import paddle_tpu.distributed.collective  # noqa: F401,E402
 import paddle_tpu.distributed.fleet.elastic  # noqa: F401,E402
 import paddle_tpu.io.worker  # noqa: F401,E402
 
