@@ -1,0 +1,79 @@
+"""Static-analysis suite: tracer-safety, host-sync budget, collective
+order, and registry lints over the framework's compiled hot paths.
+
+The framework carries runtime contracts that are invisible to the type
+system — "exactly one host sync per step" in ``GradScaler.unscale_``,
+"no trace-breaking host calls inside a jitted stepper", "collectives
+must execute in the same static order on every rank".  Nothing in
+Python stops the next change from reintroducing a ``.item()`` in a
+jitted path or a rank-conditional ``barrier()`` that deadlocks a fleet,
+so this package checks them at lint time (see T3 / EQuARX in PAPERS.md:
+compute/collective overlap wins evaporate when stray host syncs or
+misordered collectives sneak into the step).
+
+Four AST-based passes, one runner:
+
+- ``tracer-safety``  — walk functions reachable from registered jit
+  entry points (:func:`jit_surface`) and flag trace-breaking patterns:
+  ``float()``/``int()``/``bool()``/``len()`` on traced values,
+  ``.item()``/``.numpy()`` readbacks, ``np.asarray`` on traced values,
+  Python ``if``/``while`` on tensor expressions.
+- ``host-sync``      — inventory explicit sync sites (``_host_bool``,
+  ``np.asarray``, ``.item()``, ``device_get``, ``block_until_ready``)
+  in the monitored hot-path modules against a budgeted allowlist
+  (:mod:`paddle_tpu.analysis.allowlist`), machine-checking the
+  one-sync-per-step contract.
+- ``collective-order`` — flag collective calls under rank- or
+  data-dependent branches, and ``if``/``else`` arms whose collective
+  sequences differ — the classic SPMD deadlock shapes.
+- ``failpoint-refs`` / ``guardian-log`` — the registry lints formerly
+  living in ``tools/check_failpoints.py`` / ``check_guardian_log.py``,
+  folded into the same framework (the tools remain as thin wrappers).
+
+Run everything: ``python -m paddle_tpu.analysis`` (or
+``python tools/lint.py``); ``--json`` for machine output; findings
+already recorded in ``tools/lint_baseline.json`` are suppressed so only
+*new* violations fail the run (exit 1).
+
+This module stays import-light (no jax, no framework modules) so hot
+paths can ``from ..analysis import jit_surface`` without cycles.
+"""
+
+__all__ = ["jit_surface", "register_jit_surface", "registered_surfaces",
+           "main"]
+
+# (module, qualname) pairs registered at import time by the decorator /
+# explicit registration below.  The AST passes find surfaces by spotting
+# the decorator syntactically, so analysis works on un-imported fixture
+# files too; this runtime registry is the source of truth for *nested*
+# functions a decorator can't reach (see EXTRA_JIT_SURFACES in
+# allowlist.py) and lets tests introspect what is registered.
+_JIT_SURFACES = []
+
+
+def jit_surface(fn=None):
+    """Mark a function (or the builder of a nested jitted function) as a
+    jit entry point for the tracer-safety pass.  Identity decorator at
+    runtime — zero cost; the static pass recognizes it syntactically."""
+    def deco(f):
+        qn = f.__qualname__.replace(".<locals>", "")
+        _JIT_SURFACES.append((f.__module__, qn))
+        return f
+    return deco(fn) if fn is not None else deco
+
+
+def register_jit_surface(module, qualname):
+    """Explicit registration for functions a decorator can't reach
+    (nested defs).  Pair this with an EXTRA_JIT_SURFACES entry in
+    allowlist.py so the AST pass sees it too."""
+    _JIT_SURFACES.append((module, qualname))
+
+
+def registered_surfaces():
+    return list(_JIT_SURFACES)
+
+
+def main(argv=None):
+    """CLI entry (``python -m paddle_tpu.analysis``)."""
+    from .runner import main as _main
+    return _main(argv)

@@ -1,0 +1,129 @@
+"""Analysis configuration: monitored hot-path modules, the host-sync
+budget allowlist, and extra jit surfaces the decorator can't annotate.
+
+This file is the *policy*; the passes are the mechanism.  Adding a new
+host sync to a hot path means adding an entry HERE with a reason —
+that's the point: the diff review sees the contract change explicitly
+instead of a silent ``.item()`` slipping into the step.
+"""
+
+# -- host-sync budget (host_sync.py) ---------------------------------------
+#
+# Modules whose functions form the per-step hot path.  Every sync-
+# primitive call site inside them must match an allowlist entry below,
+# with a per-function budget (max sites of that callee per function).
+MONITORED_MODULES = (
+    "paddle_tpu/framework/guardian.py",
+    "paddle_tpu/amp/__init__.py",
+    "paddle_tpu/hapi/model.py",
+    "paddle_tpu/optimizer/optimizer.py",
+)
+
+# Call terminals that force (or mark) a device->host sync.
+SYNC_CALLEES = frozenset({
+    "_host_bool",           # guardian's counted sync funnel
+    "item", "numpy", "tolist",
+    "device_get",
+    "block_until_ready",
+})
+# numpy-namespace calls that materialize an array on host
+NUMPY_SYNC_FUNCS = frozenset({"asarray", "array"})
+
+# (relpath, function qualname, callee) -> {"max": N, "reason": str}
+#
+# The one-sync-per-step contract (PR 2): the step path may read back at
+# most ONE fused finite-verdict; everything else below is an off-step
+# path (trip attribution, rollback, eval/debug sinks) and says so.
+HOST_SYNC_ALLOWLIST = {
+    # guardian: the sync funnel itself + the two step-path verdict reads
+    ("paddle_tpu/framework/guardian.py",
+     "NumericSentinel.grads_ok", "_host_bool"):
+        {"max": 1, "reason": "THE eager-path verdict read: one fused "
+                             "finite-check, one sync per step"},
+    ("paddle_tpu/framework/guardian.py",
+     "TrainingGuardian.after_step", "_host_bool"):
+        {"max": 1, "reason": "THE jit-path verdict read (stepper's ok "
+                             "flag): one sync per step"},
+    ("paddle_tpu/framework/guardian.py",
+     "attribute_nonfinite", "asarray"):
+        {"max": 1, "reason": "trip path only: per-tensor attribution is "
+                             "host-side by design (rare)"},
+    ("paddle_tpu/framework/guardian.py", "TrainingGuardian._rollback",
+     "asarray"):
+        {"max": 1, "reason": "rollback path only: restored-step readback"},
+    # amp: the unscale_ contract sync + the debugging API (sync by design)
+    ("paddle_tpu/amp/__init__.py", "GradScaler.unscale_", "_host_bool"):
+        {"max": 1, "reason": "the PR 2 contract: exactly one host sync "
+                             "per unscale_, any parameter count"},
+    ("paddle_tpu/amp/__init__.py", "debugging.check_numerics", "asarray"):
+        {"max": 2, "reason": "debugging API: host readback is its job "
+                             "(never on the compiled step path)"},
+    # hapi: H2D ingest + accumulation-path verdict + eval/debug sinks
+    ("paddle_tpu/hapi/model.py", "_to_jnp", "asarray"):
+        {"max": 1, "reason": "H2D ingest of host batches (numpy->device), "
+                             "not a device readback"},
+    ("paddle_tpu/hapi/model.py", "_CompiledStepper.train_step",
+     "_host_bool"):
+        {"max": 1, "reason": "grad-accumulation path: per-microbatch "
+                             "verdict read keeps poisoned microbatches "
+                             "out of the running sum"},
+    ("paddle_tpu/hapi/model.py", "Model.train_batch", "item"):
+        {"max": 1, "reason": "eager debug path only (prepare(jit=False))"},
+    ("paddle_tpu/hapi/model.py", "Model.eval_batch", "item"):
+        {"max": 1, "reason": "eval path: loss scalar for logs"},
+    ("paddle_tpu/hapi/model.py", "Model.predict_batch", "asarray"):
+        {"max": 1, "reason": "prediction sink: outputs leave the device "
+                             "here by contract"},
+    ("paddle_tpu/hapi/model.py", "Model.predict_batch", "numpy"):
+        {"max": 1, "reason": "prediction sink (eager path): outputs "
+                             "leave the device here by contract"},
+    ("paddle_tpu/optimizer/optimizer.py", "Optimizer.set_state_dict",
+     "asarray"):
+        {"max": 1, "reason": "checkpoint-restore path: host state_dict "
+                             "values are ingested (H2D), never per-step"},
+}
+
+# -- tracer-safety (tracer_safety.py) --------------------------------------
+#
+# Jit surfaces that are nested functions (a decorator can't reach them):
+# (relpath, AST qualname).  Keep in sync with the runtime
+# register_jit_surface() calls in the same modules.
+EXTRA_JIT_SURFACES = (
+    ("paddle_tpu/models/generation.py", "generate.run"),
+    ("paddle_tpu/models/generation.py", "generate.beam_run"),
+    ("paddle_tpu/models/generation.py", "generate.apply"),
+    ("paddle_tpu/models/generation.py", "generate.pick"),
+    ("paddle_tpu/models/generation.py", "generate.prefill"),
+)
+
+# Call terminals that return *static* (trace-time) values even when
+# applied to traced arrays — metadata, not data.  Taint stops here.
+STATIC_FUNCS = frozenset({
+    "issubdtype", "result_type", "promote_types", "can_cast", "finfo",
+    "iinfo", "broadcast_shapes", "ndim", "isinstance", "hasattr",
+})
+# Attribute reads that are static under tracing (`.at` is deliberately
+# NOT here: `x.at[i].set(v)` carries x's taint)
+STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
+
+# -- collective-order (collective_order.py) --------------------------------
+
+COLLECTIVE_CALLEES = frozenset({
+    "all_reduce", "all_gather", "all_gather_into_tensor", "reduce_scatter",
+    "alltoall", "alltoall_single", "broadcast", "scatter", "barrier",
+    "reduce", "gather", "ppermute", "batch_isend_irecv",
+    "psum", "pmin", "pmax", "pmean", "all_to_all", "psum_scatter",
+    "sync_global_devices", "broadcast_one_to_all",
+})
+
+# Names whose value differs per rank: a branch on one of these around a
+# collective is the classic SPMD deadlock.  (process_count / world_size
+# are uniform across ranks and deliberately absent.)
+RANK_NAMES = frozenset({
+    "rank", "local_rank", "rank_id", "trainer_id", "group_rank",
+    "dp_rank", "mp_rank", "pp_rank", "stage_id", "worker_index",
+})
+RANK_FUNCS = frozenset({
+    "get_rank", "axis_index", "process_index", "get_group_rank",
+    "get_local_rank",
+})

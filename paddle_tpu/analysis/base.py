@@ -1,0 +1,288 @@
+"""Shared infrastructure for the static-analysis passes: findings,
+source indexing (modules, imports, functions, call resolution), and
+inline-pragma suppression.
+
+Everything here is pure-AST — no imports of the analyzed code — so the
+code passes run on fixture snippets and broken trees alike.  Only the
+registry lints (registry_lints.py) import the live framework.
+"""
+import ast
+import os
+import re
+
+# `# lint: allow(tracer-safety)` / `# lint: allow(host-readback, ...)`
+# on a finding's line suppresses it (by pass name or finding code)
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+
+
+class Finding:
+    """One lint finding.  ``key()`` is the baseline identity — it
+    deliberately excludes the line number so unrelated edits above a
+    baselined finding don't resurrect it; ``detail`` (a short stable
+    token like the offending callee) disambiguates within a function."""
+
+    __slots__ = ("pass_name", "path", "line", "qualname", "code",
+                 "message", "detail")
+
+    def __init__(self, pass_name, path, line, qualname, code, message,
+                 detail=""):
+        self.pass_name = pass_name
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.qualname = qualname or "<module>"
+        self.code = code
+        self.message = message
+        self.detail = detail
+
+    def key(self):
+        return (f"{self.pass_name}:{self.path}:{self.qualname}:"
+                f"{self.code}:{self.detail}")
+
+    def sort_key(self):
+        return (self.pass_name, self.path, self.line, self.code,
+                self.qualname, self.detail, self.message)
+
+    def to_dict(self):
+        return {"pass": self.pass_name, "path": self.path,
+                "line": self.line, "qualname": self.qualname,
+                "code": self.code, "detail": self.detail,
+                "message": self.message, "key": self.key()}
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] "
+                f"{self.qualname}: {self.message}")
+
+
+class FuncInfo:
+    __slots__ = ("qualname", "node", "class_name", "module", "is_surface")
+
+    def __init__(self, qualname, node, class_name, module, is_surface):
+        self.qualname = qualname
+        self.node = node
+        self.class_name = class_name
+        self.module = module
+        self.is_surface = is_surface
+
+
+class ModuleInfo:
+    """One parsed source file: its AST, import maps and function index."""
+
+    def __init__(self, path, relpath, modname, is_package, source, tree):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.modname = modname
+        self.is_package = is_package
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.import_alias = {}   # local name -> dotted module
+        self.from_imports = {}   # local name -> (dotted module, name)
+        self.funcs = {}          # qualname -> FuncInfo
+        self._index()
+
+    # -- pragma suppression ------------------------------------------------
+    def allowed_on_line(self, line):
+        """Set of pass names / codes suppressed by a pragma on ``line``."""
+        if 1 <= line <= len(self.lines):
+            m = _PRAGMA_RE.search(self.lines[line - 1])
+            if m:
+                return {t.strip() for t in m.group(1).split(",") if t.strip()}
+        return set()
+
+    # -- indexing ----------------------------------------------------------
+    def _resolve_relative(self, level, module):
+        """Dotted target of a ``from <dots><module> import ...``."""
+        if level == 0:
+            return module or ""
+        parts = self.modname.split(".")
+        # a package's own module path counts as its first parent level
+        if not self.is_package:
+            parts = parts[:-1]
+        parts = parts[:len(parts) - (level - 1)] if level > 1 else parts
+        base = ".".join(parts)
+        if module:
+            return f"{base}.{module}" if base else module
+        return base
+
+    def _index(self):
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.scope = []        # (kind, name) stack
+                self.class_stack = []
+
+            def visit_Import(self, node):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    mod.import_alias[local] = target
+
+            def visit_ImportFrom(self, node):
+                base = mod._resolve_relative(node.level, node.module)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    mod.from_imports[local] = (base, a.name)
+
+            def _func(self, node):
+                qual = ".".join([n for _, n in self.scope] + [node.name])
+                cls = self.class_stack[-1] if self.class_stack else None
+                surface = any(_decorator_is_surface(d)
+                              for d in node.decorator_list)
+                mod.funcs[qual] = FuncInfo(qual, node, cls, mod, surface)
+                self.scope.append(("func", node.name))
+                self.generic_visit(node)
+                self.scope.pop()
+
+            visit_FunctionDef = _func
+            visit_AsyncFunctionDef = _func
+
+            def visit_ClassDef(self, node):
+                self.scope.append(("class", node.name))
+                self.class_stack.append(node.name)
+                self.generic_visit(node)
+                self.class_stack.pop()
+                self.scope.pop()
+
+        V().visit(self.tree)
+
+    def alias_module(self, name):
+        """Dotted module a local name refers to, or None."""
+        if name in self.import_alias:
+            return self.import_alias[name]
+        fi = self.from_imports.get(name)
+        if fi is not None:
+            base, sub = fi
+            return f"{base}.{sub}" if base else sub
+        return None
+
+
+def _decorator_is_surface(dec):
+    d = dec
+    if isinstance(d, ast.Call):
+        d = d.func
+    if isinstance(d, ast.Name):
+        return d.id == "jit_surface"
+    if isinstance(d, ast.Attribute):
+        return d.attr == "jit_surface"
+    return False
+
+
+def dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_terminal(func_expr):
+    """Terminal name of a call target ('all_reduce' for
+    dist.all_reduce), or None for dynamic targets."""
+    if isinstance(func_expr, ast.Attribute):
+        return func_expr.attr
+    if isinstance(func_expr, ast.Name):
+        return func_expr.id
+    return None
+
+
+class ProjectIndex:
+    """All scanned modules plus cross-module call resolution."""
+
+    def __init__(self, root, files):
+        self.root = os.path.abspath(root)
+        self.modules = {}      # dotted modname -> ModuleInfo
+        self.by_relpath = {}   # relpath -> ModuleInfo
+        self.errors = []       # (relpath, message) parse failures
+        for path in sorted(files):
+            self._load(path)
+
+    def _load(self, path):
+        relpath = os.path.relpath(os.path.abspath(path), self.root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            self.errors.append((relpath.replace(os.sep, "/"), str(e)))
+            return
+        is_package = os.path.basename(path) == "__init__.py"
+        mp = relpath[:-3] if relpath.endswith(".py") else relpath
+        if is_package:
+            mp = os.path.dirname(relpath)
+        modname = mp.replace(os.sep, ".").replace("/", ".")
+        mod = ModuleInfo(path, relpath, modname, is_package, source, tree)
+        self.modules[modname] = mod
+        self.by_relpath[mod.relpath] = mod
+
+    def iter_modules(self):
+        for rel in sorted(self.by_relpath):
+            yield self.by_relpath[rel]
+
+    # -- call resolution ---------------------------------------------------
+    def resolve_call(self, mod, caller_qualname, func_expr):
+        """Best-effort static resolution of a call target to a FuncInfo
+        in the scanned set.  Dynamic targets resolve to None (the walk
+        stops there — deliberately conservative)."""
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            parts = caller_qualname.split(".") if caller_qualname else []
+            for i in range(len(parts), -1, -1):
+                cand = ".".join(parts[:i] + [name])
+                fi = mod.funcs.get(cand)
+                if fi is not None:
+                    return fi
+            target = mod.from_imports.get(name)
+            if target is not None:
+                tmod = self.modules.get(target[0])
+                if tmod is not None:
+                    return tmod.funcs.get(target[1])
+            return None
+        if isinstance(func_expr, ast.Attribute) and \
+                isinstance(func_expr.value, ast.Name):
+            base = func_expr.value.id
+            if base in ("self", "cls"):
+                caller = mod.funcs.get(caller_qualname)
+                cls = caller.class_name if caller else None
+                if cls:
+                    return mod.funcs.get(f"{cls}.{func_expr.attr}")
+                return None
+            target_mod = mod.alias_module(base)
+            if target_mod is not None:
+                tmod = self.modules.get(target_mod)
+                if tmod is not None:
+                    return tmod.funcs.get(func_expr.attr)
+        return None
+
+
+_PRUNE_DIRS = frozenset({"__pycache__", ".git", "build"})
+
+
+def _collect_files(paths, exts):
+    """Expand files/directories into a sorted file list, one shared
+    prune set for every pass (AST and registry alike)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, files in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d not in _PRUNE_DIRS]
+                for fn in sorted(files):
+                    if fn.endswith(exts):
+                        out.append(os.path.join(dirpath, fn))
+        elif p.endswith(exts):
+            out.append(p)
+    return sorted(set(out))
+
+
+def collect_py_files(paths):
+    return _collect_files(paths, (".py",))
+
+
+def collect_text_files(paths, exts=(".py", ".md")):
+    return _collect_files(paths, tuple(exts))

@@ -1,0 +1,189 @@
+"""Collective-order / deadlock pass: flag the SPMD deadlock shapes.
+
+XLA collectives are matched by static program order — every rank must
+issue the same collectives in the same order.  Two shapes break that:
+
+1. A collective under a *rank-dependent* (or data-dependent) branch:
+   ``if rank == 0: barrier()`` hangs every other rank forever.  The
+   classic fleet-killer; PR 2's watchdog turns the hang into a timeout,
+   this pass catches it before it ships.
+2. ``if``/``else`` arms that both issue collectives but in *different
+   static order*: rank A takes the then-arm (all_reduce, barrier), rank
+   B the else-arm (barrier, all_reduce) — each blocks in a different
+   collective and the fleet deadlocks.
+
+Heuristics are syntactic: a condition is rank-dependent if it mentions a
+rank-ish name (``rank``, ``local_rank``, ...) or call (``get_rank``,
+``axis_index``, ...); data-dependent if it calls into jnp/jax/lax (a
+traced verdict).  Uniform conditions (``process_count``, ``world_size``)
+are deliberately not flagged — every rank agrees on them.
+"""
+import ast
+
+from .base import Finding, call_terminal, dotted
+from .allowlist import COLLECTIVE_CALLEES, RANK_NAMES, RANK_FUNCS
+
+PASS_NAME = "collective-order"
+
+# host metadata every rank agrees on — a branch on these is uniform, not
+# data-dependent (e.g. `if jax.process_count() > 1: sync_global_devices()`
+# is the standard single-host fast path, not a deadlock)
+UNIFORM_FUNCS = frozenset({
+    "process_count", "device_count", "local_device_count",
+    "get_world_size", "world_size", "is_initialized",
+})
+
+
+def _collective_name(call):
+    term = call_terminal(call.func)
+    if term in COLLECTIVE_CALLEES:
+        return term
+    return None
+
+
+def _is_rankish(name):
+    return name in RANK_NAMES or name.split("_")[-1] == "rank"
+
+
+def _cond_kind(test, mod):
+    """'rank' / 'data' / None for a branch condition."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and _is_rankish(n.id):
+            return "rank"
+        if isinstance(n, ast.Attribute) and _is_rankish(n.attr):
+            return "rank"
+        if isinstance(n, ast.Call):
+            term = call_terminal(n.func)
+            if term in RANK_FUNCS:
+                return "rank"
+            if term in UNIFORM_FUNCS:
+                continue
+            name = dotted(n.func)
+            if name:
+                root = name.split(".", 1)[0]
+                target = mod.alias_module(root) or root
+                if target == "jax" or target.startswith("jax."):
+                    return "data"
+    return None
+
+
+def _collectives_in(nodes):
+    """Ordered collective-call names under ``nodes`` (no descent into
+    nested defs — they execute on their own schedule)."""
+    out = []
+    stack = list(reversed(nodes))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(n, ast.Call):
+            c = _collective_name(n)
+            if c is not None:
+                out.append((c, n))
+        stack.extend(reversed(list(ast.iter_child_nodes(n))))
+    return out
+
+
+class CollectiveOrderPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.index.iter_modules():
+            self._scan(mod, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    def _scan(self, mod, findings):
+        def flag(node, code, qual, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(
+                self.name, mod.relpath, node.lineno, qual, code, message,
+                detail))
+
+        # each branch statement is visited under exactly one owner: the
+        # innermost enclosing function (or <module>) — nested defs are
+        # skipped in the owner's walk and visited as their own unit
+        units = [("<module>", mod.tree.body)]
+        units += [(qual, mod.funcs[qual].node.body)
+                  for qual in sorted(mod.funcs)]
+        for qual, body in units:
+            stack = list(body)
+            branch_nodes = []
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(n, (ast.If, ast.While)):
+                    branch_nodes.append(n)
+                stack.extend(ast.iter_child_nodes(n))
+            branch_nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+            # one conditional-collective finding per call site: nested
+            # kind-bearing branches must not re-report a call already
+            # attributed to the outermost condition
+            flagged_calls = set()
+            # elif continuations: their chain is compared where it roots
+            elif_children = {id(b.orelse[0]) for b in branch_nodes
+                            if isinstance(b, ast.If) and
+                            len(b.orelse) == 1 and
+                            isinstance(b.orelse[0], ast.If)}
+            for n in branch_nodes:
+                kind = _cond_kind(n.test, mod)
+                if kind is not None:
+                    # an `elif` whose own condition is kind-bearing
+                    # reports its collectives itself (with the RIGHT
+                    # test text) when that nested If is visited — don't
+                    # double-report them under the outer condition.  An
+                    # elif with a neutral condition stays attributed to
+                    # the outer one (reaching it depends on it).
+                    orelse = n.orelse
+                    if len(orelse) == 1 and isinstance(orelse[0], ast.If) \
+                            and _cond_kind(orelse[0].test, mod) is not None:
+                        orelse = []
+                    for cname, cnode in _collectives_in(n.body) + \
+                            _collectives_in(orelse):
+                        if id(cnode) in flagged_calls:
+                            continue
+                        flagged_calls.add(id(cnode))
+                        flag(cnode, f"{kind}-conditional-collective", qual,
+                             f"collective `{cname}` under a "
+                             f"{kind}-dependent branch "
+                             f"(`{ast.unparse(n.test)[:60]}`) — ranks "
+                             "that skip the branch never enter the "
+                             "collective and the fleet deadlocks; hoist "
+                             "it out of the branch or make the condition "
+                             "uniform across ranks",
+                             f"{cname}:{ast.unparse(n.test)[:40]}")
+                if isinstance(n, ast.If) and n.orelse and \
+                        id(n) not in elif_children:
+                    # divergence across the WHOLE if/elif/else chain,
+                    # compared once where the chain roots.  Restricted
+                    # to all-neutral conditions: kind-bearing arms were
+                    # already flagged individually above, and flagging
+                    # their order too would double-report one defect.
+                    arms, conds, cur = [], [], n
+                    while True:
+                        arms.append(cur.body)
+                        conds.append(cur.test)
+                        if len(cur.orelse) == 1 and \
+                                isinstance(cur.orelse[0], ast.If):
+                            cur = cur.orelse[0]
+                            continue
+                        if cur.orelse:
+                            arms.append(cur.orelse)
+                        break
+                    if any(_cond_kind(c, mod) is not None for c in conds):
+                        continue
+                    seqs = [[c for c, _ in _collectives_in(a)]
+                            for a in arms]
+                    nonempty = [s for s in seqs if s]
+                    if len(nonempty) >= 2 and \
+                            any(s != nonempty[0] for s in nonempty):
+                        flag(n, "divergent-collective-order", qual,
+                             "branch arms issue different collective "
+                             f"sequences ({nonempty}) — if ranks can "
+                             "disagree on the condition each blocks in "
+                             "a different collective (SPMD deadlock); "
+                             "restructure so every arm issues the same "
+                             "sequence",
+                             "|".join("+".join(s) for s in nonempty))

@@ -1,0 +1,139 @@
+"""Host-sync budget pass: machine-check the one-sync-per-step contract.
+
+PR 2 fused ``GradScaler.unscale_`` to exactly ONE host sync per step and
+funneled every sentinel readback through ``guardian._host_bool`` so
+tests can count syncs at runtime.  That contract lived in comments; this
+pass makes it structural: every explicit sync site — ``_host_bool``,
+``.item()``/``.numpy()``, ``np.asarray``, ``device_get``,
+``block_until_ready`` — inside the monitored hot-path modules must match
+a budgeted entry in ``allowlist.HOST_SYNC_ALLOWLIST`` (with a reason),
+and a function may not grow more sites than its budget.
+
+Jit-surface functions are additionally monitored wherever they live
+(including fixture files): a sync primitive inside a surface is always a
+finding — there is no legal budget for a sync inside a trace.
+"""
+import ast
+
+from .base import Finding, call_terminal, dotted
+from .allowlist import (MONITORED_MODULES, SYNC_CALLEES, NUMPY_SYNC_FUNCS,
+                        HOST_SYNC_ALLOWLIST, EXTRA_JIT_SURFACES)
+
+PASS_NAME = "host-sync"
+
+
+def _sync_callee(call, mod):
+    """Canonical callee token if this call is a sync primitive."""
+    term = call_terminal(call.func)
+    if term in SYNC_CALLEES:
+        # `.item()`/`.numpy()` style readbacks are only syncs as
+        # zero-arg attribute calls; `_host_bool`/`device_get`/... match
+        # as plain names or module attributes
+        if term in ("item", "numpy", "tolist") and (
+                not isinstance(call.func, ast.Attribute) or call.args):
+            return None
+        return term
+    if term in NUMPY_SYNC_FUNCS:
+        name = dotted(call.func)
+        if name:
+            root = name.split(".", 1)[0]
+            target = mod.alias_module(root) or root
+            if target == "numpy" or target.startswith("numpy."):
+                return term
+    return None
+
+
+def _enclosing_qualname(mod, node):
+    """Qualname of the innermost function containing ``node`` (top-level
+    of that function counts; nested defs map to the nested qualname)."""
+    best, best_span = "<module>", None
+    for qual, fi in mod.funcs.items():
+        f = fi.node
+        end = getattr(f, "end_lineno", f.lineno)
+        if f.lineno <= node.lineno <= end:
+            span = end - f.lineno
+            if best_span is None or span < best_span:
+                best, best_span = qual, span
+    return best
+
+
+class HostSyncPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.index.iter_modules():
+            monitored = any(mod.relpath == m or mod.relpath.endswith("/" + m)
+                            for m in MONITORED_MODULES)
+            surfaces = {q for q, fi in mod.funcs.items() if fi.is_surface}
+            # nested surfaces the decorator can't reach are surfaces too
+            for rel, qual in EXTRA_JIT_SURFACES:
+                if (mod.relpath == rel or mod.relpath.endswith("/" + rel)) \
+                        and qual in mod.funcs:
+                    surfaces.add(qual)
+            if not monitored and not surfaces:
+                continue
+            self._scan(mod, monitored, surfaces, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    def _scan(self, mod, monitored, surfaces, findings):
+        # budget key -> [(node, qualname, callee), ...]
+        sites = {}
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = _sync_callee(n, mod)
+            if callee is None:
+                continue
+            qual = _enclosing_qualname(mod, n)
+            in_surface = any(qual == s or qual.startswith(s + ".")
+                             for s in surfaces)
+            if in_surface:
+                if {self.name, "sync-in-jit-surface"} & \
+                        mod.allowed_on_line(n.lineno):
+                    continue
+                findings.append(Finding(
+                    self.name, mod.relpath, n.lineno, qual,
+                    "sync-in-jit-surface",
+                    f"sync primitive `{callee}` inside jit surface "
+                    f"`{qual}` — a traced step may never read back to "
+                    "host; keep the verdict on device and sync once "
+                    "outside the trace", callee))
+                continue
+            if monitored:
+                sites.setdefault((qual, callee), []).append(n)
+        # check budgets for the monitored-module inventory
+        for (qual, callee), nodes in sorted(sites.items()):
+            # pragma'd sites are exempt BEFORE budgeting — a justified
+            # `# lint: allow(...)` site must not consume a budget slot
+            # and shift the finding onto an untouched allowlisted line
+            nodes = [x for x in nodes
+                     if not ({self.name, "unbudgeted-host-sync"}
+                             & mod.allowed_on_line(x.lineno))]
+            nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+            entry = self._allow_entry(mod.relpath, qual, callee)
+            budget = entry["max"] if entry else 0
+            for extra in nodes[budget:]:
+                if entry:
+                    msg = (f"`{qual}` has {len(nodes)} `{callee}` sync "
+                           f"site(s) but its allowlist budget is "
+                           f"{budget} — the one-sync-per-step contract "
+                           "only holds if new readbacks replace old "
+                           "ones, not stack on top")
+                else:
+                    msg = (f"unbudgeted host sync `{callee}` in hot-path "
+                           f"function `{qual}` — if this readback is "
+                           "intentional, add a HOST_SYNC_ALLOWLIST entry "
+                           "in paddle_tpu/analysis/allowlist.py with a "
+                           "reason (see docs/static_analysis.md)")
+                findings.append(Finding(
+                    self.name, mod.relpath, extra.lineno, qual,
+                    "unbudgeted-host-sync", msg, callee))
+
+    @staticmethod
+    def _allow_entry(relpath, qual, callee):
+        for (rel, q, c), entry in HOST_SYNC_ALLOWLIST.items():
+            if c == callee and q == qual and (
+                    relpath == rel or relpath.endswith("/" + rel)):
+                return entry
+        return None

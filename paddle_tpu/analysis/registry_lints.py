@@ -1,0 +1,153 @@
+"""Registry lints: the failpoint-reference and guardian-log-schema
+checks that used to live in ``tools/check_failpoints.py`` and
+``tools/check_guardian_log.py``, folded into the unified framework
+(the tools remain as thin wrappers over these passes).
+
+Unlike the AST passes these import the live framework — the failpoint
+registry and ``EVENT_SCHEMA`` are populated at import time, which is
+exactly the point: the lint compares *references* (tests/docs) against
+the *registration reality* of the running code.
+"""
+import os
+import re
+
+from .base import Finding
+
+# name references: a set_failpoint call with a quoted name, and
+# PADDLE_FAILPOINTS-shaped spec strings (name=action[;...]).  The
+# comments here deliberately avoid writing a matchable literal — this
+# very file is scanned when the lint runs over explicit paths.
+_SET_RE = re.compile(r"set_failpoint\(\s*[\"']([^\"']+)[\"']")
+_SPEC_RE = re.compile(r"[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+=[^\"']+)[\"']")
+
+# guardian-log references: an emit/events call with a quoted event
+# (positional or event=), and the docs schema table rows
+_CALL_RE = re.compile(
+    r"\b(?:emit|events)\(\s*(?:event\s*=\s*)?[\"']([a-z_]+)[\"']")
+_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|\s*`([^`]*)`", re.M)
+
+GUARDIAN_DOC = "docs/training_guardian.md"
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _line_of(text, match):
+    return text.count("\n", 0, match.start()) + 1
+
+
+class FailpointRefsPass:
+    """Every failpoint name referenced by tests/docs must exist in the
+    registry — a renamed hook site must not leave chaos tests arming a
+    failpoint that can never fire."""
+
+    name = "failpoint-refs"
+
+    def _registry(self):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ..framework import failpoints
+        # importing the hooked modules populates the registry
+        import paddle_tpu.framework.guardian        # noqa: F401
+        import paddle_tpu.distributed.store         # noqa: F401
+        import paddle_tpu.distributed.checkpoint    # noqa: F401
+        import paddle_tpu.distributed.collective    # noqa: F401
+        import paddle_tpu.distributed.fleet.elastic  # noqa: F401
+        import paddle_tpu.io.worker                 # noqa: F401
+        return failpoints
+
+    def run(self, ctx):
+        failpoints = self._registry()
+        known = failpoints.registered()
+        prefixes = {n.split(".", 1)[0] for n in known}
+        findings = []
+        for path in ctx.ref_files:
+            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+            text = _read(path)
+            for m in _SET_RE.finditer(text):
+                if m.group(1) not in known:
+                    findings.append(self._finding(rel, text, m, m.group(1)))
+            for m in _SPEC_RE.finditer(text):
+                try:
+                    parsed = failpoints.parse_spec(m.group(1))
+                except ValueError:
+                    continue    # merely spec-shaped; not a spec
+                for n in sorted(parsed):
+                    # only names carrying a registered subsystem prefix
+                    # count — an unrelated "retry.mode=skip" literal in a
+                    # test must not fail this lint
+                    if n.split(".", 1)[0] in prefixes and n not in known:
+                        findings.append(self._finding(rel, text, m, n))
+        return sorted(findings, key=Finding.sort_key)
+
+    def _finding(self, rel, text, match, name):
+        return Finding(
+            self.name, rel, _line_of(text, match), "<text>",
+            "orphan-failpoint",
+            f"failpoint {name!r} is referenced but not registered — the "
+            "chaos test silently stops testing anything; register the "
+            "site in the hooked module or fix the name", name)
+
+
+class GuardianLogSchemaPass:
+    """Guardian-log events referenced by tests/docs must match the
+    emitter's EVENT_SCHEMA, and the docs schema table must mirror it
+    field-for-field (dashboards are built from the doc)."""
+
+    name = "guardian-log"
+
+    def run(self, ctx):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ..framework.guardian import EVENT_SCHEMA
+        findings = []
+        for path in ctx.ref_files:
+            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+            text = _read(path)
+            for m in _CALL_RE.finditer(text):
+                if m.group(1) not in EVENT_SCHEMA:
+                    findings.append(Finding(
+                        self.name, rel, _line_of(text, m), "<text>",
+                        "unknown-guardian-event",
+                        f"unknown guardian event {m.group(1)!r} (known: "
+                        f"{sorted(EVENT_SCHEMA)})", m.group(1)))
+        doc = os.path.join(ctx.root, GUARDIAN_DOC)
+        # the table check runs whenever the guardian doc is in scope —
+        # an explicit `docs/` run must check the table, not skip it
+        in_scope = ctx.default_tree or any(
+            os.path.abspath(p) == os.path.abspath(doc)
+            for p in ctx.ref_files)
+        if in_scope:
+            findings.extend(self._check_doc_table(doc, EVENT_SCHEMA))
+        return sorted(findings, key=Finding.sort_key)
+
+    def _check_doc_table(self, doc, schema):
+        findings = []
+        if not os.path.exists(doc):
+            return [Finding(self.name, GUARDIAN_DOC, 1, "<doc>",
+                            "schema-drift",
+                            "docs/training_guardian.md is missing (the "
+                            "guardian log schema must be documented)",
+                            "missing-doc")]
+        text = _read(doc)
+        table = {}
+        for m in _ROW_RE.finditer(text):
+            table[m.group(1)] = (
+                {f.strip() for f in m.group(2).split(",") if f.strip()},
+                _line_of(text, m))
+        for name, (fields, line) in sorted(table.items()):
+            if name not in schema:
+                findings.append(Finding(
+                    self.name, GUARDIAN_DOC, line, "<doc>", "schema-drift",
+                    f"documents unknown event {name!r}", name))
+            elif fields != schema[name]:
+                findings.append(Finding(
+                    self.name, GUARDIAN_DOC, line, "<doc>", "schema-drift",
+                    f"event {name!r} fields {sorted(fields)} drifted from "
+                    f"emitter schema {sorted(schema[name])}", name))
+        for name in sorted(schema):
+            if name not in table:
+                findings.append(Finding(
+                    self.name, GUARDIAN_DOC, 1, "<doc>", "schema-drift",
+                    f"event {name!r} is emitted but undocumented", name))
+        return findings

@@ -1,0 +1,306 @@
+"""Tracer-safety pass: walk functions reachable from registered jit
+surfaces and flag trace-breaking patterns.
+
+A jitted function sees *tracers*, not values; any construct that needs a
+concrete value — ``float(x)``/``int(x)``/``bool(x)``, ``len(x)``,
+``.item()``/``.numpy()``, ``np.asarray(x)``, or a Python ``if``/``while``
+on a tensor expression — either crashes at trace time
+(ConcretizationTypeError) or, worse, silently bakes one traced branch
+into the compiled program.  This pass finds them statically.
+
+Mechanics:
+
+- Surfaces: functions carrying the ``@analysis.jit_surface`` decorator
+  (found syntactically, so fixture files work un-imported) plus the
+  nested functions listed in ``allowlist.EXTRA_JIT_SURFACES``.
+- Reachability: best-effort static call graph (same-module names,
+  ``self.`` methods, imported-module attributes).  Dynamic calls
+  (``self.network(...)``) stop the walk — deliberately conservative, so
+  the pass stays fast and quiet.
+- Taint: parameters of surfaces (and their nested defs — the actual
+  traced bodies built by stepper builders) are traced values; results
+  of ``jnp.*``/``jax.*``/``lax.*`` calls are traced; assignments
+  propagate.  Metadata reads (``.shape``/``.dtype``, ``issubdtype``)
+  and identity/membership tests (``is None``, ``k in cache``) are
+  trace-time-static and exempt.
+"""
+import ast
+
+from .base import Finding, call_terminal, dotted
+from .allowlist import EXTRA_JIT_SURFACES, STATIC_FUNCS, STATIC_ATTRS
+
+PASS_NAME = "tracer-safety"
+
+_CASTS = ("float", "int", "bool", "complex")
+_READBACKS = ("item", "numpy", "tolist", "block_until_ready")
+
+
+def _local_walk(fnode):
+    """Walk a function body without descending into nested defs (they
+    are analyzed as their own functions, with their own taint scope)."""
+    stack = list(fnode.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_array_ns_call(call, mod):
+    """True for calls into the jax/jnp/lax namespaces (array-producing
+    under trace), excluding the static metadata helpers."""
+    name = dotted(call.func)
+    if not name:
+        return False
+    root = name.split(".", 1)[0]
+    target = mod.alias_module(root) or root
+    if not (target == "jax" or target.startswith("jax.")):
+        return False
+    return name.split(".")[-1] not in STATIC_FUNCS
+
+
+def _is_numpy_ns_call(call, mod):
+    name = dotted(call.func)
+    if not name:
+        return False
+    root = name.split(".", 1)[0]
+    target = mod.alias_module(root) or root
+    return target == "numpy" or target.startswith("numpy.")
+
+
+def _expr_tainted(expr, tainted, mod, containers=frozenset()):
+    """Does this expression (transitively) mention a traced value?
+
+    ``containers`` holds names bound to *python containers of* traced
+    values (``dict(zip(idx, traced))``): membership over their keys is
+    host-static, but membership over a traced array itself
+    (``3 in xs``) calls the tracer's ``__contains__`` and crashes."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            continue                      # metadata: static under trace
+        if isinstance(n, ast.Call):
+            term = call_terminal(n.func)
+            if term in STATIC_FUNCS:
+                continue                  # issubdtype & co: static verdicts
+            if _is_array_ns_call(n, mod):
+                return True
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in tainted:
+            return True
+        if isinstance(n, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                continue                  # identity: host-static
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in n.ops):
+                stack.append(n.left)
+                # keys of a container-of-traced are static; a traced
+                # array as the container is not
+                for c in n.comparators:
+                    if _expr_tainted(c, tainted - containers, mod,
+                                     containers):
+                        return True
+                continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _assign_names(target):
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _assign_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _assign_names(target.value)
+
+
+def _param_names(fnode):
+    a = fnode.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+_CONTAINER_CTORS = ("dict", "list", "set", "tuple", "frozenset")
+
+
+def _compute_taint(fnode, mod, taint_params):
+    """Returns (tainted names, container-of-traced names)."""
+    tainted = set(_param_names(fnode)) if taint_params else set()
+    containers = set()
+    for _ in range(3):                     # small fixpoint: 3 rounds cover
+        before = len(tainted)              # realistic chain depths
+        for n in _local_walk(fnode):
+            if isinstance(n, ast.Assign):
+                if _expr_tainted(n.value, tainted, mod):
+                    for t in n.targets:
+                        tainted.update(_assign_names(t))
+                    v = n.value
+                    if isinstance(v, ast.Call) and \
+                            isinstance(v.func, ast.Name) and \
+                            v.func.id in _CONTAINER_CTORS:
+                        for t in n.targets:
+                            containers.update(_assign_names(t))
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                if n.value is not None and \
+                        _expr_tainted(n.value, tainted, mod):
+                    tainted.update(_assign_names(n.target))
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                if _expr_tainted(n.iter, tainted, mod):
+                    it = n.iter
+                    fname = it.func.id if isinstance(it, ast.Call) and \
+                        isinstance(it.func, ast.Name) else None
+                    if fname == "range":
+                        pass            # range() yields host ints
+                    elif fname == "enumerate" and \
+                            isinstance(n.target, ast.Tuple) and \
+                            len(n.target.elts) == 2:
+                        # the index is a host int; only the element is
+                        # traced
+                        tainted.update(_assign_names(n.target.elts[1]))
+                    else:
+                        tainted.update(_assign_names(n.target))
+            elif isinstance(n, ast.NamedExpr):
+                if _expr_tainted(n.value, tainted, mod):
+                    tainted.update(_assign_names(n.target))
+        if len(tainted) == before:
+            break
+    return tainted, containers
+
+
+class TracerSafetyPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        index = ctx.index
+        findings = []
+        # -- surface set ---------------------------------------------------
+        work = []                          # (FuncInfo, taint_params)
+        for mod in index.iter_modules():
+            for qual in sorted(mod.funcs):
+                if mod.funcs[qual].is_surface:
+                    work.append((mod.funcs[qual], True))
+        for rel, qual in EXTRA_JIT_SURFACES:
+            for mod in index.iter_modules():
+                if mod.relpath == rel or mod.relpath.endswith("/" + rel):
+                    fi = mod.funcs.get(qual)
+                    if fi is not None:
+                        work.append((fi, True))
+                    else:
+                        # a renamed nested def must not silently drop
+                        # its lint coverage — an unresolvable entry is
+                        # itself a finding
+                        findings.append(Finding(
+                            self.name, mod.relpath, 1, qual,
+                            "unresolved-surface",
+                            f"EXTRA_JIT_SURFACES names `{qual}` but no "
+                            "such function exists in this file — the "
+                            "surface was renamed or removed and is no "
+                            "longer analyzed; update "
+                            "paddle_tpu/analysis/allowlist.py (and the "
+                            "register_jit_surface call)", qual))
+        # -- reachability walk --------------------------------------------
+        done = {}                          # id(FuncInfo) -> taint flag
+        while work:
+            fi, taint_params = work.pop(0)
+            prev = done.get(id(fi))
+            if prev is not None and (prev or not taint_params):
+                continue                   # already done at >= this level
+            done[id(fi)] = taint_params
+            self._analyze(fi, taint_params, index, findings, work)
+        # findings can repeat when a function is re-analyzed with
+        # upgraded taint — dedupe on full identity
+        uniq = {}
+        for f in findings:
+            uniq[(f.path, f.line, f.code, f.detail, f.message)] = f
+        return sorted(uniq.values(), key=Finding.sort_key)
+
+    # -- per-function analysis --------------------------------------------
+    def _analyze(self, fi, taint_params, index, findings, work):
+        mod = fi.module
+        fnode = fi.node
+        tainted, containers = _compute_taint(fnode, mod, taint_params)
+
+        def flag(node, code, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(
+                self.name, mod.relpath, node.lineno, fi.qualname, code,
+                message, detail))
+
+        # nested defs are the traced bodies the builders return — they
+        # inherit the surface's taint discipline
+        prefix = fi.qualname + "."
+        for qual in sorted(mod.funcs):
+            if qual.startswith(prefix) and "." not in qual[len(prefix):]:
+                work.append((mod.funcs[qual], taint_params))
+
+        for n in _local_walk(fnode):
+            if isinstance(n, ast.Call):
+                self._check_call(n, fi, mod, tainted, containers, flag)
+                callee = index.resolve_call(mod, fi.qualname, n.func)
+                if callee is not None:
+                    work.append((callee, False))
+            elif isinstance(n, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                if _expr_tainted(n.test, tainted, mod, containers):
+                    kind = {ast.If: "if", ast.While: "while",
+                            ast.IfExp: "if-expression",
+                            ast.Assert: "assert"}[type(n)]
+                    flag(n, "control-flow-on-traced",
+                         f"Python `{kind}` on a traced tensor expression "
+                         f"(`{ast.unparse(n.test)[:60]}`) — under jit this "
+                         "needs a concrete value: use lax.cond/jnp.where "
+                         "(or checkify for asserts), or hoist the "
+                         "decision to trace time",
+                         f"{kind}:{ast.unparse(n.test)[:40]}")
+
+    def _check_call(self, n, fi, mod, tainted, containers, flag):
+        args = list(n.args) + [kw.value for kw in n.keywords]
+        term = call_terminal(n.func)
+        if isinstance(n.func, ast.Name) and n.func.id in _CASTS:
+            if any(_expr_tainted(a, tainted, mod, containers)
+                   for a in args):
+                flag(n, "cast-on-traced",
+                     f"`{n.func.id}()` on a traced value forces a host "
+                     "sync / ConcretizationTypeError under jit — keep the "
+                     "verdict on device (jnp.where/lax.cond) or read it "
+                     "back once through guardian._host_bool outside the "
+                     "trace", n.func.id)
+            return
+        if isinstance(n.func, ast.Name) and n.func.id == "len":
+            if any(_expr_tainted(a, tainted, mod, containers)
+                   for a in args):
+                flag(n, "len-on-traced",
+                     "`len()` on a possibly-traced array — use "
+                     "`x.shape[0]` (static under trace)", "len")
+            return
+        if isinstance(n.func, ast.Attribute) and term in _READBACKS \
+                and not args:
+            flag(n, "host-readback",
+                 f"`.{term}()` is a device->host readback — illegal "
+                 "inside a jitted path (and a hidden sync anywhere on "
+                 "the step path)", term)
+            return
+        if term == "device_get":
+            flag(n, "host-readback",
+                 "`device_get` inside jit-reachable code is a host "
+                 "readback", term)
+            return
+        if term == "_host_bool":
+            flag(n, "host-sync-in-trace",
+                 "guardian._host_bool is THE counted host sync — it must "
+                 "run outside the traced step, on the returned flag",
+                 term)
+            return
+        if _is_numpy_ns_call(n, mod):
+            if any(_expr_tainted(a, tainted, mod, containers)
+                   for a in args):
+                flag(n, "numpy-on-traced",
+                     f"`{dotted(n.func)}` on a traced value materializes "
+                     "it on host (breaks tracing; silent sync in eager) — "
+                     "use the jnp equivalent", dotted(n.func) or "np")

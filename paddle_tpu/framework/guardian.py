@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import failpoints as _fp
+from ..analysis import jit_surface
 
 __all__ = [
     "EVENT_SCHEMA", "emit", "events", "clear_events",
@@ -176,6 +177,7 @@ def host_sync_count():
     return HOST_SYNC_COUNT
 
 
+@jit_surface
 def tree_all_finite(leaves):
     """ONE fused device-side finite-check over a list of arrays/Tensors.
 

@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import jit_surface
 from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework import guardian as _guardian
@@ -160,6 +161,7 @@ class _CompiledStepper:
             loss = total
         return loss._value
 
+    @jit_surface
     def _build_train(self, n_in, n_lab):
         opt = self.optimizer
         t_idx = self.t_idx
@@ -170,10 +172,8 @@ class _CompiledStepper:
         def step(train_vals, frozen_vals, buffer_vals, opt_state, lr, key,
                  inputs, labels):
             def loss_f(tv):
-                full = list(frozen_vals)
                 # merge trainable into full param list
                 pv = []
-                ti = iter(range(len(tv)))
                 tv_map = dict(zip(t_idx, tv))
                 fi = iter(frozen_vals)
                 for i in range(len(self.params)):
@@ -233,6 +233,7 @@ class _CompiledStepper:
                           self._input_shardings, self._label_shardings),
             out_shardings=out_sh)
 
+    @jit_surface
     def _build_grad(self):
         """Gradient-only step (no optimizer apply) for accumulation."""
         amp = self.amp_level
@@ -262,6 +263,7 @@ class _CompiledStepper:
             return loss, out_vals, new_buf, grads
         return jax.jit(gstep)
 
+    @jit_surface
     def _build_apply(self):
         opt = self.optimizer
         pnames = [self.param_names[i] for i in self.t_idx]
@@ -271,6 +273,7 @@ class _CompiledStepper:
                 opt, train_vals, grads, opt_state, lr, param_names=pnames)
         return jax.jit(astep, donate_argnums=(0, 2))
 
+    @jit_surface
     def _build_eval(self, n_in):
         def step(param_vals, buffer_vals, key, inputs):
             out_vals, _ = self._forward_pure(param_vals, buffer_vals, key,

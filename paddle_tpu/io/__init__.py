@@ -8,7 +8,10 @@ subprocesses for both dataset kinds (workers touch only numpy, never the
 PJRT client — device collation happens in the parent); a threaded
 fallback covers fork-less platforms.
 """
+import copy as _copy
+import inspect as _inspect
 import itertools
+import warnings as _warnings
 import queue as _queue
 import threading
 from collections import deque as _deque
@@ -336,6 +339,7 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
+        self._threaded_needs_copy = None   # probe cache, see below
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -463,6 +467,30 @@ class DataLoader:
                   for _ in range(n)]
         sentinel = object()
         stop = threading.Event()
+        # decide ONCE per loader whether producers need their own
+        # dataset copy: a generator-function __iter__ mints a fresh
+        # iterator object per call (zero-copy, the common case, no
+        # probe); otherwise probe — __iter__ returning the SAME object
+        # twice (returns self, or a stored iterator) is the raced shape
+        # ADVICE r5 flagged, and only that shape pays the per-thread
+        # deepcopy (N copies of a big in-memory dataset would be a RAM
+        # blowup the fork path never pays, thanks to COW).  The probe
+        # result is cached so a side-effectful __iter__ is probed at
+        # most once per loader, not once per epoch.  KNOWN LIMIT: a
+        # fresh generator that DRAINS shared stored state (e.g.
+        # `for i in self._it: yield i`) is indistinguishable from a
+        # stateless one here and still shares — such datasets must not
+        # store their iterator, or should be fed pre-copied per loader.
+        if self._threaded_needs_copy is None:
+            if _inspect.isgeneratorfunction(type(self.dataset).__iter__):
+                self._threaded_needs_copy = False
+            else:
+                try:
+                    self._threaded_needs_copy = \
+                        iter(self.dataset) is iter(self.dataset)
+                except Exception:
+                    self._threaded_needs_copy = True
+        needs_copy = self._threaded_needs_copy
 
         def put(wid, item):
             # bounded put that gives up when the consumer is gone, so an
@@ -477,10 +505,28 @@ class DataLoader:
 
         def produce(wid):
             try:
-                _worker_info.info = _WorkerInfo(wid, n, self.dataset)
+                ds = self.dataset
+                if needs_copy:
+                    try:
+                        ds = _copy.deepcopy(ds)
+                    except Exception as e:
+                        # the shared instance may hold ONE iterator
+                        # raced across workers — warn, don't silently
+                        # corrupt data coverage
+                        _warnings.warn(
+                            f"DataLoader threaded fallback: dataset "
+                            f"{type(ds).__name__} is not deep-copyable "
+                            f"({e!r}); producer threads will SHARE the "
+                            "instance — if its __iter__ returns a "
+                            "shared stateful iterator, per-worker data "
+                            "coverage is undefined. Implement __iter__ "
+                            "as a generator (zero-copy, safe) or make "
+                            "the dataset deep-copyable.")
+                        ds = self.dataset
+                _worker_info.info = _WorkerInfo(wid, n, ds)
                 if self.worker_init_fn is not None:
                     self.worker_init_fn(wid)
-                it = iter(self.dataset)
+                it = iter(ds)
                 if self.batch_size is None:  # auto-batching disabled
                     batches = it
                 else:

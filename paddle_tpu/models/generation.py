@@ -14,9 +14,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import register_jit_surface
 from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework.random import rng_scope
+
+# generate()'s compiled bodies are nested defs a decorator can't reach —
+# registered here for the tracer-safety pass (mirrored by
+# EXTRA_JIT_SURFACES in paddle_tpu/analysis/allowlist.py)
+for _qual in ("generate.run", "generate.beam_run", "generate.apply",
+              "generate.pick", "generate.prefill"):
+    register_jit_surface(__name__, _qual)
 
 
 class _GenCaches(dict):
@@ -82,7 +90,12 @@ def _top_k_top_p_filter(logits, top_k, top_p):
     """Mask logits outside the top-k set / top-p nucleus to -inf.
     (B, V) fp32; always keeps at least the argmax."""
     if top_k and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        # clamp to the vocab: the habitual top_k=50 on a small-vocab
+        # model must degrade to "keep everything", not crash the trace
+        # with an out-of-bounds static index (reference TopKProcess
+        # clamps the same way)
+        k = min(int(top_k), logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
         desc = jnp.sort(logits, axis=-1)[:, ::-1]

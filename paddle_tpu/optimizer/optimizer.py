@@ -12,6 +12,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import jit_surface
 from ..framework.core import Tensor
 from ..framework.autograd import no_grad
 from ..framework import guardian as _guardian
@@ -23,6 +24,7 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
            "apply_functional_with_clip"]
 
 
+@jit_surface
 def apply_functional_with_clip(opt, train_vals, grads, opt_state, lr,
                                param_names=None):
     """Jit-side optimizer dispatch shared by every compiled stepper
@@ -181,12 +183,14 @@ class Optimizer:
         for p, st in zip(params, state):
             self._accumulators[id(p)] = st
 
+    @jit_surface
     def apply_functional(self, param_values, grad_values, state, lr,
                          param_names=None):
         """Pure: returns (new_param_values, new_state).  lr is a scalar
         (python float or traced array)."""
         new_params, new_state = [], []
-        names = param_names or [None] * len(param_values)
+        # len() of the python param LIST, not of an array — trace-static
+        names = param_names or [None] * len(param_values)  # lint: allow(len-on-traced)
         for p, g, st, nm in zip(param_values, grad_values, state, names):
             if g is None:
                 new_params.append(p)

@@ -37,3 +37,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "guardian: training-guardian (sentinel/ladder/"
         "watchdog) test — select with -m guardian")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis suite (paddle_tpu.analysis) "
+        "test — select with -m lint")

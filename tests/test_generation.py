@@ -132,6 +132,25 @@ class TestSampling:
         out = np.asarray(_top_k_top_p_filter(lg, 0, 1e-9))
         assert np.isfinite(out[0, 0]) and np.all(out[0, 1:] == -np.inf)
 
+    def test_top_k_larger_than_vocab_is_clamped(self, gpt):
+        # the habitual top_k=50 on a small-vocab model used to be an
+        # out-of-bounds static index at trace time (ADVICE r5) — exactly
+        # the class the tracer-safety lint targets; it must degrade to
+        # "keep everything"
+        from paddle_tpu.models.generation import _top_k_top_p_filter
+        lg = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        out = np.asarray(_top_k_top_p_filter(lg, 50, 1.0))
+        assert np.all(np.isfinite(out))     # vocab of 4: nothing masked
+        out = np.asarray(_top_k_top_p_filter(lg, 2, 1.0))
+        assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+        assert out[0, 2] == -np.inf and out[0, 3] == -np.inf
+        # end-to-end: sampling with an oversized top_k must not crash
+        ids = np.asarray([[9, 10, 11]], dtype="int32")
+        toks, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                               decode_strategy="sampling", top_k=10_000,
+                               seed=7)
+        assert np.asarray(toks._value).shape == (1, 4)
+
     def test_temperature_changes_distribution(self, gpt):
         ids = np.asarray([[3, 1, 4]], dtype="int32")
         hot, _ = gpt.generate(paddle.to_tensor(ids), max_new_tokens=16,

@@ -177,6 +177,83 @@ class TestIterableMultiProcess:
                                     num_workers=2)]
         assert sorted(vals) == sorted(list(range(10)) * 2)
 
+    def test_threaded_fallback_replicates_shared_iterator_dataset(
+            self, monkeypatch):
+        # ADVICE r5: a dataset whose __iter__ returns a SHARED stateful
+        # iterator (returns self) used to be raced across the N producer
+        # threads into arbitrary splits; the fork path replicates the
+        # dataset per worker, so the threaded fallback must deep-copy to
+        # match (each worker sees the full sequence)
+        import paddle_tpu.io.worker as worker_mod
+
+        class NoFork:
+            def __init__(self, *a, **k):
+                raise ValueError("cannot find context for 'fork'")
+
+        class SharedIter(IterableDataset):
+            def __init__(self, n):
+                self.n = n
+                self._it = None
+
+            def __iter__(self):
+                if self._it is None:
+                    self._it = iter(range(self.n))
+                return self
+
+            def __next__(self):
+                i = next(self._it)
+                return np.asarray([i], dtype=np.float32)
+
+        class StoredIter(IterableDataset):
+            # the sneakier raced shape: __iter__ returns a stored
+            # iterator rather than self
+            def __init__(self, n):
+                self._it = iter([np.asarray([i], dtype=np.float32)
+                                 for i in range(n)])
+
+            def __iter__(self):
+                return self._it
+
+        class FreshPlain(IterableDataset):
+            # plain-function __iter__ that mints fresh iterators: safe
+            # WITHOUT copying — must keep the zero-copy path (a big
+            # in-memory dataset must not be duplicated per thread)
+            copies = 0
+
+            def __init__(self, n):
+                self.records = [np.asarray([i], dtype=np.float32)
+                                for i in range(n)]
+
+            def __deepcopy__(self, memo):
+                FreshPlain.copies += 1
+                return self
+
+            def __iter__(self):
+                return iter(self.records)
+
+        monkeypatch.setattr(worker_mod, "IterableMultiProcessIter", NoFork)
+        for ds_cls in (SharedIter, StoredIter, FreshPlain):
+            vals = _values(DataLoader(ds_cls(30), batch_size=5,
+                                      num_workers=2))
+            # replication semantics: every element exactly once PER worker
+            assert sorted(vals) == sorted(list(range(30)) * 2), ds_cls
+        assert FreshPlain.copies == 0, "fresh-iterator dataset was copied"
+
+        # the needs-copy probe (2 extra __iter__ calls) runs at most
+        # once per LOADER, not once per epoch
+        class Counting(FreshPlain):
+            calls = 0
+
+            def __iter__(self):
+                Counting.calls += 1
+                return iter(self.records)
+
+        loader = DataLoader(Counting(10), batch_size=5, num_workers=2)
+        for _ in range(2):
+            assert len(_values(loader)) == 20
+        # 2 probe calls + 2 workers x 2 epochs
+        assert Counting.calls == 6, Counting.calls
+
     def test_threaded_fallback_early_break_retires_producers(
             self, monkeypatch):
         import gc

@@ -5,74 +5,20 @@ registry (paddle_tpu/framework/failpoints.py sites register at import).
 A renamed or deleted hook site would otherwise leave chaos tests arming a
 failpoint that can never fire — the test silently stops testing anything.
 
+Thin wrapper over the unified static-analysis runner (the pass itself
+lives in paddle_tpu/analysis/registry_lints.py; ``python tools/lint.py``
+runs it together with the other passes).
+
 Usage: python tools/check_failpoints.py   (exit 0 clean, 1 on orphans)
 """
 import os
-import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# importing the hooked modules populates the registry
-from paddle_tpu.framework import failpoints  # noqa: E402
-import paddle_tpu.framework.guardian  # noqa: F401,E402
-import paddle_tpu.distributed.store  # noqa: F401,E402
-import paddle_tpu.distributed.checkpoint  # noqa: F401,E402
-import paddle_tpu.distributed.collective  # noqa: F401,E402
-import paddle_tpu.distributed.fleet.elastic  # noqa: F401,E402
-import paddle_tpu.io.worker  # noqa: F401,E402
-
-# name references: set_failpoint("<name>", ...) and spec strings of the
-# PADDLE_FAILPOINTS form "<name>=<action>[;...]"
-_SET_RE = re.compile(r"set_failpoint\(\s*[\"']([^\"']+)[\"']")
-_SPEC_RE = re.compile(
-    r"[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+=[^\"']+)[\"']")
-
-
-def references(text, known_prefixes):
-    """set_failpoint("...") names are always checked; spec-shaped
-    strings count only when their name carries a registered subsystem
-    prefix (store./ckpt./...) — an unrelated "retry.mode=skip" literal
-    elsewhere in a test must not fail this lint."""
-    names = set(_SET_RE.findall(text))
-    for spec in _SPEC_RE.findall(text):
-        try:
-            parsed = failpoints.parse_spec(spec)
-        except ValueError:
-            continue    # string merely looks spec-shaped; not a spec
-        names.update(n for n in parsed
-                     if n.split(".", 1)[0] in known_prefixes)
-    return names
-
-
-def main():
-    roots = [os.path.join(REPO, "tests"), os.path.join(REPO, "docs")]
-    known = failpoints.registered()
-    known_prefixes = {n.split(".", 1)[0] for n in known}
-    bad = []
-    for root in roots:
-        for dirpath, _, files in os.walk(root):
-            for fn in files:
-                if not fn.endswith((".py", ".md")):
-                    continue
-                path = os.path.join(dirpath, fn)
-                with open(path, encoding="utf-8") as f:
-                    text = f.read()
-                for name in sorted(references(text, known_prefixes)
-                                   - known):
-                    bad.append((os.path.relpath(path, REPO), name))
-    if bad:
-        print("unknown failpoint name(s) referenced:")
-        for path, name in bad:
-            print(f"  {path}: {name!r}")
-        print(f"registered sites: {', '.join(sorted(known))}")
-        return 1
-    print(f"OK: all failpoint references resolve "
-          f"({len(known)} registered sites)")
-    return 0
-
+from paddle_tpu.analysis import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--passes", "failpoint-refs", "--no-baseline"]))

@@ -1,100 +1,23 @@
 #!/usr/bin/env python
 """Lint: guardian-log events referenced by tests/docs must match the
-emitter's schema (paddle_tpu/framework/guardian.py EVENT_SCHEMA).
+emitter's schema (paddle_tpu/framework/guardian.py EVENT_SCHEMA), and
+the docs schema table must mirror it field-for-field — dashboards are
+built from the doc, so a drifted table is a lying contract.
 
-Two contracts, both directions:
-
-1. Every event name a test or doc references — ``emit("name", ...)``,
-   ``events("name")``, or a ``| `name` | ... |`` row of the schema table
-   in docs/training_guardian.md — must exist in EVENT_SCHEMA (a renamed
-   event must not leave tests silently asserting on an empty filter).
-2. The docs schema table must list every EVENT_SCHEMA event with
-   exactly the emitter's field set — dashboards are built from the doc,
-   so a drifted table is a lying contract.
+Thin wrapper over the unified static-analysis runner (the pass itself
+lives in paddle_tpu/analysis/registry_lints.py; ``python tools/lint.py``
+runs it together with the other passes).
 
 Usage: python tools/check_guardian_log.py   (exit 0 clean, 1 on drift)
 """
 import os
-import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from paddle_tpu.framework.guardian import EVENT_SCHEMA  # noqa: E402
-
-DOC = os.path.join(REPO, "docs", "training_guardian.md")
-
-# emit("name", ...) / events("name") / events(event="name")
-_CALL_RE = re.compile(
-    r"\b(?:emit|events)\(\s*(?:event\s*=\s*)?[\"']([a-z_]+)[\"']")
-# docs schema table row: | `event_name` | `field, field, ...` |
-_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|\s*`([^`]*)`", re.M)
-
-
-def code_references():
-    refs = []    # (relpath, name)
-    for root in (os.path.join(REPO, "tests"), os.path.join(REPO, "docs")):
-        for dirpath, _, files in os.walk(root):
-            for fn in files:
-                if not fn.endswith((".py", ".md")):
-                    continue
-                path = os.path.join(dirpath, fn)
-                with open(path, encoding="utf-8") as f:
-                    text = f.read()
-                for name in _CALL_RE.findall(text):
-                    refs.append((os.path.relpath(path, REPO), name))
-    return refs
-
-
-def doc_table():
-    """{event: {fields}} parsed from the docs schema table."""
-    if not os.path.exists(DOC):
-        return None
-    with open(DOC, encoding="utf-8") as f:
-        text = f.read()
-    out = {}
-    for name, fields in _ROW_RE.findall(text):
-        out[name] = {f.strip() for f in fields.split(",") if f.strip()}
-    return out
-
-
-def main():
-    problems = []
-    for path, name in code_references():
-        if name not in EVENT_SCHEMA:
-            problems.append(f"{path}: unknown guardian event {name!r}")
-    table = doc_table()
-    if table is None:
-        problems.append(f"{os.path.relpath(DOC, REPO)}: missing (the "
-                        "guardian log schema must be documented)")
-    else:
-        for name, fields in table.items():
-            if name not in EVENT_SCHEMA:
-                problems.append(
-                    f"docs/training_guardian.md: documents unknown event "
-                    f"{name!r}")
-            elif fields != EVENT_SCHEMA[name]:
-                problems.append(
-                    f"docs/training_guardian.md: event {name!r} fields "
-                    f"{sorted(fields)} drifted from emitter schema "
-                    f"{sorted(EVENT_SCHEMA[name])}")
-        for name in EVENT_SCHEMA:
-            if name not in table:
-                problems.append(
-                    f"docs/training_guardian.md: event {name!r} is "
-                    "emitted but undocumented")
-    if problems:
-        print("guardian log schema drift:")
-        for p in problems:
-            print(f"  {p}")
-        print(f"emitter schema: {', '.join(sorted(EVENT_SCHEMA))}")
-        return 1
-    print(f"OK: guardian log references and docs match the emitter "
-          f"schema ({len(EVENT_SCHEMA)} events)")
-    return 0
-
+from paddle_tpu.analysis import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--passes", "guardian-log", "--no-baseline"]))

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Unified static-analysis entry point (thin wrapper over
+``python -m paddle_tpu.analysis``).
+
+Runs all passes — tracer-safety, host-sync budget, collective-order,
+failpoint-refs, guardian-log — over the repo, suppressing findings
+recorded in ``tools/lint_baseline.json``.  Exit 0 when no NEW findings,
+1 otherwise.
+
+Usage:
+    python tools/lint.py                 # human output vs baseline
+    python tools/lint.py --json          # machine-readable findings
+    python tools/lint.py --no-baseline   # everything, no suppression
+    python tools/lint.py --update-baseline
+    python tools/lint.py --passes tracer-safety,host-sync
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
