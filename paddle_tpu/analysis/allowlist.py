@@ -17,6 +17,7 @@ MONITORED_MODULES = (
     "paddle_tpu/amp/__init__.py",
     "paddle_tpu/hapi/model.py",
     "paddle_tpu/optimizer/optimizer.py",
+    "paddle_tpu/inference/serving.py",
 )
 
 # Call terminals that force (or mark) a device->host sync.
@@ -81,6 +82,18 @@ HOST_SYNC_ALLOWLIST = {
      "asarray"):
         {"max": 1, "reason": "checkpoint-restore path: host state_dict "
                              "values are ingested (H2D), never per-step"},
+    # serving engine: the one-host-sync-per-chunk contract — the chunk
+    # boundary reads back ONE bundled device_get (prefill first-tokens +
+    # chunk tokens + slot liveness); everything else stays on device
+    ("paddle_tpu/inference/serving.py", "ServingEngine._sync",
+     "device_get"):
+        {"max": 1, "reason": "THE chunk-boundary readback: one bundled "
+                             "device_get per decode chunk streams tokens "
+                             "and frees slots — never per token"},
+    ("paddle_tpu/inference/serving.py", "ServingEngine.submit",
+     "asarray"):
+        {"max": 1, "reason": "H2D ingest of the request prompt (host "
+                             "list/array -> int32), not a readback"},
 }
 
 # -- tracer-safety (tracer_safety.py) --------------------------------------
@@ -91,9 +104,13 @@ HOST_SYNC_ALLOWLIST = {
 EXTRA_JIT_SURFACES = (
     ("paddle_tpu/models/generation.py", "generate.run"),
     ("paddle_tpu/models/generation.py", "generate.beam_run"),
-    ("paddle_tpu/models/generation.py", "generate.apply"),
-    ("paddle_tpu/models/generation.py", "generate.pick"),
     ("paddle_tpu/models/generation.py", "generate.prefill"),
+    # apply/pick builders shared by generate() and the serving engine
+    ("paddle_tpu/models/generation.py", "build_apply.apply"),
+    ("paddle_tpu/models/generation.py", "build_pick.pick"),
+    # serving engine: bucket prefill + chunked decode (inference/serving.py)
+    ("paddle_tpu/inference/serving.py", "_build_prefill.prefill"),
+    ("paddle_tpu/inference/serving.py", "_build_decode_chunk.decode_chunk"),
 )
 
 # Call terminals that return *static* (trace-time) values even when

@@ -103,6 +103,15 @@ EVENT_SCHEMA = {
     # amp.debugging.check_numerics hit (or was failpoint-forced)
     "check_numerics": {"op_type", "var_name", "nan_count", "inf_count",
                        "forced"},
+    # serving engine (inference/serving.py): request admitted into a
+    # slot — its bucket prefill was dispatched
+    "serving_admit": {"req_id", "slot", "queue_depth", "prompt_len",
+                      "bucket"},
+    # serving engine: request completed (eos/budget) and its slot freed
+    "serving_finish": {"req_id", "slot", "tokens", "ttft_ms", "reason"},
+    # serving engine: one run()'s aggregate throughput/latency counters
+    "serving_stats": {"requests", "decoded_tokens", "chunks", "prefills",
+                      "mean_ttft_ms", "tokens_per_sec", "queue_depth"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

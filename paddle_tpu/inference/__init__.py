@@ -285,3 +285,14 @@ def convert_to_mixed_precision(src_model, src_params, dst_model,
 
 
 __all__ += ["get_version", "convert_to_mixed_precision"]
+
+# continuous-batching serving engine (lazy: serving pulls in the model
+# stack; Predictor users shouldn't pay for it)
+def __getattr__(name):
+    if name in ("ServingEngine", "FCFSScheduler", "Request"):
+        from . import serving as _serving
+        return getattr(_serving, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ += ["ServingEngine", "FCFSScheduler", "Request"]

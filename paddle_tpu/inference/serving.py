@@ -1,0 +1,407 @@
+"""Continuous-batching serving engine: slot-based compiled decode with
+in-flight admission (reference: the inference Predictor driving
+``fused_multi_transformer`` cache_kv decode / ``block_multihead_attention``
+paged KV).
+
+``models.generation.generate()`` decodes one *static* batch: finished
+rows burn FLOPs emitting pad until the slowest row drains, and a new
+request cannot start until the whole batch finishes.  This engine keeps
+**S fixed slots** alive instead:
+
+- per-slot device state (``tokens``/``pos``/``active``/``remaining``)
+  and per-slot preallocated KV ``(S, MAX, nH, D)`` per layer — the same
+  fixed-buffer cache ``generate()`` uses, indexed per-row via the
+  vector-``pos`` cached-attention path;
+- decode runs as ONE compiled ``lax.scan`` over a tunable ``chunk`` of
+  tokens (dispatch through the axon tunnel costs ~105 ms — stepping
+  from host per token would be latency death; chunking amortizes it
+  exactly like ``generate()``'s single scan);
+- between chunks the FCFS scheduler admits queued requests into freed
+  slots: prefill compiles at a small set of power-of-two length
+  buckets, right-pads the prompt to the bucket (pad positions sit
+  *after* the real tokens, so the causal prefix mask already excludes
+  them, and decode overwrites them before they are ever attended), and
+  writes the prompt's KV directly into the assigned slot;
+- the chunk boundary costs exactly ONE host sync (a single
+  ``jax.device_get`` of the token/state bundle — budgeted in
+  ``analysis.allowlist.HOST_SYNC_ALLOWLIST``), which streams per-token
+  callbacks and frees finished slots.
+
+Greedy decode only (token picks shared bitwise with ``generate()`` via
+``build_pick``); TTFT/throughput/queue-depth counters go to the
+guardian structured log (``serving_admit``/``serving_finish``/
+``serving_stats``) and profiler ``RecordEvent`` spans.  See
+``docs/serving.md``.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..analysis import register_jit_surface
+from ..framework import guardian
+from ..models.generation import (build_apply, build_pick, cast_weights,
+                                 dominant_float_dtype)
+from ..profiler import RecordEvent
+from .scheduler import FCFSScheduler, Request
+
+__all__ = ["ServingEngine", "Request", "FCFSScheduler"]
+
+# the compiled bodies are nested defs a decorator can't reach —
+# registered for the tracer-safety pass (mirrored by EXTRA_JIT_SURFACES
+# in paddle_tpu/analysis/allowlist.py)
+for _qual in ("_build_prefill.prefill", "_build_decode_chunk.decode_chunk"):
+    register_jit_surface(__name__, _qual)
+
+
+def _build_prefill(apply, pick, spec, cache_dtype, MAX, eos):
+    """Compiled prefill for one length bucket: run the model over the
+    right-padded (1, bucket) prompt with fresh single-row caches, pick
+    the first generated token from the last *real* position, scatter the
+    prompt KV into the assigned slot, and arm the slot's decode state."""
+    def prefill(pv, ids, length, slot, budget, tokens, pos, active,
+                remaining, caches):
+        fresh = [(jnp.zeros((1, MAX, nh, d), cache_dtype),
+                  jnp.zeros((1, MAX, nh, d), cache_dtype))
+                 for nh, d in spec]
+        logits, new = apply(pv, ids, fresh, jnp.zeros((), jnp.int32))
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, length - 1, 1, axis=1)[:, 0]            # (1, V)
+        t0, _ = pick(last, jax.random.key(0))               # (1,)
+        t0 = t0[0]
+        caches = [(jax.lax.dynamic_update_slice(
+                       ck, nk.astype(ck.dtype), (slot, 0, 0, 0)),
+                   jax.lax.dynamic_update_slice(
+                       vc, nv.astype(vc.dtype), (slot, 0, 0, 0)))
+                  for (ck, vc), (nk, nv) in zip(caches, new)]
+        hit_eos = (t0 == eos) if eos is not None else jnp.asarray(False)
+        fin0 = hit_eos | (budget <= 1)
+        tokens = tokens.at[slot].set(t0)
+        pos = pos.at[slot].set(length)
+        active = active.at[slot].set(~fin0)
+        remaining = remaining.at[slot].set(budget - 1)
+        return t0, fin0, tokens, pos, active, remaining, caches
+    return prefill
+
+
+def _build_decode_chunk(apply, pick, chunk, eos, pad):
+    """Compiled decode over ``chunk`` tokens for all S slots: one
+    ``lax.scan`` whose body advances only active slots (inactive slots
+    ride along emitting pad with ``valid=False``), exactly the masked-
+    finish formulation ``generate()`` uses — so dispatch amortizes the
+    same way and greedy picks stay bitwise-identical."""
+    def decode_chunk(pv, tokens, pos, active, remaining, caches):
+        def body(carry, _):
+            tokens, pos, active, remaining, caches = carry
+            logits, caches = apply(pv, tokens[:, None], caches, pos)
+            nxt, _ = pick(logits[:, 0, :], jax.random.key(0))
+            nxt = jnp.where(active, nxt, jnp.int32(pad))
+            emitted = active
+            live = active.astype(jnp.int32)
+            pos = pos + live
+            remaining = remaining - live
+            hit_eos = (nxt == eos) if eos is not None \
+                else jnp.zeros_like(active)
+            done = active & (hit_eos | (remaining <= 0))
+            tokens = jnp.where(active, nxt, tokens)
+            active = active & ~done
+            return (tokens, pos, active, remaining, caches), (nxt, emitted)
+        carry = (tokens, pos, active, remaining, caches)
+        (tokens, pos, active, remaining, caches), (toks, valid) = \
+            jax.lax.scan(body, carry, None, length=chunk)
+        return tokens, pos, active, remaining, caches, toks, valid
+    return decode_chunk
+
+
+class ServingEngine:
+    """Continuous-batching greedy decode over ``num_slots`` fixed slots.
+
+    Usage::
+
+        eng = ServingEngine(model, num_slots=8, chunk=32)
+        req = eng.submit(prompt_ids, max_new_tokens=64,
+                         callback=lambda r, tok, last: ...)
+        eng.run()              # drain queue + in-flight work
+        req.tokens             # generated ids (list of host ints)
+
+    Knobs:
+
+    - ``num_slots``: concurrent sequences (the compiled batch width);
+    - ``chunk``: decode tokens per dispatch (16-64; amortizes the ~105ms
+      tunnel dispatch latency vs. admission latency at chunk boundaries);
+    - ``prefill_buckets``: compile-once prompt length buckets (prompts
+      right-pad to the smallest fitting bucket);
+    - ``max_prefills_per_gap``: the prefill-vs-decode interleave knob
+      (see :class:`FCFSScheduler`);
+    - ``dtype``: e.g. ``"bfloat16"`` casts weights + KV once
+      (``cast_weights``) like ``generate(dtype=...)``.
+
+    The engine snapshots parameter values at construction; rebuild it
+    (or call :meth:`refresh_weights`) after a training step.  Greedy
+    only — sampling state per slot is future work (docs/serving.md).
+    """
+
+    def __init__(self, model, num_slots=8, chunk=32, max_seq_len=None,
+                 prefill_buckets=None, dtype=None, eos_token_id=None,
+                 pad_token_id=0, max_prefills_per_gap=None):
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        self.model = model
+        cfg = getattr(model, "config", None) \
+            or getattr(getattr(model, "model", None), "config", None)
+        limit = getattr(cfg, "max_position_embeddings", None)
+        self.MAX = int(max_seq_len or limit or 2048)
+        if limit is not None and self.MAX > limit:
+            raise ValueError(
+                f"max_seq_len {self.MAX} exceeds the model's "
+                f"max_position_embeddings {limit}")
+        self.num_slots = int(num_slots)
+        self.chunk = int(chunk)
+        self.eos = None if eos_token_id is None else int(eos_token_id)
+        self.pad = int(pad_token_id)
+        if prefill_buckets is None:
+            b, buckets = 16, []
+            while b < self.MAX:
+                buckets.append(b)
+                b *= 2
+            prefill_buckets = buckets or [self.MAX - 1]
+        self.buckets = sorted(int(b) for b in prefill_buckets)
+        if self.buckets[-1] >= self.MAX:
+            raise ValueError(
+                "largest prefill bucket must leave room for at least one "
+                f"generated token (bucket {self.buckets[-1]} >= "
+                f"max_seq_len {self.MAX})")
+        self._params = [p for _, p in model.named_parameters()]
+        self._spec = model.kv_cache_spec()
+        self._pvals = [p._value for p in self._params]
+        self.cache_dtype = dominant_float_dtype(self._pvals)
+        self._cast_override = dtype is not None
+        if self._cast_override:
+            self.cache_dtype = jnp.dtype(dtype)
+            self._pvals = cast_weights(model, self._pvals,
+                                       self.cache_dtype)
+        apply = build_apply(model, self._params)
+        pick = build_pick(True, 1.0, 0, 1.0)       # greedy, fp32 picks
+        self._prefill_jit = {
+            b: jax.jit(_build_prefill(apply, pick, self._spec,
+                                      self.cache_dtype, self.MAX,
+                                      self.eos),
+                       donate_argnums=(5, 6, 7, 8, 9))
+            for b in self.buckets}
+        self._decode_jit = jax.jit(
+            _build_decode_chunk(apply, pick, self.chunk, self.eos,
+                                self.pad),
+            donate_argnums=(1, 2, 3, 4, 5))
+        self.scheduler = FCFSScheduler(self.num_slots,
+                                       max_prefills_per_gap)
+        # MoE gates record aux loss as a side-effect attribute during
+        # forward; tracing would leave a tracer behind (see generate())
+        from ..incubate.distributed.models.moe.gate import BaseGate
+        self._gates = [m for _, m in model.named_sublayers()
+                       if isinstance(m, BaseGate)]
+        self.stats = None
+        self._init_state()
+
+    # -- state -------------------------------------------------------------
+    def _init_state(self):
+        S = self.num_slots
+        self._tokens = jnp.full((S,), self.pad, jnp.int32)
+        self._pos = jnp.zeros((S,), jnp.int32)
+        self._active = jnp.zeros((S,), bool)
+        self._remaining = jnp.zeros((S,), jnp.int32)
+        self._caches = [(jnp.zeros((S, self.MAX, nh, d), self.cache_dtype),
+                         jnp.zeros((S, self.MAX, nh, d), self.cache_dtype))
+                        for nh, d in self._spec]
+        self.stats = {"requests": 0, "finished": 0, "decoded_tokens": 0,
+                      "chunks": 0, "prefills": 0, "ttft_ms": [],
+                      "max_concurrent": 0}
+
+    def reset(self):
+        """Drop all queued/in-flight work and zero the device state (the
+        compiled programs are kept — bench reruns pay tracing once)."""
+        self.scheduler = FCFSScheduler(self.num_slots,
+                                       self.scheduler.max_prefills_per_gap)
+        self._init_state()
+
+    def refresh_weights(self):
+        """Re-snapshot parameter values (after a train step swapped the
+        underlying arrays).  Mirrors construction exactly: a ``dtype``
+        override always routes through ``cast_weights`` (identity-cached,
+        so a no-op refresh is cheap) — deciding by the *current* dominant
+        dtype instead would let minority-dtype params (an fp32 norm in a
+        bf16 model) slip through uncast and silently retrace the decode
+        program with mixed dtypes."""
+        pvals = [p._value for p in self._params]
+        if self._cast_override:
+            pvals = cast_weights(self.model, pvals, self.cache_dtype)
+        self._pvals = pvals
+
+    # -- API ---------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, callback=None):
+        """Queue one request; returns its :class:`Request`.  ``prompt``
+        is a 1-D int sequence (list/np array/Tensor)."""
+        prompt = np.asarray(getattr(prompt, "_value", prompt),
+                            dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest "
+                f"prefill bucket {self.buckets[-1]}")
+        if prompt.size + max_new_tokens > self.MAX:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = "
+                f"{prompt.size + int(max_new_tokens)} exceeds "
+                f"max_seq_len = {self.MAX}")
+        self.stats["requests"] += 1
+        return self.scheduler.submit(prompt, max_new_tokens, callback)
+
+    def step(self):
+        """One engine cycle: admit queued requests into free slots
+        (compiled bucket prefills), run one compiled decode chunk over
+        all slots, then ONE host sync that streams tokens and frees
+        finished slots.  Returns the requests finished this cycle."""
+        toks = valid = None
+        saved_losses = [g.loss for g in self._gates]
+        try:
+            pending = self._admit()
+            if self.scheduler.active:
+                with RecordEvent("serving.decode_chunk"):
+                    (self._tokens, self._pos, self._active,
+                     self._remaining, self._caches, toks, valid) = \
+                        self._decode_jit(
+                            self._pvals, self._tokens, self._pos,
+                            self._active, self._remaining, self._caches)
+                self.stats["chunks"] += 1
+        finally:
+            for g, l in zip(self._gates, saved_losses):
+                object.__setattr__(g, "loss", l)
+        self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
+                                           len(self.scheduler.active))
+        return self._sync(pending, toks, valid)
+
+    def run(self, timeout=None):
+        """Drain the queue and all in-flight slots; returns finished
+        requests in submission order.  Emits a ``serving_stats``
+        guardian event with the run's counters."""
+        was_training = self.model.training
+        self.model.eval()
+        finished = []
+        t0 = time.perf_counter()
+        try:
+            while self.scheduler.has_work:
+                finished.extend(self.step())
+                if timeout is not None and \
+                        time.perf_counter() - t0 > timeout:
+                    raise TimeoutError(
+                        f"serving run exceeded {timeout}s with "
+                        f"{self.scheduler.queue_depth} queued / "
+                        f"{len(self.scheduler.active)} in-flight")
+        finally:
+            if was_training:
+                self.model.train()
+        wall = time.perf_counter() - t0
+        ttfts = self.stats["ttft_ms"]
+        guardian.emit(
+            "serving_stats",
+            requests=self.stats["requests"],
+            decoded_tokens=self.stats["decoded_tokens"],
+            chunks=self.stats["chunks"],
+            prefills=self.stats["prefills"],
+            mean_ttft_ms=round(sum(ttfts) / len(ttfts), 3) if ttfts
+            else None,
+            tokens_per_sec=round(self.stats["decoded_tokens"]
+                                 / max(wall, 1e-9), 1),
+            queue_depth=self.scheduler.queue_depth)
+        return sorted(finished, key=lambda r: r.req_id)
+
+    # -- internals ---------------------------------------------------------
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket")
+
+    def _admit(self):
+        """Admit queued requests into free slots (bounded by the
+        interleave knob): one compiled bucket prefill each, KV written
+        straight into the assigned slot.  Returns the pending
+        (request, first-token, finished-flag) device handles — read
+        back at the chunk-boundary sync, never here."""
+        pending = []
+        for req, slot in self.scheduler.admissions():
+            n = int(req.prompt.size)
+            bucket = self._bucket_for(n)
+            ids = np.full((1, bucket), self.pad, np.int32)
+            ids[0, :n] = req.prompt
+            with RecordEvent("serving.prefill"):
+                (t0, fin0, self._tokens, self._pos, self._active,
+                 self._remaining, self._caches) = \
+                    self._prefill_jit[bucket](
+                        self._pvals, jnp.asarray(ids),
+                        jnp.asarray(n, jnp.int32),
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(int(req.max_new_tokens), jnp.int32),
+                        self._tokens, self._pos, self._active,
+                        self._remaining, self._caches)
+            self.stats["prefills"] += 1
+            pending.append((req, slot, t0, fin0))
+            guardian.emit("serving_admit", req_id=req.req_id, slot=slot,
+                          queue_depth=self.scheduler.queue_depth,
+                          prompt_len=n, bucket=bucket)
+        return pending
+
+    def _sync(self, pending, toks, valid):
+        """THE chunk-boundary host sync: one ``jax.device_get`` of the
+        prefill first-tokens + decode-chunk tokens + slot liveness,
+        then stream callbacks, stamp TTFT, and free finished slots."""
+        with RecordEvent("serving.sync"):
+            bundle = jax.device_get(
+                ([(t0, fin0) for _, _, t0, fin0 in pending],
+                 toks, valid, self._active))
+        first, toks_h, valid_h, active_h = bundle
+        now = time.perf_counter_ns()
+        # per-slot emissions this cycle, in chronological order:
+        # the prefill's first token, then the chunk's tokens
+        emitted = {}
+        for (req, slot, _, _), (t0, fin0) in zip(pending, first):
+            req.first_token_ns = now
+            self.stats["ttft_ms"].append(req.ttft_ms)
+            emitted[slot] = [int(t0)]
+            if fin0:
+                req.finish_reason = "eos" if (
+                    self.eos is not None and int(t0) == self.eos) \
+                    else "budget"
+        if toks_h is not None:
+            for s in range(toks_h.shape[0]):
+                for slot in np.nonzero(valid_h[s])[0]:
+                    emitted.setdefault(int(slot), []).append(
+                        int(toks_h[s, slot]))
+        finished = []
+        for slot, toks_slot in sorted(emitted.items()):
+            req = self.scheduler.active[slot]
+            req.tokens.extend(toks_slot)
+            if req.finish_reason is None and not bool(active_h[slot]):
+                last = toks_slot[-1] if toks_slot else None
+                req.finish_reason = "eos" if (
+                    self.eos is not None and last == self.eos) \
+                    else "budget"
+            self.stats["decoded_tokens"] += len(toks_slot)
+            done = req.finish_reason is not None
+            if req.callback is not None:
+                for i, tok in enumerate(toks_slot):
+                    req.callback(req, tok,
+                                 done and i == len(toks_slot) - 1)
+            if done:
+                req.finish_ns = now
+                self.scheduler.release(slot)
+                self.stats["finished"] += 1
+                finished.append(req)
+                guardian.emit("serving_finish", req_id=req.req_id,
+                              slot=slot, tokens=len(req.tokens),
+                              ttft_ms=round(req.ttft_ms, 3),
+                              reason=req.finish_reason)
+        return finished

@@ -21,9 +21,11 @@ from ..framework.random import rng_scope
 
 # generate()'s compiled bodies are nested defs a decorator can't reach —
 # registered here for the tracer-safety pass (mirrored by
-# EXTRA_JIT_SURFACES in paddle_tpu/analysis/allowlist.py)
-for _qual in ("generate.run", "generate.beam_run", "generate.apply",
-              "generate.pick", "generate.prefill"):
+# EXTRA_JIT_SURFACES in paddle_tpu/analysis/allowlist.py).  The apply/
+# pick builders are shared with the serving engine
+# (paddle_tpu/inference/serving.py), which registers its own surfaces.
+for _qual in ("generate.run", "generate.beam_run", "generate.prefill",
+              "build_apply.apply", "build_pick.pick"):
     register_jit_surface(__name__, _qual)
 
 
@@ -61,6 +63,83 @@ def _caches_for(model):
 __all__ = ["generate", "GenerationMixin"]
 
 _STRATEGIES = ("greedy_search", "sampling", "beam_search")
+
+
+def dominant_float_dtype(pvals):
+    """The model's dominant floating dtype by element count — a bf16
+    model gets bf16 caches; a stray fp32 norm or embedding doesn't flip
+    the choice."""
+    sizes = {}
+    for v in pvals:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            sizes[v.dtype] = sizes.get(v.dtype, 0) + int(v.size)
+    return max(sizes, key=sizes.get) if sizes else jnp.float32
+
+
+def cast_weights(model, pvals, cache_dtype):
+    """Cast the parameter values to ``cache_dtype`` once per (dtype,
+    weight identity): repeated serving calls must not re-materialize a
+    full low-precision weight copy.  Identity is checked by ``is``
+    against strongly-held originals, so a train step (new ``_value``
+    arrays) recasts automatically."""
+    caches = _caches_for(model)
+    cast = caches["cast"]
+    if (cast is not None and cast[0] == str(cache_dtype)
+            and len(cast[1]) == len(pvals)
+            and all(a is b for a, b in zip(cast[1], pvals))):
+        return cast[2]
+    originals = pvals
+    out = [v.astype(cache_dtype)
+           if jnp.issubdtype(v.dtype, jnp.floating) else v
+           for v in pvals]
+    caches["cast"] = (str(cache_dtype), originals, out)
+    return out
+
+
+def build_apply(model, params):
+    """Functional forward over the model's cached decode path, shared by
+    ``generate()`` and the serving engine: swap ``pv`` into the
+    parameters, run one cached step, restore.  ``pos`` may be a scalar
+    (uniform batch) or a per-row (B,) vector (the engine's per-slot
+    offsets); ``attn_mask`` is an optional additive (B, MAX) key mask."""
+    def apply(pv, ids, caches, pos, attn_mask=None):
+        olds = [p._value for p in params]
+        for p, v in zip(params, pv):
+            p._value = v
+        try:
+            kw = {}
+            if attn_mask is not None:
+                kw["attn_mask"] = Tensor(attn_mask)
+            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                logits, new_caches = model(
+                    Tensor(ids),
+                    caches=[(Tensor(k), Tensor(v)) for k, v in caches],
+                    pos=Tensor(pos), **kw)
+            return logits._value, [(k._value, v._value)
+                                   for k, v in new_caches]
+        finally:
+            for p, v in zip(params, olds):
+                p._value = v
+    return apply
+
+
+def build_pick(greedy, temperature, top_k, top_p):
+    """Token-selection builder shared by ``generate()`` and the serving
+    engine: fp32 log-softmax, then argmax (greedy) or filtered
+    categorical sampling.  Returns ``(next_token int32, logprob)``."""
+    def pick(logits, key):
+        lg = logits.astype(jnp.float32)
+        if not greedy and temperature != 1.0:
+            lg = lg / max(float(temperature), 1e-6)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        if greedy:
+            nxt = jnp.argmax(lg, axis=-1)
+        else:
+            nxt = jax.random.categorical(
+                key, _top_k_top_p_filter(lg, top_k, top_p), axis=-1)
+        score = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+        return nxt.astype(jnp.int32), score
+    return pick
 
 
 class GenerationMixin:
@@ -111,16 +190,37 @@ def _top_k_top_p_filter(logits, top_k, top_p):
 def generate(model, input_ids, max_new_tokens=32,
              decode_strategy="greedy_search", temperature=1.0, top_k=0,
              top_p=1.0, num_beams=1, length_penalty=0.0,
-             eos_token_id=None, pad_token_id=0, seed=0, dtype=None):
+             eos_token_id=None, pad_token_id=0, seed=0, dtype=None,
+             attention_mask=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``.
 
     Returns ``(ids, scores)``: the generated tokens (B, max_new_tokens)
-    and their selected-token log-probabilities, matching the reference's
-    ``GenerationMixin.generate`` return contract (generated portion only,
-    prompt excluded). The model must expose ``kv_cache_spec()`` and a
+    and their selected-token log-probabilities (generated portion only,
+    prompt excluded).
+
+    Scores contract — a DELIBERATE deviation from the reference: the
+    reference's greedy/sampling path returns a (B, 1) running-mean
+    log-prob (``update_scores_for_generation``) computed from
+    pre-temperature origin log-probs, and its beam scorer normalizes by
+    ``len**length_penalty``.  Here scores are per-token ``(B, N)``
+    POST-temperature log-probs of the selected tokens, and beam search
+    uses the GNMT penalty ``((5+len)/6)**length_penalty`` — richer for
+    streaming/serving consumers, but not numerically comparable to
+    reference scores.
+
+    The model must expose ``kv_cache_spec()`` and a
     ``forward(input_ids, caches=..., pos=...)`` cached mode (the GPT,
     LLaMA and GPT-MoE families do). ``dtype="bfloat16"`` runs the whole
     decode in bf16 weights/caches (serving mode; token picks stay fp32).
+
+    ``attention_mask`` (B, P) of 1/0 (or bool) marks real prompt tokens:
+    pad positions are excluded from attention for the WHOLE decode via
+    an additive key mask, so left-padded ragged prompts stop silently
+    attending pad tokens.  Position embeddings still run over absolute
+    buffer positions (a left-padded row sees shifted positions relative
+    to an unpadded run of the same prompt — same as the reference's
+    fused decode without position-id correction); ``None`` (the default)
+    compiles the exact program this function always compiled.
 
     ``decode_strategy="beam_search"`` carries ``num_beams`` hypotheses
     per row through the same single compiled scan: KV caches live at
@@ -173,83 +273,53 @@ def generate(model, input_ids, max_new_tokens=32,
     spec = model.kv_cache_spec()
     params = [p for _, p in model.named_parameters()]
     pvals = [p._value for p in params]
-    # KV caches follow the model's dominant floating dtype by element
-    # count (a bf16-weight model gets bf16 caches; a stray fp32 norm or
-    # embedding doesn't flip the choice) unless `dtype` overrides
-    sizes = {}
-    for v in pvals:
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            sizes[v.dtype] = sizes.get(v.dtype, 0) + int(v.size)
-    cache_dtype = max(sizes, key=sizes.get) if sizes else jnp.float32
+    # KV caches follow the model's dominant floating dtype unless
+    # `dtype` overrides (see dominant_float_dtype / cast_weights)
+    cache_dtype = dominant_float_dtype(pvals)
     if dtype is not None:
         cache_dtype = jnp.dtype(dtype)
-        # cast once per (dtype, weight identity): repeated serving calls
-        # must not re-materialize a full low-precision weight copy.
-        # Identity is checked by `is` against strongly-held originals,
-        # so a train step (new _value arrays) recasts automatically.
-        caches = _caches_for(model)
-        cast = caches["cast"]
-        if (cast is not None and cast[0] == str(cache_dtype)
-                and len(cast[1]) == len(pvals)
-                and all(a is b for a, b in zip(cast[1], pvals))):
-            pvals = cast[2]
-        else:
-            originals = pvals
-            pvals = [v.astype(cache_dtype)
-                     if jnp.issubdtype(v.dtype, jnp.floating) else v
-                     for v in pvals]
-            caches["cast"] = (str(cache_dtype), originals, pvals)
+        pvals = cast_weights(model, pvals, cache_dtype)
     greedy = decode_strategy == "greedy_search"
     eos = None if eos_token_id is None else int(eos_token_id)
     pad = int(pad_token_id)
+    # pad positions become an additive (B, MAX) key mask: -1e30 columns
+    # are excluded from attention for the whole decode (pad KV is never
+    # overwritten — decode appends at positions >= P)
+    mask_np = None
+    if attention_mask is not None:
+        am = np.asarray(attention_mask._value
+                        if isinstance(attention_mask, Tensor)
+                        else attention_mask)
+        if am.shape != (B, P):
+            raise ValueError(
+                f"attention_mask shape {am.shape} must match input_ids "
+                f"{(B, P)}")
+        mask_np = np.zeros((B, MAX), np.float32)
+        mask_np[:, :P][am.astype(bool) == False] = -1e30  # noqa: E712
 
     was_training = model.training
     model.eval()
 
-    def apply(pv, ids, caches, pos):
-        olds = [p._value for p in params]
-        for p, v in zip(params, pv):
-            p._value = v
-        try:
-            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
-                logits, new_caches = model(
-                    Tensor(ids),
-                    caches=[(Tensor(k), Tensor(v)) for k, v in caches],
-                    pos=Tensor(pos))
-            return logits._value, [(k._value, v._value)
-                                   for k, v in new_caches]
-        finally:
-            for p, v in zip(params, olds):
-                p._value = v
+    apply = build_apply(model, params)
+    pick = build_pick(greedy, temperature, top_k, top_p)
 
-    def pick(logits, key):
-        lg = logits.astype(jnp.float32)
-        if not greedy and temperature != 1.0:
-            lg = lg / max(float(temperature), 1e-6)
-        logp = jax.nn.log_softmax(lg, axis=-1)
-        if greedy:
-            nxt = jnp.argmax(lg, axis=-1)
-        else:
-            nxt = jax.random.categorical(
-                key, _top_k_top_p_filter(lg, top_k, top_p), axis=-1)
-        score = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
-        return nxt.astype(jnp.int32), score
-
-    def prefill(pv, prompt):
+    def prefill(pv, prompt, extra_mask=None):
         caches = [(jnp.zeros((B, MAX, nh, d), cache_dtype),
                    jnp.zeros((B, MAX, nh, d), cache_dtype))
                   for nh, d in spec]
-        return apply(pv, prompt, caches, jnp.zeros((), jnp.int32))
+        return apply(pv, prompt, caches, jnp.zeros((), jnp.int32),
+                     attn_mask=extra_mask)
 
-    def run(pv, prompt, key):
-        logits, caches = prefill(pv, prompt)
+    def run(pv, prompt, key, extra_mask=None):
+        logits, caches = prefill(pv, prompt, extra_mask)
         k0, key = jax.random.split(key)
         tok0, sc0 = pick(logits[:, -1, :], k0)
         finished = jnp.zeros((B,), bool) if eos is None else (tok0 == eos)
 
         def body(carry, step_key):
             tok, caches, pos, finished = carry
-            logits, caches = apply(pv, tok[:, None], caches, pos)
+            logits, caches = apply(pv, tok[:, None], caches, pos,
+                                   attn_mask=extra_mask)
             nxt, score = pick(logits[:, 0, :], step_key)
             nxt = jnp.where(finished, pad, nxt)
             score = jnp.where(finished, 0.0, score)
@@ -270,18 +340,20 @@ def generate(model, input_ids, max_new_tokens=32,
             out_ids, out_sc = tok0[:, None], sc0[:, None]
         return out_ids, out_sc
 
-    def beam_run(pv, prompt, key):
+    def beam_run(pv, prompt, key, extra_mask=None):
         K, N = num_beams, max_new_tokens
-        logits, caches = prefill(pv, prompt)
+        logits, caches = prefill(pv, prompt, extra_mask)
         logp0 = jax.nn.log_softmax(
             logits[:, -1, :].astype(jnp.float32), axis=-1)      # (B, V)
         V = logp0.shape[-1]
         beam_scores, tok0 = jax.lax.top_k(logp0, K)             # (B, K)
         tok0 = tok0.astype(jnp.int32)
         # every beam shares the prompt prefix: replicate the prefill
-        # caches to the (B*K) beam batch
+        # caches (and the pad key mask) to the (B*K) beam batch
         caches = [(jnp.repeat(k, K, axis=0), jnp.repeat(v, K, axis=0))
                   for k, v in caches]
+        beam_mask = None if extra_mask is None \
+            else jnp.repeat(extra_mask, K, axis=0)
         seqs = jnp.zeros((B, K, N), jnp.int32).at[:, :, 0].set(tok0)
         steplp = jnp.zeros((B, K, N), jnp.float32) \
             .at[:, :, 0].set(beam_scores)
@@ -291,7 +363,8 @@ def generate(model, input_ids, max_new_tokens=32,
 
         def body(carry, _):
             tok, caches, pos, t, beam_scores, seqs, steplp, fin = carry
-            logits, caches = apply(pv, tok.reshape(B * K, 1), caches, pos)
+            logits, caches = apply(pv, tok.reshape(B * K, 1), caches, pos,
+                                   attn_mask=beam_mask)
             logp = jax.nn.log_softmax(
                 logits[:, 0, :].astype(jnp.float32), -1).reshape(B, K, V)
             if eos is not None:
@@ -359,7 +432,7 @@ def generate(model, input_ids, max_new_tokens=32,
            float(top_p if top_p is not None else 1.0) if sampling else 1.0,
            int(num_beams) if beam else 1,
            float(length_penalty) if beam else 0.0,
-           eos, pad, str(cache_dtype), struct)
+           eos, pad, str(cache_dtype), struct, mask_np is not None)
     jit_cache = _caches_for(model)["jit"]
     fn = jit_cache.get(sig)
     if fn is None:
@@ -374,7 +447,9 @@ def generate(model, input_ids, max_new_tokens=32,
     saved_losses = [g.loss for g in gates]
     try:
         out_ids, out_sc = fn(pvals, jnp.asarray(ids_np),
-                             jax.random.key(int(seed)))
+                             jax.random.key(int(seed)),
+                             None if mask_np is None
+                             else jnp.asarray(mask_np))
     finally:
         for g, l in zip(gates, saved_losses):
             object.__setattr__(g, "loss", l)

@@ -82,7 +82,7 @@ class GPTAttention(nn.Layer):
             self.qkv_proj = nn.Linear(H, 3 * H)
             self.out_proj = nn.Linear(H, H)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, attn_mask=None):
         from ..tensor.manipulation import reshape, concat
         B, S, H = x.shape
         qkv = self.qkv_proj(x)
@@ -94,7 +94,7 @@ class GPTAttention(nn.Layer):
             # over the masked prefix — the jit/scan-friendly KV cache
             # (reference: cache_kv in fused multi_transformer inference)
             return _cached_attention(self.out_proj, q, k, v, cache, pos,
-                                     B, S, H)
+                                     B, S, H, attn_mask=attn_mask)
         if cache is not None:
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
@@ -109,47 +109,71 @@ class GPTAttention(nn.Layer):
         return out
 
 
-def _cached_attention(out_proj, q, k, v, cache, pos, B, S, H):
+def _decode_position_ids(p, S):
+    """Absolute positions for this decode chunk: scalar ``pos`` yields
+    (S,) shared across the batch; a per-row (B,) ``pos`` (the serving
+    engine's per-slot offsets) yields (B, S)."""
+    p = p.astype(jnp.int32)
+    if p.ndim:
+        return p[:, None] + jnp.arange(S)
+    return p + jnp.arange(S)
+
+
+def _cached_attention(out_proj, q, k, v, cache, pos, B, S, H,
+                      attn_mask=None):
     """Shared fixed-buffer KV attention for compiled decode: k/v land at
-    offset ``pos`` (traced scalar) via dynamic_update_slice; queries at
-    absolute positions pos..pos+S-1 attend to prefix positions <= theirs
-    through an additive mask. Returns (out, (k_buf, v_buf))."""
+    offset ``pos`` (traced scalar, or per-row (B,) vector — the serving
+    engine's per-slot offsets) via dynamic_update_slice / batched
+    scatter; queries at absolute positions pos..pos+S-1 attend to prefix
+    positions <= theirs through an additive mask.  ``attn_mask`` is an
+    optional extra additive (B, MAX) key mask (0 keep / -1e30 drop) for
+    left-padded ragged prompts. Returns (out, (k_buf, v_buf))."""
     from ..tensor.manipulation import reshape
     k_buf, v_buf = cache
     MAX = k_buf.shape[1]
 
     def write(buf, new, p):
+        new = new.astype(buf.dtype)
+        if p.ndim:
+            idx = _decode_position_ids(p, S)                # (B, S)
+            return buf.at[jnp.arange(B)[:, None], idx].set(new)
         return jax.lax.dynamic_update_slice(
-            buf, new.astype(buf.dtype), (0, p.astype(jnp.int32), 0, 0))
+            buf, new, (0, p.astype(jnp.int32), 0, 0))
     k_buf = call_op(write, k_buf, k, pos)
     v_buf = call_op(write, v_buf, v, pos)
 
-    def mask_fn(p):
-        valid = jnp.arange(MAX)[None, :] <= \
-            (p.astype(jnp.int32) + jnp.arange(S))[:, None]
-        return jnp.where(valid, 0.0, -1e30)[None, None]  # (1,1,S,MAX)
-    mask = call_op(mask_fn, pos)
+    def mask_fn(p, *extra):
+        qpos = _decode_position_ids(p, S)            # (S,) or (B, S)
+        valid = jnp.arange(MAX) <= qpos[..., None]   # (S,MAX) / (B,S,MAX)
+        m = jnp.where(valid, 0.0, -1e30)
+        # (1,1,S,MAX) for shared pos; (B,1,S,MAX) for per-row pos
+        m = m[None, None] if m.ndim == 2 else m[:, None]
+        if extra:
+            m = m + extra[0].astype(m.dtype)[:, None, None, :]
+        return m
+    mask = call_op(mask_fn, pos) if attn_mask is None else \
+        call_op(mask_fn, pos, attn_mask)
     out = F.scaled_dot_product_attention(q, k_buf, v_buf, attn_mask=mask,
                                          is_causal=False, training=False)
     out = reshape(out, [B, S, H])
     return out_proj(out), (k_buf, v_buf)
 
 
-def _cached_block(ln1, attn, ln2, ffn, x, cache, pos):
+def _cached_block(ln1, attn, ln2, ffn, x, cache, pos, attn_mask=None):
     """One decode step of a pre-LN block: cached attention + FFN with
     residuals — shared by the GPT/GPT-MoE/LLaMA decoder layers."""
-    a, cache = attn(ln1(x), cache=cache, pos=pos)
+    a, cache = attn(ln1(x), cache=cache, pos=pos, attn_mask=attn_mask)
     x = x + a
     x = x + ffn(ln2(x))
     return x, cache
 
 
-def _cached_layers(layers, caches, pos, x, final_norm):
+def _cached_layers(layers, caches, pos, x, final_norm, attn_mask=None):
     """Thread per-layer KV caches through the block stack and apply the
     final norm — the model-level cached forward shared by the families."""
     new_caches = []
     for blk, cache in zip(layers, caches):
-        x, cache = blk(x, cache=cache, pos=pos)
+        x, cache = blk(x, cache=cache, pos=pos, attn_mask=attn_mask)
         new_caches.append(cache)
     return final_norm(x), new_caches
 
@@ -181,10 +205,10 @@ class GPTDecoderLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._remat = config.remat
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, attn_mask=None):
         if pos is not None:
             return _cached_block(self.ln1, self.attn, self.ln2, self.mlp,
-                                 x, cache, pos)
+                                 x, cache, pos, attn_mask=attn_mask)
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return x
@@ -223,14 +247,15 @@ class GPTModel(nn.Layer):
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None,
+                attn_mask=None):
         if pos is not None:
             S = input_ids.shape[1]
             position_ids = call_op(
-                lambda p: p.astype(jnp.int32) + jnp.arange(S), pos)
+                lambda p: _decode_position_ids(p, S), pos)
             x = self.embeddings(input_ids, position_ids)
             return _cached_layers(self.layers, caches, pos, x,
-                                  self.final_norm)
+                                  self.final_norm, attn_mask=attn_mask)
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
             if self.config.remat:
@@ -287,10 +312,12 @@ class GPTForPretraining(nn.Layer, GenerationMixin):
         self.config = config
         _init_gpt_weights(self, config.initializer_range)
 
-    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None,
+                attn_mask=None):
         w = self.gpt.embeddings.word_embeddings.weight
         if pos is not None:
-            x, caches = self.gpt(input_ids, caches=caches, pos=pos)
+            x, caches = self.gpt(input_ids, caches=caches, pos=pos,
+                                 attn_mask=attn_mask)
             return call_op(lambda h, wv: h @ wv.T, x, w), caches
         x = self.gpt(input_ids, position_ids)
         return call_op(lambda h, wv: h @ wv.T, x, w)

@@ -76,11 +76,11 @@ class GPTMoEDecoderLayer(nn.Layer):
             expert_axis=config.expert_axis)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, attn_mask=None):
         if pos is not None:
             from .gpt import _cached_block
             return _cached_block(self.ln1, self.attn, self.ln2, self.moe,
-                                 x, cache, pos)
+                                 x, cache, pos, attn_mask=attn_mask)
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.moe(self.ln2(x)))
         return x
@@ -101,15 +101,16 @@ class GPTMoEModel(nn.Layer):
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None,
+                attn_mask=None):
         if pos is not None:
-            from .gpt import _cached_layers
+            from .gpt import _cached_layers, _decode_position_ids
             S = input_ids.shape[1]
             position_ids = call_op(
-                lambda p: p.astype(jnp.int32) + jnp.arange(S), pos)
+                lambda p: _decode_position_ids(p, S), pos)
             x = self.embeddings(input_ids, position_ids)
             return _cached_layers(self.layers, caches, pos, x,
-                                  self.final_norm)
+                                  self.final_norm, attn_mask=attn_mask)
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
             if self.config.remat:
@@ -138,10 +139,12 @@ class GPTMoEForPretraining(nn.Layer, GenerationMixin):
                     or name.endswith("expert_b2"):
                 p._value = jnp.zeros(tuple(p.shape), p.dtype)
 
-    def forward(self, input_ids, position_ids=None, caches=None, pos=None):
+    def forward(self, input_ids, position_ids=None, caches=None, pos=None,
+                attn_mask=None):
         w = self.gpt.embeddings.word_embeddings.weight
         if pos is not None:
-            x, caches = self.gpt(input_ids, caches=caches, pos=pos)
+            x, caches = self.gpt(input_ids, caches=caches, pos=pos,
+                                 attn_mask=attn_mask)
             return call_op(lambda h, wv: h @ wv.T, x, w), caches
         x = self.gpt(input_ids, position_ids)
         return call_op(lambda h, wv: h @ wv.T, x, w)

@@ -49,13 +49,18 @@ def llama_tiny(**kw):
 
 
 def _rope(x, theta, position_ids=None):
-    """x: (B, S, H, D) — rotate half, fp32."""
+    """x: (B, S, H, D) — rotate half, fp32.  ``position_ids`` is (S,)
+    shared across the batch or (B, S) per-row (serving-engine slots)."""
     B, S, H, D = x.shape
     pos = jnp.arange(S) if position_ids is None else position_ids
     freqs = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
-    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]   # (S, D/2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos[..., None].astype(jnp.float32) * freqs   # (S|B,S, D/2)
+    if ang.ndim == 2:
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., ::2], xf[..., 1::2]
     r1 = x1 * cos - x2 * sin
@@ -88,7 +93,7 @@ class LlamaAttention(nn.Layer):
             self.v_proj = nn.Linear(H, kv_out, bias_attr=False)
             self.o_proj = nn.Linear(H, H, bias_attr=False)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, attn_mask=None):
         from ..tensor.manipulation import reshape
         B, S, H = x.shape
         q = reshape(self.q_proj(x), [B, S, self.num_heads, self.head_dim])
@@ -97,15 +102,15 @@ class LlamaAttention(nn.Layer):
         if pos is not None:
             # absolute rotary positions pos..pos+S-1, then the shared
             # fixed-buffer cached attention (see gpt._cached_attention)
-            from .gpt import _cached_attention
+            from .gpt import _cached_attention, _decode_position_ids
 
             def roped(t, p):
-                ids = p.astype(jnp.int32) + jnp.arange(S)
-                return _rope(t, self.theta, position_ids=ids)
+                return _rope(t, self.theta,
+                             position_ids=_decode_position_ids(p, S))
             q = call_op(roped, q, pos)
             k = call_op(roped, k, pos)
             return _cached_attention(self.o_proj, q, k, v, cache, pos,
-                                     B, S, H)
+                                     B, S, H, attn_mask=attn_mask)
         q = call_op(lambda t: _rope(t, self.theta), q)
         k = call_op(lambda t: _rope(t, self.theta), k)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
@@ -143,12 +148,12 @@ class LlamaDecoderLayer(nn.Layer):
             config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, attn_mask=None):
         if pos is not None:
             from .gpt import _cached_block
             return _cached_block(self.input_layernorm, self.self_attn,
                                  self.post_attention_layernorm, self.mlp,
-                                 x, cache, pos)
+                                 x, cache, pos, attn_mask=attn_mask)
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -170,11 +175,12 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size,
                                epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, attn_mask=None):
         x = self.embed_tokens(input_ids)
         if pos is not None:
             from .gpt import _cached_layers
-            return _cached_layers(self.layers, caches, pos, x, self.norm)
+            return _cached_layers(self.layers, caches, pos, x, self.norm,
+                                  attn_mask=attn_mask)
         for blk in self.layers:
             if self.config.remat:
                 from .gpt import _remat_block
@@ -196,8 +202,9 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, attn_mask=None):
         if pos is not None:
-            x, caches = self.model(input_ids, caches=caches, pos=pos)
+            x, caches = self.model(input_ids, caches=caches, pos=pos,
+                                   attn_mask=attn_mask)
             return self.lm_head(x), caches
         return self.lm_head(self.model(input_ids))

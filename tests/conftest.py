@@ -40,3 +40,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: static-analysis suite (paddle_tpu.analysis) "
         "test — select with -m lint")
+    config.addinivalue_line(
+        "markers", "serving: continuous-batching serving engine "
+        "(inference/serving.py) test — select with -m serving")
