@@ -36,6 +36,28 @@ def _readback_sync(x):
     return float(x)
 
 
+def _telemetry_snapshot(tag, reset=True):
+    """Dump the observability registry as sink-format fixtures next to
+    the bench JSON: ``<dir>/<tag>.prom`` (Prometheus text exposition) +
+    ``<tag>.jsonl`` (the PADDLE_METRICS_LOG line format), dir from
+    ``BENCH_TELEMETRY_DIR`` (default ``telemetry/``).  ``reset`` zeroes
+    the registry afterwards so the next config's snapshot is its own
+    (counters are process-cumulative otherwise)."""
+    try:
+        from paddle_tpu import observability as obs
+        from paddle_tpu.observability import export as obs_export
+        d = os.environ.get("BENCH_TELEMETRY_DIR", "telemetry")
+        os.makedirs(d, exist_ok=True)
+        prom = obs_export.write_prometheus(os.path.join(d, f"{tag}.prom"))
+        jsl = obs_export.write_jsonl(os.path.join(d, f"{tag}.jsonl"),
+                                     run=tag)
+        if reset:
+            obs.get_registry().reset()
+        return {"prometheus": prom, "jsonl": jsl}
+    except Exception as e:  # telemetry must never sink the bench line
+        return {"error": repr(e)[:160]}
+
+
 def _timeit(step, iters, *state):
     """Run ``state = step(*state)`` iters times; the caller's step returns
     (loss_like_scalar, *new_state).  Returns (seconds, final_loss)."""
@@ -202,6 +224,16 @@ def bench_gpt(cfg, B, S, iters, peak):
     _readback_sync(loss)  # compile + warmup
     dt, final_loss, _ = _timeit(run, iters, pvals, m0, v0, t0)
     tokens_per_sec = iters * K * B * S / dt
+
+    # aggregate telemetry for the train-config snapshot: the scan-
+    # chained loop deliberately has no per-step sync, so one latency
+    # observation = the measured mean step (latency-robust, same number
+    # the JSON reports)
+    from paddle_tpu import observability as obs
+    obs.observe("pt_train_step_latency_ms", dt / (iters * K) * 1e3)
+    obs.inc("pt_train_tokens_total", iters * K * B * S)
+    obs.set_gauge("pt_train_tokens_per_sec", tokens_per_sec)
+    obs.set_gauge("pt_train_loss", final_loss)
 
     n_params = sum(int(np.prod(p.shape)) for p in params)
     flops_per_tok = 6 * n_params \
@@ -1006,6 +1038,7 @@ def main():
         return True
 
     configs = {}
+    telemetry = {}
     primary = None
     metric = "gpt125m_train_tokens_per_sec_per_chip"
     if on_tpu:
@@ -1024,6 +1057,7 @@ def main():
         # larger batches start spilling on the bf16 logits + bwd)
         if want("gpt125m"):
             primary = bench_gpt(gpt125, B=24, S=1024, iters=20, peak=peak)
+            telemetry["train"] = _telemetry_snapshot("train")
         if want("gpt350m"):
             try:
                 gpt350 = GPTConfig(
@@ -1134,6 +1168,7 @@ def main():
                 configs["serving"] = bench_serving()
             except Exception as e:
                 configs["serving"] = {"error": repr(e)[:200]}
+            telemetry["serving"] = _telemetry_snapshot("serving")
         if want("moe", "gpt_moe"):
             try:
                 configs["gpt_moe"] = bench_gpt_moe(peak=peak)
@@ -1144,7 +1179,17 @@ def main():
                          num_hidden_layers=2, num_attention_heads=4,
                          max_position_embeddings=256)
         primary = bench_gpt(tiny, B=2, S=128, iters=5, peak=peak)
+        telemetry["train"] = _telemetry_snapshot("train")
         metric = "gpt_tiny_cpu_proxy_tokens_per_sec"
+        if which is not None and "serving" in which:
+            try:
+                configs["serving"] = bench_serving(
+                    n_requests=8, hidden=64, layers=2, heads=2,
+                    p_range=(8, 32), n_range=(4, 16), slots=4, chunk=8,
+                    p_lams=(12, 24), n_lams=(6, 12))
+            except Exception as e:
+                configs["serving"] = {"error": repr(e)[:200]}
+            telemetry["serving"] = _telemetry_snapshot("serving")
 
     if primary is not None:
         rate = primary["tokens_per_sec"]
@@ -1171,7 +1216,8 @@ def main():
         "value": rate,
         "unit": "tokens/sec" if "tokens" in metric else "images/sec",
         "vs_baseline": 1.0,
-        "extra": {**primary, "configs": configs},
+        "extra": {**primary, "configs": configs,
+                  "telemetry": telemetry},
     }))
 
 

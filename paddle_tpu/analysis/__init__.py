@@ -29,6 +29,9 @@ Four AST-based passes, one runner:
 - ``failpoint-refs`` / ``guardian-log`` — the registry lints formerly
   living in ``tools/check_failpoints.py`` / ``check_guardian_log.py``,
   folded into the same framework (the tools remain as thin wrappers).
+- ``metrics-registry`` — ``pt_<subsystem>_...`` metric names referenced
+  by tests/docs must exist in ``observability/catalog.py``, and the
+  docs/observability.md catalog table must mirror it row-for-row.
 
 Run everything: ``python -m paddle_tpu.analysis`` (or
 ``python tools/lint.py``); ``--json`` for machine output; findings

@@ -18,6 +18,13 @@ MONITORED_MODULES = (
     "paddle_tpu/hapi/model.py",
     "paddle_tpu/optimizer/optimizer.py",
     "paddle_tpu/inference/serving.py",
+    # the telemetry layer records from every hot path, so the whole
+    # package is monitored: metric recording must NEVER read the
+    # device — the one legal sync is the exporter's funnel below
+    "paddle_tpu/observability/metrics.py",
+    "paddle_tpu/observability/export.py",
+    "paddle_tpu/observability/timeline.py",
+    "paddle_tpu/observability/catalog.py",
 )
 
 # Call terminals that force (or mark) a device->host sync.
@@ -94,6 +101,14 @@ HOST_SYNC_ALLOWLIST = {
      "asarray"):
         {"max": 1, "reason": "H2D ingest of the request prompt (host "
                              "list/array -> int32), not a readback"},
+    # observability: the exporter-side sync funnel.  Recording is host-
+    # only by contract; a device scalar handed to a gauge materializes
+    # exactly once, at export time, through this one budgeted site
+    # (the _host_bool pattern applied to telemetry).
+    ("paddle_tpu/observability/export.py", "_materialize", "asarray"):
+        {"max": 1, "reason": "exporter-side only: collapse a device "
+                             "scalar to host at snapshot/exposition "
+                             "time — never on the recording path"},
 }
 
 # -- tracer-safety (tracer_safety.py) --------------------------------------

@@ -28,6 +28,17 @@ _ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|\s*`([^`]*)`", re.M)
 
 GUARDIAN_DOC = "docs/training_guardian.md"
 
+# metrics-registry references: any pt_<subsystem>_... token (quoted,
+# backticked or bare) in tests/docs.  Scoping mirrors the failpoint
+# lint: only tokens whose subsystem prefix the catalog registers count,
+# so an unrelated pt_batch_* shm tag never fails this lint.
+_METRIC_RE = re.compile(r"\b(pt_[a-z0-9]+_[a-z0-9_]+)\b")
+# the observability doc's catalog table rows: | `name` | `type` | `labels` |
+_METRIC_ROW_RE = re.compile(
+    r"^\|\s*`(pt_[a-z0-9_]+)`\s*\|\s*`([a-z]+)`\s*\|\s*`([^`]*)`", re.M)
+
+OBSERVABILITY_DOC = "docs/observability.md"
+
 
 def _read(path):
     with open(path, encoding="utf-8") as f:
@@ -150,4 +161,91 @@ class GuardianLogSchemaPass:
                 findings.append(Finding(
                     self.name, GUARDIAN_DOC, 1, "<doc>", "schema-drift",
                     f"event {name!r} is emitted but undocumented", name))
+        return findings
+
+
+class MetricNamesPass:
+    """Metric names referenced by tests/docs must exist in the
+    observability catalog, and the docs catalog table must mirror it
+    row-for-row (type + labels) — the guardian-log contract applied to
+    the metrics registry: dashboards and alerts are built from names,
+    so a renamed metric must fail lint, not silently flatline a graph.
+    """
+
+    name = "metrics-registry"
+
+    def _catalog(self):
+        import os as _os
+        _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ..observability.catalog import METRICS, subsystems
+        return METRICS, subsystems()
+
+    def run(self, ctx):
+        metrics, subs = self._catalog()
+        findings = []
+        for path in ctx.ref_files:
+            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+            text = _read(path)
+            for m in _METRIC_RE.finditer(text):
+                token = m.group(1)
+                # strip prometheus exposition suffixes so a _bucket/
+                # _sum/_count sample in a doc example resolves to its
+                # base histogram
+                base = token
+                for suf in ("_bucket", "_sum", "_count"):
+                    if base.endswith(suf) and base[:-len(suf)] in metrics:
+                        base = base[:-len(suf)]
+                if base.split("_", 2)[1] in subs and base not in metrics:
+                    findings.append(Finding(
+                        self.name, rel, _line_of(text, m), "<text>",
+                        "unknown-metric",
+                        f"metric {token!r} is referenced but not in the "
+                        "observability catalog — a dashboard built on it "
+                        "would silently flatline; declare it in "
+                        "paddle_tpu/observability/catalog.py or fix the "
+                        "name", token))
+        doc = os.path.join(ctx.root, OBSERVABILITY_DOC)
+        in_scope = ctx.default_tree or any(
+            os.path.abspath(p) == os.path.abspath(doc)
+            for p in ctx.ref_files)
+        if in_scope:
+            findings.extend(self._check_doc_table(doc, metrics))
+        return sorted(findings, key=Finding.sort_key)
+
+    def _check_doc_table(self, doc, metrics):
+        findings = []
+        if not os.path.exists(doc):
+            return [Finding(self.name, OBSERVABILITY_DOC, 1, "<doc>",
+                            "catalog-drift",
+                            "docs/observability.md is missing (the metric "
+                            "catalog must be documented)", "missing-doc")]
+        text = _read(doc)
+        table = {}
+        for m in _METRIC_ROW_RE.finditer(text):
+            labels = {f.strip() for f in m.group(3).split(",")
+                      if f.strip() and f.strip() != "-"}
+            table[m.group(1)] = ((m.group(2), labels), _line_of(text, m))
+        for name, ((mtype, labels), line) in sorted(table.items()):
+            if name not in metrics:
+                findings.append(Finding(
+                    self.name, OBSERVABILITY_DOC, line, "<doc>",
+                    "catalog-drift",
+                    f"documents unknown metric {name!r}", name))
+                continue
+            spec = metrics[name]
+            want = (spec["type"], set(spec.get("labels", ())))
+            if (mtype, labels) != want:
+                findings.append(Finding(
+                    self.name, OBSERVABILITY_DOC, line, "<doc>",
+                    "catalog-drift",
+                    f"metric {name!r} documented as {mtype}/"
+                    f"{sorted(labels)} but the catalog declares "
+                    f"{want[0]}/{sorted(want[1])}", name))
+        for name in sorted(metrics):
+            if name not in table:
+                findings.append(Finding(
+                    self.name, OBSERVABILITY_DOC, 1, "<doc>",
+                    "catalog-drift",
+                    f"metric {name!r} is in the catalog but "
+                    "undocumented", name))
         return findings

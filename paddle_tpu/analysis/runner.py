@@ -26,10 +26,11 @@ def _passes():
     from .tracer_safety import TracerSafetyPass
     from .host_sync import HostSyncPass
     from .collective_order import CollectiveOrderPass
-    from .registry_lints import FailpointRefsPass, GuardianLogSchemaPass
+    from .registry_lints import (FailpointRefsPass, GuardianLogSchemaPass,
+                                 MetricNamesPass)
     return {p.name: p for p in (TracerSafetyPass, HostSyncPass,
                                 CollectiveOrderPass, FailpointRefsPass,
-                                GuardianLogSchemaPass)}
+                                GuardianLogSchemaPass, MetricNamesPass)}
 
 
 class Context:

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ... import observability as _obs
 from ...framework import failpoints as _fp
 from ...framework.core import Tensor
 
@@ -211,6 +212,7 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False,
     explicit ``generation`` (e.g. the global step as a string) to override
     — all ranks must pass the same value.
     """
+    t_save0 = time.perf_counter()
     if generation is None:
         if process_index is None:
             # auto mode: we know how to mint an id all ranks share
@@ -296,6 +298,16 @@ def save_state_dict(state_dict, path, process_index=None, async_save=False,
         os.replace(tmp, meta_path)
         if _on_commit is not None:
             _on_commit()
+        # telemetry, stamped at commit: duration spans the D2H snapshot
+        # through the metadata rename (async saves include their queue
+        # time — that IS the save's wall cost); bytes are the host
+        # payload already snapshotted, no device access here
+        if _obs.enabled():
+            _obs.observe("pt_checkpoint_save_ms",
+                         (time.perf_counter() - t_save0) * 1e3)
+            _obs.inc("pt_checkpoint_bytes_total",
+                     sum(int(d.nbytes) for _, d, _ in jobs),
+                     direction="save")
 
     # registered BEFORE the writer can run: a concurrent retention sweep
     # (an overlapping save committing out of order) must not rmtree a
@@ -452,6 +464,7 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
     if _is_checkpoint_root(path):
         return _load_latest_valid(path, template=template,
                                   shardings=shardings, mesh=mesh)
+    t_load0 = time.perf_counter()
     vcache = {}
     meta = _merged_meta(path)
     tmpl_flat = ({k: _as_array(v) for k, v in _flatten(template).items()}
@@ -494,6 +507,18 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
             slabs.append(jax.device_put(slab_cache[rkey], dev))
         out[key] = jax.make_array_from_single_device_arrays(
             shape, target, slabs)
+    if _obs.enabled():
+        _obs.observe("pt_checkpoint_load_ms",
+                     (time.perf_counter() - t_load0) * 1e3)
+        nbytes = 0
+        for entry in meta["arrays"].values():
+            n = 1
+            for d in entry["global_shape"]:
+                n *= int(d)
+            itemsize = (2 if entry["dtype"] == "bfloat16"
+                        else np.dtype(entry["dtype"]).itemsize)
+            nbytes += n * itemsize
+        _obs.inc("pt_checkpoint_bytes_total", nbytes, direction="load")
     return out
 
 
@@ -555,8 +580,11 @@ def latest_checkpoint(root):
 def _load_latest_valid(root, **kw):
     """Newest committed checkpoint that actually restores; fall back past
     corrupt ones (CRC mismatch, lost shard/metadata files)."""
-    steps = [(s, d) for s, d, committed in reversed(_iter_steps(root))
-             if committed]
+    entries = list(reversed(_iter_steps(root)))
+    steps = [(s, d) for s, d, committed in entries if committed]
+    torn = len(entries) - len(steps)
+    if torn:
+        _obs.inc("pt_checkpoint_fallbacks_total", torn, kind="torn")
     if not steps:
         raise FileNotFoundError(
             f"no committed checkpoint under {root} — nothing to resume "
@@ -574,6 +602,7 @@ def _load_latest_valid(root, **kw):
             _logger.warning(
                 "checkpoint %s is unusable (%s); falling back to the "
                 "previous one", d, e)
+            _obs.inc("pt_checkpoint_fallbacks_total", kind="corrupt")
             last_err = e
     raise CheckpointCorruptError(
         f"every committed checkpoint under {root} failed to restore "

@@ -11,10 +11,13 @@ from XLA's async collectives, so ``wait`` is a barrier on the value.
 Groups name mesh axes rather than holding NCCL communicators: ``new_group``
 returns a ``Group`` carrying the axis name(s) the collective should ride.
 """
+import time
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import observability as _obs
 from ..framework.core import Tensor
 from ..framework.autograd import call_op
 from ..framework import failpoints as _fp
@@ -121,9 +124,37 @@ def _apply(x, fn):
     return Tensor(fn(jnp.asarray(x)))
 
 
+def _telemetry(op, *vals):
+    """Per-op call/byte counters (``pt_collective_*``).  Payload size
+    comes from static ``.shape``/``.dtype`` metadata ONLY, so this is
+    legal under tracing (no readback — the tracer-safety taint stops at
+    shape/dtype).  Inside a jit trace the counters tick per *tracing*,
+    not per execution; the catalog documents that honestly.  Latency is
+    recorded only for the host-blocking ops (barrier/wait) — a traced
+    collective has no host-observable duration."""
+    if not _obs.enabled():
+        return
+    nbytes = 0
+    for v in vals:
+        for t in (v if isinstance(v, (list, tuple)) else (v,)):
+            t = getattr(t, "_value", t)
+            shape = getattr(t, "shape", None)
+            dtype = getattr(t, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            n = 1
+            for d in shape:
+                n *= int(d)
+            nbytes += n * jnp.dtype(dtype).itemsize
+    _obs.inc("pt_collective_calls_total", op=op)
+    if nbytes:
+        _obs.inc("pt_collective_bytes_total", nbytes, op=op)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("all_reduce", f"op={op} axis={_axis_of(group)}")
+    _telemetry("all_reduce", tensor)
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         red = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
@@ -150,6 +181,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("all_gather", f"axis={_axis_of(group)}")
+    _telemetry("all_gather", tensor)
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         out = _apply(tensor, lambda v: lax.all_gather(v, axis))
@@ -173,6 +205,7 @@ def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True,
     if _guardian._TRACK:
         _guardian.record_op("all_gather_into_tensor",
                             f"axis={_axis_of(group)}")
+    _telemetry("all_gather_into_tensor", tensor)
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         out = _apply(tensor, lambda v: lax.all_gather(
@@ -190,6 +223,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("reduce_scatter", f"axis={_axis_of(group)}")
+    _telemetry("reduce_scatter", tensor_or_tensor_list)
     axis = _axis_of(group)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
@@ -210,6 +244,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("alltoall", f"axis={_axis_of(group)}")
+    _telemetry("alltoall", in_tensor_list)
     axis = _axis_of(group)
     from ..tensor.manipulation import stack
     x = stack(list(in_tensor_list), axis=0)
@@ -228,6 +263,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("alltoall_single", f"axis={_axis_of(group)}")
+    _telemetry("alltoall_single", in_tensor)
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         out = _apply(in_tensor, lambda v: lax.all_to_all(
@@ -244,6 +280,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 def broadcast(tensor, src=0, group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("broadcast", f"axis={_axis_of(group)}")
+    _telemetry("broadcast", tensor)
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis):
         # select src rank's shard everywhere via all_gather + index
@@ -258,6 +295,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if _guardian._TRACK:
         _guardian.record_op("scatter", f"axis={_axis_of(group)}")
+    _telemetry("scatter", tensor)
     axis = _axis_of(group)
     if axis is not None and _in_named_trace(axis) and tensor_list:
         from ..tensor.manipulation import stack
@@ -313,13 +351,24 @@ def barrier(group=None, timeout=None):
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
-    if timeout is not None:
-        _guardian.run_with_deadline(_body, timeout, "barrier",
+    t0 = time.perf_counter()
+    try:
+        if timeout is not None:
+            _guardian.run_with_deadline(_body, timeout, "barrier",
+                                        f"group={getattr(group, 'id', 0)}")
+        else:
+            if _guardian._TRACK:
+                _guardian.record_op("barrier",
                                     f"group={getattr(group, 'id', 0)}")
-        return
-    if _guardian._TRACK:
-        _guardian.record_op("barrier", f"group={getattr(group, 'id', 0)}")
-    _body()
+            _body()
+    finally:
+        # host-blocking op: wall latency is observable without any
+        # device readback (recorded on timeout/error paths too — a
+        # stuck barrier's duration is the interesting sample)
+        if _obs.enabled():
+            _obs.inc("pt_collective_calls_total", op="barrier")
+            _obs.observe("pt_collective_latency_ms",
+                         (time.perf_counter() - t0) * 1e3, op="barrier")
 
 
 def wait(tensor, group=None, use_calc_stream=True, timeout=None):
@@ -331,11 +380,18 @@ def wait(tensor, group=None, use_calc_stream=True, timeout=None):
                 tensor._value.block_until_ready()
             except Exception:
                 pass
-        if timeout is not None:
-            _guardian.run_with_deadline(_body, timeout, "wait",
-                                        f"shape={tuple(tensor.shape)}")
-        else:
-            _body()
+        t0 = time.perf_counter()
+        try:
+            if timeout is not None:
+                _guardian.run_with_deadline(_body, timeout, "wait",
+                                            f"shape={tuple(tensor.shape)}")
+            else:
+                _body()
+        finally:
+            if _obs.enabled():
+                _obs.inc("pt_collective_calls_total", op="wait")
+                _obs.observe("pt_collective_latency_ms",
+                             (time.perf_counter() - t0) * 1e3, op="wait")
 
 
 class _Task:

@@ -12,6 +12,7 @@ unique-id exchange; the store remains the framework's control plane for
 barriers, elastic membership, and launcher rendezvous.
 """
 import ctypes
+import functools
 import os
 import socket
 import socketserver
@@ -19,6 +20,7 @@ import struct
 import threading
 import time
 
+from .. import observability as _obs
 from ..framework import failpoints as _fp
 from ..framework import native
 from ..framework.backoff import jittered_delay
@@ -48,7 +50,10 @@ _BACKOFF_CAP = 2.0
 
 
 def _backoff_sleep(attempt, deadline=None):
-    """Exponential backoff with jitter, never sleeping past deadline."""
+    """Exponential backoff with jitter, never sleeping past deadline.
+    Every call = one retry about to happen; the counter makes flapping
+    visible without log archaeology."""
+    _obs.inc("pt_store_retries_total")
     delay = jittered_delay(attempt, _BACKOFF_BASE, _BACKOFF_CAP)
     if deadline is not None:
         delay = min(delay, max(0.0, deadline - time.monotonic()))
@@ -345,6 +350,27 @@ class _PyStoreClient:
         self._close_sock()
 
 
+def _timed_op(name):
+    """Telemetry wrapper for the store facade ops: per-op count + wall
+    latency (``pt_store_*``), covering the whole connect/retry envelope
+    — errors and timeouts are recorded too, since a slow failure is the
+    sample an operator needs."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            if not _obs.enabled():
+                return fn(self, *args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(self, *args, **kwargs)
+            finally:
+                _obs.inc("pt_store_ops_total", op=name)
+                _obs.observe("pt_store_op_latency_ms",
+                             (time.perf_counter() - t0) * 1e3, op=name)
+        return wrapper
+    return deco
+
+
 class TCPStore:
     """Distributed KV store.  ``is_master=True`` also hosts the server.
 
@@ -386,6 +412,7 @@ class TCPStore:
             self._client = _PyStoreClient(host, port, timeout_ms)
 
     # -- core ops ---------------------------------------------------
+    @_timed_op("set")
     def set(self, key, value, retry_budget=None):
         """``retry_budget`` (seconds, Python client only) caps this
         call's reconnect/retry envelope below the store timeout — for
@@ -405,6 +432,7 @@ class TCPStore:
             self._client.request(_SET, key.encode(), value,
                                  budget_s=retry_budget)
 
+    @_timed_op("get")
     def get(self, key, timeout=30.0):
         if _fp._ACTIVE:
             _fp.fire(_FP_GET)
@@ -426,6 +454,7 @@ class TCPStore:
             raise KeyError(key)
         return out
 
+    @_timed_op("add")
     def add(self, key, delta=1):
         if _fp._ACTIVE:
             _fp.fire(_FP_ADD)
@@ -440,6 +469,7 @@ class TCPStore:
             raise ConnectionError("TCPStore add failed")
         return struct.unpack("<q", out)[0]
 
+    @_timed_op("wait")
     def wait(self, keys, timeout=30.0):
         if _fp._ACTIVE:
             _fp.fire(_FP_WAIT)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ..analysis import jit_surface
+from .. import observability as _obs
 from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework import guardian as _guardian
@@ -618,15 +619,35 @@ class Model:
                     ins = guard.filter_batch(ins)
                 do_update = (step + 1) % max(accumulate_grad_batches,
                                              1) == 0
+                # telemetry: wall time of the whole step, including the
+                # per-step loss readback already inside train_batch —
+                # recording adds NO device transfer (values below are
+                # host floats/shapes the loop already owns)
+                t_step = time.perf_counter()
                 res = self.train_batch(ins, labs, update=do_update)
+                verdict = None
                 if guard is not None:
                     loss_v = res[0][0] if isinstance(res, tuple) else res[0]
                     ok = (self._stepper.last_ok
                           if self._jit and self._stepper is not None
                           else None)
-                    guard.after_step(loss_v, ok_flag=ok,
-                                     batch=(ins, labs))
+                    verdict = guard.after_step(loss_v, ok_flag=ok,
+                                               batch=(ins, labs))
+                step_s = time.perf_counter() - t_step
+                if _obs.enabled():
+                    _obs.observe("pt_train_step_latency_ms", step_s * 1e3)
+                    _obs.inc("pt_train_steps_total",
+                             outcome=verdict or "ok")
+                    if ins and hasattr(ins[0], "shape"):
+                        tokens = 1
+                        for d in ins[0].shape:
+                            tokens *= int(d)
+                        _obs.inc("pt_train_tokens_total", tokens)
+                        _obs.set_gauge("pt_train_tokens_per_sec",
+                                       tokens / max(step_s, 1e-9))
                 logs = self._make_logs(res)
+                if _obs.enabled() and logs.get("loss") is not None:
+                    _obs.set_gauge("pt_train_loss", float(logs["loss"]))
                 logs["step"] = step
                 logs["batch_size"] = (
                     ins[0].shape[0] if ins and hasattr(ins[0], "shape")

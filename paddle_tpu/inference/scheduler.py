@@ -24,8 +24,8 @@ class Request:
     """
 
     __slots__ = ("req_id", "prompt", "max_new_tokens", "callback",
-                 "tokens", "submit_ns", "first_token_ns", "finish_ns",
-                 "finish_reason", "slot")
+                 "tokens", "submit_ns", "admit_ns", "first_token_ns",
+                 "finish_ns", "finish_reason", "slot")
 
     def __init__(self, req_id, prompt, max_new_tokens, callback=None):
         self.req_id = req_id
@@ -34,6 +34,7 @@ class Request:
         self.callback = callback                # fn(req, token, is_last)
         self.tokens = []
         self.submit_ns = time.perf_counter_ns()
+        self.admit_ns = None
         self.first_token_ns = None
         self.finish_ns = None
         self.finish_reason = None
@@ -50,6 +51,13 @@ class Request:
         if self.first_token_ns is None:
             return None
         return (self.first_token_ns - self.submit_ns) / 1e6
+
+    @property
+    def queue_wait_ms(self):
+        """Submit -> slot-admission wait; None while still queued."""
+        if self.admit_ns is None:
+            return None
+        return (self.admit_ns - self.submit_ns) / 1e6
 
 
 class FCFSScheduler:
@@ -105,6 +113,7 @@ class FCFSScheduler:
             req = self._queue.popleft()
             slot = self._free.pop()
             req.slot = slot
+            req.admit_ns = time.perf_counter_ns()
             self._running[slot] = req
             out.append((req, slot))
         return out

@@ -39,6 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..analysis import register_jit_surface
 from ..framework import guardian
 from ..models.generation import (build_apply, build_pick, cast_weights,
@@ -276,6 +277,7 @@ class ServingEngine:
                             self._pvals, self._tokens, self._pos,
                             self._active, self._remaining, self._caches)
                 self.stats["chunks"] += 1
+                _obs.inc("pt_serving_chunks_total")
         finally:
             for g, l in zip(self._gates, saved_losses):
                 object.__setattr__(g, "loss", l)
@@ -316,6 +318,8 @@ class ServingEngine:
             tokens_per_sec=round(self.stats["decoded_tokens"]
                                  / max(wall, 1e-9), 1),
             queue_depth=self.scheduler.queue_depth)
+        _obs.set_gauge("pt_serving_useful_tokens_per_sec",
+                       self.stats["decoded_tokens"] / max(wall, 1e-9))
         return sorted(finished, key=lambda r: r.req_id)
 
     # -- internals ---------------------------------------------------------
@@ -352,6 +356,18 @@ class ServingEngine:
             guardian.emit("serving_admit", req_id=req.req_id, slot=slot,
                           queue_depth=self.scheduler.queue_depth,
                           prompt_len=n, bucket=bucket)
+            # telemetry: all host values (scheduler stamps + static
+            # bucket metadata) — nothing here reads the device
+            if _obs.enabled():
+                _obs.inc("pt_serving_admissions_total")
+                _obs.inc("pt_serving_prefills_total", bucket=str(bucket))
+                _obs.observe("pt_serving_queue_wait_ms",
+                             req.queue_wait_ms)
+        if pending and _obs.enabled():
+            _obs.set_gauge("pt_serving_slot_occupancy",
+                           len(self.scheduler.active))
+            _obs.set_gauge("pt_serving_queue_depth",
+                           self.scheduler.queue_depth)
         return pending
 
     def _sync(self, pending, toks, valid):
@@ -370,6 +386,7 @@ class ServingEngine:
         for (req, slot, _, _), (t0, fin0) in zip(pending, first):
             req.first_token_ns = now
             self.stats["ttft_ms"].append(req.ttft_ms)
+            _obs.observe("pt_serving_ttft_ms", req.ttft_ms)
             emitted[slot] = [int(t0)]
             if fin0:
                 req.finish_reason = "eos" if (
@@ -390,6 +407,7 @@ class ServingEngine:
                     self.eos is not None and last == self.eos) \
                     else "budget"
             self.stats["decoded_tokens"] += len(toks_slot)
+            _obs.inc("pt_serving_decoded_tokens_total", len(toks_slot))
             done = req.finish_reason is not None
             if req.callback is not None:
                 for i, tok in enumerate(toks_slot):
@@ -404,4 +422,9 @@ class ServingEngine:
                               slot=slot, tokens=len(req.tokens),
                               ttft_ms=round(req.ttft_ms, 3),
                               reason=req.finish_reason)
+                _obs.inc("pt_serving_evictions_total",
+                         reason=req.finish_reason)
+        if finished and _obs.enabled():
+            _obs.set_gauge("pt_serving_slot_occupancy",
+                           len(self.scheduler.active))
         return finished

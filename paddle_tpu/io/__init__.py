@@ -11,6 +11,7 @@ fallback covers fork-less platforms.
 import copy as _copy
 import inspect as _inspect
 import itertools
+import time as _time
 import warnings as _warnings
 import queue as _queue
 import threading
@@ -18,6 +19,7 @@ from collections import deque as _deque
 
 import numpy as np
 
+from .. import observability as _obs
 from ..framework.core import Tensor
 from ..framework.random import get_seed
 
@@ -450,7 +452,12 @@ class DataLoader:
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         while True:
+            if _obs.enabled():
+                _obs.set_gauge("pt_dataloader_queue_depth", q.qsize())
+            t0 = _time.perf_counter()
             item = q.get()
+            _obs.observe("pt_dataloader_wait_ms",
+                         (_time.perf_counter() - t0) * 1e3)
             if item is sentinel:
                 break
             if isinstance(item, BaseException):
@@ -549,8 +556,14 @@ class DataLoader:
         try:
             while rotation:
                 wid = rotation[0]
+                if _obs.enabled():
+                    _obs.set_gauge("pt_dataloader_queue_depth",
+                                   sum(q.qsize() for q in queues))
+                t0 = _time.perf_counter()
                 try:
                     item = queues[wid].get(timeout=timeout)
+                    _obs.observe("pt_dataloader_wait_ms",
+                                 (_time.perf_counter() - t0) * 1e3)
                 except _queue.Empty:
                     raise TimeoutError(
                         f"DataLoader timed out after {timeout}s waiting "

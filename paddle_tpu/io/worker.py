@@ -20,10 +20,12 @@ import multiprocessing
 import os
 import pickle
 import threading
+import time
 import traceback
 
 import numpy as np
 
+from .. import observability as _obs
 from ..framework import failpoints as _fp
 from .blocking_queue import BlockingQueue
 from . import shm as _shm
@@ -218,8 +220,15 @@ class _MultiProcessIterBase:
     def __next__(self):
         if self._done:
             raise StopIteration
+        # telemetry: prefetch depth before the pop + how long the
+        # consumer blocked (producer slack) — queue-local, no device
+        if _obs.enabled():
+            _obs.set_gauge("pt_dataloader_queue_depth", self._out.size())
+        t0 = time.perf_counter()
         try:
             blob = self._out.pop(timeout=self._timeout)
+            _obs.observe("pt_dataloader_wait_ms",
+                         (time.perf_counter() - t0) * 1e3)
         except TimeoutError:
             # a timed-out epoch is dead (reference: DataLoader raises and
             # the iterator is unusable); tear down rather than letting a

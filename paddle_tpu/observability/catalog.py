@@ -1,0 +1,131 @@
+"""The metric catalog: every framework metric name, declared once.
+
+Names follow ``pt_<subsystem>_<what>[_total|_ms]``; the subsystem token
+right after ``pt_`` scopes the ``metrics-registry`` lint the way
+failpoint prefixes scope the failpoint lint — a ``pt_train_...`` /
+``pt_serving_...`` reference in tests or docs must exist HERE, while an
+unrelated ``pt_batch_...`` shm tag is ignored.  The catalog is mirrored
+row-for-row by the table in ``docs/observability.md`` (lint-checked,
+like the guardian EVENT_SCHEMA table).
+
+Conventions:
+
+- ``*_total`` counters are cumulative since process start (prometheus
+  counter semantics); gauges are point-in-time; ``*_ms`` histograms
+  observe milliseconds with the default latency buckets.
+- every value recorded is a host number the call site already owned —
+  recording NEVER forces a device readback (see metrics.py docstring
+  for the machine-checked contract).
+"""
+
+__all__ = ["METRICS", "subsystems"]
+
+_C, _G, _H = "counter", "gauge", "histogram"
+
+METRICS = {
+    # -- training (hapi Model.fit stepper) --------------------------------
+    "pt_train_steps_total": {
+        "type": _C, "labels": ("outcome",),
+        "help": "train steps by guardian verdict: ok | skip | rollback"},
+    "pt_train_step_latency_ms": {
+        "type": _H, "labels": (),
+        "help": "wall time of one train step incl. the per-step host "
+                "sync (loss readback)"},
+    "pt_train_tokens_total": {
+        "type": _C, "labels": (),
+        "help": "input elements trained on (batch x seq of the first "
+                "input)"},
+    "pt_train_tokens_per_sec": {
+        "type": _G, "labels": (),
+        "help": "instantaneous training throughput (last step)"},
+    "pt_train_loss": {
+        "type": _G, "labels": (),
+        "help": "last train-step loss (host value from the existing "
+                "per-step readback)"},
+    # -- serving (inference/serving.py + scheduler) -----------------------
+    "pt_serving_ttft_ms": {
+        "type": _H, "labels": (),
+        "help": "time to first token, stamped at the chunk-boundary "
+                "sync (quantized to chunk cadence)"},
+    "pt_serving_queue_wait_ms": {
+        "type": _H, "labels": (),
+        "help": "submit -> slot admission wait"},
+    "pt_serving_slot_occupancy": {
+        "type": _G, "labels": (),
+        "help": "in-flight slots after the latest admit/release"},
+    "pt_serving_queue_depth": {
+        "type": _G, "labels": (),
+        "help": "requests queued behind the slot pool"},
+    "pt_serving_admissions_total": {
+        "type": _C, "labels": (),
+        "help": "requests admitted into a slot (bucket prefill "
+                "dispatched)"},
+    "pt_serving_evictions_total": {
+        "type": _C, "labels": ("reason",),
+        "help": "slots freed by finish reason: eos | budget"},
+    "pt_serving_decoded_tokens_total": {
+        "type": _C, "labels": (),
+        "help": "useful tokens streamed at chunk-boundary syncs"},
+    "pt_serving_useful_tokens_per_sec": {
+        "type": _G, "labels": (),
+        "help": "useful-token throughput of the last run()"},
+    "pt_serving_chunks_total": {
+        "type": _C, "labels": (),
+        "help": "compiled decode-chunk dispatches"},
+    "pt_serving_prefills_total": {
+        "type": _C, "labels": ("bucket",),
+        "help": "compiled bucket prefill dispatches by bucket length"},
+    # -- collectives (distributed/collective.py) --------------------------
+    "pt_collective_calls_total": {
+        "type": _C, "labels": ("op",),
+        "help": "collective API calls issued (inside a trace this "
+                "counts tracings, not executions)"},
+    "pt_collective_bytes_total": {
+        "type": _C, "labels": ("op",),
+        "help": "payload bytes of issued collectives (from static "
+                "shape/dtype metadata — no readback)"},
+    "pt_collective_latency_ms": {
+        "type": _H, "labels": ("op",),
+        "help": "host-blocking collectives only (barrier/wait under "
+                "the watchdog); traced collectives have no host-"
+                "observable latency"},
+    # -- TCPStore client (distributed/store.py) ---------------------------
+    "pt_store_ops_total": {
+        "type": _C, "labels": ("op",),
+        "help": "store client operations: set | get | add | wait"},
+    "pt_store_op_latency_ms": {
+        "type": _H, "labels": ("op",),
+        "help": "wall time per store op incl. connect/retry envelope"},
+    "pt_store_retries_total": {
+        "type": _C, "labels": (),
+        "help": "Python-client reconnect/retry attempts (native client "
+                "retries internally, uncounted)"},
+    # -- dataloader (io/) -------------------------------------------------
+    "pt_dataloader_queue_depth": {
+        "type": _G, "labels": (),
+        "help": "prefetch-queue depth observed at each consumer pop"},
+    "pt_dataloader_wait_ms": {
+        "type": _H, "labels": (),
+        "help": "time the consumer blocked waiting for the next batch "
+                "(producer slack; 0-ish means the pipeline keeps up)"},
+    # -- checkpoint (distributed/checkpoint) ------------------------------
+    "pt_checkpoint_save_ms": {
+        "type": _H, "labels": (),
+        "help": "save_state_dict D2H snapshot + shard write + metadata "
+                "commit wall time"},
+    "pt_checkpoint_load_ms": {
+        "type": _H, "labels": (),
+        "help": "load_state_dict wall time (one committed step dir)"},
+    "pt_checkpoint_bytes_total": {
+        "type": _C, "labels": ("direction",),
+        "help": "checkpoint payload bytes by direction: save | load"},
+    "pt_checkpoint_fallbacks_total": {
+        "type": _C, "labels": ("kind",),
+        "help": "step dirs skipped while resolving a root: torn "
+                "(uncommitted debris) | corrupt (CRC/restore failure)"},
+}
+
+
+def subsystems():
+    """The registered ``pt_<subsystem>`` prefixes (lint scoping)."""
+    return {n.split("_", 2)[1] for n in METRICS}

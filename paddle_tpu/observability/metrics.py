@@ -1,0 +1,367 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram with
+labels (reference: the profiler summary counters + benchmark/collective
+stat hooks, unified the way PR 2 unified the FLAGS_-gated checks into
+the guardian).
+
+Import-light by design (stdlib only — no jax, no numpy): hot paths
+(``hapi.model``, ``inference/serving``, ``distributed/collective``)
+call :func:`inc`/:func:`observe`/:func:`set_gauge` unconditionally, so
+this module must never drag device state, and recording must never
+force one.  The contract (machine-checked by the ``host-sync`` lint —
+this package is in ``analysis.allowlist.MONITORED_MODULES``):
+
+- **record host values only** — callers hand in floats/ints they
+  already own (wall-clock deltas, shapes, values drained at a
+  pre-existing sync point such as the stepper's per-step loss readback
+  or the serving engine's one bundled ``device_get`` per chunk);
+- **zero syncs on jit surfaces** — nothing here touches an array; the
+  one place a device scalar may legally materialize is the exporter's
+  ``_materialize`` funnel (budgeted in ``HOST_SYNC_ALLOWLIST``).
+
+Metric *names* are declared once in :mod:`.catalog` (``pt_<subsystem>_
+...``); recording against an undeclared name raises, and the
+``metrics-registry`` lint pass checks that names referenced by
+tests/docs exist in the catalog — the same contract shape as the
+guardian log's ``EVENT_SCHEMA``.
+"""
+import collections
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "inc", "observe", "set_gauge", "enabled", "enable", "disabled",
+    "start_capture", "stop_capture", "capture_active", "samples",
+    "clock_pair", "DEFAULT_BUCKETS",
+]
+
+# latency-flavored defaults (ms): sub-ms dispatch up to 10s stalls
+DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                   500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+# -- recording gate ---------------------------------------------------------
+
+_ENABLED = [True]
+
+
+def enabled():
+    """One truthiness check — the whole cost of telemetry when off."""
+    return _ENABLED[0]
+
+
+def enable(on=True):
+    _ENABLED[0] = bool(on)
+
+
+@contextmanager
+def disabled():
+    """Temporarily silence all recording (the A/B half of the
+    measured-overhead test: instrumented vs uninstrumented runs must
+    show identical device-transfer counts)."""
+    prev = _ENABLED[0]
+    _ENABLED[0] = False
+    try:
+        yield
+    finally:
+        _ENABLED[0] = prev
+
+
+# -- timeline capture ring --------------------------------------------------
+#
+# While a capture is active every metric update also appends one sample
+# (perf_counter_ns timestamp) to a bounded ring, which timeline.py
+# overlays onto the profiler's host spans — both clocks are
+# CLOCK_MONOTONIC on Linux, so they share a timeline for free.  The
+# (wall_ns, perf_ns) pair taken at start_capture() maps the guardian
+# log's time_ns stamps onto the same axis.
+
+_SAMPLES = collections.deque(maxlen=65536)
+_CAPTURE = [False]
+_CLOCK_PAIR = [None]
+
+
+def start_capture():
+    """Begin recording per-update metric samples for the merged
+    timeline; clears previous samples and stamps the wall/perf clock
+    pair used to convert guardian ``ts_ns`` onto the shared axis."""
+    _SAMPLES.clear()
+    _CLOCK_PAIR[0] = (time.time_ns(), time.perf_counter_ns())
+    _CAPTURE[0] = True
+
+
+def stop_capture():
+    _CAPTURE[0] = False
+
+
+def capture_active():
+    return _CAPTURE[0]
+
+
+def samples():
+    """Snapshot of captured samples, oldest first: dicts of
+    ``ts_perf_ns`` / ``metric`` / ``labels`` / ``value``."""
+    return list(_SAMPLES)
+
+
+def clock_pair():
+    """(wall time_ns, perf_counter_ns) taken at start_capture, or
+    None if no capture ran this process."""
+    return _CLOCK_PAIR[0]
+
+
+def _sample(name, labels, value):
+    if _CAPTURE[0]:
+        _SAMPLES.append({"ts_perf_ns": time.perf_counter_ns(),
+                         "metric": name, "labels": dict(labels),
+                         "value": value})
+
+
+# -- metric kinds -----------------------------------------------------------
+
+class _Metric:
+    """Shared label plumbing.  Label *names* are fixed at registration;
+    every record call must pass exactly that set (the EVENT_SCHEMA
+    discipline: a series is a contract, not a suggestion)."""
+
+    kind = None
+
+    def __init__(self, name, help="", labelnames=()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series = {}     # labelvalues tuple -> state
+
+    def _key(self, labels):
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} labels {sorted(labels)} do not "
+                f"match declared labelnames {sorted(self.labelnames)}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _labels_of(self, key):
+        return dict(zip(self.labelnames, key))
+
+    def series(self):
+        """[(labels dict, state)] snapshot, deterministically ordered."""
+        with self._lock:
+            items = sorted(self._series.items())
+        return [(self._labels_of(k), v) for k, v in items]
+
+    def reset(self):
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    """Monotone cumulative count (prometheus counter semantics)."""
+
+    kind = "counter"
+
+    def inc(self, amount=1, **labels):
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            new = self._series.get(key, 0) + amount
+            self._series[key] = new
+        _sample(self.name, labels, new)
+
+    def value(self, **labels):
+        return self._series.get(self._key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Point-in-time value."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = value
+        _sample(self.name, labels, value)
+
+    def inc(self, amount=1, **labels):
+        key = self._key(labels)
+        with self._lock:
+            new = self._series.get(key, 0) + amount
+            self._series[key] = new
+        _sample(self.name, labels, new)
+
+    def dec(self, amount=1, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels):
+        return self._series.get(self._key(labels), 0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (prometheus exposition shape):
+    per-series ``counts[i]`` = observations <= buckets[i], with an
+    implicit +Inf bucket, plus ``sum`` and ``count``."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=None):
+        super().__init__(name, help, labelnames)
+        bs = tuple(sorted(float(b) for b in (buckets or DEFAULT_BUCKETS)))
+        if not bs:
+            raise ValueError("histogram needs at least one finite bucket")
+        self.buckets = bs
+
+    def observe(self, value, **labels):
+        value = float(value)
+        key = self._key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = {"counts": [0] * (len(self.buckets) + 1),
+                      "sum": 0.0, "count": 0}
+                self._series[key] = st
+            i = 0
+            while i < len(self.buckets) and value > self.buckets[i]:
+                i += 1
+            st["counts"][i] += 1
+            st["sum"] += value
+            st["count"] += 1
+        _sample(self.name, labels, value)
+
+    def count(self, **labels):
+        st = self._series.get(self._key(labels))
+        return st["count"] if st else 0
+
+    def sum(self, **labels):
+        st = self._series.get(self._key(labels))
+        return st["sum"] if st else 0.0
+
+
+# -- registry ---------------------------------------------------------------
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric map.  Re-registering an existing name
+    returns the same object (so call sites need no module-level caching)
+    but a kind/label mismatch raises — two subsystems silently sharing a
+    name with different schemas is exactly the drift the registry
+    exists to prevent."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = {}
+
+    def _register(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or \
+                        m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind} with labels {m.labelnames}")
+                return m
+            m = cls(name, help=help, labelnames=labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()):
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(), buckets=None):
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def collect(self):
+        """Deterministically-ordered snapshot for the exporters:
+        one dict per metric with its series states."""
+        out = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            rec = {"name": m.name, "type": m.kind, "help": m.help,
+                   "labelnames": list(m.labelnames)}
+            if m.kind == "histogram":
+                rec["buckets"] = list(m.buckets)
+                rec["series"] = [
+                    {"labels": labels, "counts": list(st["counts"]),
+                     "sum": st["sum"], "count": st["count"]}
+                    for labels, st in m.series()]
+            else:
+                rec["series"] = [{"labels": labels, "value": v}
+                                 for labels, v in m.series()]
+            out.append(rec)
+        return out
+
+    def reset(self):
+        """Zero every series (registrations kept) — test isolation and
+        bench per-config snapshots."""
+        with self._lock:
+            for m in self._metrics.values():
+                m.reset()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry():
+    return _REGISTRY
+
+
+# -- catalog-backed recording front door ------------------------------------
+
+def _metric(name):
+    m = _REGISTRY.get(name)
+    if m is not None:
+        return m
+    from .catalog import METRICS
+    spec = METRICS.get(name)
+    if spec is None:
+        raise KeyError(
+            f"unknown metric {name!r} — declare it in "
+            "paddle_tpu/observability/catalog.py (the metrics-registry "
+            "lint checks references against the catalog)")
+    kind = spec["type"]
+    if kind == "histogram":
+        return _REGISTRY.histogram(name, help=spec.get("help", ""),
+                                   labelnames=spec.get("labels", ()),
+                                   buckets=spec.get("buckets"))
+    return _REGISTRY._register(_KINDS[kind], name,
+                               spec.get("help", ""),
+                               spec.get("labels", ()))
+
+
+def inc(name, amount=1, **labels):
+    """Increment a catalog-declared counter (or gauge); no-op when
+    telemetry is disabled."""
+    if not _ENABLED[0]:
+        return
+    _metric(name).inc(amount, **labels)
+
+
+def observe(name, value, **labels):
+    """Observe one value into a catalog-declared histogram."""
+    if not _ENABLED[0]:
+        return
+    _metric(name).observe(value, **labels)
+
+
+def set_gauge(name, value, **labels):
+    """Set a catalog-declared gauge."""
+    if not _ENABLED[0]:
+        return
+    _metric(name).set(value, **labels)

@@ -1,0 +1,247 @@
+"""Run-summary renderer: ``python -m paddle_tpu.observability report``.
+
+Reads the sinks the framework writes — a Prometheus text exposition
+file, a JSONL metrics log, a merged chrome trace — and renders one
+human-readable run summary: counters and gauges grouped by subsystem,
+histograms with count / mean / estimated p50/p90/p99 (linear
+interpolation inside the winning bucket), trace-event totals.
+
+The parsers are deliberately self-contained (stdlib only): the report
+must run against files produced by an earlier process, a different
+machine, or a BENCH_* artifact — never against live registry state.
+"""
+import argparse
+import json
+import sys
+
+__all__ = ["parse_prometheus", "parse_jsonl", "render_report", "main"]
+
+
+# -- parsers ---------------------------------------------------------------
+
+def _parse_labels(body):
+    labels = {}
+    for part in filter(None, body.split(",")):
+        k, _, v = part.partition("=")
+        labels[k.strip()] = v.strip().strip('"')
+    return labels
+
+
+def _split_sample(line):
+    """``name{a="b"} 1.5`` -> (name, labels dict, float)."""
+    if "{" in line:
+        name, rest = line.split("{", 1)
+        body, _, val = rest.rpartition("}")
+        return name.strip(), _parse_labels(body), float(val)
+    name, _, val = line.rpartition(" ")
+    return name.strip(), {}, float(val)
+
+
+def parse_prometheus(path):
+    """{metric: {"type", "help", "series": {labelkey: value},
+    "buckets": {labelkey: [(le, cumcount)...]}}} from an exposition
+    file.  Histogram ``_bucket``/``_sum``/``_count`` samples fold back
+    under the base metric name."""
+    metrics = {}
+
+    def base(name):
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[:-len(suf)] in metrics:
+                return name[:-len(suf)], suf
+        return name, ""
+
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                _, _, rest = line.partition("# TYPE ")
+                name, _, kind = rest.partition(" ")
+                metrics.setdefault(name, {
+                    "type": kind.strip(), "help": "",
+                    "series": {}, "buckets": {}})
+                continue
+            if line.startswith("# HELP "):
+                _, _, rest = line.partition("# HELP ")
+                name, _, help_ = rest.partition(" ")
+                metrics.setdefault(name, {
+                    "type": "", "help": "", "series": {}, "buckets": {}})
+                metrics[name]["help"] = help_
+                continue
+            if line.startswith("#"):
+                continue
+            name, labels, value = _split_sample(line)
+            name, suffix = base(name)
+            m = metrics.setdefault(name, {"type": "", "help": "",
+                                          "series": {}, "buckets": {}})
+            if suffix == "_bucket":
+                le = labels.pop("le", "+Inf")
+                key = tuple(sorted(labels.items()))
+                m["buckets"].setdefault(key, []).append((le, value))
+            else:
+                key = tuple(sorted(labels.items())) + \
+                    ((("__sample__", suffix),) if suffix else ())
+                m["series"][key] = value
+    return metrics
+
+
+def parse_jsonl(path):
+    """List of snapshot records (newest last); bad lines are skipped
+    with a count so a torn tail never hides the rest of the run."""
+    recs, bad = [], 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    return recs, bad
+
+
+# -- rendering -------------------------------------------------------------
+
+def _quantile(buckets, q):
+    """Estimate a quantile from cumulative (le, count) pairs; returns
+    (value, exact) where exact=False marks an +Inf-bucket hit."""
+    if not buckets:
+        return None, False
+    finite = [(float(le), c) for le, c in buckets if le != "+Inf"]
+    total = max(c for _, c in buckets)
+    if total <= 0:
+        return None, False
+    target = q * total
+    prev_le, prev_c = 0.0, 0.0
+    for le, c in sorted(finite):
+        if c >= target:
+            span = c - prev_c
+            frac = (target - prev_c) / span if span > 0 else 1.0
+            return prev_le + (le - prev_le) * frac, True
+        prev_le, prev_c = le, c
+    return (max(le for le, _ in finite) if finite else None), False
+
+
+def _labelkey_str(key):
+    parts = [f"{k}={v}" for k, v in key if k != "__sample__"]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _subsystem(name):
+    bits = name.split("_", 2)
+    return bits[1] if len(bits) > 2 and bits[0] == "pt" else "other"
+
+
+def _render_prom(metrics, lines):
+    by_sub = {}
+    for name, m in sorted(metrics.items()):
+        by_sub.setdefault(_subsystem(name), []).append((name, m))
+    for sub in sorted(by_sub):
+        lines.append(f"\n[{sub}]")
+        for name, m in by_sub[sub]:
+            if m["type"] == "histogram" or m["buckets"]:
+                for key, buckets in sorted(m["buckets"].items()):
+                    skey = dict(key)
+                    count = m["series"].get(
+                        tuple(sorted(skey.items())) +
+                        (("__sample__", "_count"),), 0)
+                    total = m["series"].get(
+                        tuple(sorted(skey.items())) +
+                        (("__sample__", "_sum"),), 0.0)
+                    mean = total / count if count else 0.0
+                    qs = []
+                    for q in (0.5, 0.9, 0.99):
+                        v, exact = _quantile(buckets, q)
+                        qs.append(f"p{int(q * 100)}"
+                                  f"{'~' if exact else '>'}"
+                                  f"{v:.3g}" if v is not None else
+                                  f"p{int(q * 100)}=?")
+                    lines.append(
+                        f"  {name}{_labelkey_str(key)}  count={count:g} "
+                        f"mean={mean:.3g} " + " ".join(qs))
+            else:
+                for key, value in sorted(m["series"].items()):
+                    lines.append(
+                        f"  {name}{_labelkey_str(key)}  {value:g}")
+
+
+def render_report(prom=None, jsonl=None, trace=None):
+    """Render the text report from whichever sinks were given."""
+    lines = ["== paddle_tpu telemetry report =="]
+    if prom:
+        metrics = parse_prometheus(prom)
+        n_series = sum(len(m["series"]) + len(m["buckets"])
+                       for m in metrics.values())
+        lines.append(f"prometheus: {prom} "
+                     f"({len(metrics)} metrics, {n_series} series)")
+        _render_prom(metrics, lines)
+    if jsonl:
+        recs, bad = parse_jsonl(jsonl)
+        runs = sorted({r["run"] for r in recs if "run" in r})
+        span_ns = (max(r["ts_ns"] for r in recs) -
+                   min(r["ts_ns"] for r in recs)) if recs else 0
+        lines.append(f"\njsonl: {jsonl} ({len(recs)} samples"
+                     + (f", {bad} unparseable" if bad else "")
+                     + (f", runs: {', '.join(runs)}" if runs else "")
+                     + f", span {span_ns / 1e9:.3f}s)")
+        latest = {}
+        for r in recs:
+            key = (r.get("metric"),
+                   tuple(sorted((r.get("labels") or {}).items())))
+            latest[key] = r
+        for (name, key), r in sorted(latest.items()):
+            if name is None:
+                continue
+            if r["type"] == "histogram":
+                lines.append(f"  {name}{_labelkey_str(key)}  "
+                             f"count={r['count']:g} sum={r['sum']:.4g}")
+            else:
+                lines.append(f"  {name}{_labelkey_str(key)}  "
+                             f"{r['value']:g}")
+    if trace:
+        with open(trace, encoding="utf-8") as f:
+            events = json.load(f).get("traceEvents", [])
+        by_ph = {}
+        for e in events:
+            by_ph[e.get("ph", "?")] = by_ph.get(e.get("ph", "?"), 0) + 1
+        lines.append(
+            f"\ntrace: {trace} ({len(events)} events — "
+            f"{by_ph.get('X', 0)} spans, {by_ph.get('i', 0)} instants, "
+            f"{by_ph.get('C', 0)} counter samples)")
+    if len(lines) == 1:
+        lines.append("(no sinks given — pass --prom/--jsonl/--trace)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability",
+        description="Telemetry tooling for the unified metrics "
+                    "registry (see docs/observability.md).")
+    sub = ap.add_subparsers(dest="cmd")
+    rp = sub.add_parser("report",
+                        help="summarize telemetry sinks into one "
+                             "run report")
+    rp.add_argument("--prom", default=None,
+                    help="Prometheus text exposition file")
+    rp.add_argument("--jsonl", default=None,
+                    help="JSONL metrics log (PADDLE_METRICS_LOG format)")
+    rp.add_argument("--trace", default=None,
+                    help="merged chrome-trace JSON (timeline.py)")
+    args = ap.parse_args(argv)
+    if args.cmd != "report":
+        ap.print_help()
+        return 2
+    if not (args.prom or args.jsonl or args.trace):
+        print("error: pass at least one of --prom/--jsonl/--trace",
+              file=sys.stderr)
+        return 2
+    try:
+        print(render_report(prom=args.prom, jsonl=args.jsonl,
+                            trace=args.trace))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0

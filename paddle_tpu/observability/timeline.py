@@ -1,0 +1,99 @@
+"""One run, one timeline: merge profiler host spans, guardian events
+and captured metric samples into a single chrome://tracing JSON.
+
+Three telemetry streams exist with two clock bases:
+
+- profiler host spans (``RecordEvent``) and metric capture samples are
+  stamped with ``time.perf_counter_ns`` (CLOCK_MONOTONIC on Linux —
+  the same base the native C++ tracer's steady_clock uses, see
+  ``profiler.Profiler.export``);
+- guardian events are stamped with wall ``time.time_ns`` (they must be
+  mergeable across processes).
+
+The merge converts guardian timestamps onto the perf_counter axis via
+the (wall_ns, perf_ns) pair captured at
+:func:`metrics.start_capture` (minted on the fly if no capture ran —
+both clocks tick at the same rate, so the offset is all that matters).
+
+Event mapping:
+
+- host spans  -> ``"ph": "X"`` duration events (tid 0, the span track)
+- guardian    -> ``"ph": "i"`` instants (tid 1, full args attached)
+- samples     -> ``"ph": "C"`` counters (one track per metric+labels)
+"""
+import json
+import os
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["merged_trace_events", "export_chrome_trace"]
+
+PID = 0
+TID_SPANS = 0
+TID_GUARDIAN = 1
+
+
+def _guardian_to_perf_ns(ts_ns, pair):
+    wall0, perf0 = pair
+    return ts_ns - wall0 + perf0
+
+
+def merged_trace_events(include_profiler=True, include_guardian=True,
+                        include_samples=True):
+    """Build the merged chrome traceEvents list (timestamps in µs on
+    the perf_counter axis)."""
+    events = [
+        {"name": "process_name", "ph": "M", "pid": PID,
+         "args": {"name": "paddle_tpu run"}},
+        {"name": "thread_name", "ph": "M", "pid": PID, "tid": TID_SPANS,
+         "args": {"name": "host spans"}},
+        {"name": "thread_name", "ph": "M", "pid": PID,
+         "tid": TID_GUARDIAN, "args": {"name": "guardian events"}},
+    ]
+    if include_profiler:
+        from ..profiler import _collect_events
+        for e in _collect_events():
+            events.append({
+                "name": e.name, "cat": str(e.event_type), "ph": "X",
+                "ts": e.start / 1e3, "dur": (e.end - e.start) / 1e3,
+                "pid": PID, "tid": TID_SPANS})
+    if include_guardian:
+        from ..framework.guardian import events as guardian_events
+        pair = _metrics.clock_pair() or (time.time_ns(),
+                                         time.perf_counter_ns())
+        for rec in guardian_events():
+            events.append({
+                "name": rec["event"], "cat": "guardian", "ph": "i",
+                "s": "g",
+                "ts": _guardian_to_perf_ns(rec["ts_ns"], pair) / 1e3,
+                "pid": PID, "tid": TID_GUARDIAN, "args": dict(rec)})
+    if include_samples:
+        for s in _metrics.samples():
+            labels = s["labels"]
+            name = s["metric"]
+            if labels:
+                name += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+            events.append({
+                "name": name, "cat": "metric", "ph": "C",
+                "ts": s["ts_perf_ns"] / 1e3, "pid": PID,
+                "args": {"value": s["value"]}})
+    events.sort(key=lambda e: (e.get("ts", -1), e["ph"]))
+    return events
+
+
+def export_chrome_trace(path, include_profiler=True,
+                        include_guardian=True, include_samples=True):
+    """Write the merged timeline as chrome://tracing / Perfetto JSON."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    data = {"traceEvents": merged_trace_events(
+        include_profiler, include_guardian, include_samples),
+        "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+    return path

@@ -13,7 +13,7 @@ import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView"]
+           "ProfilerResult", "SummaryView"]
 
 
 class SummaryView(Enum):
@@ -102,6 +102,15 @@ def _collect_events():
             t0, t1, _tid = struct.unpack_from("<qqq", blob, off); off += 24
             events.append(_HostEvent(name, t0, t1, cat))
     return events
+
+
+def _view_of(event_type):
+    """Map a host event's category to the SummaryView it renders under:
+    user ``RecordEvent`` annotations (the default ``UserDefined`` type)
+    belong to ``UDFView``; every other category is framework-internal
+    and renders under ``OperatorView``."""
+    return (SummaryView.UDFView if "UserDefined" in str(event_type)
+            else SummaryView.OperatorView)
 
 
 class RecordEvent:
@@ -208,12 +217,29 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        # ``views`` (list of SummaryView) selects tables in the
-        # reference; this profiler renders the one merged host-event
-        # table regardless, so the parameter is accepted for API parity
+        """Render the merged host-event table.
+
+        ``views`` (a :class:`SummaryView` or list of them) filters the
+        rows by the view each event maps to (see :func:`_view_of`):
+        ``UDFView`` selects ``RecordEvent`` user spans (the default
+        ``UserDefined`` event_type), every other category renders under
+        ``OperatorView``.  Parity gaps vs the reference: this is a
+        host-span profiler, so Device/Kernel/Memory*/Distributed views
+        have no rows of their own — requesting only those views yields
+        a header-only table (device timing lives in the jax profiler
+        trace under ``log_dir``); ``OverView``/``ModelView`` are not
+        separately aggregated and fold into ``OperatorView``.
+        """
+        if views is not None and not isinstance(views, (list, tuple)):
+            views = [views]
         lines = ["------------------- Profiler Summary -------------------"]
+        if views is not None:
+            names = ", ".join(v.name for v in views)
+            lines.append(f"views: {names}")
         by_name = {}
         for e in _collect_events():
+            if views is not None and _view_of(e.event_type) not in views:
+                continue
             d = by_name.setdefault(e.name, [0, 0.0])
             d[0] += 1
             d[1] += (e.end - e.start) / 1e6
@@ -260,5 +286,68 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+class ProfilerResult:
+    """Queryable host-event collection parsed back from an exported
+    chrome trace (the ``Profiler.export`` format).
+
+    ``events`` holds :class:`_HostEvent`-shaped records — ``name``,
+    ``start``/``end`` (ns, on the exporting process's
+    ``perf_counter_ns`` clock), ``event_type`` (the trace ``cat``
+    field).  Iteration and ``len()`` delegate to it."""
+
+    def __init__(self, events):
+        self.events = list(events)
+
+    def __len__(self):
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def query(self, name=None, event_type=None, view=None):
+        """Events filtered by exact ``name``, exact ``event_type``
+        (category string), and/or :class:`SummaryView` membership."""
+        out = self.events
+        if name is not None:
+            out = [e for e in out if e.name == name]
+        if event_type is not None:
+            out = [e for e in out if str(e.event_type) == str(event_type)]
+        if view is not None:
+            out = [e for e in out if _view_of(e.event_type) == view]
+        return list(out)
+
+
 def load_profiler_result(path):
-    return None
+    """Parse a chrome-trace JSON written by :meth:`Profiler.export`
+    back into queryable host events.
+
+    Return contract: a :class:`ProfilerResult` whose ``.events`` hold
+    one ``_HostEvent`` per complete-span (``"ph": "X"``) trace event,
+    with ``start``/``end`` reconstructed in nanoseconds from the file's
+    microsecond ``ts``/``dur`` (so ``export`` → ``load_profiler_result``
+    round-trips names, categories and durations to µs precision on the
+    same clock base).  Non-span phases — the instants and counter
+    samples a merged ``observability.timeline`` trace adds — are
+    skipped, so a merged trace loads as its host-span subset.  Returns
+    ``None`` when ``path`` does not exist (probe-friendly, the old stub
+    behavior); raises ``ValueError`` on a file that is not a chrome
+    trace (no ``traceEvents``)."""
+    import json as _json
+    import os as _os
+    if not _os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        data = _json.load(f)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(
+            f"{path} is not an exported profiler trace "
+            "(missing traceEvents)")
+    events = []
+    for rec in data["traceEvents"]:
+        if rec.get("ph") != "X":
+            continue
+        start = int(round(rec.get("ts", 0) * 1e3))
+        dur = int(round(rec.get("dur", 0) * 1e3))
+        events.append(_HostEvent(rec.get("name", ""), start, start + dur,
+                                 rec.get("cat", "UserDefined")))
+    return ProfilerResult(events)

@@ -43,3 +43,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: continuous-batching serving engine "
         "(inference/serving.py) test — select with -m serving")
+    config.addinivalue_line(
+        "markers", "obs: unified telemetry layer "
+        "(paddle_tpu/observability/) test — select with -m obs")

@@ -1,0 +1,511 @@
+"""Unified telemetry layer (ISSUE 5 tentpole): metrics registry,
+exporters, merged run timeline, hot-path instrumentation, and the
+zero-sync overhead contract.
+
+Acceptance anchors:
+- one run, one timeline: an instrumented ``Model.fit`` +
+  ``ServingEngine`` session yields a merged chrome trace with host
+  spans, guardian events and metric samples on a shared clock, plus
+  Prometheus/JSONL sinks the report CLI summarizes;
+- zero syncs: device-transfer counts (guardian ``_host_bool`` shim +
+  a ``jax.device_get`` shim) are IDENTICAL with telemetry on vs off.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import catalog, export, metrics, timeline
+from paddle_tpu.framework import failpoints, guardian
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models import GPTForPretraining, gpt3_tiny
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    obs.enable(True)
+    obs.get_registry().reset()
+    obs.stop_capture()
+    failpoints.clear()
+    guardian.clear_events()
+    yield
+    obs.enable(True)
+    obs.get_registry().reset()
+    obs.stop_capture()
+    failpoints.clear()
+    guardian.clear_events()
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    return GPTForPretraining(gpt3_tiny())
+
+
+def _reg_model(seed=3):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+                  nn.MSELoss())
+    return model
+
+
+def _batches(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(8, 4).astype("float32"),
+             rng.randn(8, 2).astype("float32")) for _ in range(n)]
+
+
+# -- registry primitives ---------------------------------------------------
+
+class TestRegistry:
+    def test_counter_labels_and_monotonicity(self):
+        c = metrics.MetricsRegistry().counter("pt_x", labelnames=("op",))
+        c.inc(op="a")
+        c.inc(2, op="a")
+        c.inc(op="b")
+        assert c.value(op="a") == 3 and c.value(op="b") == 1
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1, op="a")
+        with pytest.raises(ValueError, match="labels"):
+            c.inc(wrong="a")
+
+    def test_gauge_set_inc_dec(self):
+        g = metrics.MetricsRegistry().gauge("pt_g")
+        g.set(5.0)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 4.0
+
+    def test_histogram_buckets_sum_count(self):
+        h = metrics.MetricsRegistry().histogram("pt_h", buckets=(1, 10))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        (labels, st), = h.series()
+        assert labels == {} and st["counts"] == [1, 1, 1]
+        assert st["count"] == 3 and st["sum"] == pytest.approx(55.5)
+
+    def test_reregister_same_object_conflict_raises(self):
+        reg = metrics.MetricsRegistry()
+        a = reg.counter("pt_c", labelnames=("op",))
+        assert reg.counter("pt_c", labelnames=("op",)) is a
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("pt_c")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.counter("pt_c", labelnames=("other",))
+
+    def test_record_against_undeclared_name_raises(self):
+        # name built by concatenation so the metrics-registry lint's
+        # text scan never sees a matchable bogus literal in this file
+        with pytest.raises(KeyError, match="catalog"):
+            obs.inc("pt_train_" + "not_a_real_metric_total")
+
+    def test_thread_safety_exact_total(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("pt_t")
+
+        def worker():
+            for _ in range(1000):
+                c.inc()
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == 8000
+
+    def test_catalog_well_formed_and_instantiable(self):
+        assert catalog.METRICS
+        for name, spec in catalog.METRICS.items():
+            assert name.startswith("pt_") and \
+                name.split("_", 2)[1] in catalog.subsystems()
+            assert spec["type"] in ("counter", "gauge", "histogram")
+            m = metrics._metric(name)     # registers into the default
+            assert m.labelnames == tuple(spec.get("labels", ()))
+
+    def test_disabled_records_nothing(self):
+        with obs.disabled():
+            obs.inc("pt_train_tokens_total", 100)
+            obs.observe("pt_train_step_latency_ms", 5.0)
+        assert obs.get_registry().get("pt_train_tokens_total") is None \
+            or obs.get_registry().get("pt_train_tokens_total").value() == 0
+
+
+# -- exporters -------------------------------------------------------------
+
+class TestExporters:
+    def test_prometheus_exposition_shape(self, tmp_path):
+        obs.inc("pt_store_ops_total", 3, op='we"ird\n')
+        obs.observe("pt_store_op_latency_ms", 2.0, op="get")
+        path = export.write_prometheus(str(tmp_path / "m.prom"))
+        text = open(path).read()
+        assert "# TYPE pt_store_ops_total counter" in text
+        assert 'pt_store_ops_total{op="we\\"ird\\n"} 3' in text
+        # cumulative buckets end at +Inf == count
+        assert 'pt_store_op_latency_ms_bucket{op="get",le="+Inf"} 1' \
+            in text
+        assert "pt_store_op_latency_ms_count" in text
+
+    def test_jsonl_sink_and_env_default(self, tmp_path, monkeypatch):
+        obs.inc("pt_train_tokens_total", 7)
+        p = str(tmp_path / "m.jsonl")
+        assert export.write_jsonl(p, run="r1") == p
+        recs = [json.loads(line) for line in open(p)]
+        (rec,) = [r for r in recs
+                  if r["metric"] == "pt_train_tokens_total"]
+        assert rec["value"] == 7 and rec["run"] == "r1" \
+            and rec["ts_ns"] > 0
+        # env-var default sink, the guardian-log pattern
+        monkeypatch.setenv(export.JSONL_ENV, str(tmp_path / "env.jsonl"))
+        assert export.write_jsonl() == str(tmp_path / "env.jsonl")
+        monkeypatch.delenv(export.JSONL_ENV)
+        assert export.write_jsonl() is None
+
+    def test_exporter_materializes_device_scalar(self):
+        # a device scalar handed to a gauge syncs ONCE, at export time,
+        # through the budgeted _materialize funnel
+        obs.set_gauge("pt_train_loss", jnp.asarray(1.5))
+        (rec,) = [r for r in export.snapshot()
+                  if r["metric"] == "pt_train_loss"]
+        assert isinstance(rec["value"], float) and rec["value"] == 1.5
+
+
+# -- hot-path instrumentation ----------------------------------------------
+
+class TestFitInstrumentation:
+    def test_fit_records_steps_latency_tokens_loss(self):
+        model = _reg_model()
+        model.fit(_batches(5), epochs=1, verbose=0)
+        reg = obs.get_registry()
+        assert reg.get("pt_train_steps_total").value(outcome="ok") == 5
+        assert reg.get("pt_train_step_latency_ms").count() == 5
+        assert reg.get("pt_train_tokens_total").value() == 5 * 8 * 4
+        assert reg.get("pt_train_tokens_per_sec").value() > 0
+        assert np.isfinite(reg.get("pt_train_loss").value())
+
+    def test_guardian_skip_counted_as_outcome(self):
+        model = _reg_model()
+        cfg = guardian.GuardianConfig(skip_limit=10, ckpt_root=None,
+                                      loss_spike=False)
+        failpoints.set_failpoint("guardian.poison_batch", "skip*1")
+        model.fit(_batches(4), epochs=1, verbose=0, guardian=cfg)
+        reg = obs.get_registry()
+        assert reg.get("pt_train_steps_total").value(outcome="skip") == 1
+        assert reg.get("pt_train_steps_total").value(outcome="ok") == 3
+
+
+class TestServingInstrumentation:
+    def test_serving_counters_histograms_occupancy(self, gpt):
+        rng = np.random.RandomState(4)
+        eng = ServingEngine(gpt, num_slots=2, chunk=4,
+                            prefill_buckets=(8, 16))
+        budgets = [3, 6, 4]
+        for b in budgets:
+            eng.submit(rng.randint(0, 1024, (6,)).astype("int32"), b)
+        eng.run()
+        reg = obs.get_registry()
+        assert reg.get("pt_serving_admissions_total").value() == 3
+        assert sum(v for _, v in
+                   reg.get("pt_serving_prefills_total").series()) == 3
+        assert reg.get("pt_serving_decoded_tokens_total").value() \
+            == sum(budgets)
+        assert reg.get("pt_serving_ttft_ms").count() == 3
+        assert reg.get("pt_serving_queue_wait_ms").count() == 3
+        assert reg.get("pt_serving_evictions_total").value(
+            reason="budget") == 3
+        # all slots freed by the end of the run
+        assert reg.get("pt_serving_slot_occupancy").value() == 0
+        assert reg.get("pt_serving_chunks_total").value() \
+            == eng.stats["chunks"]
+        assert reg.get("pt_serving_useful_tokens_per_sec").value() > 0
+
+
+class TestOtherLayers:
+    def test_store_ops_latency_and_retries(self):
+        from paddle_tpu.distributed.store import TCPStore
+        store = TCPStore(is_master=True, use_native=False)
+        try:
+            store.set("k", b"v")
+            assert store.get("k") == b"v"
+            store.add("c", 2)
+            store.wait("k")
+        finally:
+            store.close()
+        reg = obs.get_registry()
+        for op in ("set", "get", "add", "wait"):
+            assert reg.get("pt_store_ops_total").value(op=op) == 1
+            assert reg.get("pt_store_op_latency_ms").count(op=op) == 1
+
+    def test_store_retries_counted_under_failpoint(self):
+        from paddle_tpu.distributed.store import TCPStore
+        store = TCPStore(is_master=True, use_native=False, timeout=10.0)
+        try:
+            failpoints.set_failpoint("store.io", "error*2")
+            store.set("k2", b"v")      # retried inside the envelope
+        finally:
+            failpoints.clear()
+            store.close()
+        assert obs.get_registry().get(
+            "pt_store_retries_total").value() >= 2
+
+    def test_collective_world1_calls_bytes_barrier_latency(self):
+        import paddle_tpu.distributed as dist
+        t = paddle.to_tensor(np.ones((4, 4), "float32"))
+        dist.all_reduce(t)
+        dist.barrier()
+        reg = obs.get_registry()
+        assert reg.get("pt_collective_calls_total").value(
+            op="all_reduce") == 1
+        assert reg.get("pt_collective_bytes_total").value(
+            op="all_reduce") == 64
+        assert reg.get("pt_collective_latency_ms").count(
+            op="barrier") == 1
+
+    def test_dataloader_threaded_wait_and_depth(self):
+        class DS(paddle.io.Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, "float32")
+
+            def __len__(self):
+                return 8
+        loader = paddle.io.DataLoader(DS(), batch_size=2, num_workers=0)
+        # threaded fallback path is taken by the generic queue path;
+        # force it by using num_workers=1 iterable-free map dataset
+        loader.num_workers = 1
+        loader.batch_sampler = paddle.io.BatchSampler(
+            DS(), batch_size=2, shuffle=False)
+        # monkeypatch-free: exercise the simple threaded-queue path
+        from paddle_tpu.io.worker import MultiProcessIter  # noqa: F401
+        batches = list(loader._iter_batches())
+        assert len(batches) == 4
+        # the worker/threaded instrumented paths are covered by the
+        # fork'd loader when available; assert the metrics exist and
+        # record through a real threaded iteration
+        n = sum(1 for _ in paddle.io.DataLoader(DS(), batch_size=2,
+                                                num_workers=2))
+        assert n == 4
+        reg = obs.get_registry()
+        assert reg.get("pt_dataloader_wait_ms").count() >= 4
+
+    def test_checkpoint_save_load_bytes_and_fallbacks(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        root = str(tmp_path / "root")
+        ckpt.save_checkpoint({"a": jnp.ones((8, 8))}, root, 1)
+        ckpt.save_checkpoint({"a": jnp.ones((8, 8)) * 2}, root, 2)
+        import glob
+        (shard,) = glob.glob(os.path.join(root, "step_00000002",
+                                          "a", "*.npy"))
+        with open(shard, "wb") as f:
+            f.write(b"garbage")        # corrupt the newest commit
+        out = ckpt.load_state_dict(root)
+        assert float(np.asarray(out["a"])[0, 0]) == 1.0  # fell back
+        reg = obs.get_registry()
+        assert reg.get("pt_checkpoint_save_ms").count() == 2
+        assert reg.get("pt_checkpoint_load_ms").count() == 1
+        assert reg.get("pt_checkpoint_bytes_total").value(
+            direction="save") == 2 * 8 * 8 * 4
+        assert reg.get("pt_checkpoint_bytes_total").value(
+            direction="load") == 8 * 8 * 4
+        assert reg.get("pt_checkpoint_fallbacks_total").value(
+            kind="corrupt") == 1
+
+
+# -- THE overhead contract -------------------------------------------------
+
+class TestZeroSyncContract:
+    def test_fit_same_host_sync_count_with_telemetry_on_vs_off(self):
+        """The guardian ``_host_bool`` counting shim: a guarded fit
+        performs exactly one sync per step, telemetry on or off."""
+        cfg = dict(skip_limit=10, ckpt_root=None, loss_spike=False)
+
+        def syncs_of(enabled):
+            model = _reg_model(seed=7)
+            if not enabled:
+                ctx = obs.disabled()
+            else:
+                from contextlib import nullcontext
+                ctx = nullcontext()
+            before = guardian.host_sync_count()
+            with ctx:
+                model.fit(_batches(4), epochs=1, verbose=0,
+                          guardian=guardian.GuardianConfig(**cfg))
+            return guardian.host_sync_count() - before
+
+        on, off = syncs_of(True), syncs_of(False)
+        assert on == off == 4       # one verdict readback per step
+
+    def test_serving_same_device_get_count_with_telemetry_on_vs_off(
+            self, gpt, monkeypatch):
+        """The serving contract: ONE bundled device_get per engine
+        cycle — instrumentation must not add transfers."""
+        counts = {"n": 0}
+        real = jax.device_get
+
+        def counting(x):
+            counts["n"] += 1
+            return real(x)
+
+        def run_once(enabled):
+            rng = np.random.RandomState(5)
+            eng = ServingEngine(gpt, num_slots=2, chunk=4,
+                                prefill_buckets=(8,))
+            for b in (3, 5, 4):
+                eng.submit(rng.randint(0, 1024, (6,)).astype("int32"), b)
+            counts["n"] = 0
+            monkeypatch.setattr(jax, "device_get", counting)
+            try:
+                if enabled:
+                    eng.run()
+                else:
+                    with obs.disabled():
+                        eng.run()
+            finally:
+                monkeypatch.setattr(jax, "device_get", real)
+            return counts["n"], eng.stats["chunks"]
+
+        (n_on, chunks_on) = run_once(True)
+        (n_off, chunks_off) = run_once(False)
+        assert chunks_on == chunks_off
+        assert n_on == n_off        # zero additional transfers
+        assert n_on > 0             # the shim actually measured syncs
+
+
+# -- one run, one timeline -------------------------------------------------
+
+class TestTimeline:
+    def test_merged_trace_three_streams_shared_clock(self, tmp_path,
+                                                     monkeypatch):
+        """Acceptance: instrumented fit + serving session -> merged
+        chrome trace holding host spans (X), guardian events (i) and
+        metric samples (C) with overlapping timestamp ranges."""
+        import paddle_tpu.profiler as profiler
+        monkeypatch.setattr(profiler, "_native_tracer", lambda: None)
+        profiler._HOST_EVENTS.clear()
+        profiler._COLLECTING[0] = True
+        try:
+            obs.start_capture()
+            with profiler.RecordEvent("fit_session"):
+                model = _reg_model()
+                model.fit(_batches(3), epochs=1, verbose=0,
+                          guardian=guardian.GuardianConfig(
+                              skip_limit=10, ckpt_root=None,
+                              loss_spike=False))
+            guardian.emit("skip_step", step=99, reason="nonfinite",
+                          consecutive=1)   # a guardian instant for sure
+            obs.stop_capture()
+            path = timeline.export_chrome_trace(
+                str(tmp_path / "run.trace.json"))
+        finally:
+            profiler._COLLECTING[0] = False
+            profiler._HOST_EVENTS.clear()
+        events = json.load(open(path))["traceEvents"]
+        spans = [e for e in events if e["ph"] == "X"]
+        instants = [e for e in events if e["ph"] == "i"]
+        samples = [e for e in events if e["ph"] == "C"]
+        assert spans and instants and samples
+        assert any(e["name"] == "fit_session" for e in spans)
+        assert any(e["name"] == "skip_step" for e in instants)
+        assert any(e["name"].startswith("pt_train_") for e in samples)
+        # shared clock: every stream's timestamps land inside (a small
+        # margin around) the outer fit span
+        (span,) = [e for e in spans if e["name"] == "fit_session"]
+        lo, hi = span["ts"] - 1e6, span["ts"] + span["dur"] + 1e6
+        for e in instants + samples:
+            assert lo <= e["ts"] <= hi
+
+    def test_profiler_loads_merged_trace_as_span_subset(self, tmp_path,
+                                                        monkeypatch):
+        import paddle_tpu.profiler as profiler
+        monkeypatch.setattr(profiler, "_native_tracer", lambda: None)
+        profiler._HOST_EVENTS.clear()
+        profiler._COLLECTING[0] = True
+        try:
+            obs.start_capture()
+            with profiler.RecordEvent("only_span"):
+                obs.inc("pt_train_tokens_total", 1)
+            obs.stop_capture()
+            path = timeline.export_chrome_trace(
+                str(tmp_path / "t.json"))
+        finally:
+            profiler._COLLECTING[0] = False
+            profiler._HOST_EVENTS.clear()
+        res = profiler.load_profiler_result(path)
+        assert [e.name for e in res] == ["only_span"]
+
+
+# -- report CLI ------------------------------------------------------------
+
+class TestReportCLI:
+    def test_report_renders_prom_jsonl_trace(self, tmp_path, capsys):
+        obs.start_capture()
+        obs.inc("pt_serving_admissions_total", 4)
+        for v in (3.0, 9.0, 27.0):
+            obs.observe("pt_serving_ttft_ms", v)
+        obs.stop_capture()
+        prom = export.write_prometheus(str(tmp_path / "r.prom"))
+        jsl = export.write_jsonl(str(tmp_path / "r.jsonl"), run="t")
+        tr = timeline.export_chrome_trace(
+            str(tmp_path / "r.trace.json"), include_profiler=False,
+            include_guardian=False)
+        rc = obs.main(["report", "--prom", prom, "--jsonl", jsl,
+                       "--trace", tr])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pt_serving_admissions_total  4" in out
+        assert "pt_serving_ttft_ms" in out and "count=3" in out
+        assert "p50" in out and "counter samples" in out
+
+    def test_report_without_sinks_exits_2(self, capsys):
+        assert obs.main(["report"]) == 2
+
+    def test_quantile_interpolates_inside_winning_bucket(self):
+        from paddle_tpu.observability.report import _quantile
+        # cumulative: 50 obs <= 100, 100 obs <= 1000.  q=0.6 -> the
+        # 60th obs sits 10/50 into the (100, 1000] bucket.
+        buckets = [("100", 50), ("1000", 100), ("+Inf", 100)]
+        val, exact = _quantile(buckets, 0.6)
+        assert exact and val == pytest.approx(280.0)
+        # first-bucket targets interpolate from (0, 0)
+        val, exact = _quantile(buckets, 0.25)
+        assert exact and val == pytest.approx(50.0)
+
+
+# -- the lint pass ---------------------------------------------------------
+
+class TestMetricsRegistryLint:
+    def test_unknown_metric_reference_is_a_finding(self, tmp_path):
+        from paddle_tpu.analysis.runner import run_passes
+        bogus = "pt_serving_" + "imaginary_gauge"
+        (tmp_path / "test_fixture.py").write_text(
+            f'REF = "{bogus}"\n'
+            'IGNORED = "pt_batch_shm_tag"\n')
+        found = run_passes(paths=[str(tmp_path)],
+                           passes=["metrics-registry"])
+        assert [(f.code, f.detail) for f in found] == \
+            [("unknown-metric", bogus)]
+
+    def test_doc_table_drift_is_a_finding(self, monkeypatch):
+        from paddle_tpu.analysis.runner import run_passes, REPO_ROOT
+        from paddle_tpu.observability import catalog as cat
+        drifted = "pt_train_" + "zz_drifted"
+        monkeypatch.setitem(cat.METRICS, drifted, {"type": "gauge",
+                                                   "labels": ()})
+        found = run_passes(paths=[os.path.join(REPO_ROOT, "docs")],
+                           passes=["metrics-registry"])
+        assert [(f.code, f.detail) for f in found] == \
+            [("catalog-drift", drifted)]
+
+    def test_real_tree_is_clean(self):
+        from paddle_tpu.analysis.runner import run_passes
+        assert run_passes(passes=["metrics-registry"]) == []

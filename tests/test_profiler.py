@@ -1,0 +1,159 @@
+"""Profiler coverage (ISSUE 5 satellite): scheduler state machine,
+RecordEvent fallback collection, export -> load_profiler_result
+round-trip, step_info, summary(views=)."""
+import json
+import os
+
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (Profiler, ProfilerResult, ProfilerState,
+                                 RecordEvent, SummaryView, make_scheduler,
+                                 load_profiler_result)
+
+
+@pytest.fixture
+def py_tracer(monkeypatch):
+    """Force the pure-Python span collection (the native C++ tracer,
+    when built, would otherwise swallow spans) and arm it."""
+    monkeypatch.setattr(profiler, "_native_tracer", lambda: None)
+    profiler._HOST_EVENTS.clear()
+    profiler._COLLECTING[0] = True
+    yield
+    profiler._COLLECTING[0] = False
+    profiler._HOST_EVENTS.clear()
+
+
+class TestMakeScheduler:
+    def test_closed_ready_record_sequence(self):
+        sched = make_scheduler(closed=2, ready=1, record=2)
+        states = [sched(i) for i in range(5)]
+        assert states == [ProfilerState.CLOSED, ProfilerState.CLOSED,
+                          ProfilerState.READY, ProfilerState.RECORD,
+                          ProfilerState.RECORD_AND_RETURN]
+
+    def test_cycle_repeats_without_repeat_limit(self):
+        sched = make_scheduler(closed=1, ready=1, record=1)
+        assert [sched(i) for i in range(6)] == [
+            ProfilerState.CLOSED, ProfilerState.READY,
+            ProfilerState.RECORD_AND_RETURN] * 2
+
+    def test_skip_first_then_cycle(self):
+        sched = make_scheduler(closed=1, ready=0, record=1, skip_first=2)
+        # steps 0,1 skipped; then closed, then record-and-return
+        assert [sched(i) for i in range(4)] == [
+            ProfilerState.CLOSED, ProfilerState.CLOSED,
+            ProfilerState.CLOSED, ProfilerState.RECORD_AND_RETURN]
+
+    def test_repeat_limit_closes_for_good(self):
+        sched = make_scheduler(closed=0, ready=0, record=1, repeat=2)
+        assert sched(0) == ProfilerState.RECORD_AND_RETURN
+        assert sched(1) == ProfilerState.RECORD_AND_RETURN
+        assert sched(2) == ProfilerState.CLOSED
+        assert sched(99) == ProfilerState.CLOSED
+
+
+class TestRecordEventFallback:
+    def test_span_collected_with_name_and_type(self, py_tracer):
+        with RecordEvent("my_span"):
+            pass
+        with RecordEvent("op_span", event_type="Operator"):
+            pass
+        events = profiler._collect_events()
+        names = {e.name for e in events}
+        assert {"my_span", "op_span"} <= names
+        (udf,) = [e for e in events if e.name == "my_span"]
+        assert udf.event_type == "UserDefined"
+        assert udf.end >= udf.start
+
+    def test_not_collected_when_disarmed(self, py_tracer):
+        profiler._COLLECTING[0] = False
+        with RecordEvent("ghost"):
+            pass
+        assert all(e.name != "ghost" for e in profiler._collect_events())
+
+    def test_begin_end_explicit_api(self, py_tracer):
+        ev = RecordEvent("explicit")
+        ev.begin()
+        ev.end()
+        assert any(e.name == "explicit"
+                   for e in profiler._collect_events())
+
+
+class TestExportLoadRoundTrip:
+    def test_round_trip_names_types_durations(self, py_tracer, tmp_path):
+        with RecordEvent("alpha"):
+            with RecordEvent("beta", event_type="Operator"):
+                pass
+        path = os.path.join(str(tmp_path), "trace.json")
+        prof = Profiler(timer_only=True)
+        out = prof.export(path)
+        assert out == path
+        res = load_profiler_result(path)
+        assert isinstance(res, ProfilerResult) and len(res) == 2
+        exported = {e.name: e for e in profiler._collect_events()}
+        for e in res:
+            src = exported[e.name]
+            assert str(e.event_type) == str(src.event_type)
+            # µs-precision round-trip on the same clock base
+            assert abs(e.start - src.start) < 1000
+            assert abs((e.end - e.start) - (src.end - src.start)) < 1000
+
+    def test_query_by_name_and_view(self, py_tracer, tmp_path):
+        with RecordEvent("udf"):
+            pass
+        with RecordEvent("fw", event_type="Framework"):
+            pass
+        path = Profiler(timer_only=True).export(
+            os.path.join(str(tmp_path), "t.json"))
+        res = load_profiler_result(path)
+        assert [e.name for e in res.query(name="udf")] == ["udf"]
+        assert {e.name for e in res.query(view=SummaryView.UDFView)} \
+            == {"udf"}
+        assert {e.name for e in res.query(view=SummaryView.OperatorView)} \
+            == {"fw"}
+
+    def test_missing_file_returns_none(self, tmp_path):
+        assert load_profiler_result(
+            os.path.join(str(tmp_path), "nope.json")) is None
+
+    def test_non_trace_json_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"hello": 1}))
+        with pytest.raises(ValueError, match="traceEvents"):
+            load_profiler_result(str(p))
+
+
+class TestSummaryViews:
+    def test_views_filters_udf_vs_operator(self, py_tracer):
+        with RecordEvent("user_thing"):
+            pass
+        with RecordEvent("op_thing", event_type="Operator"):
+            pass
+        prof = Profiler(timer_only=True)
+        udf = prof.summary(views=SummaryView.UDFView)
+        assert "user_thing" in udf and "op_thing" not in udf
+        ops = prof.summary(views=[SummaryView.OperatorView])
+        assert "op_thing" in ops and "user_thing" not in ops
+        both = prof.summary()
+        assert "user_thing" in both and "op_thing" in both
+
+    def test_device_only_views_render_header_only(self, py_tracer):
+        with RecordEvent("host_span"):
+            pass
+        out = Profiler(timer_only=True).summary(
+            views=[SummaryView.KernelView])
+        assert "Summary" in out and "host_span" not in out
+
+
+class TestStepInfo:
+    def test_empty_then_populated(self):
+        prof = Profiler(timer_only=True)
+        assert prof.step_info() == ""
+        prof.start()
+        prof.step()
+        prof.step()
+        prof.stop()
+        info = prof.step_info()
+        assert "avg_step_time" in info and "ms" in info
+        assert prof._step == 2

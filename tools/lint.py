@@ -3,9 +3,9 @@
 ``python -m paddle_tpu.analysis``).
 
 Runs all passes — tracer-safety, host-sync budget, collective-order,
-failpoint-refs, guardian-log — over the repo, suppressing findings
-recorded in ``tools/lint_baseline.json``.  Exit 0 when no NEW findings,
-1 otherwise.
+failpoint-refs, guardian-log, metrics-registry — over the repo,
+suppressing findings recorded in ``tools/lint_baseline.json``.  Exit 0
+when no NEW findings, 1 otherwise.
 
 Usage:
     python tools/lint.py                 # human output vs baseline
