@@ -120,13 +120,17 @@ def chip_calibration():
         best = min(best, time.perf_counter() - t0)
     per = max(best - lat, 1e-6) / N_CHAIN
     frac = 2 * 4096 ** 3 / per / 197e12
-    # frac slightly above 1.0 = latency jitter between the tiny probe
-    # and the chain run (the subtraction overcorrected), not >peak
-    # compute; keep the raw number but flag it
+    # frac above 1.0 is physically impossible — it means the dispatch
+    # latency measured on the tiny probe overshot the latency actually
+    # paid by the chain run (jitter between the two measurements), and
+    # the subtraction overcorrected (BENCH_r05 reported 1.198).  Clamp
+    # the headline number so downstream health checks can treat it as a
+    # fraction, keep the raw value for trend analysis, and flag the
+    # jitter machine-readably instead of in a free-text note.
     out = {"dispatch_latency_ms": round(lat * 1e3, 1),
-           "matmul_peak_frac": round(frac, 4)}
-    if frac > 1.0:
-        out["note"] = "frac>1 = latency jitter in the subtraction"
+           "matmul_peak_frac": round(min(frac, 1.0), 4),
+           "matmul_peak_frac_raw": round(frac, 4),
+           "jitter_suspect": frac > 1.0}
     return out
 
 
@@ -608,19 +612,21 @@ def bench_eager_overhead(iters=5):
 
 # ---------------------------------------------------------------------------
 # GPT-3 1.3B hybrid (the BASELINE north-star config): dp x mp sharded via
-# GSPMD.  Runs whenever >1 chip is visible; on 1 chip it is reported as
-# skipped so the config stays expressible in the bench entry.
+# GSPMD.  Runs whenever >1 chip is visible; on 1 chip the same config is
+# re-exec'd as a subprocess onto an 8-virtual-device CPU mesh
+# (--xla_force_host_platform_device_count, the conftest trick) at proxy
+# scale — explicitly labeled cpu_proxy — instead of returning skipped.
 # ---------------------------------------------------------------------------
 
-def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
+def bench_gpt1p3b_hybrid(iters=5, peak=197e12, hidden=2048, layers=24,
+                         heads=16, seq=1024, vocab=50304, per_dp_batch=4):
     import jax
 
     from paddle_tpu.models import GPTConfig
 
     n = jax.device_count()
     if n < 2:
-        return {"skipped": f"needs >1 chip, have {n}; config ready "
-                           "(hidden=2048 L=24 heads=16, dp x mp mesh)"}
+        return _hybrid_cpu_proxy()
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -629,12 +635,12 @@ def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
     from paddle_tpu.framework.random import rng_scope
     from paddle_tpu.models import GPTForPretraining
 
-    cfg = GPTConfig(vocab_size=50304, hidden_size=2048,
-                    num_hidden_layers=24, num_attention_heads=16,
-                    max_position_embeddings=1024)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_hidden_layers=layers, num_attention_heads=heads,
+                    max_position_embeddings=seq)
     mp = 2 if n % 2 == 0 else 1
     dp = n // mp
-    B, S = dp * 4, 1024
+    B, S = dp * per_dp_batch, seq
     mesh = Mesh(np.asarray(jax.devices()[:dp * mp]).reshape(dp, mp),
                 ("data", "model"))
     paddle.seed(0)
@@ -644,7 +650,7 @@ def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
 
     def shard(p):
         spec = [None] * len(p.shape)
-        if len(p.shape) == 2 and int(np.prod(p.shape)) >= 2048 * 2048:
+        if len(p.shape) == 2 and int(np.prod(p.shape)) >= hidden * hidden:
             spec[-1] = "model"  # column-shard the big matmuls
         return NamedSharding(mesh, P(*spec))
     pvals = [jax.device_put(p._value, shard(p)) for p in params]
@@ -700,6 +706,140 @@ def bench_gpt1p3b_hybrid(iters=5, peak=197e12):
             "mfu": round(tps * fpt / (peak * dp * mp), 4),
             "loss": round(final, 4), "params": n_params,
             "dp": dp, "mp": mp, "batch": B, "seq": S}
+
+
+def _hybrid_cpu_proxy(timeout_s=900):
+    """One visible chip: re-exec this file onto a simulated 8-device CPU
+    mesh (``--xla_force_host_platform_device_count=8``) and measure the
+    hybrid config at proxy scale there.  The result is explicitly
+    labeled ``cpu_proxy`` — it proves the dp x mp wire pattern and the
+    grad_comm bucketed/quantized reducer end to end and gives honest
+    *relative* numbers (per-collective bytes, wire-format ratios), not
+    TPU throughput."""
+    import subprocess
+    import sys
+
+    if os.environ.get("BENCH_HYBRID_CHILD"):
+        # recursion guard: we ARE the re-exec'd child yet still see <2
+        # devices (e.g. the caller's XLA_FLAGS pins its own
+        # host_platform_device_count) — report, never fork again
+        return {"error": "cpu-proxy child still sees <2 devices; check "
+                         "XLA_FLAGS for a conflicting "
+                         "host_platform_device_count"}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_HYBRID_CHILD"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never claim the TPU tunnel
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    here = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--hybrid-cpu-proxy"],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=timeout_s)
+        if proc.returncode != 0:
+            return {"error": "cpu-proxy subprocess failed: "
+                             + (proc.stderr or "")[-300:]}
+        child = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": f"cpu-proxy subprocess: {repr(e)[:200]}"}
+    return {"cpu_proxy": True,
+            "note": "1 chip visible: measured on a simulated 8-device "
+                    "CPU mesh at proxy model scale — wire pattern and "
+                    "byte ratios are real, absolute tokens/sec is CPU",
+            **child}
+
+
+def _bench_grad_comm_wire_modes(iters=3, B=8, S=128):
+    """Pure-DP proxy GPT through the hapi grad_comm stepper, once per
+    wire format (fp32 psum / bf16 / int8 quantized), on the current
+    (8-virtual-device) mesh.  Per-collective bytes come from the
+    ``pt_collective_bytes_total`` counters — ticked per *tracing*, so
+    each mode's number is its per-replica wire bytes for one step.  The
+    registry is NOT reset between modes: each mode's ops have distinct
+    names, so one final telemetry snapshot carries the whole fp32-vs-
+    quantized comparison."""
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.fleet.base.distributed_strategy import \
+        DistributedStrategy
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining,
+                                   GPTPretrainingCriterion)
+
+    cfg = GPTConfig(vocab_size=4096, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=S)
+    obs.get_registry().reset()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype("i4")
+    out = {}
+    for mode in (None, "bf16", "int8"):
+        st = DistributedStrategy()
+        st.grad_comm = True
+        st.grad_comm_configs = {"bucket_mb": 0.25, "overlap": True,
+                                "quantize": mode}
+        paddle.seed(0)
+        net = GPTForPretraining(cfg)
+        net.eval()  # p=0 dropout: mask-free graph, math == train()
+        dp = paddle.DataParallel(net, strategy=st)
+        model = paddle.Model(dp)
+        model.prepare(paddle.optimizer.AdamW(
+            1e-4, parameters=net.parameters()), GPTPretrainingCriterion())
+        model.train_batch([ids], [ids])  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = model.train_batch([ids], [ids])
+        _readback_sync(res[0] if isinstance(res, (list, tuple)) else res)
+        dt = time.perf_counter() - t0
+        bytes_m = obs.get_registry().get("pt_collective_bytes_total")
+        per_op = {lbl["op"]: int(v) for lbl, v in bytes_m.series()
+                  if lbl["op"].startswith("grad_")} if bytes_m else {}
+        ops = {"bf16": ("grad_bucket_psum_bf16",),
+               "int8": ("grad_quant_all_to_all", "grad_quant_all_gather"),
+               }.get(mode, ("grad_bucket_psum",))
+        out[mode or "fp32"] = {
+            "tokens_per_sec": round(iters * B * S / dt, 1),
+            "wire_bytes_per_step": sum(per_op.get(o, 0) for o in ops),
+            "ops": {o: per_op.get(o, 0) for o in ops},
+        }
+    fp32_b = out["fp32"]["wire_bytes_per_step"]
+    for mode in ("bf16", "int8"):
+        if fp32_b:
+            out[mode]["wire_bytes_vs_fp32"] = round(
+                out[mode]["wire_bytes_per_step"] / fp32_b, 4)
+    return out
+
+
+def _hybrid_cpu_proxy_child():
+    """Child entry (``bench.py --hybrid-cpu-proxy``): runs on the forced
+    8-device CPU mesh, prints ONE JSON line for the parent."""
+    import jax
+
+    # the axon sitecustomize re-registers the TPU tunnel at interpreter
+    # start (clobbering JAX_PLATFORMS) — pin CPU again before backends
+    # initialize, exactly as tests/conftest.py does
+    jax.config.update("jax_platforms", "cpu")
+    out = {"devices": jax.device_count(),
+           "mesh": "xla_force_host_platform_device_count=8"}
+    hybrid = bench_gpt1p3b_hybrid(iters=3, peak=1e12, hidden=256,
+                                  layers=4, heads=8, seq=256, vocab=8192,
+                                  per_dp_batch=2)
+    hybrid["proxy_model"] = "hidden=256 L=4 heads=8 S=256 V=8192"
+    out["hybrid_gspmd"] = hybrid
+    try:
+        out["grad_comm"] = _bench_grad_comm_wire_modes()
+    except Exception as e:
+        out["grad_comm"] = {"error": repr(e)[:200]}
+    else:
+        # _telemetry_snapshot reports its own failure inline; never let
+        # a sink problem overwrite the computed wire-mode comparison
+        out["telemetry"] = _telemetry_snapshot("hybrid_proxy")
+    print(json.dumps(out))
 
 
 # ---------------------------------------------------------------------------
@@ -1190,6 +1330,14 @@ def main():
             except Exception as e:
                 configs["serving"] = {"error": repr(e)[:200]}
             telemetry["serving"] = _telemetry_snapshot("serving")
+        if which is not None and \
+                {"gpt1p3b", "gpt1p3b_hybrid"} & set(which):
+            # 1 visible device -> bench_gpt1p3b_hybrid re-execs itself
+            # onto the simulated 8-device mesh (cpu_proxy result)
+            try:
+                configs["gpt1p3b_hybrid"] = bench_gpt1p3b_hybrid(peak=peak)
+            except Exception as e:
+                configs["gpt1p3b_hybrid"] = {"error": repr(e)[:200]}
 
     if primary is not None:
         rate = primary["tokens_per_sec"]
@@ -1222,4 +1370,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--hybrid-cpu-proxy" in sys.argv[1:]:
+        _hybrid_cpu_proxy_child()
+    else:
+        main()

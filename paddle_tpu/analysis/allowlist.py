@@ -18,6 +18,10 @@ MONITORED_MODULES = (
     "paddle_tpu/hapi/model.py",
     "paddle_tpu/optimizer/optimizer.py",
     "paddle_tpu/inference/serving.py",
+    # the bucketed/quantized gradient reducer runs entirely inside the
+    # compiled step — ANY sync primitive appearing here is a bug, so it
+    # is monitored with zero allowlist entries
+    "paddle_tpu/distributed/grad_comm.py",
     # the telemetry layer records from every hot path, so the whole
     # package is monitored: metric recording must NEVER read the
     # device — the one legal sync is the exporter's funnel below
@@ -126,6 +130,11 @@ EXTRA_JIT_SURFACES = (
     # serving engine: bucket prefill + chunked decode (inference/serving.py)
     ("paddle_tpu/inference/serving.py", "_build_prefill.prefill"),
     ("paddle_tpu/inference/serving.py", "_build_decode_chunk.decode_chunk"),
+    # grad_comm: the traced bucketed-reduce closure the builder returns
+    # + the quantized-wire reduce built with static world/chunk/mode
+    ("paddle_tpu/distributed/grad_comm.py", "build_grad_reducer.reduce"),
+    ("paddle_tpu/distributed/grad_comm.py",
+     "_build_quant_reduce.quant_reduce"),
 )
 
 # Call terminals that return *static* (trace-time) values even when
@@ -133,6 +142,9 @@ EXTRA_JIT_SURFACES = (
 STATIC_FUNCS = frozenset({
     "issubdtype", "result_type", "promote_types", "can_cast", "finfo",
     "iinfo", "broadcast_shapes", "ndim", "isinstance", "hasattr",
+    # jnp.dtype(x) builds a dtype OBJECT (metadata) — its itemsize &co
+    # are trace-time constants even when x came off a traced array
+    "dtype",
 })
 # Attribute reads that are static under tracing (`.at` is deliberately
 # NOT here: `x.at[i].set(v)` carries x's taint)
@@ -146,6 +158,12 @@ COLLECTIVE_CALLEES = frozenset({
     "reduce", "gather", "ppermute", "batch_isend_irecv",
     "psum", "pmin", "pmax", "pmean", "all_to_all", "psum_scatter",
     "sync_global_devices", "broadcast_one_to_all",
+    # grad_comm reducer wrappers (distributed/grad_comm.py): each hides
+    # one or more lax collectives, so the bucketed-stepper surfaces stay
+    # walkable — a rank-conditional call to the wrapper is exactly as
+    # deadlock-prone as one to the raw collective it wraps
+    "quant_reduce", "_psum_reduce", "_bf16_reduce", "reduce_vec",
+    "reducer",
 })
 
 # Names whose value differs per rank: a branch on one of these around a

@@ -45,8 +45,13 @@ class PlacementPlan:
 
     def __init__(self, mesh, batch_axes=("data", "sharding"),
                  level=None, fsdp_axis="sharding", mp_axis="model",
-                 sep_axis="sep"):
+                 sep_axis="sep", grad_comm=None):
         self.mesh = mesh
+        # GradCommConfig for the explicit bucketed/quantized reducer
+        # (hapi stepper's shard_map path); None = GSPMD inserts the
+        # gradient all-reduce as before
+        self.grad_comm = grad_comm if grad_comm is not None and \
+            getattr(grad_comm, "enabled", False) else None
         self.batch_axes = tuple(a for a in batch_axes
                                 if a in mesh.axis_names and
                                 mesh.shape[a] > 1) or None
@@ -136,19 +141,36 @@ class PlacementPlan:
                 f"batch_axes={self.batch_axes}, level={self.level})")
 
 
-def make_data_parallel_plan(devices=None, level=None):
+def make_data_parallel_plan(devices=None, level=None, grad_comm=None):
     """All visible devices on one 'data' axis (optionally ZeRO 'sharding'
     semantics on the same axis — reference: pure-DP GroupSharded uses the
-    world group)."""
+    world group).  ``grad_comm.zero1`` is the strategy-flag spelling of
+    ``level="os"``: shard the weight update across the replicas
+    themselves (PAPERS.md "Automatic Cross-Replica Sharding of Weight
+    Update in Data-Parallel Training")."""
     devs = np.asarray(devices if devices is not None else jax.devices())
+    if grad_comm is not None and grad_comm.zero1 and level is None:
+        level = "os"
     if level in ("os", "os_g", "p_g_os"):
         mesh = Mesh(devs.reshape(1, -1), ("data", "sharding"))
     else:
         mesh = Mesh(devs, ("data",))
-    return PlacementPlan(mesh, level=level)
+    return PlacementPlan(mesh, level=level, grad_comm=grad_comm)
 
 
-def plan_from_hcg(hcg, level=None):
-    """Build the plan from a HybridCommunicateGroup (fleet.init output)."""
-    strategy_level = level
-    return PlacementPlan(hcg.jax_mesh, level=strategy_level)
+def plan_from_hcg(hcg, level=None, grad_comm=None):
+    """Build the plan from a HybridCommunicateGroup (fleet.init output).
+
+    With ``grad_comm.zero1`` on a topology whose dedicated sharding axis
+    is degenerate (sharding_degree == 1), the *data* axis becomes the
+    fsdp axis: the optimizer state shards across replicas and GSPMD
+    emits the reduce-scatter-into-update + all-gather wire pattern."""
+    fsdp_axis = "sharding"
+    if grad_comm is not None and grad_comm.zero1:
+        if level is None:
+            level = "os"
+        shape = dict(hcg.jax_mesh.shape)
+        if shape.get("sharding", 1) <= 1 and shape.get("data", 1) > 1:
+            fsdp_axis = "data"
+    return PlacementPlan(hcg.jax_mesh, level=level, fsdp_axis=fsdp_axis,
+                         grad_comm=grad_comm)

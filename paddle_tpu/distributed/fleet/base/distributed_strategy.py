@@ -58,6 +58,16 @@ class DistributedStrategy:
         self.asp = False
         self.qat = False
         self.qat_configs = {}
+        # communication-efficient gradient reduction (distributed/
+        # grad_comm.py): bucketed backward-overlapped all-reduce in the
+        # compiled DP step, opt-in quantized wire format, and ZeRO-1
+        # cross-replica sharding of the weight update as a flag.  Keys
+        # mirror GradCommConfig; bucket_mb=None defaults to
+        # fuse_grad_size_in_MB (the reference's fuse knob).
+        self.grad_comm = False
+        self.grad_comm_configs = {"bucket_mb": None, "overlap": True,
+                                  "quantize": None, "quant_chunk": 65536,
+                                  "zero1": False}
         # training guardian (framework/guardian.py): numeric sentinel +
         # skip-and-rollback ladder + collective watchdog.  Keys mirror
         # GuardianConfig's constructor; Model.fit picks this up via

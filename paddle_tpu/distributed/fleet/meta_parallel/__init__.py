@@ -17,8 +17,10 @@ from .pipeline_parallel import PipelineParallel  # noqa: F401
 def wrap_distributed_model(model, strategy, hcg):
     """Pick the wrapper by strategy (reference: fleet.distributed_model)."""
     from ...parallel import DataParallel
+    from ...grad_comm import GradCommConfig
     if hcg is None:
-        return DataParallel(model)
+        return DataParallel(model, strategy=strategy)
+    cc = GradCommConfig.from_strategy(strategy)
     level = None
     if strategy is not None and hcg.get_sharding_parallel_world_size() > 1:
         stage = (strategy.sharding_configs or {}).get("stage", 1)
@@ -27,10 +29,12 @@ def wrap_distributed_model(model, strategy, hcg):
         from .pipeline_parallel import PipelineParallel
         return PipelineParallel(model, hcg, strategy)
     if hcg.get_model_parallel_world_size() > 1:
-        return TensorParallel(model, hcg, strategy, level=level)
+        return TensorParallel(model, hcg, strategy, level=level,
+                              grad_comm=cc)
     wrapped = DataParallel(model)
     from ...engine import plan_from_hcg
-    wrapped._placement_plan = plan_from_hcg(hcg, level=level)
+    wrapped._placement_plan = plan_from_hcg(hcg, level=level,
+                                            grad_comm=cc)
     return wrapped
 
 
@@ -38,12 +42,14 @@ class TensorParallel(Layer):
     """Marker wrapper: TP layers already carry their sharding rules; this
     wrapper only pins the hcg so the engine builds the right mesh."""
 
-    def __init__(self, layers, hcg, strategy=None, level=None):
+    def __init__(self, layers, hcg, strategy=None, level=None,
+                 grad_comm=None):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
         from ...engine import plan_from_hcg
-        self._placement_plan = plan_from_hcg(hcg, level=level)
+        self._placement_plan = plan_from_hcg(hcg, level=level,
+                                             grad_comm=grad_comm)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

@@ -30,7 +30,11 @@ class DataParallel(Layer):
         self.is_data_parallel = True
         if jax.device_count() > 1:
             from .engine import make_data_parallel_plan
-            self._placement_plan = make_data_parallel_plan()
+            from .grad_comm import GradCommConfig
+            # strategy may carry grad_comm knobs (bucketed/quantized
+            # explicit reduce, or the zero1 plan flag); plain DP when not
+            self._placement_plan = make_data_parallel_plan(
+                grad_comm=GradCommConfig.from_strategy(strategy))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

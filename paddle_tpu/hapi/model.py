@@ -162,8 +162,141 @@ class _CompiledStepper:
             loss = total
         return loss._value
 
+    def _use_grad_comm(self):
+        """True when the step should use the explicit bucketed/quantized
+        gradient reducer (shard_map) instead of GSPMD's implicit
+        all-reduce: a grad_comm plan on a >1 'data' axis with fully
+        replicated parameters (pure DP).  TP/ZeRO placements keep the
+        GSPMD path — their reduction is part of the placement."""
+        plan = self.plan
+        cc = getattr(plan, "grad_comm", None) if plan is not None else None
+        if cc is None or not cc.enabled:
+            return False
+        if "data" not in plan.mesh.axis_names or \
+                plan.mesh.shape["data"] <= 1:
+            return False
+        if plan.level is not None or any(
+                any(a is not None for a in spec)
+                for spec in self._param_specs):
+            if not getattr(self, "_warned_grad_comm", False):
+                self._warned_grad_comm = True
+                import warnings
+                warnings.warn(
+                    "grad_comm: parameters are not replicated under this "
+                    "plan (TP/ZeRO placement) — the explicit bucketed "
+                    "reducer applies to pure data parallelism; falling "
+                    "back to the GSPMD path")
+            return False
+        return True
+
+    @jit_surface
+    def _build_train_comm(self, n_in, n_lab):
+        """Explicit-collective twin of ``_build_train`` for pure DP:
+        shard_map over the plan's mesh, with the grad tree reduced by
+        ``distributed.grad_comm`` buckets.  Each bucket's all-reduce
+        depends only on its members' gradients — produced early in
+        backward for the reverse-order buckets — so XLA's latency-hiding
+        scheduler can overlap the collectives with the remaining
+        backward compute (the T3 shape, by graph structure).  Quantized
+        wire formats ride the same buckets.
+
+        Output contract: every network output must carry the batch on
+        its leading axis (out_specs shards them on 'data') — nets with
+        scalar/non-batch auxiliary outputs need the GSPMD path."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from ..distributed.grad_comm import build_grad_reducer
+        opt = self.optimizer
+        t_idx = self.t_idx
+        amp = self.amp_level
+        guard = self.guard_numerics
+        pnames = [self.param_names[i] for i in t_idx]
+        plan = self.plan
+        mesh = plan.mesh
+        axis = "data"
+        world = int(mesh.shape[axis])
+        shapes = [tuple(self.params[i].shape) for i in t_idx]
+        dtypes = [self.params[i]._value.dtype for i in t_idx]
+        reducer, _ = build_grad_reducer(shapes, dtypes, plan.grad_comm,
+                                        axis, world)
+
+        def shard_step(train_vals, frozen_vals, buffer_vals, opt_state,
+                       lr, key, inputs, labels):
+            # decorrelate per-shard stochastic layers (dropout): same
+            # stream as single-device only for mask-free nets, which is
+            # what the parity contract covers
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+
+            def loss_f(tv):
+                tv_map = dict(zip(t_idx, tv))
+                fi = iter(frozen_vals)
+                pv = []
+                for i in range(len(self.params)):
+                    if i in tv_map:
+                        v = tv_map[i]
+                        if amp in ("O1", "O2") and \
+                                jnp.issubdtype(v.dtype, jnp.floating):
+                            v = v.astype(jnp.bfloat16)
+                        pv.append(v)
+                    else:
+                        pv.append(next(fi))
+                ins = inputs
+                if amp in ("O1", "O2"):
+                    ins = [v.astype(jnp.bfloat16)
+                           if jnp.issubdtype(v.dtype, jnp.floating) else v
+                           for v in inputs]
+                out_vals, new_buf = self._forward_pure(
+                    pv, buffer_vals, key, ins, training=True)
+                if amp in ("O1", "O2"):
+                    out_vals = [v.astype(jnp.float32)
+                                if jnp.issubdtype(v.dtype, jnp.bfloat16)
+                                else v for v in out_vals]
+                loss = self._loss_pure(out_vals, labels)
+                return loss, (out_vals, new_buf)
+
+            (loss, (out_vals, new_buf)), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(train_vals)
+            grads = reducer(list(grads))
+            # equal shard sizes: mean of local batch-means == global mean
+            loss = jax.lax.pmean(loss, axis)
+            # running statistics (BN & co) are computed from the local
+            # shard — average them so every replica carries the global
+            # update; integer buffers (step counters) advance in
+            # lockstep, pmax just re-asserts replication for the checker
+            new_buf = [jax.lax.pmean(b, axis)
+                       if jnp.issubdtype(b.dtype, jnp.inexact)
+                       else jax.lax.pmax(b, axis) for b in new_buf]
+            new_train, new_opt = apply_functional_with_clip(
+                opt, train_vals, grads, opt_state, lr, param_names=pnames)
+            if guard:
+                # reduced grads are replicated, so the verdict (and the
+                # skip) is identical on every replica — no extra pmin
+                ok = _guardian.tree_all_finite(list(grads) + [loss])
+                sel = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+                new_train = [sel(n, o) for n, o in zip(new_train,
+                                                       train_vals)]
+                new_opt = jax.tree_util.tree_map(sel, new_opt, opt_state)
+                new_buf = [sel(n, o) for n, o in zip(new_buf,
+                                                     buffer_vals)]
+                return loss, out_vals, new_train, new_buf, new_opt, ok
+            return loss, out_vals, new_train, new_buf, new_opt
+
+        rep = P()
+        dat = P(axis)
+        sharded = shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(rep, rep, rep, rep, rep, rep, dat, dat),
+            out_specs=(rep, dat, rep, rep, rep) +
+                      ((rep,) if guard else ()),
+            check_rep=False)
+        # batch-divisibility is validated host-side in train_step (the
+        # error must fire before this executable is compiled/cached)
+        return jax.jit(sharded, donate_argnums=(0, 2, 3))
+
     @jit_surface
     def _build_train(self, n_in, n_lab):
+        if self._use_grad_comm():
+            return self._build_train_comm(n_in, n_lab)
         opt = self.optimizer
         t_idx = self.t_idx
         amp = self.amp_level
@@ -299,6 +432,19 @@ class _CompiledStepper:
             self._label_shardings = [self.plan.input_sharding(a.ndim)
                                      for a in labels]
         key = (self._shape_key(inputs), self._shape_key(labels))
+        if self._use_grad_comm():
+            # host-side, BEFORE the executable is compiled/cached: the
+            # shard_map stepper splits the batch into equal per-replica
+            # shards (equal shards are also what make mean-of-shard-
+            # means the exact global mean — the parity contract)
+            world = int(self.plan.mesh.shape["data"])
+            for a in inputs + labels:
+                if a.ndim == 0 or a.shape[0] % world:
+                    raise ValueError(
+                        "grad_comm: global batch "
+                        f"{a.shape[0] if a.ndim else '<scalar>'} is not "
+                        f"divisible by the data-parallel world size "
+                        f"{world}; pad or resize the batch")
         train_vals = [self.params[i]._value for i in self.t_idx]
         frozen_vals = [p._value for i, p in enumerate(self.params)
                        if i not in set(self.t_idx)]

@@ -89,6 +89,15 @@ METRICS = {
         "help": "host-blocking collectives only (barrier/wait under "
                 "the watchdog); traced collectives have no host-"
                 "observable latency"},
+    "pt_collective_grad_buckets": {
+        "type": _G, "labels": (),
+        "help": "bucket count of the last grad_comm reducer build "
+                "(distributed/grad_comm.py bucketed all-reduce plan)"},
+    "pt_collective_overlap_fraction": {
+        "type": _G, "labels": (),
+        "help": "byte share of grad buckets whose all-reduce can hide "
+                "under remaining backward compute (structural, from "
+                "the bucket plan — everything but the final bucket)"},
     # -- TCPStore client (distributed/store.py) ---------------------------
     "pt_store_ops_total": {
         "type": _C, "labels": ("op",),

@@ -46,3 +46,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "obs: unified telemetry layer "
         "(paddle_tpu/observability/) test — select with -m obs")
+    config.addinivalue_line(
+        "markers", "multichip: multi-device mesh parity test (runs on "
+        "the forced-8-virtual-device CPU mesh above; exercises "
+        "grad_comm / hybrid DP wire patterns) — select with -m multichip")
