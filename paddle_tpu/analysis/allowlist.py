@@ -18,6 +18,10 @@ MONITORED_MODULES = (
     "paddle_tpu/hapi/model.py",
     "paddle_tpu/optimizer/optimizer.py",
     "paddle_tpu/inference/serving.py",
+    # paged-KV host-side manager: allocator/prefix bookkeeping between
+    # compiled dispatches — the admission-time prompt ingest is the one
+    # budgeted site; a device READBACK here is always a bug
+    "paddle_tpu/inference/kvcache.py",
     # the bucketed/quantized gradient reducer runs entirely inside the
     # compiled step — ANY sync primitive appearing here is a bug, so it
     # is monitored with zero allowlist entries
@@ -105,6 +109,17 @@ HOST_SYNC_ALLOWLIST = {
      "asarray"):
         {"max": 1, "reason": "H2D ingest of the request prompt (host "
                              "list/array -> int32), not a readback"},
+    ("paddle_tpu/inference/serving.py", "ServingEngine._resume_prompt",
+     "asarray"):
+        {"max": 1, "reason": "admission-time resume-prompt assembly "
+                             "(host token list -> int32), not a "
+                             "readback"},
+    # paged-KV manager (inference/kvcache.py): admission-time syncs only
+    ("paddle_tpu/inference/kvcache.py", "PagedKVManager.plan",
+     "asarray"):
+        {"max": 1, "reason": "admission-time prompt ingest for prefix "
+                             "keying/page planning (host array "
+                             "canonicalization), not a readback"},
     # observability: the exporter-side sync funnel.  Recording is host-
     # only by contract; a device scalar handed to a gauge materializes
     # exactly once, at export time, through this one budgeted site
@@ -130,6 +145,12 @@ EXTRA_JIT_SURFACES = (
     # serving engine: bucket prefill + chunked decode (inference/serving.py)
     ("paddle_tpu/inference/serving.py", "_build_prefill.prefill"),
     ("paddle_tpu/inference/serving.py", "_build_decode_chunk.decode_chunk"),
+    # paged-KV serving: suffix prefill + paged chunked decode
+    # (inference/kvcache.py; mirrors its register_jit_surface calls)
+    ("paddle_tpu/inference/kvcache.py",
+     "_build_paged_prefill.paged_prefill"),
+    ("paddle_tpu/inference/kvcache.py",
+     "_build_paged_decode_chunk.paged_decode_chunk"),
     # grad_comm: the traced bucketed-reduce closure the builder returns
     # + the quantized-wire reduce built with static world/chunk/mode
     ("paddle_tpu/distributed/grad_comm.py", "build_grad_reducer.reduce"),

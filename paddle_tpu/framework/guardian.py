@@ -112,6 +112,15 @@ EVENT_SCHEMA = {
     # serving engine: one run()'s aggregate throughput/latency counters
     "serving_stats": {"requests", "decoded_tokens", "chunks", "prefills",
                       "mean_ttft_ms", "tokens_per_sec", "queue_depth"},
+    # paged KV (inference/kvcache.py): admission matched a cached
+    # page-aligned prompt prefix — shared pages mapped, suffix-only
+    # prefill
+    "serving_prefix_hit": {"req_id", "slot", "cached_tokens",
+                           "pages_shared", "prompt_len"},
+    # paged KV: page pressure preempted an in-flight request back to
+    # the queue (it resumes by recompute at re-admission)
+    "serving_page_evict": {"req_id", "slot", "pages_freed",
+                           "resume_len", "queue_depth"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

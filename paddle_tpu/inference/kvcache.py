@@ -1,0 +1,579 @@
+"""Block-paged KV-cache subsystem for the serving engine (reference:
+``block_multihead_attention`` paged KV decode over fixed-size
+``cache_kvs`` blocks, plus the inference Predictor's block tables).
+
+The PR 4 engine preallocates a dense ``(S, MAX, nH, D)`` KV buffer per
+layer — HBM scales with the *worst-case* sequence length whether or not
+any slot ever reaches it, and two requests sharing a system prompt each
+re-prefill it from scratch.  This module replaces that with the paged
+formulation:
+
+- **Page pool** — per layer, one fixed ``(num_pages, page_size, nH, D)``
+  buffer for K and one for V (plus fp32 per-token scale planes in int8
+  mode).  Physical page 0 is the *trash page*: never allocated, the
+  scatter target for inactive slots and out-of-range pad writes, and the
+  gather source for unmapped page-table entries (its contents are always
+  model outputs, so reads stay finite and are masked out of attention
+  anyway).
+- **Host-side allocator** (:class:`PagedKVManager`) — a free-list over
+  pages 1..num_pages-1 with per-page refcounts; per-slot page tables map
+  logical pages (position // page_size) to physical pages and travel to
+  device as one small int32 array per dispatch.
+- **Paged gather/scatter inside the cached-attention path** — when
+  ``gpt._cached_attention`` receives a :class:`PagedCacheView` instead
+  of a dense ``(k_buf, v_buf)`` pair, it gathers the slot's pages into
+  the same ``(B, MAX, nH, D)`` working buffer the dense path uses, runs
+  the *identical* write/mask/attention math, and scatters the newly
+  written positions back to the pool.  Identical math over identical
+  values is what keeps paged greedy decode **bitwise-identical** to the
+  dense engine and to ``generate()`` (tests/test_kvcache.py asserts
+  the full chain).
+- **Prefix cache** — page-aligned prompt prefixes are content-keyed
+  (the raw token bytes, so there are no hash-collision correctness
+  holes) and their pages refcount-shared copy-on-write: shared pages
+  are only ever *read* (decode writes always land at positions past the
+  shared prefix, in slot-private pages), so the "copy" never actually
+  happens.  A hit skips recomputing the shared prefix: the suffix
+  prefill runs the model over ``prompt[k:]`` only, at position offset
+  ``k``, attending the cached pages through the same gather.  Causal
+  attention is position-wise, so chunked prefill is bitwise-identical
+  to cold prefill (same masked ``MAX``-wide reduction).
+- **int8 KV** (opt-in, the serving sibling of
+  ``quantization.weight_only_quantize``) — pool pages store int8 with
+  one fp32 absmax scale per token row (chunkwise absmax over that
+  token's ``nH x D`` values, the grad_comm/EQuARX scale discipline:
+  ``scale = max(absmax, 1e-30)/127``, round-to-nearest, clip to
+  ±127).  Element error is bounded by ``scale/2``; the end-to-end
+  logit tolerance is documented in docs/serving.md and pinned by
+  tests/test_kvcache.py.  Writes quantize, gathers dequantize; the
+  in-flight working buffer stays in the compute dtype, so the *prefill*
+  logits of a request are still bitwise-exact (quantization error only
+  enters when later steps re-read the pool).
+
+Engine integration (``ServingEngine(kv_mode="paged", ...)``): admission
+reserves pages instead of a dense slot row, decode carries the page
+tables as device state through the compiled ``lax.scan``, and page
+pressure preempts the youngest in-flight request back to the queue
+(its pages are freed; on re-admission it resumes by *recompute* — the
+prompt plus the already-streamed tokens re-prefill as one prompt, which
+is bitwise-equivalent to having never been evicted, so the parity
+contract survives preemption).  See docs/serving.md.
+"""
+import collections
+from typing import Any, NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..analysis import register_jit_surface
+from .. import observability as _obs
+
+__all__ = ["PagedCacheView", "PagedKVManager",
+           "quantize_kv", "dequantize_kv"]
+
+# the compiled bodies are nested defs a decorator can't reach —
+# registered for the tracer-safety pass (mirrored by EXTRA_JIT_SURFACES
+# in paddle_tpu/analysis/allowlist.py)
+for _qual in ("_build_paged_prefill.paged_prefill",
+              "_build_paged_decode_chunk.paged_decode_chunk"):
+    register_jit_surface(__name__, _qual)
+
+
+class PagedCacheView(NamedTuple):
+    """One layer's paged KV cache as it travels through the model's
+    cached-attention path: the page pools, optional int8 scale planes
+    (``None`` in full-precision mode), and the per-slot page table
+    ``(B, MAX // page_size)``.  A NamedTuple so jax treats it as a
+    pytree and the tracer-safety pass can tell it from the dense
+    ``(k_buf, v_buf)`` pair via ``hasattr(cache, "_fields")`` (a
+    static, taint-stopping check)."""
+    k_pages: Any
+    v_pages: Any
+    k_scales: Any
+    v_scales: Any
+    table: Any
+
+
+# -- pure-jnp kernels (called inside the compiled prefill/decode) ----------
+
+def quantize_kv(x):
+    """Per-token chunkwise absmax int8 quantization: ``x`` is
+    ``(..., nH, D)``; the scale group is one token's ``nH x D`` block
+    (the grad_comm/EQuARX discipline).  Returns ``(q int8, scale f32)``
+    with ``scale`` shaped like ``x`` minus the last two axes."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    return (q.astype(jnp.float32)
+            * scale[..., None, None]).astype(dtype)
+
+
+def _positions(p, S):
+    """Absolute write positions for this step as a (B, S) grid (scalar
+    ``pos`` broadcasts across the batch; vector ``pos`` is per-slot)."""
+    p = p.astype(jnp.int32)
+    if p.ndim:
+        return p[:, None] + jnp.arange(S)
+    return jnp.broadcast_to(p + jnp.arange(S), (1, S))
+
+
+def gather_pages(kp, vp, table):
+    """Materialize the dense ``(B, MAX, nH, D)`` working buffers from
+    the pool: ``table`` is ``(B, n_pages)``; ``MAX = n_pages *
+    page_size``.  Unmapped entries point at the trash page — their
+    values are finite model outputs and the attention mask zeroes their
+    weight, so they contribute exactly 0 (same as the dense path's
+    never-written zeros)."""
+    B = table.shape[0]
+    k = kp[table].reshape(B, -1, kp.shape[2], kp.shape[3])
+    v = vp[table].reshape(B, -1, vp.shape[2], vp.shape[3])
+    return k, v
+
+
+def gather_pages_q(kp, vp, ks, vs, table, dtype):
+    """int8 variant: dequantize with the per-token scale planes."""
+    B = table.shape[0]
+    k = dequantize_kv(kp[table], ks[table], dtype)
+    v = dequantize_kv(vp[table], vs[table], dtype)
+    return (k.reshape(B, -1, kp.shape[2], kp.shape[3]),
+            v.reshape(B, -1, vp.shape[2], vp.shape[3]))
+
+
+def _scatter_coords(table, pos, S, page_size):
+    idx = _positions(pos, S)                        # (B, S) absolute
+    B = table.shape[0]
+    rows = jnp.arange(B)[:, None]
+    phys = table[rows, idx // page_size]            # (B, S) physical
+    return phys, idx % page_size
+
+
+def scatter_pages(kp, vp, k_new, v_new, table, pos):
+    """Persist this step's freshly written K/V rows into the pool:
+    positions ``pos..pos+S-1`` of each batch row land at
+    ``(table[b, p // page_size], p % page_size)``.  Writes through an
+    unmapped (trash) entry are discarded garbage by construction —
+    inactive slots and pad positions beyond the allocated range."""
+    S = k_new.shape[1]
+    phys, off = _scatter_coords(table, pos, S, kp.shape[1])
+    kp = kp.at[phys, off].set(k_new.astype(kp.dtype))
+    vp = vp.at[phys, off].set(v_new.astype(vp.dtype))
+    return kp, vp
+
+
+def scatter_pages_q(kp, vp, ks, vs, k_new, v_new, table, pos):
+    """int8 variant: quantize each token row and store value + scale."""
+    S = k_new.shape[1]
+    phys, off = _scatter_coords(table, pos, S, kp.shape[1])
+    qk, sk = quantize_kv(k_new)
+    qv, sv = quantize_kv(v_new)
+    kp = kp.at[phys, off].set(qk)
+    vp = vp.at[phys, off].set(qv)
+    ks = ks.at[phys, off].set(sk)
+    vs = vs.at[phys, off].set(sv)
+    return kp, vp, ks, vs
+
+
+def _layer_views(pools, table, quant):
+    if quant:
+        return [PagedCacheView(kp, vp, ks, vs, table)
+                for kp, vp, ks, vs in pools]
+    return [PagedCacheView(kp, vp, None, None, table) for kp, vp in pools]
+
+
+def _layer_pools(views, quant):
+    if quant:
+        return [(c.k_pages, c.v_pages, c.k_scales, c.v_scales)
+                for c in views]
+    return [(c.k_pages, c.v_pages) for c in views]
+
+
+# -- compiled bodies -------------------------------------------------------
+
+def _build_paged_prefill(apply, pick, eos, quant):
+    """Compiled paged prefill for one suffix-length bucket: run the
+    model over the right-padded ``(1, bucket)`` suffix at position
+    offset ``start`` (0 cold; the cached-prefix length on a prefix-cache
+    hit), attending any shared prefix pages through the paged gather,
+    pick the first generated token at the last *real* suffix position,
+    and arm the slot's decode state.  KV lands in the slot's pages via
+    the in-attention scatter — nothing here touches a dense slot row."""
+    def paged_prefill(pv, ids, start, length, slot, budget,
+                      tokens, pos, active, remaining, pools, table):
+        row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
+        caches = _layer_views(pools, row, quant)
+        logits, new = apply(pv, ids, caches, start)
+        pools = _layer_pools(new, quant)
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, length - 1, 1, axis=1)[:, 0]            # (1, V)
+        t0, _ = pick(last, jax.random.key(0))               # (1,)
+        t0 = t0[0]
+        hit_eos = (t0 == eos) if eos is not None else jnp.asarray(False)
+        fin0 = hit_eos | (budget <= 1)
+        tokens = tokens.at[slot].set(t0)
+        pos = pos.at[slot].set(start + length)
+        active = active.at[slot].set(~fin0)
+        remaining = remaining.at[slot].set(budget - 1)
+        return t0, fin0, tokens, pos, active, remaining, pools
+    return paged_prefill
+
+
+def _build_paged_decode_chunk(apply, pick, chunk, eos, pad, quant):
+    """Compiled paged decode over ``chunk`` tokens for all S slots: the
+    dense engine's masked-finish scan body verbatim, except each step's
+    KV travels through the page pool (gather -> identical attention ->
+    scatter).  Inactive slots have their page-table row redirected to
+    the trash page so a freed-and-reassigned page can never be
+    corrupted by a stale slot's ride-along writes."""
+    def paged_decode_chunk(pv, tokens, pos, active, remaining, pools,
+                           table):
+        def body(carry, _):
+            tokens, pos, active, remaining, pools = carry
+            safe = jnp.where(active[:, None], table, 0)
+            caches = _layer_views(pools, safe, quant)
+            logits, new = apply(pv, tokens[:, None], caches, pos)
+            pools = _layer_pools(new, quant)
+            nxt, _ = pick(logits[:, 0, :], jax.random.key(0))
+            nxt = jnp.where(active, nxt, jnp.int32(pad))
+            emitted = active
+            live = active.astype(jnp.int32)
+            pos = pos + live
+            remaining = remaining - live
+            hit_eos = (nxt == eos) if eos is not None \
+                else jnp.zeros_like(active)
+            done = active & (hit_eos | (remaining <= 0))
+            tokens = jnp.where(active, nxt, tokens)
+            active = active & ~done
+            return (tokens, pos, active, remaining, pools), (nxt, emitted)
+        carry = (tokens, pos, active, remaining, pools)
+        (tokens, pos, active, remaining, pools), (toks, valid) = \
+            jax.lax.scan(body, carry, None, length=chunk)
+        return tokens, pos, active, remaining, pools, toks, valid
+    return paged_decode_chunk
+
+
+# -- host-side page management ---------------------------------------------
+
+class PagedKVManager:
+    """Host-side page allocator + prefix cache + device pool owner.
+
+    All methods run on host between compiled dispatches; none reads the
+    device (the module sits in ``analysis.allowlist.MONITORED_MODULES``
+    so any sync primitive appearing here must be budgeted).  The
+    ``admission-time`` np ingest below is the one budgeted site.
+
+    Page lifecycle: every physical page (1..num_pages-1) is either on
+    the free list (refcount 0) or referenced by slot page-table
+    mappings and/or prefix-cache entries (refcount = number of such
+    holders).  ``check()`` asserts the invariant and is exercised by
+    tests/test_kvcache.py.
+    """
+
+    def __init__(self, spec, num_slots, max_seq_len, page_size,
+                 num_pages, cache_dtype, kv_dtype=None,
+                 prefix_cache=True, max_prefix_entries=1024):
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq_len "
+                f"{max_seq_len} (the paged gather must reproduce the "
+                "dense MAX-wide attention for bitwise parity)")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                             "(int8 or None)")
+        self.spec = list(spec)
+        self.num_slots = int(num_slots)
+        self.MAX = int(max_seq_len)
+        self.page_size = int(page_size)
+        self.pages_per_slot = self.MAX // self.page_size
+        if num_pages is None:
+            # roomy default: every slot can reach MAX (no pressure) —
+            # the memory win then comes from sizing num_pages DOWN
+            num_pages = self.num_slots * self.pages_per_slot + 1
+        self.num_pages = int(num_pages)
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved trash page)")
+        self.cache_dtype = cache_dtype
+        self.quant = kv_dtype == "int8"
+        self.prefix_enabled = bool(prefix_cache)
+        self.max_prefix_entries = int(max_prefix_entries)
+        # per-token bytes across all layers (K+V [+ scales]) for the
+        # resident-bytes gauge
+        elt = jnp.dtype("int8" if self.quant else cache_dtype).itemsize
+        per_tok = sum(2 * nh * d * elt for nh, d in self.spec)
+        if self.quant:
+            per_tok += 2 * 4 * len(self.spec)        # fp32 scale per row
+        self.page_bytes = per_tok * self.page_size
+        self.stats = None
+        self.reset()
+
+    # -- device state ------------------------------------------------------
+    def reset(self):
+        """(Re)build zeroed pools and empty allocator/prefix state; the
+        engine's compiled programs are keyed on shapes, so a reset never
+        retraces."""
+        N, P = self.num_pages, self.page_size
+        if self.quant:
+            self._pools = [
+                (jnp.zeros((N, P, nh, d), jnp.int8),
+                 jnp.zeros((N, P, nh, d), jnp.int8),
+                 jnp.zeros((N, P), jnp.float32),
+                 jnp.zeros((N, P), jnp.float32))
+                for nh, d in self.spec]
+        else:
+            self._pools = [
+                (jnp.zeros((N, P, nh, d), self.cache_dtype),
+                 jnp.zeros((N, P, nh, d), self.cache_dtype))
+                for nh, d in self.spec]
+        self.table = np.zeros((self.num_slots, self.pages_per_slot),
+                              np.int32)
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._ref = np.zeros(self.num_pages, np.int64)
+        self._slot_pages = [dict() for _ in range(self.num_slots)]
+        self._prefix = collections.OrderedDict()    # key bytes -> pages
+        self.stats = {"prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_saved_tokens": 0, "pages_evicted": 0,
+                      "resident_high_water_bytes": 0}
+        self._gauges()
+
+    def device_pools(self):
+        return self._pools
+
+    def set_pools(self, pools):
+        self._pools = pools
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def pages_in_use(self):
+        return self.num_pages - 1 - len(self._free)
+
+    @property
+    def resident_bytes(self):
+        return self.pages_in_use * self.page_bytes
+
+    @property
+    def pool_bytes(self):
+        """Allocated pool footprint (all pages, resident or not)."""
+        return self.num_pages * self.page_bytes
+
+    def _gauges(self):
+        rb = self.resident_bytes
+        if rb > self.stats["resident_high_water_bytes"]:
+            self.stats["resident_high_water_bytes"] = rb
+        if _obs.enabled():
+            _obs.set_gauge("pt_kvcache_pages_in_use", self.pages_in_use)
+            _obs.set_gauge("pt_kvcache_resident_kv_bytes", rb)
+
+    # -- allocator core ----------------------------------------------------
+    def _incref(self, page):
+        self._ref[page] += 1
+
+    def _decref(self, page):
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+    def _reclaim_one(self):
+        """Drop the least-recently-used prefix-cache entry; its pages
+        free as soon as no slot still maps them."""
+        if not self._prefix:
+            return False
+        _, pages = self._prefix.popitem(last=False)
+        for p in pages:
+            self._decref(p)
+        return True
+
+    def _alloc(self, count):
+        """Allocate ``count`` pages (refcount 1 each), reclaiming LRU
+        prefix-cache entries under pressure; all-or-nothing — and
+        nothing is reclaimed when reclaiming everything still could not
+        satisfy the request (an oversized, FCFS-blocked admission must
+        not wipe the prefix cache as a side effect of failing)."""
+        if len(self._free) < count:
+            prefix_refs = collections.Counter(
+                p for pages in self._prefix.values() for p in pages)
+            reclaimable = sum(1 for p, c in prefix_refs.items()
+                              if self._ref[p] == c)
+            if len(self._free) + reclaimable < count:
+                return None
+        while len(self._free) < count:
+            if not self._reclaim_one():
+                return None
+        pages = [self._free.pop() for _ in range(count)]
+        for p in pages:
+            self._incref(p)
+        return pages
+
+    # -- admission ---------------------------------------------------------
+    def coverage_page(self, pos, budget, chunk):
+        """Highest logical page a chunk of up to ``chunk`` tokens can
+        write for a sequence whose next write lands at ``pos`` with
+        ``budget`` tokens left — THE page-coverage arithmetic, shared
+        by admission planning and the engine's between-chunk top-up so
+        the two can never disagree."""
+        hi = min(int(pos) + min(int(chunk), int(budget)), self.MAX) - 1
+        return hi // self.page_size
+
+    def plan(self, prompt, budget, chunk, fit=None):
+        """Reserve pages for one admission WITHOUT binding a slot:
+        longest page-aligned cached prefix (that ``fit`` accepts and
+        leaves >= 1 suffix token), plus freshly allocated private pages
+        covering the suffix and the first decode chunk.  Returns a plan
+        dict, or None when the pool cannot serve it (the scheduler then
+        keeps the request queued — FCFS head-of-line, no skip-ahead).
+
+        The plan already holds page references; ``bind`` or ``abandon``
+        must follow.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n, P = int(prompt.size), self.page_size
+        k_pages, shared = 0, []
+        if self.prefix_enabled:
+            for j in range((n - 1) // P, 0, -1):
+                ent = self._prefix.get(prompt[: j * P].tobytes())
+                if ent is None:
+                    continue
+                if fit is not None and not fit(j * P):
+                    continue
+                k_pages, shared = j, list(ent)
+                self._prefix.move_to_end(prompt[: j * P].tobytes())
+                break
+        # hold the hit pages BEFORE allocating: _alloc's LRU reclaim may
+        # drop the hit entry itself, and without the plan's references
+        # its pages would land on the free list and come back as
+        # "fresh" — one physical page mapped at two logical positions
+        for p in shared:
+            self._incref(p)
+        hi = self.coverage_page(n, budget, chunk)
+        fresh = self._alloc(max(0, hi - k_pages + 1))
+        if fresh is None:
+            for p in shared:
+                self._decref(p)
+            return None
+        return {"prompt": prompt, "k": k_pages * P,
+                "pages": shared + fresh}
+
+    def abandon(self, plan):
+        """Release a plan that never got bound (admission raced away)."""
+        for p in plan["pages"]:
+            self._decref(p)
+        self._gauges()
+
+    def bind(self, slot, plan, register_limit=None):
+        """Map a plan's pages into ``slot``'s page table and register
+        this prompt's page-aligned prefixes (up to ``register_limit``
+        tokens — the original prompt length on resume, so generated
+        tokens never pollute the cache) for future sharing."""
+        prompt, k = plan["prompt"], plan["k"]
+        n, P = int(prompt.size), self.page_size
+        row = self.table[slot]
+        row[:] = 0
+        mapping = self._slot_pages[slot]
+        assert not mapping, f"slot {slot} bound while still mapped"
+        for j, page in enumerate(plan["pages"]):
+            row[j] = page
+            mapping[j] = page
+        if self.prefix_enabled:
+            limit = n if register_limit is None else min(int(register_limit), n)
+            for j in range(1, limit // P + 1):
+                key = prompt[: j * P].tobytes()
+                if key in self._prefix:
+                    continue
+                pages = tuple(int(row[i]) for i in range(j))
+                for p in pages:
+                    self._incref(p)
+                self._prefix[key] = pages
+            while len(self._prefix) > self.max_prefix_entries:
+                self._reclaim_one()
+        self.stats["prefix_hits" if k else "prefix_misses"] += 1
+        self.stats["prefix_saved_tokens"] += k
+        if _obs.enabled():
+            _obs.inc("pt_kvcache_prefix_hits_total" if k
+                     else "pt_kvcache_prefix_misses_total")
+            if k:
+                _obs.inc("pt_kvcache_prefix_saved_tokens_total", k)
+        self._gauges()
+        return k
+
+    # -- steady state ------------------------------------------------------
+    def ensure(self, slot, through_page):
+        """Grow ``slot``'s mapping to cover logical pages
+        ``<= through_page``; False when the pool is exhausted (the
+        engine then evicts and retries)."""
+        mapping = self._slot_pages[slot]
+        through = min(int(through_page), self.pages_per_slot - 1)
+        missing = [j for j in range(through + 1) if j not in mapping]
+        if not missing:
+            return True
+        fresh = self._alloc(len(missing))
+        if fresh is None:
+            return False
+        row = self.table[slot]
+        for j, page in zip(missing, fresh):
+            row[j] = page
+            mapping[j] = page
+        self._gauges()
+        return True
+
+    def clear_prefix(self):
+        """Drop every prefix-cache entry (their pages free once no slot
+        still maps them).  Called on ``refresh_weights``: cached-prefix
+        KV was computed with the OLD parameters, and serving it after a
+        weight swap would silently break the parity contract."""
+        while self._reclaim_one():
+            pass
+        self._gauges()
+
+    def release(self, slot, evicted=False):
+        """Unmap a finished (or preempted) slot: private pages return to
+        the free list; prefix-shared pages survive under their cache
+        references.  Returns the number of pages this slot dropped."""
+        mapping = self._slot_pages[slot]
+        count = len(mapping)
+        for page in mapping.values():
+            self._decref(page)
+        mapping.clear()
+        self.table[slot][:] = 0
+        if evicted:
+            self.stats["pages_evicted"] += count
+            if _obs.enabled():
+                _obs.inc("pt_kvcache_page_evictions_total", count)
+        self._gauges()
+        return count
+
+    # -- invariants (test hook) --------------------------------------------
+    def check(self):
+        """Assert the allocator invariants; returns True for test
+        convenience."""
+        refs = np.zeros(self.num_pages, np.int64)
+        for mapping in self._slot_pages:
+            for page in mapping.values():
+                refs[page] += 1
+        for pages in self._prefix.values():
+            for page in pages:
+                refs[page] += 1
+        assert np.array_equal(refs, self._ref), \
+            f"refcount drift: counted {refs} vs tracked {self._ref}"
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate pages on free list"
+        assert 0 not in free, "trash page leaked onto the free list"
+        for page in range(1, self.num_pages):
+            held = self._ref[page] > 0
+            assert held != (page in free), \
+                f"page {page} is {'held' if held else 'unheld'} but " \
+                f"{'on' if page in free else 'off'} the free list"
+        for slot, mapping in enumerate(self._slot_pages):
+            row = self.table[slot]
+            for j in range(self.pages_per_slot):
+                want = mapping.get(j, 0)
+                assert row[j] == want, \
+                    f"table[{slot},{j}]={row[j]} != mapping {want}"
+        return True

@@ -25,7 +25,8 @@ class Request:
 
     __slots__ = ("req_id", "prompt", "max_new_tokens", "callback",
                  "tokens", "submit_ns", "admit_ns", "first_token_ns",
-                 "finish_ns", "finish_reason", "slot")
+                 "finish_ns", "finish_reason", "slot", "evictions",
+                 "resume_len", "emitted_since_admit")
 
     def __init__(self, req_id, prompt, max_new_tokens, callback=None):
         self.req_id = req_id
@@ -39,6 +40,13 @@ class Request:
         self.finish_ns = None
         self.finish_reason = None
         self.slot = None
+        # paged-KV lifecycle (see inference/kvcache.py): preemption
+        # count, the resume-prompt length of the latest admission
+        # (prompt + already-generated tokens), and tokens emitted since
+        # that admission (drives page-table top-up between chunks)
+        self.evictions = 0
+        self.resume_len = None
+        self.emitted_since_admit = 0
 
     @property
     def done(self):
@@ -103,15 +111,25 @@ class FCFSScheduler:
         return bool(self._queue or self._running)
 
     # -- slots -------------------------------------------------------------
-    def admissions(self):
+    def admissions(self, can_admit=None):
         """Pop (request, slot) pairs for this inter-chunk gap: FCFS order,
-        bounded by free slots and the interleave knob."""
+        bounded by free slots and the interleave knob.
+        ``can_admit(req, slot)`` (the paged engine's page-reservation
+        gate; ``slot`` is the slot the request WILL get) is consulted
+        before each pop so the gate can reserve/bind atomically — a
+        False answer STOPS admission: FCFS head-of-line blocking is
+        deliberate, a shorter request never skips ahead of a starved
+        one."""
         out = []
         budget = self.max_prefills_per_gap
         while self._queue and self._free and \
                 (budget is None or len(out) < budget):
-            req = self._queue.popleft()
-            slot = self._free.pop()
+            req = self._queue[0]
+            slot = self._free[-1]
+            if can_admit is not None and not can_admit(req, slot):
+                break
+            self._queue.popleft()
+            self._free.pop()
             req.slot = slot
             req.admit_ns = time.perf_counter_ns()
             self._running[slot] = req
@@ -122,4 +140,15 @@ class FCFSScheduler:
         """Return a finished slot to the free list."""
         req = self._running.pop(slot)
         self._free.append(slot)
+        return req
+
+    def requeue(self, slot):
+        """Preempt an in-flight request back to the FRONT of the queue
+        (page-pressure eviction): the slot frees, the request keeps its
+        streamed tokens and resumes by recompute at re-admission."""
+        req = self._running.pop(slot)
+        self._free.append(slot)
+        req.slot = None
+        req.evictions += 1
+        self._queue.appendleft(req)
         return req

@@ -136,7 +136,16 @@ class ServingEngine:
     - ``max_prefills_per_gap``: the prefill-vs-decode interleave knob
       (see :class:`FCFSScheduler`);
     - ``dtype``: e.g. ``"bfloat16"`` casts weights + KV once
-      (``cast_weights``) like ``generate(dtype=...)``.
+      (``cast_weights``) like ``generate(dtype=...)``;
+    - ``kv_mode="paged"`` swaps the dense per-slot KV rows for the
+      block-paged subsystem (``inference/kvcache.py``): a fixed page
+      pool sized by ``num_pages`` x ``page_size``, per-slot page tables,
+      a prompt-prefix cache (``prefix_cache``) so shared system prompts
+      prefill once, opt-in ``kv_dtype="int8"`` quantized KV, and
+      page-pressure preemption back to the queue.  Greedy output stays
+      bitwise-identical to the dense engine and ``generate()`` (int8
+      aside); resident KV HBM scales with live tokens instead of
+      S x MAX.  See docs/serving.md.
 
     The engine snapshots parameter values at construction; rebuild it
     (or call :meth:`refresh_weights`) after a training step.  Greedy
@@ -145,9 +154,18 @@ class ServingEngine:
 
     def __init__(self, model, num_slots=8, chunk=32, max_seq_len=None,
                  prefill_buckets=None, dtype=None, eos_token_id=None,
-                 pad_token_id=0, max_prefills_per_gap=None):
+                 pad_token_id=0, max_prefills_per_gap=None,
+                 kv_mode="dense", page_size=16, num_pages=None,
+                 kv_dtype=None, prefix_cache=True):
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
+        if kv_mode not in ("dense", "paged"):
+            raise ValueError(f"kv_mode {kv_mode!r} not in "
+                             "('dense', 'paged')")
+        if kv_mode == "dense" and (kv_dtype is not None
+                                   or num_pages is not None):
+            raise ValueError("kv_dtype/num_pages require kv_mode='paged'")
+        self._paged = kv_mode == "paged"
         self.model = model
         cfg = getattr(model, "config", None) \
             or getattr(getattr(model, "model", None), "config", None)
@@ -184,16 +202,35 @@ class ServingEngine:
                                        self.cache_dtype)
         apply = build_apply(model, self._params)
         pick = build_pick(True, 1.0, 0, 1.0)       # greedy, fp32 picks
-        self._prefill_jit = {
-            b: jax.jit(_build_prefill(apply, pick, self._spec,
-                                      self.cache_dtype, self.MAX,
-                                      self.eos),
-                       donate_argnums=(5, 6, 7, 8, 9))
-            for b in self.buckets}
-        self._decode_jit = jax.jit(
-            _build_decode_chunk(apply, pick, self.chunk, self.eos,
-                                self.pad),
-            donate_argnums=(1, 2, 3, 4, 5))
+        if self._paged:
+            from .kvcache import (PagedKVManager, _build_paged_prefill,
+                                  _build_paged_decode_chunk)
+            self._kv = PagedKVManager(
+                self._spec, self.num_slots, self.MAX, page_size,
+                num_pages, self.cache_dtype, kv_dtype=kv_dtype,
+                prefix_cache=prefix_cache)
+            quant = self._kv.quant
+            self._prefill_jit = {
+                b: jax.jit(_build_paged_prefill(apply, pick, self.eos,
+                                                quant),
+                           donate_argnums=(6, 7, 8, 9, 10))
+                for b in self.buckets}
+            self._decode_jit = jax.jit(
+                _build_paged_decode_chunk(apply, pick, self.chunk,
+                                          self.eos, self.pad, quant),
+                donate_argnums=(1, 2, 3, 4, 5))
+        else:
+            self._kv = None
+            self._prefill_jit = {
+                b: jax.jit(_build_prefill(apply, pick, self._spec,
+                                          self.cache_dtype, self.MAX,
+                                          self.eos),
+                           donate_argnums=(5, 6, 7, 8, 9))
+                for b in self.buckets}
+            self._decode_jit = jax.jit(
+                _build_decode_chunk(apply, pick, self.chunk, self.eos,
+                                    self.pad),
+                donate_argnums=(1, 2, 3, 4, 5))
         self.scheduler = FCFSScheduler(self.num_slots,
                                        max_prefills_per_gap)
         # MoE gates record aux loss as a side-effect attribute during
@@ -211,12 +248,18 @@ class ServingEngine:
         self._pos = jnp.zeros((S,), jnp.int32)
         self._active = jnp.zeros((S,), bool)
         self._remaining = jnp.zeros((S,), jnp.int32)
-        self._caches = [(jnp.zeros((S, self.MAX, nh, d), self.cache_dtype),
-                         jnp.zeros((S, self.MAX, nh, d), self.cache_dtype))
-                        for nh, d in self._spec]
+        if self._paged:
+            self._kv.reset()
+            self._pools = self._kv.device_pools()
+            self._caches = None
+        else:
+            self._caches = [
+                (jnp.zeros((S, self.MAX, nh, d), self.cache_dtype),
+                 jnp.zeros((S, self.MAX, nh, d), self.cache_dtype))
+                for nh, d in self._spec]
         self.stats = {"requests": 0, "finished": 0, "decoded_tokens": 0,
                       "chunks": 0, "prefills": 0, "ttft_ms": [],
-                      "max_concurrent": 0}
+                      "max_concurrent": 0, "page_evictions": 0}
 
     def reset(self):
         """Drop all queued/in-flight work and zero the device state (the
@@ -237,6 +280,11 @@ class ServingEngine:
         if self._cast_override:
             pvals = cast_weights(self.model, pvals, self.cache_dtype)
         self._pvals = pvals
+        if self._paged:
+            # cached-prefix KV belongs to the old weights; in-flight
+            # slots are the user's race (same as dense), but serving a
+            # stale prefix to a FUTURE admission never is
+            self._kv.clear_prefix()
 
     # -- API ---------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, callback=None):
@@ -257,6 +305,19 @@ class ServingEngine:
                 f"prompt_len + max_new_tokens = "
                 f"{prompt.size + int(max_new_tokens)} exceeds "
                 f"max_seq_len = {self.MAX}")
+        if self._paged:
+            # reject a request the pool can never finish EVEN RUNNING
+            # ALONE up front — discovering it mid-decode (after page
+            # pressure has already evicted everything else) would throw
+            # away every in-flight request's streamed tokens
+            P = self._kv.page_size
+            full = -(-(int(prompt.size) + int(max_new_tokens)) // P)
+            if full > self._kv.num_pages - 1:
+                raise ValueError(
+                    f"request needs {full} KV pages at full decode but "
+                    f"the pool has {self._kv.num_pages - 1} allocatable "
+                    f"pages — raise num_pages (or page_size) or lower "
+                    "max_new_tokens")
         self.stats["requests"] += 1
         return self.scheduler.submit(prompt, max_new_tokens, callback)
 
@@ -268,14 +329,36 @@ class ServingEngine:
         toks = valid = None
         saved_losses = [g.loss for g in self._gates]
         try:
+            if self._paged:
+                self._page_pressure()
             pending = self._admit()
+            if self._paged and self.scheduler.queue_depth and \
+                    not pending and not self.scheduler.active:
+                head = self.scheduler._queue[0]
+                raise RuntimeError(
+                    f"kv page pool too small: request {head.req_id} "
+                    f"(resume length {self._resume_prompt(head).size}, "
+                    f"budget {head.max_new_tokens - len(head.tokens)}) "
+                    f"cannot be admitted even with all "
+                    f"{self._kv.num_pages - 1} pages free — raise "
+                    "num_pages or lower max_new_tokens")
             if self.scheduler.active:
                 with RecordEvent("serving.decode_chunk"):
-                    (self._tokens, self._pos, self._active,
-                     self._remaining, self._caches, toks, valid) = \
-                        self._decode_jit(
-                            self._pvals, self._tokens, self._pos,
-                            self._active, self._remaining, self._caches)
+                    if self._paged:
+                        (self._tokens, self._pos, self._active,
+                         self._remaining, self._pools, toks, valid) = \
+                            self._decode_jit(
+                                self._pvals, self._tokens, self._pos,
+                                self._active, self._remaining,
+                                self._pools, jnp.asarray(self._kv.table))
+                        self._kv.set_pools(self._pools)
+                    else:
+                        (self._tokens, self._pos, self._active,
+                         self._remaining, self._caches, toks, valid) = \
+                            self._decode_jit(
+                                self._pvals, self._tokens, self._pos,
+                                self._active, self._remaining,
+                                self._caches)
                 self.stats["chunks"] += 1
                 _obs.inc("pt_serving_chunks_total")
         finally:
@@ -322,6 +405,71 @@ class ServingEngine:
                        self.stats["decoded_tokens"] / max(wall, 1e-9))
         return sorted(finished, key=lambda r: r.req_id)
 
+    # -- paged-KV internals ------------------------------------------------
+    def _coverage_page(self, req):
+        """Highest logical page the NEXT decode chunk can write for this
+        request's slot (host arithmetic from sync-time counters, the
+        manager's shared coverage formula)."""
+        pos = req.resume_len + max(0, req.emitted_since_admit - 1)
+        left = req.max_new_tokens - len(req.tokens)
+        return self._kv.coverage_page(pos, left, self.chunk)
+
+    def _resume_fits(self, req):
+        n = req.prompt.size + len(req.tokens)
+        return n <= self.buckets[-1]
+
+    def _pick_victim(self, keep):
+        """Youngest-admitted active request whose resume prompt still
+        fits a prefill bucket — protect older work, and never strand a
+        request that could not be re-prefilled."""
+        cands = sorted(
+            ((s, r) for s, r in self.scheduler.active.items()
+             if s != keep and self._resume_fits(r)),
+            key=lambda sr: sr[1].admit_ns, reverse=True)
+        return cands[0][0] if cands else None
+
+    def _evict(self, slot):
+        """Preempt one in-flight request: free its pages, flag the slot
+        inactive on device, and requeue it at the front (it resumes by
+        recompute — prompt + streamed tokens re-prefill as one prompt,
+        bitwise-equivalent to uninterrupted decode)."""
+        req = self.scheduler.requeue(slot)
+        pages = self._kv.release(slot, evicted=True)
+        self._active = self._active.at[slot].set(False)
+        self.stats["page_evictions"] += 1
+        guardian.emit("serving_page_evict", req_id=req.req_id, slot=slot,
+                      pages_freed=pages,
+                      resume_len=req.prompt.size + len(req.tokens),
+                      queue_depth=self.scheduler.queue_depth)
+        return req
+
+    def _page_pressure(self):
+        """Before each chunk, grow every active slot's page table to
+        cover the chunk's writes, oldest request first; when the pool
+        runs dry, evict the youngest in-flight request back to the
+        queue and retry (so the oldest always makes progress — the
+        no-livelock guarantee page-pressure tests rely on)."""
+        order = sorted(self.scheduler.active.items(),
+                       key=lambda sr: sr[1].admit_ns)
+        for slot, req in order:
+            if self.scheduler.active.get(slot) is not req:
+                continue                      # evicted earlier this gap
+            while not self._kv.ensure(slot, self._coverage_page(req)):
+                victim = self._pick_victim(keep=slot)
+                if victim is None:
+                    if not self._resume_fits(req):
+                        raise RuntimeError(
+                            f"kv page pool exhausted and request "
+                            f"{req.req_id} can neither grow nor be "
+                            f"evicted (resume length "
+                            f"{req.prompt.size + len(req.tokens)} "
+                            f"exceeds the largest prefill bucket "
+                            f"{self.buckets[-1]})")
+                    victim = slot
+                self._evict(victim)
+                if victim == slot:
+                    break
+
     # -- internals ---------------------------------------------------------
     def _bucket_for(self, n):
         for b in self.buckets:
@@ -329,28 +477,97 @@ class ServingEngine:
                 return b
         raise ValueError(f"prompt length {n} exceeds largest bucket")
 
+    def _resume_prompt(self, req):
+        """The token sequence a (re-)admission prefills: the original
+        prompt plus any tokens already streamed before a page-pressure
+        eviction — resume-by-recompute, which is bitwise-equivalent to
+        never having been evicted (chunked causal prefill is exact)."""
+        if req.tokens:
+            return np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+        return req.prompt
+
     def _admit(self):
         """Admit queued requests into free slots (bounded by the
         interleave knob): one compiled bucket prefill each, KV written
-        straight into the assigned slot.  Returns the pending
-        (request, first-token, finished-flag) device handles — read
-        back at the chunk-boundary sync, never here."""
+        straight into the assigned slot (dense) or into reserved pages
+        (paged; a prefix-cache hit prefills only the uncached suffix).
+        Returns the pending (request, first-token, finished-flag) device
+        handles — read back at the chunk-boundary sync, never here."""
         pending = []
-        for req, slot in self.scheduler.admissions():
-            n = int(req.prompt.size)
-            bucket = self._bucket_for(n)
-            ids = np.full((1, bucket), self.pad, np.int32)
-            ids[0, :n] = req.prompt
-            with RecordEvent("serving.prefill"):
-                (t0, fin0, self._tokens, self._pos, self._active,
-                 self._remaining, self._caches) = \
-                    self._prefill_jit[bucket](
-                        self._pvals, jnp.asarray(ids),
-                        jnp.asarray(n, jnp.int32),
-                        jnp.asarray(slot, jnp.int32),
-                        jnp.asarray(int(req.max_new_tokens), jnp.int32),
-                        self._tokens, self._pos, self._active,
-                        self._remaining, self._caches)
+        bound, can_admit = {}, None
+        if self._paged:
+            def can_admit(req, slot):
+                # reserve AND bind here (atomically per admission) so a
+                # later admission in the same gap can already hit this
+                # prompt's freshly registered prefix pages
+                rp = self._resume_prompt(req)
+                budget = req.max_new_tokens - len(req.tokens)
+
+                def fit(k):
+                    m = rp.size - k
+                    return m <= self.buckets[-1] and \
+                        k + self._bucket_for(m) <= self.MAX
+                # a request that could outgrow the largest prefill
+                # bucket would become UN-resumable mid-decode (evicting
+                # it then would strand it); reserve its full extent up
+                # front so it never needs to grow — every growth-time
+                # allocation below then belongs to a resumable request,
+                # which can always self-evict, so page pressure can
+                # never hard-fail the run
+                horizon = budget if rp.size + budget > self.buckets[-1] \
+                    else self.chunk
+                plan = self._kv.plan(rp, budget, horizon, fit=fit)
+                if plan is None:
+                    return False
+                k = self._kv.bind(slot, plan,
+                                  register_limit=req.prompt.size)
+                bound[req.req_id] = (rp, k)
+                return True
+        for req, slot in self.scheduler.admissions(can_admit):
+            if self._paged:
+                rp, k = bound.pop(req.req_id)
+                n, m = int(rp.size), int(rp.size) - k
+                budget = req.max_new_tokens - len(req.tokens)
+                bucket = self._bucket_for(m)
+                ids = np.full((1, bucket), self.pad, np.int32)
+                ids[0, :m] = rp[k:]
+                req.resume_len = n
+                req.emitted_since_admit = 0
+                with RecordEvent("serving.prefill"):
+                    (t0, fin0, self._tokens, self._pos, self._active,
+                     self._remaining, self._pools) = \
+                        self._prefill_jit[bucket](
+                            self._pvals, jnp.asarray(ids),
+                            jnp.asarray(k, jnp.int32),
+                            jnp.asarray(m, jnp.int32),
+                            jnp.asarray(slot, jnp.int32),
+                            jnp.asarray(int(budget), jnp.int32),
+                            self._tokens, self._pos, self._active,
+                            self._remaining, self._pools,
+                            jnp.asarray(self._kv.table))
+                self._kv.set_pools(self._pools)
+                if k:
+                    guardian.emit("serving_prefix_hit", req_id=req.req_id,
+                                  slot=slot, cached_tokens=k,
+                                  pages_shared=k // self._kv.page_size,
+                                  prompt_len=n)
+            else:
+                n = int(req.prompt.size)
+                bucket = self._bucket_for(n)
+                ids = np.full((1, bucket), self.pad, np.int32)
+                ids[0, :n] = req.prompt
+                with RecordEvent("serving.prefill"):
+                    (t0, fin0, self._tokens, self._pos, self._active,
+                     self._remaining, self._caches) = \
+                        self._prefill_jit[bucket](
+                            self._pvals, jnp.asarray(ids),
+                            jnp.asarray(n, jnp.int32),
+                            jnp.asarray(slot, jnp.int32),
+                            jnp.asarray(int(req.max_new_tokens),
+                                        jnp.int32),
+                            self._tokens, self._pos, self._active,
+                            self._remaining, self._caches)
             self.stats["prefills"] += 1
             pending.append((req, slot, t0, fin0))
             guardian.emit("serving_admit", req_id=req.req_id, slot=slot,
@@ -361,8 +578,13 @@ class ServingEngine:
             if _obs.enabled():
                 _obs.inc("pt_serving_admissions_total")
                 _obs.inc("pt_serving_prefills_total", bucket=str(bucket))
-                _obs.observe("pt_serving_queue_wait_ms",
-                             req.queue_wait_ms)
+                if req.evictions == 0:
+                    # a page-pressure re-admission re-stamps admit_ns;
+                    # submit->admit would then count the earlier decode
+                    # span as "queue wait" and inflate the histogram
+                    # exactly in the overload regime it diagnoses
+                    _obs.observe("pt_serving_queue_wait_ms",
+                                 req.queue_wait_ms)
         if pending and _obs.enabled():
             _obs.set_gauge("pt_serving_slot_occupancy",
                            len(self.scheduler.active))
@@ -384,9 +606,12 @@ class ServingEngine:
         # the prefill's first token, then the chunk's tokens
         emitted = {}
         for (req, slot, _, _), (t0, fin0) in zip(pending, first):
-            req.first_token_ns = now
-            self.stats["ttft_ms"].append(req.ttft_ms)
-            _obs.observe("pt_serving_ttft_ms", req.ttft_ms)
+            if req.first_token_ns is None:
+                # guard for paged re-admission after eviction: TTFT is
+                # the FIRST first-token, not the resume's
+                req.first_token_ns = now
+                self.stats["ttft_ms"].append(req.ttft_ms)
+                _obs.observe("pt_serving_ttft_ms", req.ttft_ms)
             emitted[slot] = [int(t0)]
             if fin0:
                 req.finish_reason = "eos" if (
@@ -401,6 +626,7 @@ class ServingEngine:
         for slot, toks_slot in sorted(emitted.items()):
             req = self.scheduler.active[slot]
             req.tokens.extend(toks_slot)
+            req.emitted_since_admit += len(toks_slot)
             if req.finish_reason is None and not bool(active_h[slot]):
                 last = toks_slot[-1] if toks_slot else None
                 req.finish_reason = "eos" if (
@@ -416,6 +642,8 @@ class ServingEngine:
             if done:
                 req.finish_ns = now
                 self.scheduler.release(slot)
+                if self._paged:
+                    self._kv.release(slot)
                 self.stats["finished"] += 1
                 finished.append(req)
                 guardian.emit("serving_finish", req_id=req.req_id,

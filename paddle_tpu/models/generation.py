@@ -102,6 +102,20 @@ def build_apply(model, params):
     parameters, run one cached step, restore.  ``pos`` may be a scalar
     (uniform batch) or a per-row (B,) vector (the engine's per-slot
     offsets); ``attn_mask`` is an optional additive (B, MAX) key mask."""
+    def _wrap(c):
+        # dense (k, v) pair or a paged cache view (a NamedTuple whose
+        # optional scale fields may be None) — wrap leaves, keep shape
+        if hasattr(c, "_fields"):
+            return type(c)(*(None if x is None else Tensor(x)
+                             for x in c))
+        return tuple(Tensor(x) for x in c)
+
+    def _unwrap(c):
+        if hasattr(c, "_fields"):
+            return type(c)(*(None if x is None else x._value
+                             for x in c))
+        return tuple(x._value for x in c)
+
     def apply(pv, ids, caches, pos, attn_mask=None):
         olds = [p._value for p in params]
         for p, v in zip(params, pv):
@@ -113,10 +127,9 @@ def build_apply(model, params):
             with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
                 logits, new_caches = model(
                     Tensor(ids),
-                    caches=[(Tensor(k), Tensor(v)) for k, v in caches],
+                    caches=[_wrap(c) for c in caches],
                     pos=Tensor(pos), **kw)
-            return logits._value, [(k._value, v._value)
-                                   for k, v in new_caches]
+            return logits._value, [_unwrap(c) for c in new_caches]
         finally:
             for p, v in zip(params, olds):
                 p._value = v

@@ -127,9 +127,28 @@ def _cached_attention(out_proj, q, k, v, cache, pos, B, S, H,
     scatter; queries at absolute positions pos..pos+S-1 attend to prefix
     positions <= theirs through an additive mask.  ``attn_mask`` is an
     optional extra additive (B, MAX) key mask (0 keep / -1e30 drop) for
-    left-padded ragged prompts. Returns (out, (k_buf, v_buf))."""
+    left-padded ragged prompts. Returns (out, (k_buf, v_buf)).
+
+    ``cache`` may instead be an ``inference.kvcache.PagedCacheView``
+    (block-paged serving): the slot's pages are gathered into the same
+    (B, MAX, nH, D) working buffers, the write/mask/attention math below
+    runs unchanged (bitwise-identical to the dense path), and the newly
+    written positions scatter back to the page pool (quantizing in int8
+    mode).  Returns (out, updated view) in that case."""
     from ..tensor.manipulation import reshape
-    k_buf, v_buf = cache
+    paged = hasattr(cache, "_fields")
+    if paged:
+        from ..inference import kvcache as _kvc
+        if cache.k_scales is None:
+            k_buf, v_buf = call_op(_kvc.gather_pages, cache.k_pages,
+                                   cache.v_pages, cache.table)
+        else:
+            k_buf, v_buf = call_op(
+                _kvc.gather_pages_q, cache.k_pages, cache.v_pages,
+                cache.k_scales, cache.v_scales, cache.table,
+                dtype=q.dtype)
+    else:
+        k_buf, v_buf = cache
     MAX = k_buf.shape[1]
 
     def write(buf, new, p):
@@ -156,6 +175,18 @@ def _cached_attention(out_proj, q, k, v, cache, pos, B, S, H,
     out = F.scaled_dot_product_attention(q, k_buf, v_buf, attn_mask=mask,
                                          is_causal=False, training=False)
     out = reshape(out, [B, S, H])
+    if paged:
+        if cache.k_scales is None:
+            kp, vp = call_op(_kvc.scatter_pages, cache.k_pages,
+                             cache.v_pages, k, v, cache.table, pos)
+            new_cache = cache._replace(k_pages=kp, v_pages=vp)
+        else:
+            kp, vp, ks, vs = call_op(
+                _kvc.scatter_pages_q, cache.k_pages, cache.v_pages,
+                cache.k_scales, cache.v_scales, k, v, cache.table, pos)
+            new_cache = cache._replace(k_pages=kp, v_pages=vp,
+                                       k_scales=ks, v_scales=vs)
+        return out_proj(out), new_cache
     return out_proj(out), (k_buf, v_buf)
 
 

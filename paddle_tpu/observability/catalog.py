@@ -75,6 +75,32 @@ METRICS = {
     "pt_serving_prefills_total": {
         "type": _C, "labels": ("bucket",),
         "help": "compiled bucket prefill dispatches by bucket length"},
+    # -- paged KV cache (inference/kvcache.py) ----------------------------
+    "pt_kvcache_pages_in_use": {
+        "type": _G, "labels": (),
+        "help": "physical KV pages currently referenced (slot page "
+                "tables + prefix-cache entries); trash page excluded"},
+    "pt_kvcache_resident_kv_bytes": {
+        "type": _G, "labels": (),
+        "help": "bytes of KV actually resident (pages in use x bytes "
+                "per page across layers, incl. int8 scale planes) — "
+                "scales with live tokens, not slots x max_seq_len"},
+    "pt_kvcache_page_evictions_total": {
+        "type": _C, "labels": (),
+        "help": "pages freed by page-pressure preemption (requests "
+                "requeued to resume by recompute)"},
+    "pt_kvcache_prefix_hits_total": {
+        "type": _C, "labels": (),
+        "help": "admissions whose prompt matched a cached page-aligned "
+                "prefix (shared pages mapped copy-on-write, prefill "
+                "runs over the suffix only)"},
+    "pt_kvcache_prefix_misses_total": {
+        "type": _C, "labels": (),
+        "help": "admissions that prefilled their whole prompt cold"},
+    "pt_kvcache_prefix_saved_tokens_total": {
+        "type": _C, "labels": (),
+        "help": "prompt tokens NOT re-prefilled thanks to prefix-cache "
+                "hits (prefill FLOPs saved is proportional)"},
     # -- collectives (distributed/collective.py) --------------------------
     "pt_collective_calls_total": {
         "type": _C, "labels": ("op",),
