@@ -22,6 +22,10 @@ MONITORED_MODULES = (
     # compiled dispatches — the admission-time prompt ingest is the one
     # budgeted site; a device READBACK here is always a bug
     "paddle_tpu/inference/kvcache.py",
+    # speculative decoding: everything hot is inside the compiled
+    # draft-verify chunk — the one budgeted sync is the standalone
+    # entry's prompt ingest; a readback here is always a bug
+    "paddle_tpu/inference/speculative.py",
     # the bucketed/quantized gradient reducer runs entirely inside the
     # compiled step — ANY sync primitive appearing here is a bug, so it
     # is monitored with zero allowlist entries
@@ -120,6 +124,11 @@ HOST_SYNC_ALLOWLIST = {
         {"max": 1, "reason": "admission-time prompt ingest for prefix "
                              "keying/page planning (host array "
                              "canonicalization), not a readback"},
+    # speculative decoding (inference/speculative.py): H2D ingest only
+    ("paddle_tpu/inference/speculative.py", "speculative_generate",
+     "asarray"):
+        {"max": 1, "reason": "H2D ingest of the prompt ids (host "
+                             "list/array -> int32), not a readback"},
     # observability: the exporter-side sync funnel.  Recording is host-
     # only by contract; a device scalar handed to a gauge materializes
     # exactly once, at export time, through this one budgeted site
@@ -151,6 +160,17 @@ EXTRA_JIT_SURFACES = (
      "_build_paged_prefill.paged_prefill"),
     ("paddle_tpu/inference/kvcache.py",
      "_build_paged_decode_chunk.paged_decode_chunk"),
+    # speculative decoding: drafters + compiled spec prefill/chunk +
+    # the standalone entry's jitted body (inference/speculative.py;
+    # mirrors its register_jit_surface calls)
+    ("paddle_tpu/inference/speculative.py", "build_ngram_drafter.draft"),
+    ("paddle_tpu/inference/speculative.py", "build_model_drafter.draft"),
+    ("paddle_tpu/inference/speculative.py",
+     "_build_spec_prefill.spec_prefill"),
+    ("paddle_tpu/inference/speculative.py",
+     "_build_spec_decode_chunk.spec_decode_chunk"),
+    ("paddle_tpu/inference/speculative.py",
+     "speculative_generate.spec_run"),
     # grad_comm: the traced bucketed-reduce closure the builder returns
     # + the quantized-wire reduce built with static world/chunk/mode
     ("paddle_tpu/distributed/grad_comm.py", "build_grad_reducer.reduce"),

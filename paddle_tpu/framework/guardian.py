@@ -121,6 +121,11 @@ EVENT_SCHEMA = {
     # the queue (it resumes by recompute at re-admission)
     "serving_page_evict": {"req_id", "slot", "pages_freed",
                            "resume_len", "queue_depth"},
+    # speculative decoding (inference/speculative.py): one per run() of
+    # a spec-enabled engine — the draft acceptance aggregate
+    "serving_spec_accept": {"gamma", "proposed", "accepted",
+                            "accept_rate", "mean_accept_len",
+                            "verify_steps"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

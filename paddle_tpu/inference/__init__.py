@@ -292,7 +292,11 @@ def __getattr__(name):
     if name in ("ServingEngine", "FCFSScheduler", "Request"):
         from . import serving as _serving
         return getattr(_serving, name)
+    if name in ("SpecConfig", "speculative_generate"):
+        from . import speculative as _speculative
+        return getattr(_speculative, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ += ["ServingEngine", "FCFSScheduler", "Request"]
+__all__ += ["ServingEngine", "FCFSScheduler", "Request", "SpecConfig",
+            "speculative_generate"]

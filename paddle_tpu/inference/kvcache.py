@@ -28,9 +28,14 @@ formulation:
   values is what keeps paged greedy decode **bitwise-identical** to the
   dense engine and to ``generate()`` (tests/test_kvcache.py asserts
   the full chain).
-- **Prefix cache** — page-aligned prompt prefixes are content-keyed
-  (the raw token bytes, so there are no hash-collision correctness
-  holes) and their pages refcount-shared copy-on-write: shared pages
+- **Prefix cache** — page-aligned prompt prefixes are keyed by CHAINED
+  per-page digests (``digest_j = sha256(digest_{j-1} || page_j)``), so
+  building every prefix key of an n-token prompt is one O(n) pass
+  instead of the old O(n²/page_size) whole-prefix byte keys; a hit
+  still runs a full-content equality check against the stored prefix
+  tokens, so a digest collision degrades to a miss and there are no
+  hash-collision correctness holes.  Pages are refcount-shared
+  copy-on-write: shared pages
   are only ever *read* (decode writes always land at positions past the
   shared prefix, in slot-private pages), so the "copy" never actually
   happens.  A hit skips recomputing the shared prefix: the suffix
@@ -60,6 +65,7 @@ is bitwise-equivalent to having never been evicted, so the parity
 contract survives preemption).  See docs/serving.md.
 """
 import collections
+import hashlib
 from typing import Any, NamedTuple
 
 import numpy as np
@@ -150,7 +156,12 @@ def _scatter_coords(table, pos, S, page_size):
     idx = _positions(pos, S)                        # (B, S) absolute
     B = table.shape[0]
     rows = jnp.arange(B)[:, None]
-    phys = table[rows, idx // page_size]            # (B, S) physical
+    MAX = table.shape[1] * page_size
+    # positions past MAX (a speculative verify step's overhang near the
+    # end of a slot's extent) must land in the trash page — the default
+    # gather CLAMP would silently alias them onto the last mapped page
+    lp = jnp.minimum(idx // page_size, table.shape[1] - 1)
+    phys = jnp.where(idx < MAX, table[rows, lp], 0)  # (B, S) physical
     return phys, idx % page_size
 
 
@@ -338,11 +349,32 @@ class PagedKVManager:
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._ref = np.zeros(self.num_pages, np.int64)
         self._slot_pages = [dict() for _ in range(self.num_slots)]
-        self._prefix = collections.OrderedDict()    # key bytes -> pages
+        # chained per-page digest -> (pages tuple, prefix tokens).
+        # digest_j = sha256(digest_{j-1} || page_j bytes), so building
+        # every prefix key of an n-token prompt is ONE O(n) pass (the
+        # old whole-prefix raw-byte keys were O(n^2/page_size)); the
+        # stored token array backs a full-content equality check on hit,
+        # keeping the no-collision-holes contract
+        self._prefix = collections.OrderedDict()
         self.stats = {"prefix_hits": 0, "prefix_misses": 0,
                       "prefix_saved_tokens": 0, "pages_evicted": 0,
-                      "resident_high_water_bytes": 0}
+                      "resident_high_water_bytes": 0,
+                      "prefix_key_bytes_hashed": 0}
         self._gauges()
+
+    def _page_keys(self, prompt):
+        """Chained per-page digests for every page-aligned prefix of
+        ``prompt``: ``keys[j-1]`` keys the first ``j`` pages.  One pass,
+        O(len(prompt)) total — the stats counter machine-checks that
+        admission-time key construction stays linear."""
+        P = self.page_size
+        h, keys = hashlib.sha256(), []
+        for j in range(len(prompt) // P):
+            h.update(prompt[j * P:(j + 1) * P].tobytes())
+            keys.append(h.digest())
+        self.stats["prefix_key_bytes_hashed"] += \
+            (len(prompt) // P) * P * prompt.itemsize
+        return keys
 
     def device_pools(self):
         return self._pools
@@ -386,7 +418,7 @@ class PagedKVManager:
         free as soon as no slot still maps them."""
         if not self._prefix:
             return False
-        _, pages = self._prefix.popitem(last=False)
+        _, (pages, _) = self._prefix.popitem(last=False)
         for p in pages:
             self._decref(p)
         return True
@@ -399,7 +431,7 @@ class PagedKVManager:
         not wipe the prefix cache as a side effect of failing)."""
         if len(self._free) < count:
             prefix_refs = collections.Counter(
-                p for pages in self._prefix.values() for p in pages)
+                p for pages, _ in self._prefix.values() for p in pages)
             reclaimable = sum(1 for p, c in prefix_refs.items()
                               if self._ref[p] == c)
             if len(self._free) + reclaimable < count:
@@ -436,15 +468,22 @@ class PagedKVManager:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n, P = int(prompt.size), self.page_size
         k_pages, shared = 0, []
+        keys = self._page_keys(prompt) if self.prefix_enabled else []
         if self.prefix_enabled:
             for j in range((n - 1) // P, 0, -1):
-                ent = self._prefix.get(prompt[: j * P].tobytes())
+                ent = self._prefix.get(keys[j - 1])
                 if ent is None:
                     continue
                 if fit is not None and not fit(j * P):
                     continue
-                k_pages, shared = j, list(ent)
-                self._prefix.move_to_end(prompt[: j * P].tobytes())
+                pages, toks = ent
+                # full-content check on hit: a digest collision must
+                # degrade to a miss, never to sharing wrong KV
+                if toks.size != j * P or \
+                        not np.array_equal(toks, prompt[:j * P]):
+                    continue
+                k_pages, shared = j, list(pages)
+                self._prefix.move_to_end(keys[j - 1])
                 break
         # hold the hit pages BEFORE allocating: _alloc's LRU reclaim may
         # drop the hit entry itself, and without the plan's references
@@ -459,7 +498,7 @@ class PagedKVManager:
                 self._decref(p)
             return None
         return {"prompt": prompt, "k": k_pages * P,
-                "pages": shared + fresh}
+                "pages": shared + fresh, "keys": keys}
 
     def abandon(self, plan):
         """Release a plan that never got bound (admission raced away)."""
@@ -483,14 +522,20 @@ class PagedKVManager:
             mapping[j] = page
         if self.prefix_enabled:
             limit = n if register_limit is None else min(int(register_limit), n)
+            keys = plan["keys"]
             for j in range(1, limit // P + 1):
-                key = prompt[: j * P].tobytes()
+                key = keys[j - 1]
                 if key in self._prefix:
                     continue
                 pages = tuple(int(row[i]) for i in range(j))
                 for p in pages:
                     self._incref(p)
-                self._prefix[key] = pages
+                # a VIEW, deliberately: every entry of this prompt
+                # shares one base array, so registration keeps O(n)
+                # bytes per prompt — per-entry copies would re-create
+                # the quadratic admission cost this PR removed, just in
+                # memcpy instead of hashing
+                self._prefix[key] = (pages, prompt[: j * P])
             while len(self._prefix) > self.max_prefix_entries:
                 self._reclaim_one()
         self.stats["prefix_hits" if k else "prefix_misses"] += 1
@@ -557,7 +602,7 @@ class PagedKVManager:
         for mapping in self._slot_pages:
             for page in mapping.values():
                 refs[page] += 1
-        for pages in self._prefix.values():
+        for pages, _ in self._prefix.values():
             for page in pages:
                 refs[page] += 1
         assert np.array_equal(refs, self._ref), \

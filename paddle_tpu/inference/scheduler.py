@@ -26,7 +26,8 @@ class Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "callback",
                  "tokens", "submit_ns", "admit_ns", "first_token_ns",
                  "finish_ns", "finish_reason", "slot", "evictions",
-                 "resume_len", "emitted_since_admit")
+                 "resume_len", "emitted_since_admit", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, req_id, prompt, max_new_tokens, callback=None):
         self.req_id = req_id
@@ -47,6 +48,11 @@ class Request:
         self.evictions = 0
         self.resume_len = None
         self.emitted_since_admit = 0
+        # speculative decoding (inference/speculative.py): drafts this
+        # request was offered / drafts its verify steps accepted —
+        # booked at the chunk-boundary sync from the validity mask
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     @property
     def done(self):

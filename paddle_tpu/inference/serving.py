@@ -146,6 +146,13 @@ class ServingEngine:
       bitwise-identical to the dense engine and ``generate()`` (int8
       aside); resident KV HBM scales with live tokens instead of
       S x MAX.  See docs/serving.md.
+    - ``spec_decode=SpecConfig(...)`` turns on speculative decoding
+      (``inference/speculative.py``): each compiled chunk runs
+      draft–verify steps that emit 1..gamma+1 tokens per batched target
+      forward — greedy verification keeps the output bitwise identical
+      to the non-speculative engine and ``generate()``, whatever the
+      drafter proposes.  Composes with both KV modes (paged: per-slot
+      lengths rewind on rejection, pages stay reserved).
 
     The engine snapshots parameter values at construction; rebuild it
     (or call :meth:`refresh_weights`) after a training step.  Greedy
@@ -156,7 +163,7 @@ class ServingEngine:
                  prefill_buckets=None, dtype=None, eos_token_id=None,
                  pad_token_id=0, max_prefills_per_gap=None,
                  kv_mode="dense", page_size=16, num_pages=None,
-                 kv_dtype=None, prefix_cache=True):
+                 kv_dtype=None, prefix_cache=True, spec_decode=None):
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
         if kv_mode not in ("dense", "paged"):
@@ -192,7 +199,7 @@ class ServingEngine:
                 f"generated token (bucket {self.buckets[-1]} >= "
                 f"max_seq_len {self.MAX})")
         self._params = [p for _, p in model.named_parameters()]
-        self._spec = model.kv_cache_spec()
+        self._kvspec = model.kv_cache_spec()
         self._pvals = [p._value for p in self._params]
         self.cache_dtype = dominant_float_dtype(self._pvals)
         self._cast_override = dtype is not None
@@ -202,14 +209,66 @@ class ServingEngine:
                                        self.cache_dtype)
         apply = build_apply(model, self._params)
         pick = build_pick(True, 1.0, 0, 1.0)       # greedy, fp32 picks
+        self._spec = spec_decode
+        self._spec_steps = 0
+        self._draft_params = []
+        self._draft_pvals = []
+        if spec_decode is not None:
+            from .speculative import validate_spec
+            validate_spec(spec_decode, model, self.MAX)
+            self._spec_steps = self.chunk if spec_decode.steps is None \
+                else int(spec_decode.steps)
+            if self._spec_steps < 1:
+                raise ValueError("SpecConfig.steps must be >= 1")
         if self._paged:
-            from .kvcache import (PagedKVManager, _build_paged_prefill,
-                                  _build_paged_decode_chunk)
+            from .kvcache import PagedKVManager
             self._kv = PagedKVManager(
-                self._spec, self.num_slots, self.MAX, page_size,
+                self._kvspec, self.num_slots, self.MAX, page_size,
                 num_pages, self.cache_dtype, kv_dtype=kv_dtype,
                 prefix_cache=prefix_cache)
             quant = self._kv.quant
+        else:
+            self._kv = None
+            quant = False
+        if self._spec is not None:
+            from .speculative import (_build_spec_decode_chunk,
+                                      _build_spec_prefill,
+                                      build_model_drafter,
+                                      build_ngram_drafter)
+            sc = self._spec
+            self._model_draft = sc.draft_model is not None
+            if self._model_draft:
+                dm = sc.draft_model
+                self._draft_kvspec = dm.kv_cache_spec()
+                self._draft_params = [p for _, p in dm.named_parameters()]
+                self._draft_pvals = [p._value for p in self._draft_params]
+                if self._cast_override:
+                    self._draft_pvals = cast_weights(
+                        dm, self._draft_pvals, self.cache_dtype)
+                draft_apply = build_apply(dm, self._draft_params)
+                drafter = build_model_drafter(draft_apply, pick, sc.gamma)
+            else:
+                self._draft_kvspec = []
+                draft_apply = None
+                drafter = build_ngram_drafter(sc.gamma, sc.ngram, self.MAX)
+            # ONE jit each: jax specializes per (suffix, full) bucket
+            # shape pair, so the per-bucket dict the non-spec paths keep
+            # would be redundant here
+            self._prefill_jit = jax.jit(
+                _build_spec_prefill(apply, draft_apply, pick,
+                                    self._kvspec, self._draft_kvspec,
+                                    self.cache_dtype, self.MAX, self.eos,
+                                    self._paged, quant),
+                donate_argnums=(8, 9, 10, 11, 12, 13, 14))
+            self._decode_jit = jax.jit(
+                _build_spec_decode_chunk(apply, pick, drafter,
+                                         self._spec_steps, sc.gamma,
+                                         self.eos, self.pad, self._paged,
+                                         quant, self._model_draft),
+                donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+        elif self._paged:
+            from .kvcache import (_build_paged_prefill,
+                                  _build_paged_decode_chunk)
             self._prefill_jit = {
                 b: jax.jit(_build_paged_prefill(apply, pick, self.eos,
                                                 quant),
@@ -220,9 +279,8 @@ class ServingEngine:
                                           self.eos, self.pad, quant),
                 donate_argnums=(1, 2, 3, 4, 5))
         else:
-            self._kv = None
             self._prefill_jit = {
-                b: jax.jit(_build_prefill(apply, pick, self._spec,
+                b: jax.jit(_build_prefill(apply, pick, self._kvspec,
                                           self.cache_dtype, self.MAX,
                                           self.eos),
                            donate_argnums=(5, 6, 7, 8, 9))
@@ -256,10 +314,25 @@ class ServingEngine:
             self._caches = [
                 (jnp.zeros((S, self.MAX, nh, d), self.cache_dtype),
                  jnp.zeros((S, self.MAX, nh, d), self.cache_dtype))
-                for nh, d in self._spec]
+                for nh, d in self._kvspec]
+        if self._spec is not None:
+            # slot token history (the n-gram drafter's haystack; also
+            # what resume-by-recompute re-prefills) + the draft model's
+            # compact per-slot KV (always dense, even beside paged
+            # target KV — it is small by construction)
+            self._history = jnp.full((S, self.MAX), self.pad, jnp.int32)
+            self._draft_caches = [
+                (jnp.zeros((S, self.MAX, nh, d), self.cache_dtype),
+                 jnp.zeros((S, self.MAX, nh, d), self.cache_dtype))
+                for nh, d in self._draft_kvspec] \
+                if self._model_draft else None
+        else:
+            self._history = self._draft_caches = None
         self.stats = {"requests": 0, "finished": 0, "decoded_tokens": 0,
                       "chunks": 0, "prefills": 0, "ttft_ms": [],
-                      "max_concurrent": 0, "page_evictions": 0}
+                      "max_concurrent": 0, "page_evictions": 0,
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_verify_steps": 0, "spec_chunks": 0}
 
     def reset(self):
         """Drop all queued/in-flight work and zero the device state (the
@@ -280,6 +353,12 @@ class ServingEngine:
         if self._cast_override:
             pvals = cast_weights(self.model, pvals, self.cache_dtype)
         self._pvals = pvals
+        if self._spec is not None and self._model_draft:
+            dpvals = [p._value for p in self._draft_params]
+            if self._cast_override:
+                dpvals = cast_weights(self._spec.draft_model, dpvals,
+                                      self.cache_dtype)
+            self._draft_pvals = dpvals
         if self._paged:
             # cached-prefix KV belongs to the old weights; in-flight
             # slots are the user's race (same as dense), but serving a
@@ -311,7 +390,13 @@ class ServingEngine:
             # pressure has already evicted everything else) would throw
             # away every in-flight request's streamed tokens
             P = self._kv.page_size
-            full = -(-(int(prompt.size) + int(max_new_tokens)) // P)
+            extent = int(prompt.size) + int(max_new_tokens)
+            if self._spec is not None:
+                # verify steps write a gamma-token overhang past the
+                # last emitted position (clamped to MAX; beyond-MAX
+                # writes are trash-paged)
+                extent = min(extent + self._spec.gamma, self.MAX)
+            full = -(-extent // P)
             if full > self._kv.num_pages - 1:
                 raise ValueError(
                     f"request needs {full} KV pages at full decode but "
@@ -344,7 +429,26 @@ class ServingEngine:
                     "num_pages or lower max_new_tokens")
             if self.scheduler.active:
                 with RecordEvent("serving.decode_chunk"):
-                    if self._paged:
+                    if self._spec is not None:
+                        kv = self._pools if self._paged else self._caches
+                        table = jnp.asarray(self._kv.table) \
+                            if self._paged else None
+                        (self._tokens, self._pos, self._active,
+                         self._remaining, kv, self._draft_caches,
+                         self._history, toks, valid) = \
+                            self._decode_jit(
+                                self._pvals, self._draft_pvals,
+                                self._tokens, self._pos, self._active,
+                                self._remaining, kv, self._draft_caches,
+                                self._history, table)
+                        if self._paged:
+                            self._pools = kv
+                            self._kv.set_pools(kv)
+                        else:
+                            self._caches = kv
+                        self.stats["spec_chunks"] += 1
+                        _obs.inc("pt_serving_spec_draft_chunks_total")
+                    elif self._paged:
                         (self._tokens, self._pos, self._active,
                          self._remaining, self._pools, toks, valid) = \
                             self._decode_jit(
@@ -403,6 +507,19 @@ class ServingEngine:
             queue_depth=self.scheduler.queue_depth)
         _obs.set_gauge("pt_serving_useful_tokens_per_sec",
                        self.stats["decoded_tokens"] / max(wall, 1e-9))
+        if self._spec is not None:
+            prop = self.stats["spec_proposed"]
+            acc = self.stats["spec_accepted"]
+            # per SLOT-step (0..gamma, the accept_len histogram's
+            # domain), not per batched verify step — dividing by
+            # verify_steps would scale with slot occupancy
+            part = prop // max(self._spec.gamma, 1)
+            guardian.emit(
+                "serving_spec_accept", gamma=self._spec.gamma,
+                proposed=prop, accepted=acc,
+                accept_rate=round(acc / prop, 4) if prop else None,
+                mean_accept_len=round(acc / part, 3) if part else None,
+                verify_steps=self.stats["spec_verify_steps"])
         return sorted(finished, key=lambda r: r.req_id)
 
     # -- paged-KV internals ------------------------------------------------
@@ -412,6 +529,16 @@ class ServingEngine:
         manager's shared coverage formula)."""
         pos = req.resume_len + max(0, req.emitted_since_admit - 1)
         left = req.max_new_tokens - len(req.tokens)
+        if self._spec is not None:
+            # each verify step writes gamma+1 positions from a pos that
+            # advances only by what it commits, so a chunk's write
+            # extent is min(steps*(gamma+1), left + gamma) tokens:
+            # emissions are capped by the budget (then the slot goes
+            # inactive and trash-pages its writes), and the final
+            # step's overhang adds at most gamma
+            g = self._spec.gamma
+            return self._kv.coverage_page(pos, left + g,
+                                          self._spec_steps * (g + 1))
         return self._kv.coverage_page(pos, left, self.chunk)
 
     def _resume_fits(self, req):
@@ -515,9 +642,23 @@ class ServingEngine:
                 # allocation below then belongs to a resumable request,
                 # which can always self-evict, so page pressure can
                 # never hard-fail the run
-                horizon = budget if rp.size + budget > self.buckets[-1] \
-                    else self.chunk
-                plan = self._kv.plan(rp, budget, horizon, fit=fit)
+                unresumable = rp.size + budget > self.buckets[-1]
+                if self._spec is not None:
+                    # plan in WRITE tokens: the worst-case extent is
+                    # budget + gamma (pos advances only by committed
+                    # tokens; the final step overhangs by at most
+                    # gamma), additionally capped per chunk by
+                    # steps*(gamma+1) — NOT budget*(gamma+1), which
+                    # would over-demand pages and let a small-budget
+                    # request submit() accepted hard-fail admission
+                    g = self._spec.gamma
+                    horizon = budget + g if unresumable \
+                        else self._spec_steps * (g + 1)
+                    plan_budget = budget + g
+                else:
+                    horizon = budget if unresumable else self.chunk
+                    plan_budget = budget
+                plan = self._kv.plan(rp, plan_budget, horizon, fit=fit)
                 if plan is None:
                     return False
                 k = self._kv.bind(slot, plan,
@@ -535,17 +676,39 @@ class ServingEngine:
                 req.resume_len = n
                 req.emitted_since_admit = 0
                 with RecordEvent("serving.prefill"):
-                    (t0, fin0, self._tokens, self._pos, self._active,
-                     self._remaining, self._pools) = \
-                        self._prefill_jit[bucket](
-                            self._pvals, jnp.asarray(ids),
-                            jnp.asarray(k, jnp.int32),
-                            jnp.asarray(m, jnp.int32),
-                            jnp.asarray(slot, jnp.int32),
-                            jnp.asarray(int(budget), jnp.int32),
-                            self._tokens, self._pos, self._active,
-                            self._remaining, self._pools,
-                            jnp.asarray(self._kv.table))
+                    if self._spec is not None:
+                        # the draft (and the token history) prefill the
+                        # FULL resume prompt — the draft has no prefix
+                        # cache to cover a suffix-only start
+                        bucket_f = self._bucket_for(n)
+                        ids_f = np.full((1, bucket_f), self.pad, np.int32)
+                        ids_f[0, :n] = rp
+                        (t0, fin0, self._tokens, self._pos, self._active,
+                         self._remaining, self._pools,
+                         self._draft_caches, self._history) = \
+                            self._prefill_jit(
+                                self._pvals, self._draft_pvals,
+                                jnp.asarray(ids_f), jnp.asarray(ids),
+                                jnp.asarray(k, jnp.int32),
+                                jnp.asarray(m, jnp.int32),
+                                jnp.asarray(slot, jnp.int32),
+                                jnp.asarray(int(budget), jnp.int32),
+                                self._tokens, self._pos, self._active,
+                                self._remaining, self._pools,
+                                self._draft_caches, self._history,
+                                jnp.asarray(self._kv.table))
+                    else:
+                        (t0, fin0, self._tokens, self._pos, self._active,
+                         self._remaining, self._pools) = \
+                            self._prefill_jit[bucket](
+                                self._pvals, jnp.asarray(ids),
+                                jnp.asarray(k, jnp.int32),
+                                jnp.asarray(m, jnp.int32),
+                                jnp.asarray(slot, jnp.int32),
+                                jnp.asarray(int(budget), jnp.int32),
+                                self._tokens, self._pos, self._active,
+                                self._remaining, self._pools,
+                                jnp.asarray(self._kv.table))
                 self._kv.set_pools(self._pools)
                 if k:
                     guardian.emit("serving_prefix_hit", req_id=req.req_id,
@@ -558,16 +721,32 @@ class ServingEngine:
                 ids = np.full((1, bucket), self.pad, np.int32)
                 ids[0, :n] = req.prompt
                 with RecordEvent("serving.prefill"):
-                    (t0, fin0, self._tokens, self._pos, self._active,
-                     self._remaining, self._caches) = \
-                        self._prefill_jit[bucket](
-                            self._pvals, jnp.asarray(ids),
+                    if self._spec is not None:
+                        ids_j = jnp.asarray(ids)   # full == suffix: no
+                        (t0, fin0, self._tokens,   # dense prefix cache
+                         self._pos, self._active, self._remaining,
+                         self._caches, self._draft_caches,
+                         self._history) = self._prefill_jit(
+                            self._pvals, self._draft_pvals, ids_j, ids_j,
+                            jnp.zeros((), jnp.int32),
                             jnp.asarray(n, jnp.int32),
                             jnp.asarray(slot, jnp.int32),
                             jnp.asarray(int(req.max_new_tokens),
                                         jnp.int32),
                             self._tokens, self._pos, self._active,
-                            self._remaining, self._caches)
+                            self._remaining, self._caches,
+                            self._draft_caches, self._history)
+                    else:
+                        (t0, fin0, self._tokens, self._pos, self._active,
+                         self._remaining, self._caches) = \
+                            self._prefill_jit[bucket](
+                                self._pvals, jnp.asarray(ids),
+                                jnp.asarray(n, jnp.int32),
+                                jnp.asarray(slot, jnp.int32),
+                                jnp.asarray(int(req.max_new_tokens),
+                                            jnp.int32),
+                                self._tokens, self._pos, self._active,
+                                self._remaining, self._caches)
             self.stats["prefills"] += 1
             pending.append((req, slot, t0, fin0))
             guardian.emit("serving_admit", req_id=req.req_id, slot=slot,
@@ -617,7 +796,36 @@ class ServingEngine:
                 req.finish_reason = "eos" if (
                     self.eos is not None and int(t0) == self.eos) \
                     else "budget"
-        if toks_h is not None:
+        if toks_h is not None and toks_h.ndim == 3:
+            # speculative chunk: (steps, S, gamma+1) — stream each verify
+            # step's accepted prefix in order, and book acceptance from
+            # the SAME readback (no extra sync): a slot that emitted at
+            # all was offered gamma drafts and accepted e-1 of them
+            gamma = self._spec.gamma
+            for s in range(toks_h.shape[0]):
+                vstep = valid_h[s]                       # (S, gamma+1)
+                part = np.nonzero(vstep[:, 0])[0]
+                if part.size:
+                    self.stats["spec_verify_steps"] += 1
+                    _obs.inc("pt_serving_spec_verify_steps_total")
+                for slot in part:
+                    e = int(vstep[slot].sum())
+                    acc = e - 1
+                    emitted.setdefault(int(slot), []).extend(
+                        int(t) for t in toks_h[s, slot, :e])
+                    self.stats["spec_proposed"] += gamma
+                    self.stats["spec_accepted"] += acc
+                    req = self.scheduler.active.get(int(slot))
+                    if req is not None:
+                        req.spec_proposed += gamma
+                        req.spec_accepted += acc
+                    if _obs.enabled():
+                        _obs.inc("pt_serving_spec_proposed_total", gamma)
+                        if acc:
+                            _obs.inc("pt_serving_spec_accepted_total",
+                                     acc)
+                        _obs.observe("pt_serving_spec_accept_len", acc)
+        elif toks_h is not None:
             for s in range(toks_h.shape[0]):
                 for slot in np.nonzero(valid_h[s])[0]:
                     emitted.setdefault(int(slot), []).append(

@@ -1,0 +1,503 @@
+"""Speculative decoding for the serving stack (reference: the inference
+Predictor's ``speculate_method`` draft–verify decode — draft-model and
+inference-with-reference/prompt-lookup drafting over the fused decode).
+
+Decode is dispatch-bound in this environment (~95–105 ms per axon
+tunnel dispatch, BENCH ``chip_calibration``); the PR 4 engine amortizes
+it by chunking, and speculation multiplies the *tokens per dispatch* by
+the accepted draft length — the same "fewer, fatter device steps" shape
+grad_comm applied to collectives (PAPERS.md "T3").  One compiled
+**speculative chunk** per dispatch runs an inner ``lax.scan`` of
+draft–verify steps:
+
+1. **draft** γ tokens — either a small same-family *draft model*
+   keeping its own compact per-slot KV next to the target's, or the
+   model-free **n-gram prompt-lookup** drafter (match the last ``ngram``
+   tokens against the slot's own token history and propose the γ tokens
+   that followed the most recent match — no second network, surprisingly
+   strong on the self-repetitive outputs greedy decode produces);
+2. **verify** all γ+1 positions in a SINGLE batched target forward
+   (width γ+1 through the same cached-attention path, vector ``pos``);
+3. **select** the longest accepted prefix on device (greedy: draft
+   token j is accepted iff it equals the target's argmax after the
+   accepted prefix), truncate at eos/budget, and **commit/rewind** KV:
+   per-slot lengths advance by the emitted count only; the rejected
+   overhang positions stay masked (queries never attend past their own
+   position) and are overwritten by the next step's writes.  In paged
+   mode the slot's page table already covers the overhang (pages stay
+   reserved) — lengths rewind, pages don't.
+
+**Greedy verification makes the output bitwise identical** to
+``generate()`` and to the non-speculative engine: an accepted draft
+token *is* the target's greedy token for that prefix, computed by the
+identical compiled math over identical cache values — so the emitted
+stream cannot differ, whatever the drafter proposes (a bad drafter only
+costs acceptance rate, never correctness).  This preserves the PR 4
+parity contract; ``tests/test_speculative.py`` asserts the chain across
+GPT, LLaMA and GPT-MoE on both dense and paged engines.
+
+All dispatch stays static at build time (the grad_comm discipline): γ,
+the verify-step count, and the drafter are compile-time constants; the
+one bundled host sync per chunk stands (the readback grows to the
+(steps, S, γ+1) token/validity block — same single ``device_get``).
+
+Entry points: ``ServingEngine(spec_decode=SpecConfig(...))`` (see
+``serving.py``) and the standalone :func:`speculative_generate`, both
+sharing ``build_apply``/``build_pick`` with ``generate()``.  MoE note:
+verify forwards route γ+1 tokens per slot together, so expert capacity
+is competed among more tokens than single-token decode — exact parity
+holds when capacity never binds (the same caveat ``generate()``
+documents for its own batching).
+"""
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..analysis import register_jit_surface
+from ..framework.core import Tensor
+
+__all__ = ["SpecConfig", "speculative_generate"]
+
+# the compiled bodies are nested defs a decorator can't reach —
+# registered for the tracer-safety pass (mirrored by EXTRA_JIT_SURFACES
+# in paddle_tpu/analysis/allowlist.py)
+for _qual in ("build_ngram_drafter.draft", "build_model_drafter.draft",
+              "_build_spec_prefill.spec_prefill",
+              "_build_spec_decode_chunk.spec_decode_chunk",
+              "speculative_generate.spec_run"):
+    register_jit_surface(__name__, _qual)
+
+
+@dataclass
+class SpecConfig:
+    """Speculative-decoding knobs for ``ServingEngine(spec_decode=...)``.
+
+    - ``gamma``: draft tokens proposed per verify step (the reference's
+      ``speculate_max_draft_token_num``); each verify step emits 1..γ+1
+      tokens for one batched target forward.
+    - ``draft_model``: a small same-family causal LM (must share the
+      target's vocab); ``None`` selects the model-free n-gram
+      prompt-lookup drafter (the reference's ``inference_with_reference``
+      method, generalized to the slot's full token history).
+    - ``ngram``: match length for the prompt-lookup drafter (the
+      reference's ``speculate_max_ngram_size``).
+    - ``steps``: verify steps per compiled chunk; ``None`` uses the
+      engine's ``chunk`` knob, so one dispatch carries up to
+      ``chunk * (gamma+1)`` tokens at full acceptance.
+    """
+    gamma: int = 4
+    draft_model: Any = None
+    ngram: int = 3
+    steps: Optional[int] = None
+
+
+def validate_spec(cfg, target_model, max_seq_len):
+    """Build-time checks: γ sanity, draft/target vocab match, and draft
+    position capacity — failures here raise before anything compiles."""
+    if cfg.gamma < 1:
+        raise ValueError("SpecConfig.gamma must be >= 1")
+    if cfg.ngram < 1:
+        raise ValueError("SpecConfig.ngram must be >= 1")
+    if cfg.draft_model is None:
+        return
+    def _cfg(m):
+        return getattr(m, "config", None) \
+            or getattr(getattr(m, "model", None), "config", None)
+    tc, dc = _cfg(target_model), _cfg(cfg.draft_model)
+    tv = getattr(tc, "vocab_size", None)
+    dv = getattr(dc, "vocab_size", None)
+    if tv is not None and dv is not None and tv != dv:
+        raise ValueError(
+            f"draft model vocab_size {dv} != target vocab_size {tv} — "
+            "speculative verification feeds draft tokens straight into "
+            "the target, so the vocabularies must be identical")
+    dlim = getattr(dc, "max_position_embeddings", None)
+    if dlim is not None and dlim < max_seq_len:
+        raise ValueError(
+            f"draft model max_position_embeddings {dlim} < engine "
+            f"max_seq_len {max_seq_len} — the draft KV must cover every "
+            "target position")
+
+
+# -- pure-jnp pieces (called inside the compiled bodies) --------------------
+
+def _hist_write(hist, block, pos):
+    """Write a per-row token block at positions ``pos..pos+W-1`` of the
+    (B, MAX) history; out-of-range writes drop (jax scatter default)."""
+    B, W = block.shape
+    rows = jnp.arange(B)[:, None]
+    idx = pos[:, None] + jnp.arange(W)
+    return hist.at[rows, idx].set(block.astype(hist.dtype))
+
+
+def build_ngram_drafter(gamma, ngram, MAX):
+    """Model-free prompt-lookup drafter: match the last ``ngram`` tokens
+    (ending at the current token, already written into the history at
+    ``pos``) against the row's own history and propose the γ tokens
+    that followed the MOST RECENT earlier match.  No match proposes a
+    repeat of the current token — often right for the degenerate
+    constant runs greedy decode settles into, and merely rejected when
+    wrong."""
+    K = int(ngram)
+    nwin = MAX - K + 1
+
+    def draft(hist, tokens, pos):
+        B = hist.shape[0]
+        rows = jnp.arange(B)[:, None]
+        sfx_idx = pos[:, None] + jnp.arange(-K + 1, 1)          # (B, K)
+        sfx = hist[rows, jnp.clip(sfx_idx, 0, MAX - 1)]         # (B, K)
+        win = jnp.stack([hist[:, m:m + nwin] for m in range(K)],
+                        axis=-1)                                # (B,nwin,K)
+        eq = (win == sfx[:, None, :]).all(-1)                   # (B, nwin)
+        j = jnp.arange(nwin)[None, :]
+        # the match must END strictly before the current position (a
+        # window ending at pos is the suffix itself), and a full
+        # K-suffix must exist at all
+        ok = eq & (j + K - 1 < pos[:, None]) & (pos[:, None] >= K)
+        best = jnp.max(jnp.where(ok, j, -1), axis=1)            # (B,)
+        src = best[:, None] + K + jnp.arange(gamma)[None, :]
+        # a very recent match's continuation runs past the known region
+        # (history beyond ``pos`` is stale garbage): clamp the read to
+        # the current token — in the constant runs greedy decode settles
+        # into, that IS the right continuation, and elsewhere a wrong
+        # guess is merely rejected
+        src = jnp.minimum(src, pos[:, None])
+        cand = hist[rows, jnp.clip(src, 0, MAX - 1)]
+        return jnp.where((best >= 0)[:, None], cand,
+                         tokens[:, None].astype(hist.dtype))
+    return draft
+
+
+def build_model_drafter(draft_apply, pick, gamma):
+    """Draft-model drafter: γ sequential greedy single-token forwards
+    from the draft's own KV, plus ONE extra forward consuming the last
+    proposal — without it the draft cache would keep a permanent hole at
+    ``pos+γ`` whenever the whole draft is accepted, poisoning every
+    later draft forward that attends it."""
+    def draft(dpv, dkv, tokens, pos):
+        def body(carry, _):
+            t, p, dkv = carry
+            logits, dkv = draft_apply(dpv, t[:, None], dkv, p)
+            nt, _ = pick(logits[:, 0, :], jax.random.key(0))
+            return (nt, p + 1, dkv), nt
+        (last, endp, dkv), ds = jax.lax.scan(
+            body, (tokens, pos, dkv), None, length=gamma)
+        _, dkv = draft_apply(dpv, last[:, None], dkv, endp)
+        return ds.T, dkv                                       # (B, gamma)
+    return draft
+
+
+def verify_select(g, d, remaining, active, eos, gamma):
+    """The on-device accept/commit core, shared by the engine chunk and
+    ``speculative_generate``.  ``g`` (B, γ+1) are the target's greedy
+    picks for each verified prefix, ``d`` (B, γ) the drafts.  Returns
+    ``(valid, e, newtok, eos_hit)``: the per-position emission mask (a
+    contiguous prefix — acceptance, first-eos cut and budget clamp are
+    all prefix-monotone), the emitted count, the new last-emitted token
+    and whether an emitted token hit eos."""
+    match = (d == g[:, :-1]).astype(jnp.int32)                  # (B, γ)
+    e_full = jnp.cumprod(match, axis=1).sum(1) + 1              # 1..γ+1
+    j = jnp.arange(gamma + 1)[None, :]
+    if eos is not None:
+        iseos = g == eos
+        prior_eos = jnp.cumsum(iseos.astype(jnp.int32), axis=1) \
+            - iseos.astype(jnp.int32)
+        no_prior_eos = prior_eos == 0
+    else:
+        no_prior_eos = jnp.ones(g.shape, bool)
+    valid = (j < e_full[:, None]) & no_prior_eos & \
+        (j < remaining[:, None]) & active[:, None]
+    e = valid.sum(1).astype(jnp.int32)
+    newtok = jnp.take_along_axis(
+        g, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+    if eos is not None:
+        eos_hit = (valid & iseos).any(1)
+    else:
+        eos_hit = jnp.zeros((g.shape[0],), bool)
+    return valid, e, newtok, eos_hit
+
+
+# -- compiled bodies (serving engine) ---------------------------------------
+
+def _build_spec_prefill(apply, draft_apply, pick, spec, dspec, cache_dtype,
+                        MAX, eos, paged, quant):
+    """Compiled speculative prefill for one (suffix-bucket, full-bucket)
+    pair: the target prefills the suffix exactly like the non-spec
+    prefill (dense slot-row scatter, or paged suffix-at-offset), while
+    the DRAFT always prefills the FULL resume prompt from position 0 —
+    it has no prefix cache, and a hole at the shared-prefix positions
+    would poison every later draft forward.  The full prompt also lands
+    in the slot's token-history row (the n-gram drafter's haystack).
+    ``ids_full`` and ``ids_sfx`` are the same array in dense mode (no
+    prefix cache, start is always 0)."""
+    def spec_prefill(pv, dpv, ids_full, ids_sfx, start, length, slot,
+                     budget, tokens, pos, active, remaining, kv, dkv,
+                     hist, table=None):
+        if paged:
+            from .kvcache import _layer_views, _layer_pools
+            row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
+            views = _layer_views(kv, row, quant)
+            logits, new = apply(pv, ids_sfx, views, start)
+            kv = _layer_pools(new, quant)
+        else:
+            fresh = [(jnp.zeros((1, MAX, nh, dd), cache_dtype),
+                      jnp.zeros((1, MAX, nh, dd), cache_dtype))
+                     for nh, dd in spec]
+            logits, new = apply(pv, ids_sfx, fresh, jnp.zeros((), jnp.int32))
+            kv = [(jax.lax.dynamic_update_slice(
+                       ck, nk.astype(ck.dtype), (slot, 0, 0, 0)),
+                   jax.lax.dynamic_update_slice(
+                       vc, nv.astype(vc.dtype), (slot, 0, 0, 0)))
+                  for (ck, vc), (nk, nv) in zip(kv, new)]
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, length - 1, 1, axis=1)[:, 0]                # (1, V)
+        t0, _ = pick(last, jax.random.key(0))
+        t0 = t0[0]
+        if draft_apply is not None:
+            dfresh = [(jnp.zeros((1, MAX, nh, dd), cache_dtype),
+                       jnp.zeros((1, MAX, nh, dd), cache_dtype))
+                      for nh, dd in dspec]
+            _, dnew = draft_apply(dpv, ids_full, dfresh,
+                                  jnp.zeros((), jnp.int32))
+            dkv = [(jax.lax.dynamic_update_slice(
+                        ck, nk.astype(ck.dtype), (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(
+                        vc, nv.astype(vc.dtype), (slot, 0, 0, 0)))
+                   for (ck, vc), (nk, nv) in zip(dkv, dnew)]
+        hist = jax.lax.dynamic_update_slice(
+            hist, ids_full.astype(hist.dtype), (slot, jnp.int32(0)))
+        hit_eos = (t0 == eos) if eos is not None else jnp.asarray(False)
+        fin0 = hit_eos | (budget <= 1)
+        tokens = tokens.at[slot].set(t0)
+        pos = pos.at[slot].set(start + length)
+        active = active.at[slot].set(~fin0)
+        remaining = remaining.at[slot].set(budget - 1)
+        return t0, fin0, tokens, pos, active, remaining, kv, dkv, hist
+    return spec_prefill
+
+
+def _build_spec_decode_chunk(apply, pick, drafter, steps, gamma, eos, pad,
+                             paged, quant, model_draft):
+    """Compiled speculative decode: an inner scan of ``steps``
+    draft–verify steps over all S slots.  Each step drafts γ tokens,
+    verifies the γ+1-wide window in ONE target forward (the dense
+    engine's masked-finish discipline: inactive slots ride along, paged
+    tables redirect them to the trash page), selects the accepted prefix
+    on device and advances per-slot lengths by the emitted count only —
+    the rejected overhang is masked garbage the next step overwrites.
+    Emits ``(toks, valid)`` of shape (steps, S, γ+1) for the one
+    chunk-boundary host sync."""
+    g1 = gamma + 1
+
+    def spec_decode_chunk(pv, dpv, tokens, pos, active, remaining, kv,
+                          dkv, hist, table=None):
+        if paged:
+            from .kvcache import _layer_views, _layer_pools
+
+        def body(carry, _):
+            tokens, pos, active, remaining, kv, dkv, hist = carry
+            hist = _hist_write(hist, tokens[:, None], pos)
+            if model_draft:
+                d, dkv = drafter(dpv, dkv, tokens, pos)
+            else:
+                d = drafter(hist, tokens, pos)
+            d = d.astype(jnp.int32)
+            seq = jnp.concatenate([tokens[:, None], d], axis=1)  # (S, γ+1)
+            hist = _hist_write(hist, seq, pos)
+            if paged:
+                safe = jnp.where(active[:, None], table, 0)
+                views = _layer_views(kv, safe, quant)
+                logits, new = apply(pv, seq, views, pos)
+                kv = _layer_pools(new, quant)
+            else:
+                logits, kv = apply(pv, seq, kv, pos)
+            S = seq.shape[0]
+            flat, _ = pick(logits.reshape(S * g1, -1), jax.random.key(0))
+            g = flat.reshape(S, g1)
+            valid, e, newtok, eos_hit = verify_select(
+                g, d, remaining, active, eos, gamma)
+            toks_out = jnp.where(valid, g, jnp.int32(pad))
+            tokens = jnp.where(active, newtok, tokens)
+            pos = pos + e
+            remaining = remaining - e
+            done = active & (eos_hit | (remaining <= 0))
+            active = active & ~done
+            return (tokens, pos, active, remaining, kv, dkv, hist), \
+                (toks_out, valid)
+
+        carry = (tokens, pos, active, remaining, kv, dkv, hist)
+        (tokens, pos, active, remaining, kv, dkv, hist), (toks, valid) = \
+            jax.lax.scan(body, carry, None, length=steps)
+        return (tokens, pos, active, remaining, kv, dkv, hist, toks,
+                valid)
+    return spec_decode_chunk
+
+
+# -- standalone entry -------------------------------------------------------
+
+def speculative_generate(model, input_ids, max_new_tokens=32,
+                         draft_model=None, gamma=4, ngram=3,
+                         eos_token_id=None, pad_token_id=0, dtype=None):
+    """Greedy speculative generation, **bitwise identical** to
+    ``generate(decode_strategy="greedy_search")`` on the same inputs.
+
+    Returns ``(ids, scores)`` with the same contract as ``generate()``
+    (per-token post-softmax log-probs of the selected tokens).  The
+    *ids* are bitwise identical; the *scores* may differ in the last
+    ulp — the verify forward computes the same logit rows at width γ+1,
+    and XLA's width-dependent reduction order can move the fp32
+    log-prob by one ulp (never enough to move an argmax between
+    distinct logits, which is why the ids cannot drift).  One
+    compiled program runs prefill plus a ``lax.scan`` of draft–verify
+    steps (worst case ``max_new_tokens`` steps — every step emits at
+    least one token, finished rows ride along masked, the standard
+    static-shape formulation).  ``draft_model=None`` drafts by n-gram
+    prompt lookup; a draft model must share the target's vocabulary
+    (checked before anything compiles).  Greedy only: acceptance is an
+    exact token match against the target's argmax, which is what makes
+    the output provably identical — sampling needs the rejection-
+    resampling scheme and is an open item (docs/serving.md).
+    """
+    from ..models.generation import (build_apply, build_pick, cast_weights,
+                                     dominant_float_dtype, _caches_for)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    cfg = SpecConfig(gamma=int(gamma), draft_model=draft_model,
+                     ngram=int(ngram))
+    ids_np = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
+                        else input_ids).astype("int32")
+    if ids_np.ndim != 2:
+        raise ValueError("input_ids must be (batch, prompt_len)")
+    B, P = ids_np.shape
+    N = int(max_new_tokens)
+    mcfg = getattr(model, "config", None) \
+        or getattr(getattr(model, "model", None), "config", None)
+    limit = getattr(mcfg, "max_position_embeddings", None)
+    if limit is not None and P + N > limit:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {P + N} exceeds the model's "
+            f"max_position_embeddings = {limit}")
+    validate_spec(cfg, model, P + N)
+    # the cache carries a γ-token overhang region so rejected draft
+    # writes never go out of bounds; emitted queries stay < P+N (the
+    # budget clamp), so the extra masked tail cannot change any output
+    MAX = P + N + cfg.gamma
+    spec = model.kv_cache_spec()
+    params = [p for _, p in model.named_parameters()]
+    pvals = [p._value for p in params]
+    cache_dtype = dominant_float_dtype(pvals)
+    if dtype is not None:
+        cache_dtype = jnp.dtype(dtype)
+        pvals = cast_weights(model, pvals, cache_dtype)
+    eos = None if eos_token_id is None else int(eos_token_id)
+    pad = int(pad_token_id)
+    apply = build_apply(model, params)
+    pick = build_pick(True, 1.0, 0, 1.0)
+    model_draft = draft_model is not None
+    if model_draft:
+        dspec = draft_model.kv_cache_spec()
+        dparams = [p for _, p in draft_model.named_parameters()]
+        dpvals = [p._value for p in dparams]
+        if dtype is not None:
+            dpvals = cast_weights(draft_model, dpvals, cache_dtype)
+        draft_apply = build_apply(draft_model, dparams)
+        drafter = build_model_drafter(draft_apply, pick, cfg.gamma)
+    else:
+        dspec, dpvals, draft_apply = [], [], None
+        drafter = build_ngram_drafter(cfg.gamma, cfg.ngram, MAX)
+    g1 = cfg.gamma + 1
+
+    def spec_run(pv, dpv, prompt, hist):
+        caches = [(jnp.zeros((B, MAX, nh, dd), cache_dtype),
+                   jnp.zeros((B, MAX, nh, dd), cache_dtype))
+                  for nh, dd in spec]
+        logits, caches = apply(pv, prompt, caches, jnp.zeros((), jnp.int32))
+        t0, sc0 = pick(logits[:, -1, :], jax.random.key(0))
+        if model_draft:
+            dkv = [(jnp.zeros((B, MAX, nh, dd), cache_dtype),
+                    jnp.zeros((B, MAX, nh, dd), cache_dtype))
+                   for nh, dd in dspec]
+            _, dkv = draft_apply(dpv, prompt, dkv, jnp.zeros((), jnp.int32))
+        else:
+            dkv = None
+        out = jnp.full((B, N), pad, jnp.int32).at[:, 0].set(t0)
+        scores = jnp.zeros((B, N), jnp.float32).at[:, 0].set(sc0)
+        fin0 = (t0 == eos) if eos is not None else jnp.zeros((B,), bool)
+        remaining = jnp.full((B,), N - 1, jnp.int32)
+        active = ~fin0 & (remaining > 0)
+        state = (t0, jnp.full((B,), P, jnp.int32), active, remaining,
+                 caches, dkv, hist, out, scores,
+                 jnp.ones((B,), jnp.int32))
+
+        def body(carry, _):
+            tokens, pos, active, remaining, kv, dkv, hist, out, scores, \
+                cursor = carry
+            hist = _hist_write(hist, tokens[:, None], pos)
+            if model_draft:
+                d, dkv = drafter(dpv, dkv, tokens, pos)
+            else:
+                d = drafter(hist, tokens, pos)
+            d = d.astype(jnp.int32)
+            seq = jnp.concatenate([tokens[:, None], d], axis=1)
+            hist = _hist_write(hist, seq, pos)
+            logits, kv = apply(pv, seq, kv, pos)
+            flat, flat_sc = pick(logits.reshape(B * g1, -1),
+                                 jax.random.key(0))
+            g = flat.reshape(B, g1)
+            sc = flat_sc.reshape(B, g1)
+            valid, e, newtok, eos_hit = verify_select(
+                g, d, remaining, active, eos, cfg.gamma)
+            rows = jnp.arange(B)[:, None]
+            # invalid positions scatter out of bounds and drop
+            idx = jnp.where(valid, cursor[:, None] + jnp.arange(g1), N)
+            out = out.at[rows, idx].set(g)
+            scores = scores.at[rows, idx].set(sc)
+            cursor = cursor + e
+            tokens = jnp.where(active, newtok, tokens)
+            pos = pos + e
+            remaining = remaining - e
+            done = active & (eos_hit | (remaining <= 0))
+            active = active & ~done
+            return (tokens, pos, active, remaining, kv, dkv, hist, out,
+                    scores, cursor), None
+
+        if N > 1:
+            state, _ = jax.lax.scan(body, state, None, length=N - 1)
+        return state[7], state[8]
+
+    struct = tuple((tuple(v.shape), str(v.dtype)) for v in pvals)
+    dstruct = tuple((tuple(v.shape), str(v.dtype)) for v in dpvals)
+    sig = ("spec", B, P, N, cfg.gamma, cfg.ngram, model_draft, eos, pad,
+           str(cache_dtype), struct, dstruct)
+    jit_cache = _caches_for(model)["jit"]
+    fn = jit_cache.get(sig)
+    if fn is None:
+        fn = jit_cache[sig] = jax.jit(spec_run)
+    hist0 = jnp.full((B, MAX), pad, jnp.int32).at[:, :P].set(
+        jnp.asarray(ids_np))
+    was_training = model.training
+    model.eval()
+    draft_training = model_draft and draft_model.training
+    if model_draft:
+        draft_model.eval()
+    # MoE gates record aux loss as a side-effect attribute during
+    # forward; a tracer left behind would crash the next aux_loss()
+    # read (same discipline as generate())
+    from ..incubate.distributed.models.moe.gate import BaseGate
+    nets = [model] + ([draft_model] if model_draft else [])
+    gates = [m for net in nets for _, m in net.named_sublayers()
+             if isinstance(m, BaseGate)]
+    saved = [gt.loss for gt in gates]
+    try:
+        out_ids, out_sc = fn(pvals, dpvals, jnp.asarray(ids_np), hist0)
+    finally:
+        for gt, l in zip(gates, saved):
+            object.__setattr__(gt, "loss", l)
+        if was_training:
+            model.train()
+        if draft_training:
+            draft_model.train()
+    return Tensor(out_ids), Tensor(out_sc)

@@ -177,6 +177,14 @@ class GenerationMixin:
     def generate(self, input_ids, **kw):
         return generate(self, input_ids, **kw)
 
+    def speculative_generate(self, input_ids, **kw):
+        """Greedy draft–verify generation, bitwise identical to
+        ``generate(decode_strategy="greedy_search")`` — see
+        ``paddle_tpu.inference.speculative`` (lazy import: the
+        speculative module pulls in the serving stack)."""
+        from ..inference.speculative import speculative_generate
+        return speculative_generate(self, input_ids, **kw)
+
 
 def _top_k_top_p_filter(logits, top_k, top_p):
     """Mask logits outside the top-k set / top-p nucleus to -inf.

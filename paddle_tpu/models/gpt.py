@@ -44,6 +44,12 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     tensor_parallel: bool = False     # use TP layers (mp mesh axis)
     remat: bool = False               # jax.checkpoint per block
+    # selective remat: a jax.checkpoint_policies name (e.g.
+    # "dots_saveable" keeps matmul outputs, recomputes the cheap
+    # elementwise/norm ops — the reference's recompute_granularity=
+    # "core_attn"/"full" ladder as a policy).  Setting it implies
+    # remat; None with remat=True is full recompute (the old knob).
+    remat_policy: str = None
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -289,15 +295,31 @@ class GPTModel(nn.Layer):
                                   self.final_norm, attn_mask=attn_mask)
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
-            if self.config.remat:
-                x = _remat_block(blk, x)
+            if self.config.remat or self.config.remat_policy:
+                x = _remat_block(blk, x, self.config.remat_policy)
             else:
                 x = blk(x)
         return self.final_norm(x)
 
 
-def _remat_block(blk, x):
-    """jax.checkpoint the block (reference: fleet recompute per layer)."""
+def _remat_policy(name):
+    """Resolve a ``jax.checkpoint_policies`` name (``None`` = recompute
+    everything, the classic full-remat knob)."""
+    if name is None:
+        return None
+    pol = getattr(jax.checkpoint_policies, name, None)
+    if pol is None or name.startswith("_") or not callable(pol):
+        known = sorted(n for n in dir(jax.checkpoint_policies)
+                       if not n.startswith("_"))
+        raise ValueError(f"unknown remat_policy {name!r}; available "
+                         f"jax.checkpoint_policies: {known}")
+    return pol
+
+
+def _remat_block(blk, x, policy=None):
+    """jax.checkpoint the block (reference: fleet recompute per layer);
+    ``policy`` selects which intermediates are saved vs recomputed
+    (e.g. ``"dots_saveable"`` keeps the expensive matmul outputs)."""
     params = [p for _, p in blk.named_parameters()]
 
     def run(xv, *pv):
@@ -311,7 +333,8 @@ def _remat_block(blk, x):
         finally:
             for p, v in zip(params, olds):
                 p._value = v
-    return call_op(jax.checkpoint(run), x, *params)
+    return call_op(jax.checkpoint(run, policy=_remat_policy(policy)),
+                   x, *params)
 
 
 def _init_gpt_weights(root, std):

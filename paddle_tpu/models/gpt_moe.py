@@ -113,8 +113,8 @@ class GPTMoEModel(nn.Layer):
                                   self.final_norm, attn_mask=attn_mask)
         x = self.embeddings(input_ids, position_ids)
         for blk in self.layers:
-            if self.config.remat:
-                x = _remat_block(blk, x)
+            if self.config.remat or self.config.remat_policy:
+                x = _remat_block(blk, x, self.config.remat_policy)
             else:
                 x = blk(x)
         return self.final_norm(x)

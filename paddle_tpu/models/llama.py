@@ -31,6 +31,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tensor_parallel: bool = False
     remat: bool = False
+    remat_policy: str = None          # jax.checkpoint_policies name
 
     def __post_init__(self):
         if not self.num_key_value_heads:
@@ -182,9 +183,9 @@ class LlamaModel(nn.Layer):
             return _cached_layers(self.layers, caches, pos, x, self.norm,
                                   attn_mask=attn_mask)
         for blk in self.layers:
-            if self.config.remat:
+            if self.config.remat or self.config.remat_policy:
                 from .gpt import _remat_block
-                x = _remat_block(blk, x)
+                x = _remat_block(blk, x, self.config.remat_policy)
             else:
                 x = blk(x)
         return self.norm(x)

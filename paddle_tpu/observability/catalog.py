@@ -75,6 +75,27 @@ METRICS = {
     "pt_serving_prefills_total": {
         "type": _C, "labels": ("bucket",),
         "help": "compiled bucket prefill dispatches by bucket length"},
+    # -- speculative decoding (inference/speculative.py) ------------------
+    "pt_serving_spec_proposed_total": {
+        "type": _C, "labels": (),
+        "help": "draft tokens proposed to verification (gamma per "
+                "participating slot-step)"},
+    "pt_serving_spec_accepted_total": {
+        "type": _C, "labels": (),
+        "help": "draft tokens accepted and emitted (greedy match "
+                "against the target's argmax)"},
+    "pt_serving_spec_accept_len": {
+        "type": _H, "labels": (),
+        "help": "accepted drafts per verify step per slot (0..gamma; "
+                "emitted tokens = this + 1)"},
+    "pt_serving_spec_draft_chunks_total": {
+        "type": _C, "labels": (),
+        "help": "compiled draft-verify chunk dispatches (the spec "
+                "engine's decode chunks)"},
+    "pt_serving_spec_verify_steps_total": {
+        "type": _C, "labels": (),
+        "help": "batched gamma+1-wide target verify forwards that "
+                "carried at least one active slot"},
     # -- paged KV cache (inference/kvcache.py) ----------------------------
     "pt_kvcache_pages_in_use": {
         "type": _G, "labels": (),

@@ -1,0 +1,426 @@
+"""Speculative decoding (inference/speculative.py + the spec mode of
+inference/serving.py): bitwise greedy parity vs generate() across the
+three causal-LM families on dense and paged engines, acceptance-length
+bookkeeping, n-gram prompt-lookup drafter correctness, build-time
+draft/target validation — plus the PR's selective-remat satellite
+(GPTConfig.remat_policy lowering to jax.checkpoint policies).
+
+The parity tests are the subsystem's core claim: greedy verification
+accepts a draft token only when it EQUALS the target's argmax for that
+prefix, so the emitted stream is the target's own greedy stream token
+for token, bit for bit — whatever the drafter proposes.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import guardian
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.inference.speculative import (SpecConfig,
+                                              build_ngram_drafter,
+                                              speculative_generate)
+from paddle_tpu.models import (GPTForPretraining, LlamaForCausalLM,
+                               gpt3_tiny, llama_tiny)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    return GPTForPretraining(gpt3_tiny())
+
+
+@pytest.fixture(scope="module")
+def llama():
+    paddle.seed(0)
+    net = LlamaForCausalLM(llama_tiny())
+    rng = np.random.RandomState(3)
+    for _, p in net.named_parameters():
+        if len(p.shape) >= 2:
+            p._value = jnp.asarray(
+                rng.normal(0, 0.05, tuple(p.shape)).astype("float32"))
+    return net
+
+
+@pytest.fixture(scope="module")
+def draft_gpt():
+    """Smaller same-family, same-vocab draft for the two-model path."""
+    paddle.seed(11)
+    from paddle_tpu.models import GPTConfig
+    return GPTForPretraining(GPTConfig(
+        vocab_size=1024, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, max_position_embeddings=128))
+
+
+def _gen(net, prompt_np, n, **kw):
+    if prompt_np.ndim == 1:
+        prompt_np = prompt_np[None, :]
+    ids, _ = net.generate(paddle.to_tensor(prompt_np), max_new_tokens=n,
+                          **kw)
+    return np.asarray(ids._value)
+
+
+def _run_all(eng, prompts, budgets):
+    reqs = [eng.submit(p, int(b)) for p, b in zip(prompts, budgets)]
+    eng.run()
+    return reqs
+
+
+class TestStandaloneParity:
+    def test_ngram_ids_bitwise_scores_close(self, gpt):
+        """speculative_generate == generate greedy: ids BITWISE, scores
+        to one ulp (the width-γ+1 verify recomputes the same logit rows
+        under a different XLA reduction order)."""
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 1024, (3, 12)).astype("int32")
+        ref, ref_sc = gpt.generate(paddle.to_tensor(ids),
+                                   max_new_tokens=16)
+        got, got_sc = speculative_generate(gpt, ids, max_new_tokens=16,
+                                           gamma=4, ngram=2)
+        np.testing.assert_array_equal(np.asarray(ref._value),
+                                      np.asarray(got._value))
+        np.testing.assert_allclose(np.asarray(ref_sc._value),
+                                   np.asarray(got_sc._value),
+                                   rtol=0, atol=2e-6)
+
+    def test_draft_model_ids_bitwise(self, gpt, draft_gpt):
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 1024, (2, 9)).astype("int32")
+        ref = _gen(gpt, ids, 12)
+        got, _ = speculative_generate(gpt, ids, max_new_tokens=12,
+                                      draft_model=draft_gpt, gamma=3)
+        np.testing.assert_array_equal(ref, np.asarray(got._value))
+
+    def test_eos_and_padding_bitwise(self, gpt):
+        """eos mid-stream: the emitted prefix stops at eos and the tail
+        is pad — exactly generate()'s masked-finish output."""
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, 1024, (2, 8)).astype("int32")
+        free = _gen(gpt, ids, 12)
+        eos = int(free[0, 4])
+        ref = _gen(gpt, ids, 12, eos_token_id=eos)
+        got, _ = speculative_generate(gpt, ids, max_new_tokens=12,
+                                      gamma=4, ngram=2, eos_token_id=eos)
+        np.testing.assert_array_equal(ref, np.asarray(got._value))
+
+    def test_single_token_budget(self, gpt):
+        rng = np.random.RandomState(4)
+        ids = rng.randint(0, 1024, (2, 6)).astype("int32")
+        ref = _gen(gpt, ids, 1)
+        got, _ = speculative_generate(gpt, ids, max_new_tokens=1)
+        np.testing.assert_array_equal(ref, np.asarray(got._value))
+
+    def test_mixin_entry(self, gpt):
+        rng = np.random.RandomState(5)
+        ids = rng.randint(0, 1024, (1, 7)).astype("int32")
+        got, _ = gpt.speculative_generate(ids, max_new_tokens=6)
+        np.testing.assert_array_equal(_gen(gpt, ids, 6),
+                                      np.asarray(got._value))
+
+
+class TestEngineParity:
+    def test_gpt_dense_and_paged_bitwise(self, gpt):
+        """The acceptance gate: spec engine output == generate() bitwise
+        on both KV modes, ragged prompts and budgets."""
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 1024, (n,)).astype("int32")
+                   for n in (5, 11, 8, 3)]
+        for kw in ({}, {"kv_mode": "paged", "page_size": 8}):
+            eng = ServingEngine(gpt, num_slots=2, chunk=4,
+                                prefill_buckets=(8, 16),
+                                spec_decode=SpecConfig(gamma=3, ngram=2),
+                                **kw)
+            reqs = _run_all(eng, prompts, [9, 6, 9, 4])
+            for p, b, r in zip(prompts, [9, 6, 9, 4], reqs):
+                np.testing.assert_array_equal(
+                    np.asarray(r.tokens, np.int32), _gen(gpt, p, b)[0])
+            if eng._kv is not None:
+                eng._kv.check()
+
+    def test_llama_paged_spec_bitwise(self, llama):
+        """The family whose cached attention differs most (rope + GQA)
+        through the paged spec chunk."""
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(0, 512, (n,)).astype("int32")
+                   for n in (5, 9)]
+        eng = ServingEngine(llama, num_slots=2, chunk=4,
+                            prefill_buckets=(16,), kv_mode="paged",
+                            page_size=8,
+                            spec_decode=SpecConfig(gamma=3, ngram=2))
+        reqs = _run_all(eng, prompts, [7, 4])
+        for p, b, r in zip(prompts, [7, 4], reqs):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), _gen(llama, p, b)[0])
+        eng._kv.check()
+
+    def test_gpt_moe_dense_spec_bitwise(self):
+        """Third family: MoE routing competes capacity among the γ+1
+        verify tokens, so capacity is lifted to never bind (the causal-
+        consistency caveat generate() documents)."""
+        from paddle_tpu.models import GPTMoEForPretraining, gpt_moe_tiny
+        paddle.seed(0)
+        cfg = gpt_moe_tiny(num_hidden_layers=2)
+        moe = GPTMoEForPretraining(cfg)
+        for m in moe.gpt.moe_layers():
+            m.gate.capacity_factor = float(cfg.num_experts * cfg.top_k)
+        rng = np.random.RandomState(3)
+        p = rng.randint(0, 1024, (6,)).astype("int32")
+        eng = ServingEngine(moe, num_slots=1, chunk=4,
+                            prefill_buckets=(8,),
+                            spec_decode=SpecConfig(gamma=3, ngram=2))
+        (r,) = _run_all(eng, [p], [5])
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      _gen(moe, p, 5)[0])
+
+    def test_draft_model_engine_bitwise(self, gpt, draft_gpt):
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, 1024, (n,)).astype("int32")
+                   for n in (5, 8)]
+        eng = ServingEngine(
+            gpt, num_slots=2, chunk=4, prefill_buckets=(8, 16),
+            spec_decode=SpecConfig(gamma=3, draft_model=draft_gpt))
+        reqs = _run_all(eng, prompts, [8, 8])
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), _gen(gpt, p, 8)[0])
+
+    def test_small_budget_tight_pool_admits(self, gpt):
+        """Review regression: spec admission must plan budget+gamma
+        write tokens (pos advances only by committed tokens; the final
+        step overhangs by at most gamma), NOT budget*(gamma+1) — the
+        over-demand made a resumable small-budget request that submit()
+        accepted hard-fail admission on an exactly-sized pool."""
+        rng = np.random.RandomState(14)
+        p = rng.randint(0, 1024, (16,)).astype("int32")
+        # 3 allocatable pages of 16 = 48 tokens; true extent is
+        # 16 + 8 + 4 = 28 (2 pages); the old budget*(gamma+1) plan
+        # demanded 16 + 40 = 56 (4 pages) and raised
+        eng = ServingEngine(gpt, num_slots=1, chunk=32, max_seq_len=64,
+                            prefill_buckets=(16, 32), kv_mode="paged",
+                            page_size=16, num_pages=4,
+                            spec_decode=SpecConfig(gamma=4, ngram=2))
+        (r,) = _run_all(eng, [p], [8])
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      _gen(gpt, p, 8)[0])
+        eng._kv.check()
+
+    def test_paged_int8_spec_runs_and_agrees(self, gpt):
+        """Speculation composes with int8 KV.  int8 is tolerance-
+        bounded, not bitwise (docs/serving.md) — and under speculation
+        the verify window's keys are EXACT (in-buffer) where sequential
+        int8 re-reads them quantized, so spec-vs-nonspec tokens may
+        legitimately differ at near-ties.  Assert the run completes its
+        budgets with sane acceptance and high token agreement."""
+        rng = np.random.RandomState(13)
+        p = rng.randint(0, 1024, (6,)).astype("int32")
+        outs = []
+        for spec in (None, SpecConfig(gamma=3, ngram=2)):
+            eng = ServingEngine(gpt, num_slots=1, chunk=4,
+                                prefill_buckets=(8,), kv_mode="paged",
+                                page_size=8, kv_dtype="int8",
+                                spec_decode=spec)
+            (r,) = _run_all(eng, [p], [8])
+            assert len(r.tokens) == 8
+            outs.append(list(r.tokens))
+            eng._kv.check()
+        agree = sum(a == b for a, b in zip(*outs)) / 8
+        assert agree >= 0.75, outs
+
+    def test_eviction_resume_bitwise(self, gpt):
+        """Page pressure under speculation: per-slot lengths rewind,
+        pages stay reserved, and a preempted request resumes by
+        recompute — output still bitwise equal to generate()."""
+        rng = np.random.RandomState(8)
+        prompts = [rng.randint(0, 1024, (n,)).astype("int32")
+                   for n in (6, 7, 5)]
+        eng = ServingEngine(
+            gpt, num_slots=3, chunk=4, prefill_buckets=(8, 16),
+            kv_mode="paged", page_size=4, num_pages=13,
+            spec_decode=SpecConfig(gamma=3, ngram=2, steps=1))
+        reqs = _run_all(eng, prompts, [10, 10, 10])
+        assert eng.stats["page_evictions"] > 0   # pressure actually hit
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens, np.int32), _gen(gpt, p, 10)[0])
+        eng._kv.check()
+
+
+class TestAcceptanceBookkeeping:
+    def test_stats_identity_and_events(self, gpt):
+        """decoded_tokens must reconcile exactly with the acceptance
+        ledger: one first token per admission, plus one committed token
+        per slot-verify-step, plus the accepted drafts — and the
+        serving_spec_accept guardian event mirrors the same counters."""
+        guardian.clear_events()
+        rng = np.random.RandomState(9)
+        prompts = [rng.randint(0, 1024, (n,)).astype("int32")
+                   for n in (5, 9)]
+        eng = ServingEngine(gpt, num_slots=2, chunk=4,
+                            prefill_buckets=(16,),
+                            spec_decode=SpecConfig(gamma=3, ngram=2))
+        reqs = _run_all(eng, prompts, [10, 7])
+        s = eng.stats
+        assert s["decoded_tokens"] == 17
+        participations = s["spec_proposed"] // 3
+        assert s["spec_proposed"] % 3 == 0
+        assert 0 <= s["spec_accepted"] <= s["spec_proposed"]
+        assert s["decoded_tokens"] == \
+            len(reqs) + participations + s["spec_accepted"]
+        assert s["spec_chunks"] == s["chunks"] > 0
+        assert s["spec_verify_steps"] >= s["spec_chunks"]
+        # per-request ledgers sum to the engine's
+        assert sum(r.spec_proposed for r in reqs) == s["spec_proposed"]
+        assert sum(r.spec_accepted for r in reqs) == s["spec_accepted"]
+        (ev,) = guardian.events("serving_spec_accept")
+        assert ev["proposed"] == s["spec_proposed"]
+        assert ev["accepted"] == s["spec_accepted"]
+        assert ev["verify_steps"] == s["spec_verify_steps"]
+        assert ev["gamma"] == 3
+
+    def test_spec_metrics_recorded(self, gpt):
+        from paddle_tpu import observability as obs
+        obs.get_registry().reset()
+        rng = np.random.RandomState(10)
+        eng = ServingEngine(gpt, num_slots=1, chunk=4,
+                            prefill_buckets=(8,),
+                            spec_decode=SpecConfig(gamma=2, ngram=2))
+        _run_all(eng, [rng.randint(0, 1024, (5,)).astype("int32")], [8])
+        reg = obs.get_registry()
+        prop = reg.get("pt_serving_spec_proposed_total")
+        acc = reg.get("pt_serving_spec_accepted_total")
+        assert prop is not None and prop.value() == \
+            eng.stats["spec_proposed"] > 0
+        assert (acc.value() if acc is not None else 0) == \
+            eng.stats["spec_accepted"]
+        assert reg.get("pt_serving_spec_draft_chunks_total").value() == \
+            eng.stats["spec_chunks"]
+        hist = reg.get("pt_serving_spec_accept_len")
+        assert hist is not None and \
+            hist.count() == eng.stats["spec_proposed"] // 2
+
+
+class TestNgramDrafter:
+    def test_lookup_continues_most_recent_match(self):
+        """History ...a b c a b -> with ngram=2 and current token b at
+        pos, the drafter must propose the continuation after the most
+        recent EARLIER (a, b): c, then a, then b (clamped to known)."""
+        MAX = 16
+        draft = build_ngram_drafter(3, 2, MAX)
+        a, b, c = 7, 8, 9
+        hist = np.zeros((1, MAX), np.int32)
+        seq = [a, b, c, a, b]                   # pos = 4, current = b
+        hist[0, :5] = seq
+        out = jax.jit(draft)(jnp.asarray(hist),
+                             jnp.asarray([b], jnp.int32),
+                             jnp.asarray([4], jnp.int32))
+        got = np.asarray(out)[0]
+        # match at j=0 (a b), continuation hist[2:5] = c a b
+        np.testing.assert_array_equal(got, [c, a, b])
+
+    def test_no_match_repeats_current(self):
+        MAX = 16
+        draft = build_ngram_drafter(2, 2, MAX)
+        hist = np.zeros((1, MAX), np.int32)
+        hist[0, :4] = [1, 2, 3, 4]
+        out = jax.jit(draft)(jnp.asarray(hist),
+                             jnp.asarray([4], jnp.int32),
+                             jnp.asarray([3], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(out)[0], [4, 4])
+
+    def test_constant_run_fully_accepted(self):
+        """A constant tail must draft the constant — the degenerate
+        regime greedy decode settles into, where speculation pays."""
+        MAX = 16
+        draft = build_ngram_drafter(4, 2, MAX)
+        hist = np.zeros((1, MAX), np.int32)
+        hist[0, :6] = [3, 5, 5, 5, 5, 5]        # pos=5, current=5
+        out = jax.jit(draft)(jnp.asarray(hist),
+                             jnp.asarray([5], jnp.int32),
+                             jnp.asarray([5], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(out)[0], [5, 5, 5, 5])
+
+
+class TestBuildTimeValidation:
+    def test_vocab_mismatch_raises(self, gpt, llama):
+        with pytest.raises(ValueError, match="vocab_size"):
+            ServingEngine(gpt, spec_decode=SpecConfig(draft_model=llama))
+        with pytest.raises(ValueError, match="vocab_size"):
+            speculative_generate(gpt, np.zeros((1, 4), np.int32),
+                                 max_new_tokens=4, draft_model=llama)
+
+    def test_bad_gamma_and_steps_raise(self, gpt):
+        with pytest.raises(ValueError, match="gamma"):
+            ServingEngine(gpt, spec_decode=SpecConfig(gamma=0))
+        with pytest.raises(ValueError, match="steps"):
+            ServingEngine(gpt, spec_decode=SpecConfig(steps=0))
+
+    def test_short_draft_position_table_raises(self, gpt):
+        from paddle_tpu.models import GPTConfig
+        paddle.seed(12)
+        shorty = GPTForPretraining(GPTConfig(
+            vocab_size=1024, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, max_position_embeddings=16))
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            ServingEngine(gpt, max_seq_len=64,
+                          spec_decode=SpecConfig(draft_model=shorty))
+
+
+class TestRematPolicy:
+    """PR satellite: GPTConfig.remat widened to remat_policy (a
+    jax.checkpoint_policies name) — selective remat must not change the
+    math, and an unknown policy must fail loudly at build."""
+
+    def _grads(self, remat=False, policy=None):
+        from paddle_tpu.framework import autograd as _ag
+        from paddle_tpu.framework.random import rng_scope
+        from paddle_tpu.models import GPTConfig
+        cfg = GPTConfig(vocab_size=512, hidden_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=64, remat=remat,
+                        remat_policy=policy)
+        paddle.seed(0)
+        net = GPTForPretraining(cfg)
+        net.eval()
+        params = [p for _, p in net.named_parameters()]
+        pvals = [p._value for p in params]
+        ids = jnp.asarray(np.random.RandomState(0).randint(
+            0, 512, (2, 16)).astype("int32"))
+
+        def loss_fn(pv):
+            olds = [p._value for p in params]
+            for p, v in zip(params, pv):
+                p._value = v
+            try:
+                with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                    lg = net(paddle.Tensor(ids))._value
+                lp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+                return -jnp.take_along_axis(
+                    lp[:, :-1], ids[:, 1:, None], 2).mean()
+            finally:
+                for p, v in zip(params, olds):
+                    p._value = v
+        loss, g = jax.jit(jax.value_and_grad(loss_fn))(pvals)
+        return float(loss), [np.asarray(x) for x in g]
+
+    def test_policy_matches_full_remat_and_baseline(self):
+        l0, g0 = self._grads()
+        l1, g1 = self._grads(policy="dots_saveable")
+        l2, g2 = self._grads(remat=True)
+        assert l0 == pytest.approx(l1, rel=1e-6)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+        # a policy is just selective saving: identical to full remat
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+    def test_unknown_policy_raises(self):
+        with pytest.raises(ValueError, match="remat_policy"):
+            self._grads(policy="definitely_not_a_policy")
+
+    def test_llama_config_has_knob(self):
+        from paddle_tpu.models import LlamaConfig
+        assert LlamaConfig(remat_policy="dots_saveable").remat_policy \
+            == "dots_saveable"
