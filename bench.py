@@ -67,19 +67,62 @@ def _telemetry_snapshot(tag, reset=True):
     ``<tag>.jsonl`` (the PADDLE_METRICS_LOG line format), dir from
     ``BENCH_TELEMETRY_DIR`` (default ``telemetry/``).  ``reset`` zeroes
     the registry afterwards so the next config's snapshot is its own
-    (counters are process-cumulative otherwise)."""
+    (counters are process-cumulative otherwise).
+
+    Idempotent per tag: the ``.prom`` write truncates (atomic replace)
+    and the ``.jsonl`` write is run-id-keyed (``replace_run``), so
+    re-running bench updates the snapshot in place instead of appending
+    one copy per invocation.  A run that produced request-trace spans
+    (serving configs) also drops ``<tag>_requests.trace.json`` — the
+    per-request-lane chrome trace ``report --requests`` summarizes."""
     try:
         from paddle_tpu import observability as obs
         from paddle_tpu.observability import export as obs_export
+        from paddle_tpu.observability import timeline as obs_timeline
+        from paddle_tpu.observability import tracing as obs_tracing
         d = os.environ.get("BENCH_TELEMETRY_DIR", "telemetry")
         os.makedirs(d, exist_ok=True)
         prom = obs_export.write_prometheus(os.path.join(d, f"{tag}.prom"))
         jsl = obs_export.write_jsonl(os.path.join(d, f"{tag}.jsonl"),
-                                     run=tag)
+                                     run=tag, replace_run=True)
+        out = {"prometheus": prom, "jsonl": jsl}
+        if obs_tracing.spans():
+            out["requests_trace"] = obs_timeline.export_chrome_trace(
+                os.path.join(d, f"{tag}_requests.trace.json"),
+                include_profiler=False, include_guardian=False,
+                include_samples=False)
+            obs_tracing.reset()
         if reset:
             obs.get_registry().reset()
-        return {"prometheus": prom, "jsonl": jsl}
+        return out
     except Exception as e:  # telemetry must never sink the bench line
+        return {"error": repr(e)[:160]}
+
+
+def _roofline_snapshot(measured_ms, peak_flops, hbm_bw):
+    """Join the process's compile telemetry (every surface any config
+    compiled) with measured step latency into the per-surface
+    roofline/MFU-attribution table the MFU-plateau roadmap item asks
+    for, committed as ``<dir>/roofline.json`` (the same table
+    ``report --roofline`` renders from a ``.prom`` snapshot)."""
+    try:
+        import json as _json
+        from paddle_tpu.observability import compilestats, report
+        stats = compilestats.snapshot()
+        if not stats:
+            return {"skipped": "no compile telemetry recorded"}
+        table = report.roofline_from_stats(stats, measured_ms,
+                                           peak_flops, hbm_bw)
+        d = os.environ.get("BENCH_TELEMETRY_DIR", "telemetry")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "roofline.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            _json.dump(table, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return {"roofline": path, "surfaces": len(table["rows"])}
+    except Exception as e:
         return {"error": repr(e)[:160]}
 
 
@@ -237,7 +280,13 @@ def bench_gpt(cfg, B, S, iters, peak):
             body, (pv, m, v, t), None, length=K)
         return losses[-1], pv, m, v, t
 
-    step_jit = jax.jit(scan_steps, donate_argnums=(0, 1, 2))
+    # compile telemetry (observability/compilestats.py): the scan
+    # stepper is ONE executable covering K inner steps — its analytical
+    # FLOPs/bytes and the per-DISPATCH latency recorded below are what
+    # `report --roofline` / telemetry/roofline.json join
+    from paddle_tpu.observability import compilestats as _cstats
+    step_jit = _cstats.wrap(jax.jit(scan_steps, donate_argnums=(0, 1, 2)),
+                            "bench.train_step", budget=1)
     m0 = [jnp.zeros_like(v) for v in pvals]
     v0 = [jnp.zeros_like(v) for v in pvals]
     t0 = jnp.zeros((), jnp.int32)
@@ -260,6 +309,10 @@ def bench_gpt(cfg, B, S, iters, peak):
     # the JSON reports)
     from paddle_tpu import observability as obs
     obs.observe("pt_train_step_latency_ms", dt / (iters * K) * 1e3)
+    # per-DISPATCH measured latency for the roofline join (the scan
+    # covers K steps, so this is K x the per-step number above)
+    obs.observe("pt_compile_dispatch_ms", dt / iters * 1e3,
+                surface="bench.train_step")
     obs.inc("pt_train_tokens_total", iters * K * B * S)
     obs.set_gauge("pt_train_tokens_per_sec", tokens_per_sec)
     obs.set_gauge("pt_train_loss", final_loss)
@@ -270,7 +323,9 @@ def bench_gpt(cfg, B, S, iters, peak):
     mfu = tokens_per_sec * flops_per_tok / peak
     return {"tokens_per_sec": round(tokens_per_sec, 1),
             "mfu": round(mfu, 4), "loss": round(final_loss, 4),
-            "params": n_params, "batch": B, "seq": S}
+            "params": n_params, "batch": B, "seq": S,
+            "step_ms": round(dt / (iters * K) * 1e3, 3),
+            "dispatch_ms": round(dt / iters * 1e3, 3)}
 
 
 # ---------------------------------------------------------------------------
@@ -1663,6 +1718,19 @@ def main():
                 configs["gpt1p3b_hybrid"] = bench_gpt1p3b_hybrid(peak=peak)
             except Exception as e:
                 configs["gpt1p3b_hybrid"] = {"error": repr(e)[:200]}
+
+    # roofline/MFU-attribution artifact: join every surface the run
+    # compiled (train stepper + any serving engines) with the measured
+    # per-dispatch latency.  HBM bandwidth: v5e ~819 GB/s; the CPU
+    # proxy gets a nominal figure (the table still shows analytical
+    # intensity + compute/memory split — attribution fractions are
+    # proxy-scale there and labeled by the peak used).
+    hbm_bw = 819e9 if on_tpu else 50e9
+    measured = {}
+    if primary is not None and isinstance(primary, dict) and \
+            primary.get("dispatch_ms"):
+        measured["bench.train_step"] = primary["dispatch_ms"]
+    telemetry["roofline"] = _roofline_snapshot(measured, peak, hbm_bw)
 
     if primary is not None:
         rate = primary["tokens_per_sec"]

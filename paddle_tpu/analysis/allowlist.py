@@ -37,6 +37,13 @@ MONITORED_MODULES = (
     "paddle_tpu/observability/export.py",
     "paddle_tpu/observability/timeline.py",
     "paddle_tpu/observability/catalog.py",
+    # compile telemetry + request tracing (ISSUE 10): both record
+    # around hot dispatch paths, so a readback in either is always a
+    # bug — monitored with ZERO allowlist entries (compile stats come
+    # from lowering metadata, trace spans from host clocks the engine
+    # already owned)
+    "paddle_tpu/observability/compilestats.py",
+    "paddle_tpu/observability/tracing.py",
 )
 
 # Call terminals that force (or mark) a device->host sync.

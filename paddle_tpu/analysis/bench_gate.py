@@ -1,0 +1,155 @@
+"""Bench trajectory regression gate (opt-in ``bench`` pass).
+
+The driver commits one ``BENCH_r*.json`` per round; nothing so far
+*diffs* them — a 20% tokens/sec drop or a serving config flipping
+``valid: false`` only surfaces when a human reads the numbers.  This
+pass compares the newest two bench artifacts and fails on:
+
+- a tracked throughput/MFU metric dropping by more than the threshold
+  (relative; ``PADDLE_BENCH_THRESHOLD`` env or ``--threshold``,
+  default 5%);
+- a validity regression: a config whose ``valid`` flag flips
+  true -> false, or that newly reports ``skipped``/``error``.
+
+Deliberately **opt-in** (``tools/lint.py --passes bench`` or
+``python tools/bench_compare.py``): bench numbers move with machine
+load, so the gate belongs in the bench workflow, not in every lint run.
+Higher-is-better is assumed for every tracked metric below.
+"""
+import glob
+import json
+import os
+import re
+
+from .base import Finding
+
+__all__ = ["BenchComparePass", "bench_files", "load_bench", "compare",
+           "DEFAULT_THRESHOLD", "THRESHOLD_ENV"]
+
+DEFAULT_THRESHOLD = 0.05
+THRESHOLD_ENV = "PADDLE_BENCH_THRESHOLD"
+
+# per-config numeric fields worth gating (higher is better)
+_RATE_KEYS = ("tokens_per_sec", "images_per_sec",
+              "decode_tokens_per_sec", "useful_tokens_per_sec",
+              "engine_tokens_per_sec", "mfu", "active_mfu")
+
+
+def bench_files(root):
+    """BENCH_r*.json under ``root``, oldest first (numeric round
+    order, not lexicographic)."""
+    def round_of(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                  key=round_of)
+
+
+def load_bench(path):
+    """One bench record: handles both the raw bench.py JSON line and
+    the driver wrapper that nests it under ``parsed``."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("parsed", data)
+
+
+def _flatten(rec):
+    """{metric key: value} of everything the gate tracks."""
+    out = {}
+    if isinstance(rec.get("value"), (int, float)):
+        out[rec.get("metric", "value")] = rec["value"]
+    extra = rec.get("extra") or {}
+    if isinstance(extra.get("mfu"), (int, float)):
+        out["extra.mfu"] = extra["mfu"]
+    for name, cfg in sorted((extra.get("configs") or {}).items()):
+        if not isinstance(cfg, dict):
+            continue
+        for k in _RATE_KEYS:
+            if isinstance(cfg.get(k), (int, float)):
+                out[f"configs.{name}.{k}"] = cfg[k]
+        if "valid" in cfg:
+            out[f"configs.{name}.valid"] = bool(cfg["valid"])
+        if "skipped" in cfg or "error" in cfg:
+            out[f"configs.{name}.unavailable"] = True
+    return out
+
+
+def compare(old_rec, new_rec, threshold=None):
+    """Diff two bench records; returns a list of row dicts (every
+    tracked metric) with ``regressed`` set where the gate trips."""
+    if threshold is None:
+        threshold = float(os.environ.get(THRESHOLD_ENV,
+                                         DEFAULT_THRESHOLD))
+    old, new = _flatten(old_rec), _flatten(new_rec)
+
+    def newly_unavailable(key):
+        # "configs.<name>.<field>" whose config newly reports
+        # skipped/error — that regression is flagged once on its
+        # .unavailable row, not once per vanished numeric field
+        parts = key.split(".")
+        return len(parts) == 3 and parts[0] == "configs" and \
+            f"configs.{parts[1]}.unavailable" in new
+
+    rows = []
+    for key in sorted(set(old) | set(new)):
+        o, n = old.get(key), new.get(key)
+        row = {"key": key, "old": o, "new": n, "delta": None,
+               "regressed": False, "why": None}
+        if key.endswith(".unavailable"):
+            if n and not o:
+                row.update(regressed=True,
+                           why="config newly skipped/errored")
+        elif o is not None and n is None:
+            # a tracked metric (or whole config) vanished from the
+            # newer artifact — exactly the silent-disappearance class
+            # the gate exists for
+            if not newly_unavailable(key):
+                row.update(regressed=True,
+                           why="disappeared from the newer artifact")
+        elif key.endswith(".valid"):
+            if o is True and n is False:
+                row.update(regressed=True,
+                           why="validity flipped true -> false")
+        elif isinstance(o, (int, float)) and \
+                not isinstance(o, bool) and isinstance(n, (int, float)):
+            if o > 0:
+                delta = (n - o) / o
+                row["delta"] = round(delta, 4)
+                if delta < -threshold:
+                    row.update(regressed=True,
+                               why=f"dropped {-delta:.1%} "
+                                   f"(threshold {threshold:.0%})")
+        rows.append(row)
+    return rows
+
+
+class BenchComparePass:
+    """Opt-in lint pass: diff the repo's newest two BENCH_r*.json.
+    Needs at least two committed rounds; fewer is a clean pass (there
+    is no trajectory to regress yet)."""
+
+    name = "bench"
+    optional = True
+
+    def run(self, ctx):
+        files = bench_files(ctx.root)
+        if len(files) < 2:
+            return []
+        old_p, new_p = files[-2], files[-1]
+        rel = os.path.relpath(new_p, ctx.root).replace(os.sep, "/")
+        try:
+            rows = compare(load_bench(old_p), load_bench(new_p))
+        except (OSError, ValueError) as e:
+            return [Finding(self.name, rel, 1, "<bench>",
+                            "bench-unreadable",
+                            f"cannot diff bench artifacts: {e}", "parse")]
+        findings = []
+        for row in rows:
+            if not row["regressed"]:
+                continue
+            findings.append(Finding(
+                self.name, rel, 1, "<bench>", "bench-regression",
+                f"{row['key']}: {row['old']} -> {row['new']} "
+                f"({row['why']}) vs {os.path.basename(old_p)}",
+                row["key"]))
+        return sorted(findings, key=Finding.sort_key)

@@ -248,12 +248,23 @@ def build_grad_reducer(shapes, dtypes, cfg, axis_name, world):
     if not cfg.overlap:
         bucket_bytes = 1 << 62          # one monolithic bucket
     plan = plan_buckets(shapes, dtypes, bucket_bytes)
+    mode = cfg.quantize
+    chunk = cfg.quant_chunk
     if _obs.enabled():
         _obs.set_gauge("pt_collective_grad_buckets", len(plan.buckets))
         _obs.set_gauge("pt_collective_overlap_fraction",
                        plan.overlap_fraction)
-    mode = cfg.quantize
-    chunk = cfg.quant_chunk
+        # analytical bytes ONE step puts on the wire under this plan
+        # (static shapes + wire mode — no readback): quantized modes
+        # carry ~1 byte/element plus one fp32 scale per quant chunk;
+        # joined against compile-telemetry FLOPs by `report --roofline`
+        n_elts = sum(int(np.prod(s, dtype=np.int64) or 1)
+                     for s in shapes)
+        item = {"int8": 1, "fp8": 1, "bf16": 2}.get(mode, 4)
+        wire = n_elts * item
+        if mode in ("int8", "fp8"):
+            wire += -(-n_elts // max(chunk, 1)) * 4
+        _obs.set_gauge("pt_collective_wire_bytes_per_step", wire)
     inv_world = 1.0 / float(world)
     if mode in ("int8", "fp8"):
         reduce_vec = _build_quant_reduce(axis_name, world, chunk, mode)

@@ -126,6 +126,10 @@ EVENT_SCHEMA = {
     "serving_spec_accept": {"gamma", "proposed", "accepted",
                             "accept_rate", "mean_accept_len",
                             "verify_steps"},
+    # compile telemetry (observability/compilestats.py): a tracked jit
+    # surface compiled past its declared budget — the jit cache-miss
+    # class of perf bug, with the old-vs-new signature diff attached
+    "compile_retrace": {"surface", "compiles", "budget", "diff"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

@@ -423,6 +423,15 @@ class _CompiledStepper:
     def _shape_key(self, arrays):
         return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
 
+    @staticmethod
+    def _tracked(fn, surface):
+        """Compile-telemetry wrap (observability/compilestats.py): each
+        built executable is keyed by input shapes already, so its
+        declared compile budget is ONE — a second compile inside one
+        cache entry is a genuine retrace (dtype drift through the merge
+        paths) and raises the guardian ``compile_retrace`` sentinel."""
+        return _obs.compilestats.wrap(fn, surface, budget=1)
+
     def train_step(self, inputs, labels, update=True):
         inputs = [_to_jnp(x) for x in _as_list(inputs)]
         labels = [_to_jnp(x) for x in _as_list(labels)]
@@ -464,8 +473,10 @@ class _CompiledStepper:
         if not accumulating:
             # fused fast path: fwd+bwd+update in one executable
             if key not in self._train_cache:
-                self._train_cache[key] = self._build_train(len(inputs),
-                                                           len(labels))
+                self._train_cache[key] = self._tracked(
+                    self._build_train(len(inputs), len(labels)),
+                    "hapi.train_step_comm" if self._use_grad_comm()
+                    else "hapi.train_step")
             out = self._train_cache[key](train_vals, frozen_vals,
                                          buffer_vals, self.opt_state, lr,
                                          rng, inputs, labels)
@@ -485,7 +496,8 @@ class _CompiledStepper:
 
         # accumulation path: grads only, apply on the update step
         if key not in self._grad_cache:
-            self._grad_cache[key] = self._build_grad()
+            self._grad_cache[key] = self._tracked(self._build_grad(),
+                                                  "hapi.grad_step")
         loss, out_vals, new_buf, grads = self._grad_cache[key](
             train_vals, frozen_vals, buffer_vals, rng, inputs, labels)
         if self.guard_numerics:
@@ -510,7 +522,8 @@ class _CompiledStepper:
             k = self._accum_count
             mean_grads = [g / k for g in self._accum_grads]
             if self._apply_fn is None:
-                self._apply_fn = self._build_apply()
+                self._apply_fn = self._tracked(self._build_apply(),
+                                               "hapi.apply_step")
             new_train, new_opt = self._apply_fn(train_vals, mean_grads,
                                                 self.opt_state, lr)
             for i, v in zip(self.t_idx, new_train):
@@ -528,7 +541,8 @@ class _CompiledStepper:
                                      for a in inputs]
         key = self._shape_key(inputs)
         if key not in self._eval_cache:
-            self._eval_cache[key] = self._build_eval(len(inputs))
+            self._eval_cache[key] = self._tracked(
+                self._build_eval(len(inputs)), "hapi.eval_step")
         fn = self._eval_cache[key]
         param_vals = [p._value for p in self.params]
         buffer_vals = [b._value for b in self.buffers]
@@ -544,7 +558,8 @@ class _CompiledStepper:
         labels = [_to_jnp(x) for x in _as_list(labels)]
         key = (self._shape_key(inputs), self._shape_key(labels))
         if key not in self._grad_cache:
-            self._grad_cache[key] = self._build_grad()
+            self._grad_cache[key] = self._tracked(self._build_grad(),
+                                                  "hapi.grad_step")
         train_vals = [self.params[i]._value for i in self.t_idx]
         frozen_vals = [p._value for i, p in enumerate(self.params)
                        if i not in set(self.t_idx)]

@@ -85,6 +85,15 @@ for _qual in ("_build_paged_prefill.paged_prefill",
               "_build_paged_decode_chunk.paged_decode_chunk"):
     register_jit_surface(__name__, _qual)
 
+# compile-telemetry surface names (observability/compilestats.py) —
+# declared HERE, beside the builders, so the cost/retrace vocabulary
+# stays in sync with the registration above.  The engine wraps one
+# prefill per bucket (budget 1 each: the suffix offset is a traced
+# scalar, so one bucket legitimately owns exactly one compile) and one
+# decode chunk (budget 1: its state shapes are fixed at construction).
+PREFILL_SURFACE = "serving.paged_prefill"
+DECODE_SURFACE = "serving.paged_decode_chunk"
+
 
 class PagedCacheView(NamedTuple):
     """One layer's paged KV cache as it travels through the model's

@@ -11,6 +11,8 @@ import collections
 import itertools
 import time
 
+from ..observability import tracing as _tracing
+
 __all__ = ["Request", "FCFSScheduler"]
 
 
@@ -27,7 +29,8 @@ class Request:
                  "tokens", "submit_ns", "admit_ns", "first_token_ns",
                  "finish_ns", "finish_reason", "slot", "evictions",
                  "resume_len", "emitted_since_admit", "spec_proposed",
-                 "spec_accepted")
+                 "spec_accepted", "trace_id", "span_ns", "requeue_ns",
+                 "prefix_cached", "bucket", "decode_ms")
 
     def __init__(self, req_id, prompt, max_new_tokens, callback=None):
         self.req_id = req_id
@@ -53,6 +56,21 @@ class Request:
         # booked at the chunk-boundary sync from the validity mask
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # request-scoped tracing (observability/tracing.py): the trace
+        # id is minted HERE, at submit; span_ns is the end of the last
+        # booked span (spans tile submit -> finish), requeue_ns restarts
+        # the queue-wait clock after a page-pressure eviction, and
+        # prefix_cached/bucket carry admission metadata into the
+        # prefill span's args
+        self.trace_id = _tracing.mint(req_id)
+        self.span_ns = None
+        self.requeue_ns = None
+        self.prefix_cached = 0
+        self.bucket = None
+        # decode-phase wall accumulated across chunk-participation
+        # spans — the TPOT numerator (an evicted request's requeue
+        # wait and re-prefill must NOT inflate its per-token time)
+        self.decode_ms = 0.0
 
     @property
     def done(self):
@@ -156,5 +174,6 @@ class FCFSScheduler:
         self._free.append(slot)
         req.slot = None
         req.evictions += 1
+        req.requeue_ns = time.perf_counter_ns()
         self._queue.appendleft(req)
         return req

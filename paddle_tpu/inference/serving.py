@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as _obs
+from ..observability import tracing as _tracing
 from ..analysis import register_jit_surface
 from ..framework import guardian
 from ..models.generation import (build_apply, build_pick, cast_weights,
@@ -253,42 +254,58 @@ class ServingEngine:
                 drafter = build_ngram_drafter(sc.gamma, sc.ngram, self.MAX)
             # ONE jit each: jax specializes per (suffix, full) bucket
             # shape pair, so the per-bucket dict the non-spec paths keep
-            # would be redundant here
-            self._prefill_jit = jax.jit(
+            # would be redundant here.  Compile telemetry
+            # (observability/compilestats.py): the prefill legitimately
+            # owns one compile per (suffix, full) pair; the decode
+            # chunk's state shapes are fixed, so its budget is ONE —
+            # a second compile is the retrace sentinel's bug class
+            # (e.g. a dtype drift through refresh_weights)
+            _wrap = _obs.compilestats.wrap
+            self._prefill_jit = _wrap(jax.jit(
                 _build_spec_prefill(apply, draft_apply, pick,
                                     self._kvspec, self._draft_kvspec,
                                     self.cache_dtype, self.MAX, self.eos,
                                     self._paged, quant),
-                donate_argnums=(8, 9, 10, 11, 12, 13, 14))
-            self._decode_jit = jax.jit(
+                donate_argnums=(8, 9, 10, 11, 12, 13, 14)),
+                "serving.spec_prefill",
+                budget=len(self.buckets) ** 2)
+            self._decode_jit = _wrap(jax.jit(
                 _build_spec_decode_chunk(apply, pick, drafter,
                                          self._spec_steps, sc.gamma,
                                          self.eos, self.pad, self._paged,
                                          quant, self._model_draft),
-                donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+                donate_argnums=(2, 3, 4, 5, 6, 7, 8)),
+                "serving.spec_decode_chunk", budget=1)
         elif self._paged:
             from .kvcache import (_build_paged_prefill,
-                                  _build_paged_decode_chunk)
+                                  _build_paged_decode_chunk,
+                                  PREFILL_SURFACE, DECODE_SURFACE)
+            _wrap = _obs.compilestats.wrap
             self._prefill_jit = {
-                b: jax.jit(_build_paged_prefill(apply, pick, self.eos,
-                                                quant),
-                           donate_argnums=(6, 7, 8, 9, 10))
+                b: _wrap(jax.jit(_build_paged_prefill(apply, pick,
+                                                      self.eos, quant),
+                                 donate_argnums=(6, 7, 8, 9, 10)),
+                         PREFILL_SURFACE, budget=1)
                 for b in self.buckets}
-            self._decode_jit = jax.jit(
+            self._decode_jit = _wrap(jax.jit(
                 _build_paged_decode_chunk(apply, pick, self.chunk,
                                           self.eos, self.pad, quant),
-                donate_argnums=(1, 2, 3, 4, 5))
+                donate_argnums=(1, 2, 3, 4, 5)),
+                DECODE_SURFACE, budget=1)
         else:
+            _wrap = _obs.compilestats.wrap
             self._prefill_jit = {
-                b: jax.jit(_build_prefill(apply, pick, self._kvspec,
-                                          self.cache_dtype, self.MAX,
-                                          self.eos),
-                           donate_argnums=(5, 6, 7, 8, 9))
+                b: _wrap(jax.jit(_build_prefill(apply, pick, self._kvspec,
+                                                self.cache_dtype, self.MAX,
+                                                self.eos),
+                                 donate_argnums=(5, 6, 7, 8, 9)),
+                         "serving.prefill", budget=1)
                 for b in self.buckets}
-            self._decode_jit = jax.jit(
+            self._decode_jit = _wrap(jax.jit(
                 _build_decode_chunk(apply, pick, self.chunk, self.eos,
                                     self.pad),
-                donate_argnums=(1, 2, 3, 4, 5))
+                donate_argnums=(1, 2, 3, 4, 5)),
+                "serving.decode_chunk", budget=1)
         self.scheduler = FCFSScheduler(self.num_slots,
                                        max_prefills_per_gap)
         # MoE gates record aux loss as a side-effect attribute during
@@ -568,6 +585,10 @@ class ServingEngine:
                       pages_freed=pages,
                       resume_len=req.prompt.size + len(req.tokens),
                       queue_depth=self.scheduler.queue_depth)
+        # trace marker from the requeue stamp the scheduler just took —
+        # a host clock read between chunks, not a device sync
+        _tracing.instant(req.trace_id, req.req_id, "page_evict",
+                         req.requeue_ns, pages_freed=pages)
         return req
 
     def _page_pressure(self):
@@ -671,6 +692,7 @@ class ServingEngine:
                 n, m = int(rp.size), int(rp.size) - k
                 budget = req.max_new_tokens - len(req.tokens)
                 bucket = self._bucket_for(m)
+                req.prefix_cached = k
                 ids = np.full((1, bucket), self.pad, np.int32)
                 ids[0, :m] = rp[k:]
                 req.resume_len = n
@@ -748,6 +770,7 @@ class ServingEngine:
                                 self._tokens, self._pos, self._active,
                                 self._remaining, self._caches)
             self.stats["prefills"] += 1
+            req.bucket = bucket
             pending.append((req, slot, t0, fin0))
             guardian.emit("serving_admit", req_id=req.req_id, slot=slot,
                           queue_depth=self.scheduler.queue_depth,
@@ -831,6 +854,7 @@ class ServingEngine:
                     emitted.setdefault(int(slot), []).append(
                         int(toks_h[s, slot]))
         finished = []
+        admitted_slots = {slot for _, slot, _, _ in pending}
         for slot, toks_slot in sorted(emitted.items()):
             req = self.scheduler.active[slot]
             req.tokens.extend(toks_slot)
@@ -843,12 +867,47 @@ class ServingEngine:
             self.stats["decoded_tokens"] += len(toks_slot)
             _obs.inc("pt_serving_decoded_tokens_total", len(toks_slot))
             done = req.finish_reason is not None
+            # request-scoped trace spans, booked from host stamps the
+            # engine already owns (scheduler clocks + THIS sync's
+            # ``now``): queue_wait + prefill for this cycle's
+            # admissions, one decode span per chunk participation —
+            # per request they tile submit -> finish exactly
+            if _obs.enabled():
+                if slot in admitted_slots:
+                    _tracing.span(req.trace_id, req.req_id, "queue_wait",
+                                  req.requeue_ns or req.submit_ns,
+                                  req.admit_ns,
+                                  resume=req.evictions > 0)
+                    _tracing.span(req.trace_id, req.req_id, "prefill",
+                                  req.admit_ns, now, bucket=req.bucket,
+                                  cached_tokens=req.prefix_cached,
+                                  resume=req.evictions > 0,
+                                  tokens=len(toks_slot),
+                                  reason=req.finish_reason)
+                else:
+                    start = req.span_ns or req.admit_ns
+                    _tracing.span(req.trace_id, req.req_id,
+                                  "spec_decode" if self._spec is not None
+                                  else "decode",
+                                  start, now,
+                                  tokens=len(toks_slot),
+                                  reason=req.finish_reason)
+                    req.decode_ms += (now - start) / 1e6
+                req.span_ns = now
             if req.callback is not None:
                 for i, tok in enumerate(toks_slot):
                     req.callback(req, tok,
                                  done and i == len(toks_slot) - 1)
             if done:
                 req.finish_ns = now
+                # TPOT = decode-phase span time per token after the
+                # first (the catalog contract; same numerator as
+                # `report --requests`) — NOT wall since first token,
+                # which would fold an evicted request's requeue wait
+                # and re-prefill into its per-token time
+                _tracing.finish(
+                    req.decode_ms / (len(req.tokens) - 1)
+                    if len(req.tokens) > 1 else None)
                 self.scheduler.release(slot)
                 if self._paged:
                     self._kv.release(slot)

@@ -58,6 +58,7 @@ import jax.numpy as jnp
 
 from ..analysis import register_jit_surface
 from ..framework.core import Tensor
+from ..observability import compilestats as _cstats
 
 __all__ = ["SpecConfig", "speculative_generate"]
 
@@ -475,7 +476,10 @@ def speculative_generate(model, input_ids, max_new_tokens=32,
     jit_cache = _caches_for(model)["jit"]
     fn = jit_cache.get(sig)
     if fn is None:
-        fn = jit_cache[sig] = jax.jit(spec_run)
+        # compile telemetry: the cache key above already pins every
+        # shape-relevant knob, so one entry owns exactly one compile
+        fn = jit_cache[sig] = _cstats.wrap(
+            jax.jit(spec_run), "speculative.generate", budget=1)
     hist0 = jnp.full((B, MAX), pad, jnp.int32).at[:, :P].set(
         jnp.asarray(ids_np))
     was_training = model.training

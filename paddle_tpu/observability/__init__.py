@@ -18,7 +18,14 @@ fourth piece that makes them ONE picture:
 - :mod:`.timeline` — the merged chrome trace overlaying metric samples
   and guardian events onto the profiler's host spans on one clock;
 - :mod:`.report` — ``python -m paddle_tpu.observability report``
-  renders a run summary from the sinks.
+  renders a run summary from the sinks (``--roofline`` joins compile
+  telemetry with measured latency; ``--requests`` summarizes the
+  per-request lanes);
+- :mod:`.compilestats` — compile telemetry per jit surface (analytical
+  FLOPs/bytes/footprint from the lowering, compile counts + wall, the
+  ``compile_retrace`` guardian sentinel on budget overrun);
+- :mod:`.tracing` — request-scoped serving traces booked at the
+  engine's existing chunk-boundary sync.
 
 THE design constraint (machine-checked: this package sits in
 ``analysis.allowlist.MONITORED_MODULES``, and the instrumented call
@@ -42,12 +49,17 @@ from .metrics import (    # noqa: F401
     DEFAULT_BUCKETS,
 )
 from .catalog import METRICS    # noqa: F401
+# compile telemetry + request tracing (ISSUE 10): both import-light
+# (stdlib + the metrics registry; jax is touched lazily on use)
+from . import compilestats     # noqa: F401
+from . import tracing          # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "inc", "observe", "set_gauge", "enabled", "enable", "disabled",
     "start_capture", "stop_capture", "capture_active", "samples",
     "clock_pair", "DEFAULT_BUCKETS", "METRICS", "main",
+    "compilestats", "tracing",
 ]
 
 

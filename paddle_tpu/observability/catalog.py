@@ -122,6 +122,50 @@ METRICS = {
         "type": _C, "labels": (),
         "help": "prompt tokens NOT re-prefilled thanks to prefix-cache "
                 "hits (prefill FLOPs saved is proportional)"},
+    # -- compile telemetry (observability/compilestats.py) ----------------
+    "pt_compile_compiles_total": {
+        "type": _C, "labels": ("surface",),
+        "help": "distinct-signature compiles per tracked jit surface "
+                "(one AOT lower+compile each)"},
+    "pt_compile_wall_ms": {
+        "type": _H, "labels": ("surface",),
+        "help": "trace+lower+compile wall time per compile (host work "
+                "jax does anyway, measured at the wrapper)"},
+    "pt_compile_flops": {
+        "type": _G, "labels": ("surface",),
+        "help": "analytical FLOPs of ONE dispatch from the lowering's "
+                "cost_analysis (last compiled signature)"},
+    "pt_compile_bytes_accessed": {
+        "type": _G, "labels": ("surface",),
+        "help": "analytical bytes accessed per dispatch from "
+                "cost_analysis (last compiled signature)"},
+    "pt_compile_memory_bytes": {
+        "type": _G, "labels": ("surface",),
+        "help": "executable memory footprint (argument + output + temp "
+                "bytes from memory_analysis; last compiled signature)"},
+    "pt_compile_retraces_total": {
+        "type": _C, "labels": ("surface",),
+        "help": "compiles past the surface's declared budget — each "
+                "one also raised a guardian compile_retrace event"},
+    "pt_compile_dispatch_ms": {
+        "type": _H, "labels": ("surface",),
+        "help": "measured wall time of ONE dispatch of this surface, "
+                "recorded where a latency-clean measurement exists "
+                "(bench steady-state loops) — the roofline join's "
+                "measured half"},
+    # -- request tracing (observability/tracing.py) -----------------------
+    "pt_trace_requests_total": {
+        "type": _C, "labels": (),
+        "help": "serving requests whose trace reached finish"},
+    "pt_trace_spans_total": {
+        "type": _C, "labels": ("phase",),
+        "help": "request-trace spans booked, by lifecycle phase: "
+                "queue_wait | prefill | decode | spec_decode | "
+                "page_evict"},
+    "pt_trace_tpot_ms": {
+        "type": _H, "labels": (),
+        "help": "time per output token after the first (decode-phase "
+                "span time / (tokens - 1)), booked at request finish"},
     # -- collectives (distributed/collective.py) --------------------------
     "pt_collective_calls_total": {
         "type": _C, "labels": ("op",),
@@ -145,6 +189,11 @@ METRICS = {
         "help": "byte share of grad buckets whose all-reduce can hide "
                 "under remaining backward compute (structural, from "
                 "the bucket plan — everything but the final bucket)"},
+    "pt_collective_wire_bytes_per_step": {
+        "type": _G, "labels": (),
+        "help": "analytical bytes one step's gradient reduction puts "
+                "on the wire under the current grad_comm plan (static "
+                "shapes + wire mode; roofline comm input)"},
     # -- TCPStore client (distributed/store.py) ---------------------------
     "pt_store_ops_total": {
         "type": _C, "labels": ("op",),

@@ -1,0 +1,273 @@
+"""Compile telemetry: what every jit surface *costs*, and when it
+silently recompiled.
+
+The PR 5 telemetry layer sees the runtime — step latency, chunk counts,
+wire bytes — but nothing about the compiled surfaces themselves: how
+often a surface compiled, what one dispatch of it analytically costs
+(FLOPs, bytes accessed, HBM footprint), or that a shape/dtype drift
+quietly retraced a hot executable (the jit cache-miss class of perf bug:
+a minority-dtype param slipping through ``refresh_weights`` retraces the
+whole decode program; a non-bucketed prompt length compiles a prefill
+per request).  This module closes that gap:
+
+- :func:`wrap` takes an already-``jax.jit``-ed callable and a *surface*
+  name (matching the ``analysis.jit_surface`` registry vocabulary) and
+  returns a :class:`CompiledSurface` that owns the executable cache per
+  shape signature.  On a signature's first call it lowers once, records
+  the lowering's ``cost_analysis()`` (FLOPs / bytes accessed) and the
+  compiled ``memory_analysis()`` footprint plus the compile wall time,
+  then calls the AOT executable — ONE compile per signature, same
+  lowering pipeline, bitwise-identical outputs;
+- every record lands in the ``pt_compile_*`` metrics (labels:
+  ``surface``) and in a module registry :func:`snapshot` the roofline
+  view joins against measured latency (``report --roofline``,
+  ``telemetry/roofline.json``);
+- the **retrace sentinel**: each wrapper declares a compile *budget* —
+  the number of distinct signatures the surface legitimately needs in
+  its lifetime (1 for a chunked decode loop; ``len(buckets)`` for a
+  bucket-compiled prefill family).  Compiling past the budget emits the
+  guardian ``compile_retrace`` event carrying the old-vs-new signature
+  diff, turning silent recompilation into a machine-checked event.
+
+Zero new host syncs: everything here is host-side bookkeeping around
+the dispatch (trace/lower/compile are host work jax does anyway); no
+device value is ever read back.  The module sits in
+``analysis.allowlist.MONITORED_MODULES`` with zero budgeted sync
+entries, and the PR 5 A/B device-transfer test is extended to cover it
+(``tests/test_compile_tracing.py``).
+
+The grad_comm reducer closures have no executable of their own — they
+are traced *into* the ``hapi.train_step_comm`` stepper, so their cost
+shows up in that surface's row.
+"""
+import threading
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["wrap", "CompiledSurface", "signature", "signature_diff",
+           "snapshot", "reset", "surfaces"]
+
+
+# -- shape signatures -------------------------------------------------------
+
+def signature(args):
+    """Canonical (hashable) shape/dtype signature of one positional
+    argument tuple: array leaves become ``(shape, dtype, weak)``
+    triples, scalars keep their python type, and the (hashable)
+    pytree treedef rides along so a ``None``-vs-array cache split is
+    part of the key (mirroring jax's own dispatch key closely enough
+    that one signature == one executable)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for x in leaves:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype),
+                        bool(getattr(x, "weak_type", False))))
+        else:
+            sig.append((type(x).__name__,))
+    return (treedef, tuple(sig))
+
+
+def _fmt_leaf(leaf):
+    if len(leaf) == 1:
+        return leaf[0]
+    shape, dtype, weak = leaf
+    return f"{dtype}[{','.join(str(d) for d in shape)}]" + \
+        ("~" if weak else "")
+
+
+def signature_diff(old, new):
+    """Human-readable old-vs-new diff for the retrace event: leaf
+    positions whose shape/dtype changed, plus a structure note when the
+    pytrees differ."""
+    if old is None:
+        return "first compile"
+    parts = []
+    if old[0] != new[0]:
+        parts.append("pytree structure changed")
+    o, n = old[1], new[1]
+    if len(o) != len(n):
+        parts.append(f"leaf count {len(o)} -> {len(n)}")
+    for i, (a, b) in enumerate(zip(o, n)):
+        if a != b:
+            parts.append(f"arg[{i}]: {_fmt_leaf(a)} -> {_fmt_leaf(b)}")
+    return "; ".join(parts[:8]) if parts else "identical signature"
+
+
+# -- module registry --------------------------------------------------------
+#
+# Per-surface cumulative stats, independent of wrapper lifetimes (an
+# engine rebuild makes a fresh CompiledSurface, but the surface's cost
+# story is one story).  Budget enforcement is deliberately
+# per-*wrapper*: a rebuilt engine legitimately re-pays its compiles,
+# while one wrapper compiling twice IS the retrace bug.
+
+_LOCK = threading.Lock()
+_SURFACES = {}     # surface -> {"compiles", "retraces", "wall_ms",
+#                                "sigs": {sig: rec}, "last": rec}
+
+
+def _record(surface, sig, wall_ms, cost, mem):
+    rec = {"signature": [_fmt_leaf(l) for l in sig[1]],
+           "compile_ms": round(wall_ms, 3),
+           "flops": cost.get("flops") if cost else None,
+           "bytes_accessed": cost.get("bytes accessed") if cost else None,
+           "memory_bytes": mem}
+    with _LOCK:
+        st = _SURFACES.setdefault(
+            surface, {"compiles": 0, "retraces": 0, "wall_ms": 0.0,
+                      "sigs": {}, "last": None})
+        st["compiles"] += 1
+        st["wall_ms"] += wall_ms
+        st["sigs"][sig] = rec
+        st["last"] = rec
+    if _metrics.enabled():
+        _metrics.inc("pt_compile_compiles_total", surface=surface)
+        _metrics.observe("pt_compile_wall_ms", wall_ms, surface=surface)
+        if rec["flops"] is not None:
+            _metrics.set_gauge("pt_compile_flops", rec["flops"],
+                               surface=surface)
+        if rec["bytes_accessed"] is not None:
+            _metrics.set_gauge("pt_compile_bytes_accessed",
+                               rec["bytes_accessed"], surface=surface)
+        if mem is not None:
+            _metrics.set_gauge("pt_compile_memory_bytes", mem,
+                               surface=surface)
+    return rec
+
+
+def surfaces():
+    """Names of every surface that compiled at least once."""
+    with _LOCK:
+        return sorted(_SURFACES)
+
+
+def snapshot():
+    """Per-surface cumulative compile stats (the roofline view's
+    analytical half): ``{surface: {compiles, retraces, wall_ms,
+    signatures, flops, bytes_accessed, memory_bytes}}`` where the cost
+    numbers are the LAST compiled signature's (documented: a
+    multi-signature family reports its most recent member)."""
+    out = {}
+    with _LOCK:
+        for name, st in sorted(_SURFACES.items()):
+            last = st["last"] or {}
+            out[name] = {
+                "compiles": st["compiles"],
+                "retraces": st["retraces"],
+                "compile_wall_ms": round(st["wall_ms"], 3),
+                "signatures": len(st["sigs"]),
+                "flops": last.get("flops"),
+                "bytes_accessed": last.get("bytes_accessed"),
+                "memory_bytes": last.get("memory_bytes"),
+            }
+    return out
+
+
+def reset():
+    """Drop all per-surface stats (test isolation / bench per-run
+    snapshots).  Wrapper-local executable caches are untouched —
+    compiled programs stay warm."""
+    with _LOCK:
+        _SURFACES.clear()
+
+
+def _count_retrace(surface):
+    with _LOCK:
+        st = _SURFACES.get(surface)
+        if st is not None:
+            st["retraces"] += 1
+    if _metrics.enabled():
+        _metrics.inc("pt_compile_retraces_total", surface=surface)
+
+
+# -- the wrapper ------------------------------------------------------------
+
+class CompiledSurface:
+    """Owns the per-signature executable cache for one jit surface.
+
+    Calling it with a new signature lowers + compiles once (recording
+    cost/memory analysis and compile wall time), then dispatches the
+    AOT executable; a cached signature goes straight to its executable.
+    If the AOT path fails for a signature (axon/backend quirk), the
+    wrapper permanently falls back to the underlying jitted callable
+    for that signature — telemetry degrades, behavior never does.
+    """
+
+    def __init__(self, fn, surface, budget=None):
+        self._fn = fn
+        self.surface = surface
+        self.budget = budget
+        self._cache = {}       # sig -> callable (AOT compiled or fn)
+        self._last_sig = None
+        self._lock = threading.Lock()
+
+    @property
+    def compiles(self):
+        return len(self._cache)
+
+    def __call__(self, *args):
+        sig = signature(args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._compile(sig, args)
+        return entry(*args)
+
+    def _compile(self, sig, args):
+        with self._lock:
+            entry = self._cache.get(sig)
+            if entry is not None:
+                return entry
+            t0 = time.perf_counter()
+            cost = mem = None
+            try:
+                lowered = self._fn.lower(*args)
+                try:
+                    ca = lowered.cost_analysis()
+                    cost = ca[0] if isinstance(ca, (list, tuple)) else ca
+                except Exception:
+                    cost = None
+                compiled = lowered.compile()
+                try:
+                    ma = compiled.memory_analysis()
+                    mem = int(ma.argument_size_in_bytes +
+                              ma.output_size_in_bytes +
+                              ma.temp_size_in_bytes)
+                except Exception:
+                    mem = None
+                entry = compiled
+            except Exception:
+                # AOT unavailable for this call shape: the normal jit
+                # dispatch path compiles instead (still one compile —
+                # the wall time below covers neither, so record 0-cost)
+                entry = self._fn
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            _record(self.surface, sig, wall_ms, cost, mem)
+            n = len(self._cache) + 1
+            if self.budget is not None and n > self.budget:
+                self._retrace(sig, n)
+            self._last_sig = sig
+            self._cache[sig] = entry
+            return entry
+
+    def _retrace(self, sig, n):
+        diff = signature_diff(self._last_sig, sig)
+        _count_retrace(self.surface)
+        from ..framework import guardian
+        guardian.emit("compile_retrace", surface=self.surface,
+                      compiles=n, budget=self.budget, diff=diff)
+
+
+def wrap(fn, surface, budget=None):
+    """Wrap an already-jitted callable as a tracked
+    :class:`CompiledSurface`.  ``surface`` names the jit surface (the
+    ``analysis`` registry vocabulary: ``hapi.train_step``,
+    ``serving.decode_chunk``, ...); ``budget`` is the declared number
+    of legitimate compiles for this wrapper's lifetime (None = no
+    retrace sentinel, count-only)."""
+    return CompiledSurface(fn, surface, budget=budget)

@@ -127,11 +127,25 @@ def snapshot(registry=None, run=None):
     return out
 
 
-def write_jsonl(path=None, registry=None, run=None):
+def write_jsonl(path=None, registry=None, run=None, replace_run=False):
     """Append one snapshot (one JSON line per series) to ``path``, or
     to ``$PADDLE_METRICS_LOG`` when ``path`` is None — the guardian-log
     sink pattern.  Returns the path written, or None when no sink is
-    configured."""
+    configured.
+
+    ``replace_run=True`` (needs ``run``) makes the write idempotent per
+    run id: existing records carrying the same ``run`` are dropped
+    before the new snapshot lands (atomic rewrite), while records of
+    *other* runs — and unparseable lines — survive untouched.  This is
+    how bench keeps ``telemetry/<tag>.jsonl`` from re-appending one
+    snapshot per invocation (the PR 7–8 duplicate-commit churn).
+
+    Use ``replace_run`` only on files this process owns (bench's
+    per-tag snapshots): the read-rewrite-replace cycle races a
+    concurrent appender, and after the replace a live writer's open
+    fd still points at the unlinked old inode — a long-lived
+    ``PADDLE_METRICS_LOG`` sink must stick to the append path.
+    """
     path = path or os.environ.get(JSONL_ENV)
     if not path:
         return None
@@ -139,6 +153,28 @@ def write_jsonl(path=None, registry=None, run=None):
     if d:
         os.makedirs(d, exist_ok=True)
     recs = snapshot(registry, run=run)
+    if replace_run and run is not None and os.path.exists(path):
+        kept = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    if isinstance(rec, dict) and \
+                            rec.get("run") == str(run):
+                        continue
+                except ValueError:
+                    pass        # torn tail: keep, never destroy data
+                kept.append(line.rstrip("\n"))
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for line in kept:
+                f.write(line + "\n")
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+        os.replace(tmp, path)
+        return path
     with open(path, "a", encoding="utf-8") as f:
         for rec in recs:
             f.write(json.dumps(rec) + "\n")

@@ -6,15 +6,50 @@ human-readable run summary: counters and gauges grouped by subsystem,
 histograms with count / mean / estimated p50/p90/p99 (linear
 interpolation inside the winning bucket), trace-event totals.
 
-The parsers are deliberately self-contained (stdlib only): the report
-must run against files produced by an earlier process, a different
-machine, or a BENCH_* artifact — never against live registry state.
+Two focused subviews (ISSUE 10):
+
+- ``report --roofline --prom <file>`` — join the compile-telemetry
+  analytical costs (``pt_compile_flops`` / ``pt_compile_bytes_accessed``
+  per surface) with measured step latency and the grad_comm wire-bytes
+  gauge into a per-surface roofline table: arithmetic intensity, the
+  compute/memory roofline time at the given ``--peak-flops`` /
+  ``--hbm-bw``, which roof binds, and — where a measured latency
+  exists — the step-time attribution across compute / memory /
+  dispatch+other (the artifact the MFU-plateau roadmap item asks for;
+  bench runs commit it as ``telemetry/roofline.json``);
+- ``report --requests --trace <file>`` — fold the per-request lanes of
+  a merged chrome trace back into request summaries: TTFT/TPOT
+  percentiles plus the mean per-phase breakdown of the slowest-TTFT
+  decile (where the tail's time went).
+
+Both support ``--json``.  The parsers are deliberately self-contained
+(stdlib only): the report must run against files produced by an earlier
+process, a different machine, or a BENCH_* artifact — never against
+live registry state.
 """
 import argparse
 import json
 import sys
 
-__all__ = ["parse_prometheus", "parse_jsonl", "render_report", "main"]
+__all__ = ["parse_prometheus", "parse_jsonl", "render_report",
+           "roofline_from_stats", "compile_stats_from_prom",
+           "roofline_view", "requests_view", "request_rows_from_trace",
+           "main"]
+
+# defaults for the roofline roofs: TPU v5e bf16 peak and HBM bandwidth
+DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_HBM_BW = 819e9
+
+# fallback join for surfaces whose measured latency the sinks already
+# carry: the hapi steppers map onto the step-latency histogram (one
+# fit step == one dispatch of that surface).  The primary join is the
+# per-surface pt_compile_dispatch_ms histogram — the bench scan-chained
+# stepper runs K inner steps per dispatch, so the step histogram would
+# be K-off for it.
+_MEASURED_LATENCY = {
+    "hapi.train_step": "pt_train_step_latency_ms",
+    "hapi.train_step_comm": "pt_train_step_latency_ms",
+}
 
 
 # -- parsers ---------------------------------------------------------------
@@ -215,6 +250,271 @@ def render_report(prom=None, jsonl=None, trace=None):
     return "\n".join(lines)
 
 
+# -- roofline view ---------------------------------------------------------
+
+def roofline_from_stats(stats, measured_ms=None, peak_flops=None,
+                        hbm_bw=None, wire_bytes=None):
+    """Per-surface roofline/attribution rows from compile-telemetry
+    stats (``compilestats.snapshot()`` shape, or the same rebuilt from
+    a prom file).  ``measured_ms`` maps surface -> measured wall ms per
+    dispatch; rows with a measured number get the step-time attribution
+    across compute / memory / dispatch+other and an analytical MFU.
+
+    The attribution is a PARTITION of the measured step (fractions sum
+    to 1): the binding roof takes its analytical share, the non-binding
+    roof is reported as 0 — in the roofline model its traffic hides
+    under the binding resource (its analytical ms stays in its own
+    ``compute_ms``/``memory_ms`` column) — and ``dispatch_other_frac``
+    is the residual above the roof."""
+    peak_flops = peak_flops or DEFAULT_PEAK_FLOPS
+    hbm_bw = hbm_bw or DEFAULT_HBM_BW
+    measured_ms = measured_ms or {}
+    rows = []
+    for surface, st in sorted(stats.items()):
+        flops = st.get("flops")
+        bytes_ = st.get("bytes_accessed")
+        row = {"surface": surface,
+               "compiles": st.get("compiles"),
+               "retraces": st.get("retraces"),
+               "flops": flops, "bytes_accessed": bytes_,
+               "memory_bytes": st.get("memory_bytes"),
+               "intensity_flop_per_byte":
+                   round(flops / bytes_, 3) if flops and bytes_ else None}
+        t_c = flops / peak_flops * 1e3 if flops else None
+        t_m = bytes_ / hbm_bw * 1e3 if bytes_ else None
+        row["compute_ms"] = round(t_c, 6) if t_c is not None else None
+        row["memory_ms"] = round(t_m, 6) if t_m is not None else None
+        roof = max(t_c or 0.0, t_m or 0.0) or None
+        row["roofline_ms"] = round(roof, 6) if roof else None
+        row["bound"] = None if roof is None else (
+            "compute" if (t_c or 0.0) >= (t_m or 0.0) else "memory")
+        meas = measured_ms.get(surface)
+        row["measured_ms"] = round(meas, 3) if meas else None
+        if meas and roof:
+            bound_c = row["bound"] == "compute"
+            # measured below the analytical roof (timing noise, or a
+            # wrong peak) clamps to an all-roof split rather than >100%
+            roof_frac = min(roof / meas, 1.0)
+            row["attribution"] = {
+                "compute_frac": round(roof_frac if bound_c else 0.0, 4),
+                "memory_frac": round(0.0 if bound_c else roof_frac, 4),
+                "dispatch_other_frac": round(1.0 - roof_frac, 4)}
+            row["mfu"] = round(flops / (meas * 1e-3) / peak_flops, 4) \
+                if flops else None
+        else:
+            row["attribution"] = None
+            row["mfu"] = None
+        rows.append(row)
+    return {"peak_flops": peak_flops, "hbm_bw_bytes_per_s": hbm_bw,
+            "wire_bytes_per_step": wire_bytes, "rows": rows}
+
+
+def _series_value(metrics, name, **want):
+    m = metrics.get(name)
+    if not m:
+        return None
+    key = tuple(sorted(want.items()))
+    return m["series"].get(key)
+
+
+def compile_stats_from_prom(metrics):
+    """Rebuild the ``compilestats.snapshot()`` shape from a parsed
+    prom exposition (the ``pt_compile_*`` series)."""
+    stats = {}
+
+    def fold(metric, field):
+        m = metrics.get(metric)
+        if not m:
+            return
+        for key, value in m["series"].items():
+            labels = dict(k for k in key if k[0] != "__sample__")
+            surface = labels.get("surface")
+            if surface is None or "__sample__" in dict(key):
+                continue
+            stats.setdefault(surface, {})[field] = value
+
+    fold("pt_compile_flops", "flops")
+    fold("pt_compile_bytes_accessed", "bytes_accessed")
+    fold("pt_compile_memory_bytes", "memory_bytes")
+    fold("pt_compile_compiles_total", "compiles")
+    fold("pt_compile_retraces_total", "retraces")
+    return stats
+
+
+def measured_from_prom(metrics):
+    """surface -> measured ms per dispatch: the per-surface
+    ``pt_compile_dispatch_ms`` histogram mean first, then the hapi
+    step-latency fallback for surfaces it does not cover."""
+    out = {}
+    m = metrics.get("pt_compile_dispatch_ms")
+    if m:
+        sums, counts = {}, {}
+        for key, value in m["series"].items():
+            kd = dict(key)
+            suf = kd.pop("__sample__", None)
+            surface = kd.get("surface")
+            if surface is None:
+                continue
+            if suf == "_sum":
+                sums[surface] = value
+            elif suf == "_count":
+                counts[surface] = value
+        for s, total in sums.items():
+            if counts.get(s):
+                out[s] = total / counts[s]
+    for surface, hist in _MEASURED_LATENCY.items():
+        if surface in out:
+            continue
+        m = metrics.get(hist)
+        if not m:
+            continue
+        count = m["series"].get((("__sample__", "_count"),))
+        total = m["series"].get((("__sample__", "_sum"),))
+        if count:
+            out[surface] = total / count
+    return out
+
+
+def roofline_view(prom, peak_flops=None, hbm_bw=None):
+    """Build the roofline table from one prom exposition file."""
+    metrics = parse_prometheus(prom)
+    stats = compile_stats_from_prom(metrics)
+    wire = _series_value(metrics, "pt_collective_wire_bytes_per_step")
+    return roofline_from_stats(stats, measured_from_prom(metrics),
+                               peak_flops, hbm_bw, wire_bytes=wire)
+
+
+def _fmt_num(v):
+    if v is None:
+        return "-"
+    if v == 0:
+        return "0"
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000:
+            return f"{v:.3g}{unit}"
+        v /= 1000.0
+    return f"{v:.3g}E"
+
+
+def render_roofline(table):
+    lines = ["== roofline / MFU attribution ==",
+             f"peak_flops={_fmt_num(table['peak_flops'])}  "
+             f"hbm_bw={_fmt_num(table['hbm_bw_bytes_per_s'])}B/s"
+             + (f"  wire_bytes/step="
+                f"{_fmt_num(table['wire_bytes_per_step'])}"
+                if table.get("wire_bytes_per_step") else "")]
+    hdr = (f"{'surface':<28} {'flops':>8} {'bytes':>8} {'int.':>7} "
+           f"{'bound':>7} {'roof_ms':>9} {'meas_ms':>9} {'mfu':>6}  "
+           "attribution c/m/d")
+    lines.append(hdr)
+    for r in table["rows"]:
+        att = r["attribution"]
+        att_s = "-" if not att else (
+            f"{att['compute_frac']:.0%}/{att['memory_frac']:.0%}/"
+            f"{att['dispatch_other_frac']:.0%}")
+        mfu_s = f"{r['mfu']:.3f}" if r["mfu"] is not None else "-"
+        lines.append(
+            f"{r['surface']:<28} {_fmt_num(r['flops']):>8} "
+            f"{_fmt_num(r['bytes_accessed']):>8} "
+            f"{_fmt_num(r['intensity_flop_per_byte']):>7} "
+            f"{(r['bound'] or '-'):>7} "
+            f"{_fmt_num(r['roofline_ms']):>9} "
+            f"{_fmt_num(r['measured_ms']):>9} "
+            f"{mfu_s:>6}  {att_s}")
+    if not table["rows"]:
+        lines.append("(no pt_compile_* series in this exposition — run "
+                     "with compile telemetry wired, e.g. bench.py)")
+    return "\n".join(lines)
+
+
+# -- requests view ---------------------------------------------------------
+
+def request_rows_from_trace(path):
+    """Fold a merged chrome trace's per-request lanes (``cat:
+    "request"``) back into one summary per trace id (the
+    ``tracing.request_summaries`` shape)."""
+    with open(path, encoding="utf-8") as f:
+        events = json.load(f).get("traceEvents", [])
+    span_list = []
+    for e in events:
+        if e.get("cat") != "request":
+            continue
+        args = e.get("args", {})
+        start_ns = int(e["ts"] * 1e3)
+        end_ns = start_ns + int(e.get("dur", 0) * 1e3)
+        span_list.append({
+            "trace": args.get("trace", f"tid{e.get('tid')}"),
+            "req_id": args.get("req_id"),
+            "phase": args.get("phase", e.get("name")),
+            "start_ns": start_ns, "end_ns": end_ns,
+            "args": args})
+    from . import tracing as _tracing
+    return _tracing.request_summaries(span_list)
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return round(sorted_vals[lo] +
+                 (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo), 3)
+
+
+def requests_view(rows):
+    """TTFT/TPOT percentiles + the tail's per-phase attribution (mean
+    phase breakdown of the slowest-TTFT decile)."""
+    ttfts = sorted(r["ttft_ms"] for r in rows if r["ttft_ms"] is not None)
+    tpots = sorted(r["tpot_ms"] for r in rows if r["tpot_ms"] is not None)
+    out = {"requests": len(rows),
+           "evictions": sum(r["evictions"] for r in rows),
+           "tokens": sum(r["tokens"] for r in rows),
+           "ttft_ms": {f"p{int(q * 100)}": _percentile(ttfts, q)
+                       for q in (0.5, 0.9, 0.99)},
+           "tpot_ms": {f"p{int(q * 100)}": _percentile(tpots, q)
+                       for q in (0.5, 0.9, 0.99)}}
+    p90 = _percentile(ttfts, 0.9)
+    tail = [r for r in rows
+            if r["ttft_ms"] is not None and p90 is not None
+            and r["ttft_ms"] >= p90] or rows
+    phases = {}
+    for r in tail:
+        for ph, ms in r["phase_ms"].items():
+            phases[ph] = phases.get(ph, 0.0) + ms
+    out["tail_requests"] = len(tail)
+    out["tail_phase_ms_mean"] = {
+        ph: round(ms / len(tail), 3) for ph, ms in sorted(phases.items())}
+    return out
+
+
+def render_requests(summary, rows):
+    lines = ["== per-request serving traces ==",
+             f"requests={summary['requests']} "
+             f"tokens={summary['tokens']} "
+             f"evictions={summary['evictions']}"]
+    for name in ("ttft_ms", "tpot_ms"):
+        qs = summary[name]
+        lines.append("  " + name + "  " + "  ".join(
+            f"{k}={v if v is not None else '-'}"
+            for k, v in qs.items()))
+    lines.append(f"  tail (slowest-TTFT decile, "
+                 f"{summary['tail_requests']} req) mean phase ms: "
+                 + ", ".join(f"{k}={v}" for k, v in
+                             summary["tail_phase_ms_mean"].items()))
+    for r in rows[:32]:
+        lines.append(
+            f"  {r['trace']:<12} req={r['req_id']} "
+            f"total={r['total_ms']:.1f}ms ttft={r['ttft_ms']}ms "
+            f"tpot={r['tpot_ms'] if r['tpot_ms'] is not None else '-'}"
+            f"ms tokens={r['tokens']} "
+            + " ".join(f"{k}={v}" for k, v in r["phase_ms"].items())
+            + (f" evictions={r['evictions']}" if r["evictions"] else ""))
+    if len(rows) > 32:
+        lines.append(f"  ... {len(rows) - 32} more")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability",
@@ -230,15 +530,57 @@ def main(argv=None):
                     help="JSONL metrics log (PADDLE_METRICS_LOG format)")
     rp.add_argument("--trace", default=None,
                     help="merged chrome-trace JSON (timeline.py)")
+    rp.add_argument("--roofline", action="store_true",
+                    help="per-surface roofline/MFU-attribution table "
+                         "from the --prom file's pt_compile_* series")
+    rp.add_argument("--requests", action="store_true",
+                    help="per-request TTFT/TPOT summary from the "
+                         "--trace file's request lanes")
+    rp.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the subview as JSON (with --roofline / "
+                         "--requests)")
+    rp.add_argument("--peak-flops", type=float,
+                    default=DEFAULT_PEAK_FLOPS,
+                    help="compute roof (FLOP/s) for --roofline "
+                         "(default: TPU v5e bf16 peak)")
+    rp.add_argument("--hbm-bw", type=float, default=DEFAULT_HBM_BW,
+                    help="memory roof (bytes/s) for --roofline "
+                         "(default: TPU v5e HBM)")
     args = ap.parse_args(argv)
     if args.cmd != "report":
         ap.print_help()
+        return 2
+    if args.roofline and not args.prom:
+        print("error: --roofline needs --prom", file=sys.stderr)
+        return 2
+    if args.requests and not args.trace:
+        print("error: --requests needs --trace", file=sys.stderr)
         return 2
     if not (args.prom or args.jsonl or args.trace):
         print("error: pass at least one of --prom/--jsonl/--trace",
               file=sys.stderr)
         return 2
     try:
+        if args.roofline or args.requests:
+            out = {}
+            if args.roofline:
+                table = roofline_view(args.prom, args.peak_flops,
+                                      args.hbm_bw)
+                if args.as_json:
+                    out["roofline"] = table
+                else:
+                    print(render_roofline(table))
+            if args.requests:
+                rows = request_rows_from_trace(args.trace)
+                summary = requests_view(rows)
+                if args.as_json:
+                    out["requests"] = {"summary": summary,
+                                       "per_request": rows}
+                else:
+                    print(render_requests(summary, rows))
+            if args.as_json:
+                print(json.dumps(out, indent=1, sort_keys=True))
+            return 0
         print(render_report(prom=args.prom, jsonl=args.jsonl,
                             trace=args.trace))
     except (OSError, ValueError) as e:

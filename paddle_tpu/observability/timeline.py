@@ -20,18 +20,23 @@ Event mapping:
 - host spans  -> ``"ph": "X"`` duration events (tid 0, the span track)
 - guardian    -> ``"ph": "i"`` instants (tid 1, full args attached)
 - samples     -> ``"ph": "C"`` counters (one track per metric+labels)
+- request traces (``tracing.py``) -> one LANE per request (tid 100+,
+  named by trace id): ``"X"`` spans for queue_wait/prefill/decode,
+  ``"i"`` instants for page evictions — already on the perf clock.
 """
 import json
 import os
 import time
 
 from . import metrics as _metrics
+from . import tracing as _tracing
 
 __all__ = ["merged_trace_events", "export_chrome_trace"]
 
 PID = 0
 TID_SPANS = 0
 TID_GUARDIAN = 1
+TID_REQUESTS = 100      # first per-request lane
 
 
 def _guardian_to_perf_ns(ts_ns, pair):
@@ -40,7 +45,7 @@ def _guardian_to_perf_ns(ts_ns, pair):
 
 
 def merged_trace_events(include_profiler=True, include_guardian=True,
-                        include_samples=True):
+                        include_samples=True, include_requests=True):
     """Build the merged chrome traceEvents list (timestamps in µs on
     the perf_counter axis)."""
     events = [
@@ -68,6 +73,34 @@ def merged_trace_events(include_profiler=True, include_guardian=True,
                 "s": "g",
                 "ts": _guardian_to_perf_ns(rec["ts_ns"], pair) / 1e3,
                 "pid": PID, "tid": TID_GUARDIAN, "args": dict(rec)})
+    if include_requests:
+        if _tracing.dropped_spans():
+            # ring overflow: the oldest lanes below are incomplete —
+            # stamp it into the trace so a reader can tell
+            events.append({
+                "name": "tracing_dropped_spans", "ph": "M", "pid": PID,
+                "args": {"count": _tracing.dropped_spans()}})
+        lanes = {}
+        for s in _tracing.spans():
+            tid = lanes.get(s["trace"])
+            if tid is None:
+                tid = lanes[s["trace"]] = TID_REQUESTS + len(lanes)
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": PID,
+                    "tid": tid, "args": {"name": f"req {s['trace']}"}})
+            args = {"trace": s["trace"], "req_id": s["req_id"],
+                    "phase": s["phase"], **s["args"]}
+            if s["end_ns"] > s["start_ns"]:
+                events.append({
+                    "name": s["phase"], "cat": "request", "ph": "X",
+                    "ts": s["start_ns"] / 1e3,
+                    "dur": (s["end_ns"] - s["start_ns"]) / 1e3,
+                    "pid": PID, "tid": tid, "args": args})
+            else:
+                events.append({
+                    "name": s["phase"], "cat": "request", "ph": "i",
+                    "s": "t", "ts": s["start_ns"] / 1e3,
+                    "pid": PID, "tid": tid, "args": args})
     if include_samples:
         for s in _metrics.samples():
             labels = s["labels"]
@@ -84,13 +117,15 @@ def merged_trace_events(include_profiler=True, include_guardian=True,
 
 
 def export_chrome_trace(path, include_profiler=True,
-                        include_guardian=True, include_samples=True):
+                        include_guardian=True, include_samples=True,
+                        include_requests=True):
     """Write the merged timeline as chrome://tracing / Perfetto JSON."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     data = {"traceEvents": merged_trace_events(
-        include_profiler, include_guardian, include_samples),
+        include_profiler, include_guardian, include_samples,
+        include_requests),
         "displayTimeUnit": "ms"}
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:

@@ -1,0 +1,144 @@
+"""Request-scoped tracing for the serving engine: where one request's
+latency went.
+
+The PR 5 timeline shows the *engine's* spans (prefill/chunk/sync); a
+single slow request is invisible in them — its TTFT might be queue
+wait, a cold prefill, a page eviction, or plain decode cadence.  This
+module gives every request a trace id (minted at ``submit()``) and
+books one span per lifecycle phase:
+
+- ``queue_wait`` — submit (or page-pressure requeue) -> slot admission;
+- ``prefill``    — admission -> the chunk-boundary sync that streamed
+  its first token (args: bucket, prefix-hit/cached tokens, resume flag);
+- ``decode`` / ``spec_decode`` — one span per decode chunk the request
+  participated in, tiling sync-to-sync (args: tokens emitted);
+- ``page_evict`` — instant: preempted back to the queue;
+- finish is the end of the last span (reason in its args).
+
+THE contract (the PR 5 discipline, A/B-verified by
+``tests/test_compile_tracing.py``): spans are booked **only from host
+timestamps the engine already owns** — ``submit_ns``/``admit_ns`` are
+host-side scheduler stamps, and every span end is the engine's ONE
+bundled ``device_get`` per chunk.  Tracing adds zero host syncs; by
+construction a request's spans tile submit -> finish, so their sum
+equals its measured wall time (the machine-checked invariant).
+
+Sinks: per-request lanes in the merged chrome trace
+(``timeline.export_chrome_trace``) and the ``report --requests`` view
+(TTFT/TPOT percentiles with per-phase tail attribution).  Import-light:
+stdlib only, gated by the same :func:`metrics.enabled` switch as every
+other recorder.
+"""
+import collections
+import itertools
+import threading
+
+from . import metrics as _metrics
+
+__all__ = ["mint", "span", "instant", "finish", "spans", "reset",
+           "dropped_spans", "request_summaries"]
+
+_SPANS = collections.deque(maxlen=65536)
+_LOCK = threading.Lock()
+_IDS = itertools.count()
+# ring overflow tally: once the deque wraps, the oldest requests lose
+# their queue_wait/prefill spans and the tiling invariant no longer
+# holds for them — consumers must be able to SEE that it happened
+# (timeline export stamps it into the trace; drain with reset())
+_DROPPED = [0]
+
+
+def mint(req_id):
+    """Mint a trace id for one submitted request — unique per process
+    even when engines (and their req_id counters) are rebuilt."""
+    return f"t{next(_IDS)}-r{req_id}"
+
+
+def span(trace_id, req_id, phase, start_ns, end_ns, **args):
+    """Book one [start_ns, end_ns] perf_counter_ns span.  Both stamps
+    must be host values the caller already owned (never taken around a
+    new device readback)."""
+    if not _metrics.enabled():
+        return
+    with _LOCK:
+        if len(_SPANS) == _SPANS.maxlen:
+            _DROPPED[0] += 1
+        _SPANS.append({"trace": trace_id, "req_id": req_id,
+                       "phase": phase, "start_ns": int(start_ns),
+                       "end_ns": int(end_ns), "args": args})
+    _metrics.inc("pt_trace_spans_total", phase=phase)
+
+
+def instant(trace_id, req_id, phase, ts_ns, **args):
+    """Book a zero-duration marker (eviction, resume)."""
+    span(trace_id, req_id, phase, ts_ns, ts_ns, **args)
+
+
+def spans():
+    """Snapshot, oldest first."""
+    with _LOCK:
+        return list(_SPANS)
+
+
+def dropped_spans():
+    """Spans evicted by ring overflow since the last :func:`reset` —
+    nonzero means the oldest traces in :func:`spans` are incomplete
+    (their summaries under-report early phases)."""
+    return _DROPPED[0]
+
+
+def reset():
+    with _LOCK:
+        _SPANS.clear()
+        _DROPPED[0] = 0
+
+
+def finish(tpot_ms=None):
+    """Book the request-level summary counters at finish (all host
+    numbers computed from existing stamps)."""
+    if not _metrics.enabled():
+        return
+    _metrics.inc("pt_trace_requests_total")
+    if tpot_ms is not None:
+        _metrics.observe("pt_trace_tpot_ms", tpot_ms)
+
+
+def request_summaries(span_list=None):
+    """Fold spans into one record per trace id: total/queue/prefill/
+    decode milliseconds, ttft (queue+prefill), tokens and tpot.  Used
+    by ``report --requests`` and the span-sum test."""
+    per = {}
+    for s in (span_list if span_list is not None else spans()):
+        r = per.setdefault(s["trace"], {
+            "trace": s["trace"], "req_id": s["req_id"],
+            "start_ns": s["start_ns"], "end_ns": s["end_ns"],
+            "tokens": 0, "evictions": 0, "phase_ms": {}})
+        r["start_ns"] = min(r["start_ns"], s["start_ns"])
+        r["end_ns"] = max(r["end_ns"], s["end_ns"])
+        dur = (s["end_ns"] - s["start_ns"]) / 1e6
+        ph = s["phase"]
+        if ph == "page_evict":
+            r["evictions"] += 1
+            continue
+        r["phase_ms"][ph] = r["phase_ms"].get(ph, 0.0) + dur
+        r["tokens"] += int(s["args"].get("tokens", 0))
+        if ph == "prefill" and "first_token_end_ns" not in r:
+            r["first_token_end_ns"] = s["end_ns"]
+        if s["args"].get("reason"):
+            r["reason"] = s["args"]["reason"]
+    out = []
+    for r in per.values():
+        r["total_ms"] = (r["end_ns"] - r["start_ns"]) / 1e6
+        r["span_sum_ms"] = round(sum(r["phase_ms"].values()), 3)
+        decode = r["phase_ms"].get("decode", 0.0) + \
+            r["phase_ms"].get("spec_decode", 0.0)
+        # TTFT from the FIRST prefill span's end (an evicted request's
+        # re-prefill must not restart its clock)
+        first = r.pop("first_token_end_ns", r["end_ns"])
+        r["ttft_ms"] = round((first - r["start_ns"]) / 1e6, 3)
+        r["tpot_ms"] = round(decode / (r["tokens"] - 1), 3) \
+            if r["tokens"] > 1 else None
+        r["phase_ms"] = {k: round(v, 3)
+                         for k, v in sorted(r["phase_ms"].items())}
+        out.append(r)
+    return sorted(out, key=lambda r: r["start_ns"])

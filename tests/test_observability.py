@@ -35,12 +35,16 @@ def _clean_telemetry():
     obs.enable(True)
     obs.get_registry().reset()
     obs.stop_capture()
+    obs.tracing.reset()
+    obs.compilestats.reset()
     failpoints.clear()
     guardian.clear_events()
     yield
     obs.enable(True)
     obs.get_registry().reset()
     obs.stop_capture()
+    obs.tracing.reset()
+    obs.compilestats.reset()
     failpoints.clear()
     guardian.clear_events()
 

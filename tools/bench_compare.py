@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Bench trajectory regression gate: diff the newest two BENCH_r*.json
+(tokens/sec, MFU, serving useful-tok/s, validity flags) and exit
+non-zero when a tracked metric drops past the threshold or a config's
+validity regresses.
+
+Thin wrapper over ``paddle_tpu.analysis.bench_gate`` (the same logic
+runs as the opt-in ``bench`` lint pass: ``python tools/lint.py
+--passes bench``).  Threshold: ``--threshold 0.05`` (relative drop) or
+the ``PADDLE_BENCH_THRESHOLD`` env; see docs/observability.md.
+
+Usage:
+    python tools/bench_compare.py                 # newest two in repo
+    python tools/bench_compare.py OLD.json NEW.json
+    python tools/bench_compare.py --threshold 0.10 --json
+
+Exit codes: 0 no regression, 1 regression(s), 2 usage/unreadable.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.analysis import bench_gate  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Diff two bench artifacts; exit 1 on regression.")
+    ap.add_argument("files", nargs="*",
+                    help="OLD.json NEW.json (default: the newest two "
+                         "BENCH_r*.json in the repo root)")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="relative drop that fails the gate (default "
+                         f"{bench_gate.DEFAULT_THRESHOLD}, or "
+                         f"${bench_gate.THRESHOLD_ENV})")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.files and len(args.files) != 2:
+        print("error: pass exactly two files (or none)", file=sys.stderr)
+        return 2
+    if args.files:
+        old_p, new_p = args.files
+    else:
+        files = bench_gate.bench_files(REPO)
+        if len(files) < 2:
+            print(f"nothing to diff: {len(files)} BENCH_r*.json under "
+                  f"{REPO} (need 2)")
+            return 0
+        old_p, new_p = files[-2], files[-1]
+    try:
+        rows = bench_gate.compare(bench_gate.load_bench(old_p),
+                                  bench_gate.load_bench(new_p),
+                                  threshold=args.threshold)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    regressions = [r for r in rows if r["regressed"]]
+    if args.as_json:
+        print(json.dumps({"old": old_p, "new": new_p, "rows": rows,
+                          "regressions": len(regressions)},
+                         indent=1, sort_keys=True))
+        return 1 if regressions else 0
+    print(f"bench diff: {os.path.basename(old_p)} -> "
+          f"{os.path.basename(new_p)}")
+    for r in rows:
+        mark = "REGRESSED" if r["regressed"] else "ok"
+        delta = f" ({r['delta']:+.1%})" if r["delta"] is not None else ""
+        why = f" — {r['why']}" if r["why"] else ""
+        print(f"  [{mark:>9}] {r['key']}: {r['old']} -> "
+              f"{r['new']}{delta}{why}")
+    if regressions:
+        print(f"FAIL: {len(regressions)} regression(s)")
+        return 1
+    print("OK: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
