@@ -11,7 +11,7 @@ so this package checks them at lint time (see T3 / EQuARX in PAPERS.md:
 compute/collective overlap wins evaporate when stray host syncs or
 misordered collectives sneak into the step).
 
-Four AST-based passes, one runner:
+The AST-based passes, one runner:
 
 - ``tracer-safety``  — walk functions reachable from registered jit
   entry points (:func:`jit_surface`) and flag trace-breaking patterns:
@@ -26,6 +26,16 @@ Four AST-based passes, one runner:
 - ``collective-order`` — flag collective calls under rank- or
   data-dependent branches, and ``if``/``else`` arms whose collective
   sequences differ — the classic SPMD deadlock shapes.
+- ``donation``      — registered jit surfaces must donate their large
+  state-tree arguments; flag use-after-donate, double donation and
+  donated-buffer re-entry into a second jit.
+- ``retrace-hazard`` — jit cache keys / static args built from
+  data-dependent values (unbucketed shapes, computed floats, dict/set
+  order); findings carry the ``pt_compile_*`` surface labels, the
+  static half of the runtime ``compile_retrace`` sentinel.
+- ``concurrency``   — host state mutated from more than one thread
+  entry point must be lock-guarded or explicitly thread-confined;
+  flag check-then-act on shared queues/free-lists.
 - ``failpoint-refs`` / ``guardian-log`` — the registry lints formerly
   living in ``tools/check_failpoints.py`` / ``check_guardian_log.py``,
   folded into the same framework (the tools remain as thin wrappers).

@@ -183,7 +183,159 @@ EXTRA_JIT_SURFACES = (
     ("paddle_tpu/distributed/grad_comm.py", "build_grad_reducer.reduce"),
     ("paddle_tpu/distributed/grad_comm.py",
      "_build_quant_reduce.quant_reduce"),
+    # hybrid-parallel steppers (ISSUE 11 donation audit): both donate
+    # their state trees — registered so the donation/tracer passes keep
+    # them honest
+    ("paddle_tpu/models/gpt_hybrid.py", "build_hybrid_gpt.step"),
+    ("paddle_tpu/distributed/fleet/meta_parallel/pipeline_parallel.py",
+     "_PipelineStepper._build.step"),
 )
+
+# -- donation (donation.py) ------------------------------------------------
+#
+# Parameter-name tokens that mark a jit-surface argument as a *large
+# state tree* (params / optimizer state / KV pools / slot state):
+# surfaces taking one must declare donate_argnums or pragma the jit
+# line with the reason the tree must outlive the call.  Matched against
+# the ``_``-split tokens of the parameter name, so ``train_vals`` and
+# ``opt_state`` match but ``lr`` and ``key`` never do.
+DONATABLE_PARAM_TOKENS = frozenset({
+    "params", "pv", "pvals", "dpv", "dpvals", "state", "states",
+    "caches", "cache", "kv", "dkv", "pool", "pools", "hist", "history",
+    "buffer", "buffers", "vals", "tree", "trees", "slots", "weights",
+    "opt",
+})
+
+# -- retrace-hazard (retrace_hazard.py) ------------------------------------
+#
+# The compile-surface vocabulary: every label passed to
+# ``observability.compilestats.wrap`` (the ``pt_compile_*`` metrics'
+# ``surface`` label set).  Retrace-hazard findings attribute to these
+# same names so static findings and runtime ``compile_retrace`` events
+# speak one language; tests cross-reference this tuple against the
+# wrap() call sites in source (tests/test_graph_discipline.py).
+COMPILE_SURFACES = (
+    "hapi.train_step",
+    "hapi.train_step_comm",
+    "hapi.grad_step",
+    "hapi.apply_step",
+    "hapi.eval_step",
+    "serving.prefill",
+    "serving.decode_chunk",
+    "serving.paged_prefill",
+    "serving.paged_decode_chunk",
+    "serving.spec_prefill",
+    "serving.spec_decode_chunk",
+    "speculative.generate",
+    "generation.decode",
+)
+
+# Fallback surface labels for jit-cache sites whose module does not
+# wrap with compilestats (the wrap string literal is the primary
+# source): (relpath, enclosing function qualname) -> surface label.
+SURFACE_LABELS = {}
+
+# Parameter/local-name tokens that mark a value as *request data* (the
+# extents that jitter per call): a cache-key component derived from a
+# data value's ``len()``/``.shape`` is the unbucketed-retrace hazard.
+RETRACE_DATA_TOKENS = frozenset({
+    "input", "inputs", "ids", "prompt", "prompts", "tokens", "labels",
+    "batch", "feed", "x", "y", "data",
+})
+
+# -- concurrency (concurrency.py) ------------------------------------------
+#
+# Modules whose host-side state crosses threads (dataloader producer
+# threads, async checkpoint writers, the elastic heartbeat lease, the
+# metrics registry, the serving scheduler/engine ahead of the
+# multi-replica router).
+CONCURRENCY_MODULES = (
+    "paddle_tpu/inference/scheduler.py",
+    "paddle_tpu/inference/serving.py",
+    "paddle_tpu/io/__init__.py",
+    "paddle_tpu/io/worker.py",
+    "paddle_tpu/distributed/checkpoint/__init__.py",
+    "paddle_tpu/distributed/fleet/elastic/__init__.py",
+    "paddle_tpu/observability/metrics.py",
+)
+
+# Classes (or "<module>" namespaces) whose public API is a declared
+# cross-thread surface even when no Thread() appears in the file.
+# ``entries`` lists the methods other threads may call concurrently
+# with the owner loop ("*" = every public method is its own root).
+CONCURRENT_CLASSES = {
+    # the serving admission queue: router threads submit() while the
+    # engine loop admits/releases/requeues (ROADMAP: multi-replica
+    # serving tier)
+    ("paddle_tpu/inference/scheduler.py", "FCFSScheduler"):
+        {"entries": ["submit"],
+         "reason": "router threads submit while the engine loop "
+                   "admits/releases — the queue and free-list are the "
+                   "cross-thread boundary"},
+    ("paddle_tpu/inference/serving.py", "ServingEngine"):
+        {"entries": ["submit"],
+         "reason": "submit() is the engine's only cross-thread entry; "
+                   "everything else runs on the engine event loop"},
+    # the metrics registry records from every thread by contract
+    ("paddle_tpu/observability/metrics.py", "<module>"):
+        {"entries": "*", "reason": "recording API is process-wide"},
+    ("paddle_tpu/observability/metrics.py", "_Metric"):
+        {"entries": "*", "reason": "metric instances record from any "
+                                   "thread"},
+    ("paddle_tpu/observability/metrics.py", "Counter"):
+        {"entries": "*", "reason": "see _Metric"},
+    ("paddle_tpu/observability/metrics.py", "Gauge"):
+        {"entries": "*", "reason": "see _Metric"},
+    ("paddle_tpu/observability/metrics.py", "Histogram"):
+        {"entries": "*", "reason": "see _Metric"},
+    ("paddle_tpu/observability/metrics.py", "MetricsRegistry"):
+        {"entries": "*", "reason": "registration races recording"},
+}
+
+# (relpath, "Owner.attr" | "<module>.name") -> reason the unguarded
+# access is sound (single-writer publish, GIL-atomic slot write,
+# happens-before via Thread.start()/join()).  The concurrency pass's
+# equivalent of HOST_SYNC_ALLOWLIST: the diff review sees the
+# justification, not a silent data race.
+THREAD_SAFE_STATE = {
+    # metrics: the lock-free recording fast path (PR 5 design): single
+    # bounded deque ring + single-slot list cells, GIL-atomic ops only
+    ("paddle_tpu/observability/metrics.py", "<module>._ENABLED"):
+        "single-slot list write; readers tolerate either value (the "
+        "enable/disable race drops or keeps one sample, never corrupts)",
+    ("paddle_tpu/observability/metrics.py", "<module>._CAPTURE"):
+        "single-slot capture flag, same tolerance as _ENABLED",
+    ("paddle_tpu/observability/metrics.py", "<module>._CLOCK_PAIR"):
+        "single-slot write at start_capture; readers see old or new "
+        "pair atomically",
+    ("paddle_tpu/observability/metrics.py", "<module>._SAMPLES"):
+        "bounded collections.deque ring: append/clear are GIL-atomic "
+        "by design — the lock-free recording path is the point",
+    # checkpoint: write-once publish, synchronized by join()/is_alive()
+    ("paddle_tpu/distributed/checkpoint/__init__.py",
+     "AsyncSaveHandle.exception"):
+        "write-once by the writer thread before it exits; readers "
+        "observe it only after join()/is_alive() established "
+        "happens-before",
+    # elastic: published before the heartbeat thread starts
+    ("paddle_tpu/distributed/fleet/elastic/__init__.py",
+     "ElasticManager._node_id"):
+        "written in start() before Thread.start() publishes it to the "
+        "heartbeat loop; never rewritten while the thread lives",
+    ("paddle_tpu/distributed/fleet/elastic/__init__.py",
+     "ElasticManager._endpoint"):
+        "written in start() before Thread.start(), same "
+        "happens-before as _node_id",
+    ("paddle_tpu/distributed/fleet/elastic/__init__.py",
+     "ElasticManager._store"):
+        "TCPStore.add() is a store RPC (server-side atomic), not a "
+        "local container mutation; the client is internally "
+        "synchronized (PR 1 retry envelope)",
+    # dataloader: single-writer liveness flags polled by the collector
+    ("paddle_tpu/io/worker.py", "_MultiProcessIterBase._stopping"):
+        "single-writer bool publish (consumer -> collector poll); "
+        "GIL-atomic, the collector tolerates observing it late",
+}
 
 # Call terminals that return *static* (trace-time) values even when
 # applied to traced arrays — metadata, not data.  Taint stops here.

@@ -170,6 +170,74 @@ def _decorator_is_surface(dec):
     return False
 
 
+# telemetry wrappers a jit call may hide behind (compilestats.wrap and
+# the hapi/serving aliases) — shared by the donation and retrace passes
+WRAP_CALLEES = ("wrap", "_tracked", "_wrap")
+
+
+def is_jax_jit_call(call, mod):
+    """True for ``jax.jit(...)`` / ``jit(...)`` calls, resolved through
+    the module's import aliases (incl. ``from jax import jit``)."""
+    name = dotted(call.func)
+    if not name:
+        return False
+    if name == "jit" or name.endswith(".jit"):
+        root = name.split(".", 1)[0]
+        target = mod.alias_module(root) or root
+        if target == "jax" or target.startswith("jax."):
+            return True
+        if name == "jit" and (mod.alias_module("jit") or "").startswith(
+                "jax"):
+            return True
+    return False
+
+
+def assign_names(target):
+    """Names bound by an assignment target (tuples/lists/starred
+    unpacked)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from assign_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from assign_names(target.value)
+
+
+def int_literals(expr):
+    """Statically-literal ints in a tuple/list/single expression —
+    the donate_argnums / static_argnums shapes."""
+    elts = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) \
+        else [expr]
+    return [e.value for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+
+
+def param_names(fnode):
+    """Parameter names of a function node (vararg/kwarg included,
+    ``self``/``cls`` excluded)."""
+    a = fnode.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def enclosing_qualname(mod, node, default="<module>"):
+    """Qualname of the innermost function containing ``node``."""
+    best, best_span = default, None
+    for qual, fi in mod.funcs.items():
+        f = fi.node
+        end = getattr(f, "end_lineno", f.lineno)
+        if f.lineno <= node.lineno <= end:
+            span = end - f.lineno
+            if best_span is None or span < best_span:
+                best, best_span = qual, span
+    return best
+
+
 def dotted(node):
     """'a.b.c' for a Name/Attribute chain, else None."""
     parts = []

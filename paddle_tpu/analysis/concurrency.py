@@ -1,0 +1,507 @@
+"""Host-concurrency pass: shared host state mutated from more than one
+thread entry point must be lock-guarded or explicitly thread-confined.
+
+The compiled hot paths are single-dispatcher by construction, but the
+*host* side is not: dataloader producer threads, ``AsyncSaveHandle``
+writers, the elastic heartbeat, the metrics registry, and — ahead of
+the multi-replica serving router — ``ServingEngine.submit`` /
+``FCFSScheduler`` all touch instance or module state from more than one
+thread.  This pass inventories those mutations statically and requires
+each one to be either inside a ``with <...lock>:`` block, covered by a
+``THREAD_SAFE_STATE`` allowlist entry (with the reason the lock-free
+access is sound), or pragma'd.
+
+Scope: modules listed in ``allowlist.CONCURRENCY_MODULES``.  Thread
+entry points are found syntactically (``threading.Thread(target=...)``,
+``atexit.register(...)``) and declared via
+``allowlist.CONCURRENT_CLASSES`` for classes (or a module namespace,
+``"<module>"``) whose *public API* is the cross-thread surface: the
+scheduler's ``submit`` may be called from router threads while the
+engine loop admits/releases — no ``Thread`` appears in the file, but
+the contract is concurrent.
+
+Sharedness is computed per *cell*: a plain attribute is one cell;
+dict-style subscript accesses with constant keys are per-key cells
+(``self.stats["requests"]`` from ``submit`` does not conflict with the
+engine loop's ``self.stats["chunks"]`` under the GIL — but the same key
+from two roots does; a non-constant key conflicts with every key).  A
+cell is shared when it is accessed from two or more roots and mutated
+by at least one of them.  Constructor bodies are exempt — the object is
+not shared yet — but a def *nested* in a constructor and handed to
+``Thread(target=...)`` is not (it runs later, on its own thread).
+
+Codes:
+
+- ``unguarded-shared-mutation`` — mutation of a shared cell outside a
+  lock.
+- ``check-then-act`` — an ``if``/``while`` tests a shared cell and its
+  body mutates that same cell, with the test outside the lock: the
+  classic TOCTOU on a queue/free-list (``if not self._free: ...
+  self._free.pop()``).
+"""
+import ast
+
+from .base import Finding, call_terminal, dotted
+from .allowlist import (CONCURRENCY_MODULES, CONCURRENT_CLASSES,
+                        THREAD_SAFE_STATE)
+
+PASS_NAME = "concurrency"
+
+# attribute-call terminals that mutate their receiver in place; queue
+# ops (put/push/get on queue.Queue) and Event.set/clear are
+# deliberately absent — thread-safe by design
+_MUTATING_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "pop", "popleft", "popitem", "remove", "discard",
+    "update", "add", "setdefault", "sort", "reverse",
+})
+_LOCKY_FRAGMENTS = ("lock", "cond", "_cv", "mutex")
+
+
+def _is_locky(expr):
+    name = dotted(expr) or ""
+    leaf = name.rsplit(".", 1)[-1].lower()
+    return any(f in leaf for f in _LOCKY_FRAGMENTS)
+
+
+def _with_locked(with_node, outer_locked):
+    """Lock state inside a ``with`` body — THE single place that
+    decides what counts as taking a lock (both walkers route here)."""
+    return outer_locked or any(_is_locky(i.context_expr)
+                               for i in with_node.items)
+
+
+def _walk_lockstate(body, locked=False):
+    """Full-descent (node, locked) walk of a statement list: nested
+    defs/classes are skipped, ``with`` bodies carry their lock state."""
+    stack = [(n, locked) for n in reversed(body)]
+    while stack:
+        n, lk = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(n, ast.With):
+            inner = _with_locked(n, lk)
+            for c in reversed(n.body):
+                stack.append((c, inner))
+            for i in n.items:
+                stack.append((i.context_expr, lk))
+            continue
+        yield n, lk
+        for c in reversed(list(ast.iter_child_nodes(n))):
+            stack.append((c, lk))
+
+
+class _Access:
+    __slots__ = ("cell", "node", "mutates", "locked", "func")
+
+    def __init__(self, cell, node, mutates, locked, func):
+        self.cell = cell           # (owner, attr, key)
+        self.node = node
+        self.mutates = mutates
+        self.locked = locked
+        self.func = func
+
+
+def _cells_conflict(a, b):
+    """Same owner+attr; per-key cells conflict only on equal (or
+    unknown) keys."""
+    if a[:2] != b[:2]:
+        return False
+    ka, kb = a[2], b[2]
+    return ka is None or kb is None or ka == kb
+
+
+def _iter_accesses(body, mod, module_containers, qual,
+                   locked_init=False):
+    """Yield _Access records for a statement list, without descending
+    into nested defs (they are their own functions).  Subscript bases
+    are consumed into per-key cells, never double-counted as bare
+    attribute reads."""
+
+    def cell_for(base, key):
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self":
+            return ("self", base.attr, key)
+        if isinstance(base, ast.Name) and base.id in module_containers:
+            return ("<module>", base.id, key)
+        return None
+
+    stack = [(n, locked_init) for n in reversed(body)]
+    while stack:
+        n, lk = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(n, ast.With):
+            inner = _with_locked(n, lk)
+            for c in reversed(n.body):
+                stack.append((c, inner))
+            for i in n.items:
+                stack.append((i.context_expr, lk))
+            continue
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) \
+                else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    key = repr(t.slice.value) \
+                        if isinstance(t.slice, ast.Constant) else None
+                    cell = cell_for(t.value, key)
+                    if cell is not None:
+                        yield _Access(cell, t, True, lk, qual)
+                    stack.append((t.slice, lk))
+                    continue
+                cell = cell_for(t, None)
+                if cell is not None:
+                    yield _Access(cell, t, True, lk, qual)
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    stack.append((t, lk))
+            if n.value is not None:
+                stack.append((n.value, lk))
+            continue
+        if isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    key = repr(t.slice.value) \
+                        if isinstance(t.slice, ast.Constant) else None
+                    cell = cell_for(t.value, key)
+                else:
+                    cell = cell_for(t, None)
+                if cell is not None:
+                    yield _Access(cell, t, True, lk, qual)
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            term = call_terminal(n.func)
+            if term in _MUTATING_METHODS:
+                recv = n.func.value
+                key = None
+                if isinstance(recv, ast.Subscript):
+                    key = repr(recv.slice.value) \
+                        if isinstance(recv.slice, ast.Constant) else None
+                    recv = recv.value
+                cell = cell_for(recv, key)
+                if cell is not None:
+                    yield _Access(cell, n, True, lk, qual)
+                    for a in n.args + [kw.value for kw in n.keywords]:
+                        stack.append((a, lk))
+                    continue
+            stack.append((n.func.value, lk))
+            for a in n.args + [kw.value for kw in n.keywords]:
+                stack.append((a, lk))
+            continue
+        if isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Load):
+            key = repr(n.slice.value) \
+                if isinstance(n.slice, ast.Constant) else None
+            cell = cell_for(n.value, key)
+            if cell is not None:
+                yield _Access(cell, n, False, lk, qual)
+                stack.append((n.slice, lk))
+                continue
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            cell = cell_for(n, None)
+            if cell is not None:
+                yield _Access(cell, n, False, lk, qual)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            cell = cell_for(n, None)
+            if cell is not None:
+                yield _Access(cell, n, False, lk, qual)
+        for c in reversed(list(ast.iter_child_nodes(n))):
+            stack.append((c, lk))
+
+
+def _module_containers(mod):
+    """Module-level names bound to mutable containers —
+    ``threading.local()`` is thread-confined by construction and
+    exempt."""
+    out = set()
+    for n in mod.tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name):
+            v = n.value
+            name = n.targets[0].id
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                out.add(name)
+            elif isinstance(v, ast.Call):
+                leaf = (dotted(v.func) or "").rsplit(".", 1)[-1]
+                if leaf in ("dict", "list", "set", "deque",
+                            "defaultdict", "OrderedDict"):
+                    out.add(name)
+    return out
+
+
+def _thread_targets(mod):
+    """Qualnames handed to ``threading.Thread(target=...)`` /
+    ``atexit.register(...)``: ``("method", attr)`` for ``self.m``
+    targets, ``("local", encl_qual, name)`` for local/module
+    functions."""
+    out = []
+    walk_units = [("<module>", mod.tree.body)]
+    walk_units += [(q, mod.funcs[q].node.body) for q in sorted(mod.funcs)]
+    for qual, body in walk_units:
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call):
+                cname = dotted(n.func) or ""
+                leaf = cname.rsplit(".", 1)[-1]
+                tgt = None
+                if leaf == "Thread":
+                    for kw in n.keywords:
+                        if kw.arg == "target":
+                            tgt = kw.value
+                elif leaf == "register" and cname.startswith("atexit"):
+                    tgt = n.args[0] if n.args else None
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    out.append(("method", tgt.attr))
+                elif isinstance(tgt, ast.Name):
+                    out.append(("local", qual, tgt.id))
+            stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _reachable(roots, callgraph):
+    out = set(roots)
+    work = list(roots)
+    while work:
+        q = work.pop()
+        for callee in callgraph.get(q, ()):
+            if callee not in out:
+                out.add(callee)
+                work.append(callee)
+    return out
+
+
+class ConcurrencyPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.index.iter_modules():
+            if not any(mod.relpath == m or mod.relpath.endswith("/" + m)
+                       for m in CONCURRENCY_MODULES):
+                continue
+            self._scan(mod, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    def _scan(self, mod, findings):
+        def flag(node, qual, code, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(self.name, mod.relpath, node.lineno,
+                                    qual, code, message, detail))
+
+        containers = _module_containers(mod)
+        targets = _thread_targets(mod)
+
+        # units: one per class, plus the module namespace (top-level
+        # functions and their nested defs, which see module containers)
+        units = {"<module>": {}}
+        for qual, fi in mod.funcs.items():
+            root = qual.split(".")[0]
+            if root in mod.funcs or "." not in qual:
+                units["<module>"][qual] = fi
+            else:
+                units.setdefault(root, {})[qual] = fi
+
+        declared = {}
+        for (rel, cls), meta in CONCURRENT_CLASSES.items():
+            if mod.relpath == rel or mod.relpath.endswith("/" + rel):
+                declared[cls] = meta
+
+        for unit_name in sorted(units):
+            self._scan_unit(mod, unit_name, units[unit_name], containers,
+                            targets, declared.get(unit_name), flag)
+
+    def _scan_unit(self, mod, unit_name, funcs, containers, targets,
+                   decl, flag):
+        if not funcs:
+            return
+        is_module_unit = unit_name == "<module>"
+
+        callgraph = {}
+        for qual, fi in funcs.items():
+            edges = set()
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Attribute) and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id == "self" and not is_module_unit:
+                    cand = f"{unit_name}.{n.func.attr}"
+                    if cand in funcs:
+                        edges.add(cand)
+                elif isinstance(n.func, ast.Name):
+                    parts = qual.split(".")
+                    for i in range(len(parts), -1, -1):
+                        cand = ".".join(parts[:i] + [n.func.id])
+                        if cand in funcs:
+                            edges.add(cand)
+                            break
+            callgraph[qual] = edges
+
+        entry_roots = {}
+        for tgt in targets:
+            if tgt[0] == "method" and not is_module_unit:
+                cand = f"{unit_name}.{tgt[1]}"
+                if cand in funcs:
+                    entry_roots[f"thread:{cand}"] = cand
+            elif tgt[0] == "local":
+                _, encl_qual, local = tgt
+                for cand in (f"{encl_qual}.{local}", local):
+                    if cand in funcs:
+                        entry_roots[f"thread:{cand}"] = cand
+                        break
+        if decl:
+            entries = decl.get("entries", "*")
+            quals = []
+            if entries == "*":
+                quals = [q for q in funcs
+                         if not q.rsplit(".", 1)[-1].startswith("_")
+                         and q.count(".") == (0 if is_module_unit else 1)]
+            else:
+                for e in entries:
+                    cand = e if is_module_unit else f"{unit_name}.{e}"
+                    if cand in funcs:
+                        quals.append(cand)
+            for q in quals:
+                entry_roots[f"api:{q.rsplit('.', 1)[-1]}"] = q
+        if not entry_roots:
+            return
+
+        entry_reach = {r: _reachable({q}, callgraph)
+                       for r, q in entry_roots.items()}
+        entry_starts = set(entry_roots.values())
+        # the owner thread enters through the unit's PUBLIC api (plus
+        # dunders like __next__); a private helper only called from a
+        # thread entry (or only from the constructor) must not inherit
+        # a phantom owner root
+        def _owner_entry(qual):
+            leaf = qual.rsplit(".", 1)[-1]
+            if leaf in ("__init__", "__new__", "__del__"):
+                return False
+            return not leaf.startswith("_") or (
+                leaf.startswith("__") and leaf.endswith("__"))
+        owner_start = {q for q in funcs
+                       if q not in entry_starts and _owner_entry(q)}
+        owner_reach = _reachable(owner_start, callgraph)
+
+        def is_ctor(qual):
+            return qual.rsplit(".", 1)[-1] in ("__init__", "__new__",
+                                               "__del__")
+
+        accesses = []                    # (root, _Access)
+        for qual, fi in funcs.items():
+            if is_ctor(qual):
+                continue
+            roots_here = [r for r, reach in entry_reach.items()
+                          if qual in reach]
+            if qual in owner_reach or not roots_here:
+                roots_here.append("owner")
+            for acc in _iter_accesses(fi.node.body, mod, containers,
+                                      qual):
+                for r in roots_here:
+                    accesses.append((r, acc))
+
+        mutated_cells = {a.cell for _, a in accesses if a.mutates}
+        shared = set()
+        for cell in mutated_cells:
+            touching = [(r, a) for r, a in accesses
+                        if _cells_conflict(a.cell, cell)]
+            roots = {r for r, _ in touching}
+            if len(roots) >= 2 and any(r != "owner" for r in roots):
+                shared.add(cell)
+
+        seen = set()
+        for root, acc in accesses:
+            if not acc.mutates or acc.locked:
+                continue
+            # flag only mutations whose OWN cell is shared: the engine
+            # loop's stats["chunks"] does not become hot because
+            # submit() touches stats["requests"]
+            if acc.cell not in shared:
+                continue
+            if self._allowlisted(mod, unit_name, acc.cell):
+                continue
+            ident = (id(acc.node), acc.cell)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            roots = sorted({r for r, a in accesses
+                            if _cells_conflict(a.cell, acc.cell)})
+            flag(acc.node, acc.func, "unguarded-shared-mutation",
+                 f"`{self._cellname(unit_name, acc.cell)}` is reached "
+                 f"from multiple thread roots ({', '.join(roots)}) but "
+                 "this mutation is not lock-guarded — wrap the mutation "
+                 "in `with self._lock:` (or a module lock), or add a "
+                 "THREAD_SAFE_STATE entry in "
+                 "paddle_tpu/analysis/allowlist.py stating why the "
+                 "lock-free access is sound",
+                 self._cellname(unit_name, acc.cell))
+
+        for qual, fi in funcs.items():
+            if is_ctor(qual):
+                continue
+            self._check_then_act(mod, unit_name, qual, fi, containers,
+                                 shared, flag)
+
+    def _check_then_act(self, mod, unit_name, qual, fi, containers,
+                        shared, flag):
+        def accs(nodes, locked=False):
+            return list(_iter_accesses(nodes, mod, containers, qual,
+                                       locked_init=locked))
+
+        for n, lk in _walk_lockstate(fi.node.body):
+            if lk or not isinstance(n, (ast.If, ast.While)):
+                continue
+            test_cells = {a.cell
+                          for a in accs([ast.Expr(value=n.test)])
+                          if any(_cells_conflict(a.cell, s)
+                                 for s in shared)}
+            if not test_cells:
+                continue
+            # a mutation under its OWN lock does not absolve the
+            # unlocked test: check-outside/act-inside is still the
+            # TOCTOU (two threads pass the check, the second act
+            # corrupts) — the lock must span the whole region
+            hits = [a for a in accs(n.body)
+                    if a.mutates and
+                    any(_cells_conflict(a.cell, c) for c in test_cells)]
+            hits = [a for a in hits
+                    if not self._allowlisted(mod, unit_name, a.cell)]
+            if not hits:
+                continue
+            if {self.name, "check-then-act"} & \
+                    mod.allowed_on_line(n.lineno):
+                continue
+            flag(n, qual, "check-then-act",
+                 f"test reads shared "
+                 f"`{self._cellname(unit_name, hits[0].cell)}` and the "
+                 "body mutates it outside a lock — another thread can "
+                 "change the state between check and act (TOCTOU on a "
+                 "queue/free-list); take the lock around the whole "
+                 "check-then-act region",
+                 self._cellname(unit_name, hits[0].cell))
+
+    @staticmethod
+    def _cellname(unit_name, cell):
+        owner, attr, key = cell
+        base = f"{unit_name}.{attr}" if owner == "self" \
+            else f"<module>.{attr}"
+        return base + (f"[{key}]" if key else "")
+
+    @staticmethod
+    def _allowlisted(mod, unit_name, cell):
+        owner, attr, _key = cell
+        name = f"{unit_name}.{attr}" if owner == "self" \
+            else f"<module>.{attr}"
+        for (rel, entry), _reason in THREAD_SAFE_STATE.items():
+            if entry == name and (mod.relpath == rel or
+                                  mod.relpath.endswith("/" + rel)):
+                return True
+        return False

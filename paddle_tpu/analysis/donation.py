@@ -1,0 +1,290 @@
+"""Donation/aliasing discipline pass: large state trees entering a
+registered jit surface must be donated, and a donated buffer must never
+be touched again.
+
+Why a *pass*: XLA aliases a donated input buffer to an output, so an
+un-donated params/opt-state/KV tree round-trips HBM on every hot
+dispatch — double the working set, and exactly the class of invariant
+PAPERS.md ("Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training") argues should be machine-checked, not
+reviewed.  The flip side is sharper: after donation the old buffer is
+*invalid* — reading it raises at runtime (if you are lucky), and
+re-entering it into a second jit double-donates (the aliased-buffer
+hazard documented at ``paddle_tpu/nn/layer/transformer.py``'s
+``_reown_params``).
+
+Four finding codes:
+
+- ``missing-donation`` — a registered jit surface (``@jit_surface``
+  builders and ``EXTRA_JIT_SURFACES`` nested defs) is ``jax.jit``-ed
+  with arguments that carry large state trees (parameter-name
+  heuristics: ``*_vals``/``pv``/``params``/``opt_state``/``caches``/
+  ``pool``/``hist``/...) but no ``donate_argnums``/``donate_argnames``.
+  Donate the consumed trees, or pragma the jit line with a one-line
+  justification when the arguments must outlive the call (live weights,
+  trip-path state).
+- ``use-after-donate`` — the caller reads a variable it passed in a
+  donated position after the call returns.
+- ``double-donation`` — one variable passed into two donated positions
+  of the same call (two aliased output buffers, one backing store).
+- ``donated-reentry`` — a variable passed in a donated position of one
+  jit call is later fed to a *second* jitted callable.
+
+Mechanics are deliberately name-based and local (pure AST): the pass
+tracks names bound to ``jax.jit(...)`` results in the same function —
+including ``fn = cache[sig] = jax.jit(...)`` chains and
+``compilestats.wrap(jax.jit(...), ...)`` wrappers — and follows
+donated *Name* arguments through subsequent statements by line order.
+Attribute-held jits and cross-function flows are out of scope (the
+runtime invalidation error covers them); the pass exists to catch the
+local patterns review keeps missing.
+"""
+import ast
+
+from .base import (Finding, call_terminal, is_jax_jit_call, assign_names,
+                   enclosing_qualname, int_literals, param_names,
+                   WRAP_CALLEES)
+from .allowlist import EXTRA_JIT_SURFACES, DONATABLE_PARAM_TOKENS
+
+PASS_NAME = "donation"
+
+
+def _unwrap_jit(expr, mod):
+    """The ``jax.jit`` Call inside ``expr``, looking through telemetry
+    wrappers (``compilestats.wrap(jax.jit(...), ...)``) and tuple
+    containers; None if ``expr`` holds no jit call."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            if is_jax_jit_call(n, mod):
+                return n
+            if call_terminal(n.func) in WRAP_CALLEES:
+                stack.extend(n.args)
+                continue
+        if isinstance(n, (ast.Tuple, ast.List)):
+            stack.extend(n.elts)
+    return None
+
+
+def _donated_positions(jit_call):
+    """Positions named by ``donate_argnums`` (ints when statically
+    literal).  Returns (has_donation, positions)."""
+    for kw in jit_call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return True, int_literals(kw.value)
+    return False, []
+
+
+def _jit_targets(jit_call, mod, enclosing_qual, index):
+    """FuncInfos the jit call compiles: a Name (both arms of an IfExp),
+    or the nested defs of a builder invoked inline
+    (``jax.jit(_build_prefill(...))``)."""
+    if not jit_call.args:
+        return []
+    arg = jit_call.args[0]
+    names = []
+    if isinstance(arg, ast.Name):
+        names = [arg]
+    elif isinstance(arg, ast.IfExp):
+        names = [a for a in (arg.body, arg.orelse)
+                 if isinstance(a, ast.Name)]
+    out = []
+    for nm in names:
+        parts = enclosing_qual.split(".") if enclosing_qual else []
+        for i in range(len(parts), -1, -1):
+            cand = ".".join(parts[:i] + [nm.id])
+            fi = mod.funcs.get(cand)
+            if fi is not None:
+                out.append(fi)
+                break
+    if isinstance(arg, ast.Call):
+        builder = index.resolve_call(mod, enclosing_qual, arg.func)
+        if builder is not None:
+            prefix = builder.qualname + "."
+            for qual in sorted(builder.module.funcs):
+                if qual.startswith(prefix) and \
+                        "." not in qual[len(prefix):]:
+                    out.append(builder.module.funcs[qual])
+    return out
+
+
+def _surface_quals(mod):
+    """Qualnames in ``mod`` that are registered surfaces (decorated or
+    EXTRA)."""
+    quals = {q for q, fi in mod.funcs.items() if fi.is_surface}
+    for rel, qual in EXTRA_JIT_SURFACES:
+        if mod.relpath == rel or mod.relpath.endswith("/" + rel):
+            quals.add(qual)
+    return quals
+
+
+def _state_params(fnode):
+    """Parameter names of ``fnode`` that look like large state trees."""
+    return [n for n in param_names(fnode)
+            if set(n.lower().split("_")) & DONATABLE_PARAM_TOKENS]
+
+
+class DonationPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        self._squals = {}     # per-run cache: relpath -> surface quals
+        for mod in ctx.index.iter_modules():
+            self._scan_module(mod, ctx.index, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    def _surfaces_of(self, mod):
+        if mod.relpath not in self._squals:
+            self._squals[mod.relpath] = _surface_quals(mod)
+        return self._squals[mod.relpath]
+
+    def _scan_module(self, mod, index, findings):
+
+        def flag(node, qual, code, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(self.name, mod.relpath, node.lineno,
+                                    qual, code, message, detail))
+
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call) and is_jax_jit_call(n, mod):
+                self._check_jit_site(n, mod, index, flag)
+
+        # caller-side flow checks run per function body
+        for qual in sorted(mod.funcs):
+            self._check_caller(mod.funcs[qual], mod, flag)
+
+    # -- missing-donation at the jit site ----------------------------------
+    def _check_jit_site(self, jit_call, mod, index, flag):
+        qual = enclosing_qualname(mod, jit_call, default="")
+        encl = mod.funcs.get(qual)
+        targets = _jit_targets(jit_call, mod, qual, index)
+        relevant = []
+        for fi in targets:
+            if fi.qualname in self._surfaces_of(fi.module) or \
+                    fi.is_surface:
+                relevant.append(fi)
+        if not relevant and encl is not None and encl.is_surface:
+            # hapi-style builder: jit inside a @jit_surface builder
+            relevant = targets
+        if not relevant:
+            return
+        has_donation, _ = _donated_positions(jit_call)
+        if has_donation:
+            return
+        for fi in relevant:
+            state = _state_params(fi.node)
+            if not state:
+                continue
+            flag(jit_call, qual or fi.qualname, "missing-donation",
+                 f"jit surface `{fi.qualname}` takes state-tree "
+                 f"argument(s) {state} but the jax.jit call declares no "
+                 "donate_argnums — un-donated state round-trips HBM "
+                 "every dispatch (input and output buffers both live). "
+                 "Donate the consumed trees, or pragma this line with "
+                 "the reason they must outlive the call",
+                 fi.qualname)
+
+    # -- caller-side flow: use-after-donate / double / reentry -------------
+    def _check_caller(self, fi, mod, flag):
+        body = fi.node
+        donating = {}   # name -> set(donated positions)
+        jitted = set()  # names bound to any jitted callable
+
+        # first sweep: bindings, plus assign-targets of each call so
+        # `params = g(params, x)` rebinds (the donated name now holds
+        # the RESULT, which is valid)
+        call_targets = {}
+        for n in ast.walk(body):
+            if not isinstance(n, ast.Assign):
+                continue
+            if isinstance(n.value, ast.Call):
+                names = [x for t in n.targets
+                         for x in assign_names(t)]
+                call_targets[id(n.value)] = names
+            jc = _unwrap_jit(n.value, mod)
+            if jc is None:
+                continue
+            has, pos = _donated_positions(jc)
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    jitted.add(t.id)
+                    if has and pos:
+                        donating[t.id] = set(pos)
+
+        if not jitted:
+            return
+
+        # second sweep: calls in line order; then uses after them
+        events = []   # (lineno, col, kind, payload)
+        for n in ast.walk(body):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                if n.func.id in jitted:
+                    events.append((n.lineno, n.col_offset, "call", n))
+            elif isinstance(n, ast.Name):
+                events.append((n.lineno, n.col_offset,
+                               "store" if isinstance(n.ctx, ast.Store)
+                               else "load", n))
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        donated_vars = {}   # name -> (call node, position)
+        for lineno, col, kind, n in events:
+            if kind == "call":
+                fname = n.func.id
+                pos = donating.get(fname, set())
+                seen = {}
+                for i, a in enumerate(n.args):
+                    if not isinstance(a, ast.Name):
+                        continue
+                    if a.id in donated_vars:
+                        call0, p0 = donated_vars[a.id]
+                        if call0 is not n:
+                            flag(n, fi.qualname, "donated-reentry",
+                                 f"`{a.id}` was donated to "
+                                 f"`{call0.func.id}` (arg {p0}) and is "
+                                 f"re-entered into jitted `{fname}` — "
+                                 "the donated buffer is invalid (or "
+                                 "silently aliased); thread the "
+                                 "returned value instead",
+                                 f"{a.id}->{fname}")
+                            donated_vars.pop(a.id, None)
+                    if i in pos:
+                        if a.id in seen:
+                            flag(n, fi.qualname, "double-donation",
+                                 f"`{a.id}` is passed in two donated "
+                                 f"positions ({seen[a.id]} and {i}) of "
+                                 f"one call — XLA aliases one backing "
+                                 "buffer to two outputs; pass "
+                                 "independent buffers (cf. "
+                                 "_reown_params in nn/layer/"
+                                 "transformer.py)",
+                                 f"{a.id}:{seen[a.id]}:{i}")
+                        else:
+                            seen[a.id] = i
+                            # `params = g(params, x)` rebinds the name
+                            # to the RESULT — don't track it as dead;
+                            # double-donation above still sees it
+                            if a.id not in call_targets.get(id(n), ()):
+                                donated_vars[a.id] = (n, i)
+            elif kind == "store" and n.id in donated_vars:
+                del donated_vars[n.id]     # rebound: old binding gone
+            elif kind == "load" and n.id in donated_vars:
+                call0, p0 = donated_vars[n.id]
+                # the donating call's own argument list re-walks here —
+                # ignore loads on the call line at/after its column
+                if n.lineno < call0.lineno or (
+                        n.lineno == call0.lineno and
+                        n.col_offset <= call0.col_offset):
+                    continue
+                end = getattr(call0, "end_lineno", call0.lineno)
+                if call0.lineno <= n.lineno <= end:
+                    continue
+                flag(n, fi.qualname, "use-after-donate",
+                     f"`{n.id}` was donated to `{call0.func.id}` "
+                     f"(arg {p0}) and read afterwards — the buffer is "
+                     "invalidated by donation; use the call's returned "
+                     "value (or drop the donation)",
+                     f"{n.id}")
+                del donated_vars[n.id]

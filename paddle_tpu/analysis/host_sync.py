@@ -15,7 +15,7 @@ finding — there is no legal budget for a sync inside a trace.
 """
 import ast
 
-from .base import Finding, call_terminal, dotted
+from .base import Finding, call_terminal, dotted, enclosing_qualname
 from .allowlist import (MONITORED_MODULES, SYNC_CALLEES, NUMPY_SYNC_FUNCS,
                         HOST_SYNC_ALLOWLIST, EXTRA_JIT_SURFACES)
 
@@ -41,20 +41,6 @@ def _sync_callee(call, mod):
             if target == "numpy" or target.startswith("numpy."):
                 return term
     return None
-
-
-def _enclosing_qualname(mod, node):
-    """Qualname of the innermost function containing ``node`` (top-level
-    of that function counts; nested defs map to the nested qualname)."""
-    best, best_span = "<module>", None
-    for qual, fi in mod.funcs.items():
-        f = fi.node
-        end = getattr(f, "end_lineno", f.lineno)
-        if f.lineno <= node.lineno <= end:
-            span = end - f.lineno
-            if best_span is None or span < best_span:
-                best, best_span = qual, span
-    return best
 
 
 class HostSyncPass:
@@ -85,7 +71,7 @@ class HostSyncPass:
             callee = _sync_callee(n, mod)
             if callee is None:
                 continue
-            qual = _enclosing_qualname(mod, n)
+            qual = enclosing_qualname(mod, n)
             in_surface = any(qual == s or qual.startswith(s + ".")
                              for s in surfaces)
             if in_surface:

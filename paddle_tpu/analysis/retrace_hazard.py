@@ -1,0 +1,371 @@
+"""Retrace-hazard pass: the static complement of the runtime
+``compile_retrace`` sentinel (observability/compilestats.py).
+
+The sentinel catches a silent recompile *after it happened*; this pass
+flags the key/static-arg constructions that cause them *before they
+ship*.  The hazard classes (the compilestats docstring's "jit
+cache-miss class of perf bug", made lintable):
+
+- ``unbucketed-shape-key`` — a jit cache key (or static argument) built
+  from a *data-dependent* dynamic extent: ``len(prompt)`` /
+  ``ids.shape`` interpolated into the key compiles one executable per
+  request shape.  Route the extent through a bucketing helper first
+  (anything named ``*bucket*`` exempts the component — the serving
+  engine's ``_bucket_for`` discipline), or pragma the line where the
+  per-shape compile is the documented contract (``generate()``).
+- ``float-cache-key`` — a *computed* float as a key component: any
+  jitter in the value (a ratio, a schedule read) is an unbounded
+  retrace stream.  ``float(<plain parameter>)`` canonicalizations are
+  exempt — bounded user knobs, exact dict equality.
+- ``unordered-key-part`` — dict/set iteration order feeding a cache key
+  or static argument (``tuple(set(...))``, ``d.keys()`` unsorted): the
+  key varies run-to-run, so warm caches go cold.  Wrap in
+  ``sorted(...)``.
+- ``uncached-jit-call`` — ``jax.jit(f)(...)`` called inline: the jit
+  object is rebuilt (and the program retraced) on every call; hoist the
+  jit into a cache or a build-once closure.
+
+Findings are attributed to the SAME surface-name labels the
+``pt_compile_*`` telemetry uses: the pass reads the surface string from
+the ``compilestats.wrap(...)`` / ``_tracked(...)`` call wrapping the
+stored jit (falling back to ``allowlist.SURFACE_LABELS``), so a static
+finding and the runtime retrace event for one surface share one
+vocabulary (``docs/observability.md``).  Sites that resolve no label
+report ``<unlabeled>`` — wrap them.
+"""
+import ast
+
+from .base import (Finding, call_terminal, dotted, is_jax_jit_call,
+                   assign_names, enclosing_qualname, int_literals,
+                   param_names, WRAP_CALLEES)
+from .allowlist import (COMPILE_SURFACES, SURFACE_LABELS,
+                        RETRACE_DATA_TOKENS)
+
+PASS_NAME = "retrace-hazard"
+
+_SHAPEY_CALL_FRAGMENTS = ("shape", "len", "sig")
+
+
+def _find_jit(expr, mod):
+    """The jax.jit Call nested anywhere in ``expr`` (through wrappers,
+    tuples, builder-call args), or None."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and is_jax_jit_call(n, mod):
+            return n
+    return None
+
+
+def _wrap_labels(expr):
+    """Surface-name string literals passed to compilestats wrappers
+    inside ``expr``."""
+    out = []
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and \
+                call_terminal(n.func) in WRAP_CALLEES:
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                for c in ast.walk(a):
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, str) and \
+                            c.value in COMPILE_SURFACES:
+                        out.append(c.value)
+    return sorted(set(out))
+
+
+def _is_surface_builder_store(value, mod, index, qual):
+    """True when the stored value builds a compiled surface without a
+    visible jax.jit — ``self._tracked(self._build_train(...), ...)``:
+    a wrapper call whose argument invokes a @jit_surface builder."""
+    for n in ast.walk(value):
+        if isinstance(n, ast.Call) and \
+                call_terminal(n.func) in WRAP_CALLEES:
+            for a in n.args:
+                if isinstance(a, ast.Call):
+                    fi = index.resolve_call(mod, qual, a.func)
+                    if fi is not None and fi.is_surface:
+                        return True
+    return False
+
+
+def _is_dataish(name):
+    toks = set(name.lower().split("_"))
+    return bool(toks & RETRACE_DATA_TOKENS)
+
+
+class _FnFacts:
+    """Per-function name facts: which locals are data-derived, which
+    carry data-derived *shape* extents, and the latest visible
+    assignment expression per name."""
+
+    def __init__(self, fnode):
+        self.data = {p for p in param_names(fnode) if _is_dataish(p)}
+        self.shapeish = set()
+        self.assigns = {}   # name -> value expr (last one wins)
+        for _ in range(3):
+            before = (len(self.data), len(self.shapeish))
+            for n in ast.walk(fnode):
+                if not isinstance(n, ast.Assign):
+                    continue
+                names = [x for t in n.targets for x in assign_names(t)]
+                for name in names:
+                    self.assigns[name] = n.value
+                mentions_data = any(
+                    isinstance(c, ast.Name) and c.id in self.data
+                    for c in ast.walk(n.value))
+                if mentions_data:
+                    self.data.update(names)
+                    if self._shape_extract(n.value) and \
+                            not _through_bucket(n.value):
+                        self.shapeish.update(names)
+                # a shape extent only stays an extent through SCALAR
+                # arithmetic (MAX = P + n); flowing into an array/
+                # container/str kills the taint (mask = zeros((B, MAX)))
+                if self._scalar_expr(n.value) and any(
+                        isinstance(c, ast.Name) and c.id in self.shapeish
+                        for c in ast.walk(n.value)):
+                    self.shapeish.update(names)
+            if (len(self.data), len(self.shapeish)) == before:
+                break
+
+    _SCALAR_FUNCS = frozenset({"int", "min", "max", "abs", "round",
+                               "len"})
+
+    def _scalar_expr(self, expr):
+        """True when ``expr`` is pure scalar arithmetic over names and
+        constants (the shape-extent-preserving shapes)."""
+        for c in ast.walk(expr):
+            if isinstance(c, (ast.Name, ast.Constant, ast.BinOp,
+                              ast.UnaryOp, ast.IfExp, ast.Compare,
+                              ast.BoolOp, ast.Load, ast.Tuple)):
+                continue
+            if isinstance(c, ast.Call) and \
+                    isinstance(c.func, ast.Name) and \
+                    c.func.id in self._SCALAR_FUNCS:
+                continue
+            if isinstance(c, (ast.Attribute, ast.Subscript)):
+                continue          # x.shape[0]-style extent reads
+            if isinstance(c, (ast.operator, ast.unaryop, ast.cmpop,
+                              ast.boolop, ast.expr_context)):
+                continue
+            return False
+        return True
+
+    def _shape_extract(self, expr):
+        """Does ``expr`` read a dynamic extent off a data value —
+        ``x.shape`` / ``len(x)`` with x data-derived?"""
+        for c in ast.walk(expr):
+            if isinstance(c, ast.Attribute) and c.attr == "shape" and \
+                    isinstance(c.value, ast.Name) and \
+                    c.value.id in self.data:
+                return True
+            if isinstance(c, ast.Call) and \
+                    isinstance(c.func, ast.Name) and c.func.id == "len" \
+                    and any(isinstance(a, ast.Name) and a.id in self.data
+                            for a in c.args):
+                return True
+        return False
+
+
+def _through_bucket(expr):
+    """A component routed through anything named ``*bucket*`` is
+    bounded by construction."""
+    for c in ast.walk(expr):
+        if isinstance(c, ast.Call):
+            name = dotted(c.func) or ""
+            if "bucket" in name.lower():
+                return True
+        if isinstance(c, ast.Name) and "bucket" in c.id.lower():
+            return True
+    return False
+
+
+def _components(key_expr):
+    if isinstance(key_expr, (ast.Tuple, ast.List)):
+        return list(key_expr.elts)
+    return [key_expr]
+
+
+def _surface_label(mod, qual, store_value):
+    labels = _wrap_labels(store_value) if store_value is not None else []
+    if not labels and qual:
+        fi = mod.funcs.get(qual)
+        if fi is not None:
+            labels = _wrap_labels(fi.node)
+    if labels:
+        return "|".join(labels)
+    for (rel, q), label in SURFACE_LABELS.items():
+        if q == qual and (mod.relpath == rel or
+                          mod.relpath.endswith("/" + rel)):
+            return label
+    return "<unlabeled>"
+
+
+class RetraceHazardPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.index.iter_modules():
+            self._scan(mod, ctx.index, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    def _scan(self, mod, index, findings):
+        def flag(node, qual, code, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(self.name, mod.relpath, node.lineno,
+                                    qual, code, message, detail))
+
+        facts_cache = {}
+
+        def facts_for(qual):
+            fi = mod.funcs.get(qual)
+            if fi is None:
+                return None
+            if qual not in facts_cache:
+                facts_cache[qual] = _FnFacts(fi.node)
+            return facts_cache[qual]
+
+        static_jits = {}   # (qual, name) -> static positions
+
+        for n in ast.walk(mod.tree):
+            # uncached-jit-call: jax.jit(f)(...) inline
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Call) \
+                    and is_jax_jit_call(n.func, mod):
+                qual = enclosing_qualname(mod, n, default="")
+                flag(n, qual, "uncached-jit-call",
+                     "`jax.jit(f)(...)` rebuilds the jit object (and "
+                     "retraces) on every call — bind it once and cache "
+                     "per signature (compilestats.wrap gives the cached "
+                     "surface telemetry for free)", "inline-jit")
+                continue
+            if not isinstance(n, ast.Assign):
+                continue
+            jit_call = _find_jit(n.value, mod)
+            qual = enclosing_qualname(mod, n, default="")
+            # record static_argnums bindings for the call-site check
+            if jit_call is not None:
+                for kw in jit_call.keywords:
+                    if kw.arg == "static_argnums":
+                        pos = int_literals(kw.value)
+                        for t in n.targets:
+                            if isinstance(t, ast.Name) and pos:
+                                static_jits[(qual, t.id)] = pos
+            # jit-cache-key sites: a Subscript store whose value holds a
+            # jit (or builds a tracked surface)
+            subs = [t for t in n.targets if isinstance(t, ast.Subscript)]
+            if not subs:
+                continue
+            if jit_call is None and not _is_surface_builder_store(
+                    n.value, mod, index, qual):
+                continue
+            facts = facts_for(qual)
+            if facts is None:
+                continue
+            label = _surface_label(mod, qual, n.value)
+            for sub in subs:
+                key_expr = sub.slice
+                anchor = key_expr
+                if isinstance(key_expr, ast.Name):
+                    resolved = facts.assigns.get(key_expr.id)
+                    if resolved is not None:
+                        anchor = resolved
+                        key_expr = resolved
+                self._check_key(key_expr, anchor, qual, label, facts,
+                                mod, flag)
+
+        # static-argnum call sites
+        if static_jits:
+            for n in ast.walk(mod.tree):
+                if not (isinstance(n, ast.Call) and
+                        isinstance(n.func, ast.Name)):
+                    continue
+                qual = enclosing_qualname(mod, n, default="")
+                pos = static_jits.get((qual, n.func.id))
+                if not pos:
+                    continue
+                facts = facts_for(qual)
+                if facts is None:
+                    continue
+                label = _surface_label(mod, qual, None)
+                for i in pos:
+                    if i < len(n.args):
+                        self._check_key(n.args[i], n, qual, label, facts,
+                                        mod, flag, where="static arg")
+
+    # -- component rules ---------------------------------------------------
+    def _check_key(self, key_expr, anchor, qual, label, facts, mod, flag,
+                   where="cache key"):
+        seen = set()
+        for comp in _components(key_expr):
+            if _through_bucket(comp):
+                continue
+            code, tok = self._classify(comp, facts)
+            if code is None or (code, tok) in seen:
+                continue
+            seen.add((code, tok))
+            text = ast.unparse(comp)[:50]
+            if code == "unbucketed-shape-key":
+                msg = (f"{where} component `{text}` is a data-dependent "
+                       "dynamic extent — one compile per request shape "
+                       "(the compile_retrace sentinel fires at runtime; "
+                       "this is the same bug before it ships).  Bucket "
+                       "the extent (cf. ServingEngine._bucket_for) or "
+                       "pragma with the documented per-shape contract")
+            elif code == "float-cache-key":
+                msg = (f"{where} component `{text}` is a computed float "
+                       "— any jitter retraces; canonicalize to a "
+                       "bounded knob or quantize before keying")
+            else:
+                msg = (f"{where} component `{text}` iterates a dict/set "
+                       "— hash order varies run-to-run, so the key "
+                       "never matches a warm cache; wrap in sorted()")
+            flag(anchor, qual, code,
+                 f"[surface={label}] {msg}", f"{label}:{tok}")
+
+    def _classify(self, comp, facts):
+        # unordered: set/dict-view iteration not wrapped in sorted()
+        for c in ast.walk(comp):
+            if isinstance(c, ast.Call) and isinstance(c.func, ast.Name) \
+                    and c.func.id == "sorted":
+                break
+        else:
+            for c in ast.walk(comp):
+                if isinstance(c, (ast.Set, ast.SetComp)):
+                    return "unordered-key-part", "set"
+                if isinstance(c, ast.Call):
+                    if isinstance(c.func, ast.Name) and \
+                            c.func.id in ("set", "frozenset"):
+                        return "unordered-key-part", c.func.id
+                    if isinstance(c.func, ast.Attribute) and \
+                            c.func.attr in ("keys", "values", "items"):
+                        return "unordered-key-part", c.func.attr
+        # shape: data-derived extents
+        if facts._shape_extract(comp):
+            return "unbucketed-shape-key", "shape"
+        for c in ast.walk(comp):
+            if isinstance(c, ast.Name) and c.id in facts.shapeish:
+                return "unbucketed-shape-key", c.id
+            if isinstance(c, ast.Call):
+                name = (dotted(c.func) or "").lower()
+                leaf = name.rsplit(".", 1)[-1]
+                if any(f in leaf for f in _SHAPEY_CALL_FRAGMENTS) and \
+                        any(isinstance(a, ast.Name) and
+                            (a.id in facts.data or a.id in facts.shapeish)
+                            for a in c.args):
+                    return "unbucketed-shape-key", leaf
+        # computed floats
+        for c in ast.walk(comp):
+            if isinstance(c, ast.Call) and isinstance(c.func, ast.Name) \
+                    and c.func.id == "float" and c.args:
+                arg = c.args[0]
+                plain = True
+                for x in ast.walk(arg):
+                    if isinstance(x, ast.Call):
+                        plain = False
+                    if isinstance(x, ast.Name) and (
+                            x.id in facts.assigns or
+                            x.id in facts.shapeish):
+                        plain = False
+                if not plain:
+                    return "float-cache-key", "float"
+        return None, None

@@ -26,11 +26,16 @@ def _passes():
     from .tracer_safety import TracerSafetyPass
     from .host_sync import HostSyncPass
     from .collective_order import CollectiveOrderPass
+    from .donation import DonationPass
+    from .retrace_hazard import RetraceHazardPass
+    from .concurrency import ConcurrencyPass
     from .registry_lints import (FailpointRefsPass, GuardianLogSchemaPass,
                                  MetricNamesPass)
     return {p.name: p for p in (TracerSafetyPass, HostSyncPass,
-                                CollectiveOrderPass, FailpointRefsPass,
-                                GuardianLogSchemaPass, MetricNamesPass)}
+                                CollectiveOrderPass, DonationPass,
+                                RetraceHazardPass, ConcurrencyPass,
+                                FailpointRefsPass, GuardianLogSchemaPass,
+                                MetricNamesPass)}
 
 
 def _optional_passes():
@@ -118,7 +123,8 @@ def run_passes(paths=None, passes=None, root=None, ctx=None):
         known = sorted(set(_passes()) | set(_optional_passes()))
         raise ValueError(f"unknown pass(es) {unknown}; known: {known}")
     findings = []
-    ast_passes = {"tracer-safety", "host-sync", "collective-order"}
+    ast_passes = {"tracer-safety", "host-sync", "collective-order",
+                  "donation", "retrace-hazard", "concurrency"}
     if any(n in ast_passes for n in names):
         for rel, msg in ctx.index.errors:
             findings.append(Finding("parse", rel, 1, "<module>",
@@ -168,6 +174,37 @@ def split_new(findings, baseline_counts):
     return new, old
 
 
+# -- changed-only scoping --------------------------------------------------
+
+def git_changed_files(root):
+    """Repo files changed vs HEAD (staged + unstaged) plus untracked,
+    filtered to the extensions the passes read and to files that still
+    exist.  Used by ``--changed-only`` so the inner loop lints the diff
+    while CI stays exhaustive."""
+    import subprocess
+    out = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"--changed-only needs git: {e}")
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only: `{' '.join(cmd)}` failed: "
+                f"{res.stderr.strip()}")
+        out.extend(res.stdout.splitlines())
+    files = []
+    for rel in sorted(set(out)):
+        if not rel.endswith((".py", ".md")):
+            continue
+        path = os.path.join(root, rel)
+        if os.path.exists(path):          # deleted files have no AST
+            files.append(path)
+    return files
+
+
 # -- CLI -------------------------------------------------------------------
 
 def main(argv=None):
@@ -191,6 +228,10 @@ def main(argv=None):
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to the current findings "
                          "and exit 0")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs git HEAD (plus "
+                         "untracked) — the inner-loop mode; CI runs "
+                         "the full sweep")
     args = ap.parse_args(argv)
 
     if args.list_passes:
@@ -202,8 +243,27 @@ def main(argv=None):
 
     passes = [p.strip() for p in args.passes.split(",")] \
         if args.passes else None
+    paths = args.paths or None
+    if args.changed_only:
+        if paths:
+            print("error: --changed-only and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        if args.update_baseline:
+            print("error: --update-baseline needs the full default "
+                  "tree, not a --changed-only subset", file=sys.stderr)
+            return 2
+        try:
+            paths = git_changed_files(REPO_ROOT)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("OK: no changed .py/.md files vs HEAD "
+                  "(--changed-only)")
+            return 0
     try:
-        ctx = make_context(args.paths or None)
+        ctx = make_context(paths)
         findings = run_passes(passes=passes, ctx=ctx)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

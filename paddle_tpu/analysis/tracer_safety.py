@@ -26,7 +26,8 @@ Mechanics:
 """
 import ast
 
-from .base import Finding, call_terminal, dotted
+from .base import Finding, call_terminal, dotted, assign_names, \
+    param_names
 from .allowlist import EXTRA_JIT_SURFACES, STATIC_FUNCS, STATIC_ATTRS
 
 PASS_NAME = "tracer-safety"
@@ -106,32 +107,12 @@ def _expr_tainted(expr, tainted, mod, containers=frozenset()):
     return False
 
 
-def _assign_names(target):
-    if isinstance(target, ast.Name):
-        yield target.id
-    elif isinstance(target, (ast.Tuple, ast.List)):
-        for e in target.elts:
-            yield from _assign_names(e)
-    elif isinstance(target, ast.Starred):
-        yield from _assign_names(target.value)
-
-
-def _param_names(fnode):
-    a = fnode.args
-    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
-    if a.vararg:
-        names.append(a.vararg.arg)
-    if a.kwarg:
-        names.append(a.kwarg.arg)
-    return [n for n in names if n not in ("self", "cls")]
-
-
 _CONTAINER_CTORS = ("dict", "list", "set", "tuple", "frozenset")
 
 
 def _compute_taint(fnode, mod, taint_params):
     """Returns (tainted names, container-of-traced names)."""
-    tainted = set(_param_names(fnode)) if taint_params else set()
+    tainted = set(param_names(fnode)) if taint_params else set()
     containers = set()
     for _ in range(3):                     # small fixpoint: 3 rounds cover
         before = len(tainted)              # realistic chain depths
@@ -139,17 +120,17 @@ def _compute_taint(fnode, mod, taint_params):
             if isinstance(n, ast.Assign):
                 if _expr_tainted(n.value, tainted, mod):
                     for t in n.targets:
-                        tainted.update(_assign_names(t))
+                        tainted.update(assign_names(t))
                     v = n.value
                     if isinstance(v, ast.Call) and \
                             isinstance(v.func, ast.Name) and \
                             v.func.id in _CONTAINER_CTORS:
                         for t in n.targets:
-                            containers.update(_assign_names(t))
+                            containers.update(assign_names(t))
             elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
                 if n.value is not None and \
                         _expr_tainted(n.value, tainted, mod):
-                    tainted.update(_assign_names(n.target))
+                    tainted.update(assign_names(n.target))
             elif isinstance(n, (ast.For, ast.AsyncFor)):
                 if _expr_tainted(n.iter, tainted, mod):
                     it = n.iter
@@ -162,12 +143,12 @@ def _compute_taint(fnode, mod, taint_params):
                             len(n.target.elts) == 2:
                         # the index is a host int; only the element is
                         # traced
-                        tainted.update(_assign_names(n.target.elts[1]))
+                        tainted.update(assign_names(n.target.elts[1]))
                     else:
-                        tainted.update(_assign_names(n.target))
+                        tainted.update(assign_names(n.target))
             elif isinstance(n, ast.NamedExpr):
                 if _expr_tainted(n.value, tainted, mod):
-                    tainted.update(_assign_names(n.target))
+                    tainted.update(assign_names(n.target))
         if len(tainted) == before:
             break
     return tainted, containers

@@ -24,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ....analysis import register_jit_surface
 from ....nn.layer.layers import Layer
 from ....framework.core import Tensor
 from ....framework import autograd as _ag
@@ -32,6 +33,13 @@ from ...engine import plan_from_hcg
 from .pp_layers import PipelineLayer
 
 __all__ = ["PipelineParallel"]
+
+# the compiled pipeline stepper body is a nested def — registered for
+# the tracer-safety/donation passes (mirrored by EXTRA_JIT_SURFACES in
+# paddle_tpu/analysis/allowlist.py).  Donation audit (ISSUE 11): the
+# jit donates (0, 2, 3, 4) — trainable/stacked/buffer/opt-state trees
+# are consumed and re-emitted; frozen params (1) stay live.
+register_jit_surface(__name__, "_PipelineStepper._build.step")
 
 
 def _apply_items(items, x):
@@ -179,7 +187,7 @@ class _PipelineStepper:
             grads = list(g_ot) + list(g_st)
             new_vals, new_opt = apply_functional_with_clip(
                 opt, train_vals, grads, opt_state, lr, param_names=pnames)
-            k = len(other_t)
+            k = len(other_t)  # lint: allow(len-on-traced) — python list of leaves, host-static
             return loss, new_vals[:k], new_vals[k:], new_buf, new_opt
 
         rep = self.plan.replicated()

@@ -9,6 +9,7 @@ model.
 """
 import collections
 import itertools
+import threading
 import time
 
 from ..observability import tracing as _tracing
@@ -113,11 +114,17 @@ class FCFSScheduler:
         self._free = list(range(num_slots - 1, -1, -1))  # pop() -> slot 0
         self._running = {}                               # slot -> Request
         self._ids = itertools.count()
+        # queue and free-list are the cross-thread boundary: router
+        # threads submit() while the engine loop admits/releases (the
+        # concurrency lint declares this class concurrent — see
+        # CONCURRENT_CLASSES in paddle_tpu/analysis/allowlist.py)
+        self._lock = threading.Lock()
 
     # -- queue -------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, callback=None):
         req = Request(next(self._ids), prompt, max_new_tokens, callback)
-        self._queue.append(req)
+        with self._lock:
+            self._queue.append(req)
         return req
 
     @property
@@ -146,34 +153,41 @@ class FCFSScheduler:
         one."""
         out = []
         budget = self.max_prefills_per_gap
-        while self._queue and self._free and \
-                (budget is None or len(out) < budget):
-            req = self._queue[0]
-            slot = self._free[-1]
-            if can_admit is not None and not can_admit(req, slot):
-                break
-            self._queue.popleft()
-            self._free.pop()
-            req.slot = slot
-            req.admit_ns = time.perf_counter_ns()
-            self._running[slot] = req
-            out.append((req, slot))
+        # the lock spans the whole check-then-act region (queue peek ->
+        # pop -> slot bind), including the can_admit gate: the paged
+        # engine's page reservation must be atomic with the pop, and a
+        # racing submit() only ever APPENDS behind the head
+        with self._lock:
+            while self._queue and self._free and \
+                    (budget is None or len(out) < budget):
+                req = self._queue[0]
+                slot = self._free[-1]
+                if can_admit is not None and not can_admit(req, slot):
+                    break
+                self._queue.popleft()
+                self._free.pop()
+                req.slot = slot
+                req.admit_ns = time.perf_counter_ns()
+                self._running[slot] = req
+                out.append((req, slot))
         return out
 
     def release(self, slot):
         """Return a finished slot to the free list."""
-        req = self._running.pop(slot)
-        self._free.append(slot)
+        with self._lock:
+            req = self._running.pop(slot)
+            self._free.append(slot)
         return req
 
     def requeue(self, slot):
         """Preempt an in-flight request back to the FRONT of the queue
         (page-pressure eviction): the slot frees, the request keeps its
         streamed tokens and resumes by recompute at re-admission."""
-        req = self._running.pop(slot)
-        self._free.append(slot)
-        req.slot = None
-        req.evictions += 1
-        req.requeue_ns = time.perf_counter_ns()
-        self._queue.appendleft(req)
+        with self._lock:
+            req = self._running.pop(slot)
+            self._free.append(slot)
+            req.slot = None
+            req.evictions += 1
+            req.requeue_ns = time.perf_counter_ns()
+            self._queue.appendleft(req)
         return req

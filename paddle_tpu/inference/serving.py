@@ -33,6 +33,7 @@ guardian structured log (``serving_admit``/``serving_finish``/
 ``serving_stats``) and profiler ``RecordEvent`` spans.  See
 ``docs/serving.md``.
 """
+import threading
 import time
 
 import numpy as np
@@ -174,6 +175,13 @@ class ServingEngine:
                                    or num_pages is not None):
             raise ValueError("kv_dtype/num_pages require kv_mode='paged'")
         self._paged = kv_mode == "paged"
+        # submit() is the engine's only cross-thread entry (router
+        # threads, ahead of the multi-replica tier); the lock covers
+        # the state submit shares with the owner loop (stats, the
+        # scheduler rebind in reset) — see CONCURRENT_CLASSES.
+        # RLock: reset() holds it across the whole scheduler+stats
+        # transition while _init_state re-enters for the stats rebind.
+        self._lock = threading.RLock()
         self.model = model
         cfg = getattr(model, "config", None) \
             or getattr(getattr(model, "model", None), "config", None)
@@ -345,18 +353,25 @@ class ServingEngine:
                 if self._model_draft else None
         else:
             self._history = self._draft_caches = None
-        self.stats = {"requests": 0, "finished": 0, "decoded_tokens": 0,
-                      "chunks": 0, "prefills": 0, "ttft_ms": [],
-                      "max_concurrent": 0, "page_evictions": 0,
-                      "spec_proposed": 0, "spec_accepted": 0,
-                      "spec_verify_steps": 0, "spec_chunks": 0}
+        with self._lock:
+            self.stats = {"requests": 0, "finished": 0,
+                          "decoded_tokens": 0, "chunks": 0,
+                          "prefills": 0, "ttft_ms": [],
+                          "max_concurrent": 0, "page_evictions": 0,
+                          "spec_proposed": 0, "spec_accepted": 0,
+                          "spec_verify_steps": 0, "spec_chunks": 0}
 
     def reset(self):
         """Drop all queued/in-flight work and zero the device state (the
         compiled programs are kept — bench reruns pay tracing once)."""
-        self.scheduler = FCFSScheduler(self.num_slots,
-                                       self.scheduler.max_prefills_per_gap)
-        self._init_state()
+        # one critical section for the whole transition: a racing
+        # submit() lands entirely before (its request dropped with the
+        # old queue, counted in the old stats) or entirely after (new
+        # scheduler, new stats) — never split across the two
+        with self._lock:
+            self.scheduler = FCFSScheduler(
+                self.num_slots, self.scheduler.max_prefills_per_gap)
+            self._init_state()
 
     def refresh_weights(self):
         """Re-snapshot parameter values (after a train step swapped the
@@ -420,8 +435,16 @@ class ServingEngine:
                     f"the pool has {self._kv.num_pages - 1} allocatable "
                     f"pages — raise num_pages (or page_size) or lower "
                     "max_new_tokens")
-        self.stats["requests"] += 1
-        return self.scheduler.submit(prompt, max_new_tokens, callback)
+        # the lock spans the scheduler handoff: a submit racing reset()
+        # must land entirely on the old scheduler (whose queued work
+        # reset drops) or entirely on the new one — never return a
+        # Request parked on an abandoned queue after the new stats
+        # dict already counted it.  Lock order is engine -> scheduler
+        # (nothing takes them in reverse).
+        with self._lock:
+            self.stats["requests"] += 1
+            return self.scheduler.submit(prompt, max_new_tokens,
+                                         callback)
 
     def step(self):
         """One engine cycle: admit queued requests into free slots

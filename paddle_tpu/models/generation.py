@@ -447,7 +447,9 @@ def generate(model, input_ids, max_new_tokens=32,
     # knobs that don't apply to the chosen strategy are canonicalized so
     # they can't force a spurious retrace (they're ignored by the math)
     sampling = decode_strategy == "sampling"
-    sig = (B, P, max_new_tokens, decode_strategy,
+    # generate() is the one-shot API and compiles per (B, P) by
+    # documented contract — the serving engine is the bucketed path
+    sig = (B, P, max_new_tokens, decode_strategy,  # lint: allow(unbucketed-shape-key)
            float(temperature) if sampling else 1.0,
            int(top_k or 0) if sampling else 0,
            float(top_p if top_p is not None else 1.0) if sampling else 1.0,
@@ -457,7 +459,17 @@ def generate(model, input_ids, max_new_tokens=32,
     jit_cache = _caches_for(model)["jit"]
     fn = jit_cache.get(sig)
     if fn is None:
-        fn = jit_cache[sig] = jax.jit(beam_run if beam else run)
+        # prompt ids, PRNG key and pad mask are fresh per call and
+        # consumed by the decode — donate them so XLA reuses the
+        # buffers (the weights in position 0 stay live: the model owns
+        # them).  compilestats.wrap puts the decode on the same
+        # pt_compile_* surface vocabulary as the serving jits (no
+        # retrace budget: the sig-keyed cache legitimately owns one
+        # compile per entry, so each wrapper compiles exactly once).
+        from ..observability import compilestats as _cstats
+        fn = jit_cache[sig] = _cstats.wrap(
+            jax.jit(beam_run if beam else run, donate_argnums=(1, 2, 3)),
+            "generation.decode", budget=1)
     # MoE gates record their aux loss as a side-effect attribute during
     # forward; inside the jitted scan that value is a tracer, and leaving
     # it behind would crash the next aux_loss()/get_loss() read — restore
@@ -467,10 +479,18 @@ def generate(model, input_ids, max_new_tokens=32,
              if isinstance(m, BaseGate)]
     saved_losses = [g.loss for g in gates]
     try:
-        out_ids, out_sc = fn(pvals, jnp.asarray(ids_np),
-                             jax.random.key(int(seed)),
-                             None if mask_np is None
-                             else jnp.asarray(mask_np))
+        import warnings
+        with warnings.catch_warnings():
+            # donation usability is backend-dependent: on TPU the
+            # prompt/key/mask buffers alias scan temporaries; the CPU
+            # proxy can decline some (it still frees them early) and
+            # warns once per compile — deliberate, not actionable here
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            out_ids, out_sc = fn(pvals, jnp.asarray(ids_np),
+                                 jax.random.key(int(seed)),
+                                 None if mask_np is None
+                                 else jnp.asarray(mask_np))
     finally:
         for g, l in zip(gates, saved_losses):
             object.__setattr__(g, "loss", l)

@@ -20,9 +20,17 @@ from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework.random import rng_scope
 from .gpt import GPTConfig, GPTForPretraining
+from ..analysis import register_jit_surface
 from ..distributed.pipeline import spmd_pipeline, stack_block_params
 
 __all__ = ["build_hybrid_gpt", "hybrid_train_step"]
+
+# the hybrid stepper's compiled body is a nested def — registered for
+# the tracer-safety/donation passes (mirrored by EXTRA_JIT_SURFACES in
+# paddle_tpu/analysis/allowlist.py).  Donation audit (ISSUE 11): the
+# jit donates (0, 1) — other params + stacked block params are consumed
+# by the update and returned as new state.
+register_jit_surface(__name__, "build_hybrid_gpt.step")
 
 
 def _capture(layer):

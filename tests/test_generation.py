@@ -453,3 +453,68 @@ class TestEosAndErrors:
             assert gpt.training
         finally:
             gpt.eval()
+
+
+class TestDonationRegression:
+    """ISSUE 11: the decode/beam jits donate their per-call inputs
+    (prompt ids, PRNG key, pad mask — the weights stay live).  The
+    contract mirrors the PR 7 serving-donation tests: donation must be
+    bitwise-invisible, and steady-state repeated decode must not
+    accumulate live device buffers call over call."""
+
+    def _live(self):
+        import gc
+        import jax
+        gc.collect()
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.live_arrays())
+
+    def test_greedy_bitwise_and_live_bytes_flat(self, gpt):
+        rng = np.random.RandomState(11)
+        ids = rng.randint(0, 1024, (2, 6)).astype("int32")
+        ref, ref_sc = gpt.generate(paddle.to_tensor(ids),
+                                   max_new_tokens=8)
+        ref = np.asarray(ref._value).copy()
+        ref_sc = np.asarray(ref_sc._value).copy()
+        base = self._live()
+        sizes = []
+        for _ in range(4):
+            out, sc = gpt.generate(paddle.to_tensor(ids),
+                                   max_new_tokens=8)
+            np.testing.assert_array_equal(np.asarray(out._value), ref)
+            np.testing.assert_array_equal(np.asarray(sc._value), ref_sc)
+            del out, sc
+            sizes.append(self._live())
+        assert max(sizes) <= base, \
+            f"live device bytes grew across decodes: {base} -> {sizes}"
+
+    def test_beam_bitwise_and_live_bytes_flat(self, gpt):
+        rng = np.random.RandomState(12)
+        ids = rng.randint(0, 1024, (1, 5)).astype("int32")
+        kw = dict(max_new_tokens=6, decode_strategy="beam_search",
+                  num_beams=3, eos_token_id=0)
+        ref, _ = gpt.generate(paddle.to_tensor(ids), **kw)
+        ref = np.asarray(ref._value).copy()
+        base = self._live()
+        sizes = []
+        for _ in range(3):
+            out, _sc = gpt.generate(paddle.to_tensor(ids), **kw)
+            np.testing.assert_array_equal(np.asarray(out._value), ref)
+            del out, _sc
+            sizes.append(self._live())
+        assert max(sizes) <= base, \
+            f"live device bytes grew across decodes: {base} -> {sizes}"
+
+    def test_masked_prompt_donation_bitwise(self, gpt):
+        # the donated (B, MAX) pad mask path: left-padded ragged prompt
+        rng = np.random.RandomState(13)
+        ids = rng.randint(1, 1024, (2, 6)).astype("int32")
+        ids[1, :2] = 0
+        mask = np.ones((2, 6), np.int32)
+        mask[1, :2] = 0
+        kw = dict(max_new_tokens=5,
+                  attention_mask=paddle.to_tensor(mask))
+        ref, _ = gpt.generate(paddle.to_tensor(ids), **kw)
+        ref = np.asarray(ref._value).copy()
+        out, _ = gpt.generate(paddle.to_tensor(ids), **kw)
+        np.testing.assert_array_equal(np.asarray(out._value), ref)

@@ -1,0 +1,807 @@
+"""Graph-discipline lints (ISSUE 11): donation/aliasing, retrace-hazard
+and host-concurrency passes.
+
+Sibling of tests/test_static_analysis.py, same contract: every pass is
+exercised with seeded-violation fixtures it MUST flag and known-good
+idioms it must NOT, plus pragma/baseline interplay, the runner's
+--passes listing, the --changed-only scoping, a self-lint proving the
+real tree is clean, and the surface-label cross-reference against the
+compilestats vocabulary (static and runtime retrace findings share one
+language).
+"""
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import runner as runner_mod
+from paddle_tpu.analysis import allowlist
+from paddle_tpu.analysis.runner import (run_passes, make_context,
+                                        write_baseline, load_baseline,
+                                        split_new, REPO_ROOT)
+
+pytestmark = pytest.mark.lint
+
+NEW_PASSES = ["donation", "retrace-hazard", "concurrency"]
+
+
+def _lint(tmp_path, code, passes, name="fixture.py"):
+    (tmp_path / name).write_text(textwrap.dedent(code))
+    return run_passes(paths=[str(tmp_path)], passes=passes)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+class TestDonationPass:
+    def test_missing_donation_on_state_tree_surface(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            @jit_surface
+            def step(params, opt_state, lr):
+                return params, opt_state
+
+            f = jax.jit(step)
+            """, ["donation"])
+        assert _codes(found) == ["missing-donation"]
+        assert "donate_argnums" in found[0].message
+
+    def test_donated_surface_is_quiet(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            @jit_surface
+            def step(params, opt_state, lr):
+                return params, opt_state
+
+            f = jax.jit(step, donate_argnums=(0, 1))
+            """, ["donation"])
+        assert found == []
+
+    def test_no_state_tree_params_is_quiet(self, tmp_path):
+        # a surface over scalars/activations has nothing worth donating
+        found = _lint(tmp_path, """
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            @jit_surface
+            def kernel(x, scale):
+                return x * scale
+
+            f = jax.jit(kernel)
+            """, ["donation"])
+        assert found == []
+
+    def test_builder_pattern_checked_inside_surface(self, tmp_path):
+        # hapi style: @jit_surface on the BUILDER, jit on the nested def
+        found = _lint(tmp_path, """
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            class Stepper:
+                @jit_surface
+                def _build(self):
+                    def step(train_vals, opt_state, lr):
+                        return train_vals, opt_state
+                    return jax.jit(step)
+            """, ["donation"])
+        assert _codes(found) == ["missing-donation"]
+
+    def test_use_after_donate(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(params, batch):
+                return params
+
+            def train(params, batch):
+                g = jax.jit(step, donate_argnums=(0,))
+                new_params = g(params, batch)
+                return params[0] + new_params[0]
+            """, ["donation"])
+        assert _codes(found) == ["use-after-donate"]
+
+    def test_rebound_name_after_donate_is_quiet(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(params, batch):
+                return params
+
+            def train(params, batch):
+                g = jax.jit(step, donate_argnums=(0,))
+                params = g(params, batch)
+                return params[0]
+            """, ["donation"])
+        assert found == []
+
+    def test_double_donation_one_call(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(k_pool, v_pool):
+                return k_pool, v_pool
+
+            def serve(pool):
+                g = jax.jit(step, donate_argnums=(0, 1))
+                return g(pool, pool)
+            """, ["donation"])
+        assert _codes(found) == ["double-donation"]
+
+    def test_double_donation_survives_result_rebind(self, tmp_path):
+        # `pool = g(pool, pool)` rebinds the result, but the CALL still
+        # aliases one backing buffer into two donated positions
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(k_pool, v_pool):
+                return k_pool, v_pool
+
+            def serve(pool):
+                g = jax.jit(step, donate_argnums=(0, 1))
+                pool = g(pool, pool)
+                return pool
+            """, ["donation"])
+        assert _codes(found) == ["double-donation"]
+
+    def test_donated_reentry_into_second_jit(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(state, x):
+                return state
+
+            def other(state):
+                return state
+
+            def run(state, x):
+                g = jax.jit(step, donate_argnums=(0,))
+                h = jax.jit(other)
+                out = g(state, x)
+                return h(state)
+            """, ["donation"])
+        assert _codes(found) == ["donated-reentry"]
+
+    def test_pragma_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            @jit_surface
+            def step(params, lr):
+                return params
+
+            f = jax.jit(step)  # lint: allow(missing-donation)
+            """, ["donation"])
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+class TestRetraceHazardPass:
+    def test_unbucketed_shape_key(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(prompt_ids, f):
+                key = (len(prompt_ids), 4)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert _codes(found) == ["unbucketed-shape-key"]
+
+    def test_bucketed_key_is_quiet(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def bucket_for(n):
+                return 1 << n.bit_length()
+
+            def build(prompt_ids, f):
+                b = bucket_for(len(prompt_ids))
+                key = (b, 4)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert found == []
+
+    def test_shape_unpack_into_key(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(input_ids, f):
+                B, P = input_ids.shape
+                key = (B, P)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert _codes(found) == ["unbucketed-shape-key",
+                                 "unbucketed-shape-key"]
+
+    def test_computed_float_key(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(scale, f):
+                s = scale * 2
+                key = (float(s),)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert _codes(found) == ["float-cache-key"]
+
+    def test_canonicalized_knob_float_is_quiet(self, tmp_path):
+        # float(<plain parameter>) is the generate()-style bounded knob
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(temperature, f):
+                key = (float(temperature), 4)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert found == []
+
+    def test_unordered_key_part(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(names, f):
+                key = (tuple(set(names)),)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert _codes(found) == ["unordered-key-part"]
+
+    def test_sorted_set_is_quiet(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(names, f):
+                key = (tuple(sorted(set(names))),)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert found == []
+
+    def test_uncached_inline_jit_call(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def run(f, x):
+                return jax.jit(f)(x)
+            """, ["retrace-hazard"])
+        assert _codes(found) == ["uncached-jit-call"]
+
+    def test_data_dependent_static_arg(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def f(x, n):
+                return x[:n]
+
+            def run(ids, x):
+                g = jax.jit(f, static_argnums=(1,))
+                return g(x, len(ids))
+            """, ["retrace-hazard"])
+        assert _codes(found) == ["unbucketed-shape-key"]
+        assert "static arg" in found[0].message
+
+    def test_finding_carries_wrap_surface_label(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from paddle_tpu.observability.compilestats import wrap
+
+            cache = {}
+
+            def build(prompt_ids, f):
+                key = (len(prompt_ids),)
+                cache[key] = wrap(jax.jit(f), "serving.decode_chunk",
+                                  budget=1)
+            """, ["retrace-hazard"])
+        assert len(found) == 1
+        assert found[0].detail.startswith("serving.decode_chunk:")
+        assert "[surface=serving.decode_chunk]" in found[0].message
+
+    def test_pragma_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            cache = {}
+
+            def build(prompt_ids, f):
+                key = (len(prompt_ids), 4)  # lint: allow(unbucketed-shape-key)
+                cache[key] = jax.jit(f)
+            """, ["retrace-hazard"])
+        assert found == []
+
+
+class TestSurfaceVocabulary:
+    """Static retrace findings and runtime pt_compile_* telemetry must
+    share one surface-name vocabulary (the acceptance criterion)."""
+
+    @staticmethod
+    def _wrap_literals_in_tree():
+        """Every surface-name string the source passes to
+        compilestats.wrap (directly, via _tracked, or through a
+        *_SURFACE module constant)."""
+        ctx = make_context()
+        literals = set()
+        for mod in ctx.index.iter_modules():
+            for n in ast.walk(mod.tree):
+                if isinstance(n, ast.Call):
+                    term = n.func.attr if isinstance(
+                        n.func, ast.Attribute) else (
+                        n.func.id if isinstance(n.func, ast.Name)
+                        else None)
+                    if term in ("wrap", "_tracked", "_wrap"):
+                        for a in list(n.args) + \
+                                [kw.value for kw in n.keywords]:
+                            for c in ast.walk(a):
+                                if isinstance(c, ast.Constant) and \
+                                        isinstance(c.value, str) and \
+                                        "." in c.value:
+                                    literals.add(c.value)
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and n.targets[0].id.endswith("_SURFACE") \
+                        and isinstance(n.value, ast.Constant) \
+                        and isinstance(n.value.value, str):
+                    literals.add(n.value.value)
+        return literals
+
+    def test_compile_surfaces_mirror_wrap_sites(self):
+        lits = self._wrap_literals_in_tree()
+        declared = set(allowlist.COMPILE_SURFACES)
+        assert declared == lits, (
+            "COMPILE_SURFACES (analysis/allowlist.py) must mirror the "
+            "compilestats.wrap call sites exactly — "
+            f"missing from allowlist: {sorted(lits - declared)}, "
+            f"stale in allowlist: {sorted(declared - lits)}")
+
+    def test_runtime_compile_registry_uses_declared_labels(self):
+        """Run one tiny generate(): the surface it registers in the
+        runtime compilestats registry must be a declared label."""
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.models import GPTForPretraining, gpt3_tiny
+        from paddle_tpu.observability import compilestats
+        paddle.seed(0)
+        net = GPTForPretraining(gpt3_tiny())
+        net.generate(paddle.to_tensor(
+            np.asarray([[1, 2, 3]], dtype="int32")), max_new_tokens=2)
+        assert "generation.decode" in compilestats.surfaces()
+        assert "generation.decode" in allowlist.COMPILE_SURFACES
+
+    def test_surface_labels_fallback_points_at_declared_names(self):
+        for (_rel, _qual), label in allowlist.SURFACE_LABELS.items():
+            assert label in allowlist.COMPILE_SURFACES
+
+
+# ---------------------------------------------------------------------------
+# concurrency
+# ---------------------------------------------------------------------------
+
+def _conc(tmp_path, code, monkeypatch, declared=None, safe=None):
+    name = "fixture.py"
+    monkeypatch.setattr(allowlist, "CONCURRENCY_MODULES",
+                        allowlist.CONCURRENCY_MODULES + (name,))
+    # the pass imported the tuple by value — patch its module too
+    from paddle_tpu.analysis import concurrency as conc_mod
+    monkeypatch.setattr(conc_mod, "CONCURRENCY_MODULES",
+                        conc_mod.CONCURRENCY_MODULES + (name,))
+    for key, meta in (declared or {}).items():
+        monkeypatch.setitem(allowlist.CONCURRENT_CLASSES,
+                            (name, key), meta)
+    for key, reason in (safe or {}).items():
+        monkeypatch.setitem(allowlist.THREAD_SAFE_STATE,
+                            (name, key), reason)
+    return _lint(tmp_path, code, ["concurrency"], name=name)
+
+
+class TestConcurrencyPass:
+    THREADED = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._items = []
+                self._lock = threading.Lock()
+
+            def start(self):
+                t = threading.Thread(target=self._work, daemon=True)
+                t.start()
+
+            def _work(self):
+                {work}
+
+            def drain(self):
+                {drain}
+    """
+
+    def test_unguarded_thread_mutation_flagged(self, tmp_path,
+                                               monkeypatch):
+        found = _conc(tmp_path, self.THREADED.format(
+            work="self._items.append(1)",
+            drain="return self._items.pop() if self._items else None"),
+            monkeypatch)
+        codes = _codes(found)
+        assert codes.count("unguarded-shared-mutation") == 2  # both sides
+
+    def test_lock_guarded_is_quiet(self, tmp_path, monkeypatch):
+        found = _conc(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._items = []
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    t = threading.Thread(target=self._work, daemon=True)
+                    t.start()
+
+                def _work(self):
+                    with self._lock:
+                        self._items.append(1)
+
+                def drain(self):
+                    with self._lock:
+                        if self._items:
+                            return self._items.pop()
+                    return None
+            """, monkeypatch)
+        assert found == []
+
+    def test_check_then_act_flagged(self, tmp_path, monkeypatch):
+        found = _conc(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._free = [1, 2]
+
+                def start(self):
+                    t = threading.Thread(target=self._work, daemon=True)
+                    t.start()
+
+                def _work(self):
+                    with self._lock:
+                        self._free.append(3)
+
+                def take(self):
+                    if self._free:
+                        return self._free.pop()
+                    return None
+            """, monkeypatch)
+        codes = _codes(found)
+        assert "check-then-act" in codes
+
+    def test_lock_only_the_act_still_flagged(self, tmp_path,
+                                             monkeypatch):
+        # the natural WRONG fix: leaving the check outside the lock —
+        # two threads both pass `if self._free:` with one element left
+        found = _conc(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._free = [1, 2]
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    t = threading.Thread(target=self._work, daemon=True)
+                    t.start()
+
+                def _work(self):
+                    with self._lock:
+                        self._free.append(3)
+
+                def take(self):
+                    if self._free:
+                        with self._lock:
+                            return self._free.pop()
+                    return None
+            """, monkeypatch)
+        assert "check-then-act" in _codes(found)
+
+    def test_thread_confined_state_is_quiet(self, tmp_path, monkeypatch):
+        # no second root ever touches the attr -> not shared
+        found = _conc(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._n = 0
+
+                def start(self):
+                    t = threading.Thread(target=self._work, daemon=True)
+                    t.start()
+
+                def _work(self):
+                    self._n += 1
+            """, monkeypatch)
+        assert found == []
+
+    def test_declared_concurrent_class_without_threads(self, tmp_path,
+                                                       monkeypatch):
+        # the FCFSScheduler shape: no Thread() in the file, contract
+        # declared via CONCURRENT_CLASSES
+        code = """
+            class Sched:
+                def __init__(self):
+                    self._queue = []
+
+                def submit(self, item):
+                    self._queue.append(item)
+
+                def admit(self):
+                    if self._queue:
+                        return self._queue.pop()
+                    return None
+            """
+        # undeclared, the file is quiet (no thread entry points)...
+        from paddle_tpu.analysis import concurrency as conc_mod
+        monkeypatch.setattr(conc_mod, "CONCURRENCY_MODULES",
+                            conc_mod.CONCURRENCY_MODULES
+                            + ("fixture.py",))
+        assert _lint(tmp_path, code, ["concurrency"],
+                     name="fixture.py") == []
+        # ...declared, the contract is enforced
+        found = _conc(tmp_path, code, monkeypatch,
+                      declared={"Sched": {"entries": ["submit"],
+                                          "reason": "router threads"}})
+        codes = _codes(found)
+        assert codes.count("unguarded-shared-mutation") == 2
+        assert "check-then-act" in codes
+
+    def test_per_key_dict_cells_do_not_alias(self, tmp_path,
+                                             monkeypatch):
+        # submit touches stats["a"], the owner loop touches stats["b"]:
+        # different cells, only the cross-thread key is hot
+        found = _conc(tmp_path, """
+            class Eng:
+                def __init__(self):
+                    self.stats = {"a": 0, "b": 0}
+
+                def submit(self):
+                    self.stats["a"] += 1
+
+                def step(self):
+                    self.stats["b"] += 1
+                    return self.stats["a"]
+            """, monkeypatch,
+            declared={"Eng": {"entries": ["submit"], "reason": "x"}})
+        assert _codes(found) == ["unguarded-shared-mutation"]
+        assert 'stats[\'a\']' in found[0].detail
+
+    def test_thread_safe_state_allowlist(self, tmp_path, monkeypatch):
+        found = _conc(tmp_path, self.THREADED.format(
+            work="self._items.append(1)",
+            drain="return list(self._items)"),
+            monkeypatch,
+            safe={"Box._items": "GIL-atomic append; reader snapshots"})
+        assert found == []
+
+    def test_module_global_mutation_from_thread(self, tmp_path,
+                                                monkeypatch):
+        found = _conc(tmp_path, """
+            import threading
+
+            _REG = {}
+
+            def loop():
+                _REG["x"] = 1
+
+            def start():
+                threading.Thread(target=loop, daemon=True).start()
+
+            def read():
+                return _REG.get("x")
+            """, monkeypatch)
+        assert _codes(found) == ["unguarded-shared-mutation"]
+        assert "<module>._REG" in found[0].detail
+
+    def test_pragma_suppresses(self, tmp_path, monkeypatch):
+        found = _conc(tmp_path, self.THREADED.format(
+            work="self._items.append(1)  # lint: allow(concurrency)",
+            drain="return list(self._items)"),
+            monkeypatch)
+        assert found == []
+
+    def test_real_scheduler_and_engine_are_clean(self):
+        found = run_passes(
+            paths=[os.path.join(REPO_ROOT, "paddle_tpu", "inference")],
+            passes=["concurrency"])
+        assert found == [], found
+
+    def test_scheduler_lock_actually_guards(self):
+        """Runtime spot check of the fix: concurrent submits against a
+        draining scheduler lose no request and never corrupt the
+        free-list."""
+        import threading
+        from paddle_tpu.inference.scheduler import FCFSScheduler
+        sched = FCFSScheduler(num_slots=4)
+        N, workers = 200, 4
+        errs = []
+
+        def submitter(k):
+            try:
+                for i in range(N):
+                    sched.submit([1, 2, 3], 4)
+            except Exception as e:            # pragma: no cover
+                errs.append(e)
+        ts = [threading.Thread(target=submitter, args=(k,))
+              for k in range(workers)]
+        drained = 0
+        for t in ts:
+            t.start()
+        while any(t.is_alive() for t in ts) or sched.queue_depth:
+            for _req, slot in sched.admissions():
+                sched.release(slot)
+                drained += 1
+        for t in ts:
+            t.join()
+        assert not errs
+        assert drained == N * workers
+        assert sorted(sched._free) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# runner integration: listing, baseline, self-lint, --changed-only
+# ---------------------------------------------------------------------------
+
+class TestRunnerIntegration:
+    def test_new_passes_in_default_registry(self):
+        with pytest.raises(ValueError) as ei:
+            run_passes(passes=["no-such-pass"])
+        msg = str(ei.value)
+        for name in NEW_PASSES:
+            assert name in msg
+
+    def test_list_passes_cli(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis",
+             "--list-passes"], capture_output=True, text=True,
+            cwd=REPO_ROOT)
+        assert out.returncode == 0
+        for name in NEW_PASSES:
+            assert name in out.stdout.split()
+
+    def test_self_lint_new_passes_clean(self):
+        """The tree must be CLEAN under the three new passes with the
+        baseline still empty — every finding was fixed or pragma'd
+        (the acceptance criterion), none baselined."""
+        found = run_passes(passes=NEW_PASSES)
+        assert found == [], found
+        baseline = load_baseline(os.path.join(
+            REPO_ROOT, "tools", "lint_baseline.json"))
+        assert baseline == {}, "lint_baseline.json must stay EMPTY"
+
+    def test_baseline_interplay(self, tmp_path):
+        (tmp_path / "fx.py").write_text(textwrap.dedent("""
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            @jit_surface
+            def step(params, lr):
+                return params
+
+            f = jax.jit(step)
+            """))
+        found = run_passes(paths=[str(tmp_path)], passes=["donation"])
+        assert len(found) == 1
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), found)
+        new, old = split_new(found, load_baseline(str(bl)))
+        assert new == [] and len(old) == 1
+        # a second, distinct finding is NOT covered
+        (tmp_path / "fx.py").write_text(textwrap.dedent("""
+            import jax
+            from paddle_tpu.analysis import jit_surface
+
+            @jit_surface
+            def step(params, lr):
+                return params
+
+            @jit_surface
+            def step2(opt_state, lr):
+                return opt_state
+
+            f = jax.jit(step)
+            g = jax.jit(step2)
+            """))
+        found2 = run_passes(paths=[str(tmp_path)], passes=["donation"])
+        new2, old2 = split_new(found2, load_baseline(str(bl)))
+        assert len(new2) == 1 and len(old2) == 1
+
+    def test_changed_only_scoped_run(self, monkeypatch, capsys):
+        target = os.path.join(REPO_ROOT, "paddle_tpu", "analysis",
+                              "base.py")
+        monkeypatch.setattr(runner_mod, "git_changed_files",
+                            lambda root: [target])
+        rc = runner_mod.main(["--changed-only", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["new"] == 0
+
+    def test_changed_only_empty_diff_is_green(self, monkeypatch,
+                                              capsys):
+        monkeypatch.setattr(runner_mod, "git_changed_files",
+                            lambda root: [])
+        rc = runner_mod.main(["--changed-only"])
+        assert rc == 0
+        assert "no changed" in capsys.readouterr().out
+
+    def test_changed_only_rejects_explicit_paths(self, capsys):
+        rc = runner_mod.main(["--changed-only", "paddle_tpu"])
+        assert rc == 2
+
+    def test_changed_only_finds_seeded_violation(self, tmp_path,
+                                                 monkeypatch, capsys):
+        # a changed file with a violation fails the scoped run
+        fx = tmp_path / "fx.py"
+        fx.write_text(textwrap.dedent("""
+            import jax
+
+            def run(f, x):
+                return jax.jit(f)(x)
+            """))
+        monkeypatch.setattr(runner_mod, "git_changed_files",
+                            lambda root: [str(fx)])
+        rc = runner_mod.main(["--changed-only", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert any(f["code"] == "uncached-jit-call"
+                   for f in out["findings"])
+
+
+class TestPolicyIntegrity:
+    def test_concurrency_modules_exist(self):
+        ctx = make_context()
+        for rel in allowlist.CONCURRENCY_MODULES:
+            assert rel in ctx.index.by_relpath, rel
+
+    def test_concurrent_class_declarations_resolve(self):
+        ctx = make_context()
+        for rel, cls in allowlist.CONCURRENT_CLASSES:
+            mod = ctx.index.by_relpath.get(rel)
+            assert mod is not None, rel
+            if cls == "<module>":
+                continue
+            assert any(q.split(".")[0] == cls for q in mod.funcs), \
+                (rel, cls)
+
+    def test_thread_safe_state_entries_resolve(self):
+        ctx = make_context()
+        for rel, entry in allowlist.THREAD_SAFE_STATE:
+            mod = ctx.index.by_relpath.get(rel)
+            assert mod is not None, rel
+            owner, attr = entry.split(".", 1)
+            if owner == "<module>":
+                assert attr in mod.source, (rel, entry)
+            else:
+                assert any(q.split(".")[0] == owner
+                           for q in mod.funcs), (rel, entry)
+                assert attr in mod.source, (rel, entry)

@@ -3,9 +3,10 @@
 ``python -m paddle_tpu.analysis``).
 
 Runs all passes — tracer-safety, host-sync budget, collective-order,
-failpoint-refs, guardian-log, metrics-registry — over the repo,
-suppressing findings recorded in ``tools/lint_baseline.json``.  Exit 0
-when no NEW findings, 1 otherwise.
+donation, retrace-hazard, concurrency, failpoint-refs, guardian-log,
+metrics-registry — over the repo, suppressing findings recorded in
+``tools/lint_baseline.json``.  Exit 0 when no NEW findings, 1
+otherwise.
 
 Usage:
     python tools/lint.py                 # human output vs baseline
@@ -13,6 +14,7 @@ Usage:
     python tools/lint.py --no-baseline   # everything, no suppression
     python tools/lint.py --update-baseline
     python tools/lint.py --passes tracer-safety,host-sync
+    python tools/lint.py --changed-only  # git-diff-scoped inner loop
 """
 import os
 import sys
