@@ -1369,6 +1369,293 @@ def bench_serving_spec(n_requests=64, seed=0, hidden=768, layers=12,
 
 
 # ---------------------------------------------------------------------------
+# Serving fleet: the SAME Poisson trace replayed through ONE engine and
+# through N-replica ServingFleet routers (ISSUE 12).  Each replica is its
+# own engine (slots + KV + compiled programs) stepped by its own thread.
+# Every config runs in a FRESH SUBPROCESS whose CPU affinity is set to
+# one core per replica-chip BEFORE jax initializes -- the chip-proxy
+# discipline (PR 6's --xla_force_host_platform_device_count sibling):
+# without it, XLA:CPU's machine-wide intra-op pool lets the single
+# "one-chip" baseline borrow every core during prefill matmuls, which
+# understates fleet scaling by exactly the borrowed factor.  Output is
+# asserted BITWISE equal to the single engine per request (same seeds ->
+# same weights in every child); the N=max child snapshots telemetry
+# under the `router` tag (telemetry/router.{prom,jsonl} +
+# router_requests.trace.json -- traces span router->replica).
+# ---------------------------------------------------------------------------
+
+_FLEET_CHILD_ENV = "BENCH_FLEET_CHILD"
+
+
+def _fleet_run_config(P, n_replicas, snapshot=False):
+    """One serving_fleet sub-config (runs inside the pinned child):
+    ``n_replicas == 1`` is the plain single-engine baseline, else a
+    ``ServingFleet`` with worker threads.  Returns plain-JSON results
+    including every request's token ids (the parent's bitwise check)."""
+    import jax  # noqa: F401  (device selection side effects)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.router import ServingFleet
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    def bucket(n, lo):
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    p_lo, p_hi = P["p_range"]
+    n_lo, n_hi = P["n_range"]
+    chunk = int(P["chunk"])
+    max_seq = bucket(p_hi, p_lo) + bucket(n_hi, n_lo)
+    # modest vocab ON PURPOSE: one replica's decode matmuls should fit
+    # one proxy core the way one real replica fits one chip
+    cfg = GPTConfig(vocab_size=P["vocab"], hidden_size=P["hidden"],
+                    num_hidden_layers=P["layers"],
+                    num_attention_heads=P["heads"],
+                    max_position_embeddings=max_seq)
+    paddle.seed(0)
+    net = GPTForPretraining(cfg)
+    net.eval()
+    rng = np.random.RandomState(P["seed"])
+    n_requests = int(P["n_requests"])
+    plens = np.clip(
+        rng.poisson(lam=rng.choice(P["p_lams"], size=n_requests)),
+        p_lo, p_hi).astype(int)
+    budgets = np.clip(
+        rng.poisson(lam=rng.choice(P["n_lams"], size=n_requests)),
+        n_lo, n_hi).astype(int)
+    spl = int(P["sys_prompt_len"])
+    sys_prompt = rng.randint(0, cfg.vocab_size, (spl,)).astype("int32")
+    prompts = []
+    for i, n in enumerate(plens):
+        body = rng.randint(0, cfg.vocab_size, (int(n),)).astype("int32")
+        if i % 2 == 0 and n > spl:
+            body[:spl] = sys_prompt            # shared-prefix half
+        prompts.append(body)
+
+    def warm(eng):
+        # compile every prefill bucket + the decode chunk once (the
+        # timed pass then measures scheduling, not tracing)
+        for b in eng.buckets:
+            budget = min(chunk + 2, eng.MAX - b)
+            if b <= p_hi * 2 and budget >= 1:
+                eng.submit(np.ones((b,), np.int32), budget)
+        eng.run()
+        eng.reset()
+
+    dtype = P.get("dtype", "float32")
+    if n_replicas == 1:
+        fe = ServingEngine(net, num_slots=P["slots"], chunk=chunk,
+                           max_seq_len=max_seq, dtype=dtype)
+        warm(fe)
+        reset = fe.reset
+        run_trace = fe.run
+        submit = fe.submit
+    else:
+        fl = ServingFleet(net, num_replicas=n_replicas,
+                          num_slots=P["slots"], chunk=chunk,
+                          max_seq_len=max_seq, dtype=dtype)
+        for rep in fl.replicas:
+            warm(rep.engine)
+        reset = fl.reset
+        run_trace = lambda: fl.run(threads=True)   # noqa: E731
+        submit = fl.submit
+    # best of `trials` timed passes (compiles amortized after warm):
+    # the fleet walls are thread-scheduling-sensitive on the shared
+    # cpu proxy, and the min is the capability estimate (the
+    # chip_calibration discipline); outputs are asserted identical
+    # across trials — noise may move the clock, never the tokens
+    best = None
+    for _ in range(int(P.get("trials", 2))):
+        reset()
+        try:
+            # per-trial telemetry reset so the committed snapshot is
+            # one-run-shaped (the last trial's), not a 2x aggregate
+            from paddle_tpu import observability as _obs
+            from paddle_tpu.observability import tracing as _tracing
+            _obs.get_registry().reset()
+            _tracing.reset()
+        except Exception:
+            pass
+        t0 = time.perf_counter()
+        reqs = [submit(p, int(b)) for p, b in zip(prompts, budgets)]
+        run_trace()
+        wall = time.perf_counter() - t0
+        toks = [list(map(int, r.tokens)) for r in reqs]
+        if best is not None:
+            assert toks == best["toks"], "trial outputs diverged"
+        if best is None or wall < best["wall"]:
+            ttfts = sorted(r.ttft_ms for r in reqs)
+            best = {"toks": toks, "wall": wall,
+                    "p99": ttfts[min(int(0.99 * (len(ttfts) - 1)),
+                                     len(ttfts) - 1)]}
+    if n_replicas == 1:
+        extra = {"chunks": fe.stats["chunks"],
+                 "prefills": fe.stats["prefills"]}
+    else:
+        extra = {"affinity_routes": fl.stats["affinity_routes"],
+                 "least_loaded_routes":
+                     fl.stats["least_loaded_routes"],
+                 "rebalanced": fl.stats["rebalanced"],
+                 "chunks": sum(r.engine.stats["chunks"]
+                               for r in fl.replicas),
+                 "prefills": sum(r.engine.stats["prefills"]
+                                 for r in fl.replicas)}
+    useful = int(budgets.sum())
+    out = {"tokens": best["toks"],
+           "useful_tokens": useful,
+           "useful_tokens_per_sec": round(useful / best["wall"], 1),
+           "p99_ttft_ms": round(best["p99"], 1), **extra}
+    if snapshot:
+        out["telemetry"] = _telemetry_snapshot("router")
+    return out
+
+
+def _fleet_child_main():
+    """Child-process entry (``BENCH_FLEET_CHILD`` env): run one config
+    in a fresh process (its own XLA pool + metrics registry — the
+    telemetry snapshot a fleet child writes is that run's alone) and
+    print one tagged JSON line.
+
+    CPU affinity is set PROPORTIONALLY before jax initializes:
+    ``cores_per_replica * n_replicas`` cores — every replica is backed
+    by the same slice of hardware whatever the config, exactly like a
+    real replica owning a chip.  Without it, XLA:CPU's machine-wide
+    intra-op pool lets the "one-chip" baseline borrow every core
+    during prefill matmuls (measured: 202-291 tok/s run-to-run on one
+    machine), which both understates fleet scaling and makes the
+    ratio noisy.  The trace runs fp32 ON PURPOSE: different affinity
+    masks change XLA:CPU reduction partitioning, and at bf16 that
+    flipped a near-tie greedy pick (one token in 5.5k) between masks —
+    at fp32 the cross-config output is bitwise (asserted by the
+    parent)."""
+    spec = json.loads(os.environ[_FLEET_CHILD_ENV])
+    n = int(spec["n_replicas"])
+    cpr = int(spec.get("cores_per_replica") or 0)
+    pinned = False
+    if cpr > 0 and hasattr(os, "sched_setaffinity"):
+        try:
+            os.sched_setaffinity(
+                0, set(range(min(cpr * n, os.cpu_count() or 1))))
+            pinned = True
+        except OSError:
+            pass
+    out = _fleet_run_config(spec["params"], n,
+                            snapshot=spec.get("snapshot", False))
+    out["pinned"] = pinned
+    print("FLEET_CHILD_RESULT:" + json.dumps(out))
+
+
+def bench_serving_fleet(n_requests=64, seed=0, hidden=256, layers=6,
+                        heads=8, vocab=8192, p_range=(32, 224),
+                        n_range=(32, 160), slots=4, chunk=64,
+                        p_lams=(48, 96, 192), n_lams=(48, 96, 128),
+                        replica_counts=(2, 4), sys_prompt_len=64):
+    """Single engine (the baseline fleet-of-one) vs ``ServingFleet`` at
+    each ``replica_counts`` entry, all over one Poisson-mixed trace
+    submitted as a burst (every request queued at t=0 -- the regime
+    where a deeper fleet drains the queue Nx faster, which is exactly
+    what p99 TTFT measures).  Half the requests share a
+    ``sys_prompt_len``-token system prompt so prefix-affinity routing
+    has something to route on (dense engines here -- warmth effects are
+    covered by the paged fleet tests; this config measures *scaling*).
+    Each config runs in its own pinned subprocess (see the banner
+    comment); useful-tok/s counts each request's own budget."""
+    import subprocess
+    import sys
+
+    P = {"n_requests": n_requests, "seed": seed, "hidden": hidden,
+         "layers": layers, "heads": heads, "vocab": vocab,
+         "p_range": list(p_range), "n_range": list(n_range),
+         "slots": slots, "chunk": chunk, "p_lams": list(p_lams),
+         "n_lams": list(n_lams), "sys_prompt_len": sys_prompt_len}
+    counts = [1] + [int(n) for n in replica_counts]
+    # the even-division anchor: one replica-chip = ncpu / max-replicas
+    # cores, for EVERY config (hardware scales with replica count the
+    # way chips do in a real fleet)
+    cores_per_replica = max(1, (os.cpu_count() or 1) // max(counts))
+    results, base, telemetry, pinned = {}, None, None, True
+    for n in counts:
+        spec = {"n_replicas": n, "params": P,
+                "cores_per_replica": cores_per_replica,
+                "snapshot": n == max(counts)}
+        env = dict(os.environ)
+        env[_FLEET_CHILD_ENV] = json.dumps(spec)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=1800)
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("FLEET_CHILD_RESULT:")]
+        if proc.returncode != 0 or not line:
+            raise RuntimeError(
+                f"fleet child N={n} failed (rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[-400:]}")
+        r = json.loads(line[-1][len("FLEET_CHILD_RESULT:"):])
+        toks = r.pop("tokens")
+        pinned &= bool(r.pop("pinned"))
+        telemetry = r.pop("telemetry", telemetry)
+        if n == 1:
+            base = {"toks": toks,
+                    "tps": r["useful_tokens_per_sec"],
+                    "p99": r["p99_ttft_ms"],
+                    "useful": r["useful_tokens"]}
+        else:
+            # the parity contract IS the product: bitwise or bust,
+            # whatever replica/slot a request landed on
+            assert toks == base["toks"], f"fleet N={n} output diverged"
+            r["speedup_vs_one"] = round(
+                r["useful_tokens_per_sec"] / max(base["tps"], 1e-9), 3)
+            r["p99_ttft_vs_one"] = round(
+                r["p99_ttft_ms"] / max(base["p99"], 1e-9), 3)
+        r.pop("useful_tokens", None)
+        results[str(n)] = r
+    scaling_ok = all(results[str(n)]["speedup_vs_one"] >= 0.75 * n
+                     for n in counts[1:])
+    p99_ok = all(results[str(n)]["p99_ttft_ms"] < base["p99"]
+                 for n in counts[1:])
+    lat_ms = _dispatch_latency_ms()
+    out = {"replicas": results,
+           "speedup_n2": results.get("2", {}).get("speedup_vs_one"),
+           "speedup_n4": results.get("4", {}).get("speedup_vs_one"),
+           "bitwise": True,                 # asserted above, per fleet
+           "scaling_near_linear": bool(scaling_ok),
+           "p99_ttft_strictly_lower": bool(p99_ok),
+           "requests": n_requests, "useful_tokens": base["useful"],
+           "slots_per_replica": slots, "chunk": chunk,
+           "dispatch_latency_ms": lat_ms,
+           "cores_per_replica": cores_per_replica,
+           "cpu_proxy_affinity": bool(pinned),
+           "valid": bool(scaling_ok and p99_ok),
+           "model": f"gpt_h{hidden}_l{layers}", "dtype": "float32",
+           "note": ("burst-submitted Poisson trace, one subprocess "
+                    "per config with PROPORTIONAL affinity (one "
+                    "replica-chip = ncpu/max-replicas cores, set "
+                    "before jax init — hardware scales with replica "
+                    "count the way chips do; fp32 keeps cross-mask "
+                    "greedy picks bitwise): replicas multiply the "
+                    "slot pool and overlap dispatches; idle replicas "
+                    "steal queued work from deep ones (router "
+                    "rebalance), flattening the variable-budget "
+                    "straggler tail.  Shared-host caveat: a replica "
+                    "can transiently borrow sibling replicas' idle "
+                    "cores through the child's one XLA pool, which "
+                    "can push measured scaling slightly SUPER-linear "
+                    "-- real chips cannot; read >=N as ~N")}
+    if telemetry is not None:
+        out["telemetry"] = telemetry
+    if not out["valid"]:
+        out["invalid_reason"] = (
+            "fleet scaling below 0.75x-per-replica or p99 TTFT not "
+            "strictly lower than the single engine -- the ratio is "
+            "reported but should not be read as the fleet win")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
 # GPT-MoE: GShard-pattern sparse FFNs (every other layer 8-expert top-2),
 # single chip.  MFU is computed over ACTIVE FLOPs (top_k of E experts per
 # token), the standard sparse-model accounting.
@@ -1665,6 +1952,16 @@ def main():
             except Exception as e:
                 configs["serving_spec"] = {"error": repr(e)[:200]}
             telemetry["serving_spec"] = _telemetry_snapshot("serving_spec")
+        if want("serving_fleet"):
+            try:
+                configs["serving_fleet"] = bench_serving_fleet()
+            except Exception as e:
+                configs["serving_fleet"] = {"error": repr(e)[:200]}
+            # the pinned N=max CHILD wrote the router telemetry
+            # snapshot (its registry holds the fleet run, ours is
+            # empty) — surface its paths instead of overwriting
+            telemetry["router"] = configs["serving_fleet"].pop(
+                "telemetry", {"skipped": "fleet child did not report"})
         if want("moe", "gpt_moe"):
             try:
                 configs["gpt_moe"] = bench_gpt_moe(peak=peak)
@@ -1710,6 +2007,16 @@ def main():
             except Exception as e:
                 configs["serving_spec"] = {"error": repr(e)[:200]}
             telemetry["serving_spec"] = _telemetry_snapshot("serving_spec")
+        if which is not None and "serving_fleet" in which:
+            try:
+                configs["serving_fleet"] = bench_serving_fleet()
+            except Exception as e:
+                configs["serving_fleet"] = {"error": repr(e)[:200]}
+            # the pinned N=max CHILD wrote the router telemetry
+            # snapshot (its registry holds the fleet run, ours is
+            # empty) — surface its paths instead of overwriting
+            telemetry["router"] = configs["serving_fleet"].pop(
+                "telemetry", {"skipped": "fleet child did not report"})
         if which is not None and \
                 {"gpt1p3b", "gpt1p3b_hybrid"} & set(which):
             # 1 visible device -> bench_gpt1p3b_hybrid re-execs itself
@@ -1767,5 +2074,7 @@ if __name__ == "__main__":
 
     if "--hybrid-cpu-proxy" in sys.argv[1:]:
         _hybrid_cpu_proxy_child()
+    elif _FLEET_CHILD_ENV in os.environ:
+        _fleet_child_main()
     else:
         main()

@@ -26,6 +26,10 @@ MONITORED_MODULES = (
     # draft-verify chunk — the one budgeted sync is the standalone
     # entry's prompt ingest; a readback here is always a bug
     "paddle_tpu/inference/speculative.py",
+    # fleet router: pure host-side scheduling between engine dispatches
+    # — the one budgeted sync is submit's prompt ingest; routing,
+    # admission control and health checks must NEVER read the device
+    "paddle_tpu/inference/router.py",
     # the bucketed/quantized gradient reducer runs entirely inside the
     # compiled step — ANY sync primitive appearing here is a bug, so it
     # is monitored with zero allowlist entries
@@ -131,6 +135,31 @@ HOST_SYNC_ALLOWLIST = {
         {"max": 1, "reason": "admission-time prompt ingest for prefix "
                              "keying/page planning (host array "
                              "canonicalization), not a readback"},
+    ("paddle_tpu/inference/kvcache.py", "prefix_affinity_key",
+     "asarray"):
+        {"max": 1, "reason": "routing-time prompt canonicalization for "
+                             "the fleet affinity key (host array), not "
+                             "a readback"},
+    ("paddle_tpu/inference/kvcache.py", "PagedKVManager.export_pages",
+     "device_get"):
+        {"max": 1, "reason": "disaggregation seam: the prefill->decode "
+                             "KV-page handoff is D2H by design and off "
+                             "the chunk hot path (one bundled readback "
+                             "per export)"},
+    ("paddle_tpu/inference/kvcache.py", "PagedKVManager.export_pages",
+     "asarray"):
+        {"max": 1, "reason": "disaggregation seam: host-side page-index "
+                             "assembly for the export gather, not a "
+                             "readback"},
+    ("paddle_tpu/inference/kvcache.py", "PagedKVManager.import_pages",
+     "asarray"):
+        {"max": 2, "reason": "disaggregation seam: H2D ingest of the "
+                             "imported page payload + its index vector, "
+                             "not a readback"},
+    # fleet router (inference/router.py): H2D ingest only
+    ("paddle_tpu/inference/router.py", "ServingFleet.submit", "asarray"):
+        {"max": 1, "reason": "H2D ingest of the request prompt (host "
+                             "list/array -> int32), not a readback"},
     # speculative decoding (inference/speculative.py): H2D ingest only
     ("paddle_tpu/inference/speculative.py", "speculative_generate",
      "asarray"):
@@ -252,6 +281,7 @@ RETRACE_DATA_TOKENS = frozenset({
 CONCURRENCY_MODULES = (
     "paddle_tpu/inference/scheduler.py",
     "paddle_tpu/inference/serving.py",
+    "paddle_tpu/inference/router.py",
     "paddle_tpu/io/__init__.py",
     "paddle_tpu/io/worker.py",
     "paddle_tpu/distributed/checkpoint/__init__.py",
@@ -268,14 +298,24 @@ CONCURRENT_CLASSES = {
     # engine loop admits/releases/requeues (ROADMAP: multi-replica
     # serving tier)
     ("paddle_tpu/inference/scheduler.py", "FCFSScheduler"):
-        {"entries": ["submit"],
-         "reason": "router threads submit while the engine loop "
-                   "admits/releases — the queue and free-list are the "
-                   "cross-thread boundary"},
+        {"entries": ["submit", "enqueue", "steal_tail"],
+         "reason": "router threads submit/enqueue/steal while the "
+                   "engine loop admits/releases — the queue and "
+                   "free-list are the cross-thread boundary"},
     ("paddle_tpu/inference/serving.py", "ServingEngine"):
-        {"entries": ["submit"],
-         "reason": "submit() is the engine's only cross-thread entry; "
+        {"entries": ["submit", "submit_request"],
+         "reason": "submit()/submit_request() are the engine's cross-"
+                   "thread entries (client threads + the fleet router "
+                   "dispatching while the replica worker steps); "
                    "everything else runs on the engine event loop"},
+    # the fleet router: client threads submit() while the run() loop
+    # dispatches and replica worker threads step engines / report
+    # finishes — the fleet queue and stats are the cross-thread boundary
+    ("paddle_tpu/inference/router.py", "ServingFleet"):
+        {"entries": ["submit"],
+         "reason": "client threads submit while the router loop "
+                   "dispatches and replica workers report finishes; "
+                   "all shared fleet state is behind self._lock"},
     # the metrics registry records from every thread by contract
     ("paddle_tpu/observability/metrics.py", "<module>"):
         {"entries": "*", "reason": "recording API is process-wide"},

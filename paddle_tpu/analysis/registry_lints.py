@@ -66,6 +66,7 @@ class FailpointRefsPass:
         import paddle_tpu.distributed.collective    # noqa: F401
         import paddle_tpu.distributed.fleet.elastic  # noqa: F401
         import paddle_tpu.io.worker                 # noqa: F401
+        import paddle_tpu.inference.router          # noqa: F401
         return failpoints
 
     def run(self, ctx):

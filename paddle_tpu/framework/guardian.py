@@ -130,6 +130,23 @@ EVENT_SCHEMA = {
     # surface compiled past its declared budget — the jit cache-miss
     # class of perf bug, with the old-vs-new signature diff attached
     "compile_retrace": {"surface", "compiles", "budget", "diff"},
+    # serving fleet router (inference/router.py): SLO admission control
+    # shed a best-effort request whose projected queue wait blew its
+    # TTFT SLO (the request got a terminal callback, reason "shed")
+    "router_shed": {"req_id", "priority", "projected_wait_ms",
+                    "slo_ttft_ms"},
+    # router: a replica died (crash/failpoint); its queued + in-flight
+    # requests were drained and requeued to the survivors
+    "router_replica_death": {"replica", "error", "requeued",
+                             "queue_depth"},
+    # router: the autoscale recommendation changed to nonzero
+    # (direction +1 = scale up, -1 = scale down)
+    "router_scale": {"direction", "alive_replicas", "queue_depth",
+                     "occupancy"},
+    # router: one run()'s fleet-level aggregate counters
+    "router_stats": {"requests", "finished", "shed", "requeued",
+                     "replica_deaths", "affinity_routes",
+                     "least_loaded_routes", "tokens_per_sec"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

@@ -295,8 +295,11 @@ def __getattr__(name):
     if name in ("SpecConfig", "speculative_generate"):
         from . import speculative as _speculative
         return getattr(_speculative, name)
+    if name in ("ServingFleet", "PRIORITY_CLASSES"):
+        from . import router as _router
+        return getattr(_router, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ += ["ServingEngine", "FCFSScheduler", "Request", "SpecConfig",
-            "speculative_generate"]
+            "speculative_generate", "ServingFleet", "PRIORITY_CLASSES"]

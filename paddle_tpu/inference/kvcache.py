@@ -76,7 +76,8 @@ from ..analysis import register_jit_surface
 from .. import observability as _obs
 
 __all__ = ["PagedCacheView", "PagedKVManager",
-           "quantize_kv", "dequantize_kv"]
+           "quantize_kv", "dequantize_kv",
+           "chained_page_digests", "prefix_affinity_key"]
 
 # the compiled bodies are nested defs a decorator can't reach —
 # registered for the tracer-safety pass (mirrored by EXTRA_JIT_SURFACES
@@ -108,6 +109,46 @@ class PagedCacheView(NamedTuple):
     k_scales: Any
     v_scales: Any
     table: Any
+
+
+# -- prefix keys (host-side, shared with the fleet router) -----------------
+
+def chained_page_digests(prompt, page_size):
+    """Chained per-page sha256 digests of every page-aligned prefix of
+    ``prompt`` (``digest_j = sha256(digest_{j-1} || page_j bytes)``):
+    ``keys[j-1]`` keys the first ``j`` pages.  One O(len(prompt)) pass —
+    THE prefix-key primitive, shared by the prefix cache
+    (:meth:`PagedKVManager._page_keys`) and the router's
+    :func:`prefix_affinity_key` so the two can never disagree about
+    what "the same prefix" means."""
+    P = int(page_size)
+    h, keys = hashlib.sha256(), []
+    for j in range(len(prompt) // P):
+        h.update(prompt[j * P:(j + 1) * P].tobytes())
+        keys.append(h.digest())
+    return keys
+
+
+def prefix_affinity_key(prompt, page_size, max_pages=4):
+    """O(1)-sized routing key for prefix-affinity (inference/router.py):
+    the chained digest of the request's first ``min(max_pages, full
+    pages)`` prompt pages.  Requests sharing a system prompt of at
+    least ``max_pages * page_size`` tokens map to the same key, so the
+    router can land them on the replica whose prefix cache already
+    holds those pages.  Returns ``None`` when the prompt has no full
+    page (nothing page-aligned to share — route by load instead).
+
+    Capping at ``max_pages`` is deliberate: affinity only needs to
+    agree on the SHARED head (the system prompt), and hashing the whole
+    prompt would split requests whose suffixes differ."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    P = int(page_size)
+    j = min(int(prompt.size) // P, int(max_pages))
+    if j < 1:
+        return None
+    h = hashlib.sha256()
+    h.update(prompt[:j * P].tobytes())
+    return h.hexdigest()
 
 
 # -- pure-jnp kernels (called inside the compiled prefill/decode) ----------
@@ -377,10 +418,7 @@ class PagedKVManager:
         O(len(prompt)) total — the stats counter machine-checks that
         admission-time key construction stays linear."""
         P = self.page_size
-        h, keys = hashlib.sha256(), []
-        for j in range(len(prompt) // P):
-            h.update(prompt[j * P:(j + 1) * P].tobytes())
-            keys.append(h.digest())
+        keys = chained_page_digests(prompt, P)
         self.stats["prefix_key_bytes_hashed"] += \
             (len(prompt) // P) * P * prompt.itemsize
         return keys
@@ -602,6 +640,58 @@ class PagedKVManager:
                 _obs.inc("pt_kvcache_page_evictions_total", count)
         self._gauges()
         return count
+
+    # -- disaggregation seam (prefill/decode split) ------------------------
+    def export_pages(self, slot):
+        """KV-page handoff seam toward prefill/decode disaggregation
+        (ROADMAP "Internet-scale serving tier"; PAPERS.md portable
+        collective redistribution): snapshot a slot's mapped pages as
+        host arrays so a prefill-specialized replica can stream
+        finished KV into a decode replica's pool.  Deliberately OFF the
+        chunk hot path — the single bundled ``device_get`` here is the
+        budgeted sync (HOST_SYNC_ALLOWLIST), and the router does not
+        call this yet: it is the seam the disaggregated tier will plug
+        into, shaped so the transport (host copy today, ICI/DMA later)
+        is the only thing left to swap.
+
+        Returns ``{"logical": [logical pages, ascending], "layers":
+        [per-layer tuples of (k, page_size, nH, D) page stacks],
+        "quant": bool}``.
+        """
+        mapping = self._slot_pages[slot]
+        order = sorted(mapping)
+        phys = np.asarray([mapping[j] for j in order], np.int32)
+        layers = jax.device_get(
+            [tuple(buf[phys] for buf in pools) for pools in self._pools])
+        return {"logical": order, "layers": layers, "quant": self.quant}
+
+    def import_pages(self, slot, payload):
+        """Inverse seam: allocate fresh pages for ``slot`` and write an
+        :meth:`export_pages` payload into this pool (same layer spec,
+        same page size, same quant mode).  Returns the number of pages
+        imported; raises when the pool cannot hold them (the decode
+        replica's admission gate decides before calling)."""
+        if bool(payload["quant"]) != self.quant:
+            raise ValueError("exporter/importer kv quant modes differ")
+        order = list(payload["logical"])
+        mapping = self._slot_pages[slot]
+        assert not mapping, f"slot {slot} imported while still mapped"
+        fresh = self._alloc(len(order))
+        if fresh is None:
+            raise RuntimeError(
+                f"pool cannot hold {len(order)} imported pages "
+                f"({len(self._free)} free)")
+        row = self.table[slot]
+        for j, page in zip(order, fresh):
+            row[j] = page
+            mapping[j] = page
+        idx = np.asarray(fresh, np.int32)
+        self._pools = [
+            tuple(buf.at[idx].set(jnp.asarray(vals).astype(buf.dtype))
+                  for buf, vals in zip(pools, layer))
+            for pools, layer in zip(self._pools, payload["layers"])]
+        self._gauges()
+        return len(fresh)
 
     # -- invariants (test hook) --------------------------------------------
     def check(self):

@@ -14,7 +14,17 @@ import time
 
 from ..observability import tracing as _tracing
 
-__all__ = ["Request", "FCFSScheduler"]
+__all__ = ["Request", "FCFSScheduler", "PRIORITY_CLASSES",
+           "BEST_EFFORT"]
+
+# Fleet-level priority classes (inference/router.py): rank 0 is served
+# first; BEST_EFFORT (the highest rank) is the only class the router's
+# SLO admission control may shed.  The per-replica scheduler stays FCFS
+# — priority ordering is a ROUTING decision, applied before a request
+# is bound to a replica, so the engine's head-of-line/no-skip-ahead
+# contract (and its bitwise tests) are untouched.
+PRIORITY_CLASSES = {"interactive": 0, "standard": 1, "batch": 2}
+BEST_EFFORT = "batch"
 
 
 class Request:
@@ -31,7 +41,9 @@ class Request:
                  "finish_ns", "finish_reason", "slot", "evictions",
                  "resume_len", "emitted_since_admit", "spec_proposed",
                  "spec_accepted", "trace_id", "span_ns", "requeue_ns",
-                 "prefix_cached", "bucket", "decode_ms")
+                 "prefix_cached", "bucket", "decode_ms", "priority",
+                 "slo_ttft_ms", "replica", "route_ns", "route_reason",
+                 "affinity_key")
 
     def __init__(self, req_id, prompt, max_new_tokens, callback=None):
         self.req_id = req_id
@@ -72,6 +84,17 @@ class Request:
         # spans — the TPOT numerator (an evicted request's requeue
         # wait and re-prefill must NOT inflate its per-token time)
         self.decode_ms = 0.0
+        # fleet routing (inference/router.py): priority class +
+        # per-request TTFT SLO drive the router's scheduling/admission;
+        # replica/route_ns/route_reason record the routing decision
+        # (the `route` trace span's args), and affinity_key is the
+        # chained prefix digest the router hashes for prefix-affinity
+        self.priority = "standard"
+        self.slo_ttft_ms = None
+        self.replica = None
+        self.route_ns = None
+        self.route_reason = None
+        self.affinity_key = None
 
     @property
     def done(self):
@@ -126,6 +149,32 @@ class FCFSScheduler:
         with self._lock:
             self._queue.append(req)
         return req
+
+    def enqueue(self, req):
+        """Append an *existing* :class:`Request` behind the queue tail —
+        the router's dispatch path (and its cross-replica requeue): the
+        Request identity (id, callback, trace, streamed tokens) must
+        survive being handed to a different replica's scheduler."""
+        with self._lock:
+            self._queue.append(req)
+        return req
+
+    def drain_queue(self):
+        """Pop every queued (not yet admitted) request, oldest first —
+        the replica-death/scale-down drain seam.  In-flight slots are
+        drained separately via :meth:`requeue`."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
+
+    def steal_tail(self):
+        """Pop the YOUNGEST queued (not yet admitted) request, or None
+        — the router's work-stealing rebalance: an idle replica pulls
+        parked work off a deep queue.  Tail-steal keeps this queue's
+        FCFS head (and the head-of-line contract) untouched."""
+        with self._lock:
+            return self._queue.pop() if self._queue else None
 
     @property
     def queue_depth(self):

@@ -326,6 +326,16 @@ class ServingEngine:
 
     # -- state -------------------------------------------------------------
     def _init_state(self):
+        self._init_device_state()
+        with self._lock:
+            self.stats = {"requests": 0, "finished": 0,
+                          "decoded_tokens": 0, "chunks": 0,
+                          "prefills": 0, "ttft_ms": [],
+                          "max_concurrent": 0, "page_evictions": 0,
+                          "spec_proposed": 0, "spec_accepted": 0,
+                          "spec_verify_steps": 0, "spec_chunks": 0}
+
+    def _init_device_state(self):
         S = self.num_slots
         self._tokens = jnp.full((S,), self.pad, jnp.int32)
         self._pos = jnp.zeros((S,), jnp.int32)
@@ -353,13 +363,6 @@ class ServingEngine:
                 if self._model_draft else None
         else:
             self._history = self._draft_caches = None
-        with self._lock:
-            self.stats = {"requests": 0, "finished": 0,
-                          "decoded_tokens": 0, "chunks": 0,
-                          "prefills": 0, "ttft_ms": [],
-                          "max_concurrent": 0, "page_evictions": 0,
-                          "spec_proposed": 0, "spec_accepted": 0,
-                          "spec_verify_steps": 0, "spec_chunks": 0}
 
     def reset(self):
         """Drop all queued/in-flight work and zero the device state (the
@@ -398,31 +401,27 @@ class ServingEngine:
             self._kv.clear_prefix()
 
     # -- API ---------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=32, callback=None):
-        """Queue one request; returns its :class:`Request`.  ``prompt``
-        is a 1-D int sequence (list/np array/Tensor)."""
-        prompt = np.asarray(getattr(prompt, "_value", prompt),
-                            dtype=np.int32).reshape(-1)
-        if prompt.size == 0:
+    def _check_extent(self, prompt_len, total_extent):
+        """Shared admission validation for :meth:`submit` and
+        :meth:`submit_request`: the (resume-)prompt must fit a prefill
+        bucket, the request's full extent must fit the sequence budget,
+        and (paged) the pool must be able to finish it even running
+        alone — discovering that mid-decode (after page pressure has
+        already evicted everything else) would throw away every
+        in-flight request's streamed tokens."""
+        if prompt_len == 0:
             raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if prompt.size > self.buckets[-1]:
+        if prompt_len > self.buckets[-1]:
             raise ValueError(
-                f"prompt length {prompt.size} exceeds the largest "
+                f"prompt length {prompt_len} exceeds the largest "
                 f"prefill bucket {self.buckets[-1]}")
-        if prompt.size + max_new_tokens > self.MAX:
+        if total_extent > self.MAX:
             raise ValueError(
-                f"prompt_len + max_new_tokens = "
-                f"{prompt.size + int(max_new_tokens)} exceeds "
+                f"prompt_len + max_new_tokens = {total_extent} exceeds "
                 f"max_seq_len = {self.MAX}")
         if self._paged:
-            # reject a request the pool can never finish EVEN RUNNING
-            # ALONE up front — discovering it mid-decode (after page
-            # pressure has already evicted everything else) would throw
-            # away every in-flight request's streamed tokens
             P = self._kv.page_size
-            extent = int(prompt.size) + int(max_new_tokens)
+            extent = int(total_extent)
             if self._spec is not None:
                 # verify steps write a gamma-token overhang past the
                 # last emitted position (clamped to MAX; beyond-MAX
@@ -435,6 +434,16 @@ class ServingEngine:
                     f"the pool has {self._kv.num_pages - 1} allocatable "
                     f"pages — raise num_pages (or page_size) or lower "
                     "max_new_tokens")
+
+    def submit(self, prompt, max_new_tokens=32, callback=None):
+        """Queue one request; returns its :class:`Request`.  ``prompt``
+        is a 1-D int sequence (list/np array/Tensor)."""
+        prompt = np.asarray(getattr(prompt, "_value", prompt),
+                            dtype=np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self._check_extent(int(prompt.size),
+                           int(prompt.size) + int(max_new_tokens))
         # the lock spans the scheduler handoff: a submit racing reset()
         # must land entirely on the old scheduler (whose queued work
         # reset drops) or entirely on the new one — never return a
@@ -445,6 +454,49 @@ class ServingEngine:
             self.stats["requests"] += 1
             return self.scheduler.submit(prompt, max_new_tokens,
                                          callback)
+
+    def submit_request(self, req):
+        """Enqueue an *existing* :class:`Request` — the fleet router's
+        dispatch seam (``inference/router.py``).  Same validation as
+        :meth:`submit`, but the Request object (id, callback, trace id,
+        already-streamed tokens) is preserved, so a request drained off
+        a dead replica re-enters here and resumes by recompute exactly
+        like a page-pressure re-admission (bitwise-equivalent output).
+        Like :meth:`submit`, this is a declared cross-thread entry (the
+        router dispatches while the replica loop steps)."""
+        budget = req.max_new_tokens - len(req.tokens)
+        if budget < 1:
+            raise ValueError(
+                f"request {req.req_id} has no generation budget left")
+        rp = self._resume_prompt(req)
+        self._check_extent(int(rp.size),
+                           int(req.prompt.size) + int(req.max_new_tokens))
+        with self._lock:
+            self.stats["requests"] += 1
+            self.scheduler.enqueue(req)
+        return req
+
+    def drain(self):
+        """Remove and return every queued + in-flight request (oldest
+        first) and rebuild the engine's device state — the replica
+        lifecycle seam: the router drains a dead or scaled-down replica
+        and re-routes the requests to survivors, where they resume by
+        recompute (prompt + streamed tokens re-prefill, bitwise-
+        equivalent to uninterrupted decode).
+
+        Contract: call only with the engine loop quiesced (the replica
+        worker dead or joined) — drain rebuilds the slot/KV device
+        state from scratch, so it must never race a ``step()``.  That
+        also makes it safe after a mid-step crash left donated buffers
+        invalidated: nothing here reads the old device arrays."""
+        with self._lock:
+            for slot in sorted(self.scheduler.active):
+                self.scheduler.requeue(slot)
+                if self._paged:
+                    self._kv.release(slot, evicted=True)
+            out = self.scheduler.drain_queue()
+            self._init_device_state()
+        return out
 
     def step(self):
         """One engine cycle: admit queued requests into free slots
@@ -611,7 +663,9 @@ class ServingEngine:
         # trace marker from the requeue stamp the scheduler just took —
         # a host clock read between chunks, not a device sync
         _tracing.instant(req.trace_id, req.req_id, "page_evict",
-                         req.requeue_ns, pages_freed=pages)
+                         req.requeue_ns, pages_freed=pages,
+                         **({} if req.replica is None
+                            else {"replica": req.replica}))
         return req
 
     def _page_pressure(self):
@@ -761,10 +815,19 @@ class ServingEngine:
                                   pages_shared=k // self._kv.page_size,
                                   prompt_len=n)
             else:
-                n = int(req.prompt.size)
+                # resume-by-recompute works on the dense path too (the
+                # fleet router requeues a dead replica's in-flight work
+                # here): the resume prompt re-prefills prompt + already-
+                # streamed tokens with the REMAINING budget — for a
+                # fresh request this is exactly the original formulation
+                rp = self._resume_prompt(req)
+                n = int(rp.size)
+                budget = req.max_new_tokens - len(req.tokens)
                 bucket = self._bucket_for(n)
                 ids = np.full((1, bucket), self.pad, np.int32)
-                ids[0, :n] = req.prompt
+                ids[0, :n] = rp
+                req.resume_len = n
+                req.emitted_since_admit = 0
                 with RecordEvent("serving.prefill"):
                     if self._spec is not None:
                         ids_j = jnp.asarray(ids)   # full == suffix: no
@@ -776,8 +839,7 @@ class ServingEngine:
                             jnp.zeros((), jnp.int32),
                             jnp.asarray(n, jnp.int32),
                             jnp.asarray(slot, jnp.int32),
-                            jnp.asarray(int(req.max_new_tokens),
-                                        jnp.int32),
+                            jnp.asarray(int(budget), jnp.int32),
                             self._tokens, self._pos, self._active,
                             self._remaining, self._caches,
                             self._draft_caches, self._history)
@@ -788,8 +850,7 @@ class ServingEngine:
                                 self._pvals, jnp.asarray(ids),
                                 jnp.asarray(n, jnp.int32),
                                 jnp.asarray(slot, jnp.int32),
-                                jnp.asarray(int(req.max_new_tokens),
-                                            jnp.int32),
+                                jnp.asarray(int(budget), jnp.int32),
                                 self._tokens, self._pos, self._active,
                                 self._remaining, self._caches)
             self.stats["prefills"] += 1
@@ -896,17 +957,29 @@ class ServingEngine:
             # admissions, one decode span per chunk participation —
             # per request they tile submit -> finish exactly
             if _obs.enabled():
+                # spans carry the replica label when the request came
+                # through the fleet router (report --requests
+                # --per-replica groups on it); single-engine traces are
+                # unchanged
+                rep = {} if req.replica is None \
+                    else {"replica": req.replica}
                 if slot in admitted_slots:
+                    # queue wait restarts at the LATEST of submit, the
+                    # page-pressure requeue, and the router's dispatch
+                    # stamp — the route span (router-side) ends where
+                    # this one starts, so per-request spans still tile
+                    qstart = max(s for s in (req.submit_ns,
+                                             req.requeue_ns,
+                                             req.route_ns) if s)
                     _tracing.span(req.trace_id, req.req_id, "queue_wait",
-                                  req.requeue_ns or req.submit_ns,
-                                  req.admit_ns,
-                                  resume=req.evictions > 0)
+                                  qstart, req.admit_ns,
+                                  resume=req.evictions > 0, **rep)
                     _tracing.span(req.trace_id, req.req_id, "prefill",
                                   req.admit_ns, now, bucket=req.bucket,
                                   cached_tokens=req.prefix_cached,
                                   resume=req.evictions > 0,
                                   tokens=len(toks_slot),
-                                  reason=req.finish_reason)
+                                  reason=req.finish_reason, **rep)
                 else:
                     start = req.span_ns or req.admit_ns
                     _tracing.span(req.trace_id, req.req_id,
@@ -914,7 +987,7 @@ class ServingEngine:
                                   else "decode",
                                   start, now,
                                   tokens=len(toks_slot),
-                                  reason=req.finish_reason)
+                                  reason=req.finish_reason, **rep)
                     req.decode_ms += (now - start) / 1e6
                 req.span_ns = now
             if req.callback is not None:

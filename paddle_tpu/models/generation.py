@@ -10,6 +10,8 @@ compiled program. Sampling uses counter-based keys split per step;
 finished rows emit ``pad_token_id`` (scan has no early exit — the
 standard masked-finish formulation).
 """
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -96,12 +98,25 @@ def cast_weights(model, pvals, cache_dtype):
     return out
 
 
+# build_apply swaps values INTO the (shared) model's parameters for the
+# duration of one traced forward.  Two serving-fleet replicas tracing
+# over the same model concurrently would leak one thread's tracers into
+# the other's trace as hoisted constants ("Computation compiled for N
+# inputs but called with M" / "Detected argument of Tracer type"), so
+# the swap->forward->restore window is one atomic critical section.
+# Held only while TRACING (apply bodies run under jit); compiled
+# dispatch never takes it.
+_APPLY_LOCK = threading.RLock()
+
+
 def build_apply(model, params):
     """Functional forward over the model's cached decode path, shared by
     ``generate()`` and the serving engine: swap ``pv`` into the
     parameters, run one cached step, restore.  ``pos`` may be a scalar
     (uniform batch) or a per-row (B,) vector (the engine's per-slot
-    offsets); ``attn_mask`` is an optional additive (B, MAX) key mask."""
+    offsets); ``attn_mask`` is an optional additive (B, MAX) key mask.
+    Thread-safe across models sharing parameters (the fleet's replicas):
+    the swap-restore window is serialized by ``_APPLY_LOCK``."""
     def _wrap(c):
         # dense (k, v) pair or a paged cache view (a NamedTuple whose
         # optional scale fields may be None) — wrap leaves, keep shape
@@ -117,22 +132,23 @@ def build_apply(model, params):
         return tuple(x._value for x in c)
 
     def apply(pv, ids, caches, pos, attn_mask=None):
-        olds = [p._value for p in params]
-        for p, v in zip(params, pv):
-            p._value = v
-        try:
-            kw = {}
-            if attn_mask is not None:
-                kw["attn_mask"] = Tensor(attn_mask)
-            with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
-                logits, new_caches = model(
-                    Tensor(ids),
-                    caches=[_wrap(c) for c in caches],
-                    pos=Tensor(pos), **kw)
-            return logits._value, [_unwrap(c) for c in new_caches]
-        finally:
-            for p, v in zip(params, olds):
+        with _APPLY_LOCK:
+            olds = [p._value for p in params]
+            for p, v in zip(params, pv):
                 p._value = v
+            try:
+                kw = {}
+                if attn_mask is not None:
+                    kw["attn_mask"] = Tensor(attn_mask)
+                with _ag.suspend_tape(), rng_scope(jax.random.key(0)):
+                    logits, new_caches = model(
+                        Tensor(ids),
+                        caches=[_wrap(c) for c in caches],
+                        pos=Tensor(pos), **kw)
+                return logits._value, [_unwrap(c) for c in new_caches]
+            finally:
+                for p, v in zip(params, olds):
+                    p._value = v
     return apply
 
 

@@ -96,6 +96,54 @@ METRICS = {
         "type": _C, "labels": (),
         "help": "batched gamma+1-wide target verify forwards that "
                 "carried at least one active slot"},
+    # -- serving fleet router (inference/router.py) -----------------------
+    "pt_router_requests_total": {
+        "type": _C, "labels": ("priority",),
+        "help": "requests submitted to the fleet router, by priority "
+                "class: interactive | standard | batch"},
+    "pt_router_routed_total": {
+        "type": _C, "labels": ("reason",),
+        "help": "routing decisions by pick reason: affinity (prefix-"
+                "digest match) | least_loaded (queue-depth x occupancy "
+                "fallback) | rebalance (idle replica stole parked "
+                "work)"},
+    "pt_router_shed_total": {
+        "type": _C, "labels": ("priority",),
+        "help": "best-effort requests shed by SLO admission control "
+                "(terminal callback with reason 'shed')"},
+    "pt_router_queue_depth": {
+        "type": _G, "labels": (),
+        "help": "fleet-level queue depth after the latest dispatch gap "
+                "(excludes per-replica queues)"},
+    "pt_router_route_wait_ms": {
+        "type": _H, "labels": (),
+        "help": "submit (or requeue) -> replica-dispatch wait in the "
+                "fleet queue (the `route` trace span's duration)"},
+    "pt_router_replica_queue_depth": {
+        "type": _G, "labels": ("replica",),
+        "help": "per-replica engine queue depth at the latest dispatch "
+                "gap (the least-loaded score's first component)"},
+    "pt_router_replica_active": {
+        "type": _G, "labels": ("replica",),
+        "help": "per-replica in-flight slots at the latest dispatch "
+                "gap (the least-loaded score's tie-breaker)"},
+    "pt_router_replica_deaths_total": {
+        "type": _C, "labels": (),
+        "help": "replicas detected dead (worker crash / failpoint) and "
+                "drained"},
+    "pt_router_requeued_total": {
+        "type": _C, "labels": (),
+        "help": "requests drained off a dead or retired replica and "
+                "requeued for re-routing (they resume by recompute)"},
+    "pt_router_aged_total": {
+        "type": _C, "labels": (),
+        "help": "requests promoted at least one priority rank by anti-"
+                "starvation aging while waiting in the fleet queue"},
+    "pt_router_scale_hint": {
+        "type": _G, "labels": (),
+        "help": "latest autoscale recommendation: +1 scale up, -1 "
+                "scale down, 0 steady (keyed on queue-depth and "
+                "occupancy)"},
     # -- paged KV cache (inference/kvcache.py) ----------------------------
     "pt_kvcache_pages_in_use": {
         "type": _G, "labels": (),

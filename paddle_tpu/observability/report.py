@@ -488,6 +488,33 @@ def requests_view(rows):
     return out
 
 
+def per_replica_views(rows):
+    """Group request summaries by the replica that served them (the
+    fleet router's ``replica`` span label; the LAST replica for a
+    request that migrated after a replica death) and fold each group
+    through :func:`requests_view`.  Requests with no replica label
+    (single-engine serving, or shed before dispatch) group under
+    ``"-"``."""
+    groups = {}
+    for r in rows:
+        key = r.get("replica")
+        groups.setdefault("-" if key is None else str(key), []).append(r)
+    return {k: requests_view(v) for k, v in sorted(groups.items())}
+
+
+def render_per_replica(views):
+    lines = ["== per-replica request summary =="]
+    for rep, v in views.items():
+        t, p = v["ttft_ms"], v["tpot_ms"]
+        lines.append(
+            f"  replica {rep}: requests={v['requests']} "
+            f"tokens={v['tokens']} "
+            f"ttft p50={t['p50']} p99={t['p99']} "
+            f"tpot p50={p['p50']} p99={p['p99']} "
+            f"evictions={v['evictions']}")
+    return "\n".join(lines)
+
+
 def render_requests(summary, rows):
     lines = ["== per-request serving traces ==",
              f"requests={summary['requests']} "
@@ -536,6 +563,10 @@ def main(argv=None):
     rp.add_argument("--requests", action="store_true",
                     help="per-request TTFT/TPOT summary from the "
                          "--trace file's request lanes")
+    rp.add_argument("--per-replica", action="store_true",
+                    dest="per_replica",
+                    help="with --requests: additionally group the "
+                         "summary by the fleet router's replica label")
     rp.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the subview as JSON (with --roofline / "
                          "--requests)")
@@ -555,6 +586,9 @@ def main(argv=None):
         return 2
     if args.requests and not args.trace:
         print("error: --requests needs --trace", file=sys.stderr)
+        return 2
+    if args.per_replica and not args.requests:
+        print("error: --per-replica needs --requests", file=sys.stderr)
         return 2
     if not (args.prom or args.jsonl or args.trace):
         print("error: pass at least one of --prom/--jsonl/--trace",
@@ -578,6 +612,12 @@ def main(argv=None):
                                        "per_request": rows}
                 else:
                     print(render_requests(summary, rows))
+                if args.per_replica:
+                    views = per_replica_views(rows)
+                    if args.as_json:
+                        out["per_replica"] = views
+                    else:
+                        print(render_per_replica(views))
             if args.as_json:
                 print(json.dumps(out, indent=1, sort_keys=True))
             return 0

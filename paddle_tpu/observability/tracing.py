@@ -7,7 +7,11 @@ wait, a cold prefill, a page eviction, or plain decode cadence.  This
 module gives every request a trace id (minted at ``submit()``) and
 books one span per lifecycle phase:
 
-- ``queue_wait`` — submit (or page-pressure requeue) -> slot admission;
+- ``route``      — submit (or drain-requeue) -> replica dispatch by the
+  fleet router (args: pick reason ``affinity | least_loaded | shed``
+  and the replica index; absent for single-engine serving);
+- ``queue_wait`` — replica dispatch (or submit / page-pressure
+  requeue, whichever is latest) -> slot admission;
 - ``prefill``    — admission -> the chunk-boundary sync that streamed
   its first token (args: bucket, prefix-hit/cached tokens, resume flag);
 - ``decode`` / ``spec_decode`` — one span per decode chunk the request
@@ -117,9 +121,16 @@ def request_summaries(span_list=None):
         r["end_ns"] = max(r["end_ns"], s["end_ns"])
         dur = (s["end_ns"] - s["start_ns"]) / 1e6
         ph = s["phase"]
+        if "replica" in s["args"]:
+            # LAST replica that touched the request (a drained request
+            # finishes on a survivor — that's the one tail attribution
+            # should blame); report --per-replica groups on this
+            r["replica"] = s["args"]["replica"]
         if ph == "page_evict":
             r["evictions"] += 1
             continue
+        if ph == "drain":
+            continue           # instant marker (replica death), no wall
         r["phase_ms"][ph] = r["phase_ms"].get(ph, 0.0) + dur
         r["tokens"] += int(s["args"].get("tokens", 0))
         if ph == "prefill" and "first_token_end_ns" not in r:

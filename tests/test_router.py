@@ -1,0 +1,486 @@
+"""Multi-replica serving fleet (inference/router.py): bitwise parity
+through the router, prefix-affinity + least-loaded routing, SLO-aware
+priority scheduling (ordering, aging, shed), replica lifecycle
+(crash-drain-requeue chaos, scale-down, autoscale hints), the
+route-span tracing contract, and the concurrency/host-sync lint
+self-check on the router's locked regions.
+
+The parity tests are the real check: whatever replica/slot a request
+lands on — including after a mid-decode replica crash — greedy output
+must match ``generate()`` token for token (resume-by-recompute)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.framework import failpoints, guardian
+from paddle_tpu.inference import kvcache
+from paddle_tpu.inference.router import ServingFleet
+from paddle_tpu.observability import tracing, report, timeline
+from paddle_tpu.models import GPTForPretraining, gpt3_tiny
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    return GPTForPretraining(gpt3_tiny())
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.get_registry().reset()
+    tracing.reset()
+    guardian.clear_events()
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _gen(gpt, prompt, n):
+    ids, _ = gpt.generate(paddle.to_tensor(prompt[None, :]),
+                          max_new_tokens=n)
+    return np.asarray(ids._value)[0]
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 1024, (n,)).astype("int32") for n in lens]
+
+
+@pytest.fixture(scope="module")
+def fleet2(gpt):
+    """Shared 2-replica dense fleet (compiles once per module)."""
+    return ServingFleet(gpt, num_replicas=2, num_slots=2, chunk=4,
+                        prefill_buckets=(8, 16))
+
+
+class TestFleetParity:
+    def test_serial_bitwise_and_balanced(self, gpt, fleet2):
+        """Round-robin serial fleet: every request bitwise == its own
+        generate() run, and the load balancer uses both replicas."""
+        fleet2.reset()
+        prompts = _prompts(1, (5, 11, 8, 3, 7, 9))
+        refs = [_gen(gpt, p, 6) for p in prompts]
+        reqs = [fleet2.submit(p, 6) for p in prompts]
+        done = fleet2.run(threads=False, timeout=120)
+        assert [r.req_id for r in done] == [r.req_id for r in reqs]
+        for r, ref in zip(done, refs):
+            np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                          ref)
+        assert {r.replica for r in done} == {0, 1}
+        assert all(r.route_reason in ("affinity", "least_loaded")
+                   for r in done)
+
+    def test_threaded_bitwise(self, gpt, fleet2):
+        """Worker-thread mode: scheduling is nondeterministic, output
+        must not be."""
+        fleet2.reset()
+        prompts = _prompts(2, (5, 11, 8, 3))
+        refs = [_gen(gpt, p, 6) for p in prompts]
+        reqs = [fleet2.submit(p, 6) for p in prompts]
+        fleet2.run(threads=True, timeout=120)
+        for r, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                          ref)
+
+    def test_submit_validates_like_engine(self, fleet2):
+        """A structurally impossible request raises at submit() —
+        never silently surfaces later as an asynchronous 'shed'."""
+        with pytest.raises(ValueError, match="largest"):
+            fleet2.submit(np.arange(100, dtype=np.int32), 4)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            fleet2.submit(np.arange(10, dtype=np.int32), 1000)
+        with pytest.raises(ValueError, match="empty prompt"):
+            fleet2.submit(np.zeros((0,), np.int32), 4)
+        with pytest.raises(ValueError, match="priority"):
+            fleet2.submit(np.arange(5, dtype=np.int32), 4,
+                          priority="vip")
+
+    def test_submit_is_thread_safe(self, fleet2):
+        fleet2.reset()
+        prompts = _prompts(3, (5,)) * 5
+
+        def burst():
+            for p in prompts:
+                fleet2.submit(p, 2)
+
+        ts = [threading.Thread(target=burst) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        done = fleet2.run(threads=False, timeout=120)
+        assert len(done) == 20
+        assert len({r.req_id for r in done}) == 20
+        assert all(r.finish_reason == "budget" for r in done)
+
+
+class TestRouting:
+    def test_affinity_key_helper(self):
+        rng = np.random.RandomState(0)
+        sys = rng.randint(0, 1024, (32,)).astype("int32")
+        a = np.concatenate([sys, rng.randint(0, 1024, (5,)).astype("int32")])
+        b = np.concatenate([sys, rng.randint(0, 1024, (9,)).astype("int32")])
+        ka = kvcache.prefix_affinity_key(a, 8, max_pages=4)
+        kb = kvcache.prefix_affinity_key(b, 8, max_pages=4)
+        assert ka == kb is not None
+        other = rng.randint(0, 1024, (40,)).astype("int32")
+        assert kvcache.prefix_affinity_key(other, 8, 4) != ka
+        # no full page -> no key (route by load)
+        assert kvcache.prefix_affinity_key(sys[:7], 8, 4) is None
+        # the key is the chained page digest of the capped prefix: it
+        # must equal the prefix cache's key for the same pages
+        assert bytes.fromhex(ka) == \
+            kvcache.chained_page_digests(a[:32], 8)[3]
+
+    def test_rebalance_steals_parked_work(self, gpt, fleet2):
+        """An idle replica steals queued-but-unadmitted work off the
+        deepest replica queue (the straggler fix); the FCFS head of the
+        deep queue never moves."""
+        fleet2.reset()
+        for p in _prompts(16, (5,) * 6):
+            fleet2.submit(p, 3)
+        fleet2._dispatch()               # parks 2 on each + 2 backpressured
+        rep0 = fleet2.replicas[0].engine.scheduler
+        rep1 = fleet2.replicas[1].engine.scheduler
+        # drain replica 1's queue so it sits idle with free slots while
+        # replica 0 still has parked work
+        for r in rep1.drain_queue():
+            rep0.enqueue(r)
+        head = rep0._queue[0].req_id
+        fleet2._rebalance()
+        assert fleet2.stats["rebalanced"] >= 1
+        assert rep0._queue[0].req_id == head     # FCFS head untouched
+        assert rep1.queue_depth >= 1
+        done = fleet2.run(threads=False, timeout=120)
+        assert all(r.finish_reason == "budget" for r in done)
+        reasons = {r.route_reason for r in done}
+        assert "rebalance" in reasons
+
+    def test_prefix_affinity_pins_shared_prompts(self, gpt):
+        """Requests sharing a system prompt land on one replica (warm
+        prefix cache); unrelated prompts spread by load."""
+        rng = np.random.RandomState(3)
+        sys = rng.randint(0, 1024, (32,)).astype("int32")
+        shared = [np.concatenate([sys, rng.randint(0, 1024, (k,))
+                                  .astype("int32")]) for k in (3, 5, 7)]
+        others = [rng.randint(0, 1024, (9,)).astype("int32")
+                  for _ in range(3)]
+        fleet = ServingFleet(gpt, num_replicas=2, num_slots=2, chunk=4,
+                             kv_mode="paged", page_size=8,
+                             prefill_buckets=(8, 16, 32, 64),
+                             max_seq_len=128, affinity_pages=4)
+        reqs = [fleet.submit(p, 4) for p in shared + others]
+        fleet.run(threads=False, timeout=120)
+        homes = {r.replica for r in reqs[:3]}
+        assert len(homes) == 1
+        assert fleet.stats["affinity_routes"] >= 2
+        hits = sum(rep.engine._kv.stats["prefix_hits"]
+                   for rep in fleet.replicas)
+        assert hits >= 2        # the warm-cache payoff of pinning
+        assert {r.replica for r in reqs} == {0, 1}   # others balanced
+
+
+class TestPriorityScheduling:
+    def test_priority_orders_dispatch(self, gpt):
+        """Fleet-level dispatch respects SLO ordering: with one
+        single-slot replica, an interactive request submitted LAST is
+        admitted first."""
+        fleet = ServingFleet(gpt, num_replicas=1, num_slots=1, chunk=4,
+                             prefill_buckets=(8,), replica_queue_limit=0)
+        ps = _prompts(4, (5, 5, 5))
+        rb = fleet.submit(ps[0], 2, priority="batch")
+        rs = fleet.submit(ps[1], 2, priority="standard")
+        ri = fleet.submit(ps[2], 2, priority="interactive")
+        fleet.run(threads=False, timeout=120)
+        order = sorted((rb, rs, ri), key=lambda r: r.admit_ns)
+        assert [r.req_id for r in order] == [ri.req_id, rs.req_id,
+                                             rb.req_id]
+
+    def test_aging_prevents_starvation(self, gpt):
+        """A parked batch request eventually outranks fresh interactive
+        traffic (eff rank drops one per aging_ms waited)."""
+        import time as _time
+        fleet = ServingFleet(gpt, num_replicas=1, num_slots=1, chunk=4,
+                             prefill_buckets=(8,), replica_queue_limit=0,
+                             aging_ms=1.0)
+        ps = _prompts(5, (5, 5))
+        rb = fleet.submit(ps[0], 2, priority="batch")
+        _time.sleep(0.01)        # >= 2 aging periods: rank 2 -> 0
+        ri = fleet.submit(ps[1], 2, priority="interactive")
+        fleet.run(threads=False, timeout=120)
+        assert rb.admit_ns < ri.admit_ns
+        assert fleet.stats["aged"] >= 1
+
+    def test_shed_terminal_callback_and_event(self, gpt):
+        """Over-SLO best-effort traffic is shed with a terminal
+        callback (reason 'shed') and a router_shed guardian event;
+        higher classes are never shed."""
+        fleet = ServingFleet(gpt, num_replicas=1, num_slots=1, chunk=2,
+                             prefill_buckets=(8,), replica_queue_limit=1,
+                             service_ms_prior=1e6)
+        ps = _prompts(6, (5, 5, 5, 5))
+        # budget 8 over chunk 2 keeps the slot busy across dispatch
+        # gaps, so the projection sees a genuinely saturated replica
+        std = [fleet.submit(p, 8, priority="standard") for p in ps[:3]]
+        sheds = []
+        rb = fleet.submit(ps[3], 2, priority="batch", slo_ttft_ms=1.0,
+                          callback=lambda r, t, last:
+                          sheds.append((r.req_id, t, last)))
+        done = fleet.run(threads=False, timeout=120)
+        assert rb.finish_reason == "shed"
+        assert sheds == [(rb.req_id, None, True)]
+        assert all(r.finish_reason == "budget" for r in std)
+        assert fleet.stats["shed"] == 1
+        evs = guardian.events("router_shed")
+        assert evs and evs[-1]["req_id"] == rb.req_id
+        assert evs[-1]["slo_ttft_ms"] == 1.0
+        assert len(done) == 4    # shed requests are still returned
+
+    def test_defer_policy_keeps_best_effort(self, gpt):
+        """overload_policy='defer' parks over-SLO best-effort traffic
+        instead of shedding; it completes once the backlog clears."""
+        fleet = ServingFleet(gpt, num_replicas=1, num_slots=1, chunk=4,
+                             prefill_buckets=(8,), replica_queue_limit=1,
+                             service_ms_prior=50.0,
+                             overload_policy="defer")
+        ps = _prompts(7, (5, 5, 5))
+        std = [fleet.submit(p, 2, priority="standard") for p in ps[:2]]
+        rb = fleet.submit(ps[2], 2, priority="batch", slo_ttft_ms=0.001)
+        fleet.run(threads=False, timeout=120)
+        assert rb.finish_reason == "budget"        # never shed
+        assert fleet.stats["shed"] == 0
+        assert max(s.admit_ns for s in std) < rb.admit_ns
+
+
+class TestReplicaLifecycle:
+    @pytest.mark.chaos
+    def test_replica_crash_requeues_bitwise(self, gpt):
+        """THE chaos acceptance: kill a replica mid-decode; its queued
+        + in-flight requests requeue to the survivor and ALL requests
+        complete with bitwise-correct output (resume-by-recompute)."""
+        prompts = _prompts(8, (5, 11, 8, 3, 7, 9))
+        refs = [_gen(gpt, p, 8) for p in prompts]
+        fleet = ServingFleet(gpt, num_replicas=2, num_slots=2, chunk=4,
+                             prefill_buckets=(8, 16, 32))
+        failpoints.set_failpoint("serving.replica_crash", "error*1")
+        reqs = [fleet.submit(p, 8) for p in prompts]
+        done = fleet.run(threads=False, timeout=120)
+        assert fleet.stats["replica_deaths"] == 1
+        assert fleet.stats["requeued"] >= 1
+        dead = [rep for rep in fleet.replicas if rep.state == "dead"]
+        assert len(dead) == 1 and "Failpoint" in dead[0].error
+        for r, ref in zip(done, refs):
+            np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                          ref)
+        survivor = (set(range(2)) - {dead[0].idx}).pop()
+        moved = [r for r in done if r.evictions > 0]
+        assert moved and all(r.replica == survivor for r in moved)
+        evs = guardian.events("router_replica_death")
+        assert evs and evs[-1]["replica"] == dead[0].idx
+
+    @pytest.mark.chaos
+    def test_threaded_paged_crash_bitwise(self, gpt):
+        """Same chaos through worker threads and the paged KV engine
+        (pages freed on drain, prefix state rebuilt)."""
+        prompts = _prompts(9, (5, 11, 8, 9))
+        refs = [_gen(gpt, p, 6) for p in prompts]
+        fleet = ServingFleet(gpt, num_replicas=2, num_slots=2, chunk=4,
+                             kv_mode="paged", page_size=8,
+                             prefill_buckets=(8, 16, 32),
+                             max_seq_len=128)
+        failpoints.set_failpoint("serving.replica_crash", "error*1")
+        reqs = [fleet.submit(p, 6) for p in prompts]
+        fleet.run(threads=True, timeout=120)
+        assert fleet.stats["replica_deaths"] == 1
+        for r, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                          ref)
+        for rep in fleet.replicas:       # no leaked pages anywhere
+            if rep.state == "up":
+                assert rep.engine._kv.check()
+
+    def test_remove_replica_drains_and_requeues(self, gpt, fleet2):
+        fleet2.reset()
+        prompts = _prompts(10, (5, 5, 5, 5))
+        reqs = [fleet2.submit(p, 3) for p in prompts]
+        fleet2._dispatch()               # park work on both replicas
+        n = fleet2.remove_replica(1)
+        assert n >= 1
+        assert fleet2.stats["requeued"] == n
+        done = fleet2.run(threads=False, timeout=120)
+        assert all(r.finish_reason == "budget" for r in done)
+        assert all(r.replica == 0 for r in done)
+        with pytest.raises(RuntimeError, match="last routable"):
+            fleet2.remove_replica(0)
+        # restore the module fleet for later tests
+        fleet2.replicas[1].state = "up"
+        fleet2.replicas[1].retire.clear()
+        fleet2.reset()
+
+    def test_autoscale_recommendation(self, gpt):
+        fleet = ServingFleet(gpt, num_replicas=2, num_slots=1, chunk=2,
+                             prefill_buckets=(8,),
+                             scale_up_queue_per_replica=2.0)
+        # an idle multi-replica fleet recommends retiring a replica
+        assert fleet.autoscale_recommendation() == -1
+        for p in _prompts(11, (5,) * 12):
+            fleet.submit(p, 8)
+        fleet._dispatch()
+        for rep in fleet.replicas:       # occupy every slot
+            fleet._step_replica(rep)
+        rec = fleet.autoscale_recommendation()
+        assert rec == 1                  # deep backlog, full occupancy
+        fleet.run(threads=False, timeout=120)
+        assert fleet.autoscale_recommendation() == -1  # idle again
+        assert guardian.events("router_scale")
+
+    def test_export_import_pages_roundtrip(self):
+        """The disaggregation seam: a slot's pages survive an
+        export->import hop into another manager's pool bit-for-bit."""
+        spec = [(2, 4), (2, 4)]
+        a = kvcache.PagedKVManager(spec, 2, 32, 8, 9, "float32")
+        b = kvcache.PagedKVManager(spec, 2, 32, 8, 9, "float32")
+        prompt = np.arange(16, dtype=np.int32)
+        plan = a.plan(prompt, 8, 8)
+        a.bind(0, plan)
+        rng = np.random.RandomState(0)
+        pools = [tuple(buf.at[1:3].set(rng.randn(2, 8, 2, 4)
+                                       .astype("float32"))
+                       for buf in pools) for pools in a.device_pools()]
+        a.set_pools(pools)
+        payload = a.export_pages(0)
+        assert payload["logical"] == sorted(a._slot_pages[0])
+        n = b.import_pages(1, payload)
+        assert n == len(payload["logical"])
+        assert b.check()
+        got = b.export_pages(1)
+        for la, lb in zip(payload["layers"], got["layers"]):
+            for xa, xb in zip(la, lb):
+                np.testing.assert_array_equal(xa, xb)
+
+
+class TestFleetObservability:
+    def test_route_span_tiling_and_per_replica(self, gpt, fleet2):
+        """Every routed request books route -> queue_wait -> prefill
+        (-> decode) spans that tile submit -> finish, each carrying the
+        replica label; report --per-replica groups them."""
+        fleet2.reset()
+        prompts = _prompts(12, (5, 11, 8, 3))
+        reqs = [fleet2.submit(p, 6) for p in prompts]
+        fleet2.run(threads=False, timeout=120)
+        rows = tracing.request_summaries()
+        assert len(rows) == len(reqs)
+        for row in rows:
+            assert {"route", "queue_wait", "prefill"} <= \
+                set(row["phase_ms"])
+            assert row["replica"] in (0, 1)
+            assert row["span_sum_ms"] == pytest.approx(
+                row["total_ms"], rel=0.01, abs=0.05)
+        views = report.per_replica_views(rows)
+        assert set(views) <= {"0", "1"}
+        assert sum(v["requests"] for v in views.values()) == len(reqs)
+
+    def test_report_per_replica_cli(self, gpt, fleet2, tmp_path,
+                                    capsys):
+        fleet2.reset()
+        for p in _prompts(13, (5, 9, 7)):
+            fleet2.submit(p, 4)
+        fleet2.run(threads=False, timeout=120)
+        trace = str(tmp_path / "t.trace.json")
+        timeline.export_chrome_trace(trace, include_profiler=False,
+                                     include_guardian=False,
+                                     include_samples=False)
+        rc = report.main(["report", "--trace", trace, "--requests",
+                          "--per-replica", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["per_replica"]
+        assert sum(v["requests"] for v in out["per_replica"].values()) \
+            == 3
+        assert report.main(["report", "--trace", trace,
+                            "--per-replica"]) == 2   # needs --requests
+
+    def test_router_metrics_recorded(self, gpt, fleet2):
+        fleet2.reset()
+        for p in _prompts(14, (5, 9)):
+            fleet2.submit(p, 3, priority="interactive")
+        fleet2.run(threads=False, timeout=120)
+        reg = obs.get_registry()
+        assert reg.get("pt_router_requests_total").value(
+            priority="interactive") == 2
+        routed = reg.get("pt_router_routed_total")
+        total = sum(s[1] for s in routed.series())
+        assert total == 2
+        assert reg.get("pt_router_queue_depth") is not None
+        evs = guardian.events("router_stats")
+        assert evs and evs[-1]["requests"] == 2
+
+    def test_zero_new_host_sync_ab(self, gpt, monkeypatch):
+        """The PR 5/9 A/B extended to the fleet: routing + route spans
+        + router metrics add ZERO device transfers (serial mode, so the
+        chunk schedule is deterministic across legs)."""
+        lock = threading.Lock()
+        counts = {"n": 0}
+        real = jax.device_get
+
+        def counting(x):
+            with lock:
+                counts["n"] += 1
+            return real(x)
+
+        def run_once(enabled):
+            fleet = ServingFleet(gpt, num_replicas=2, num_slots=2,
+                                 chunk=4, prefill_buckets=(8, 16))
+            for p in _prompts(15, (5, 11, 8, 3)):
+                fleet.submit(p, 5)
+            counts["n"] = 0
+            monkeypatch.setattr(jax, "device_get", counting)
+            try:
+                if enabled:
+                    fleet.run(threads=False, timeout=120)
+                else:
+                    with obs.disabled():
+                        fleet.run(threads=False, timeout=120)
+            finally:
+                monkeypatch.setattr(jax, "device_get", real)
+            chunks = sum(r.engine.stats["chunks"]
+                         for r in fleet.replicas)
+            return counts["n"], chunks
+
+        n_on, chunks_on = run_once(True)
+        n_off, chunks_off = run_once(False)
+        assert chunks_on == chunks_off
+        assert n_on == n_off > 0
+        assert len(tracing.spans()) > 0   # tracing DID run in the on leg
+
+
+@pytest.mark.lint
+class TestRouterLintSelfCheck:
+    def test_failpoint_registered(self):
+        import paddle_tpu.inference.router  # noqa: F401 — registers
+        assert "serving.replica_crash" in failpoints.registered()
+
+    def test_router_concurrency_and_sync_lints_clean(self):
+        """The router's locked regions satisfy the concurrency pass and
+        its one budgeted sync satisfies host-sync — with the committed
+        baseline still EMPTY."""
+        from paddle_tpu.analysis import runner
+        findings = runner.run_passes(
+            paths=["paddle_tpu/inference/router.py",
+                   "paddle_tpu/inference/scheduler.py",
+                   "paddle_tpu/inference/serving.py",
+                   "paddle_tpu/inference/kvcache.py"],
+            passes=["concurrency", "host-sync"])
+        assert findings == []
+        import os
+        base = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "lint_baseline.json")
+        with open(base, encoding="utf-8") as f:
+            assert not json.load(f)["findings"]      # baseline EMPTY

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Single CI entry point: lint sweep -> tier-1 tests -> opt-in bench
+gate, in that order, stopping at the first failing stage.
+
+The three gates existed separately (`tools/lint.py`, the tier-1 pytest
+invocation from ROADMAP.md, `tools/bench_compare.py`); nothing ran them
+as one pipeline, so "is this tree green" was three commands and a
+README lookup.  This wires them into one:
+
+    python tools/ci_check.py                  # lint + tests
+    python tools/ci_check.py --changed-only   # git-diff-scoped lint,
+                                              # then tests
+    python tools/ci_check.py --bench-gate     # + BENCH_r* trajectory
+                                              # diff (opt-in: bench
+                                              # numbers move with
+                                              # machine load)
+    python tools/ci_check.py --skip-tests     # lint (+gate) only
+
+Stages:
+
+1. **lint** — the full static-analysis suite (`python -m
+   paddle_tpu.analysis`, baseline-suppressed).  `--changed-only`
+   passes through to the runner's git-diff scoping.
+2. **tests** — tier-1: ``pytest tests/ -m 'not slow'`` on the CPU
+   backend (the ROADMAP.md verify command without the log plumbing).
+   ``--pytest-args "..."`` appends extra flags (e.g. ``-x -k serving``).
+3. **bench gate** (``--bench-gate``) — diff the newest two committed
+   ``BENCH_r*.json`` via the `bench` pass (threshold:
+   ``PADDLE_BENCH_THRESHOLD``, default 5%).
+
+Exit code: the first failing stage's (lint/bench: 1; tests: pytest's).
+"""
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _stage(name):
+    print(f"\n=== ci_check: {name} ===", flush=True)
+    return time.perf_counter()
+
+
+def run_lint(changed_only):
+    from paddle_tpu.analysis import main as lint_main
+    t0 = _stage("lint sweep" + (" (--changed-only)" if changed_only
+                                else ""))
+    argv = ["--changed-only"] if changed_only else []
+    rc = lint_main(argv)
+    print(f"lint: {'OK' if rc == 0 else 'FAIL'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
+def run_tests(extra):
+    t0 = _stage("tier-1 tests (pytest -m 'not slow')")
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q",
+           "-m", "not slow", "--continue-on-collection-errors",
+           "-p", "no:cacheprovider"] + extra
+    print("$", " ".join(shlex.quote(c) for c in cmd), flush=True)
+    rc = subprocess.call(cmd, cwd=REPO)
+    print(f"tests: {'OK' if rc == 0 else f'FAIL (rc={rc})'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
+def run_bench_gate():
+    from paddle_tpu.analysis import runner
+    t0 = _stage("bench trajectory gate (opt-in)")
+    findings = runner.run_passes(passes=["bench"])
+    for f in findings:
+        print(f"  [{f.code}] {f.message}")
+    rc = 1 if any(f.code == "bench-regression" for f in findings) else 0
+    print(f"bench gate: {'OK' if rc == 0 else 'FAIL'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="lint sweep -> tier-1 pytest -> opt-in bench gate")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scope the lint sweep to the git diff "
+                         "(tests still run in full)")
+    ap.add_argument("--bench-gate", action="store_true",
+                    help="also diff the newest two BENCH_r*.json")
+    ap.add_argument("--skip-tests", action="store_true",
+                    help="lint (and gate) only")
+    ap.add_argument("--pytest-args", default="",
+                    help="extra pytest flags, quoted (e.g. '-x -k "
+                         "serving')")
+    args = ap.parse_args(argv)
+
+    rc = run_lint(args.changed_only)
+    if rc != 0:
+        return rc
+    if args.bench_gate:
+        rc = run_bench_gate()
+        if rc != 0:
+            return rc
+    if not args.skip_tests:
+        rc = run_tests(shlex.split(args.pytest_args))
+        if rc != 0:
+            return rc
+    print("\nci_check: ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
