@@ -1068,7 +1068,26 @@ def bench_serving(n_requests=64, seed=0, hidden=768, layers=12, heads=12,
     run_engine(eng)
     run_static()
     static_tps, static_ttft, _ = run_static()
-    engine_tps, engine_ttft, engine_wall = run_engine(eng)
+    # timed pass runs with the flight recorder watching (ISSUE 13):
+    # sampling is host-only at the existing chunk sync, so it is free
+    # at bench fidelity — and a healthy bench run must raise ZERO watch
+    # alerts, which the committed bench line records
+    from paddle_tpu.framework import guardian as _guardian
+    from paddle_tpu.observability import flight as _flight
+    _alerts0 = len(_guardian.events("watch_alert"))
+    # dump_dir=False: alerts-only, so a rule trip can never start disk
+    # I/O inside the timed region even when PADDLE_FLIGHT_DIR is set;
+    # and never stomp a recorder the user installed via PADDLE_FLIGHT=1
+    _owned = not _flight.active()
+    _rec = _flight.enable(dump_dir=False) if _owned \
+        else _flight.recorder()
+    try:
+        engine_tps, engine_ttft, engine_wall = run_engine(eng)
+        watch_alerts = len(_guardian.events("watch_alert")) - _alerts0
+        flight_samples = len(_rec.samples())
+    finally:
+        if _owned:
+            _flight.disable()
 
     lat_ms = _dispatch_latency_ms()
     n_dispatch = eng.stats["chunks"] + eng.stats["prefills"]
@@ -1084,6 +1103,8 @@ def bench_serving(n_requests=64, seed=0, hidden=768, layers=12, heads=12,
            "requests": n_requests, "slots": GROUP, "chunk": chunk,
            "chunks": eng.stats["chunks"],
            "prefills": eng.stats["prefills"],
+           "flight_samples": flight_samples,
+           "watch_alerts": watch_alerts,
            "dispatch_latency_ms": lat_ms,
            "latency_share_of_engine_wall": (round(lat_share, 4)
                                             if lat_share is not None

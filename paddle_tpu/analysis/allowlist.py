@@ -48,6 +48,14 @@ MONITORED_MODULES = (
     # already owned)
     "paddle_tpu/observability/compilestats.py",
     "paddle_tpu/observability/tracing.py",
+    # flight recorder + watchdog + doctor (ISSUE 13): samples are host
+    # dicts recorded at pre-existing sync points, rule evaluation reads
+    # only those host values, and doctor parses files — a device
+    # readback in any of them is always a bug, so all three are
+    # monitored with ZERO allowlist entries
+    "paddle_tpu/observability/flight.py",
+    "paddle_tpu/observability/watch.py",
+    "paddle_tpu/observability/doctor.py",
 )
 
 # Call terminals that force (or mark) a device->host sync.
@@ -287,6 +295,9 @@ CONCURRENCY_MODULES = (
     "paddle_tpu/distributed/checkpoint/__init__.py",
     "paddle_tpu/distributed/fleet/elastic/__init__.py",
     "paddle_tpu/observability/metrics.py",
+    # flight recorder: hot threads record() while the daemon dump
+    # worker drains forensic-bundle jobs
+    "paddle_tpu/observability/flight.py",
 )
 
 # Classes (or "<module>" namespaces) whose public API is a declared
@@ -330,6 +341,16 @@ CONCURRENT_CLASSES = {
         {"entries": "*", "reason": "see _Metric"},
     ("paddle_tpu/observability/metrics.py", "MetricsRegistry"):
         {"entries": "*", "reason": "registration races recording"},
+    # the flight recorder records from every hot thread (fit loop,
+    # replica workers, the router loop) while its daemon dump worker
+    # writes bundles; window/jobs/dump bookkeeping live behind
+    # self._lock
+    ("paddle_tpu/observability/flight.py", "FlightRecorder"):
+        {"entries": ["record"],
+         "reason": "record() is the declared cross-thread entry "
+                   "(every sync point on every hot thread); the dump "
+                   "worker shares the window/job state behind "
+                   "self._lock"},
 }
 
 # (relpath, "Owner.attr" | "<module>.name") -> reason the unguarded

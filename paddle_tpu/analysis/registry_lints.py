@@ -37,6 +37,14 @@ _METRIC_RE = re.compile(r"\b(pt_[a-z0-9]+_[a-z0-9_]+)\b")
 _METRIC_ROW_RE = re.compile(
     r"^\|\s*`(pt_[a-z0-9_]+)`\s*\|\s*`([a-z]+)`\s*\|\s*`([^`]*)`", re.M)
 
+# the watch-rule table (ISSUE 13): rows | `rule` | `signal` |
+# `trips_when` | meaning |, scoped to the doc's "Watch rules" section
+# so the metric table's rows (same pipe shape) never collide
+_WATCH_SECTION_RE = re.compile(r"^##[^\n]*watch rules[^\n]*$",
+                               re.I | re.M)
+_WATCH_ROW_RE = re.compile(
+    r"^\|\s*`([a-z_]+)`\s*\|\s*`([^`]*)`\s*\|\s*`([^`]*)`", re.M)
+
 OBSERVABILITY_DOC = "docs/observability.md"
 
 
@@ -211,6 +219,7 @@ class MetricNamesPass:
             for p in ctx.ref_files)
         if in_scope:
             findings.extend(self._check_doc_table(doc, metrics))
+            findings.extend(self._check_watch_table(doc))
         return sorted(findings, key=Finding.sort_key)
 
     def _check_doc_table(self, doc, metrics):
@@ -248,5 +257,55 @@ class MetricNamesPass:
                     self.name, OBSERVABILITY_DOC, 1, "<doc>",
                     "catalog-drift",
                     f"metric {name!r} is in the catalog but "
+                    "undocumented", name))
+        return findings
+
+    def _check_watch_table(self, doc):
+        """The WatchRule catalog (observability/watch.py) must be
+        mirrored row-for-row — name, signal, trips_when — by the doc's
+        'Watch rules' section table (the metric/event-table discipline
+        applied to alert rules: dashboards route on rule names)."""
+        if not os.path.exists(doc):
+            return []            # the catalog check already reported it
+        from ..observability.watch import WATCH_RULES
+        text = _read(doc)
+        findings = []
+        m = _WATCH_SECTION_RE.search(text)
+        if m is None:
+            return [Finding(
+                self.name, OBSERVABILITY_DOC, 1, "<doc>",
+                "watch-rule-drift",
+                "docs/observability.md has no 'Watch rules' section — "
+                "the WatchRule catalog must be documented "
+                "(observability/watch.py WATCH_RULES)", "missing-table")]
+        start = m.end()
+        nxt = text.find("\n## ", start)
+        section = text[start:nxt if nxt != -1 else len(text)]
+        offset = text.count("\n", 0, start)
+        table = {}
+        for row in _WATCH_ROW_RE.finditer(section):
+            line = offset + section.count("\n", 0, row.start()) + 1
+            table[row.group(1)] = ((row.group(2), row.group(3)), line)
+        for name, ((signal, trips), line) in sorted(table.items()):
+            spec = WATCH_RULES.get(name)
+            if spec is None:
+                findings.append(Finding(
+                    self.name, OBSERVABILITY_DOC, line, "<doc>",
+                    "watch-rule-drift",
+                    f"documents unknown watch rule {name!r}", name))
+            elif (signal, trips) != (spec["signal"],
+                                     spec["trips_when"]):
+                findings.append(Finding(
+                    self.name, OBSERVABILITY_DOC, line, "<doc>",
+                    "watch-rule-drift",
+                    f"watch rule {name!r} signal/trips_when drifted "
+                    "from the WATCH_RULES catalog "
+                    "(observability/watch.py)", name))
+        for name in sorted(WATCH_RULES):
+            if name not in table:
+                findings.append(Finding(
+                    self.name, OBSERVABILITY_DOC, 1, "<doc>",
+                    "watch-rule-drift",
+                    f"watch rule {name!r} is in the catalog but "
                     "undocumented", name))
         return findings

@@ -147,6 +147,15 @@ EVENT_SCHEMA = {
     "router_stats": {"requests", "finished", "shed", "requeued",
                      "replica_deaths", "affinity_routes",
                      "least_loaded_routes", "tokens_per_sec"},
+    # SLO watchdog (observability/watch.py via flight.py): a declared
+    # WatchRule tripped over the flight recorder's rolling window —
+    # value/threshold are the rule's measured quantity and its limit,
+    # point names the sync point whose sample tripped it
+    "watch_alert": {"rule", "value", "threshold", "detail", "point"},
+    # flight recorder (observability/flight.py): a forensic bundle was
+    # written (atomic tmp+rename; kept = bundles surviving the
+    # keep-last-K retention sweep)
+    "flight_dump": {"trigger", "path", "alerts", "kept"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

@@ -804,20 +804,38 @@ class Model:
                     verdict = guard.after_step(loss_v, ok_flag=ok,
                                                batch=(ins, labs))
                 step_s = time.perf_counter() - t_step
+                # one token count feeds both the metrics below and the
+                # flight sample — counted once so they can never drift
+                tokens = None
+                if ins and hasattr(ins[0], "shape"):
+                    tokens = 1
+                    for d in ins[0].shape:
+                        tokens *= int(d)
                 if _obs.enabled():
                     _obs.observe("pt_train_step_latency_ms", step_s * 1e3)
                     _obs.inc("pt_train_steps_total",
                              outcome=verdict or "ok")
-                    if ins and hasattr(ins[0], "shape"):
-                        tokens = 1
-                        for d in ins[0].shape:
-                            tokens *= int(d)
+                    if tokens is not None:
                         _obs.inc("pt_train_tokens_total", tokens)
                         _obs.set_gauge("pt_train_tokens_per_sec",
                                        tokens / max(step_s, 1e-9))
                 logs = self._make_logs(res)
                 if _obs.enabled() and logs.get("loss") is not None:
                     _obs.set_gauge("pt_train_loss", float(logs["loss"]))
+                # flight recorder (observability/flight.py): one sample
+                # per step at THIS existing sync point — every value is
+                # a host number the loop already owns (wall delta,
+                # static shapes, the loss readback train_batch already
+                # paid), so the zero-new-host-sync A/B contract holds
+                if _obs.flight.active():
+                    tok_s = None if tokens is None \
+                        else tokens / max(step_s, 1e-9)
+                    _obs.flight.record(
+                        "fit_step", step_latency_ms=step_s * 1e3,
+                        tokens_per_sec=tok_s,
+                        loss=(float(logs["loss"])
+                              if logs.get("loss") is not None else None),
+                        verdict=verdict or "ok")
                 logs["step"] = step
                 logs["batch_size"] = (
                     ins[0].shape[0] if ins and hasattr(ins[0], "shape")

@@ -197,16 +197,19 @@ class ServingFleet:
             else float(service_ms_prior)
         self._last_scale_hint = 0
         self._threads_running = False
+        self._last_flight_ns = 0     # router-gap sample throttle
         self.stats = None
         self._init_stats()
-        self._replicas = [_Replica(i, self._make_engine())
+        self._replicas = [_Replica(i, self._make_engine(i))
                           for i in range(num_replicas)]
         if replica_queue_limit is None:
             replica_queue_limit = self._replicas[0].engine.num_slots
         self.replica_queue_limit = int(replica_queue_limit)
 
-    def _make_engine(self):
-        return ServingEngine(self.model, **self._engine_kwargs)
+    def _make_engine(self, idx=None):
+        eng = ServingEngine(self.model, **self._engine_kwargs)
+        eng.replica_label = idx      # flight-sample identity
+        return eng
 
     def _init_stats(self):
         with self._lock:
@@ -400,7 +403,8 @@ class ServingFleet:
     def add_replica(self):
         """Scale-up hook: build one more engine replica (same config)
         and make it routable immediately.  Returns its index."""
-        rep = _Replica(len(self._replicas), self._make_engine())
+        rep = _Replica(len(self._replicas),
+                       self._make_engine(len(self._replicas)))
         with self._lock:
             self._replicas.append(rep)
         if self._threads_running:
@@ -654,6 +658,26 @@ class ServingFleet:
                 _obs.set_gauge("pt_router_replica_active",
                                len(rep.engine.scheduler.active),
                                replica=str(rep.idx))
+        # flight recorder: one replica-labeled sample per dispatch gap
+        # (throttled while idle — the loop spins sub-ms), all host
+        # stamps/counters the router already owns
+        if _obs.flight.active():
+            n2 = time.perf_counter_ns()
+            if routed or sheds or n2 - self._last_flight_ns > 50e6:
+                self._last_flight_ns = n2
+                up = [r for r in self._replicas if r.state == _UP]
+                with self._lock:
+                    snap = dict(self.stats)
+                _obs.flight.record(
+                    "router_gap", queue_depth=depth,
+                    requests=snap["requests"], shed=snap["shed"],
+                    requeued=snap["requeued"],
+                    replica_deaths=snap["replica_deaths"],
+                    stale_replicas=sum(1 for r in up if r.stale),
+                    max_beat_age_s=round(
+                        max(((n2 - r.beat_ns) / 1e9 for r in up),
+                            default=0.0), 3)
+                    if self._threads_running else 0.0)
         return len(routed) + len(sheds)
 
     def _route_span_start(self, req):

@@ -193,6 +193,11 @@ class ServingEngine:
                 f"max_position_embeddings {limit}")
         self.num_slots = int(num_slots)
         self.chunk = int(chunk)
+        # fleet identity (inference/router.py sets this to the replica
+        # index): rides the flight recorder's serving_sync samples so
+        # the watchdog can keep per-replica throughput/queue windows
+        # instead of interleaving concurrent engines into one stream
+        self.replica_label = None
         self.eos = None if eos_token_id is None else int(eos_token_id)
         self.pad = int(pad_token_id)
         if prefill_buckets is None:
@@ -888,6 +893,7 @@ class ServingEngine:
                  toks, valid, self._active))
         first, toks_h, valid_h, active_h = bundle
         now = time.perf_counter_ns()
+        new_ttfts = []       # stamped THIS sync (flight-recorder sample)
         # per-slot emissions this cycle, in chronological order:
         # the prefill's first token, then the chunk's tokens
         emitted = {}
@@ -898,6 +904,7 @@ class ServingEngine:
                 req.first_token_ns = now
                 self.stats["ttft_ms"].append(req.ttft_ms)
                 _obs.observe("pt_serving_ttft_ms", req.ttft_ms)
+                new_ttfts.append(round(req.ttft_ms, 3))
             emitted[slot] = [int(t0)]
             if fin0:
                 req.finish_reason = "eos" if (
@@ -988,8 +995,15 @@ class ServingEngine:
                                   start, now,
                                   tokens=len(toks_slot),
                                   reason=req.finish_reason, **rep)
-                    req.decode_ms += (now - start) / 1e6
-                req.span_ns = now
+            # decode_ms (the TPOT numerator) and the span cursor are
+            # host stamps the flight recorder reads too, so they
+            # accumulate whether or not the metrics gate is on — a
+            # flight sample must never report tpot=0 just because
+            # telemetry was disabled
+            if slot not in admitted_slots:
+                req.decode_ms += \
+                    (now - (req.span_ns or req.admit_ns)) / 1e6
+            req.span_ns = now
             if req.callback is not None:
                 for i, tok in enumerate(toks_slot):
                     req.callback(req, tok,
@@ -1018,4 +1032,25 @@ class ServingEngine:
         if finished and _obs.enabled():
             _obs.set_gauge("pt_serving_slot_occupancy",
                            len(self.scheduler.active))
+        # flight recorder: one sample per chunk-boundary sync plus one
+        # per finish — all values are host numbers this sync already
+        # produced (the bundled device_get above is the ONLY transfer)
+        if _obs.flight.active():
+            _obs.flight.record(
+                "serving_sync",
+                decoded_tokens=sum(len(t) for t in emitted.values()),
+                queue_depth=self.scheduler.queue_depth,
+                active=len(self.scheduler.active),
+                finished=len(finished), ttft_ms=new_ttfts,
+                replica=self.replica_label)
+            for req in finished:
+                _obs.flight.record(
+                    "request",
+                    ttft_ms=(round(req.ttft_ms, 3)
+                             if req.first_token_ns else None),
+                    tpot_ms=(round(req.decode_ms /
+                                   (len(req.tokens) - 1), 3)
+                             if len(req.tokens) > 1 else None),
+                    replica=req.replica, reason=req.finish_reason,
+                    tokens=len(req.tokens))
         return finished

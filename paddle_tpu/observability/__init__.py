@@ -53,13 +53,18 @@ from .catalog import METRICS    # noqa: F401
 # (stdlib + the metrics registry; jax is touched lazily on use)
 from . import compilestats     # noqa: F401
 from . import tracing          # noqa: F401
+# flight recorder + SLO watchdog (ISSUE 13): rolling windows recorded
+# at existing sync points, anomaly-triggered forensic bundles; the
+# `doctor` CLI (doctor.py) loads lazily like report.py
+from . import flight           # noqa: F401
+from . import watch            # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "inc", "observe", "set_gauge", "enabled", "enable", "disabled",
     "start_capture", "stop_capture", "capture_active", "samples",
     "clock_pair", "DEFAULT_BUCKETS", "METRICS", "main",
-    "compilestats", "tracing",
+    "compilestats", "tracing", "flight", "watch",
 ]
 
 

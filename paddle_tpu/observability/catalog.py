@@ -170,6 +170,28 @@ METRICS = {
         "type": _C, "labels": (),
         "help": "prompt tokens NOT re-prefilled thanks to prefix-cache "
                 "hits (prefill FLOPs saved is proportional)"},
+    # -- flight recorder + SLO watchdog (observability/flight.py,
+    #    observability/watch.py) -------------------------------------------
+    "pt_watch_evals_total": {
+        "type": _C, "labels": (),
+        "help": "watch-rule evaluation sweeps (one per recorded flight "
+                "sample; zero device cost by construction)"},
+    "pt_watch_alerts_total": {
+        "type": _C, "labels": ("rule",),
+        "help": "watchdog rule trips by rule name — each one also "
+                "emitted a guardian watch_alert event"},
+    "pt_flight_samples": {
+        "type": _G, "labels": (),
+        "help": "flight-recorder rolling-window occupancy after the "
+                "latest sample (bounded by the window size)"},
+    "pt_flight_dumps_total": {
+        "type": _C, "labels": (),
+        "help": "forensic bundles written to PADDLE_FLIGHT_DIR "
+                "(atomic tmp+rename, keep-last-K retention)"},
+    "pt_flight_dump_ms": {
+        "type": _H, "labels": (),
+        "help": "wall time of one forensic bundle dump (runs on the "
+                "dump thread, off the hot path)"},
     # -- compile telemetry (observability/compilestats.py) ----------------
     "pt_compile_compiles_total": {
         "type": _C, "labels": ("surface",),

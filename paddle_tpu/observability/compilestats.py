@@ -46,7 +46,7 @@ import time
 from . import metrics as _metrics
 
 __all__ = ["wrap", "CompiledSurface", "signature", "signature_diff",
-           "snapshot", "reset", "surfaces"]
+           "snapshot", "reset", "surfaces", "retrace_total"]
 
 
 # -- shape signatures -------------------------------------------------------
@@ -167,6 +167,14 @@ def snapshot():
                 "memory_bytes": last.get("memory_bytes"),
             }
     return out
+
+
+def retrace_total():
+    """Cumulative over-budget recompiles across all surfaces — one
+    lock, one sum, no dict building (the SLO watchdog polls this per
+    flight sample, so it must stay cheap)."""
+    with _LOCK:
+        return sum(st["retraces"] for st in _SURFACES.values())
 
 
 def reset():

@@ -19,6 +19,7 @@ budgets, instead of ad-hoc readbacks).
 """
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -29,6 +30,12 @@ __all__ = ["prometheus_text", "write_prometheus", "snapshot",
            "write_jsonl", "JSONL_ENV"]
 
 JSONL_ENV = "PADDLE_METRICS_LOG"
+
+# serializes same-process writers: two threads replace_run-rewriting
+# one file would otherwise race read-rewrite-replace and silently drop
+# each other's freshly appended run (cross-process writers remain the
+# caller's problem — see the write_jsonl docstring)
+_WRITE_LOCK = threading.Lock()
 
 
 def _materialize(v):
@@ -141,10 +148,12 @@ def write_jsonl(path=None, registry=None, run=None, replace_run=False):
     snapshot per invocation (the PR 7–8 duplicate-commit churn).
 
     Use ``replace_run`` only on files this process owns (bench's
-    per-tag snapshots): the read-rewrite-replace cycle races a
-    concurrent appender, and after the replace a live writer's open
-    fd still points at the unlinked old inode — a long-lived
-    ``PADDLE_METRICS_LOG`` sink must stick to the append path.
+    per-tag snapshots): same-process writers are serialized by a module
+    lock (concurrent threads each land their own run intact), but the
+    read-rewrite-replace cycle still races a *foreign-process* appender
+    — and after the replace a live writer's open fd points at the
+    unlinked old inode — so a long-lived ``PADDLE_METRICS_LOG`` sink
+    shared across processes must stick to the append path.
     """
     path = path or os.environ.get(JSONL_ENV)
     if not path:
@@ -153,29 +162,30 @@ def write_jsonl(path=None, registry=None, run=None, replace_run=False):
     if d:
         os.makedirs(d, exist_ok=True)
     recs = snapshot(registry, run=run)
-    if replace_run and run is not None and os.path.exists(path):
-        kept = []
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                if not line.strip():
-                    continue
-                try:
-                    rec = json.loads(line)
-                    if isinstance(rec, dict) and \
-                            rec.get("run") == str(run):
+    with _WRITE_LOCK:
+        if replace_run and run is not None and os.path.exists(path):
+            kept = []
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    if not line.strip():
                         continue
-                except ValueError:
-                    pass        # torn tail: keep, never destroy data
-                kept.append(line.rstrip("\n"))
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            for line in kept:
-                f.write(line + "\n")
+                    try:
+                        rec = json.loads(line)
+                        if isinstance(rec, dict) and \
+                                rec.get("run") == str(run):
+                            continue
+                    except ValueError:
+                        pass    # torn tail: keep, never destroy data
+                    kept.append(line.rstrip("\n"))
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for line in kept:
+                    f.write(line + "\n")
+                for rec in recs:
+                    f.write(json.dumps(rec) + "\n")
+            os.replace(tmp, path)
+            return path
+        with open(path, "a", encoding="utf-8") as f:
             for rec in recs:
                 f.write(json.dumps(rec) + "\n")
-        os.replace(tmp, path)
-        return path
-    with open(path, "a", encoding="utf-8") as f:
-        for rec in recs:
-            f.write(json.dumps(rec) + "\n")
     return path

@@ -29,6 +29,8 @@ live registry state.
 """
 import argparse
 import json
+import math
+import os
 import sys
 
 __all__ = ["parse_prometheus", "parse_jsonl", "render_report",
@@ -106,7 +108,11 @@ def parse_prometheus(path):
                 continue
             if line.startswith("#"):
                 continue
-            name, labels, value = _split_sample(line)
+            try:
+                name, labels, value = _split_sample(line)
+            except ValueError:
+                continue     # torn tail / foreign line: never let one
+                #              bad sample hide the rest of the file
             name, suffix = base(name)
             m = metrics.setdefault(name, {"type": "", "help": "",
                                           "series": {}, "buckets": {}})
@@ -288,7 +294,20 @@ def roofline_from_stats(stats, measured_ms=None, peak_flops=None,
         row["roofline_ms"] = round(roof, 6) if roof else None
         row["bound"] = None if roof is None else (
             "compute" if (t_c or 0.0) >= (t_m or 0.0) else "memory")
+        # measured-latency guard (ISSUE 13 satellite): a zero or
+        # non-finite measured pt_compile_dispatch_ms (torn sink, NaN
+        # exposition sample, count-without-sum) must never surface as
+        # a NaN/inf MFU row — such surfaces render n/a with a reason
         meas = measured_ms.get(surface)
+        reason = None
+        if meas is None:
+            reason = "no-measured-latency"
+        elif not math.isfinite(meas):
+            reason = "nonfinite-measured-latency"
+            meas = None
+        elif meas <= 0:
+            reason = "zero-measured-latency"
+            meas = None
         row["measured_ms"] = round(meas, 3) if meas else None
         if meas and roof:
             bound_c = row["bound"] == "compute"
@@ -301,9 +320,13 @@ def roofline_from_stats(stats, measured_ms=None, peak_flops=None,
                 "dispatch_other_frac": round(1.0 - roof_frac, 4)}
             row["mfu"] = round(flops / (meas * 1e-3) / peak_flops, 4) \
                 if flops else None
+            row["attribution_reason"] = None
         else:
+            if meas and not roof:
+                reason = "no-analytical-cost"
             row["attribution"] = None
             row["mfu"] = None
+            row["attribution_reason"] = reason
         rows.append(row)
     return {"peak_flops": peak_flops, "hbm_bw_bytes_per_s": hbm_bw,
             "wire_bytes_per_step": wire_bytes, "rows": rows}
@@ -409,9 +432,13 @@ def render_roofline(table):
     lines.append(hdr)
     for r in table["rows"]:
         att = r["attribution"]
-        att_s = "-" if not att else (
-            f"{att['compute_frac']:.0%}/{att['memory_frac']:.0%}/"
-            f"{att['dispatch_other_frac']:.0%}")
+        if att:
+            att_s = (f"{att['compute_frac']:.0%}/"
+                     f"{att['memory_frac']:.0%}/"
+                     f"{att['dispatch_other_frac']:.0%}")
+        else:
+            reason = r.get("attribution_reason")
+            att_s = f"n/a ({reason})" if reason else "-"
         mfu_s = f"{r['mfu']:.3f}" if r["mfu"] is not None else "-"
         lines.append(
             f"{r['surface']:<28} {_fmt_num(r['flops']):>8} "
@@ -542,12 +569,42 @@ def render_requests(summary, rows):
     return "\n".join(lines)
 
 
+def _sink_note(path, what):
+    """One-line no-data reason for a subview's sink, or None when the
+    file at least exists and is non-empty (ISSUE 13 satellite: a
+    missing or torn telemetry file must never traceback a report)."""
+    if path is None:
+        return f"no {what} file given"
+    if not os.path.exists(path):
+        return f"missing file {path}"
+    try:
+        if os.path.getsize(path) == 0:
+            return f"empty file {path}"
+    except OSError as e:
+        return f"unreadable file {path} ({e})"
+    return None
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability",
         description="Telemetry tooling for the unified metrics "
                     "registry (see docs/observability.md).")
     sub = ap.add_subparsers(dest="cmd")
+    dp = sub.add_parser("doctor",
+                        help="ranked probable-cause diagnosis from a "
+                             "flight-recorder bundle or loose sinks")
+    dp.add_argument("bundle", nargs="?", default=None,
+                    help="forensic bundle directory written by the "
+                         "flight recorder (PADDLE_FLIGHT_DIR)")
+    dp.add_argument("--prom", default=None,
+                    help="Prometheus text exposition file")
+    dp.add_argument("--jsonl", default=None,
+                    help="JSONL metrics log")
+    dp.add_argument("--trace", default=None,
+                    help="merged chrome-trace JSON")
+    dp.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the diagnosis as JSON")
     rp = sub.add_parser("report",
                         help="summarize telemetry sinks into one "
                              "run report")
@@ -570,6 +627,9 @@ def main(argv=None):
     rp.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the subview as JSON (with --roofline / "
                          "--requests)")
+    rp.add_argument("--doctor", action="store_true", dest="doctor",
+                    help="append the doctor's ranked probable-cause "
+                         "diagnosis built from the same sinks")
     rp.add_argument("--peak-flops", type=float,
                     default=DEFAULT_PEAK_FLOPS,
                     help="compute roof (FLOP/s) for --roofline "
@@ -578,6 +638,9 @@ def main(argv=None):
                     help="memory roof (bytes/s) for --roofline "
                          "(default: TPU v5e HBM)")
     args = ap.parse_args(argv)
+    if args.cmd == "doctor":
+        from . import doctor as _doctor
+        return _doctor.run_cli(args)
     if args.cmd != "report":
         ap.print_help()
         return 2
@@ -596,33 +659,78 @@ def main(argv=None):
         return 2
     try:
         if args.roofline or args.requests:
+            # no-data discipline (ISSUE 13 satellite): a missing,
+            # empty, or torn telemetry file prints ONE line and exits
+            # 0 (`--json` emits {}) — a cron job or CI smoke over a
+            # quiet run must not die on a traceback
             out = {}
+            no_data = []
             if args.roofline:
-                table = roofline_view(args.prom, args.peak_flops,
-                                      args.hbm_bw)
-                if args.as_json:
+                note = _sink_note(args.prom, "prom")
+                table = None
+                if note is None:
+                    table = roofline_view(args.prom, args.peak_flops,
+                                          args.hbm_bw)
+                    if not table["rows"]:
+                        note = f"no pt_compile_* series in {args.prom}"
+                        table = None
+                if table is None:
+                    no_data.append(f"no data: roofline — {note}")
+                elif args.as_json:
                     out["roofline"] = table
                 else:
                     print(render_roofline(table))
             if args.requests:
-                rows = request_rows_from_trace(args.trace)
-                summary = requests_view(rows)
-                if args.as_json:
-                    out["requests"] = {"summary": summary,
-                                       "per_request": rows}
-                else:
-                    print(render_requests(summary, rows))
-                if args.per_replica:
-                    views = per_replica_views(rows)
-                    if args.as_json:
-                        out["per_replica"] = views
+                note = _sink_note(args.trace, "trace")
+                rows = None
+                if note is None:
+                    try:
+                        rows = request_rows_from_trace(args.trace)
+                    except ValueError as e:
+                        note = f"unparseable trace {args.trace} " \
+                               f"(torn write? {e})"
                     else:
-                        print(render_per_replica(views))
+                        if not rows:
+                            note = f"no request lanes in {args.trace}"
+                            rows = None
+                if rows is None:
+                    no_data.append(f"no data: requests — {note}")
+                else:
+                    summary = requests_view(rows)
+                    if args.as_json:
+                        out["requests"] = {"summary": summary,
+                                           "per_request": rows}
+                    else:
+                        print(render_requests(summary, rows))
+                    if args.per_replica:
+                        views = per_replica_views(rows)
+                        if args.as_json:
+                            out["per_replica"] = views
+                        else:
+                            print(render_per_replica(views))
+            if args.doctor:
+                from . import doctor as _doctor
+                result = _doctor.diagnose(_doctor.evidence_from_sinks(
+                    prom=args.prom, jsonl=args.jsonl,
+                    trace=args.trace))
+                if args.as_json:
+                    out["doctor"] = result
+                else:
+                    print(_doctor.render(result))
             if args.as_json:
-                print(json.dumps(out, indent=1, sort_keys=True))
+                print(json.dumps(out, indent=1, sort_keys=True)
+                      if out else "{}")
+            else:
+                for line in no_data:
+                    print(line)
             return 0
         print(render_report(prom=args.prom, jsonl=args.jsonl,
                             trace=args.trace))
+        if args.doctor:
+            from . import doctor as _doctor
+            result = _doctor.diagnose(_doctor.evidence_from_sinks(
+                prom=args.prom, jsonl=args.jsonl, trace=args.trace))
+            print(_doctor.render(result))
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

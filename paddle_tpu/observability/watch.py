@@ -1,0 +1,366 @@
+"""SLO watchdog: the declarative rule catalog and the engine that
+evaluates it over flight-recorder samples.
+
+The observability layer so far is *passive*: metrics, traces and
+guardian events exist, but nothing watches them — an SLO burn, a
+retrace storm or a throughput collapse is only discovered when a human
+runs ``report`` after the fact.  This module is the active half of the
+flight recorder (``flight.py``): every sample the recorder takes at an
+existing sync point is pushed through :class:`WatchEngine`, a small
+stateful rules engine over :data:`WATCH_RULES`.
+
+The catalog follows the metrics-catalog discipline: every rule is
+declared once HERE with its signal and trip condition, and the table in
+``docs/observability.md`` mirrors it **row-for-row** (checked by the
+``metrics-registry`` lint pass, exactly like the metric and guardian
+event tables).  A renamed rule must fail lint, not silently stop a
+dashboard's alert routing.
+
+Zero-sync contract: the engine only ever reads the host values already
+inside the sample (plus the compile-telemetry registry's host-side
+retrace counters) — evaluation never touches the device, and the module
+sits in ``analysis.allowlist.MONITORED_MODULES`` with zero budgeted
+sync entries.  A trip is *reported* by the recorder (guardian
+``watch_alert`` event + ``pt_watch_alerts_total`` + a forensic bundle);
+this module only decides.
+"""
+import collections
+import time
+
+__all__ = ["WATCH_RULES", "WatchConfig", "WatchEngine"]
+
+# The rule catalog.  ``signal`` names what is measured, ``trips_when``
+# the condition (knob names refer to WatchConfig fields); both strings
+# are mirrored verbatim by the docs/observability.md watch-rule table
+# (lint-checked row-for-row).
+WATCH_RULES = {
+    "slo_burn": {
+        "signal": "p99 of the rolling ttft_ms window; shed fraction "
+                  "of submitted requests",
+        "trips_when": "p99 ttft > slo_ttft_ms over >= min_ttft_samples "
+                      "samples, or shed/requests >= shed_rate with >= "
+                      "min_requests requests",
+        "help": "the serving tier is burning its TTFT SLO: tail "
+                "latency blew the target, or admission control is "
+                "already shedding a meaningful share of traffic"},
+    "throughput_collapse": {
+        "signal": "fast vs trailing EWMA of tokens/sec (fit steps and "
+                  "serving syncs)",
+        "trips_when": "fast EWMA < tput_drop x trailing EWMA after "
+                      "tput_warmup samples",
+        "help": "sustained throughput fell off a cliff relative to "
+                "the run's own trailing baseline — retrace storm, "
+                "input stall or straggler, whatever the cause the "
+                "bundle holds the evidence"},
+    "retrace_storm": {
+        "signal": "sum of per-surface retraces from the "
+                  "compile-telemetry registry",
+        "trips_when": "retraces grew by >= retrace_limit since the "
+                      "last trip baseline",
+        "help": "hot jit surfaces are recompiling past their declared "
+                "budgets (the silent-recompile perf bug class the "
+                "compile_retrace sentinel flags per event)"},
+    "queue_runaway": {
+        "signal": "queue depth at serving syncs and router dispatch "
+                  "gaps (tracked per sync point and replica)",
+        "trips_when": "one stream's depth >= queue_limit and "
+                      "non-decreasing across its last queue_window "
+                      "samples",
+        "help": "arrival rate has outrun service rate long enough "
+                "that the backlog only grows — the overload regime "
+                "the SLO admission control exists for"},
+    "straggler_replica": {
+        "signal": "per-replica heartbeat age and per-replica mean "
+                  "tpot_ms from finished requests",
+        "trips_when": "a replica is quarantined stale (stale_replicas "
+                      "> 0), or its mean tpot > straggler_skew x the "
+                      "median of the other replicas over >= "
+                      "straggler_min_requests requests each",
+        "help": "one replica is serving markedly slower than its "
+                "peers (sick host, hot affinity home) or stopped "
+                "heartbeating while its thread lives"},
+    "guardian_escalation": {
+        "signal": "guardian ladder verdicts at fit steps; replica "
+                  "death counters at router gaps",
+        "trips_when": "a fit step ends in rollback, or replica_deaths "
+                      "grew since the previous router gap",
+        "help": "the fault-tolerance machinery actually fired — a "
+                "numeric rollback or a replica death deserves a "
+                "forensic bundle even when throughput recovers"},
+}
+
+
+class WatchConfig:
+    """Thresholds for the rule catalog.  ``rules`` restricts evaluation
+    to a subset of :data:`WATCH_RULES` names (None = all); every other
+    knob is named from the rule table's ``trips_when`` column."""
+
+    def __init__(self, rules=None, slo_ttft_ms=None, min_ttft_samples=8,
+                 shed_rate=0.5, min_requests=8, tput_drop=0.4,
+                 tput_warmup=12, fast_alpha=0.5, slow_alpha=0.05,
+                 retrace_limit=3, queue_limit=64, queue_window=6,
+                 straggler_skew=3.0, straggler_min_requests=4,
+                 cooldown_s=30.0):
+        if rules is not None:
+            unknown = set(rules) - set(WATCH_RULES)
+            if unknown:
+                raise ValueError(
+                    f"unknown watch rules {sorted(unknown)} "
+                    f"(known: {sorted(WATCH_RULES)})")
+        self.rules = tuple(rules) if rules is not None \
+            else tuple(sorted(WATCH_RULES))
+        self.slo_ttft_ms = None if slo_ttft_ms is None \
+            else float(slo_ttft_ms)
+        self.min_ttft_samples = int(min_ttft_samples)
+        self.shed_rate = float(shed_rate)
+        self.min_requests = int(min_requests)
+        self.tput_drop = float(tput_drop)
+        self.tput_warmup = int(tput_warmup)
+        self.fast_alpha = float(fast_alpha)
+        self.slow_alpha = float(slow_alpha)
+        self.retrace_limit = int(retrace_limit)
+        self.queue_limit = int(queue_limit)
+        self.queue_window = int(queue_window)
+        self.straggler_skew = float(straggler_skew)
+        self.straggler_min_requests = int(straggler_min_requests)
+        self.cooldown_s = float(cooldown_s)
+
+    def summary(self):
+        """JSON-ready knob dict (stamped into bundle meta.json)."""
+        return {k: (list(v) if isinstance(v, tuple) else v)
+                for k, v in sorted(vars(self).items())}
+
+
+def _p99(sorted_vals):
+    if not sorted_vals:
+        return None
+    pos = 0.99 * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * \
+        (pos - lo)
+
+
+class WatchEngine:
+    """Stateful evaluation of :data:`WATCH_RULES` over one run's flight
+    samples.  ``evaluate(sample)`` returns the alerts that tripped on
+    this sample (post per-rule cooldown); all state is host-side and
+    O(window).  NOT thread-safe by itself — the flight recorder
+    serializes calls under its own lock."""
+
+    def __init__(self, config=None):
+        self.config = config or WatchConfig()
+        self.evals = 0
+        self._ttft = collections.deque(maxlen=256)
+        self._fast = None               # throughput EWMAs
+        self._slow = None
+        self._tput_n = 0
+        # one depth window PER stream (sync point + replica):
+        # interleaving the fleet queue's depth — or a concurrent
+        # replica engine's — into a single window would defeat the
+        # monotonic-growth check in exactly the fleet-overload case
+        # this rule targets
+        self._queue = {}                # stream -> deque of depths
+        self._tpot = {}                 # replica -> deque of tpot_ms
+        self._retrace_base = None
+        self._deaths_seen = 0
+        self._last_serving = {}         # stream -> last sample ts_ns
+        self._last_trip = {}            # rule -> perf_counter stamp
+
+    # -- helpers -----------------------------------------------------------
+    def _enabled(self, rule):
+        return rule in self.config.rules
+
+    def _alert(self, out, sample, rule, value, threshold, detail):
+        now = time.perf_counter()
+        last = self._last_trip.get(rule)
+        if last is not None and now - last < self.config.cooldown_s:
+            return
+        self._last_trip[rule] = now
+        out.append({"rule": rule, "value": round(float(value), 4),
+                    "threshold": round(float(threshold), 4),
+                    "detail": str(detail),
+                    "point": str(sample.get("point"))})
+
+    def _retrace_total(self):
+        # host-side registry total; lazy import keeps this module
+        # stdlib-only at import time, and retrace_total() is one
+        # lock+sum — cheap enough to poll per sample
+        from . import compilestats
+        return compilestats.retrace_total()
+
+    # -- rule bodies -------------------------------------------------------
+    def _throughput(self, out, sample, tok_s):
+        cfg = self.config
+        if tok_s is None or tok_s <= 0:
+            return
+        if self._fast is None:
+            self._fast = self._slow = float(tok_s)
+        else:
+            self._fast += cfg.fast_alpha * (tok_s - self._fast)
+            self._slow += cfg.slow_alpha * (tok_s - self._slow)
+        self._tput_n += 1
+        if self._tput_n <= cfg.tput_warmup or \
+                not self._enabled("throughput_collapse"):
+            return
+        floor = cfg.tput_drop * self._slow
+        if self._fast < floor:
+            self._alert(out, sample, "throughput_collapse", self._fast,
+                        floor,
+                        f"fast EWMA {self._fast:.1f} tok/s fell under "
+                        f"{cfg.tput_drop:.0%} of trailing "
+                        f"{self._slow:.1f} tok/s")
+
+    def _slo(self, out, sample):
+        cfg = self.config
+        if not self._enabled("slo_burn"):
+            return
+        if cfg.slo_ttft_ms is not None and \
+                len(self._ttft) >= cfg.min_ttft_samples:
+            p99 = _p99(sorted(self._ttft))
+            if p99 is not None and p99 > cfg.slo_ttft_ms:
+                self._alert(out, sample, "slo_burn", p99,
+                            cfg.slo_ttft_ms,
+                            f"p99 ttft {p99:.1f}ms over slo "
+                            f"{cfg.slo_ttft_ms:.1f}ms across "
+                            f"{len(self._ttft)} requests")
+
+    @staticmethod
+    def _stream(sample):
+        """Sample-stream key: the sync point, split per replica when
+        the sample carries one (concurrent fleet engines must never
+        interleave into one rate/depth window)."""
+        point = str(sample.get("point"))
+        rep = sample.get("replica")
+        return point if rep is None else f"{point}[{rep}]"
+
+    def _queue_depth(self, out, sample, depth):
+        cfg = self.config
+        point = self._stream(sample)
+        dq = self._queue.setdefault(
+            point, collections.deque(maxlen=cfg.queue_window))
+        dq.append(int(depth))
+        if not self._enabled("queue_runaway"):
+            return
+        q = list(dq)
+        if len(q) < cfg.queue_window or q[-1] < cfg.queue_limit:
+            return
+        if all(b >= a for a, b in zip(q, q[1:])) and q[-1] > q[0]:
+            self._alert(out, sample, "queue_runaway", q[-1],
+                        cfg.queue_limit,
+                        f"{point} queue depth grew {q[0]} -> {q[-1]} "
+                        f"across its last {len(q)} samples")
+
+    def _straggler_skew(self, out, sample):
+        cfg = self.config
+        if not self._enabled("straggler_replica") or len(self._tpot) < 2:
+            return
+        means = {r: sum(d) / len(d) for r, d in self._tpot.items()
+                 if len(d) >= cfg.straggler_min_requests}
+        if len(means) < 2:
+            return
+        worst = max(means, key=means.get)
+        others = sorted(v for r, v in means.items() if r != worst)
+        median = others[len(others) // 2]
+        if median > 0 and means[worst] > cfg.straggler_skew * median:
+            self._alert(out, sample, "straggler_replica", means[worst],
+                        cfg.straggler_skew * median,
+                        f"replica {worst} mean tpot "
+                        f"{means[worst]:.2f}ms vs peer median "
+                        f"{median:.2f}ms")
+
+    # -- entry -------------------------------------------------------------
+    def evaluate(self, sample):
+        """Feed one flight sample; returns the list of alerts that
+        tripped (each: rule / value / threshold / detail / point)."""
+        self.evals += 1
+        cfg = self.config
+        out = []
+        point = sample.get("point")
+        if point == "fit_step":
+            self._throughput(out, sample, sample.get("tokens_per_sec"))
+            if self._enabled("guardian_escalation") and \
+                    sample.get("verdict") == "rollback":
+                self._alert(out, sample, "guardian_escalation", 1, 0,
+                            "fit step ended in a guardian rollback")
+        elif point == "serving_sync":
+            for t in sample.get("ttft_ms") or ():
+                self._ttft.append(float(t))
+            stream = self._stream(sample)
+            ts = sample.get("ts_ns")
+            last = self._last_serving.get(stream)
+            if last is not None and ts is not None:
+                dt = (ts - last) / 1e9
+                if dt > 0:
+                    self._throughput(
+                        out, sample,
+                        sample.get("decoded_tokens", 0) / dt)
+            self._last_serving[stream] = ts
+            self._queue_depth(out, sample, sample.get("queue_depth", 0))
+            self._slo(out, sample)
+        elif point == "request":
+            t = sample.get("ttft_ms")
+            if t is not None:
+                self._ttft.append(float(t))
+            tpot = sample.get("tpot_ms")
+            rep = sample.get("replica")
+            if tpot is not None and rep is not None:
+                self._tpot.setdefault(
+                    rep, collections.deque(maxlen=64)).append(float(tpot))
+            self._slo(out, sample)
+            self._straggler_skew(out, sample)
+        elif point == "router_gap":
+            self._queue_depth(out, sample, sample.get("queue_depth", 0))
+            if self._enabled("guardian_escalation"):
+                deaths = int(sample.get("replica_deaths", 0))
+                if deaths > self._deaths_seen:
+                    self._alert(out, sample, "guardian_escalation",
+                                deaths, self._deaths_seen,
+                                f"replica death count grew "
+                                f"{self._deaths_seen} -> {deaths}")
+                self._deaths_seen = max(self._deaths_seen, deaths)
+            if self._enabled("straggler_replica") and \
+                    int(sample.get("stale_replicas", 0)) > 0:
+                self._alert(out, sample, "straggler_replica",
+                            sample["stale_replicas"], 0,
+                            "replica(s) quarantined with a stale "
+                            "heartbeat and a live thread")
+            if self._enabled("slo_burn"):
+                req = int(sample.get("requests", 0))
+                shed = int(sample.get("shed", 0))
+                if req >= cfg.min_requests and \
+                        shed / req >= cfg.shed_rate:
+                    self._alert(out, sample, "slo_burn", shed / req,
+                                cfg.shed_rate,
+                                f"{shed}/{req} requests shed by SLO "
+                                "admission control")
+        if self._enabled("retrace_storm"):
+            total = self._retrace_total()
+            if self._retrace_base is None:
+                self._retrace_base = total
+            elif total - self._retrace_base >= cfg.retrace_limit:
+                self._alert(out, sample, "retrace_storm",
+                            total - self._retrace_base,
+                            cfg.retrace_limit,
+                            f"{total - self._retrace_base} over-budget "
+                            "recompiles since the last baseline")
+                self._retrace_base = total
+        return out
+
+    def state_summary(self):
+        """JSON-ready verdict snapshot for bundle meta.json: per-rule
+        last-trip marks and the engine's rolling statistics."""
+        return {
+            "evals": self.evals,
+            "rules": list(self.config.rules),
+            "tripped": sorted(self._last_trip),
+            "ttft_window": len(self._ttft),
+            "tput_fast": self._fast, "tput_slow": self._slow,
+            "queue_window": {p: list(d)
+                             for p, d in sorted(self._queue.items())},
+            "replica_tpot_mean": {
+                str(r): round(sum(d) / len(d), 3)
+                for r, d in sorted(self._tpot.items()) if d},
+            "deaths_seen": self._deaths_seen,
+            "retrace_base": self._retrace_base,
+        }

@@ -14,6 +14,10 @@ README lookup.  This wires them into one:
                                               # diff (opt-in: bench
                                               # numbers move with
                                               # machine load)
+    python tools/ci_check.py --doctor         # + doctor smoke over the
+                                              # committed telemetry/
+                                              # snapshots (healthy ->
+                                              # 'no alerts', exit 0)
     python tools/ci_check.py --skip-tests     # lint (+gate) only
 
 Stages:
@@ -70,6 +74,45 @@ def run_tests(extra):
     return rc
 
 
+def run_doctor():
+    """Doctor smoke over the committed telemetry/ snapshots: every
+    artifact must parse clean and yield the 'no alerts' verdict, so
+    the committed files and the doctor/report parsers can never drift
+    apart (the ISSUE 13 CI satellite; opt-in like the bench gate)."""
+    import glob
+    from paddle_tpu.observability import doctor
+    t0 = _stage("doctor smoke over committed telemetry/ (opt-in)")
+    tdir = os.path.join(REPO, "telemetry")
+    proms = sorted(glob.glob(os.path.join(tdir, "*.prom")))
+    if not proms:
+        print("doctor: no committed telemetry snapshots found")
+        return 1
+    rc = 0
+    for prom in proms:
+        tag = os.path.splitext(os.path.basename(prom))[0]
+        jsonl = os.path.join(tdir, tag + ".jsonl")
+        trace = os.path.join(tdir, tag + "_requests.trace.json")
+        ev = doctor.evidence_from_sinks(
+            prom=prom,
+            jsonl=jsonl if os.path.exists(jsonl) else None,
+            trace=trace if os.path.exists(trace) else None)
+        result = doctor.diagnose(ev)
+        healthy = result["verdict"] == "no alerts"
+        print(f"  {tag}: verdict={result['verdict']!r} "
+              f"({len(result['sources'])} sink(s), "
+              f"{len(result['diagnoses'])} signal(s))")
+        for note in result["notes"]:
+            print(f"    note: {note}")
+        if not healthy:
+            for d in result["diagnoses"][:3]:
+                for e in d["evidence"]:
+                    print(f"    [{d['cause']}] {e}")
+            rc = 1
+    print(f"doctor: {'OK' if rc == 0 else 'FAIL'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
 def run_bench_gate():
     from paddle_tpu.analysis import runner
     t0 = _stage("bench trajectory gate (opt-in)")
@@ -90,6 +133,10 @@ def main(argv=None):
                          "(tests still run in full)")
     ap.add_argument("--bench-gate", action="store_true",
                     help="also diff the newest two BENCH_r*.json")
+    ap.add_argument("--doctor", action="store_true",
+                    help="also run the doctor smoke over the committed "
+                         "telemetry/ snapshots (healthy artifacts must "
+                         "parse clean with a 'no alerts' verdict)")
     ap.add_argument("--skip-tests", action="store_true",
                     help="lint (and gate) only")
     ap.add_argument("--pytest-args", default="",
@@ -100,6 +147,10 @@ def main(argv=None):
     rc = run_lint(args.changed_only)
     if rc != 0:
         return rc
+    if args.doctor:
+        rc = run_doctor()
+        if rc != 0:
+            return rc
     if args.bench_gate:
         rc = run_bench_gate()
         if rc != 0:
