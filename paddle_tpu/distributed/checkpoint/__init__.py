@@ -15,6 +15,18 @@ TP→FSDP regrouping) is the same code path as same-mesh load.
 ``async_save=True`` snapshots shards to host synchronously (cheap D2H)
 and writes to disk on a background thread, returning a waitable handle —
 the orbax/tensorstore pattern.
+
+Elastic resharded resume (ISSUE 14): every :func:`save_checkpoint` can
+carry a **layout manifest** (``layout.manifest.json``, committed under
+the same ``COMMITTED`` sentinel) recording the mesh that wrote the
+checkpoint, every array's PartitionSpec, the world size, step, RNG
+stream, dataloader cursor and the sharding plan that produced the
+layout.  A manifest-aware load re-derives target shardings for the
+*current* mesh from those axis-name specs — resuming at a different
+``np`` / dp×mp split needs no caller-supplied template (PAPERS.md
+"Memory-efficient array redistribution through portable collective
+communication": redistribution happens at the host slab layer here,
+one byte-range read per target region).
 """
 import atexit
 import json
@@ -33,22 +45,35 @@ import jax.numpy as jnp
 
 from ... import observability as _obs
 from ...framework import failpoints as _fp
+from ...framework import random as _random
 from ...framework.core import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
-           "save_checkpoint", "latest_checkpoint", "CheckpointCorruptError"]
+           "save_checkpoint", "latest_checkpoint", "CheckpointCorruptError",
+           "build_manifest", "load_manifest", "restore_latest",
+           "rng_state_from_manifest", "target_shardings_from_manifest"]
 
 _logger = logging.getLogger("paddle_tpu.checkpoint")
 
 _META = "checkpoint.metadata.json"
+_MANIFEST = "layout.manifest.json"
 _SENTINEL = "COMMITTED"               # written LAST: its presence == commit
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_READING = ".READING."                # reader sentinel prefix (see sweep)
 
 # failpoint sites (framework/failpoints.py): shard write, metadata write,
-# and the commit sentinel — `ckpt.commit_sentinel=skip` simulates a kill
-# between the last shard write and the commit
+# the layout-manifest write, shard read, and the commit sentinel —
+# `ckpt.commit_sentinel=skip` simulates a kill between the last shard
+# write and the commit; `ckpt.write_manifest=error` a kill between shard
+# write and manifest commit; `checkpoint.manifest_torn=skip` truncates
+# the manifest mid-write (sentinel still lands: a committed step whose
+# manifest is garbage); `ckpt.read_shard=delay:S` parks a reader so the
+# retention-sweep race is testable deterministically
 _FP_WRITE_SHARD = _fp.register("ckpt.write_shard")
 _FP_WRITE_META = _fp.register("ckpt.write_meta")
+_FP_WRITE_MANIFEST = _fp.register("ckpt.write_manifest")
+_FP_MANIFEST_TORN = _fp.register("checkpoint.manifest_torn", skippable=True)
+_FP_READ_SHARD = _fp.register("ckpt.read_shard")
 _FP_COMMIT = _fp.register("ckpt.commit_sentinel", skippable=True)
 
 
@@ -82,7 +107,63 @@ _pending_handles = []                 # unwaited AsyncSaveHandles
 _pending_lock = threading.Lock()
 
 _active_saves = set()                 # abspaths with an in-flight writer
+_active_reads = {}                    # abspath -> live reader refcount
 _active_lock = threading.Lock()       # (protects the retention sweep)
+
+
+def _enter_read(path):
+    """Register a live restore of ``path`` so a concurrent retention
+    sweep (same process: the ``_active_reads`` refcount; other
+    processes: an on-disk ``.READING.<pid>.<token>`` sentinel file)
+    never deletes a committed step dir out from under it."""
+    ap = os.path.abspath(path)
+    with _active_lock:
+        _active_reads[ap] = _active_reads.get(ap, 0) + 1
+    token = os.path.join(ap, f"{_READING}{os.getpid()}."
+                             f"{uuid.uuid4().hex[:8]}")
+    try:
+        with open(token, "w") as f:
+            f.write(str(time.time_ns()))
+    except OSError:
+        token = None          # best effort: in-process guard still holds
+    return ap, token
+
+
+def _exit_read(ap, token):
+    with _active_lock:
+        n = _active_reads.get(ap, 0) - 1
+        if n <= 0:
+            _active_reads.pop(ap, None)
+        else:
+            _active_reads[ap] = n
+    if token is not None:
+        try:
+            os.remove(token)
+        except OSError:
+            pass
+
+
+def _fresh_read_sentinel(d):
+    """True when ``d`` holds a fresh on-disk reader sentinel (another
+    process's restore in flight).  Sentinels older than
+    ``PADDLE_CKPT_READ_GRACE`` seconds (default 900) are the debris of
+    a dead reader and do not pin the dir.  Lock-free: call it with
+    ``_active_lock`` held when atomicity with the refcount matters."""
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return False
+    grace = float(os.environ.get("PADDLE_CKPT_READ_GRACE", "900"))
+    now = time.time()
+    for name in names:
+        if not name.startswith(_READING):
+            continue
+        try:
+            if now - os.stat(os.path.join(d, name)).st_mtime < grace:
+                return True
+        except OSError:
+            continue
+    return False
 
 
 class AsyncSaveHandle:
@@ -372,6 +453,8 @@ def _read_region(path, shard_rec, region, is_bf16=False, vcache=None):
             return None, None
         inter_src.append(slice(lo - s0, hi - s0))
         inter_dst.append(slice(lo - rs, hi - rs))
+    if _fp._ACTIVE:
+        _fp.fire(_FP_READ_SHARD)
     _verify_shard_crc(path, shard_rec, vcache)
     data = np.load(path, mmap_mode="r")[tuple(inter_src)]
     data = np.ascontiguousarray(data)
@@ -443,6 +526,209 @@ def _merged_meta(path):
     return merged
 
 
+# -- layout manifest (elastic resharded resume) -------------------------
+#
+# ``layout.manifest.json`` sits beside the rank metadata in a step dir
+# and is committed under the same COMMITTED sentinel (process 0 writes
+# it strictly before the sentinel).  It records everything a relaunched
+# job needs to resume on a DIFFERENT topology: the mesh that wrote the
+# checkpoint, per-array PartitionSpecs (axis *names*, which survive a
+# mesh-shape change), world size, step, the RNG stream, the dataloader
+# cursor, and the sharding plan that produced the layout.
+
+def _spec_to_json(spec, ndim):
+    """PartitionSpec -> JSON list, one entry per dim (None | name |
+    [names]), padded to the array's rank."""
+    entries = []
+    for e in tuple(spec):
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, (tuple, list)):
+            entries.append([str(a) for a in e])
+        else:
+            entries.append(str(e))
+    entries += [None] * (ndim - len(entries))
+    return entries[:ndim]
+
+
+def _adapt_spec(entries, mesh, global_shape):
+    """Re-derive a PartitionSpec for the CURRENT mesh from saved axis
+    names: axes the new mesh doesn't have are dropped (replicate), and
+    a dim that stops dividing evenly under the new axis sizes falls
+    back to replicated on that dim — elastic resume must never refuse
+    a legal mesh over a divisibility corner."""
+    from jax.sharding import PartitionSpec
+    out = []
+    for d, e in enumerate(entries or ()):
+        if d >= len(global_shape):
+            break
+        names = [e] if isinstance(e, str) else list(e or ())
+        names = [n for n in names if n in mesh.axis_names]
+        total = 1
+        for n in names:
+            total *= int(mesh.shape[n])
+        if not names or total <= 0 or global_shape[d] % total:
+            out.append(None)
+        elif len(names) == 1:
+            out.append(names[0])
+        else:
+            out.append(tuple(names))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def _mesh_desc(mesh):
+    return {"axis_names": [str(a) for a in mesh.axis_names],
+            "shape": [int(mesh.shape[a]) for a in mesh.axis_names]}
+
+
+def _plan_desc(plan):
+    if plan is None:
+        return None
+    gc = getattr(plan, "grad_comm", None)
+    return {"level": plan.level,
+            "fsdp_axis": plan.fsdp_axis,
+            "mp_axis": plan.mp_axis,
+            "batch_axes": list(plan.batch_axes or ()),
+            "zero1": bool(gc is not None and getattr(gc, "zero1", False))}
+
+
+def build_manifest(state_dict, step=None, plan=None, mesh=None,
+                   data_cursor=None, opt_meta=None, rng=True, extra=None):
+    """Capture the layout manifest for ``state_dict`` as it is placed
+    RIGHT NOW: per-array PartitionSpecs from the live shardings, the
+    mesh (explicit ``mesh`` > ``plan.mesh`` > the first NamedSharding
+    seen), world size, RNG stream (the global key chain every rank
+    folds per-shard keys from — one record restores any np), plus the
+    caller's dataloader cursor and optimizer metadata."""
+    from jax.sharding import NamedSharding
+    flat = {k: _as_array(v) for k, v in _flatten(state_dict).items()}
+    pspecs = {}
+    cap_mesh = None
+    for key, arr in flat.items():
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            pspecs[key] = _spec_to_json(sh.spec, getattr(arr, "ndim", 0))
+            if cap_mesh is None:
+                cap_mesh = sh.mesh
+    m = mesh if mesh is not None else (
+        plan.mesh if plan is not None else cap_mesh)
+    manifest = {
+        "format": 1,
+        "step": int(step) if step is not None else None,
+        "world_size": int(m.size) if m is not None else jax.device_count(),
+        "mesh": _mesh_desc(m) if m is not None else None,
+        "pspecs": pspecs,
+        "plan": _plan_desc(plan),
+        "data_cursor": data_cursor,
+        "opt": opt_meta or {},
+        "extra": extra or {},
+    }
+    if rng:
+        key = _random.get_rng_state()[0]
+        manifest["rng"] = {
+            "seed": _random.get_seed(),
+            "key_data": np.asarray(jax.random.key_data(key))
+                          .astype(np.uint32).tolist(),
+        }
+    return manifest
+
+
+def load_manifest(step_dir):
+    """The step dir's layout manifest, or None when absent/unreadable.
+    An unreadable manifest degrades to the template-path restore (the
+    pre-manifest contract) instead of failing the whole resume."""
+    p = os.path.join(step_dir, _MANIFEST)
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        _logger.warning(
+            "layout manifest %s is unreadable (%s); falling back to the "
+            "template restore path", p, e)
+        return None
+
+
+def rng_state_from_manifest(manifest):
+    """Rebuild the saved global PRNG key, or None when unrecorded."""
+    rng = (manifest or {}).get("rng") or {}
+    data = rng.get("key_data")
+    if data is None:
+        return None
+    return jax.random.wrap_key_data(
+        jnp.asarray(np.asarray(data, dtype=np.uint32)))
+
+
+def target_shardings_from_manifest(manifest, mesh, shapes):
+    """{flat key -> NamedSharding on ``mesh``} re-derived from the
+    manifest's saved PartitionSpecs.  ``shapes``: {key -> global shape}
+    (divisibility decides which saved axes survive)."""
+    from jax.sharding import NamedSharding
+    out = {}
+    for key, entries in (manifest.get("pspecs") or {}).items():
+        if key not in shapes:
+            continue
+        out[key] = NamedSharding(
+            mesh, _adapt_spec(entries, mesh, tuple(shapes[key])))
+    return out
+
+
+def _detect_reshard(manifest, mesh, tmpl_flat):
+    """(old_np, new_np) when the restore target topology differs from
+    the one that wrote the checkpoint, else None.  The current topology
+    is the explicit ``mesh`` or the first NamedSharding in the
+    template."""
+    if not manifest or manifest.get("mesh") is None:
+        return None
+    cur = mesh
+    if cur is None:
+        from jax.sharding import NamedSharding
+        for v in (tmpl_flat or {}).values():
+            sh = getattr(v, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                cur = sh.mesh
+                break
+    if cur is None:
+        return None
+    old_np = int(manifest.get("world_size") or 0)
+    new_np = int(cur.size)
+    if old_np and (old_np != new_np or
+                   _mesh_desc(cur) != manifest["mesh"]):
+        return old_np, new_np
+    return None
+
+
+def _emit_reshard(old_np, new_np, root, source):
+    """elastic_reshard guardian event + pt_checkpoint_reshard_total —
+    the observable record that a checkpoint crossed a topology change."""
+    if _obs.enabled():
+        _obs.inc("pt_checkpoint_reshard_total", kind=source)
+    try:
+        from ...framework import guardian as _guardian
+        _guardian.emit("elastic_reshard", old_np=int(old_np),
+                       new_np=int(new_np), root=str(root),
+                       source=source)
+    except Exception:           # guardian unavailable in exotic embeds
+        _logger.info("elastic reshard: np %s -> %s (%s)", old_np, new_np,
+                     source)
+
+
+def _emit_fallback(root, step, kind, detail):
+    """checkpoint_fallback guardian event + the fallback counter: a
+    resume that silently lost steps must be observable."""
+    _obs.inc("pt_checkpoint_fallbacks_total", kind=kind)
+    try:
+        from ...framework import guardian as _guardian
+        _guardian.emit("checkpoint_fallback", root=str(root),
+                       step=int(step), kind=kind, detail=str(detail))
+    except Exception:
+        _logger.info("checkpoint fallback at %s step %s (%s): %s", root,
+                     step, kind, detail)
+
+
 def load_state_dict(path, template=None, shardings=None, mesh=None):
     """Load a checkpoint, resharding every array onto its target sharding.
 
@@ -460,53 +746,92 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
     from :func:`save_checkpoint` rather than metadata itself), the
     newest committed step is loaded, falling back step by step past any
     torn or corrupt checkpoint until one restores cleanly.
+
+    Elastic reshard: when the step dir carries a layout manifest and a
+    target ``mesh`` is given, arrays with no explicit sharding/template
+    get their target re-derived from the manifest's saved PartitionSpecs
+    adapted to the current mesh — restoring onto a different np or
+    dp×mp split needs no caller-supplied template.  A topology change
+    emits the ``elastic_reshard`` guardian event and books
+    ``pt_checkpoint_reshard_*``.
     """
     if _is_checkpoint_root(path):
         return _load_latest_valid(path, template=template,
                                   shardings=shardings, mesh=mesh)
+    return _load_step_dir(path, template, shardings, mesh)[0]
+
+
+def _load_step_dir(path, template=None, shardings=None, mesh=None):
+    """One step dir → ``(state, manifest)``.  The manifest is parsed
+    INSIDE the reader-sentinel window — callers that need it must not
+    re-read it from disk after the sentinel is released (a concurrent
+    retention sweep could have removed the dir by then)."""
     t_load0 = time.perf_counter()
-    vcache = {}
-    meta = _merged_meta(path)
-    tmpl_flat = ({k: _as_array(v) for k, v in _flatten(template).items()}
-                 if template is not None else {})
-    out = {}
-    for key, entry in meta["arrays"].items():
-        shape = tuple(entry["global_shape"])
-        dtype = np.dtype(entry["dtype"]) if entry["dtype"] != "bfloat16" \
-            else jnp.bfloat16
-        target = None
-        if shardings is not None and key in shardings:
-            target = shardings[key]
-        elif key in tmpl_flat and isinstance(tmpl_flat[key], jax.Array):
-            target = tmpl_flat[key].sharding
-        if target is None:
-            full = _assemble_region(path, entry,
-                                    [(0, s) for s in shape], dtype, vcache)
-            arr = jnp.asarray(full)
-            if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec
-                arr = jax.device_put(
-                    arr, NamedSharding(mesh, PartitionSpec()))
-            out[key] = arr
-            continue
-        # build per-device slabs for the target sharding; devices sharing a
-        # region (replication) reuse one host slab
-        device_map = target.addressable_devices_indices_map(shape)
-        slab_cache = {}
-        slabs = []
-        for dev, idx in device_map.items():
-            region = []
-            for d, s in enumerate(idx):
-                start = s.start or 0
-                stop = s.stop if s.stop is not None else shape[d]
-                region.append((start, stop))
-            rkey = tuple(region)
-            if rkey not in slab_cache:
-                slab_cache[rkey] = _assemble_region(path, entry, region,
-                                                    dtype, vcache)
-            slabs.append(jax.device_put(slab_cache[rkey], dev))
-        out[key] = jax.make_array_from_single_device_arrays(
-            shape, target, slabs)
+    # reader sentinel: a concurrent retention sweep (overlapping async
+    # save committing a newer step) must never rmtree this dir mid-read
+    ap, rtok = _enter_read(path)
+    try:
+        vcache = {}
+        meta = _merged_meta(path)
+        tmpl_flat = ({k: _as_array(v) for k, v in
+                      _flatten(template).items()}
+                     if template is not None else {})
+        manifest = load_manifest(path)
+        derived = {}
+        if manifest is not None and mesh is not None:
+            shapes = {k: tuple(e["global_shape"])
+                      for k, e in meta["arrays"].items()}
+            derived = target_shardings_from_manifest(manifest, mesh,
+                                                     shapes)
+        reshard = _detect_reshard(manifest, mesh, tmpl_flat)
+        out = {}
+        for key, entry in meta["arrays"].items():
+            shape = tuple(entry["global_shape"])
+            dtype = np.dtype(entry["dtype"]) \
+                if entry["dtype"] != "bfloat16" else jnp.bfloat16
+            target = None
+            if shardings is not None and key in shardings:
+                target = shardings[key]
+            elif key in tmpl_flat and isinstance(tmpl_flat[key],
+                                                 jax.Array):
+                target = tmpl_flat[key].sharding
+            elif key in derived:
+                target = derived[key]
+            if target is None:
+                full = _assemble_region(
+                    path, entry, [(0, s) for s in shape], dtype, vcache)
+                arr = jnp.asarray(full)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    arr = jax.device_put(
+                        arr, NamedSharding(mesh, PartitionSpec()))
+                out[key] = arr
+                continue
+            # build per-device slabs for the target sharding; devices
+            # sharing a region (replication) reuse one host slab
+            device_map = target.addressable_devices_indices_map(shape)
+            slab_cache = {}
+            slabs = []
+            for dev, idx in device_map.items():
+                region = []
+                for d, s in enumerate(idx):
+                    start = s.start or 0
+                    stop = s.stop if s.stop is not None else shape[d]
+                    region.append((start, stop))
+                rkey = tuple(region)
+                if rkey not in slab_cache:
+                    slab_cache[rkey] = _assemble_region(
+                        path, entry, region, dtype, vcache)
+                slabs.append(jax.device_put(slab_cache[rkey], dev))
+            out[key] = jax.make_array_from_single_device_arrays(
+                shape, target, slabs)
+    finally:
+        _exit_read(ap, rtok)
+    if reshard is not None:
+        _emit_reshard(reshard[0], reshard[1], path, "load")
+        if _obs.enabled():
+            _obs.observe("pt_checkpoint_reshard_ms",
+                         (time.perf_counter() - t_load0) * 1e3)
     if _obs.enabled():
         _obs.observe("pt_checkpoint_load_ms",
                      (time.perf_counter() - t_load0) * 1e3)
@@ -519,7 +844,7 @@ def load_state_dict(path, template=None, shardings=None, mesh=None):
                         else np.dtype(entry["dtype"]).itemsize)
             nbytes += n * itemsize
         _obs.inc("pt_checkpoint_bytes_total", nbytes, direction="load")
-    return out
+    return out, manifest
 
 
 # -- step-directory commit protocol (save_checkpoint / latest) ----------
@@ -577,22 +902,48 @@ def latest_checkpoint(root):
     return None
 
 
-def _load_latest_valid(root, **kw):
-    """Newest committed checkpoint that actually restores; fall back past
-    corrupt ones (CRC mismatch, lost shard/metadata files)."""
+def restore_latest(root, template=None, shardings=None, mesh=None):
+    """Newest committed checkpoint under ``root`` that actually
+    restores, falling back past torn and corrupt steps — each skipped
+    step emits a ``checkpoint_fallback`` guardian event (plus the
+    ``pt_checkpoint_fallbacks_total`` counter), so a resume that lost
+    steps is observable, never silent.
+
+    Returns ``(state, manifest, step_dir)``; ``manifest`` is None for
+    pre-manifest checkpoints."""
     entries = list(reversed(_iter_steps(root)))
     steps = [(s, d) for s, d, committed in entries if committed]
-    torn = len(entries) - len(steps)
-    if torn:
-        _obs.inc("pt_checkpoint_fallbacks_total", torn, kind="torn")
+    torn = [(s, d) for s, d, committed in entries if not committed]
     if not steps:
+        # nothing restorable at all: every torn dir is lost work
+        for s, d in torn:
+            _emit_fallback(root, s, "torn",
+                           f"uncommitted step dir {d} skipped")
         raise FileNotFoundError(
             f"no committed checkpoint under {root} — nothing to resume "
             "from (torn step directories, if any, were skipped)")
     last_err = None
     for step, d in steps:
         try:
-            return load_state_dict(d, **kw)
+            # one pass: the manifest comes back from the same reader-
+            # pinned window as the state (re-reading it here, after the
+            # sentinel is gone, could race a retention sweep)
+            state, manifest = _load_step_dir(d, template=template,
+                                             shardings=shardings,
+                                             mesh=mesh)
+            # book only torn dirs NEWER than the restored step: those
+            # are writer-died-mid-save steps this resume actually lost.
+            # Older torn debris cost the resume nothing, and a dir this
+            # process's async writer is STILL FILLING is an in-flight
+            # save, not lost work — booking either would make the event
+            # unusable for alerting.
+            with _active_lock:
+                in_flight = set(_active_saves)
+            for s, td in torn:
+                if s > step and os.path.abspath(td) not in in_flight:
+                    _emit_fallback(root, s, "torn",
+                                   f"uncommitted step dir {td} skipped")
+            return state, manifest, d
         # only integrity failures trigger fallback: CRC mismatch, files
         # lost from under the sentinel, truncated metadata.  A user error
         # (wrong template/sharding) raises through immediately rather
@@ -602,11 +953,17 @@ def _load_latest_valid(root, **kw):
             _logger.warning(
                 "checkpoint %s is unusable (%s); falling back to the "
                 "previous one", d, e)
-            _obs.inc("pt_checkpoint_fallbacks_total", kind="corrupt")
+            _emit_fallback(root, step, "corrupt", e)
             last_err = e
     raise CheckpointCorruptError(
         f"every committed checkpoint under {root} failed to restore "
         f"(last error: {last_err})") from last_err
+
+
+def _load_latest_valid(root, **kw):
+    """State-only veneer over :func:`restore_latest` (the historical
+    root-load entry point load_state_dict delegates to)."""
+    return restore_latest(root, **kw)[0]
 
 
 def _retention_sweep(root, keep_last):
@@ -615,7 +972,21 @@ def _retention_sweep(root, keep_last):
     Directories this process is still writing into (overlapping async
     saves, which can commit out of order) are exempt via the
     ``_active_saves`` registry; torn dirs newer than the commit are left
-    alone too — another host's save may be filling them."""
+    alone too — another host's save may be filling them.  Directories a
+    restore is reading FROM right now (same process: ``_active_reads``;
+    any process: a fresh ``.READING.*`` sentinel file) are likewise
+    never swept — an elastic resume restoring the K-th-newest step must
+    not lose it to a concurrent writer's sweep mid-read.  The reader
+    check and an atomic rename out of the ``step_NNNN`` namespace
+    happen under ONE ``_active_lock`` hold per dir (not check-then-act);
+    the slow rmtree runs on the renamed dir outside the lock, so
+    registration never stalls behind disk I/O.  A same-process reader
+    either registers before the sweep takes the lock and pins the dir,
+    or registers after the rename and falls back to a newer step
+    through the normal corrupt-fallback path.  (Cross-process, the
+    sentinel-file check leaves an inherent listdir-vs-token-write
+    window; the grace period and the never-doomed newest-K cover
+    practical readers.)"""
     if not keep_last or keep_last <= 0:
         return
     steps = _iter_steps(root)
@@ -625,18 +996,51 @@ def _retention_sweep(root, keep_last):
         newest_committed = committed[-1][0]
         doomed += [d for s, d, ok in steps
                    if not ok and s < newest_committed]
-    with _active_lock:
-        doomed = [d for d in doomed
-                  if os.path.abspath(d) not in _active_saves]
     for d in doomed:
+        ap = os.path.abspath(d)
+        # the cross-process sentinel-file check is inherently racy, so
+        # its listdir/stat runs OUTSIDE the lock (no disk I/O stalls
+        # registration); the in-process refcount check + the ATOMIC
+        # rename out of the step_NNNN namespace share ONE lock hold —
+        # that pair is what makes the same-process guarantee sound.
+        # The slow rmtree of a multi-GB dir runs outside the lock.
+        if _fresh_read_sentinel(d):
+            continue
+        tomb = f"{d}.doomed.{os.getpid()}.{uuid.uuid4().hex[:6]}"
+        with _active_lock:
+            if ap in _active_saves or _active_reads.get(ap):
+                continue
+            try:
+                os.rename(d, tomb)
+            except OSError as e:
+                _logger.warning(
+                    "retention sweep could not retire %s: %s", d, e)
+                continue
         try:
-            shutil.rmtree(d)
+            shutil.rmtree(tomb)
         except OSError as e:
-            _logger.warning("retention sweep could not remove %s: %s", d, e)
+            _logger.warning("retention sweep could not remove %s: %s",
+                            tomb, e)
+    # orphaned tombs (an earlier sweep's rmtree failed transiently —
+    # NFS EBUSY, open handle): they no longer match _STEP_RE, so
+    # collect them here or they would accumulate forever
+    try:
+        leftovers = [n for n in os.listdir(root)
+                     if ".doomed." in n and n.startswith("step_")]
+    except OSError:
+        leftovers = []
+    for name in leftovers:
+        p = os.path.join(root, name)
+        if os.path.isdir(p):
+            try:
+                shutil.rmtree(p)
+            except OSError as e:
+                _logger.warning(
+                    "retention sweep could not remove %s: %s", p, e)
 
 
 def save_checkpoint(state_dict, root, step, process_index=None,
-                    async_save=False, keep_last=None):
+                    async_save=False, keep_last=None, manifest=None):
     """Save into ``root/step_NNNN`` with crash-safe commit + retention.
 
     The commit sentinel is written by process 0 only, strictly after its
@@ -646,17 +1050,56 @@ def save_checkpoint(state_dict, root, step, process_index=None,
     else 5; 0 disables) sweeps older committed steps after the commit.
     Returns the step directory path (sync) or an :class:`AsyncSaveHandle`
     whose ``wait()`` completes after commit + sweep (async).
+
+    ``manifest`` (a :func:`build_manifest` dict, or True to capture one
+    from the state's live shardings) is written as
+    ``layout.manifest.json`` strictly before the sentinel, so a
+    committed step always carries a complete manifest — the elastic
+    resharded-resume contract.
     """
     if keep_last is None:
         keep_last = int(os.environ.get("PADDLE_CKPT_KEEP_LAST", "5"))
     path = _step_path(root, step)
     pidx = (jax.process_index() if process_index is None else process_index)
+    if manifest is True:
+        # only process 0 writes the manifest — other ranks must not pay
+        # the state walk + key_data readback for a dict commit() discards
+        manifest = build_manifest(state_dict, step=step) if pidx == 0 \
+            else None
+    # re-saving an already-committed step: UN-commit it first, or a
+    # crash mid-rewrite would leave a committed-looking dir with torn
+    # shards — the one state the sentinel-written-LAST protocol exists
+    # to make impossible.  Torn-until-recommitted is the honest state.
+    if pidx == 0:
+        try:
+            os.remove(os.path.join(path, _SENTINEL))
+        except FileNotFoundError:
+            pass
 
     def commit():
         if pidx != 0:
             return
         if _fp._ACTIVE and _fp.fire(_FP_COMMIT) == "skip":
             return          # simulated kill between shard write and commit
+        if manifest is not None:
+            man = dict(manifest)
+            man.setdefault("format", 1)
+            man["step"] = int(step)
+            if _fp._ACTIVE:
+                # a kill between shard write and manifest commit leaves
+                # NO sentinel — the whole dir reads as torn and resume
+                # falls back cleanly (chaos-tested)
+                _fp.fire(_FP_WRITE_MANIFEST)
+            payload = json.dumps(man)
+            if _fp._ACTIVE and _fp.fire(_FP_MANIFEST_TORN) == "skip":
+                # simulate a torn manifest write that still got
+                # committed (crash straddling a non-atomic filesystem):
+                # the loader must degrade to the template path
+                payload = payload[:max(8, len(payload) // 3)]
+            mtmp = os.path.join(path, _MANIFEST + ".tmp")
+            with open(mtmp, "w") as f:
+                f.write(payload)
+            os.replace(mtmp, os.path.join(path, _MANIFEST))
         # overlapping async saves can commit out of order, and the later
         # step's retention sweep may then remove this still-uncommitted
         # directory mid-write; never stamp COMMITTED unless everything we

@@ -22,6 +22,11 @@ from ...store import TCPStore
 __all__ = ["ElasticStatus", "ElasticLevel", "ElasticManager"]
 
 _FP_HEARTBEAT = _fp.register("elastic.heartbeat")
+# fired by the launcher when a membership change relaunches workers at
+# the observed member count with resume pointed at the manifest root —
+# `elastic.reshard=error` makes the relaunch-with-resume path itself
+# chaos-testable (delay:S parks it mid-reshard)
+FP_RESHARD = _fp.register("elastic.reshard")
 
 
 class _NpWaitResult(int):

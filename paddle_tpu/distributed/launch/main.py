@@ -19,8 +19,10 @@ import subprocess
 import sys
 import time
 
+from ...framework import failpoints as _fp
 from ...framework.backoff import jittered_delay
 from ...framework.preemption import PREEMPTED_EXIT_CODE
+from ..fleet import elastic as _elastic_mod
 from ..fleet.elastic import ElasticManager, ElasticStatus
 
 # restart hygiene: sleep with exponential backoff between restarts of the
@@ -143,6 +145,16 @@ def _parse():
                         " (default 127.0.0.1 with sequential ports)")
     p.add_argument("--devices", "--gpus", type=str, default=None,
                    help="accepted for compat; chip selection is automatic")
+    p.add_argument("--ckpt_root", type=str,
+                   default=os.environ.get("PADDLE_CKPT_ROOT", ""),
+                   help="manifest-checkpoint root for elastic resume: "
+                        "exported to every worker as PADDLE_CKPT_ROOT "
+                        "AND PADDLE_RESUME_ROOT, so the trainer script "
+                        "resumes from the newest committed manifest "
+                        "step via Model.fit(resume=) — an empty root "
+                        "is a fresh start, making resume a property of "
+                        "the on-disk state rather than launcher-local "
+                        "restart history")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -150,7 +162,14 @@ def _parse():
 
 def _worker_env(args, local_rank, membership):
     """membership: {"node_index": i, "n_nodes": n, "endpoints": [...]}
-    — static from --node_rank/--nnodes, or live from the elastic store."""
+    — static from --node_rank/--nnodes, or live from the elastic store.
+    With a ``--ckpt_root`` configured, EVERY start points the worker at
+    the manifest root via ``PADDLE_RESUME_ROOT``: the trainer passes it
+    to ``Model.fit(resume=...)``, which treats an empty root as a fresh
+    start — so whether this launch resumes is decided by the on-disk
+    checkpoint state, not launcher-local restart history (a freshly
+    rebooted launcher rejoining an elastic job must restore the same
+    checkpoint its surviving peers do, or ranks diverge)."""
     env = dict(os.environ)
     nproc = args.nproc_per_node
     world = membership["n_nodes"] * nproc
@@ -177,7 +196,33 @@ def _worker_env(args, local_rank, membership):
         env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(expanded)
     env["PADDLE_CURRENT_ENDPOINT"] = \
         f"{os.environ.get('POD_IP', '127.0.0.1')}:{6170 + local_rank}"
+    if getattr(args, "ckpt_root", ""):
+        env["PADDLE_CKPT_ROOT"] = args.ckpt_root
+        env["PADDLE_RESUME_ROOT"] = args.ckpt_root
     return env
+
+
+def _note_reshard(old_np, new_np, root):
+    """Book a restart-with-resume at a changed world size: fire the
+    ``elastic.reshard`` failpoint, count ``pt_checkpoint_reshard_total``
+    and emit the ``elastic_reshard`` guardian event — the observable
+    record that the job is resuming on different capacity."""
+    if _fp._ACTIVE:
+        _fp.fire(_elastic_mod.FP_RESHARD)
+    try:
+        from ... import observability as _obs
+        if _obs.enabled():
+            _obs.inc("pt_checkpoint_reshard_total", kind="relaunch")
+    except Exception:
+        pass
+    try:
+        from ...framework import guardian as _guardian
+        _guardian.emit("elastic_reshard", old_np=int(old_np),
+                       new_np=int(new_np), root=str(root or ""),
+                       source="relaunch")
+    except Exception:
+        print(f"[launch] elastic reshard: np {old_np} -> {new_np} "
+              f"(resume root {root!r})", flush=True)
 
 
 def _elastic_registry_endpoint(master):
@@ -421,6 +466,11 @@ def main():
     # elastic/manager.py membership watch)
     holding = False
     hold_since = None
+    # the world size workers are ACTUALLY running at — `membership` is
+    # recomputed on every hold/restart pass (including capped-out holds
+    # that never relaunch), so the reshard event's old_np must come
+    # from the last world that really ran, not the latest snapshot
+    active_world = membership["n_nodes"] * args.nproc_per_node
     while True:
         status = elastic.watch() if elastic is not None else None
         if status == ElasticStatus.HOLD:
@@ -451,6 +501,19 @@ def main():
         if status == ElasticStatus.RESTART or \
                 (holding and status == ElasticStatus.NORMAL):
             holding = False
+            # re-read the OBSERVED member count: the relaunch runs at
+            # whatever np the cluster actually gives back right now,
+            # not the snapshot the watch() poll happened to see
+            observed = elastic.wait_for_np()
+            if not observed:
+                print(f"[launch] elastic: membership changed but only "
+                      f"{int(observed)} member(s) observed; holding",
+                      flush=True)
+                holding = True
+                hold_since = time.time()
+                time.sleep(1)
+                continue
+            old_world = active_world
             membership = _elastic_membership(elastic, args)
             if membership["node_index"] is None:
                 # capped out by max_np: stand by until a slot opens
@@ -461,12 +524,23 @@ def main():
                 hold_since = time.time()
                 time.sleep(1)
                 continue
+            new_world = membership["n_nodes"] * args.nproc_per_node
             print(f"[launch] elastic membership changed → relaunch as "
                   f"node {membership['node_index']} of "
-                  f"{membership['n_nodes']}: {membership['endpoints']}",
+                  f"{membership['n_nodes']} (observed np="
+                  f"{int(observed)}): {membership['endpoints']}",
                   flush=True)
             stop_workers()
             policy.reset_all()           # fresh budget for the new epoch
+            if args.ckpt_root and old_world != new_world:
+                # the relaunch resumes at a DIFFERENT world size: the
+                # workers will reshard the newest committed manifest
+                # step onto the new mesh.  Same-size membership churn
+                # (node replaced, quorum dip-and-recover) still resumes
+                # but is not a reshard — booking it would make the
+                # event/counter useless for alerting.
+                _note_reshard(old_world, new_world, args.ckpt_root)
+            active_world = new_world
             for i in range(args.nproc_per_node):
                 start(i)
 

@@ -156,6 +156,16 @@ EVENT_SCHEMA = {
     # written (atomic tmp+rename; kept = bundles surviving the
     # keep-last-K retention sweep)
     "flight_dump": {"trigger", "path", "alerts", "kept"},
+    # checkpoint (distributed/checkpoint): a root-level restore skipped
+    # a step dir — torn (uncommitted debris) or corrupt (CRC/restore
+    # failure) — and fell back to an older one; a resume that lost
+    # steps must be observable, never silent
+    "checkpoint_fallback": {"root", "step", "kind", "detail"},
+    # elastic resharded resume: a checkpoint crossed a topology change
+    # — either the launcher relaunching at the observed member count
+    # (source "relaunch") or a manifest-aware load re-deriving
+    # shardings for a different mesh (source "load")
+    "elastic_reshard": {"old_np", "new_np", "root", "source"},
 }
 
 _EVENTS = collections.deque(maxlen=256)
@@ -678,8 +688,12 @@ class TrainingGuardian:
         from ..distributed import checkpoint as ckpt
         flat = _capture_state(self.model)
         flat["meta.step"] = jnp.asarray(int(step), jnp.int32)
+        # manifest=True: good checkpoints are layout-self-describing, so
+        # a relaunch on different capacity can reshard-restore them
+        # (ISSUE 14) — same commit protocol, one extra json
         path = ckpt.save_checkpoint(flat, self.config.ckpt_root, step,
-                                    keep_last=self.config.keep_ckpts)
+                                    keep_last=self.config.keep_ckpts,
+                                    manifest=True)
         self._have_ckpt = True
         emit("good_checkpoint", step=int(step), path=str(path))
         return path

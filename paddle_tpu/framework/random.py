@@ -63,5 +63,10 @@ def get_rng_state():
     return [_STATE["key"]]
 
 
-def set_rng_state(state):
+def set_rng_state(state, seed=None):
+    """Restore the global key chain.  ``seed`` (optional) restores the
+    recorded originating seed alongside it — a resumed run must not
+    report this process's default seed in later checkpoint manifests."""
     _STATE["key"] = state[0]
+    if seed is not None:
+        _STATE["seed"] = int(seed)

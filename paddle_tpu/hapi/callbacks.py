@@ -159,13 +159,21 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """``epoch_saves=False`` keeps only the end-of-training ``final``
+    save: Model.fit passes it when its step-dir manifest checkpoints
+    (ISSUE 14) own the periodic cadence — writing the same full state
+    twice per epoch in two formats would double checkpoint I/O, and
+    the legacy per-epoch pickles are never retention-swept."""
+
+    def __init__(self, save_freq=1, save_dir=None, epoch_saves=True):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.epoch_saves = epoch_saves
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+        if self.save_dir and self.epoch_saves and \
+                (epoch + 1) % self.save_freq == 0:
             path = os.path.join(self.save_dir, str(epoch))
             self.model.save(path)
 
@@ -330,12 +338,16 @@ class VisualDL(Callback):
 
 def config_callbacks(callbacks=None, model=None, batch_size=None,
                      epochs=None, steps=None, log_freq=2, verbose=2,
-                     save_freq=1, save_dir=None, metrics=None, mode="train"):
+                     save_freq=1, save_dir=None, metrics=None, mode="train",
+                     manifest_saves=False):
     cbks = list(callbacks) if callbacks else []
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
     if not any(isinstance(c, ModelCheckpoint) for c in cbks):
-        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+        # manifest_saves: fit's step-dir manifest checkpoints own the
+        # periodic cadence; the auto-added callback keeps only `final`
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir,
+                                       epoch_saves=not manifest_saves)]
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({"batch_size": batch_size, "epochs": epochs,

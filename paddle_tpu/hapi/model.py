@@ -10,6 +10,7 @@ the static-graph adapter + fused optimizer kernels to get this.  Eager
 """
 import json
 import os
+import re
 import time
 import zlib
 
@@ -23,7 +24,7 @@ from ..framework.core import Tensor
 from ..framework import autograd as _ag
 from ..framework import guardian as _guardian
 from ..framework import preemption as _preemption
-from ..framework.random import rng_scope, next_key
+from ..framework.random import rng_scope, next_key, set_rng_state
 from ..framework.io import save as _save, load as _load
 from ..metric import Metric
 from ..optimizer.lr import LRScheduler
@@ -467,13 +468,7 @@ class _CompiledStepper:
         frozen_vals = [p._value for i, p in enumerate(self.params)
                        if i not in set(self.t_idx)]
         buffer_vals = [b._value for b in self.buffers]
-        if self.opt_state is None:
-            self.opt_state = self.optimizer.init_functional_state(train_vals)
-            if self.plan is not None:
-                o_sh = self._opt_shardings_for(self.opt_state)
-                self.opt_state = [
-                    {k: jax.device_put(v, s[k]) for k, v in st.items()}
-                    for st, s in zip(self.opt_state, o_sh)]
+        self.ensure_opt_state()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = next_key()
         self._last_rng = rng     # guardian attribution replays this key
@@ -579,6 +574,22 @@ class _CompiledStepper:
         _, _, _, grads = self._grad_cache[key](
             train_vals, frozen_vals, buffer_vals, rng, inputs, labels)
         return list(grads)
+
+    def ensure_opt_state(self):
+        """Lazily build (and plan-place) the functional optimizer state
+        — the same init train_step used to do inline, factored out so
+        the resume path can materialize a correctly-sharded template
+        before the first step runs."""
+        if self.opt_state is None:
+            train_vals = [self.params[i]._value for i in self.t_idx]
+            self.opt_state = self.optimizer.init_functional_state(
+                train_vals)
+            if self.plan is not None:
+                o_sh = self._opt_shardings_for(self.opt_state)
+                self.opt_state = [
+                    {k: jax.device_put(v, s[k]) for k, v in st.items()}
+                    for st, s in zip(self.opt_state, o_sh)]
+        return self.opt_state
 
     def sync_opt_state_to_optimizer(self):
         if self.opt_state is not None:
@@ -708,12 +719,162 @@ class Model:
             return [loss], list(metrics.values())
         return [loss]
 
+    # -- elastic resume train state ----------------------------------------
+    def train_state_dict(self):
+        """The full train state as one nested dict for
+        ``distributed/checkpoint``: ``model.<name>`` params + buffers
+        and ``opt.<param_name>.<accumulator>`` functional optimizer
+        state.  Keys are stable param *names*, not layout positions, so
+        the same checkpoint restores onto any topology (the elastic
+        resharded-resume contract).  Eager (``prepare(jit=False)``)
+        models capture the optimizer's materialized accumulators under
+        the ``optimizer.state_dict`` naming (``p.name`` or
+        ``param_<i>``), so a preempted eager run keeps its moments."""
+        state = {"model": dict(self.network.state_dict())}
+        st = self._stepper
+        if st is not None and self._optimizer is not None:
+            st.ensure_opt_state()
+            opt = {}
+            for i, idx in enumerate(st.t_idx):
+                opt[st.param_names[idx]] = dict(st.opt_state[i])
+            state["opt"] = opt
+        elif self._optimizer is not None and \
+                self._optimizer._parameter_list:
+            opt = {}
+            for i, p in enumerate(self._optimizer._parameter_list):
+                acc = self._optimizer._accumulators.get(id(p))
+                if acc:
+                    opt[p.name or f"param_{i}"] = dict(acc)
+            if opt:
+                state["opt"] = opt
+        return state
+
+    def _restore_train_state(self, flat, manifest=None):
+        """Install a flat checkpoint state (from ``restore_latest``)
+        into the live model: params/buffers by name, functional opt
+        state by param name, then step counter, LR-scheduler state and
+        the global RNG stream from the manifest.  Values are assigned
+        directly — they already carry the target shardings the restore
+        derived; a host round-trip here would undo the reshard."""
+        own = self.network.state_dict()
+        matched = 0
+        for name, t in own.items():
+            v = flat.get("model." + name)
+            if v is None:
+                continue
+            if tuple(v.shape) != tuple(t._value.shape):
+                raise ValueError(
+                    f"resume shape mismatch for {name}: checkpoint has "
+                    f"{tuple(v.shape)}, model has {tuple(t._value.shape)}")
+            if v.dtype != t._value.dtype:
+                v = v.astype(t._value.dtype)
+            t._value = v
+            matched += 1
+        if own and flat and not matched:
+            # a checkpoint that shares NO keys with this model (e.g. a
+            # guardian ckpt_root, or a foreign state layout) must fail
+            # loudly — "resumed" with nothing restored would silently
+            # train from random init
+            raise ValueError(
+                "resume checkpoint shares no keys with this model: "
+                f"checkpoint has {sorted(flat)[:3]}..., expected "
+                "'model.<param_name>' entries as written by "
+                "Model.train_state_dict / the fit emergency save")
+        st = self._stepper
+        if st is not None and self._optimizer is not None:
+            st.ensure_opt_state()
+            new_opt = []
+            for i, idx in enumerate(st.t_idx):
+                pname = st.param_names[idx]
+                d = dict(st.opt_state[i])
+                for acc in list(d):
+                    v = flat.get(f"opt.{pname}.{acc}")
+                    if v is not None:
+                        d[acc] = v
+                new_opt.append(d)
+            st.opt_state = new_opt
+        elif self._optimizer is not None and \
+                self._optimizer._parameter_list:
+            # eager path: reinstate materialized accumulators in place
+            for i, p in enumerate(self._optimizer._parameter_list):
+                name = p.name or f"param_{i}"
+                acc = {}
+                for a in self._optimizer._state_names:
+                    v = flat.get(f"opt.{name}.{a}")
+                    if v is not None:
+                        acc[a] = v
+                if acc:
+                    cur = dict(self._optimizer._accumulators.get(id(p))
+                               or {})
+                    cur.update(acc)
+                    self._optimizer._accumulators[id(p)] = cur
+        if manifest:
+            opt_meta = manifest.get("opt") or {}
+            if self._optimizer is not None:
+                self._optimizer._global_step = int(
+                    opt_meta.get("global_step",
+                                 self._optimizer._global_step))
+                lrs = opt_meta.get("lr_scheduler")
+                if lrs and self._optimizer._lr_scheduler is not None:
+                    self._optimizer._lr_scheduler.set_state_dict(lrs)
+            from ..distributed import checkpoint as ckpt
+            key = ckpt.rng_state_from_manifest(manifest)
+            if key is not None:
+                set_rng_state([key],
+                              seed=(manifest.get("rng") or {}).get("seed"))
+        if st is not None:
+            st._refresh_state_refs()
+            st._train_cache.clear()
+            st._grad_cache.clear()
+            st._eval_cache.clear()
+
+    def _resume_from(self, root):
+        """Restore from the newest valid manifest checkpoint under
+        ``root`` onto whatever mesh THIS process came up with (the
+        stepper's plan, or single device), and return the data cursor
+        as ``(start_epoch, skip_steps)``.  An empty root is a fresh
+        start, not an error — the launcher points every (re)launch at
+        the same resume root."""
+        from ..distributed import checkpoint as ckpt
+        st = self._stepper
+        template = self.train_state_dict()
+        mesh = st.plan.mesh if (st is not None and
+                                st.plan is not None) else None
+        try:
+            state, manifest, d = ckpt.restore_latest(
+                root, template=template, mesh=mesh)
+        except FileNotFoundError:
+            print(f"[hapi] resume: no committed checkpoint under "
+                  f"{root}; starting fresh", flush=True)
+            return None
+        self._restore_train_state(state, manifest)
+        if manifest is None and self._optimizer is not None:
+            # torn/missing manifest (the documented degrade): the RNG
+            # stream and data cursor are unrecoverable, but the step
+            # counter must still move FORWARD — the step-dir number IS
+            # the global step for fit checkpoints, and leaving it at 0
+            # would make later periodic saves write step numbers older
+            # than the committed dirs, regressing every future resume
+            # to this stale step
+            m = re.search(r"step_(\d+)$", d)
+            if m:
+                self._optimizer._global_step = int(m.group(1))
+        cursor = (manifest or {}).get("data_cursor") or {}
+        epoch = int(cursor.get("epoch", 0))
+        step = cursor.get("step")
+        gstep = (manifest or {}).get("opt", {}).get("global_step")
+        print(f"[hapi] resumed from {d} (global step {gstep}, epoch "
+              f"{epoch}, step {step})", flush=True)
+        if step == "epoch-end" or step is None:
+            return (epoch + 1, 0) if step == "epoch-end" else (epoch, 0)
+        return epoch, int(step) + 1
+
     # -- fit / evaluate / predict -------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
-            guardian=None):
+            guardian=None, resume=None):
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
@@ -727,7 +888,8 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose,
-            metrics=["loss"] + self._metric_names())
+            metrics=["loss"] + self._metric_names(),
+            manifest_saves=bool(save_dir))
         cbks.on_begin("train")
         self.stop_training = False
         # preemption-aware: SIGTERM sets a flag we poll between steps so a
@@ -752,9 +914,22 @@ class Model:
                 self._stepper.guard_numerics = True
                 self._stepper._train_cache.clear()
         try:
+            # elastic resume (resume=<checkpoint root>): restore step
+            # counter, params, opt state, RNG and data cursor onto the
+            # mesh THIS process came up with — the checkpoint may have
+            # been written at a different np / dp×mp split.  Runs after
+            # guardian setup so the restored state lands in the cleared
+            # step caches.
+            start_epoch = skip_steps = 0
+            if resume:
+                cursor = self._resume_from(resume)
+                if cursor is not None:
+                    start_epoch, skip_steps = cursor
             self._fit_epochs(epochs, eval_freq, save_dir, cbks,
                              train_loader, eval_loader, num_iters,
-                             accumulate_grad_batches, batch_size)
+                             accumulate_grad_batches, batch_size,
+                             start_epoch=start_epoch,
+                             skip_steps=skip_steps, save_freq=save_freq)
         finally:
             if self._guardian is not None:
                 self._guardian.stop()
@@ -769,9 +944,9 @@ class Model:
 
     def _fit_epochs(self, epochs, eval_freq, save_dir, cbks, train_loader,
                     eval_loader, num_iters, accumulate_grad_batches,
-                    batch_size):
+                    batch_size, start_epoch=0, skip_steps=0, save_freq=1):
         logs = {}            # bound even when epochs == 0
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
             self._reset_metrics()
             self.network.train()
@@ -779,6 +954,20 @@ class Model:
             for step, batch in enumerate(train_loader):
                 if num_iters is not None and step >= num_iters:
                     break
+                if epoch == start_epoch and step < skip_steps:
+                    # data cursor: batches the pre-kill run already
+                    # trained on (exact for deterministic loaders; a
+                    # reshuffling loader resumes at the right COUNT).
+                    # SIGTERM during a long replay still honors the
+                    # exit-71 contract promptly — the state equals the
+                    # committed checkpoint we resumed from, so exiting
+                    # without a new save loses nothing.
+                    if _preemption.preempted():
+                        cbks.on_end("train", logs)
+                        raise _preemption.PreemptedExit()
+                    if self.stop_training:
+                        break
+                    continue
                 cbks.on_batch_begin("train", step, logs)
                 ins, labs = self._split_batch(batch)
                 guard = self._guardian
@@ -852,6 +1041,18 @@ class Model:
                 eval_logs = self._run_eval(eval_loader, cbks)
                 logs.update({"eval_" + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
+            # periodic manifest checkpoint at the epoch boundary: a
+            # crash that never gets the SIGTERM grace (OOM kill,
+            # segfault) resumes from here through the same
+            # fit(resume=root) path as the emergency save.  Best
+            # effort: a failed periodic save must not kill training.
+            if save_dir and (epoch + 1) % max(save_freq, 1) == 0:
+                try:
+                    self._save_train_checkpoint(save_dir, epoch,
+                                                "epoch-end")
+                except Exception as e:
+                    print(f"[hapi] periodic checkpoint at epoch "
+                          f"{epoch} failed: {e!r}", flush=True)
             # SIGTERM during the eval pass or at the epoch boundary must
             # not wait for the next train batch to be honored — the
             # platform's kill grace may lapse first
@@ -964,64 +1165,67 @@ class Model:
         return data  # assume iterable of batches
 
     def _emergency_save(self, save_dir, epoch, step):
-        """Final checkpoint on preemption: params + optimizer state under
-        ``save_dir/preempted`` (the resume target for the relaunched
-        worker).  Failures are logged, not raised — exiting with the
-        preemption code matters more than a perfect save."""
+        """Final checkpoint on preemption, written through
+        ``distributed/checkpoint``'s step-dir manifest protocol — ONE
+        format for the emergency save, periodic saves and the elastic
+        resharded resume, so the relaunched worker restores it via
+        ``Model.fit(resume=save_dir)`` on WHATEVER mesh it comes up
+        with.  (The pre-ISSUE-14 ``preempted.pdparams/.pdopt`` sentinel
+        swap is gone: that format carried no layout manifest, so the
+        resharded path could not read it; ``Model.load`` still accepts
+        old checkpoints.)  The step dir is ``step_<global_step>`` under
+        ``save_dir``, COMMITTED-sentinel-committed with the manifest,
+        so a kill mid-save leaves a torn dir the resume path skips.
+        Failures are logged, not raised — exiting with the preemption
+        code matters more than a perfect save."""
         if not save_dir:
             return
         try:
-            # Deliberately NOT built on distributed/checkpoint's step-dir
-            # protocol: hapi checkpoints are pickles of the full
-            # state_dict (optimizer hyperstate and all, the .pdparams
-            # format Model.load speaks), while that module stores flat
-            # array trees with sharding metadata — bridging the two here
-            # would couple the emergency path to reshard semantics it
-            # doesn't need.  The commit IDEA is the same, though: each
-            # save writes a FRESH generation-suffixed pair
-            # (preempted.g<ns>.pdparams/.pdopt), then atomically swaps
-            # the COMMITTED sentinel to point at it.  The sentinel swap
-            # is the single commit point, so the previous pair stays
-            # valid through the entire window — a kill at any moment
-            # leaves either the old checkpoint (sentinel untouched) or
-            # the new one (sentinel swapped); never nothing.  Old
-            # generations are swept only after the swap.  Resume via
-            # ``Model.load(save_dir + "/preempted")``, which follows the
-            # sentinel; scripts should key on ``preempted.COMMITTED``.
-            base = os.path.join(save_dir, "preempted")
-            # the pid lands in the generation token so co-located workers
-            # sharing one save_dir can never sweep each other's pair out
-            # from under the (last-writer-wins) sentinel
-            gen = f"{time.time_ns()}p{os.getpid()}"
-            gbase = f"{base}.g{gen}"
-            self.save(gbase)
-            exts = [ext for ext in (".pdopt", ".pdparams")
-                    if os.path.exists(gbase + ext)]
-            # content identity (size + CRC32), not mtime: a checkpoint
-            # rsync'd/staged to the replacement node must still validate
-            stamp = {"gen": gen,
-                     "files": {ext: _file_stamp(gbase + ext)
-                               for ext in exts}}
-            with open(base + ".COMMITTED.tmp", "w") as f:
-                json.dump(stamp, f)
-            os.replace(base + ".COMMITTED.tmp", base + ".COMMITTED")
-            # sweep THIS process's older generations only — other
-            # workers' files may be what the final sentinel points at
-            mine = f"p{os.getpid()}"
-            for fn in os.listdir(save_dir):
-                if fn.startswith("preempted.g") and \
-                        not fn.startswith(f"preempted.g{gen}"):
-                    token = fn[len("preempted.g"):].split(".", 1)[0]
-                    if token.endswith(mine):
-                        try:
-                            os.remove(os.path.join(save_dir, fn))
-                        except OSError:
-                            pass
+            # _save_train_checkpoint dedups per global step, so SIGTERM
+            # landing right after an epoch-end periodic save does not
+            # burn the kill grace re-serializing identical state
+            path = self._save_train_checkpoint(save_dir, epoch, step)
             print(f"[hapi] preempted at epoch {epoch} step {step}: "
-                  f"emergency checkpoint saved to {gbase}", flush=True)
+                  f"emergency checkpoint saved to {path}", flush=True)
         except Exception as e:
             print(f"[hapi] preempted but emergency save failed: {e!r}",
                   flush=True)
+
+    def _save_train_checkpoint(self, save_dir, epoch, step):
+        """One train-state checkpoint through the step-dir manifest
+        protocol — shared by the periodic epoch-end saves and the
+        preemption emergency save, so a crash WITHOUT the SIGTERM
+        grace (OOM kill, segfault) still resumes from the last epoch
+        boundary via the same ``Model.fit(resume=root)`` path.
+
+        Idempotent per global step: when the newest committed step dir
+        already carries the current step number (the state it holds is
+        this state — the step counter only moves on optimizer updates),
+        the save is skipped rather than re-writing a committed dir."""
+        from ..distributed import checkpoint as ckpt
+        gstep = (self._optimizer._global_step
+                 if self._optimizer is not None else 0)
+        latest = ckpt.latest_checkpoint(save_dir)
+        if latest is not None and os.path.basename(latest) == \
+                f"step_{int(gstep):08d}":
+            return latest
+        state = self.train_state_dict()
+        opt_meta = {"global_step": int(gstep)}
+        if self._optimizer is not None and \
+                self._optimizer._lr_scheduler is not None:
+            opt_meta["lr_scheduler"] = \
+                self._optimizer._lr_scheduler.state_dict()
+        plan = self._stepper.plan if self._stepper is not None else None
+        # rank 0 commits the manifest for the job; other ranks skip the
+        # state walk + key readback for a dict the commit would discard
+        manifest = None
+        if jax.process_index() == 0:
+            manifest = ckpt.build_manifest(
+                state, step=gstep, plan=plan,
+                data_cursor={"epoch": int(epoch), "step": step},
+                opt_meta=opt_meta)
+        return ckpt.save_checkpoint(state, save_dir, step=gstep,
+                                    manifest=manifest)
 
     # -- persistence --------------------------------------------------------
     def save(self, path, training=True):

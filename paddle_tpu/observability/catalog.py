@@ -298,6 +298,17 @@ METRICS = {
         "type": _C, "labels": ("kind",),
         "help": "step dirs skipped while resolving a root: torn "
                 "(uncommitted debris) | corrupt (CRC/restore failure)"},
+    "pt_checkpoint_reshard_total": {
+        "type": _C, "labels": ("kind",),
+        "help": "checkpoints crossing a topology change: load "
+                "(manifest-aware restore onto a different mesh) | "
+                "relaunch (launcher restart at the observed elastic "
+                "member count)"},
+    "pt_checkpoint_reshard_ms": {
+        "type": _H, "labels": (),
+        "help": "wall time of a manifest-aware load whose target "
+                "topology differed from the saving one (reshard-on-"
+                "restore cost)"},
 }
 
 

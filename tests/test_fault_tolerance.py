@@ -407,7 +407,11 @@ class _SigtermAt(cbks_mod.Callback):
 class TestPreemption:
     def test_sigterm_mid_fit_saves_and_resumes(self, tmp_path):
         # acceptance (d): SIGTERM mid-fit → emergency checkpoint + the
-        # restart-with-resume exit code; a fresh model restores bitwise
+        # restart-with-resume exit code; a fresh model restores bitwise.
+        # Since ISSUE 14 the emergency save writes the step-dir layout-
+        # manifest format (ONE format with periodic saves and elastic
+        # resharded resume) and the relaunched worker restores it via
+        # Model.fit(resume=save_dir).
         save_dir = str(tmp_path)
         paddle.seed(3)
         model = _reg_model()
@@ -415,15 +419,21 @@ class TestPreemption:
             model.fit(_batches(), epochs=4, save_dir=save_dir, verbose=0,
                       callbacks=[_SigtermAt(at_step=2)])
         assert exc_info.value.code == preemption.PREEMPTED_EXIT_CODE
-        # the atomically-swapped sentinel is the resume script's signal
-        assert os.path.exists(os.path.join(save_dir,
-                                           "preempted.COMMITTED"))
+        # step-dir committed under the sentinel, with a layout manifest
+        steps = [d for d in os.listdir(save_dir)
+                 if d.startswith("step_")]
+        assert len(steps) == 1
+        step_dir = os.path.join(save_dir, steps[0])
+        assert os.path.exists(os.path.join(step_dir, "COMMITTED"))
+        assert ckpt.load_manifest(step_dir) is not None
 
         at_exit = {k: np.asarray(v._value)
                    for k, v in model.network.state_dict().items()}
+        preemption.reset()                     # the relaunch starts clean
         paddle.seed(4)                         # different init on purpose
         resumed = _reg_model()
-        resumed.load(os.path.join(save_dir, "preempted"))
+        resumed.fit(_batches(), epochs=1, num_iters=0, verbose=0,
+                    resume=save_dir)           # restore only, no steps
         for k, v in resumed.network.state_dict().items():
             np.testing.assert_array_equal(np.asarray(v._value), at_exit[k])
 
@@ -436,29 +446,24 @@ class TestPreemption:
                       verbose=0)
         assert exc_info.value.code == preemption.PREEMPTED_EXIT_CODE
 
-    def test_torn_emergency_pair_detected(self, tmp_path):
-        # a pair contradicting its COMMITTED sentinel (saver killed
-        # between the two renames) must fail loudly, not resume params
-        # with mismatched optimizer moments
+    def test_torn_emergency_save_is_skipped_on_resume(self, tmp_path):
+        # saver killed before the commit sentinel: the torn step dir
+        # must be invisible to resume (skipped loudly, never restored)
         model = _reg_model()
         preemption.request()
+        failpoints.set_failpoint("ckpt.commit_sentinel", "skip")
         with pytest.raises(SystemExit):
             model.fit(_batches(8), epochs=1, save_dir=str(tmp_path),
                       verbose=0)
-        base = os.path.join(str(tmp_path), "preempted")
-        opt_files = [f for f in os.listdir(str(tmp_path))
-                     if f.startswith("preempted.g") and
-                     f.endswith(".pdopt")]
-        assert len(opt_files) == 1
-        with open(os.path.join(str(tmp_path), opt_files[0]),
-                  "r+b") as f:                    # simulate a torn pair
-            f.seek(-1, os.SEEK_END)
-            last = f.read(1)
-            f.seek(-1, os.SEEK_END)
-            f.write(bytes([last[0] ^ 0xFF]))
-        fresh = _reg_model()
-        with pytest.raises(RuntimeError, match="torn"):
-            fresh.load(base)
+        failpoints.clear()
+        steps = [d for d in os.listdir(str(tmp_path))
+                 if d.startswith("step_")]
+        assert steps
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), steps[0], "COMMITTED"))
+        assert ckpt.latest_checkpoint(str(tmp_path)) is None
+        with pytest.raises(FileNotFoundError):
+            ckpt.load_state_dict(str(tmp_path))
 
     def test_exit_code_contract_with_launcher(self):
         # trainer and launcher must agree on the restart-with-resume code

@@ -18,6 +18,12 @@ README lookup.  This wires them into one:
                                               # committed telemetry/
                                               # snapshots (healthy ->
                                               # 'no alerts', exit 0)
+    python tools/ci_check.py --chaos          # + the chaos-marked
+                                              # elastic-resume suite on
+                                              # the 8-device CPU-proxy
+                                              # mesh (opt-in: kill/
+                                              # resume e2e is slower
+                                              # than tier-1 unit tests)
     python tools/ci_check.py --skip-tests     # lint (+gate) only
 
 Stages:
@@ -113,6 +119,24 @@ def run_doctor():
     return rc
 
 
+def run_chaos():
+    """Chaos stage (the ISSUE 14 CI satellite, opt-in): run the
+    `chaos`-marked elastic-resume suite — manifest save/restore across
+    topology changes, the np=8 → np=4 kill/resume e2e, retention/read
+    races — on the 8-virtual-device CPU-proxy mesh the tests/conftest
+    forces."""
+    t0 = _stage("elastic-resume chaos suite (opt-in, 8-dev proxy mesh)")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_elastic_resume.py", "tests/test_fault_tolerance.py",
+           "-q", "-m", "chaos", "--continue-on-collection-errors",
+           "-p", "no:cacheprovider"]
+    print("$", " ".join(shlex.quote(c) for c in cmd), flush=True)
+    rc = subprocess.call(cmd, cwd=REPO)
+    print(f"chaos: {'OK' if rc == 0 else f'FAIL (rc={rc})'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
 def run_bench_gate():
     from paddle_tpu.analysis import runner
     t0 = _stage("bench trajectory gate (opt-in)")
@@ -137,6 +161,9 @@ def main(argv=None):
                     help="also run the doctor smoke over the committed "
                          "telemetry/ snapshots (healthy artifacts must "
                          "parse clean with a 'no alerts' verdict)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also run the chaos-marked elastic-resume "
+                         "tests on the 8-device CPU-proxy mesh")
     ap.add_argument("--skip-tests", action="store_true",
                     help="lint (and gate) only")
     ap.add_argument("--pytest-args", default="",
@@ -153,6 +180,10 @@ def main(argv=None):
             return rc
     if args.bench_gate:
         rc = run_bench_gate()
+        if rc != 0:
+            return rc
+    if args.chaos:
+        rc = run_chaos()
         if rc != 0:
             return rc
     if not args.skip_tests:
