@@ -154,6 +154,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         # only the analysis readout is best-effort — trace/compile
         # errors above are REAL user errors and must propagate
         cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):   # jax 0.4.x: per-device list
+            cost = cost[0] if cost else None
         total = int(cost.get("flops", 0)) if cost else 0
     except Exception:
         total = 0

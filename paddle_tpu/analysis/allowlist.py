@@ -265,6 +265,15 @@ COMPILE_SURFACES = (
     "serving.spec_decode_chunk",
     "speculative.generate",
     "generation.decode",
+    # kernel registry (ops/registry.py *_SURFACE constants): standalone
+    # dispatches of the fused kernels are compilestats-tracked under
+    # these names so the roofline attributes per-kernel FLOPs/bytes;
+    # traced calls inline into the enclosing stepper surface
+    "kernel.flash_fwd",
+    "kernel.flash_fwd_lse",
+    "kernel.flash_bwd",
+    "kernel.xent_fwd",
+    "kernel.xent_bwd",
 )
 
 # Fallback surface labels for jit-cache sites whose module does not

@@ -34,6 +34,25 @@ _RATE_KEYS = ("tokens_per_sec", "images_per_sec",
               "decode_tokens_per_sec", "useful_tokens_per_sec",
               "engine_tokens_per_sec", "mfu", "active_mfu")
 
+# configs whose MFU must be PRESENT in the newest artifact (ISSUE 15):
+# these are the headline optimization targets — the pairwise diff only
+# sees *transitions*, so a config that errored two rounds in a row (or
+# was dropped from the sweep) would otherwise stop being gated at all.
+REQUIRED_MFU_CONFIGS = ("gpt125m_s4096",)
+
+
+def missing_required_mfu(new_rec):
+    """REQUIRED_MFU_CONFIGS entries whose newest record lacks a numeric
+    ``mfu`` (absent config, error/skip, or a non-numeric field)."""
+    configs = (new_rec.get("extra") or {}).get("configs") or {}
+    out = []
+    for name in REQUIRED_MFU_CONFIGS:
+        cfg = configs.get(name)
+        mfu = cfg.get("mfu") if isinstance(cfg, dict) else None
+        if not isinstance(mfu, (int, float)) or isinstance(mfu, bool):
+            out.append(name)
+    return out
+
 
 def bench_files(root):
     """BENCH_r*.json under ``root``, oldest first (numeric round
@@ -133,17 +152,35 @@ class BenchComparePass:
 
     def run(self, ctx):
         files = bench_files(ctx.root)
-        if len(files) < 2:
+        if not files:
             return []
-        old_p, new_p = files[-2], files[-1]
-        rel = os.path.relpath(new_p, ctx.root).replace(os.sep, "/")
+        rel = os.path.relpath(files[-1], ctx.root).replace(os.sep, "/")
         try:
-            rows = compare(load_bench(old_p), load_bench(new_p))
+            new_rec = load_bench(files[-1])
         except (OSError, ValueError) as e:
             return [Finding(self.name, rel, 1, "<bench>",
                             "bench-unreadable",
-                            f"cannot diff bench artifacts: {e}", "parse")]
+                            f"cannot read bench artifact: {e}", "parse")]
         findings = []
+        # presence gate: required-MFU configs must carry a number in the
+        # NEWEST artifact regardless of what older rounds reported
+        for name in missing_required_mfu(new_rec):
+            findings.append(Finding(
+                self.name, rel, 1, "<bench>", "bench-coverage",
+                f"configs.{name}.mfu: required config has no numeric "
+                "MFU in the newest artifact (missing, errored or "
+                "skipped) — the long-context target is ungated",
+                f"configs.{name}.mfu"))
+        if len(files) < 2:
+            return sorted(findings, key=Finding.sort_key)
+        old_p = files[-2]
+        try:
+            rows = compare(load_bench(old_p), new_rec)
+        except (OSError, ValueError) as e:
+            return findings + [Finding(self.name, rel, 1, "<bench>",
+                                       "bench-unreadable",
+                                       f"cannot diff bench artifacts: {e}",
+                                       "parse")]
         for row in rows:
             if not row["regressed"]:
                 continue

@@ -11,6 +11,7 @@ toolchain is absent (``PADDLE_TPU_DISABLE_NATIVE=1`` forces that).
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
@@ -28,8 +29,13 @@ def _build():
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
     srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
     tmp = _SO + f".tmp.{os.getpid()}"
+    # -lrt: glibc < 2.34 keeps shm_open/shm_unlink in librt — without
+    # it the link succeeds (shared libs may carry undefined symbols)
+    # but dlopen fails at load time on older runtimes.  Linux-only:
+    # Darwin/BSD have no librt and the flag breaks the link there.
+    librt = ["-lrt"] if sys.platform.startswith("linux") else []
     cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-pthread",
-           "-o", tmp] + srcs
+           "-o", tmp] + srcs + librt
     subprocess.run(cmd, check=True, capture_output=True, cwd=_CSRC)
     os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
 
@@ -110,7 +116,15 @@ def get_lib():
         try:
             if _stale():
                 _build()
-            _lib = _declare(ctypes.CDLL(_SO))
+            try:
+                _lib = _declare(ctypes.CDLL(_SO))
+            except OSError:
+                # a prebuilt .so from another runtime can be loadable
+                # there but not here (e.g. linked without -lrt on a
+                # glibc that still needs it for shm_open) — rebuild
+                # once against the local toolchain and retry
+                _build()
+                _lib = _declare(ctypes.CDLL(_SO))
         except Exception:
             _lib = None
         return _lib

@@ -279,15 +279,15 @@ class _CompiledStepper:
                 new_opt = jax.tree_util.tree_map(sel, new_opt, opt_state)
                 new_buf = [sel(n, o) for n, o in zip(new_buf,
                                                      buffer_vals)]
-                return loss, out_vals, new_train, new_buf, new_opt, ok
-            return loss, out_vals, new_train, new_buf, new_opt
+                return loss, new_train, new_buf, new_opt, out_vals, ok
+            return loss, new_train, new_buf, new_opt, out_vals
 
         rep = P()
         dat = P(axis)
         sharded = shard_map(
             shard_step, mesh=mesh,
             in_specs=(rep, rep, rep, rep, rep, rep, dat, dat),
-            out_specs=(rep, dat, rep, rep, rep) +
+            out_specs=(rep, rep, rep, rep, dat) +
                       ((rep,) if guard else ()),
             check_rep=False)
         # batch-divisibility is validated host-side in train_step (the
@@ -296,6 +296,16 @@ class _CompiledStepper:
 
     @jit_surface
     def _build_train(self, n_in, n_lab):
+        # OUTPUT ORDER CONTRACT: the updated state trees (new_train /
+        # new_buf / new_opt) come BEFORE out_vals.  XLA pairs donated
+        # inputs to outputs greedily in output order by GLOBAL
+        # shape+dtype; with activations first, a batch-sharded logits
+        # output whose global shape happens to equal a replicated
+        # param's stole that param's donated buffer and the executable
+        # aborted at launch on the local-shard size mismatch (jax
+        # 0.4.x; the PR 14 "donation aliasing" quirk).  State-first
+        # ordering pairs every donated leaf with its own updated
+        # output — same sharding, always aliasable.
         if self._use_grad_comm():
             return self._build_train_comm(n_in, n_lab)
         opt = self.optimizer
@@ -349,8 +359,8 @@ class _CompiledStepper:
                                                        train_vals)]
                 new_opt = jax.tree_util.tree_map(sel, new_opt, opt_state)
                 new_buf = [sel(n, o) for n, o in zip(new_buf, buffer_vals)]
-                return loss, out_vals, new_train, new_buf, new_opt, ok
-            return loss, out_vals, new_train, new_buf, new_opt
+                return loss, new_train, new_buf, new_opt, out_vals, ok
+            return loss, new_train, new_buf, new_opt, out_vals
 
         if self.plan is None:
             return jax.jit(step, donate_argnums=(0, 2, 3))
@@ -361,7 +371,7 @@ class _CompiledStepper:
         b_sh = list(self._buffer_shardings)
         o_sh = self._opt_shardings_for(self.opt_state)
         rep = plan.replicated()
-        out_sh = (rep, None, t_sh, b_sh, o_sh) + ((rep,) if guard else ())
+        out_sh = (rep, t_sh, b_sh, o_sh, None) + ((rep,) if guard else ())
         return jax.jit(
             step, donate_argnums=(0, 2, 3),
             in_shardings=(t_sh, f_sh, b_sh, o_sh, rep, rep,
@@ -485,10 +495,10 @@ class _CompiledStepper:
                                          buffer_vals, self.opt_state, lr,
                                          rng, inputs, labels)
             if self.guard_numerics:
-                loss, out_vals, new_train, new_buf, new_opt, ok = out
+                loss, new_train, new_buf, new_opt, out_vals, ok = out
                 self.last_ok = ok
             else:
-                loss, out_vals, new_train, new_buf, new_opt = out
+                loss, new_train, new_buf, new_opt, out_vals = out
                 self.last_ok = None
             for i, v in zip(self.t_idx, new_train):
                 self.params[i]._value = v

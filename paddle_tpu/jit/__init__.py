@@ -21,6 +21,7 @@ import pickle
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import export as _jax_export
 
 from ..framework.core import Tensor
 from ..framework import autograd as _ag
@@ -422,7 +423,7 @@ def save(layer, path, input_spec=None, **configs):
     # relations when the model combines inputs along the batch axis).
     tie_batch = bool(configs.pop("tie_batch_dims", False))
     n_sym = 0
-    scope = jax.export.SymbolicScope()   # one scope for every input
+    scope = _jax_export.SymbolicScope()   # one scope for every input
     arg_shapes = []
     for spec_idx, s in enumerate(specs):
         dims = []
@@ -439,7 +440,7 @@ def save(layer, path, input_spec=None, **configs):
             else:
                 dims.append(str(int(d)))
         if has_sym:
-            shape = jax.export.symbolic_shape(
+            shape = _jax_export.symbolic_shape(
                 "(" + ", ".join(dims) + ")", scope=scope)
         else:
             shape = tuple(int(d) for d in s.shape)
@@ -451,7 +452,7 @@ def save(layer, path, input_spec=None, **configs):
     # the human-inspectable "program" like the reference's protobuf.
     # platforms: lower for both so a TPU-saved artifact loads on CPU hosts
     # (dev/CI) and vice versa.
-    exported = jax.export.export(jax.jit(pure),
+    exported = _jax_export.export(jax.jit(pure),
                                  platforms=("cpu", "tpu"))(
         pv, bv, *arg_shapes)
     stablehlo = exported.mlir_module()
@@ -509,7 +510,7 @@ def load(path, params_path=None, **configs):
         # params stored in a different precision (e.g. a bf16-converted
         # artifact — inference.convert_to_mixed_precision) cast back here
         try:
-            avals = jax.export.deserialize(bytearray(blob)).in_avals
+            avals = _jax_export.deserialize(bytearray(blob)).in_avals
             flat = list(avals)
             n_p = len(params)
             params = [p if p.dtype == flat[i].dtype
@@ -524,7 +525,7 @@ def load(path, params_path=None, **configs):
         raise ValueError(
             f"{path}.pdiparams has no serialized executable — re-save the "
             "model with this version's jit.save")
-    exported = jax.export.deserialize(bytearray(blob))
+    exported = _jax_export.deserialize(bytearray(blob))
 
     def compiled_forward(*arg_vals):
         return exported.call(params, buffers, *arg_vals)

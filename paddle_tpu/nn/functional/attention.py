@@ -10,6 +10,7 @@ short sequences, on CPU, and for the backward (recompute-based pullback,
 the flash-bwd recompute strategy expressed at the XLA level).
 """
 import math
+from collections import namedtuple
 from functools import partial
 
 import jax
@@ -17,13 +18,19 @@ import jax.numpy as jnp
 
 from ...framework.core import Tensor
 from ...framework.autograd import call_op
+from ...ops import registry as kreg
+from ...ops.pallas import flash_attention as _fa
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdp_kernel", "sparse_attention"]
 
 # Pallas kernel pays off past this seq length on TPU (short seqs fit XLA's
-# fused softmax just fine and avoid kernel-launch overhead)
+# fused softmax just fine and avoid kernel-launch overhead); forcing the
+# impl (sdp_kernel / PADDLE_TPU_ATTN_IMPL=flash) skips the floor
 _PALLAS_MIN_SEQ = 1024
+# sequences pad up to this granule so S need not be a multiple of 512
+# (256 divides every block pair the autotune table can answer)
+_PAD_GRANULE = 256
 
 
 def _xla_attention(q, k, v, mask=None, causal=False, scale=None,
@@ -54,44 +61,118 @@ def _xla_attention(q, k, v, mask=None, causal=False, scale=None,
     return o.astype(q.dtype)
 
 
-def _use_pallas(S, scale):
-    # pallas kernel path: default scale only (it bakes 1/sqrt(D));
-    # PADDLE_TPU_ATTN_IMPL=dense|flash overrides for A/B tuning
-    import os
-    ov = os.environ.get("PADDLE_TPU_ATTN_IMPL")
-    if ov == "dense":
-        return False
-    if ov == "flash":
-        return scale is None and S % 512 == 0 \
-            and jax.default_backend() == "tpu"
-    return (scale is None and S >= _PALLAS_MIN_SEQ and S % 512 == 0 and
-            jax.default_backend() == "tpu")
+# -- kernel-registry dispatch ----------------------------------------------
+#
+# The registry owns the platform/override/interpret policy; the
+# constraint ladder below encodes what the Pallas kernels can express
+# (docs/kernels.md "Dispatch rules" is the table form of this code).
+# The XLA path is registered as the everywhere-fallback with identical
+# math.
+
+kreg.register("attention", "pallas", _fa.flash_attention_fwd,
+              platforms=("tpu",))
+kreg.register("attention", "xla", _xla_attention, platforms=("*",))
+
+# standalone (eager) flash dispatches are compilestats-tracked under the
+# kernel.* surfaces so `report --roofline` attributes per-kernel
+# FLOPs/bytes; traced calls inline into the caller's surface
+_flash_fwd = kreg.TrackedKernel(_fa.flash_attention_fwd,
+                                kreg.FLASH_FWD_SURFACE)
+_flash_fwd_lse = kreg.TrackedKernel(_fa.flash_attention_fwd_lse,
+                                    kreg.FLASH_FWD_LSE_SURFACE)
+_flash_bwd = kreg.TrackedKernel(_fa.flash_attention_bwd,
+                                kreg.FLASH_BWD_SURFACE)
+
+_Flash = namedtuple("_Flash", ["use", "interpret"])
+_NO_FLASH = _Flash(False, False)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _attention_core(q, k, v, causal, scale):
-    from ...ops.pallas.flash_attention import flash_attention_fwd
-    if _use_pallas(q.shape[1], scale):
-        return flash_attention_fwd(q, k, v, causal=causal)
+def _select_flash(S, Sk, D, causal, has_mask, mask_is_keybias, scale,
+                  dropout_p=0.0):
+    """The dispatch decision for one attention call, made on static
+    shapes at trace time.  Platform/override policy comes from the
+    registry; the constraint ladder maps what the kernels support, and
+    every constraint fallback is booked in pt_kernel_fallbacks_total
+    (a silently dense-running config must be visible in telemetry)."""
+    sel = kreg.choose("attention")
+    if sel.impl != "pallas":
+        return _NO_FLASH
+    pad = (-S) % _PAD_GRANULE
+    spad = S + pad
+    need_bias = bool(has_mask and mask_is_keybias) or \
+        bool(pad and not causal)
+    reason = None
+    if dropout_p and dropout_p > 0.0:
+        reason = "dropout"
+    elif scale is not None:
+        reason = "scale"
+    elif Sk != S:
+        reason = "cross-seq"
+    elif has_mask and not mask_is_keybias:
+        reason = "mask"
+    elif need_bias and spad * D > _fa._MH_BWD_MAX_SD:
+        # the key-bias path lives in the head-folded kernels; past their
+        # VMEM cap a masked (or padded non-causal) shape has no kernel
+        reason = "mask-large" if has_mask else "pad-noncausal"
+    elif not sel.forced and S < _PALLAS_MIN_SEQ:
+        reason = "short-seq"
+    if reason is not None:
+        kreg.record_fallback("attention", reason)
+        return _NO_FLASH
+    return _Flash(True, sel.interpret)
+
+
+def _pad_qkv(q, k, v, bias, causal):
+    """Pad S up to the 256 granule.  Causal needs no key masking (real
+    queries never attend the appended keys); non-causal folds the pad
+    drop into the additive key bias.  Returns (q, k, v, bias, S)."""
+    S = q.shape[1]
+    pad = (-S) % _PAD_GRANULE
+    if not pad:
+        return q, k, v, bias, S
+    pw = ((0, 0), (0, pad), (0, 0), (0, 0))
+    q, k, v = jnp.pad(q, pw), jnp.pad(k, pw), jnp.pad(v, pw)
+    if not causal or bias is not None:
+        B = q.shape[0]
+        if bias is None:
+            bias = jnp.zeros((B, S), jnp.float32)
+        bias = jnp.pad(bias.astype(jnp.float32), ((0, 0), (0, pad)),
+                       constant_values=-1e30)
+    return q, k, v, bias, S
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _attention_core(q, k, v, causal, scale, flash):
+    if flash.use:
+        qp, kp, vp, bias, S = _pad_qkv(q, k, v, None, causal)
+        o = _flash_fwd(qp, kp, vp, bias, causal=causal,
+                       interpret=flash.interpret)
+        return o[:, :S] if o.shape[1] != S else o
     return _xla_attention(q, k, v, causal=causal, scale=scale)
 
 
-def _attn_fwd(q, k, v, causal, scale):
-    from ...ops.pallas.flash_attention import flash_attention_fwd_lse
-    if _use_pallas(q.shape[1], scale):
-        o, lse = flash_attention_fwd_lse(q, k, v, causal=causal)
-        return o, (q, k, v, o, lse)
+def _attn_fwd(q, k, v, causal, scale, flash):
+    if flash.use:
+        qp, kp, vp, bias, S = _pad_qkv(q, k, v, None, causal)
+        o, lse = _flash_fwd_lse(qp, kp, vp, bias, causal=causal,
+                                interpret=flash.interpret)
+        return (o[:, :S] if o.shape[1] != S else o), \
+            (qp, kp, vp, bias, o, lse)
     return _xla_attention(q, k, v, causal=causal, scale=scale), \
-        (q, k, v, None, None)
+        (q, k, v, None, None, None)
 
 
-def _attn_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
-    if o is not None:
+def _attn_bwd(causal, scale, flash, res, g):
+    q, k, v, bias, o, lse = res
+    if lse is not None:
         # pallas flash backward: recompute P blockwise from saved lse —
         # no S×S materialization (the reference's flash_attn_bwd)
-        from ...ops.pallas.flash_attention import flash_attention_bwd
-        return flash_attention_bwd(q, k, v, o, lse, g, causal=causal)
+        S = g.shape[1]
+        if o.shape[1] != S:   # padded: pad the cotangent, slice grads
+            g = jnp.pad(g, ((0, 0), (0, o.shape[1] - S), (0, 0), (0, 0)))
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse, g, bias, causal=causal,
+                                interpret=flash.interpret)
+        return dq[:, :S], dk[:, :S], dv[:, :S]
     # recompute-based pullback at the XLA level (flash-bwd strategy)
     _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
         q_, k_, v_, causal=causal, scale=scale), q, k, v)
@@ -101,22 +182,92 @@ def _attn_bwd(causal, scale, res, g):
 _attention_core.defvjp(_attn_fwd, _attn_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _attention_core_bias(q, k, v, bias, causal, flash):
+    """Masked flash path: ``bias`` is a (B, Sk) additive per-key mask
+    (the reduced (B, 1, 1, Sk) attention mask).  Only entered when
+    ``_select_flash`` accepted the shape; the mask gets zero cotangent
+    (masks are data, matching the dense path's detached-mask
+    contract)."""
+    qp, kp, vp, bp, S = _pad_qkv(q, k, v, bias, causal)
+    o = _flash_fwd(qp, kp, vp, bp, causal=causal,
+                   interpret=flash.interpret)
+    return o[:, :S] if o.shape[1] != S else o
+
+
+def _attn_bias_fwd(q, k, v, bias, causal, flash):
+    qp, kp, vp, bp, S = _pad_qkv(q, k, v, bias, causal)
+    o, lse = _flash_fwd_lse(qp, kp, vp, bp, causal=causal,
+                            interpret=flash.interpret)
+    return (o[:, :S] if o.shape[1] != S else o), \
+        (qp, kp, vp, bp, o, lse, bias)
+
+
+def _attn_bias_bwd(causal, flash, res, g):
+    q, k, v, bp, o, lse, bias0 = res
+    S = g.shape[1]
+    if o.shape[1] != S:
+        g = jnp.pad(g, ((0, 0), (0, o.shape[1] - S), (0, 0), (0, 0)))
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, g, bp, causal=causal,
+                            interpret=flash.interpret)
+    return dq[:, :S], dk[:, :S], dv[:, :S], jnp.zeros_like(bias0)
+
+
+_attention_core_bias.defvjp(_attn_bias_fwd, _attn_bias_bwd)
+
+
+def _as_key_bias(m, B, Sk):
+    """Reduce an additive attention mask to the kernels' per-key (B, Sk)
+    bias when it is constant over heads and queries — the key-padding
+    shape (B|1, 1, 1, Sk).  Returns None when the mask genuinely varies
+    per query/head (the XLA path keeps full generality)."""
+    if m is None:
+        return None
+    shape = tuple(getattr(m, "shape", ()))
+    if len(shape) == 4 and shape[1] == 1 and shape[2] == 1 \
+            and shape[3] == Sk and shape[0] in (1, B):
+        return lambda mv: jnp.broadcast_to(
+            mv[:, 0, 0, :].astype(jnp.float32), (B, Sk))
+    return None
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    """paddle.nn.functional.scaled_dot_product_attention — (B, S, H, D)."""
+    """paddle.nn.functional.scaled_dot_product_attention — (B, S, H, D).
+
+    Dispatch (ops/registry.py policy + the kernel constraint ladder):
+    TPU (or interpret mode) routes through the Pallas flash kernels —
+    including masked calls whose mask reduces to a per-key bias (the
+    key-padding shape) and sequences that are not a multiple of 512
+    (padded to the 256 granule) — everything else through the XLA
+    attention with identical math."""
     from ...framework.random import next_key
     tensors = [query, key, value]
     q, k, v = [t if isinstance(t, Tensor) else Tensor(t) for t in tensors]
-    if attn_mask is None and dropout_p == 0.0:
-        sc = None
-        return call_op(lambda a, b, c: _attention_core(
-            a, b, c, bool(is_causal), sc), q, k, v)
-    rng = next_key() if (dropout_p > 0.0 and training) else None
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    causal = bool(is_causal)
+    drop = dropout_p if training else 0.0
     m = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+    reduce = _as_key_bias(m, B, Sk) if attn_mask is not None else None
+    flash = _select_flash(S, Sk, D, causal,
+                          has_mask=attn_mask is not None,
+                          mask_is_keybias=reduce is not None,
+                          scale=None, dropout_p=drop)
+    if flash.use:
+        if attn_mask is None:
+            return call_op(lambda a, b, c: _attention_core(
+                a, b, c, causal, None, flash), q, k, v)
+        return call_op(lambda a, b, c: _attention_core_bias(
+            a, b, c, reduce(m), causal, flash), q, k, v)
+    if attn_mask is None and drop == 0.0:
+        return call_op(lambda a, b, c: _attention_core(
+            a, b, c, causal, None, _NO_FLASH), q, k, v)
+    rng = next_key() if (drop > 0.0) else None
     return call_op(lambda a, b, c: _xla_attention(
-        a, b, c, mask=m, causal=bool(is_causal),
-        dropout_p=dropout_p if training else 0.0, key=rng), q, k, v)
+        a, b, c, mask=m, causal=causal,
+        dropout_p=drop, key=rng), q, k, v)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -166,16 +317,29 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
 
 class sdp_kernel:
-    """Context manager selecting attention backends (torch-compat shim the
-    reference also exposes); on TPU the dispatch is automatic."""
+    """Context manager selecting attention backends (torch-compat shim
+    the reference also exposes), now wired to the kernel registry:
+    ``enable_flash=False`` forces the XLA path, ``enable_math=False``
+    (with flash enabled) forces the Pallas kernel — the same override
+    rail as ``PADDLE_TPU_ATTN_IMPL``/``PADDLE_TPU_KERNEL_ATTENTION``.
+    With both enabled (the default) the dispatch stays automatic."""
 
-    def __init__(self, **kwargs):
-        pass
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True, **kwargs):
+        self._force = None
+        if not enable_flash:
+            self._force = kreg.force("attention", "xla")
+        elif not enable_math:
+            self._force = kreg.force("attention", "pallas")
 
     def __enter__(self):
+        if self._force is not None:
+            self._force.__enter__()
         return self
 
     def __exit__(self, *exc):
+        if self._force is not None:
+            self._force.__exit__(*exc)
         return False
 
 

@@ -223,6 +223,26 @@ METRICS = {
                 "recorded where a latency-clean measurement exists "
                 "(bench steady-state loops) — the roofline join's "
                 "measured half"},
+    # -- kernel registry (ops/registry.py) --------------------------------
+    "pt_kernel_selects_total": {
+        "type": _C, "labels": ("kernel", "impl"),
+        "help": "kernel-registry selections by implementation (one per "
+                "dispatch decision: trace time for jitted surfaces, "
+                "per call for eager dispatches)"},
+    "pt_kernel_fallbacks_total": {
+        "type": _C, "labels": ("kernel", "reason"),
+        "help": "calls the platform policy routed to a Pallas impl but "
+                "a kernel contract sent to the XLA path instead: "
+                "mask | scale | dropout | cross-seq | short-seq | "
+                "pad-noncausal | mask-large | unaligned-vocab"},
+    "pt_kernel_autotune_runs_total": {
+        "type": _C, "labels": ("kernel",),
+        "help": "block-size micro-sweeps executed (autotune_flash; "
+                "winners persist to the autotune cache)"},
+    "pt_kernel_autotune_best_ms": {
+        "type": _G, "labels": ("kernel", "key"),
+        "help": "median dispatch ms of the winning block config for "
+                "one (S, D, heads) autotune key"},
     # -- request tracing (observability/tracing.py) -----------------------
     "pt_trace_requests_total": {
         "type": _C, "labels": (),

@@ -224,6 +224,28 @@ class CompiledSurface:
         entry = self._cache.get(sig)
         if entry is None:
             entry = self._compile(sig, args)
+            if entry is not self._fn:
+                try:
+                    return entry(*args)
+                except Exception:
+                    # the AOT executable rejected its very FIRST
+                    # dispatch (jax 0.4.x aborts AOT calls whose
+                    # donation aliasing pairs same-sized-but-differently
+                    # -shaped buffers the plain jit path accepts):
+                    # permanently fall back to the plain jit for this
+                    # signature.  Launch-time rejections raise before
+                    # donated buffers are consumed, so the retry is
+                    # safe there; a MID-execution failure (device OOM
+                    # past the launch checks) may already have eaten
+                    # donated inputs — retrying would mask the real
+                    # error with "Array has been deleted", so re-raise.
+                    import jax as _jax
+                    if any(getattr(a, "is_deleted", lambda: False)()
+                           for a in _jax.tree_util.tree_leaves(args)):
+                        raise
+                    with self._lock:
+                        self._cache[sig] = self._fn
+                    return self._fn(*args)
         return entry(*args)
 
     def _compile(self, sig, args):

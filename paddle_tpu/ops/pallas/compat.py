@@ -1,0 +1,16 @@
+"""jax-API drift shims for the Pallas TPU kernel layer.
+
+The TPU compiler-params dataclass has been renamed across jax releases:
+``pltpu.CompilerParams`` (newest) vs ``pltpu.TPUCompilerParams``
+(jax 0.4.3x, the pinned toolchain).  Every kernel resolves it through
+this one alias so a jax upgrade is a one-line (zero-line) change
+instead of the nine dead call sites this shim originally un-broke
+(ISSUE 15: 6+ tier-1 failures were exactly this class).
+"""
+from jax.experimental.pallas import tpu as pltpu
+
+TPUCompilerParams = getattr(pltpu, "TPUCompilerParams", None)
+if TPUCompilerParams is None:  # pragma: no cover - newer jax
+    TPUCompilerParams = pltpu.CompilerParams
+
+__all__ = ["TPUCompilerParams"]

@@ -13,37 +13,21 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .compat import TPUCompilerParams
+
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 _LANES = 128   # lse/delta carry a broadcast lane dim (TPU tiling rule)
 
 
-def _fwd_blocks(S):
-    """Measured on v5e (r3 autotune): at S>=4096 streaming k/v in 1024-
-    wide blocks cuts fwd time ~20% (fewer loop trips); below that
-    256/256 wins for the head-folded kernel (smaller unrolled stack,
-    better VPU/MXU overlap).  Blocks must DIVIDE S — the kernels size
+def _fwd_blocks(S, D=64, heads=None):
+    """(block_q, block_k) from the kernel registry's autotune table
+    (ops/registry.py): env override > cached micro-sweep winner >
+    measured static heuristic.  Blocks must DIVIDE S — the kernels size
     their loops as S // block (S=4608 with bk=1024 would silently skip
-    the last 512 keys).  PADDLE_TPU_FLASH_BLOCKS="bq,bk" overrides for
-    model-level A/B tuning."""
-    import os
-    ov = os.environ.get("PADDLE_TPU_FLASH_BLOCKS")
-    if ov:
-        bq, bk = (int(t) for t in ov.split(","))
-        if S % bq == 0 and S % bk == 0:
-            return (bq, bk)
-        import warnings
-        warnings.warn(
-            f"PADDLE_TPU_FLASH_BLOCKS={ov} ignored: blocks must divide "
-            f"S={S} (measurement would be attributed to the wrong "
-            "config)", RuntimeWarning)
-    if S >= 4096 and S % 512 == 0:
-        # r4 scan autotune: (512,512) 6.97ms vs (512,1024) 7.36ms at
-        # S=4096 (the r3 pick was taken under ~5ms dispatch noise)
-        return (512, 512)
-    if S % 256 == 0:
-        return (256, 256)
-    return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    the last 512 keys) — and the registry guarantees that."""
+    from ..registry import flash_blocks
+    return flash_blocks(S, D, heads)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
@@ -88,9 +72,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                              "interpret"))
 def _flash_bhsd(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
-                block_k=DEFAULT_BLOCK_K):
+                block_k=DEFAULT_BLOCK_K, interpret=False):
     """q,k,v: (BH, S, D) — flattened batch*heads."""
     BH, S, D = q.shape
     block_q = min(block_q, S)
@@ -109,6 +94,7 @@ def _flash_bhsd(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
     )(q, k, v)
 
 
@@ -396,7 +382,7 @@ def _flash_bhsd_bwd_fused(q, k, v, o, lse, do, causal=False,
         ],
         scratch_shapes=[pltpu.VMEM((S, D), jnp.float32),
                         pltpu.VMEM((S, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, o, lse[:, None, :].astype(jnp.float32))
@@ -462,13 +448,18 @@ def _flash_bhsd_bwd(q, k, v, o, lse, do, causal=False,
 # amortizes that overhead ~HB*nq-fold.
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                         causal, block_q, block_k, seq_len, with_lse):
+def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                         scale, causal, block_q, block_k, seq_len,
+                         with_lse):
     """lse is stored UNBROADCAST as (hb, 1, S) — the (S, LANES) lane-
     broadcast layout cost a 128x-inflated HBM write (151MB per layer at
     BH=288/S=1024, measured ~24% of bwd time); the (block_q,) lane
     vector <-> (block_q, 1) column relayout inside the kernel is far
-    cheaper."""
+    cheaper.  ``bias_ref`` (optional, same slim (hb, 1, S) layout) is an
+    additive per-KEY bias broadcast over queries — the key-padding /
+    attention-mask path (0 keep, -1e30 drop, or any additive values
+    constant over heads and queries); every row must keep >=1 live key
+    (the registry's mask contract, docs/kernels.md)."""
     hb = q_ref.shape[0]
     d = q_ref.shape[2]
     nq = seq_len // block_q
@@ -487,6 +478,8 @@ def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
                 k = k_ref[h, pl.ds(k_lo, block_k), :]
                 v = v_ref[h, pl.ds(k_lo, block_k), :]
                 s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+                if bias_ref is not None:
+                    s = s + bias_ref[h, 0, pl.ds(k_lo, block_k)][None, :]
                 if causal and k_lo + block_k - 1 > q_lo:   # straddles diag
                     q_idx = q_lo + jax.lax.broadcasted_iota(
                         jnp.int32, (block_q, 1), 0)
@@ -510,14 +503,16 @@ def _flash_fwd_mh_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
 
 def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
-                         causal, block_q, block_k, seq_len):
+                         bias_ref, dq_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                         *, scale, causal, block_q, block_k, seq_len):
     """One-pass backward, HB heads per program, static loops; dk/dv
     accumulate in fp32 VMEM scratch within the program (no cross-program
     state — each program owns its heads outright).  delta = rowsum(do*o)
     is computed in-kernel from the o block and lse rides the slim
     (hb, 1, S) layout — the old precomputed (S, LANES) broadcasts were
-    ~300MB/layer of pure HBM overhead (measured 24% of bwd time)."""
+    ~300MB/layer of pure HBM overhead (measured 24% of bwd time).
+    ``bias_ref`` (optional, slim layout) replays the forward's additive
+    per-key bias so the recomputed P matches bitwise."""
     hb = q_ref.shape[0]
     d = q_ref.shape[2]
     nq = seq_len // block_q
@@ -541,6 +536,8 @@ def _flash_bwd_mh_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 k = k_ref[h, pl.ds(k_lo, block_k), :]
                 v = v_ref[h, pl.ds(k_lo, block_k), :]
                 s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+                if bias_ref is not None:
+                    s = s + bias_ref[h, 0, pl.ds(k_lo, block_k)][None, :]
                 if causal and k_lo + block_k - 1 > q_lo:
                     q_idx = q_lo + jax.lax.broadcasted_iota(
                         jnp.int32, (block_q, 1), 0)
@@ -578,9 +575,11 @@ def _pick_hb(BH, S, D, n_bufs, budget=2 * 1024 * 1024):
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                               "with_lse", "interpret", "hb"))
-def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
-                       block_k=DEFAULT_BLOCK_K, with_lse=True,
-                       interpret=False, hb=None):
+def _flash_bhsd_fwd_mh(q, k, v, bias=None, causal=False,
+                       block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                       with_lse=True, interpret=False, hb=None):
+    """``bias``: optional (BH, 1, S) f32 additive per-key bias (the
+    attention-mask path), rides the same slim layout as lse."""
     BH, S, D = q.shape
     block_q = min(block_q, S)
     block_k = min(block_k, S)
@@ -592,25 +591,40 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
         # kernel tables must be validated at model level
         hb = _pick_hb(BH, S, D, n_bufs=4, budget=1280 * 1024)  # hb=2 best at S=1024 (measured)
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
+    spec_l = pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0))
     out_specs = [spec]
     out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype)]
     if with_lse:
-        out_specs.append(pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0)))
+        out_specs.append(spec_l)
         out_shape.append(jax.ShapeDtypeStruct((BH, 1, S), jnp.float32))
     kernel = functools.partial(_flash_fwd_mh_kernel, scale=scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, seq_len=S, with_lse=with_lse)
-    if not with_lse:
-        kernel_nl = kernel
-        kernel = lambda qr, kr, vr, orf: kernel_nl(qr, kr, vr, orf, None)
+    kern = kernel
+    with_bias = bias is not None
+    if with_bias:
+        in_specs = [spec, spec, spec, spec_l]
+        ins = (q, k, v, bias.astype(jnp.float32))
+        if not with_lse:
+            kern = lambda qr, kr, vr, br, orf: kernel(qr, kr, vr, br, orf,
+                                                      None)
+    else:
+        in_specs = [spec, spec, spec]
+        ins = (q, k, v)
+        if with_lse:
+            kern = lambda qr, kr, vr, orf, lr: kernel(qr, kr, vr, None,
+                                                      orf, lr)
+        else:
+            kern = lambda qr, kr, vr, orf: kernel(qr, kr, vr, None, orf,
+                                                  None)
     out = pl.pallas_call(
-        kernel,
+        kern,
         grid=(BH // hb,),
-        in_specs=[spec, spec, spec],
+        in_specs=in_specs,
         out_specs=out_specs if with_lse else out_specs[0],
         out_shape=out_shape if with_lse else out_shape[0],
         interpret=interpret,
-    )(q, k, v)
+    )(*ins)
     if with_lse:
         return out[0], out[1][:, 0, :]     # lse -> (BH, S)
     return out, None
@@ -618,7 +632,7 @@ def _flash_bhsd_fwd_mh(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                               "interpret", "hb"))
-def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
+def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, bias=None, causal=False,
                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                        interpret=False, hb=None):
     BH, S, D = q.shape
@@ -629,11 +643,23 @@ def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
         hb = _pick_hb(BH, S, D, n_bufs=7, budget=1024 * 1024)  # bwd: hb=1 measured flat-optimal
     spec = pl.BlockSpec((hb, S, D), lambda b: (b, 0, 0))
     spec_l = pl.BlockSpec((hb, 1, S), lambda b: (b, 0, 0))
+    kernel = functools.partial(_flash_bwd_mh_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, seq_len=S)
+    if bias is not None:
+        in_specs = [spec, spec, spec, spec, spec, spec_l, spec_l]
+        ins = (q, k, v, do, o, lse[:, None, :].astype(jnp.float32),
+               bias.astype(jnp.float32))
+        kern = kernel
+    else:
+        in_specs = [spec, spec, spec, spec, spec, spec_l]
+        ins = (q, k, v, do, o, lse[:, None, :].astype(jnp.float32))
+        kern = lambda qr, kr, vr, dor, orf, lr, dqr, dkr, dvr, dka, dva: \
+            kernel(qr, kr, vr, dor, orf, lr, None, dqr, dkr, dvr, dka, dva)
     return pl.pallas_call(
-        functools.partial(_flash_bwd_mh_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=S),
+        kern,
         grid=(BH // hb,),
-        in_specs=[spec, spec, spec, spec, spec, spec_l],
+        in_specs=in_specs,
         out_specs=[spec, spec, spec],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -643,7 +669,7 @@ def _flash_bhsd_bwd_mh(q, k, v, o, lse, do, causal=False,
         scratch_shapes=[pltpu.VMEM((S, D), jnp.float32),
                         pltpu.VMEM((S, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, o, lse[:, None, :].astype(jnp.float32))
+    )(*ins)
 
 
 def _to_bhsd(x):
@@ -656,28 +682,46 @@ def _from_bhsd(x, B, H):
     return jnp.swapaxes(x.reshape(B, H, S, D), 1, 2)
 
 
-def flash_attention_fwd(q, k, v, causal=False):
+def _bias_bh(bias, B, H, S):
+    """(B, S) additive key bias -> the kernels' slim (BH, 1, S) layout."""
+    if bias is None:
+        return None
+    bb = jnp.broadcast_to(bias.astype(jnp.float32)[:, None, :], (B, H, S))
+    return bb.reshape(B * H, 1, S)
+
+
+def flash_attention_fwd(q, k, v, bias=None, causal=False, interpret=False):
     """(B, S, H, D) in/out — paddle layout; supports MQA/GQA (H_kv divides
     H) by repeating kv heads.  No-grad path: uses the LSE-less kernel so
-    inference pays nothing for backward residuals."""
+    inference pays nothing for backward residuals.  ``bias``: optional
+    (B, S) additive per-key mask — head-folded kernels only (the
+    registry routes masked shapes past the VMEM cap to the XLA path)."""
     B, S, H, D = q.shape
     Hk = k.shape[2]
     if Hk != H:
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    bq, bk = _fwd_blocks(S)
+    bq, bk = _fwd_blocks(S, D, B * H)
+    if bias is not None and S * D > _MH_FWD_MAX_SD:
+        raise ValueError(
+            f"flash key-bias path needs S*D <= {_MH_FWD_MAX_SD} "
+            f"(got S={S}, D={D}); the dispatch layer routes larger "
+            "masked shapes to the XLA attention")
     if S * D <= _MH_FWD_MAX_SD:
         of, _ = _flash_bhsd_fwd_mh(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                                   bias=_bias_bh(bias, B, H, S),
                                    causal=causal, block_q=bq, block_k=bk,
-                                   with_lse=False)
+                                   with_lse=False, interpret=interpret)
     else:
         of = _flash_bhsd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
-                         causal=causal, block_q=bq, block_k=bk)
+                         causal=causal, block_q=bq, block_k=bk,
+                         interpret=interpret)
     return _from_bhsd(of, B, H)
 
 
-def flash_attention_fwd_lse(q, k, v, causal=False, interpret=False):
+def flash_attention_fwd_lse(q, k, v, bias=None, causal=False,
+                            interpret=False):
     """Forward returning (o [B,S,H,D], lse [B*H,S]) for the flash bwd."""
     B, S, H, D = q.shape
     Hk = k.shape[2]
@@ -685,9 +729,15 @@ def flash_attention_fwd_lse(q, k, v, causal=False, interpret=False):
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    bq, bk = _fwd_blocks(S)
+    bq, bk = _fwd_blocks(S, D, B * H)
+    if bias is not None and S * D > _MH_FWD_MAX_SD:
+        raise ValueError(
+            f"flash key-bias path needs S*D <= {_MH_FWD_MAX_SD} "
+            f"(got S={S}, D={D}); the dispatch layer routes larger "
+            "masked shapes to the XLA attention")
     if S * D <= _MH_FWD_MAX_SD:
         of, lse = _flash_bhsd_fwd_mh(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                                     bias=_bias_bh(bias, B, H, S),
                                      causal=causal, block_q=bq, block_k=bk,
                                      with_lse=True, interpret=interpret)
         # mh path already returns lse as (BH, S)
@@ -699,26 +749,36 @@ def flash_attention_fwd_lse(q, k, v, causal=False, interpret=False):
     return _from_bhsd(of, B, H), lse[..., 0]
 
 
-def flash_attention_bwd(q, k, v, o, lse, do, causal=False, interpret=False):
+def flash_attention_bwd(q, k, v, o, lse, do, bias=None, causal=False,
+                        interpret=False):
     """Pallas flash backward — returns (dq, dk, dv) in (B, S, H, D);
-    GQA kv grads are summed back over the repeated query-head groups."""
+    GQA kv grads are summed back over the repeated query-head groups.
+    ``bias`` must replay the forward's additive per-key mask (head-
+    folded kernel only, same cap contract as the forward)."""
     B, S, H, D = q.shape
     Hk = k.shape[2]
     if Hk != H:
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    if bias is not None and S * D > _MH_BWD_MAX_SD:
+        raise ValueError(
+            f"flash key-bias backward needs S*D <= {_MH_BWD_MAX_SD} "
+            f"(got S={S}, D={D}); the dispatch layer routes larger "
+            "masked shapes to the XLA attention")
     # ladder: head-folded one-pass (smallest grids, whole (b,h) resident)
     # -> q-grid one-pass (cross-step dk/dv scratch) -> two-pass
     if S * D <= _MH_BWD_MAX_SD:
-        bwd = _flash_bhsd_bwd_mh
-    elif S * D <= _FUSED_BWD_MAX_SD:
-        bwd = _flash_bhsd_bwd_fused
+        dqf, dkf, dvf = _flash_bhsd_bwd_mh(
+            _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(o), lse,
+            _to_bhsd(do), bias=_bias_bh(bias, B, H, S), causal=causal,
+            interpret=interpret)
     else:
-        bwd = _flash_bhsd_bwd
-    dqf, dkf, dvf = bwd(
-        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(o), lse,
-        _to_bhsd(do), causal=causal, interpret=interpret)
+        bwd = _flash_bhsd_bwd_fused if S * D <= _FUSED_BWD_MAX_SD \
+            else _flash_bhsd_bwd
+        dqf, dkf, dvf = bwd(
+            _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(o), lse,
+            _to_bhsd(do), causal=causal, interpret=interpret)
     dq = _from_bhsd(dqf, B, H)
     dk = _from_bhsd(dkf, B, H)
     dv = _from_bhsd(dvf, B, H)

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .compat import TPUCompilerParams
+
 
 _VARLEN_ONEPASS_MAX_TD = 8192 * 64    # resident tier: k/v (+f32 scratch)
 _BLOCK = 512
@@ -230,7 +232,7 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, causal, block_q=_BLOCK,
         ],
         scratch_shapes=[pltpu.VMEM((T, D), jnp.float32),
                         pltpu.VMEM((T, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(_seg2d(seg_q), _seg2d(seg_k), q, k, v, do, o,
@@ -424,7 +426,7 @@ def _varlen_fwd_stream(q, k, v, seg_q, seg_k, causal, block_q=_BLOCK,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
                         pltpu.VMEM((block_q, 128), jnp.float32),
                         pltpu.VMEM((block_q, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(_seg2d(seg_q), _seg2d(seg_k), q, k, v)
@@ -455,7 +457,7 @@ def _varlen_bwd_stream(q, k, v, o, lse, do, seg_q, seg_k, causal,
         out_specs=sp["qb"],
         out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(_seg2d(seg_q), _seg2d(seg_k), q, k, v, do, lse3, delta3)
@@ -475,7 +477,7 @@ def _varlen_bwd_stream(q, k, v, o, lse, do, seg_q, seg_k, causal,
                    jax.ShapeDtypeStruct((H, T, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(_seg2d(seg_q), _seg2d(seg_k), k, v, q, do, lse3, delta3)

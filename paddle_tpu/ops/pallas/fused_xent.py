@@ -22,12 +22,32 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .compat import TPUCompilerParams
+from .. import registry as kreg
+
 __all__ = ["fused_softmax_xent"]
 
 _LANES = 128
-_BT = 256          # rows per program
+_BT = 256          # rows per program (T pads up to this granule)
 _MAX_BV = 2048     # V streamed in chunks of <= this many lanes
 _FORCE_INTERPRET = False   # tests: run the kernels in interpret mode on CPU
+
+# registry policy: Pallas on TPU (or interpret mode), jnp reference math
+# everywhere else; V must stay lane-aligned (the one hard constraint —
+# rows pad to the _BT granule since ISSUE 15, so T is unconstrained)
+kreg.register("xent", "pallas", None, platforms=("tpu",))
+kreg.register("xent", "xla", None, platforms=("*",))
+
+
+def _select():
+    """(use_pallas, interpret) for this call — module _FORCE_INTERPRET
+    (the test hook) short-circuits the registry."""
+    if _FORCE_INTERPRET:
+        return True, True
+    sel = kreg.choose("xent")
+    if sel.impl != "pallas":
+        return False, False
+    return True, sel.interpret
 
 
 def _pick_bv(V):
@@ -113,18 +133,20 @@ def _ref_rowloss(logits2, labels):
     return jnp.where(labels >= 0, lse - picked, 0.0)
 
 
-def _fwd_impl(logits2, labels):
-    T, V = logits2.shape
-    bv = _pick_bv(V)
-    interp = _FORCE_INTERPRET
-    on_tpu = jax.default_backend() == "tpu" or interp
-    if not on_tpu or bv is None or T % _BT:
-        lg = logits2.astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(lg, axis=-1)
-        return _ref_rowloss(logits2, labels), lse
-    lbl = _lane_col(labels.astype(jnp.int32), T)
-    n_v = -(-V // bv)      # ceil: tail chunk masked in-kernel
-    out, lse = pl.pallas_call(
+def _pad_rows(logits2, labels):
+    """Pad T up to the _BT granule with ignore rows (label -1) so the
+    kernel's row-block grid divides; callers slice back to T."""
+    T = logits2.shape[0]
+    pad = (-T) % _BT
+    if not pad:
+        return logits2, labels, T
+    return (jnp.pad(logits2, ((0, pad), (0, 0))),
+            jnp.pad(labels, (0, pad), constant_values=-1), T)
+
+
+def _fwd_pallas(logits2, lbl, *, n_v, bv, V, interpret):
+    T = logits2.shape[0]
+    return pl.pallas_call(
         functools.partial(_xent_fwd_kernel, n_v=n_v, bv=bv, V=V),
         grid=(T // _BT, n_v),
         in_specs=[
@@ -142,11 +164,34 @@ def _fwd_impl(logits2, labels):
         scratch_shapes=[pltpu.VMEM((_BT, _LANES), jnp.float32),
                         pltpu.VMEM((_BT, _LANES), jnp.float32),
                         pltpu.VMEM((_BT, _LANES), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-        interpret=interp,
+        interpret=interpret,
     )(logits2, lbl)
-    return out[:, 0], lse[:, 0]
+
+
+# standalone dispatches are compilestats-tracked (roofline attribution
+# under kernel.xent_*); traced calls inline into the caller's surface
+_fwd_tracked = kreg.TrackedKernel(_fwd_pallas, kreg.XENT_FWD_SURFACE)
+
+
+def _fwd_impl(logits2, labels):
+    T, V = logits2.shape
+    bv = _pick_bv(V)
+    use, interp = _select()
+    if use and bv is None:
+        kreg.record_fallback("xent", "unaligned-vocab")
+        use = False
+    if not use:
+        lg = logits2.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        return _ref_rowloss(logits2, labels), lse
+    lg_p, lb_p, T0 = _pad_rows(logits2, labels.astype(jnp.int32))
+    lbl = _lane_col(lb_p, lg_p.shape[0])
+    n_v = -(-V // bv)      # ceil: tail chunk masked in-kernel
+    out, lse = _fwd_tracked(lg_p, lbl, n_v=n_v, bv=bv, V=V,
+                            interpret=interp)
+    return out[:T0, 0], lse[:T0, 0]
 
 
 def _xent_fwd(logits2, labels):
@@ -154,23 +199,9 @@ def _xent_fwd(logits2, labels):
     return out, (logits2, labels, lse)
 
 
-def _xent_bwd(res, g):
-    logits2, labels, lse = res
-    T, V = logits2.shape
-    bv = _pick_bv(V)
-    interp = _FORCE_INTERPRET
-    on_tpu = jax.default_backend() == "tpu" or interp
-    if not on_tpu or bv is None or T % _BT:
-        p = jnp.exp(logits2.astype(jnp.float32) - lse[:, None])
-        safe = jnp.maximum(labels, 0)
-        onehot = jax.nn.one_hot(safe, V, dtype=jnp.float32)
-        valid = (labels >= 0).astype(jnp.float32)
-        dlg = (p - onehot) * (g * valid)[:, None]
-        return dlg.astype(logits2.dtype), None
-    lbl = _lane_col(labels.astype(jnp.int32), T)
-    lse_l = _lane_col(lse, T)
-    g_l = _lane_col(g.astype(jnp.float32), T)
-    dlg = pl.pallas_call(
+def _bwd_pallas(logits2, lbl, lse_l, g_l, *, bv, V, interpret):
+    T = logits2.shape[0]
+    return pl.pallas_call(
         functools.partial(_xent_bwd_kernel, bv=bv, V=V),
         grid=(T // _BT, -(-V // bv)),
         in_specs=[
@@ -181,11 +212,34 @@ def _xent_bwd(res, g):
         ],
         out_specs=pl.BlockSpec((_BT, bv), lambda t, v: (t, v)),
         out_shape=jax.ShapeDtypeStruct((T, V), logits2.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-        interpret=interp,
+        interpret=interpret,
     )(logits2, lbl, lse_l, g_l)
-    return dlg, None
+
+
+_bwd_tracked = kreg.TrackedKernel(_bwd_pallas, kreg.XENT_BWD_SURFACE)
+
+
+def _xent_bwd(res, g):
+    logits2, labels, lse = res
+    T, V = logits2.shape
+    bv = _pick_bv(V)
+    use, interp = _select()
+    if not use or bv is None:
+        p = jnp.exp(logits2.astype(jnp.float32) - lse[:, None])
+        safe = jnp.maximum(labels, 0)
+        onehot = jax.nn.one_hot(safe, V, dtype=jnp.float32)
+        valid = (labels >= 0).astype(jnp.float32)
+        dlg = (p - onehot) * (g * valid)[:, None]
+        return dlg.astype(logits2.dtype), None
+    lg_p, lb_p, T0 = _pad_rows(logits2, labels.astype(jnp.int32))
+    Tp = lg_p.shape[0]
+    lbl = _lane_col(lb_p, Tp)
+    lse_l = _lane_col(jnp.pad(lse, (0, Tp - T0)), Tp)
+    g_l = _lane_col(jnp.pad(g.astype(jnp.float32), (0, Tp - T0)), Tp)
+    dlg = _bwd_tracked(lg_p, lbl, lse_l, g_l, bv=bv, V=V, interpret=interp)
+    return dlg[:T0], None
 
 
 fused_softmax_xent.defvjp(_xent_fwd, _xent_bwd)

@@ -30,6 +30,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .compat import TPUCompilerParams
+
 __all__ = ["int8_matmul", "fp8_matmul", "fp8_quantize_weight"]
 
 _BM, _BK, _BN = 256, 512, 256
@@ -105,7 +107,7 @@ def int8_matmul(x, w_int, w_scale, act_scale, bit_length=8,
         out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
         out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(xp, wp, wsp.reshape(1, -1), sc)

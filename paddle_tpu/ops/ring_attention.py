@@ -33,6 +33,16 @@ __all__ = ["ring_flash_attention", "ulysses_attention"]
 _NEG_INF = -1e30
 
 
+def _axis_size(axis_name):
+    """Static (python int) size of a named mesh axis from inside
+    shard_map.  ``lax.axis_size`` only exists on newer jax; on the
+    pinned 0.4.x toolchain ``lax.psum`` of a literal 1 constant-folds
+    to the same static int."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _repeat_kv(q, k, v):
     H, Hk = q.shape[2], k.shape[2]
     if Hk != H:  # MQA/GQA: repeat kv heads
@@ -49,7 +59,7 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None):
     (B, S_local, H, D) — the exact softmax attention over the full
     sequence, computed without ever materializing full K/V on one device.
     """
-    size = lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
     if scale is None:
@@ -104,7 +114,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     all_to_all reshards to head-sharded/full-sequence, runs dense (flash)
     attention locally, reshards back.  Requires sep | H and sep | H_kv.
     """
-    size = lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     if q.shape[2] % size or k.shape[2] % size:
         raise ValueError(
             f"ulysses requires sep axis size {size} to divide num heads "
@@ -118,10 +128,16 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     if attention_fn is None:
         # flash-capable core: Pallas blockwise kernel on TPU for long S
         # (which is exactly the regime sep parallelism serves), XLA path
-        # elsewhere, with the recompute-based backward
-        from ..nn.functional.attention import _attention_core
-        attention_fn = lambda a, b, c: _attention_core(
-            a, b, c, bool(causal), scale)
+        # elsewhere, with the recompute-based backward; the registry
+        # decides per-shard (the local S/D after the reshard)
+        from ..nn.functional.attention import (_attention_core,
+                                               _select_flash)
+
+        def attention_fn(a, b, c):
+            sel = _select_flash(a.shape[1], b.shape[1], a.shape[3],
+                                bool(causal), has_mask=False,
+                                mask_is_keybias=False, scale=scale)
+            return _attention_core(a, b, c, bool(causal), scale, sel)
     o = attention_fn(q, k, v)
     # (B, S, H/sep, D) -> (B, S/sep, H, D)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,

@@ -328,7 +328,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     arg_shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype)
                   for _, t in ph_items]
     leaf_vals = [t._value for t in leaves]
-    exported = jax.export.export(
+    # jax 0.4.x: `jax.export` is importable but not an attribute of jax
+    from jax import export as _jax_export
+    exported = _jax_export.export(
         jax.jit(pure), platforms=("cpu", "tpu"))(arg_shapes, leaf_vals)
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     with open(path_prefix + ".pdmodel", "w") as f:
@@ -352,7 +354,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     import jax.numpy as jnp
     with open(path_prefix + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
-    exported = jax.export.deserialize(bytearray(meta["exported"]))
+    from jax import export as _jax_export
+    exported = _jax_export.deserialize(bytearray(meta["exported"]))
     leaves = [jnp.asarray(a) for a in meta["leaves"]]
     feed_names = meta["feed_names"]
 

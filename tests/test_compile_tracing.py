@@ -393,8 +393,10 @@ class TestBenchCompare:
         class Ctx:
             root = str(tmp_path)
         findings = bench_gate.BenchComparePass().run(Ctx())
-        assert len(findings) == 1 and findings[0].code == \
-            "bench-regression"
+        # the synthetic artifacts lack the required long-context config,
+        # so the ISSUE 15 presence gate fires alongside the regression
+        assert sorted(f.code for f in findings) == \
+            ["bench-coverage", "bench-regression"]
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools",
                                           "bench_compare.py"),
@@ -406,10 +408,26 @@ class TestBenchCompare:
 
     def test_repo_bench_trajectory_gate_passes(self):
         """The committed BENCH history must pass its own gate at the
-        default threshold (r4 -> r5 is flat)."""
+        default threshold (r4 -> r5 is flat), INCLUDING the required-
+        MFU presence gate (r5 carries gpt125m_s4096.mfu)."""
         from paddle_tpu.analysis import runner
         findings = runner.run_passes(passes=["bench"])
-        assert [f for f in findings if f.code == "bench-regression"] == []
+        assert [f for f in findings
+                if f.code in ("bench-regression", "bench-coverage")] == []
+
+    def test_required_mfu_presence_gate(self):
+        """ISSUE 15: the long-context target must carry a numeric MFU
+        in the newest artifact — error/skip/absence all trip."""
+        from paddle_tpu.analysis import bench_gate
+        ok = {"extra": {"configs": {"gpt125m_s4096": {"mfu": 0.47}}}}
+        assert bench_gate.missing_required_mfu(ok) == []
+        for cfgs in ({}, {"gpt125m_s4096": {"error": "boom"}},
+                     {"gpt125m_s4096": {"skipped": "budget"}},
+                     {"gpt125m_s4096": {"mfu": None}},
+                     {"gpt125m_s4096": {"mfu": True}}):
+            rec = {"extra": {"configs": cfgs}}
+            assert bench_gate.missing_required_mfu(rec) == \
+                ["gpt125m_s4096"], cfgs
 
 
 class TestSnapshotIdempotency:

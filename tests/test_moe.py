@@ -222,7 +222,10 @@ def test_sparse_dispatch_flops_scale_linearly():
 
     def flops(fn):
         lowered = jax.jit(lambda xv, *ps: fn(xv, *ps)[0]).lower(x, *params)
-        return lowered.compile().cost_analysis()["flops"]
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):   # jax 0.4.x: per-device list
+            ca = ca[0]
+        return ca["flops"]
 
     f_dense = flops(moe._moe_fn_stacked)
     f_sparse = flops(moe._moe_fn_stacked_sparse)

@@ -24,6 +24,11 @@ README lookup.  This wires them into one:
                                               # mesh (opt-in: kill/
                                               # resume e2e is slower
                                               # than tier-1 unit tests)
+    python tools/ci_check.py --kernels        # + the Pallas kernel /
+                                              # registry suites with
+                                              # interpret mode forced
+                                              # (selected TPU kernels
+                                              # run on the CPU backend)
     python tools/ci_check.py --skip-tests     # lint (+gate) only
 
 Stages:
@@ -137,13 +142,37 @@ def run_chaos():
     return rc
 
 
+def run_kernels():
+    """Kernel stage (the ISSUE 15 CI satellite, opt-in): run the Pallas
+    kernel + registry suites with interpret mode forced, so the
+    *selected* TPU kernels — dispatch, padding, masks, custom VJPs —
+    execute end to end on the CPU backend (the same parity contract
+    the train-step tests machine-check)."""
+    t0 = _stage("interpret-mode kernel suite (opt-in)")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_flash_attention.py", "tests/test_fused_xent.py",
+           "tests/test_pallas_fused.py", "tests/test_quant_matmul.py",
+           "tests/test_varlen_attention.py",
+           "tests/test_kernel_registry.py",
+           "-q", "--continue-on-collection-errors",
+           "-p", "no:cacheprovider"]
+    env = {**os.environ, "PADDLE_TPU_KERNEL_INTERPRET": "1"}
+    print("$ PADDLE_TPU_KERNEL_INTERPRET=1",
+          " ".join(shlex.quote(c) for c in cmd), flush=True)
+    rc = subprocess.call(cmd, cwd=REPO, env=env)
+    print(f"kernels: {'OK' if rc == 0 else f'FAIL (rc={rc})'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
 def run_bench_gate():
     from paddle_tpu.analysis import runner
     t0 = _stage("bench trajectory gate (opt-in)")
     findings = runner.run_passes(passes=["bench"])
     for f in findings:
         print(f"  [{f.code}] {f.message}")
-    rc = 1 if any(f.code == "bench-regression" for f in findings) else 0
+    rc = 1 if any(f.code in ("bench-regression", "bench-coverage")
+                  for f in findings) else 0
     print(f"bench gate: {'OK' if rc == 0 else 'FAIL'} "
           f"({time.perf_counter() - t0:.1f}s)")
     return rc
@@ -164,6 +193,10 @@ def main(argv=None):
     ap.add_argument("--chaos", action="store_true",
                     help="also run the chaos-marked elastic-resume "
                          "tests on the 8-device CPU-proxy mesh")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also run the Pallas kernel + registry suites "
+                         "with interpret mode forced (the selected TPU "
+                         "kernels execute on the CPU backend)")
     ap.add_argument("--skip-tests", action="store_true",
                     help="lint (and gate) only")
     ap.add_argument("--pytest-args", default="",
@@ -184,6 +217,10 @@ def main(argv=None):
             return rc
     if args.chaos:
         rc = run_chaos()
+        if rc != 0:
+            return rc
+    if args.kernels:
+        rc = run_kernels()
         if rc != 0:
             return rc
     if not args.skip_tests:

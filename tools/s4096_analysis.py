@@ -39,7 +39,8 @@ v = jnp.asarray(rng.randn(B, S, H, D).astype('f4')*0.1, jnp.bfloat16)
 fl_attn = 2 * 2 * B * H * S * S * D * 0.5          # causal fwd flops
 
 from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
-from paddle_tpu.nn.functional.attention import _attention_core
+from paddle_tpu.nn.functional.attention import (_attention_core,
+                                                _select_flash)
 
 # --- 1. flash fwd chain (output feeds next q)
 RF = 500
@@ -58,7 +59,10 @@ RB = 200
 @jax.jit
 def fb_chain(q, k, v):
     def loss(qq, kk, vv):
-        return jnp.sum(_attention_core(qq, kk, vv, True, None)
+        sel = _select_flash(qq.shape[1], kk.shape[1], qq.shape[3],
+                            True, has_mask=False, mask_is_keybias=False,
+                            scale=None)
+        return jnp.sum(_attention_core(qq, kk, vv, True, None, sel)
                        .astype(jnp.float32))
     g = jax.grad(loss, argnums=(0,))
     def rep(qc, _):
