@@ -1639,9 +1639,18 @@ def _fleet_run_config(P, n_replicas, snapshot=False):
         eng.reset()
 
     dtype = P.get("dtype", "float32")
+    ekw = {"dtype": dtype}
+    paged = P.get("paged") or {}
+    if paged:
+        ekw.update(kv_mode="paged", page_size=int(paged["page_size"]),
+                   prefill_buckets=tuple(int(b)
+                                         for b in paged["prefill_buckets"]))
+        if paged.get("num_pages"):
+            ekw["num_pages"] = int(paged["num_pages"])
+    roles = P.get("roles")
     if n_replicas == 1:
         fe = ServingEngine(net, num_slots=P["slots"], chunk=chunk,
-                           max_seq_len=max_seq, dtype=dtype)
+                           max_seq_len=max_seq, **ekw)
         warm(fe)
         reset = fe.reset
         run_trace = fe.run
@@ -1649,9 +1658,22 @@ def _fleet_run_config(P, n_replicas, snapshot=False):
     else:
         fl = ServingFleet(net, num_replicas=n_replicas,
                           num_slots=P["slots"], chunk=chunk,
-                          max_seq_len=max_seq, dtype=dtype)
+                          max_seq_len=max_seq,
+                          roles=tuple(roles) if roles else None,
+                          handoff_ttl_s=float(P.get("handoff_ttl_s", 60.0)),
+                          **ekw)
         for rep in fl.replicas:
             warm(rep.engine)
+        if roles:
+            # the per-engine warm bypassed the router: run a few real
+            # requests through the fleet so the handoff path (budget-1
+            # stub prefill + arm-at-k) is compiled before the clock
+            for b in fl.replicas[0].engine.buckets:
+                if b <= p_hi * 2:
+                    fl.submit(np.ones((min(int(b), max_seq - n_lo),),
+                                      np.int32), 2)
+            fl.run(threads=True)
+            fl.reset()
         reset = fl.reset
         run_trace = lambda: fl.run(threads=True)   # noqa: E731
         submit = fl.submit
@@ -1667,9 +1689,11 @@ def _fleet_run_config(P, n_replicas, snapshot=False):
             # per-trial telemetry reset so the committed snapshot is
             # one-run-shaped (the last trial's), not a 2x aggregate
             from paddle_tpu import observability as _obs
+            from paddle_tpu.framework import guardian as _guardian
             from paddle_tpu.observability import tracing as _tracing
             _obs.get_registry().reset()
             _tracing.reset()
+            _guardian.clear_events()
         except Exception:
             pass
         t0 = time.perf_counter()
@@ -1682,6 +1706,7 @@ def _fleet_run_config(P, n_replicas, snapshot=False):
         if best is None or wall < best["wall"]:
             ttfts = sorted(r.ttft_ms for r in reqs)
             best = {"toks": toks, "wall": wall,
+                    "ttfts": [round(r.ttft_ms, 2) for r in reqs],
                     "p99": ttfts[min(int(0.99 * (len(ttfts) - 1)),
                                      len(ttfts) - 1)]}
     if n_replicas == 1:
@@ -1696,13 +1721,46 @@ def _fleet_run_config(P, n_replicas, snapshot=False):
                                for r in fl.replicas),
                  "prefills": sum(r.engine.stats["prefills"]
                                  for r in fl.replicas)}
+        if roles:
+            from paddle_tpu.framework import guardian
+            hs = fl._handoff.snapshot()
+            transfer_ms = sorted(
+                e["transfer_ms"]
+                for e in guardian.events("handoff_transfer"))
+            extra.update(
+                prefills_by_role={
+                    r.role: r.engine.stats["prefills"]
+                    for r in fl.replicas},
+                handoff_transfers=hs["transfers"],
+                handoff_fallbacks=hs["fallbacks"],
+                mean_transfer_ms=round(
+                    sum(transfer_ms) / len(transfer_ms), 2)
+                if transfer_ms else None,
+                p99_transfer_ms=round(
+                    transfer_ms[min(int(0.99 * (len(transfer_ms) - 1)),
+                                    len(transfer_ms) - 1)], 2)
+                if transfer_ms else None)
+            # the recompute-saved side of the TTFT attribution: what a
+            # fallback would pay — one median prompt re-prefilled on the
+            # (already-compiled) decode replica, timed directly
+            dec = next(r.engine for r in fl.replicas
+                       if r.role == "decode")
+            probe = prompts[int(np.argsort(plens)[len(plens) // 2])]
+            t0 = time.perf_counter()
+            dec.submit(probe, 1)
+            dec.run()
+            extra["reprefill_probe_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            dec.reset()
     useful = int(budgets.sum())
     out = {"tokens": best["toks"],
            "useful_tokens": useful,
            "useful_tokens_per_sec": round(useful / best["wall"], 1),
-           "p99_ttft_ms": round(best["p99"], 1), **extra}
+           "p99_ttft_ms": round(best["p99"], 1),
+           "ttfts_ms": best["ttfts"], **extra}
     if snapshot:
-        out["telemetry"] = _telemetry_snapshot("router")
+        out["telemetry"] = _telemetry_snapshot(
+            P.get("snapshot_tag", "router"))
     return out
 
 
@@ -1804,6 +1862,7 @@ def bench_serving_fleet(n_requests=64, seed=0, hidden=256, layers=6,
             r["p99_ttft_vs_one"] = round(
                 r["p99_ttft_ms"] / max(base["p99"], 1e-9), 3)
         r.pop("useful_tokens", None)
+        r.pop("ttfts_ms", None)       # per-request detail: pd_split's
         results[str(n)] = r
     scaling_ok = all(results[str(n)]["speedup_vs_one"] >= 0.75 * n
                      for n in counts[1:])
@@ -1844,6 +1903,126 @@ def bench_serving_fleet(n_requests=64, seed=0, hidden=256, layers=6,
             "fleet scaling below 0.75x-per-replica or p99 TTFT not "
             "strictly lower than the single engine -- the ratio is "
             "reported but should not be read as the fleet win")
+    return out
+
+
+def bench_prefill_decode_split(n_requests=32, seed=0, hidden=256,
+                               layers=6, heads=8, vocab=8192,
+                               p_range=(16, 96), n_range=(16, 64),
+                               slots=4, chunk=16, page_size=16,
+                               p_lams=(24, 48, 80), n_lams=(24, 48),
+                               sys_prompt_len=16):
+    """Disaggregated prefill/decode fleet (``roles=("prefill",
+    "decode")``) vs the SAME 2-replica paged fleet unified, over one
+    Poisson burst — both in pinned subprocesses like serving_fleet.
+    The contract under measurement: every prompt prefills on the
+    prefill replica only (``prefills_by_role["decode"] == 0``), its KV
+    crosses as a checksummed bundle, and the output is BITWISE equal
+    to the unified fleet.  TTFT attribution splits what the handoff
+    costs (measured per-transfer wall, the `handoff_transfer` guardian
+    events) from what it saves the decode replica (one median prompt
+    re-prefilled there directly, the fallback price)."""
+    import subprocess
+    import sys
+
+    def bucket(n, lo):
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    buckets = []
+    b = p_range[0]
+    while b < bucket(p_range[1], p_range[0]) * 2:
+        buckets.append(b)
+        b *= 2
+    # decode pool sized for the WHOLE admitted burst: every launched
+    # handoff holds its page reservation until its decode slot frees,
+    # and decode drains far slower than prefill — an undersized pool
+    # turns the burst into reserve_timeout fallbacks (that ladder is
+    # chaos-tested; this config measures the happy path)
+    num_pages = n_requests * ((p_range[1] + n_range[1]) // page_size
+                              + 2) + 1
+    P = {"n_requests": n_requests, "seed": seed, "hidden": hidden,
+         "layers": layers, "heads": heads, "vocab": vocab,
+         "p_range": list(p_range), "n_range": list(n_range),
+         "slots": slots, "chunk": chunk, "p_lams": list(p_lams),
+         "n_lams": list(n_lams), "sys_prompt_len": sys_prompt_len,
+         "paged": {"page_size": page_size, "prefill_buckets": buckets,
+                   "num_pages": num_pages},
+         "snapshot_tag": "pd_split"}
+    cores_per_replica = max(1, (os.cpu_count() or 1) // 2)
+    results, telemetry = {}, None
+    for name, roles in (("unified", None),
+                        ("split", ["prefill", "decode"])):
+        spec = {"n_replicas": 2,
+                "params": {**P, "roles": roles},
+                "cores_per_replica": cores_per_replica,
+                "snapshot": roles is not None}
+        env = dict(os.environ)
+        env[_FLEET_CHILD_ENV] = json.dumps(spec)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=1800)
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("FLEET_CHILD_RESULT:")]
+        if proc.returncode != 0 or not line:
+            raise RuntimeError(
+                f"pd_split child {name} failed (rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[-400:]}")
+        r = json.loads(line[-1][len("FLEET_CHILD_RESULT:"):])
+        r.pop("pinned", None)
+        telemetry = r.pop("telemetry", telemetry)
+        results[name] = r
+    uni, spl = results["unified"], results["split"]
+    # the parity contract IS the product: same tokens whether the KV
+    # was computed in place or crossed replicas as a bundle
+    bitwise = uni.pop("tokens") == spl.pop("tokens")
+    uni_ttfts = uni.pop("ttfts_ms")
+    spl_ttfts = spl.pop("ttfts_ms")
+    mean = lambda xs: round(sum(xs) / len(xs), 2)   # noqa: E731
+    attribution = {
+        "mean_ttft_unified_ms": mean(uni_ttfts),
+        "mean_ttft_split_ms": mean(spl_ttfts),
+        "mean_transfer_ms": spl.get("mean_transfer_ms"),
+        "p99_transfer_ms": spl.get("p99_transfer_ms"),
+        "transfer_share_of_ttft": round(
+            spl["mean_transfer_ms"] / max(mean(spl_ttfts), 1e-9), 3)
+        if spl.get("mean_transfer_ms") else None,
+        "reprefill_saved_ms": spl.get("reprefill_probe_ms"),
+    }
+    decode_prefills = spl.get("prefills_by_role", {}).get("decode")
+    valid = bool(bitwise and decode_prefills == 0
+                 and spl.get("handoff_fallbacks") == 0
+                 and spl.get("handoff_transfers") == n_requests)
+    out = {"unified": uni, "split": spl, "bitwise": bitwise,
+           "decode_prompt_prefills": decode_prefills,
+           "ttft_attribution": attribution,
+           "requests": n_requests, "slots_per_replica": slots,
+           "chunk": chunk, "page_size": page_size,
+           "cores_per_replica": cores_per_replica,
+           "valid": valid,
+           "model": f"gpt_h{hidden}_l{layers}", "dtype": "float32",
+           "note": ("same Poisson burst through a unified 2-replica "
+                    "paged fleet and the same fleet split "
+                    "prefill/decode; both pinned like serving_fleet.  "
+                    "The split config serializes all prompt prefills "
+                    "on ONE replica, so burst p99 TTFT is expected to "
+                    "trail the unified fleet on this proxy -- the win "
+                    "disaggregation buys (decode batches never stall "
+                    "behind a prompt prefill) shows as the decode "
+                    "replica's zero prompt prefills and in the "
+                    "attribution: a bundle import costs "
+                    "mean_transfer_ms where the fallback "
+                    "(re-prefill on the decode replica) costs "
+                    "reprefill_saved_ms")}
+    if telemetry is not None:
+        out["telemetry"] = telemetry
+    if not valid:
+        out["invalid_reason"] = (
+            "expected bitwise output, zero decode prompt prefills, "
+            "zero fallbacks and one transfer per request")
     return out
 
 
@@ -2169,6 +2348,14 @@ def main():
             # empty) — surface its paths instead of overwriting
             telemetry["router"] = configs["serving_fleet"].pop(
                 "telemetry", {"skipped": "fleet child did not report"})
+        if want("prefill_decode_split"):
+            try:
+                configs["prefill_decode_split"] = \
+                    bench_prefill_decode_split()
+            except Exception as e:
+                configs["prefill_decode_split"] = {"error": repr(e)[:200]}
+            telemetry["pd_split"] = configs["prefill_decode_split"].pop(
+                "telemetry", {"skipped": "pd_split child did not report"})
         if want("moe", "gpt_moe"):
             try:
                 configs["gpt_moe"] = bench_gpt_moe(peak=peak)
@@ -2224,6 +2411,14 @@ def main():
             # empty) — surface its paths instead of overwriting
             telemetry["router"] = configs["serving_fleet"].pop(
                 "telemetry", {"skipped": "fleet child did not report"})
+        if which is not None and "prefill_decode_split" in which:
+            try:
+                configs["prefill_decode_split"] = \
+                    bench_prefill_decode_split()
+            except Exception as e:
+                configs["prefill_decode_split"] = {"error": repr(e)[:200]}
+            telemetry["pd_split"] = configs["prefill_decode_split"].pop(
+                "telemetry", {"skipped": "pd_split child did not report"})
         if which is not None and \
                 {"longctx_sweep", "gpt125m_s4096_sweep"} & set(which):
             try:

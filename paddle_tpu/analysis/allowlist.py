@@ -30,6 +30,10 @@ MONITORED_MODULES = (
     # — the one budgeted sync is submit's prompt ingest; routing,
     # admission control and health checks must NEVER read the device
     "paddle_tpu/inference/router.py",
+    # prefill/decode handoff coordinator: protocol state machine only —
+    # the ONE device readback (bundle export) lives in kvcache.py, so
+    # this module is monitored with zero allowlist entries
+    "paddle_tpu/inference/handoff.py",
     # the bucketed/quantized gradient reducer runs entirely inside the
     # compiled step — ANY sync primitive appearing here is a bug, so it
     # is monitored with zero allowlist entries
@@ -299,6 +303,9 @@ CONCURRENCY_MODULES = (
     "paddle_tpu/inference/scheduler.py",
     "paddle_tpu/inference/serving.py",
     "paddle_tpu/inference/router.py",
+    # prefill/decode handoff: record table + stats shared between the
+    # router thread and prefill/decode workers
+    "paddle_tpu/inference/handoff.py",
     "paddle_tpu/io/__init__.py",
     "paddle_tpu/io/worker.py",
     "paddle_tpu/distributed/checkpoint/__init__.py",
@@ -336,6 +343,16 @@ CONCURRENT_CLASSES = {
          "reason": "client threads submit while the router loop "
                    "dispatches and replica workers report finishes; "
                    "all shared fleet state is behind self._lock"},
+    # the prefill/decode handoff coordinator: the router thread
+    # launches/pumps while prefill workers deliver captured bundles and
+    # decode workers consume/arm/fail records at their admission gate —
+    # the record table and stats live behind self._lock
+    ("paddle_tpu/inference/handoff.py", "HandoffCoordinator"):
+        {"entries": ["_captured", "consume", "import_failed", "armed"],
+         "reason": "prefill workers deliver via the stub callback "
+                   "(_captured) and decode workers consume/arm/fail "
+                   "via the record's delegate methods, concurrent "
+                   "with the router thread's launch/pump"},
     # the metrics registry records from every thread by contract
     ("paddle_tpu/observability/metrics.py", "<module>"):
         {"entries": "*", "reason": "recording API is process-wide"},

@@ -75,6 +75,7 @@ class FailpointRefsPass:
         import paddle_tpu.distributed.fleet.elastic  # noqa: F401
         import paddle_tpu.io.worker                 # noqa: F401
         import paddle_tpu.inference.router          # noqa: F401
+        import paddle_tpu.inference.handoff         # noqa: F401
         return failpoints
 
     def run(self, ctx):

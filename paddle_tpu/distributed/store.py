@@ -23,7 +23,7 @@ import time
 from .. import observability as _obs
 from ..framework import failpoints as _fp
 from ..framework import native
-from ..framework.backoff import jittered_delay
+from ..framework.retry import RetryPolicy
 
 __all__ = ["TCPStore", "MasterStore"]
 
@@ -43,22 +43,15 @@ _FP_ADD = _fp.register("store.add")
 _FP_WAIT = _fp.register("store.wait")
 
 # retry envelope for the Python client: reconnect attempts back off
-# exponentially with jitter up to _BACKOFF_CAP between tries, bounded
-# overall by the store timeout (the "deadline")
-_BACKOFF_BASE = 0.05
-_BACKOFF_CAP = 2.0
-
-
-def _backoff_sleep(attempt, deadline=None):
-    """Exponential backoff with jitter, never sleeping past deadline.
-    Every call = one retry about to happen; the counter makes flapping
-    visible without log archaeology."""
-    _obs.inc("pt_store_retries_total")
-    delay = jittered_delay(attempt, _BACKOFF_BASE, _BACKOFF_CAP)
-    if deadline is not None:
-        delay = min(delay, max(0.0, deadline - time.monotonic()))
-    if delay > 0:
-        time.sleep(delay)
+# exponentially with jitter up to the cap between tries, bounded
+# overall by the store timeout (the "deadline").  The sleep/expiry
+# mechanics live in the shared framework.retry policy (ISSUE 16); the
+# loop semantics — what retries, what surfaces, the mid-ADD
+# at-most-once rule — stay in the client below, where they are the
+# wire contract.  Every backoff = one retry about to happen; the
+# counter makes flapping visible without log archaeology.
+_RETRY = RetryPolicy(base=0.05, cap=2.0,
+                     on_retry=lambda: _obs.inc("pt_store_retries_total"))
 
 
 class _PyStoreServer:
@@ -230,12 +223,12 @@ class _PyStoreClient:
             try:
                 return self._connect_once()
             except OSError as e:
-                if time.monotonic() >= deadline:
+                if _RETRY.expired(deadline):
                     raise TimeoutError(
                         f"TCPStore: cannot reach {self._host}:{self._port} "
                         f"within {self._timeout_s:.1f}s "
                         f"(last error: {e})") from e
-                _backoff_sleep(attempt, deadline)
+                _RETRY.backoff(attempt, deadline)
                 attempt += 1
 
     def _close_sock(self):
@@ -331,7 +324,7 @@ class _PyStoreClient:
                         "TCPStore: connection lost mid-ADD; the "
                         "increment may or may not have been applied "
                         f"({e})") from e
-                if time.monotonic() >= deadline:
+                if _RETRY.expired(deadline):
                     if connecting:
                         raise TimeoutError(
                             f"TCPStore: cannot reach "
@@ -342,7 +335,7 @@ class _PyStoreClient:
                         f"TCPStore: request failed after its "
                         f"{base_budget + extra:.1f}s retry "
                         f"budget ({e})") from e
-                _backoff_sleep(attempt, deadline)
+                _RETRY.backoff(attempt, deadline)
                 attempt += 1
 
     def close(self):

@@ -166,6 +166,16 @@ EVENT_SCHEMA = {
     # (source "relaunch") or a manifest-aware load re-deriving
     # shardings for a different mesh (source "load")
     "elastic_reshard": {"old_np", "new_np", "root", "source"},
+    # disaggregated prefill/decode (inference/handoff.py): a checksummed
+    # KV bundle crossed replicas and armed a decode slot — no suffix
+    # re-prefill ran (src/dst are replica indices)
+    "handoff_transfer": {"req_id", "pages", "bytes", "transfer_ms",
+                         "src", "dst"},
+    # handoff protocol: a terminal failure (prefill death, drop,
+    # checksum mismatch, reservation expiry, pool pressure) degraded
+    # the request to local re-prefill on the decode replica — output
+    # stays bitwise-equal, only TTFT pays
+    "handoff_fallback": {"req_id", "reason", "dst"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

@@ -1,0 +1,114 @@
+"""Bounded-retry policy: deadline + max-attempts + full-jitter
+exponential backoff, factored out of the TCPStore client's ad-hoc
+reconnect loop (PR 1) so every subsystem that retries — store
+reconnects, the prefill→decode KV-handoff's reserve/import/arm phases —
+shares ONE discipline instead of re-deriving sleep math and expiry
+checks (the ``backoff.jittered_delay`` formula stays the single source
+of delay truth).
+
+The policy is deliberately mechanism-only.  *What* to retry (which
+exception classes, which error surfaces at exhaustion) stays at the
+call site, because those semantics are the subsystem's contract: the
+store's mid-ADD at-most-once rule and its connecting-vs-requesting
+error split cannot be expressed generically without losing them, and
+the handoff's whole point is that exhaustion means "fall back to
+recompute", not "raise to the user".  Call sites either
+
+- keep their own loop and drive :meth:`RetryPolicy.backoff` /
+  :meth:`RetryPolicy.expired` (the store client: exact legacy
+  semantics, shared sleep discipline), or
+- hand the whole loop to :meth:`RetryPolicy.run` (the handoff phases:
+  bounded attempts under a deadline, :class:`RetryBudgetExceeded` at
+  exhaustion chaining the last error).
+
+Hooks (``on_retry``, ``sleep``, ``clock``) are injectable so adopting
+the policy changes no observable behavior: the store keeps its
+``pt_store_retries_total`` counter, tests can pin time.
+"""
+import time
+
+from .backoff import jittered_delay
+
+__all__ = ["RetryPolicy", "RetryBudgetExceeded"]
+
+
+class RetryBudgetExceeded(TimeoutError):
+    """A :meth:`RetryPolicy.run` call spent its budget (deadline or
+    attempt count).  Subclasses TimeoutError so callers that already
+    handle deadline expiry handle exhaustion the same way; the last
+    underlying error rides ``__cause__``."""
+
+
+class RetryPolicy:
+    """Immutable retry discipline: ``base``/``cap`` feed the shared
+    full-jitter delay formula; ``max_attempts`` bounds :meth:`run`
+    (None = deadline-only); ``on_retry`` fires once per backoff —
+    before the sleep — so flapping is countable without log
+    archaeology."""
+
+    __slots__ = ("base", "cap", "max_attempts", "on_retry", "_sleep",
+                 "_clock")
+
+    def __init__(self, base=0.05, cap=2.0, max_attempts=None,
+                 on_retry=None, sleep=time.sleep, clock=time.monotonic):
+        if base < 0 or cap < 0:
+            raise ValueError("backoff base/cap must be >= 0")
+        if max_attempts is not None and max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1 (or None)")
+        self.base = float(base)
+        self.cap = float(cap)
+        self.max_attempts = max_attempts
+        self.on_retry = on_retry
+        self._sleep = sleep
+        self._clock = clock
+
+    # -- loop primitives (call sites that keep their own loop) ------------
+    def deadline(self, timeout_s):
+        """Absolute deadline for a ``timeout_s`` budget starting now
+        (None = no deadline)."""
+        return None if timeout_s is None else self._clock() + timeout_s
+
+    def expired(self, deadline):
+        """True once ``deadline`` (an absolute clock value) has lapsed;
+        a None deadline never expires."""
+        return deadline is not None and self._clock() >= deadline
+
+    def backoff(self, attempt, deadline=None):
+        """One retry is about to happen: fire ``on_retry`` (the
+        caller's flap counter), then sleep the jittered delay — never
+        past ``deadline``."""
+        if self.on_retry is not None:
+            self.on_retry()
+        delay = jittered_delay(attempt, self.base, self.cap)
+        if deadline is not None:
+            delay = min(delay, max(0.0, deadline - self._clock()))
+        if delay > 0:
+            self._sleep(delay)
+
+    # -- the whole loop (call sites that hand it over) --------------------
+    def run(self, fn, timeout_s=None, retry_on=(ConnectionError,
+                                                TimeoutError),
+            describe=None):
+        """Call ``fn()`` under the policy: retry on ``retry_on``
+        exceptions with backoff until the ``timeout_s`` deadline lapses
+        or ``max_attempts`` calls have failed, then raise
+        :class:`RetryBudgetExceeded` chaining the last error.  Any
+        exception outside ``retry_on`` propagates immediately (it is
+        the call site's terminal contract, not a transient)."""
+        deadline = self.deadline(timeout_s)
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on as e:
+                spent = (self.max_attempts is not None
+                         and attempt + 1 >= self.max_attempts)
+                if spent or self.expired(deadline):
+                    what = describe or getattr(fn, "__name__",
+                                               "operation")
+                    raise RetryBudgetExceeded(
+                        f"{what}: retry budget spent after "
+                        f"{attempt + 1} attempt(s) "
+                        f"(last error: {e})") from e
+                self.backoff(attempt, deadline)
+                attempt += 1

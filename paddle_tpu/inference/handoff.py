@@ -1,0 +1,464 @@
+"""Fault-tolerant disaggregated prefill/decode: the cross-replica
+KV-handoff protocol (reference: Paddle's splitwise / PD-disaggregation
+serving deployments, rebuilt on this repo's fleet; wire integrity per
+the PR 1 checksummed-shard discipline, layout per PAPERS.md
+"Memory-efficient array redistribution").
+
+``ServingFleet(roles=("prefill", "decode", ...))`` specializes
+replicas: the router sends every fresh prompt to a prefill replica and
+assigns it a *decode home* up front.  This module owns everything in
+between — the protocol that moves one request's finished prefill KV
+from the prefill replica's pool into the decode home's, or degrades to
+local re-prefill when anything breaks:
+
+1. **reserve** (router thread, at launch): the decode home's allocator
+   atomically holds the bundle's page count under a reservation ticket
+   (``PagedKVManager.reserve_pages``) so the pages cannot be taken
+   between now and import.  Runs under the shared
+   :class:`~paddle_tpu.framework.retry.RetryPolicy` (deadline +
+   bounded attempts + jittered backoff); a reservation carries a TTL so
+   a prefill replica that dies mid-transfer can never leak pool pages.
+2. **transfer**: the prefill replica runs the request's prompt as a
+   budget-1 *stub* through its normal compiled admission — the stub's
+   finish callback fires at the chunk-boundary sync **before** its slot
+   releases, which is exactly the window where
+   ``PagedKVManager.export_pages`` can snapshot the slot's pages as a
+   checksummed bundle (per-page CRC32 + structural manifest).
+3. **import + arm** (decode worker thread, at the admission gate): the
+   decode engine verifies every checksum BEFORE any page touches its
+   pool (a torn/corrupt bundle is rejected whole), consumes the
+   reservation, then arms the slot directly at position ``k`` with the
+   prefill's first token — no suffix re-prefill.  Arming is
+   exactly-once: the record's ``consume()`` flips under the
+   coordinator lock and the allocator pops the ticket atomically, so a
+   retried import cannot double-scatter.
+
+**The failure ladder**: every terminal failure — prefill replica death
+(heartbeat-detected or mid-transfer), a dropped or corrupt bundle,
+reservation expiry, decode pool pressure at import — converges on ONE
+degradation: the request falls back to local re-prefill on a decode
+replica, which is the fleet's ordinary admission path and therefore
+bitwise-identical to the unified fleet and to ``generate()``.  Chaos
+tests (tests/test_handoff.py) drive the four failpoints registered
+here plus ``serving.replica_crash`` and assert exactly that, plus a
+clean allocator ``check()`` after every run.
+
+Observability: ``pt_handoff_*`` metrics (docs/observability.md),
+``handoff_transfer`` / ``handoff_fallback`` guardian events, and the
+router's ``router_gap`` flight sample carries the transfer/fallback
+counters.  Concurrency: the coordinator's record table and stats are
+shared between the router thread (launch/pump), prefill workers
+(capture/deliver) and decode workers (consume/arm) — every mutation
+runs under ``self._lock`` (machine-checked: this module is declared in
+``CONCURRENCY_MODULES`` / ``CONCURRENT_CLASSES``).
+"""
+import functools
+import threading
+import time
+from typing import Any, NamedTuple
+
+from .. import observability as _obs
+from ..framework import failpoints, guardian
+from ..framework.retry import RetryBudgetExceeded, RetryPolicy
+from .scheduler import Request
+
+__all__ = ["KVBundle", "HandoffRecord", "HandoffCoordinator"]
+
+# chaos hooks (tests/test_handoff.py; linted by the failpoint-refs
+# pass).  drop/corrupt fire inside the capture path and are CAUGHT
+# (they model the wire losing or flipping bits — the protocol must
+# degrade, not crash); prefill_crash fires UNCAUGHT so it propagates
+# through the engine sync into the replica-death path, modeling a
+# prefill replica dying mid-transfer with the bundle half-built.
+_FP_DROP = failpoints.register("handoff.drop_bundle")
+_FP_CORRUPT = failpoints.register("handoff.corrupt_page")
+_FP_RESERVE = failpoints.register("handoff.reserve_timeout")
+_FP_PREFILL_CRASH = failpoints.register("serving.prefill_crash")
+
+# protocol states (one-way ladder; terminal = DONE)
+_TRANSFER = "transfer"      # reserved + stub launched, bundle in flight
+_DELIVERED = "delivered"    # bundle captured, awaiting router dispatch
+_ARMING = "arming"          # request handed to the decode engine
+_ABORTED = "aborted"        # terminal failure seen; fallback pending
+_DONE = "done"              # armed or fallen back (record retired)
+
+
+class KVBundle(NamedTuple):
+    """One prefill's exported KV in its wire envelope: the
+    ``export_pages`` payload (manifest + per-page CRC32 inside),
+    the prefill's first generated token, and the metadata the arm
+    phase needs to rebuild the decode slot's host/device state."""
+
+    payload: Any        # PagedKVManager.export_pages dict
+    first_token: int    # token the prefill sampled at position n-1
+    prompt_len: int     # n — the arm position
+    bucket: int         # prefill bucket (telemetry parity with admit)
+    nbytes: int         # payload bytes (pt_handoff_bytes_total)
+
+
+class HandoffRecord:
+    """One request's protocol state, shared across the three threads.
+    All mutation goes through coordinator methods (under its lock);
+    the engine-facing methods below are thin delegates so
+    ``serving.py`` needs only the record object, never the module."""
+
+    __slots__ = ("coord", "req", "prefill_idx", "decode_idx", "ticket",
+                 "reserved", "state", "expires_at", "consumed",
+                 "bundle", "launch_ns", "fail_reason")
+
+    def __init__(self, coord, req, prefill_idx, decode_idx, ticket,
+                 reserved, ttl_s):
+        self.coord = coord
+        self.req = req
+        self.prefill_idx = prefill_idx
+        self.decode_idx = decode_idx
+        self.ticket = ticket
+        self.reserved = reserved          # page count the ticket holds
+        self.state = _TRANSFER
+        self.expires_at = time.monotonic() + ttl_s
+        self.consumed = False
+        self.bundle = None
+        self.launch_ns = time.perf_counter_ns()
+        self.fail_reason = None
+
+    # -- decode-engine seam (duck-typed from serving.py's admission) ------
+    def consume(self):
+        """Exactly-once gate: True exactly once, and only while the
+        record is in the arming window."""
+        return self.coord.consume(self)
+
+    def import_failed(self, reason, detail=None):
+        self.coord.import_failed(self, reason, detail)
+
+    def armed(self, slot):
+        self.coord.armed(self, slot)
+
+
+class HandoffCoordinator:
+    """Owns every in-flight :class:`HandoffRecord` for one fleet.
+
+    Thread roles: the router thread launches and pumps; prefill
+    worker threads deliver captured bundles (or report lost stubs);
+    decode worker threads consume/arm/fail records at their admission
+    gate.  ``self._lock`` guards the record table and stats — the
+    cross-thread contract the concurrency lint machine-checks."""
+
+    def __init__(self, fleet, ttl_s=2.0, retry=None):
+        if ttl_s <= 0:
+            raise ValueError("handoff_ttl_s must be > 0")
+        self.fleet = fleet
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.RLock()
+        self._records = []
+        self.stats = self._zero_stats()
+        # reserve-phase retry discipline: small jittered backoff under
+        # the reservation TTL as deadline — exhaustion is NOT an error
+        # surface, it is the signal to fall back to recompute
+        self._retry = retry if retry is not None else RetryPolicy(
+            base=0.002, cap=0.05, max_attempts=3,
+            on_retry=self._count_retry)
+
+    @staticmethod
+    def _zero_stats():
+        return {"launched": 0, "transfers": 0, "fallbacks": 0,
+                "retries": 0, "reserve_expired": 0}
+
+    def _count_retry(self):
+        with self._lock:
+            self.stats["retries"] += 1
+        _obs.inc("pt_handoff_retries_total")
+
+    def snapshot(self):
+        """Stats copy for the router's flight sample / tests."""
+        with self._lock:
+            return dict(self.stats)
+
+    def reset(self):
+        """Drop all protocol state (fleet.reset() already rebuilt the
+        engines, which clears their allocators' reservations)."""
+        with self._lock:
+            for rec in self._records:
+                rec.state = _DONE
+            self._records = []
+            self.stats = self._zero_stats()
+
+    # -- launch (router thread) -------------------------------------------
+    def launch(self, req, prefill_rep):
+        """Start the protocol for a fresh request the router just
+        assigned to ``prefill_rep``: pick the decode home, reserve its
+        pages under the retry policy, then hand the budget-1 stub to
+        the prefill replica.  Any launch-time failure books the
+        fallback immediately (the request never waits on a protocol
+        that cannot start)."""
+        fleet = self.fleet
+        decode = [r for r in fleet._replicas
+                  if r.role == "decode" and r.routable]
+        if not decode:
+            self._fallback(req, "no_decode_replica")
+            return
+        home = min(decode, key=lambda r: (fleet._load(r), r.idx))
+        mgr = home.engine._kv
+        n = int(req.prompt.size)
+        # exact mirror of the stub's admission plan: budget 1 covers
+        # through coverage_page(n, 1, chunk) = page of position n, so
+        # the bundle always carries exactly this many pages
+        est = (min(n + 1, mgr.MAX) - 1) // mgr.page_size + 1
+
+        def reserve():
+            if failpoints._ACTIVE:
+                failpoints.fire(_FP_RESERVE)
+            ticket = mgr.reserve_pages(est)
+            if ticket is None:
+                raise ConnectionError(
+                    f"decode replica {home.idx} cannot hold {est} "
+                    "reserved page(s) (pool pressure)")
+            return ticket
+
+        try:
+            ticket = self._retry.run(
+                reserve, timeout_s=self.ttl_s,
+                describe=f"handoff reserve (request {req.req_id})")
+        except RetryBudgetExceeded:
+            self._fallback(req, "reserve_timeout")
+            return
+        rec = HandoffRecord(self, req, prefill_rep.idx, home.idx,
+                            ticket, est, self.ttl_s)
+        with self._lock:
+            self.stats["launched"] += 1
+            self._records.append(rec)
+        stub = Request(f"{req.req_id}+prefill", req.prompt, 1,
+                       callback=functools.partial(self._captured, rec))
+        stub.handoff_stub = True
+        stub.handoff = rec
+        stub.priority = req.priority
+        stub.affinity_key = req.affinity_key
+        fleet._hand_off(stub, prefill_rep, "prefill")
+
+    # -- capture (prefill worker thread) ----------------------------------
+    def _captured(self, rec, stub, tok, is_last):
+        """The stub's finish callback: fires inside the prefill
+        replica's chunk-boundary sync with the slot still bound —
+        the one window where the slot's pages are exportable."""
+        if not is_last:
+            return
+        if tok is None or stub.slot is None or \
+                stub.finish_reason == "shed":
+            self.stub_lost(rec)
+            return
+        if failpoints._ACTIVE:
+            # mid-transfer prefill death: UNCAUGHT, so it propagates
+            # through _sync/step into the router's replica-death path
+            # with the bundle never delivered
+            failpoints.fire(_FP_PREFILL_CRASH)
+        eng = self.fleet._replicas[rec.prefill_idx].engine
+        try:
+            if failpoints._ACTIVE:
+                failpoints.fire(_FP_DROP)
+            payload = eng._kv.export_pages(stub.slot)
+        except failpoints.FailpointError:
+            return      # bundle lost in transit -> TTL expiry -> fallback
+        if failpoints._ACTIVE:
+            try:
+                failpoints.fire(_FP_CORRUPT)
+            except failpoints.FailpointError:
+                _corrupt_one_page(payload)
+        nbytes = sum(int(buf.nbytes) for layer in payload["layers"]
+                     for buf in layer)
+        bundle = KVBundle(payload=payload, first_token=int(tok),
+                          prompt_len=int(stub.resume_len),
+                          bucket=stub.bucket, nbytes=nbytes)
+        self._deliver(rec, bundle)
+
+    def _deliver(self, rec, bundle):
+        """Attach the captured bundle to its record — only while the
+        record is still live (a late delivery after expiry/abort is
+        ignored; its reservation was already cancelled)."""
+        with self._lock:
+            if rec.state != _TRANSFER or \
+                    time.monotonic() >= rec.expires_at:
+                return
+            pages = len(bundle.payload["logical"])
+            if pages != rec.reserved:
+                # defensive adjust-at-delivery: the estimate mirrors
+                # the stub's plan so this should never fire, but a
+                # mismatched reservation must be swapped, not trusted
+                mgr = self.fleet._replicas[rec.decode_idx].engine._kv
+                mgr.cancel_reservation(rec.ticket)
+                ticket = mgr.reserve_pages(pages)
+                if ticket is None:
+                    rec.state = _ABORTED
+                    rec.fail_reason = "decode_pool_pressure"
+                    rec.ticket = None
+                    return
+                rec.ticket = ticket
+                rec.reserved = pages
+            rec.bundle = bundle
+            rec.state = _DELIVERED
+
+    def stub_lost(self, rec):
+        """The stub died without delivering (replica drain, shed): the
+        protocol cannot complete — abort toward fallback."""
+        with self._lock:
+            if rec.state in (_TRANSFER, _DELIVERED):
+                rec.state = _ABORTED
+                rec.fail_reason = "prefill_replica_death"
+
+    # -- pump (router thread, once per dispatch gap) ----------------------
+    def pump(self):
+        """Advance every record: expire/abort dead transfers, dispatch
+        delivered bundles to their decode home.  Returns the number of
+        requests moved (the router's idle-sleep signal)."""
+        now = time.monotonic()
+        dispatch, fallbacks, expired = [], [], 0
+        with self._lock:
+            keep = []
+            for rec in self._records:
+                if rec.state == _TRANSFER:
+                    if now >= rec.expires_at:
+                        rec.state = _ABORTED
+                        rec.fail_reason = "reserve_ttl_expired"
+                        self.stats["reserve_expired"] += 1
+                        expired += 1
+                    elif not self.fleet._replicas[
+                            rec.prefill_idx].routable:
+                        rec.state = _ABORTED
+                        rec.fail_reason = "prefill_replica_death"
+                if rec.state == _DELIVERED:
+                    rec.state = _ARMING
+                    dispatch.append(rec)
+                elif rec.state == _ABORTED:
+                    fallbacks.append(rec)
+                elif rec.state == _TRANSFER:
+                    keep.append(rec)
+                # _ARMING/_DONE leave the table: an arming record
+                # travels on req.handoff until the admission gate
+                # consumes it (or a decode-replica drain abandons it)
+            self._records = keep
+        if expired:
+            _obs.inc("pt_handoff_reserve_expired_total", expired)
+        for rec in dispatch:
+            home = self.fleet._replicas[rec.decode_idx]
+            if not home.routable:
+                # the decode home died after reserve: its engine was
+                # (or will be) drained and its allocator rebuilt, so
+                # the reservation is gone — plain fallback elsewhere
+                self._fallback(rec.req, "decode_replica_death", rec=rec)
+                continue
+            rec.req.handoff = rec
+            self.fleet._hand_off(rec.req, home, "handoff")
+        for rec in fallbacks:
+            self._fallback(rec.req, rec.fail_reason, rec=rec)
+        return len(dispatch) + len(fallbacks)
+
+    def abandon(self, req):
+        """A request drained off a dead decode replica while arming:
+        retire its record and strip the handoff so the re-route treats
+        it as fresh (it may get a brand-new handoff attempt)."""
+        rec = req.handoff
+        req.handoff = None
+        if rec is None:
+            return
+        with self._lock:
+            rec.state = _DONE
+        self._cancel_reservation(rec)
+
+    # -- decode-engine seam (decode worker thread) ------------------------
+    def consume(self, rec):
+        """Exactly-once arming gate (see :meth:`HandoffRecord.consume`)."""
+        with self._lock:
+            if rec.state != _ARMING or rec.consumed:
+                return False
+            rec.consumed = True
+            return True
+
+    def import_failed(self, rec, reason, detail=None):
+        """Import/arm failed on the decode worker (checksum, unknown
+        ticket, pool pressure): book the fallback accounting; the
+        caller falls through to local re-prefill in the SAME admission,
+        so no dispatch happens here."""
+        with self._lock:
+            rec.state = _DONE
+        self._cancel_reservation(rec)
+        self._book_fallback(rec.req, reason, rec.decode_idx,
+                            detail=detail)
+
+    def armed(self, rec, slot):
+        """The decode slot is live at position k with the prefill's
+        first token: the protocol succeeded end to end."""
+        ms = (time.perf_counter_ns() - rec.launch_ns) / 1e6
+        with self._lock:
+            rec.state = _DONE
+            self.stats["transfers"] += 1
+        _obs.inc("pt_handoff_transfers_total")
+        _obs.inc("pt_handoff_bytes_total", rec.bundle.nbytes)
+        _obs.observe("pt_handoff_transfer_ms", ms)
+        guardian.emit("handoff_transfer", req_id=rec.req.req_id,
+                      pages=len(rec.bundle.payload["logical"]),
+                      bytes=rec.bundle.nbytes,
+                      transfer_ms=round(ms, 3),
+                      src=rec.prefill_idx, dst=rec.decode_idx)
+
+    # -- fallback ladder ---------------------------------------------------
+    def _cancel_reservation(self, rec):
+        with self._lock:
+            ticket, rec.ticket = rec.ticket, None
+        if ticket is None:
+            return
+        # idempotent by the allocator's contract: a ticket already
+        # consumed by import (or wiped by an engine rebuild) is a 0-page
+        # no-op, so abort paths can never double-free
+        self.fleet._replicas[rec.decode_idx].engine._kv \
+            .cancel_reservation(ticket)
+
+    def _book_fallback(self, req, reason, dst, detail=None):
+        with self._lock:
+            self.stats["fallbacks"] += 1
+        # reason is a closed enum (bounded metric-label cardinality);
+        # the free-text detail goes to the guardian event only
+        _obs.inc("pt_handoff_fallbacks_total", reason=reason)
+        guardian.emit("handoff_fallback", req_id=req.req_id,
+                      reason=reason if detail is None
+                      else f"{reason}: {detail}", dst=dst)
+
+    def book_direct_fallback(self, req, reason, dst_idx):
+        """Router-side accounting for a degradation that never entered
+        the protocol (e.g. no live prefill replica: the request routes
+        straight to a decode replica for local prefill)."""
+        self._book_fallback(req, reason, dst_idx)
+
+    def _fallback(self, req, reason, rec=None):
+        """Terminal degradation: retire the record (cancelling its
+        reservation), book the fallback, and dispatch the request to a
+        live replica for local re-prefill — decode replicas preferred,
+        any routable replica if none (the request must complete)."""
+        if rec is not None:
+            with self._lock:
+                rec.state = _DONE
+            self._cancel_reservation(rec)
+            req.handoff = None
+        fleet = self.fleet
+        cands = [r for r in fleet._replicas
+                 if r.routable and r.role == "decode"] or \
+                [r for r in fleet._replicas if r.routable]
+        if not cands:
+            # no live replica at all: park fleet-side — the router's
+            # health check raises (or a replica recovers) before the
+            # request could be lost
+            with fleet._lock:
+                fleet._queue.append(req)
+            return
+        dst = min(cands, key=lambda r: (fleet._load(r), r.idx))
+        self._book_fallback(req, reason, dst.idx)
+        fleet._hand_off(req, dst, "handoff_fallback")
+
+
+def _corrupt_one_page(payload):
+    """Chaos helper for ``handoff.corrupt_page``: flip one byte of the
+    first page's first buffer AFTER the manifest checksums were taken —
+    the import-side CRC verification must reject the bundle whole."""
+    layer0 = list(payload["layers"][0])
+    buf = layer0[0].copy()          # device_get views may be read-only
+    flat = buf.view("uint8").reshape(-1)
+    flat[0] ^= 0xFF
+    layer0[0] = buf
+    payload["layers"][0] = tuple(layer0)

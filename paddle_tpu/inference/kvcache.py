@@ -65,7 +65,10 @@ is bitwise-equivalent to having never been evicted, so the parity
 contract survives preemption).  See docs/serving.md.
 """
 import collections
+import functools
 import hashlib
+import threading
+import zlib
 from typing import Any, NamedTuple
 
 import numpy as np
@@ -75,7 +78,7 @@ import jax.numpy as jnp
 from ..analysis import register_jit_surface
 from .. import observability as _obs
 
-__all__ = ["PagedCacheView", "PagedKVManager",
+__all__ = ["KVBundleError", "PagedCacheView", "PagedKVManager",
            "quantize_kv", "dequantize_kv",
            "chained_page_digests", "prefix_affinity_key"]
 
@@ -94,6 +97,51 @@ for _qual in ("_build_paged_prefill.paged_prefill",
 # decode chunk (budget 1: its state shapes are fixed at construction).
 PREFILL_SURFACE = "serving.paged_prefill"
 DECODE_SURFACE = "serving.paged_decode_chunk"
+
+
+class KVBundleError(ValueError):
+    """An exported KV bundle failed integrity verification on import —
+    torn shape, missing manifest, or a per-page CRC32 mismatch.  Raised
+    BEFORE any page touches the importing pool, so the handoff protocol
+    can reject the bundle whole and fall back to recompute."""
+
+
+def _page_crcs(layers):
+    """Per-page CRC32 over an export payload's host arrays: page ``i``'s
+    checksum chains every layer's every buffer (K, V and — in int8
+    mode — the scale planes) for that page, in layer/buffer order.  The
+    checkpoint-shard integrity discipline (PR 1) applied to the KV
+    wire: a torn or bit-flipped page cannot silently enter a pool."""
+    if not layers:
+        return []
+    n = int(layers[0][0].shape[0])
+    crcs = []
+    for i in range(n):
+        c = 0
+        for pools in layers:
+            for buf in pools:
+                c = zlib.crc32(np.ascontiguousarray(buf[i]).tobytes(), c)
+        crcs.append(c & 0xFFFFFFFF)
+    return crcs
+
+
+def _allocator_locked(fn):
+    """Serialize a :class:`PagedKVManager` host-side mutator under the
+    manager's RLock.  The allocator was engine-thread-private until the
+    handoff protocol (inference/handoff.py): now the router thread
+    reserves/cancels reservation pages while a decode worker plans,
+    binds and releases — free list, refcounts, prefix-cache OrderedDict
+    and the reservation table are all shared mutable state, and the
+    prefix cache's LRU iteration in particular must never interleave
+    with a reclaim.  RLock (not Lock) because locked methods call each
+    other (``plan`` -> ``_alloc`` via ``import_pages``-style nesting is
+    fine either way, but ``clear_prefix`` under a locked caller must
+    not deadlock)."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
 
 
 class PagedCacheView(NamedTuple):
@@ -374,9 +422,15 @@ class PagedKVManager:
             per_tok += 2 * 4 * len(self.spec)        # fp32 scale per row
         self.page_bytes = per_tok * self.page_size
         self.stats = None
+        # cross-thread boundary (ISSUE 16): the router thread calls
+        # reserve_pages/cancel_reservation while the engine worker
+        # plans/binds/releases — every public host-side mutator runs
+        # under this RLock (@_allocator_locked)
+        self._lock = threading.RLock()
         self.reset()
 
     # -- device state ------------------------------------------------------
+    @_allocator_locked
     def reset(self):
         """(Re)build zeroed pools and empty allocator/prefix state; the
         engine's compiled programs are keyed on shapes, so a reset never
@@ -406,6 +460,13 @@ class PagedKVManager:
         # stored token array backs a full-content equality check on hit,
         # keeping the no-collision-holes contract
         self._prefix = collections.OrderedDict()
+        # handoff reservations (ISSUE 16): ticket -> page list, pages
+        # held at refcount 1 between the protocol's reserve and import
+        # phases.  Tracked by the allocator itself so check() stays the
+        # one authority on where every page is — a leaked reservation
+        # is a counted invariant violation, not invisible drift.
+        self._reservations = {}
+        self._next_ticket = 0
         self.stats = {"prefix_hits": 0, "prefix_misses": 0,
                       "prefix_saved_tokens": 0, "pages_evicted": 0,
                       "resident_high_water_bytes": 0,
@@ -501,6 +562,7 @@ class PagedKVManager:
         hi = min(int(pos) + min(int(chunk), int(budget)), self.MAX) - 1
         return hi // self.page_size
 
+    @_allocator_locked
     def plan(self, prompt, budget, chunk, fit=None):
         """Reserve pages for one admission WITHOUT binding a slot:
         longest page-aligned cached prefix (that ``fit`` accepts and
@@ -547,12 +609,14 @@ class PagedKVManager:
         return {"prompt": prompt, "k": k_pages * P,
                 "pages": shared + fresh, "keys": keys}
 
+    @_allocator_locked
     def abandon(self, plan):
         """Release a plan that never got bound (admission raced away)."""
         for p in plan["pages"]:
             self._decref(p)
         self._gauges()
 
+    @_allocator_locked
     def bind(self, slot, plan, register_limit=None):
         """Map a plan's pages into ``slot``'s page table and register
         this prompt's page-aligned prefixes (up to ``register_limit``
@@ -596,6 +660,7 @@ class PagedKVManager:
         return k
 
     # -- steady state ------------------------------------------------------
+    @_allocator_locked
     def ensure(self, slot, through_page):
         """Grow ``slot``'s mapping to cover logical pages
         ``<= through_page``; False when the pool is exhausted (the
@@ -615,6 +680,7 @@ class PagedKVManager:
         self._gauges()
         return True
 
+    @_allocator_locked
     def clear_prefix(self):
         """Drop every prefix-cache entry (their pages free once no slot
         still maps them).  Called on ``refresh_weights``: cached-prefix
@@ -624,6 +690,7 @@ class PagedKVManager:
             pass
         self._gauges()
 
+    @_allocator_locked
     def release(self, slot, evicted=False):
         """Unmap a finished (or preempted) slot: private pages return to
         the free list; prefix-shared pages survive under their cache
@@ -643,44 +710,116 @@ class PagedKVManager:
 
     # -- disaggregation seam (prefill/decode split) ------------------------
     def export_pages(self, slot):
-        """KV-page handoff seam toward prefill/decode disaggregation
+        """KV-page handoff seam for prefill/decode disaggregation
         (ROADMAP "Internet-scale serving tier"; PAPERS.md portable
         collective redistribution): snapshot a slot's mapped pages as
         host arrays so a prefill-specialized replica can stream
         finished KV into a decode replica's pool.  Deliberately OFF the
         chunk hot path — the single bundled ``device_get`` here is the
-        budgeted sync (HOST_SYNC_ALLOWLIST), and the router does not
-        call this yet: it is the seam the disaggregated tier will plug
-        into, shaped so the transport (host copy today, ICI/DMA later)
-        is the only thing left to swap.
+        budgeted sync (HOST_SYNC_ALLOWLIST); ``inference/handoff.py``
+        wraps the payload in the fleet's checksummed :class:`KVBundle`
+        envelope, shaped so the transport (host copy today, ICI/DMA
+        later) is the only thing left to swap.
 
         Returns ``{"logical": [logical pages, ascending], "layers":
         [per-layer tuples of (k, page_size, nH, D) page stacks],
-        "quant": bool}``.
+        "quant": bool, "manifest": {...}}``.  The manifest carries the
+        page count/size, dtype, layer spec and a per-page CRC32 chain
+        over every buffer (scales included in int8 mode) —
+        :meth:`import_pages` refuses the payload whole on any mismatch.
         """
         mapping = self._slot_pages[slot]
         order = sorted(mapping)
         phys = np.asarray([mapping[j] for j in order], np.int32)
         layers = jax.device_get(
             [tuple(buf[phys] for buf in pools) for pools in self._pools])
-        return {"logical": order, "layers": layers, "quant": self.quant}
+        manifest = {
+            "pages": len(order),
+            "page_size": self.page_size,
+            "dtype": "int8" if self.quant else str(self.cache_dtype),
+            "layers": len(self.spec),
+            "positions": [int(j) for j in order],
+            "crc32": _page_crcs(layers),
+        }
+        return {"logical": order, "layers": layers, "quant": self.quant,
+                "manifest": manifest}
 
-    def import_pages(self, slot, payload):
-        """Inverse seam: allocate fresh pages for ``slot`` and write an
-        :meth:`export_pages` payload into this pool (same layer spec,
-        same page size, same quant mode).  Returns the number of pages
-        imported; raises when the pool cannot hold them (the decode
-        replica's admission gate decides before calling)."""
+    def _verify_payload(self, payload):
+        """Integrity gate for :meth:`import_pages`: every structural
+        field and every per-page CRC32 must verify BEFORE any page
+        touches the pool — a torn or corrupt bundle is rejected whole
+        (:class:`KVBundleError`), leaving allocator and pools
+        untouched."""
+        man = payload.get("manifest")
+        if not man:
+            raise KVBundleError(
+                "KV bundle has no integrity manifest — refusing the "
+                "unverifiable import (re-export with this release's "
+                "export_pages)")
+        order = list(payload["logical"])
+        layers = payload["layers"]
+        want_dtype = "int8" if self.quant else str(self.cache_dtype)
+        if (man.get("pages") != len(order)
+                or man.get("positions") != [int(j) for j in order]
+                or len(man.get("crc32", ())) != len(order)):
+            raise KVBundleError(
+                f"torn KV bundle: manifest covers {man.get('pages')} "
+                f"page(s) at positions {man.get('positions')} but the "
+                f"payload carries {len(order)} ({order})")
+        if man.get("page_size") != self.page_size \
+                or man.get("layers") != len(self.spec) \
+                or len(layers) != len(self.spec):
+            raise KVBundleError(
+                f"KV bundle layout mismatch: bundle page_size="
+                f"{man.get('page_size')}/{man.get('layers')} layer(s) "
+                f"vs pool page_size={self.page_size}/"
+                f"{len(self.spec)} layer(s)")
+        if man.get("dtype") != want_dtype:
+            raise KVBundleError(
+                f"KV bundle dtype {man.get('dtype')!r} != pool dtype "
+                f"{want_dtype!r}")
+        got = _page_crcs(layers)
+        if got != list(man["crc32"]):
+            bad = [order[i] for i, (a, b)
+                   in enumerate(zip(got, man["crc32"])) if a != b]
+            raise KVBundleError(
+                f"KV bundle checksum mismatch on logical page(s) {bad} "
+                "— rejecting the bundle whole (no page touched the "
+                "pool)")
+
+    @_allocator_locked
+    def import_pages(self, slot, payload, ticket=None):
+        """Inverse seam: verify an :meth:`export_pages` payload, then
+        write it into pages of this pool mapped to ``slot`` (same layer
+        spec, same page size, same quant mode).  Verification is
+        all-before-anything: a torn/corrupt bundle raises
+        :class:`KVBundleError` with the pool untouched.  ``ticket``
+        consumes pages held by :meth:`reserve_pages` (the handoff
+        protocol's reserve phase) instead of allocating fresh ones.
+        Returns the number of pages imported; raises when the pool
+        cannot hold them (the decode replica's admission gate decides
+        before calling)."""
         if bool(payload["quant"]) != self.quant:
-            raise ValueError("exporter/importer kv quant modes differ")
+            raise KVBundleError("exporter/importer kv quant modes differ")
+        self._verify_payload(payload)
         order = list(payload["logical"])
         mapping = self._slot_pages[slot]
         assert not mapping, f"slot {slot} imported while still mapped"
-        fresh = self._alloc(len(order))
-        if fresh is None:
-            raise RuntimeError(
-                f"pool cannot hold {len(order)} imported pages "
-                f"({len(self._free)} free)")
+        if ticket is not None:
+            held = self._reservations.get(ticket)
+            if held is None:
+                raise KeyError(f"unknown/expired reservation {ticket}")
+            if len(held) != len(order):
+                raise ValueError(
+                    f"reservation {ticket} holds {len(held)} page(s) "
+                    f"but the bundle carries {len(order)}")
+            fresh = self._reservations.pop(ticket)
+        else:
+            fresh = self._alloc(len(order))
+            if fresh is None:
+                raise RuntimeError(
+                    f"pool cannot hold {len(order)} imported pages "
+                    f"({len(self._free)} free)")
         row = self.table[slot]
         for j, page in zip(order, fresh):
             row[j] = page
@@ -693,7 +832,42 @@ class PagedKVManager:
         self._gauges()
         return len(fresh)
 
+    # -- handoff reservations (ISSUE 16) -----------------------------------
+    @_allocator_locked
+    def reserve_pages(self, count):
+        """Atomically hold ``count`` pages under a reservation ticket
+        (the handoff protocol's *reserve* phase): all-or-nothing like
+        :meth:`_alloc`, returns the ticket or None under pool pressure.
+        Reserved pages count as in-use (no slot may take them) until
+        :meth:`import_pages` consumes the ticket or
+        :meth:`cancel_reservation` returns them — the TTL that bounds a
+        reservation's life belongs to the protocol layer
+        (``inference/handoff.py``), which owns the clock."""
+        pages = self._alloc(count)
+        if pages is None:
+            return None
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._reservations[ticket] = pages
+        self._gauges()
+        return ticket
+
+    @_allocator_locked
+    def cancel_reservation(self, ticket):
+        """Release a reservation's pages back to the pool (expiry or
+        protocol abort); returns the page count freed (0 for an
+        unknown/already-consumed ticket — cancel is idempotent so an
+        expiry sweep racing a successful import never double-frees)."""
+        pages = self._reservations.pop(ticket, None)
+        if pages is None:
+            return 0
+        for p in pages:
+            self._decref(p)
+        self._gauges()
+        return len(pages)
+
     # -- invariants (test hook) --------------------------------------------
+    @_allocator_locked
     def check(self):
         """Assert the allocator invariants; returns True for test
         convenience."""
@@ -702,6 +876,9 @@ class PagedKVManager:
             for page in mapping.values():
                 refs[page] += 1
         for pages, _ in self._prefix.values():
+            for page in pages:
+                refs[page] += 1
+        for pages in self._reservations.values():
             for page in pages:
                 refs[page] += 1
         assert np.array_equal(refs, self._ref), \

@@ -58,9 +58,12 @@ Shared fleet state is guarded by ``self._lock`` (machine-checked by
 the ``concurrency`` lint pass; the module is declared in
 ``CONCURRENCY_MODULES`` / ``CONCURRENT_CLASSES``).
 
-The prefill/decode disaggregation seam —
-``PagedKVManager.export_pages`` / ``import_pages`` — is shaped but not
-yet routed through here; see docs/serving.md "Serving fleet".
+Disaggregated prefill/decode: ``roles=("prefill", "decode", ...)``
+specializes replicas — fresh prompts route to prefill replicas, which
+export their finished prefill KV as a checksummed bundle that arms a
+slot on a pre-reserved decode home (``inference/handoff.py`` owns the
+protocol and its failure ladder; decode replicas never run prompt
+prefill except as the protocol's local-recompute fallback).
 """
 import itertools
 import threading
@@ -94,11 +97,12 @@ class _Replica:
     ``state`` only after the worker is confirmed dead/joined)."""
 
     __slots__ = ("idx", "engine", "thread", "wake", "retire", "beat_ns",
-                 "alive", "stale", "error", "state")
+                 "alive", "stale", "error", "state", "role")
 
-    def __init__(self, idx, engine):
+    def __init__(self, idx, engine, role=None):
         self.idx = idx
         self.engine = engine
+        self.role = role        # None | "prefill" | "decode"
         self.thread = None
         self.wake = threading.Event()
         self.retire = threading.Event()
@@ -150,7 +154,13 @@ class ServingFleet:
       the queue-wait projection (EWMA of finished requests otherwise;
       until either exists the projection is 0 and nothing is shed);
     - ``scale_up_queue_per_replica`` / ``scale_down_occupancy``:
-      thresholds for :meth:`autoscale_recommendation`.
+      thresholds for :meth:`autoscale_recommendation`;
+    - ``roles``: one of ``"prefill"``/``"decode"`` per replica enables
+      disaggregated serving (requires ``kv_mode="paged"``; at least
+      one of each) — see ``inference/handoff.py`` and docs/serving.md;
+    - ``handoff_ttl_s``: reservation TTL + transfer deadline for the
+      disaggregated handoff (a dead prefill replica can never leak its
+      decode home's pool pages past this).
 
     Caveat: replicas share ``model``'s parameter arrays (read-only), so
     memory scales with KV pools, not weights.  MoE models record aux
@@ -164,7 +174,8 @@ class ServingFleet:
                  overload_policy="shed", replica_queue_limit=None,
                  heartbeat_timeout=10.0, service_ms_prior=None,
                  scale_up_queue_per_replica=4.0,
-                 scale_down_occupancy=0.25, **engine_kwargs):
+                 scale_down_occupancy=0.25, roles=None,
+                 handoff_ttl_s=2.0, **engine_kwargs):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         if overload_policy not in ("shed", "defer"):
@@ -172,6 +183,28 @@ class ServingFleet:
                              "in ('shed', 'defer')")
         if aging_ms <= 0:
             raise ValueError("aging_ms must be > 0")
+        if roles is not None:
+            roles = tuple(roles)
+            if len(roles) != num_replicas:
+                raise ValueError(
+                    f"roles must name all {num_replicas} replicas "
+                    f"(got {len(roles)})")
+            bad = set(roles) - {"prefill", "decode"}
+            if bad:
+                raise ValueError(f"unknown replica roles {sorted(bad)} "
+                                 "(want 'prefill'/'decode')")
+            if "prefill" not in roles or "decode" not in roles:
+                raise ValueError("disaggregated fleet needs at least "
+                                 "one prefill and one decode replica")
+            if engine_kwargs.get("kv_mode") != "paged":
+                raise ValueError(
+                    "disaggregated prefill/decode requires "
+                    "kv_mode='paged' (the handoff moves KV pages)")
+            if engine_kwargs.get("spec_decode") is not None:
+                raise ValueError(
+                    "disaggregated roles do not support spec_decode "
+                    "(draft KV does not travel in the bundle)")
+        self.roles = roles
         self.model = model
         self._engine_kwargs = dict(engine_kwargs)
         self.affinity_pages = int(affinity_pages)
@@ -200,11 +233,18 @@ class ServingFleet:
         self._last_flight_ns = 0     # router-gap sample throttle
         self.stats = None
         self._init_stats()
-        self._replicas = [_Replica(i, self._make_engine(i))
-                          for i in range(num_replicas)]
+        self._replicas = [
+            _Replica(i, self._make_engine(i),
+                     role=None if roles is None else roles[i])
+            for i in range(num_replicas)]
         if replica_queue_limit is None:
             replica_queue_limit = self._replicas[0].engine.num_slots
         self.replica_queue_limit = int(replica_queue_limit)
+        if roles is None:
+            self._handoff = None
+        else:
+            from .handoff import HandoffCoordinator
+            self._handoff = HandoffCoordinator(self, ttl_s=handoff_ttl_s)
 
     def _make_engine(self, idx=None):
         eng = ServingEngine(self.model, **self._engine_kwargs)
@@ -337,6 +377,10 @@ class ServingFleet:
                 self._check_health()
                 moved = self._dispatch()
                 self._rebalance()
+                if self._handoff is not None:
+                    # advance the prefill/decode protocol: dispatch
+                    # delivered bundles, expire/fallback dead transfers
+                    moved += self._handoff.pump()
                 if not threads:
                     for rep in self._replicas:
                         if rep.routable and rep.engine.scheduler.has_work:
@@ -397,6 +441,10 @@ class ServingFleet:
         for rep in self._replicas:
             if rep.state == _UP:
                 rep.engine.reset()
+        if self._handoff is not None:
+            # after the engine resets: their rebuilt allocators already
+            # dropped every reservation the records may still hold
+            self._handoff.reset()
         self._init_stats()
 
     # -- lifecycle ---------------------------------------------------------
@@ -404,7 +452,8 @@ class ServingFleet:
         """Scale-up hook: build one more engine replica (same config)
         and make it routable immediately.  Returns its index."""
         rep = _Replica(len(self._replicas),
-                       self._make_engine(len(self._replicas)))
+                       self._make_engine(len(self._replicas)),
+                       role=None if self._handoff is None else "decode")
         with self._lock:
             self._replicas.append(rep)
         if self._threads_running:
@@ -506,6 +555,10 @@ class ServingFleet:
             rep.alive = False
             return
         if finished:
+            # budget-1 handoff stubs are protocol internals: they must
+            # never enter the fleet's finished stats or service EWMA
+            finished = [r for r in finished if not r.handoff_stub]
+        if finished:
             with self._lock:
                 self._finished.extend(finished)
 
@@ -513,6 +566,21 @@ class ServingFleet:
         """Drain a dead/retired replica's engine and park the requests
         back on the fleet queue for re-routing (resume by recompute)."""
         reqs = rep.engine.drain()
+        if self._handoff is not None:
+            live = []
+            for r in reqs:
+                if r.handoff_stub:
+                    # a drained stub's transfer can never complete:
+                    # abort the record toward its fallback (the REAL
+                    # request was never on this replica)
+                    if r.handoff is not None:
+                        self._handoff.stub_lost(r.handoff)
+                    continue
+                # a real request drained mid-arm re-routes as fresh —
+                # retire its record and free the reservation
+                self._handoff.abandon(r)
+                live.append(r)
+            reqs = live
         now = time.perf_counter_ns()
         with self._lock:
             for r in reqs:
@@ -579,6 +647,17 @@ class ServingFleet:
         already decided (see :meth:`_load`).  ``(None, None)`` = every
         live replica is at its queue limit (backpressure: the request
         stays fleet-side where priority order keeps applying)."""
+        pool = self._replicas
+        if self._handoff is not None:
+            # role-bound routing: fresh prompts go to prefill replicas
+            # (the handoff launch point), resumed/fallen-back requests
+            # to decode replicas.  Only when a role has NO live member
+            # does the pool degrade to the whole fleet — the failure
+            # ladder's last rung before "no live replicas" raises
+            want = "decode" if req.tokens else "prefill"
+            role_pool = [r for r in self._replicas if r.role == want]
+            if any(r.routable for r in role_pool):
+                pool = role_pool
         key = req.affinity_key
         home = None
         if key is not None:
@@ -588,11 +667,11 @@ class ServingFleet:
                 # warmth is worth a deeper queue: the affinity home
                 # admits up to 2x the normal queue limit before the
                 # request spills to least-loaded
-                if home.routable and self._has_room(
+                if home.routable and home in pool and self._has_room(
                         home, pending.get(home.idx, 0),
                         limit=2 * self.replica_queue_limit):
                     return home, "affinity"
-        cands = [r for r in self._replicas
+        cands = [r for r in pool
                  if r.routable and self._has_room(r, pending.get(r.idx,
                                                                  0))]
         if not cands:
@@ -648,6 +727,19 @@ class ServingFleet:
         for req, proj in sheds:
             self._finalize_shed(req, proj)
         for req, rep, reason in routed:
+            if self._handoff is not None and not req.tokens:
+                # disaggregated fleet: a fresh prompt landing on a
+                # prefill replica enters the handoff protocol; one
+                # landing anywhere else (no live prefill replica — the
+                # degraded rung) is a booked fallback that prefills
+                # locally on its destination
+                if rep.role == "prefill":
+                    self._handoff.launch(req, rep)
+                    continue
+                self._handoff.book_direct_fallback(
+                    req, "no_prefill_replica", rep.idx)
+                self._hand_off(req, rep, "handoff_fallback")
+                continue
             self._hand_off(req, rep, reason)
         if _obs.enabled():
             _obs.set_gauge("pt_router_queue_depth", depth)
@@ -668,6 +760,8 @@ class ServingFleet:
                 up = [r for r in self._replicas if r.state == _UP]
                 with self._lock:
                     snap = dict(self.stats)
+                ho = None if self._handoff is None \
+                    else self._handoff.snapshot()
                 _obs.flight.record(
                     "router_gap", queue_depth=depth,
                     requests=snap["requests"], shed=snap["shed"],
@@ -677,7 +771,11 @@ class ServingFleet:
                     max_beat_age_s=round(
                         max(((n2 - r.beat_ns) / 1e9 for r in up),
                             default=0.0), 3)
-                    if self._threads_running else 0.0)
+                    if self._threads_running else 0.0,
+                    handoff_transfers=0 if ho is None
+                    else ho["transfers"],
+                    handoff_fallbacks=0 if ho is None
+                    else ho["fallbacks"])
         return len(routed) + len(sheds)
 
     def _route_span_start(self, req):
@@ -734,6 +832,11 @@ class ServingFleet:
         no replica's FCFS head-of-line contract is disturbed, and the
         re-route books a normal `route` span with reason
         ``rebalance``."""
+        if self._handoff is not None:
+            # disaggregated fleet: queued work is role-bound (stubs on
+            # prefill replicas, arming requests on their decode home) —
+            # cross-replica stealing would tear the protocol
+            return
         while True:
             idle = [r for r in self._replicas if r.routable
                     and r.engine.scheduler.queue_depth == 0

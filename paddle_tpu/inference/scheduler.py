@@ -43,7 +43,7 @@ class Request:
                  "spec_accepted", "trace_id", "span_ns", "requeue_ns",
                  "prefix_cached", "bucket", "decode_ms", "priority",
                  "slo_ttft_ms", "replica", "route_ns", "route_reason",
-                 "affinity_key")
+                 "affinity_key", "handoff", "handoff_stub")
 
     def __init__(self, req_id, prompt, max_new_tokens, callback=None):
         self.req_id = req_id
@@ -95,6 +95,14 @@ class Request:
         self.route_ns = None
         self.route_reason = None
         self.affinity_key = None
+        # disaggregated prefill/decode (inference/handoff.py): a real
+        # request carries its HandoffRecord from delivery until the
+        # decode engine's admission gate consumes it (import-or-
+        # fallback); handoff_stub marks the budget-1 prefill clone the
+        # coordinator launches on a prefill replica — stubs never enter
+        # router stats, finished collection, or death requeue
+        self.handoff = None
+        self.handoff_stub = False
 
     @property
     def done(self):

@@ -717,6 +717,44 @@ class ServingEngine:
                 [req.prompt, np.asarray(req.tokens, np.int32)])
         return req.prompt
 
+    def _import_bundle(self, req, slot, h):
+        """Import phase of the prefill/decode handoff (the decode-side
+        half of ``inference/handoff.py``): verify + scatter the
+        checksummed bundle into this engine's pool under the
+        reservation ticket, then extend the slot's page table to cover
+        the first decode chunk.  Any failure leaves the pool untouched
+        (checksum verification precedes every write; a coverage
+        shortfall releases exactly the just-imported mapping) and
+        books the fallback on the record — the caller then falls
+        through to a local re-prefill."""
+        from .kvcache import KVBundleError
+        try:
+            self._kv.import_pages(slot, h.bundle.payload,
+                                  ticket=h.ticket)
+        except (KVBundleError, KeyError, ValueError, RuntimeError) as e:
+            h.import_failed("import_rejected", detail=e)
+            return False
+        n = int(h.bundle.prompt_len)
+        budget = req.max_new_tokens - len(req.tokens)
+        # the bundle maps pages only through position n, but the first
+        # decode chunk runs in THIS step (after _page_pressure already
+        # passed): grow coverage now or the chunk's scatter would land
+        # in the trash page and silently corrupt the slot
+        unresumable = n + budget > self.buckets[-1]
+        horizon = budget if unresumable else self.chunk
+        if not self._kv.ensure(slot,
+                               self._kv.coverage_page(n, budget,
+                                                      horizon)):
+            self._kv.release(slot)
+            h.import_failed("decode_pool_pressure")
+            return False
+        # the import rebuilt the manager's pool arrays: refresh the
+        # engine's handles NOW so a normal admission later in this
+        # same gap prefills against (and set_pools preserves) the
+        # imported data instead of clobbering it with stale pools
+        self._pools = self._kv.device_pools()
+        return True
+
     def _admit(self):
         """Admit queued requests into free slots (bounded by the
         interleave knob): one compiled bucket prefill each, KV written
@@ -725,9 +763,22 @@ class ServingEngine:
         Returns the pending (request, first-token, finished-flag) device
         handles — read back at the chunk-boundary sync, never here."""
         pending = []
-        bound, can_admit = {}, None
+        bound, armed, can_admit = {}, {}, None
         if self._paged:
             def can_admit(req, slot):
+                h = req.handoff
+                if h is not None:
+                    # disaggregated prefill/decode (inference/
+                    # handoff.py): single-shot — whatever happens in
+                    # the import, a later (re-)admission of this
+                    # request must take the normal resume path below
+                    req.handoff = None
+                    if h.consume() and self._import_bundle(req, slot, h):
+                        armed[req.req_id] = h
+                        return True
+                    # fall through: local re-prefill on THIS replica —
+                    # the protocol's fallback leg runs inside the same
+                    # admission, so FCFS head-of-line order holds
                 # reserve AND bind here (atomically per admission) so a
                 # later admission in the same gap can already hit this
                 # prompt's freshly registered prefix pages
@@ -769,6 +820,39 @@ class ServingEngine:
                 bound[req.req_id] = (rp, k)
                 return True
         for req, slot in self.scheduler.admissions(can_admit):
+            if req.req_id in armed:
+                # arm phase of the prefill/decode handoff: the slot's
+                # KV pages were imported (checksum-verified) in the
+                # gate above — rebuild host/device state exactly as
+                # the compiled prefill would have left it (position n,
+                # first token seeded, budget-1 remaining) and skip the
+                # prefill dispatch entirely: no suffix re-prefill
+                h = armed.pop(req.req_id)
+                n = int(h.bundle.prompt_len)
+                budget = req.max_new_tokens - len(req.tokens)
+                t0 = int(h.bundle.first_token)
+                fin0 = (self.eos is not None and t0 == self.eos) \
+                    or budget <= 1
+                self._tokens = self._tokens.at[slot].set(t0)
+                self._pos = self._pos.at[slot].set(n)
+                self._active = self._active.at[slot].set(not fin0)
+                self._remaining = self._remaining.at[slot].set(budget - 1)
+                req.prefix_cached = 0
+                req.resume_len = n
+                req.emitted_since_admit = 0
+                req.bucket = h.bundle.bucket
+                pending.append((req, slot, t0, fin0))
+                h.armed(slot)
+                guardian.emit("serving_admit", req_id=req.req_id,
+                              slot=slot,
+                              queue_depth=self.scheduler.queue_depth,
+                              prompt_len=n, bucket=h.bundle.bucket)
+                if _obs.enabled():
+                    _obs.inc("pt_serving_admissions_total")
+                    if req.evictions == 0:
+                        _obs.observe("pt_serving_queue_wait_ms",
+                                     req.queue_wait_ms)
+                continue
             if self._paged:
                 rp, k = bound.pop(req.req_id)
                 n, m = int(rp.size), int(rp.size) - k

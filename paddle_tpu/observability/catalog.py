@@ -144,6 +144,37 @@ METRICS = {
         "help": "latest autoscale recommendation: +1 scale up, -1 "
                 "scale down, 0 steady (keyed on queue-depth and "
                 "occupancy)"},
+    # -- prefill/decode handoff (inference/handoff.py) --------------------
+    "pt_handoff_transfers_total": {
+        "type": _C, "labels": (),
+        "help": "KV bundles that completed the full reserve -> import "
+                "-> arm protocol (the decode slot armed without any "
+                "suffix re-prefill)"},
+    "pt_handoff_bytes_total": {
+        "type": _C, "labels": (),
+        "help": "payload bytes of successfully armed KV bundles "
+                "(page buffers incl. int8 scale planes)"},
+    "pt_handoff_retries_total": {
+        "type": _C, "labels": (),
+        "help": "retried handoff protocol attempts (jittered backoff "
+                "under the reservation TTL, framework/retry.py)"},
+    "pt_handoff_fallbacks_total": {
+        "type": _C, "labels": ("reason",),
+        "help": "requests degraded to local re-prefill on a decode "
+                "replica, by terminal failure: prefill_replica_death | "
+                "reserve_timeout | reserve_ttl_expired | "
+                "decode_pool_pressure | decode_replica_death | "
+                "no_decode_replica | no_prefill_replica | "
+                "import_rejected (checksum/manifest)"},
+    "pt_handoff_reserve_expired_total": {
+        "type": _C, "labels": (),
+        "help": "page reservations released by TTL expiry (the bundle "
+                "never arrived — a dead prefill replica cannot leak "
+                "its decode home's pool pages)"},
+    "pt_handoff_transfer_ms": {
+        "type": _H, "labels": (),
+        "help": "launch -> slot-armed wall per successful handoff "
+                "(reserve + stub prefill + export/verify/import)"},
     # -- paged KV cache (inference/kvcache.py) ----------------------------
     "pt_kvcache_pages_in_use": {
         "type": _G, "labels": (),

@@ -10,6 +10,9 @@ probable causes with the evidence lines that support each verdict —
 - ``replica_death``      — a fleet replica crashed and was drained;
 - ``straggler_replica``  — one replica served markedly slower than its
   peers (or hung with a stale heartbeat);
+- ``handoff_failure``    — the disaggregated prefill/decode protocol
+  degraded requests to local re-prefill (dropped/corrupt bundles,
+  reservation expiries, prefill deaths mid-transfer);
 - ``numeric_instability``— the guardian ladder fired (sentinel trips,
   loss spikes, a rollback);
 - ``retrace_storm``      — hot jit surfaces recompiled past budget;
@@ -44,8 +47,9 @@ __all__ = ["load_bundle", "evidence_from_sinks", "diagnose", "render",
            "run_cli", "INCIDENT_CAUSES"]
 
 INCIDENT_CAUSES = ("replica_death", "straggler_replica",
-                   "numeric_instability", "retrace_storm",
-                   "overload_shed", "throughput_collapse")
+                   "handoff_failure", "numeric_instability",
+                   "retrace_storm", "overload_shed",
+                   "throughput_collapse")
 # the roofline-attribution causes: informational unless an alert exists
 PERF_CAUSES = ("dispatch_bound", "memory_bound", "compute_bound")
 
@@ -305,6 +309,31 @@ def diagnose(ev):
         lines.append(f"request lanes: replica {worst} mean tpot "
                      f"{mean:.2f}ms vs peer median {median:.2f}ms")
     add("straggler_replica", score, lines)
+
+    # prefill/decode handoff degradation: every fallback event is one
+    # request that paid a local re-prefill (output stayed bitwise —
+    # this diagnoses the TTFT/availability regression, not corruption)
+    falls = _events(ev, "handoff_fallback")
+    score, lines = 0.0, []
+    for e in falls[:6]:
+        lines.append(f"guardian: request {e.get('req_id')} fell back "
+                     f"to local re-prefill on replica {e.get('dst')} "
+                     f"({e.get('reason')})")
+    if falls:
+        score += 10 * len(falls)
+        if len(falls) > 6:
+            lines.append(f"... and {len(falls) - 6} more fallback(s)")
+    else:
+        n = _metric_total(ev, "pt_handoff_fallbacks_total") or 0
+        if n:
+            score += 6 * n
+            lines.append(f"pt_handoff_fallbacks_total = {n:g}")
+    n = _metric_total(ev, "pt_handoff_reserve_expired_total") or 0
+    if n:
+        score += 2
+        lines.append(f"pt_handoff_reserve_expired_total = {n:g} "
+                     "(bundles never arrived; reservations TTL-freed)")
+    add("handoff_failure", score, lines)
 
     # numeric instability
     score, lines = 0.0, []

@@ -1,0 +1,367 @@
+"""Fault-tolerant disaggregated prefill/decode (inference/handoff.py):
+the reserve -> transfer -> import -> arm protocol end to end, and its
+failure ladder under chaos.
+
+The parity contract is the real check: whatever the protocol does —
+complete the handoff, or degrade to local re-prefill after a dropped
+bundle, a flipped byte, a reservation timeout/expiry, or a prefill
+replica dying mid-transfer — greedy output must match ``generate()``
+token for token, every degradation must book exactly one
+``handoff_fallback`` event, and every allocator must come out of the
+run with ``check()`` clean (no leaked pages, no stuck reservations).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.framework import failpoints, guardian
+from paddle_tpu.inference import handoff, kvcache
+from paddle_tpu.inference.router import ServingFleet
+from paddle_tpu.observability import tracing
+from paddle_tpu.models import GPTForPretraining, gpt3_tiny
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    return GPTForPretraining(gpt3_tiny())
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.enable(True)     # the elastic suite leaves the front door off
+    obs.get_registry().reset()
+    tracing.reset()
+    guardian.clear_events()
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _gen(gpt, prompt, n):
+    ids, _ = gpt.generate(paddle.to_tensor(prompt[None, :]),
+                          max_new_tokens=n)
+    return np.asarray(ids._value)[0]
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 1024, (n,)).astype("int32") for n in lens]
+
+
+PROMPT_LENS = (5, 11, 8, 9)
+BUDGET = 6
+
+
+def _make_fleet(gpt):
+    return ServingFleet(gpt, num_replicas=2, num_slots=2, chunk=4,
+                        kv_mode="paged", page_size=8,
+                        prefill_buckets=(8, 16, 32), max_seq_len=128,
+                        roles=("prefill", "decode"), handoff_ttl_s=60.0)
+
+
+@pytest.fixture(scope="module")
+def pd_fleet(gpt):
+    """Shared prefill+decode fleet (compiles once per module); tests
+    ``reset()`` it and may shrink ``_handoff.ttl_s`` (restored there).
+    Tests that KILL a replica must build their own — ``reset()``
+    deliberately never revives the dead."""
+    fleet = _make_fleet(gpt)
+    yield fleet
+
+
+@pytest.fixture(scope="module")
+def refs(gpt):
+    return [_gen(gpt, p, BUDGET)
+            for p in _prompts(21, PROMPT_LENS)]
+
+
+def _run(fleet, threads=False):
+    reqs = [fleet.submit(p, BUDGET) for p in _prompts(21, PROMPT_LENS)]
+    fleet.run(threads=threads, timeout=300)
+    return reqs
+
+
+def _assert_bitwise(reqs, refs):
+    for r, ref in zip(reqs, refs):
+        assert r.finish_reason in ("eos", "budget")
+        np.testing.assert_array_equal(np.asarray(r.tokens, np.int32),
+                                      ref)
+
+
+def _assert_clean(fleet):
+    for rep in fleet.replicas:
+        if rep.state == "up":
+            assert rep.engine._kv.check()
+            assert not rep.engine._kv._reservations
+
+
+class TestDisaggregatedHappyPath:
+    def test_bitwise_and_decode_never_prefills(self, gpt, pd_fleet,
+                                               refs):
+        """The tentpole contract: every fresh prompt prefills on the
+        prefill replica, its KV crosses as a checksummed bundle, and
+        the decode replica arms the slot WITHOUT running any prompt
+        prefill — output bitwise-equal to ``generate()``."""
+        pd_fleet.reset()
+        reqs = _run(pd_fleet)
+        _assert_bitwise(reqs, refs)
+        _assert_clean(pd_fleet)
+        stats = pd_fleet._handoff.snapshot()
+        assert stats["transfers"] == len(reqs)
+        assert stats["fallbacks"] == 0
+        by_role = {r.role: r for r in pd_fleet.replicas}
+        assert by_role["decode"].engine.stats["prefills"] == 0
+        assert by_role["prefill"].engine.stats["prefills"] == len(reqs)
+        evs = guardian.events("handoff_transfer")
+        assert len(evs) == len(reqs)
+        for e in evs:
+            assert e["src"] == by_role["prefill"].idx
+            assert e["dst"] == by_role["decode"].idx
+            assert e["pages"] >= 1 and e["bytes"] > 0
+        reg = obs.get_registry()
+        assert reg.get("pt_handoff_transfers_total").value() == len(reqs)
+        assert reg.get("pt_handoff_bytes_total").value() > 0
+
+    def test_threaded_bitwise(self, gpt, pd_fleet, refs):
+        pd_fleet.reset()
+        reqs = _run(pd_fleet, threads=True)
+        _assert_bitwise(reqs, refs)
+        _assert_clean(pd_fleet)
+        assert pd_fleet._handoff.snapshot()["transfers"] + \
+            pd_fleet._handoff.snapshot()["fallbacks"] >= len(reqs)
+
+    def test_roles_validation(self, gpt):
+        with pytest.raises(ValueError, match="at least one"):
+            ServingFleet(gpt, num_replicas=2, kv_mode="paged",
+                         page_size=8, num_slots=2,
+                         prefill_buckets=(8, 16), max_seq_len=64,
+                         roles=("prefill", "prefill"))
+        with pytest.raises(ValueError, match="paged"):
+            ServingFleet(gpt, num_replicas=2, num_slots=2,
+                         prefill_buckets=(8, 16),
+                         roles=("prefill", "decode"))
+        with pytest.raises(ValueError, match="all 2 replicas"):
+            ServingFleet(gpt, num_replicas=2, kv_mode="paged",
+                         page_size=8, num_slots=2,
+                         prefill_buckets=(8, 16), max_seq_len=64,
+                         roles=("prefill",))
+        with pytest.raises(ValueError, match="unknown replica roles"):
+            ServingFleet(gpt, num_replicas=2, kv_mode="paged",
+                         page_size=8, num_slots=2,
+                         prefill_buckets=(8, 16), max_seq_len=64,
+                         roles=("prefill", "verify"))
+
+
+@pytest.mark.chaos
+class TestHandoffChaos:
+    """Each failpoint drives one rung of the failure ladder; every rung
+    must converge on bitwise output, one fallback event per degraded
+    request, and zero leaked pages/reservations."""
+
+    def _chaos(self, fleet, refs, fp, spec, ttl=None):
+        fleet.reset()
+        guardian.clear_events()
+        old_ttl = fleet._handoff.ttl_s
+        if ttl is not None:
+            fleet._handoff.ttl_s = ttl
+        failpoints.set_failpoint(fp, spec)
+        try:
+            reqs = _run(fleet)
+        finally:
+            failpoints.clear()
+            fleet._handoff.ttl_s = old_ttl
+        _assert_bitwise(reqs, refs)
+        _assert_clean(fleet)
+        falls = guardian.events("handoff_fallback")
+        stats = fleet._handoff.snapshot()
+        assert len(falls) == stats["fallbacks"]
+        # exactly one degradation event per fallen-back request
+        assert len({e["req_id"] for e in falls}) == len(falls)
+        return reqs, falls, stats
+
+    def test_drop_bundle_ttl_reclaims_reservation(self, gpt, pd_fleet,
+                                                  refs):
+        """Every bundle is lost in transit: reservations expire by TTL
+        (no page leaks past the deadline), every request completes by
+        local re-prefill on the decode replica."""
+        reqs, falls, stats = self._chaos(
+            pd_fleet, refs, "handoff.drop_bundle", "error", ttl=0.4)
+        assert stats["transfers"] == 0
+        assert stats["fallbacks"] == len(reqs)
+        assert stats["reserve_expired"] == len(reqs)
+        assert all(e["reason"] == "reserve_ttl_expired" for e in falls)
+        reg = obs.get_registry()
+        assert reg.get("pt_handoff_reserve_expired_total") \
+            .value() == len(reqs)
+
+    def test_corrupt_page_rejected_then_local_prefill(self, gpt,
+                                                      pd_fleet, refs):
+        """A flipped byte fails the per-page CRC at import: the bundle
+        is rejected whole (pool untouched) and the SAME admission falls
+        through to a local re-prefill — no retried import, no
+        double-scatter."""
+        reqs, falls, stats = self._chaos(
+            pd_fleet, refs, "handoff.corrupt_page", "error*2")
+        assert stats["fallbacks"] == 2
+        assert stats["transfers"] == len(reqs) - 2
+        assert all(e["reason"].startswith("import_rejected")
+                   for e in falls)
+
+    def test_reserve_timeout_retries_then_falls_back(self, gpt,
+                                                     pd_fleet, refs):
+        """The reserve phase exhausts its bounded retry budget: the
+        protocol never starts and the request books a launch-time
+        fallback (jittered-backoff attempts are metered)."""
+        reqs, falls, stats = self._chaos(
+            pd_fleet, refs, "handoff.reserve_timeout", "error")
+        assert stats["launched"] == 0
+        assert stats["fallbacks"] == len(reqs)
+        assert stats["retries"] == 2 * len(reqs)   # 3 attempts each
+        assert all(e["reason"] == "reserve_timeout" for e in falls)
+
+    @pytest.mark.slow          # fresh fleet: pays its own compiles
+    def test_prefill_crash_mid_transfer(self, gpt, refs):
+        """The prefill replica dies INSIDE the capture window (bundle
+        half-built): in-protocol requests degrade via stub-loss /
+        heartbeat detection, later requests route straight to the
+        decode replica — all complete bitwise on the survivor.
+        Fresh fleet: the kill is permanent across ``reset()``."""
+        fleet = _make_fleet(gpt)
+        reqs, falls, stats = self._chaos(
+            fleet, refs, "serving.prefill_crash", "error*1")
+        assert fleet.stats["replica_deaths"] == 1
+        assert stats["transfers"] == 0
+        assert len(falls) == len(reqs)
+        assert {e["reason"] for e in falls} <= {
+            "prefill_replica_death", "no_prefill_replica"}
+        assert "prefill_replica_death" in {e["reason"] for e in falls}
+
+    def test_threaded_replica_crash_bitwise(self, gpt, refs):
+        """Generic mid-decode replica crash through worker threads on
+        the disaggregated fleet: whichever role dies, the survivor
+        finishes everything bitwise with no leaked pages."""
+        fleet = _make_fleet(gpt)
+        failpoints.set_failpoint("serving.replica_crash", "error*1")
+        try:
+            reqs = _run(fleet, threads=True)
+        finally:
+            failpoints.clear()
+        _assert_bitwise(reqs, refs)
+        _assert_clean(fleet)
+        assert fleet.stats["replica_deaths"] == 1
+
+
+class TestBundleIntegrity:
+    """Satellite: the checksummed-bundle contract at the allocator
+    level — corrupt/torn bundles are rejected whole with the pool
+    untouched, and a reservation ticket is strictly single-use."""
+
+    def _managers(self):
+        spec = [(2, 4), (2, 4)]
+        a = kvcache.PagedKVManager(spec, 2, 32, 8, 9, "float32")
+        b = kvcache.PagedKVManager(spec, 2, 32, 8, 9, "float32")
+        prompt = np.arange(16, dtype=np.int32)
+        a.bind(0, a.plan(prompt, 8, 8))
+        return a, b
+
+    def test_corrupt_bundle_rejected_whole_pool_untouched(self):
+        a, b = self._managers()
+        payload = a.export_pages(0)
+        handoff._corrupt_one_page(payload)
+        ticket = b.reserve_pages(len(payload["logical"]))
+        pools_before = b.device_pools()
+        with pytest.raises(kvcache.KVBundleError, match="checksum"):
+            b.import_pages(1, payload, ticket=ticket)
+        # rejected WHOLE: no page touched the pool, no mapping exists,
+        # and the reservation survived (failure happened before the
+        # ticket was consumed)
+        assert b.device_pools() is pools_before
+        assert not b._slot_pages[1]
+        assert b.check()
+        clean = a.export_pages(0)
+        assert b.import_pages(1, clean, ticket=ticket) \
+            == len(clean["logical"])
+        assert b.check()
+
+    def test_torn_bundle_rejected(self):
+        a, b = self._managers()
+        payload = a.export_pages(0)
+        payload["layers"] = payload["layers"][:-1]       # torn in flight
+        with pytest.raises(kvcache.KVBundleError):
+            b.import_pages(1, payload)
+        assert b.check() and not b._slot_pages[1]
+
+    def test_reservation_ticket_single_use(self):
+        """Exactly-once arming at the allocator: a consumed ticket can
+        never import again (a retried import cannot double-scatter)."""
+        a, b = self._managers()
+        payload = a.export_pages(0)
+        ticket = b.reserve_pages(len(payload["logical"]))
+        b.import_pages(0, payload, ticket=ticket)
+        with pytest.raises(KeyError, match="reservation"):
+            b.import_pages(1, payload, ticket=ticket)
+        assert b.check() and not b._slot_pages[1]
+        # cancel after consumption is an idempotent no-op
+        assert b.cancel_reservation(ticket) == 0
+
+    def test_record_consume_gate_is_exactly_once(self, gpt, pd_fleet):
+        """The coordinator half of exactly-once: ``consume()`` flips
+        true exactly once, and only inside the arming window."""
+        coord = pd_fleet._handoff
+        req = type("R", (), {"req_id": "x"})()
+        rec = handoff.HandoffRecord(coord, req, 0, 1, ticket=None,
+                                    reserved=1, ttl_s=60.0)
+        assert not rec.consume()             # still in transfer state
+        rec.state = handoff._ARMING
+        assert rec.consume()
+        assert not rec.consume()             # second arm attempt loses
+
+
+class TestHandoffObservability:
+    def test_doctor_ranks_handoff_failure(self):
+        from paddle_tpu.observability import doctor
+        ev = doctor._empty_evidence()
+        ev["guardian_events"] = [
+            {"event": "handoff_fallback", "req_id": i,
+             "reason": "reserve_ttl_expired", "dst": 1}
+            for i in range(3)]
+        d = doctor.diagnose(ev)
+        assert d["verdict"] == "handoff_failure"
+        top = d["diagnoses"][0]
+        assert top["cause"] == "handoff_failure"
+        assert top["score"] >= doctor._MIN_INCIDENT_SCORE
+        assert any("fell back" in line for line in top["evidence"])
+
+
+@pytest.mark.lint
+class TestHandoffLintSelfCheck:
+    def test_failpoints_registered(self):
+        import paddle_tpu.inference.handoff  # noqa: F401 — registers
+        names = failpoints.registered()
+        for fp in ("handoff.drop_bundle", "handoff.corrupt_page",
+                   "handoff.reserve_timeout", "serving.prefill_crash"):
+            assert fp in names
+
+    def test_handoff_concurrency_and_sync_lints_clean(self):
+        """The coordinator's locked regions satisfy the concurrency
+        pass and the module's zero-sync contract satisfies host-sync —
+        with the committed baseline still EMPTY."""
+        from paddle_tpu.analysis import runner
+        findings = runner.run_passes(
+            paths=["paddle_tpu/inference/handoff.py",
+                   "paddle_tpu/inference/router.py",
+                   "paddle_tpu/inference/serving.py",
+                   "paddle_tpu/inference/kvcache.py"],
+            passes=["concurrency", "host-sync"])
+        assert findings == []
+        import os
+        base = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "lint_baseline.json")
+        with open(base, encoding="utf-8") as f:
+            assert not json.load(f)["findings"]      # baseline EMPTY

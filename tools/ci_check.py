@@ -19,11 +19,11 @@ README lookup.  This wires them into one:
                                               # snapshots (healthy ->
                                               # 'no alerts', exit 0)
     python tools/ci_check.py --chaos          # + the chaos-marked
-                                              # elastic-resume suite on
-                                              # the 8-device CPU-proxy
-                                              # mesh (opt-in: kill/
-                                              # resume e2e is slower
-                                              # than tier-1 unit tests)
+                                              # elastic-resume + PD-
+                                              # handoff suites (opt-in:
+                                              # kill/resume e2e is
+                                              # slower than tier-1
+                                              # unit tests)
     python tools/ci_check.py --kernels        # + the Pallas kernel /
                                               # registry suites with
                                               # interpret mode forced
@@ -126,13 +126,16 @@ def run_doctor():
 
 def run_chaos():
     """Chaos stage (the ISSUE 14 CI satellite, opt-in): run the
-    `chaos`-marked elastic-resume suite — manifest save/restore across
-    topology changes, the np=8 → np=4 kill/resume e2e, retention/read
-    races — on the 8-virtual-device CPU-proxy mesh the tests/conftest
-    forces."""
-    t0 = _stage("elastic-resume chaos suite (opt-in, 8-dev proxy mesh)")
+    `chaos`-marked suites — elastic-resume (manifest save/restore
+    across topology changes, the np=8 → np=4 kill/resume e2e,
+    retention/read races) on the 8-virtual-device CPU-proxy mesh the
+    tests/conftest forces, plus the prefill/decode handoff chaos suite
+    (dropped/corrupt bundles, reservation expiry, mid-transfer prefill
+    death — bitwise fallback, zero leaked pages)."""
+    t0 = _stage("chaos suites (opt-in: elastic resume + handoff)")
     cmd = [sys.executable, "-m", "pytest",
            "tests/test_elastic_resume.py", "tests/test_fault_tolerance.py",
+           "tests/test_handoff.py",
            "-q", "-m", "chaos", "--continue-on-collection-errors",
            "-p", "no:cacheprovider"]
     print("$", " ".join(shlex.quote(c) for c in cmd), flush=True)
@@ -192,7 +195,8 @@ def main(argv=None):
                          "parse clean with a 'no alerts' verdict)")
     ap.add_argument("--chaos", action="store_true",
                     help="also run the chaos-marked elastic-resume "
-                         "tests on the 8-device CPU-proxy mesh")
+                         "tests (8-device CPU-proxy mesh) and the "
+                         "prefill/decode handoff chaos suite")
     ap.add_argument("--kernels", action="store_true",
                     help="also run the Pallas kernel + registry suites "
                          "with interpret mode forced (the selected TPU "
