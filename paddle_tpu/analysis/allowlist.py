@@ -437,6 +437,104 @@ STATIC_FUNCS = frozenset({
 # NOT here: `x.at[i].set(v)` carries x's taint)
 STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
 
+# -- mesh-axes / spec-drift (mesh_axes.py, spec_drift.py) -------------------
+#
+# The mesh-axis vocabulary: every axis name the framework itself
+# hardcodes — in `PartitionSpec` literals, `shard_map` specs,
+# `jax.sharding.Mesh` constructions and collective `axis_name=`
+# arguments — must come from this tuple.  User-facing mesh wrappers
+# (`ProcessMesh(dim_names=...)`, `auto_mesh`) take arbitrary names and
+# are deliberately out of scope: the vocabulary governs the GSPMD hot
+# paths the framework owns, not what users call their axes.
+# Canonical order mirrors fleet topology (`_AXIS_ORDER` +
+# the expert axis the auto-parallel Engine adds).
+MESH_AXES = ("data", "pipe", "sharding", "sep", "model", "expert")
+
+# -- dtype-flow (dtype_flow.py) ---------------------------------------------
+#
+# Modules whose compiled hot paths are declared bf16-capable: a literal
+# `.astype(jnp.float32)` upcast or a dtype-less `jnp.zeros`-family
+# allocation (which silently materializes fp32) inside them must match
+# a contract entry below or carry a pragma.  Jit-surface functions are
+# additionally checked wherever they live (the host-sync scoping rule).
+DTYPE_MONITORED_MODULES = (
+    "paddle_tpu/models/generation.py",
+    "paddle_tpu/models/gpt_hybrid.py",
+    "paddle_tpu/models/llama.py",
+    "paddle_tpu/inference/serving.py",
+    "paddle_tpu/inference/kvcache.py",
+    "paddle_tpu/inference/speculative.py",
+    "paddle_tpu/distributed/grad_comm.py",
+    "paddle_tpu/distributed/pipeline.py",
+    "paddle_tpu/hapi/model.py",
+    "paddle_tpu/framework/guardian.py",
+)
+
+# (relpath, function qualname) -> reason the fp32 upcast is *by
+# contract* (numerics, not an accident).  The host-sync allowlist
+# pattern applied to precision: the diff review sees the accumulator
+# contract explicitly instead of a silent upcast eating the bf16 win.
+FP32_CONTRACT_CASTS = {
+    ("paddle_tpu/models/generation.py", "build_pick.pick"):
+        "sampling contract: log-softmax + temperature math in fp32 "
+        "(bf16 logprobs skew the categorical draw)",
+    ("paddle_tpu/models/generation.py", "generate.beam_run"):
+        "beam-search scores are fp32 log-probs by contract; bf16 "
+        "accumulation reorders beams after ~100 steps",
+    ("paddle_tpu/models/generation.py", "generate.beam_run.body"):
+        "per-step log-softmax feeding the fp32 beam-score accumulator",
+    ("paddle_tpu/models/gpt_hybrid.py", "build_hybrid_gpt.loss_fn"):
+        "xent logits widen to fp32 before log-softmax — the one "
+        "blessed upcast of the bf16 training recipe",
+    ("paddle_tpu/models/llama.py", "_rope"):
+        "rotary angles/products in fp32: bf16 sin/cos loses position "
+        "resolution past ~4k context",
+    ("paddle_tpu/inference/kvcache.py", "quantize_kv"):
+        "absmax/scale math runs in fp32 before narrowing to int8 — "
+        "quantizer internals, not a hot-path leak",
+    ("paddle_tpu/inference/kvcache.py", "dequantize_kv"):
+        "dequant is a widen-then-rescale by definition; result is "
+        "cast back to the compute dtype by the caller-passed `dtype`",
+    ("paddle_tpu/distributed/grad_comm.py",
+     "_build_quant_reduce.quant_reduce"):
+        "EQuARX partial sums dequantize to fp32 between the "
+        "all_to_all and all_gather phases (accuracy contract)",
+    ("paddle_tpu/hapi/model.py",
+     "_CompiledStepper._build_train.step.loss_f"):
+        "AMP O1/O2 restores bf16 forward outputs to fp32 before the "
+        "loss — the mixed-precision master contract",
+    ("paddle_tpu/hapi/model.py",
+     "_CompiledStepper._build_train_comm.shard_step.loss_f"):
+        "AMP O1/O2 restores bf16 forward outputs to fp32 before the "
+        "loss — the mixed-precision master contract",
+    ("paddle_tpu/framework/guardian.py", "attribute_nonfinite"):
+        "post-mortem nonfinite attribution widens on host; not a "
+        "compiled hot path",
+}
+
+# (relpath, function qualname) -> reason a narrow-dtype cast
+# (int8/fp8) without scale handling in the same function is sound.
+NARROW_CAST_CONTRACT = {
+    ("paddle_tpu/distributed/grad_comm.py", "_to_narrow"):
+        "input is pre-scaled by every caller (`x / scale`); the "
+        "helper only rounds/clips onto the wire dtype",
+    ("paddle_tpu/nn/quant/__init__.py", "_unpack_int4"):
+        "nibble repack of already-quantized int4 weights; the scale "
+        "is applied by the `weight_dequantize` caller",
+}
+
+# quantize/dequantize callee pairs that must stay balanced per module:
+# a module calling one side without the other ships garbage (quantized
+# values read as raw ints, or a dequant of never-quantized data).
+KV_QUANT_PAIRS = (
+    ("quantize_kv", "dequantize_kv"),
+)
+
+# EQuARX narrowing wrappers (distributed/grad_comm.py): every call
+# site must see a widening `.astype(jnp.float32)` dequant in the same
+# function — the wire value is useless until rescaled to fp32.
+EQUARX_NARROW_CALLEES = frozenset({"_to_narrow"})
+
 # -- collective-order (collective_order.py) --------------------------------
 
 COLLECTIVE_CALLEES = frozenset({

@@ -260,6 +260,35 @@ def call_terminal(func_expr):
     return None
 
 
+# Parsed-module cache shared across every ProjectIndex in the process
+# (one sweep runs 12 passes over one index, but ci_check/pytest build
+# many contexts): keyed by (abspath, relpath) and invalidated on
+# mtime/size change, so edits between runs are always re-parsed.
+# ModuleInfo is immutable after construction — passes only read it.
+_MODULE_CACHE = {}
+_MODULE_CACHE_MAX = 4096
+
+# Same idea for the registry/drift passes' reference files (tests/docs
+# are re-read by several passes per sweep).
+_TEXT_CACHE = {}
+
+
+def read_text(path):
+    """Read a reference text file through the mtime-keyed cache."""
+    ap = os.path.abspath(path)
+    st = os.stat(ap)
+    stamp = (st.st_mtime_ns, st.st_size)
+    hit = _TEXT_CACHE.get(ap)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    with open(ap, encoding="utf-8") as f:
+        text = f.read()
+    if len(_TEXT_CACHE) >= _MODULE_CACHE_MAX:
+        _TEXT_CACHE.clear()
+    _TEXT_CACHE[ap] = (stamp, text)
+    return text
+
+
 class ProjectIndex:
     """All scanned modules plus cross-module call resolution."""
 
@@ -272,8 +301,17 @@ class ProjectIndex:
             self._load(path)
 
     def _load(self, path):
-        relpath = os.path.relpath(os.path.abspath(path), self.root)
+        abspath = os.path.abspath(path)
+        relpath = os.path.relpath(abspath, self.root)
         try:
+            st = os.stat(abspath)
+            stamp = (st.st_mtime_ns, st.st_size)
+            hit = _MODULE_CACHE.get((abspath, relpath))
+            if hit is not None and hit[0] == stamp:
+                mod = hit[1]
+                self.modules[mod.modname] = mod
+                self.by_relpath[mod.relpath] = mod
+                return
             with open(path, encoding="utf-8") as f:
                 source = f.read()
             tree = ast.parse(source, filename=path)
@@ -286,6 +324,9 @@ class ProjectIndex:
             mp = os.path.dirname(relpath)
         modname = mp.replace(os.sep, ".").replace("/", ".")
         mod = ModuleInfo(path, relpath, modname, is_package, source, tree)
+        if len(_MODULE_CACHE) >= _MODULE_CACHE_MAX:
+            _MODULE_CACHE.clear()
+        _MODULE_CACHE[(abspath, relpath)] = (stamp, mod)
         self.modules[modname] = mod
         self.by_relpath[mod.relpath] = mod
 

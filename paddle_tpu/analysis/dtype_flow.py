@@ -1,0 +1,232 @@
+"""Static dtype-propagation pass over the declared-bf16 hot paths.
+
+The mixed-precision contract is directional: the hot paths compute in
+bf16 (or the KV cache's narrow wire dtype) and widen to fp32 only at
+*declared accumulator* sites — xent/softmax logits, guardian
+reductions, EQuARX partial sums.  Three silent ways to break it:
+
+1. ``fp32-upcast`` — a literal ``.astype(jnp.float32)`` inside a
+   monitored module or jit surface that is not in the
+   ``FP32_CONTRACT_CASTS`` allowlist.  An accidental upcast doubles
+   the bytes of everything downstream and XLA will happily keep the
+   whole tail of the graph in fp32.
+2. ``untyped-alloc`` — a dtype-less ``jnp.zeros``/``ones``/``full``/
+   ``empty`` allocation in the same scope: the default dtype is fp32,
+   so the allocation silently re-widens whatever flows through it.
+   The fix is always to say what you mean (any explicit dtype passes).
+3. ``unpaired-quantize`` / ``unscaled-narrow-cast`` — the quantization
+   pairing contracts: ``quantize_kv``/``dequantize_kv`` call sites
+   must stay balanced per module (``KV_QUANT_PAIRS``); every EQuARX
+   ``_to_narrow`` call needs a widening fp32 dequant in the same
+   function; and any ``.astype(int8/fp8)`` narrowing must show scale
+   handling (a ``*scale*``/``*amax*`` name) in its enclosing function
+   or carry a ``NARROW_CAST_CONTRACT`` entry — the machine check the
+   fp8 train pilot's delayed-scaling amax state will need.
+
+Scope rule (the host-sync pattern): ``DTYPE_MONITORED_MODULES`` are
+checked wholesale; jit-surface functions are checked wherever they
+live, fixtures included.  The narrow-cast check is tree-wide — a
+scale-free quantize is never right.
+"""
+import ast
+
+from .base import Finding, call_terminal, dotted, enclosing_qualname
+from .allowlist import (DTYPE_MONITORED_MODULES, FP32_CONTRACT_CASTS,
+                        NARROW_CAST_CONTRACT, KV_QUANT_PAIRS,
+                        EQUARX_NARROW_CALLEES, EXTRA_JIT_SURFACES)
+
+PASS_NAME = "dtype-flow"
+
+# jnp allocators whose dtype defaults to fp32 when omitted
+_ALLOC_CALLEES = {"zeros": 1, "ones": 1, "empty": 1, "full": 2}
+
+
+def _is_fp32_dtype(expr):
+    name = dotted(expr)
+    if name and name.split(".")[-1] == "float32":
+        return True
+    return isinstance(expr, ast.Constant) and expr.value == "float32"
+
+
+def _is_narrow_dtype(expr):
+    name = dotted(expr)
+    if name:
+        last = name.split(".")[-1]
+        if last == "int8" or last.startswith("float8"):
+            return True
+        if last == "_FP8_DTYPE":
+            return True
+    return isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+        and (expr.value == "int8" or expr.value.startswith("float8"))
+
+
+def _astype_arg(call):
+    """The dtype argument of an ``x.astype(...)`` call, or None."""
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr == "astype" and len(call.args) == 1:
+        return call.args[0]
+    return None
+
+
+def _is_jnp_call(call, mod):
+    name = dotted(call.func)
+    if not name or "." not in name:
+        return False
+    root = name.split(".", 1)[0]
+    target = mod.alias_module(root) or root
+    return target in ("jax.numpy", "jnp") or target.startswith("jax.numpy.")
+
+
+def _has_dtype_arg(call, n_pos):
+    """True when an allocator call pins its dtype (positional index
+    ``n_pos`` or a ``dtype=`` keyword)."""
+    if len(call.args) > n_pos:
+        return True
+    return any(kw.arg == "dtype" for kw in call.keywords)
+
+
+def _scale_evidence(node):
+    """True when any identifier under ``node`` carries scale/amax
+    handling."""
+    for n in ast.walk(node):
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        elif isinstance(n, ast.arg):
+            ident = n.arg
+        if ident is not None:
+            low = ident.lower()
+            if "scale" in low or "amax" in low:
+                return True
+    return False
+
+
+def _contract_entry(table, relpath, qual):
+    for (rel, q), reason in table.items():
+        if q == qual and (relpath == rel or relpath.endswith("/" + rel)):
+            return reason
+    return None
+
+
+class DtypeFlowPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.index.iter_modules():
+            monitored = any(mod.relpath == m or mod.relpath.endswith("/" + m)
+                            for m in DTYPE_MONITORED_MODULES)
+            surfaces = {q for q, fi in mod.funcs.items() if fi.is_surface}
+            for rel, qual in EXTRA_JIT_SURFACES:
+                if (mod.relpath == rel or mod.relpath.endswith("/" + rel)) \
+                        and qual in mod.funcs:
+                    surfaces.add(qual)
+            self._scan(mod, monitored, surfaces, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    def _scan(self, mod, monitored, surfaces, findings):
+        def flag(node, code, qual, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(
+                self.name, mod.relpath, node.lineno, qual, code, message,
+                detail))
+
+        kv_calls = {}        # callee -> first call node (pairing check)
+        narrow_by_func = {}  # qual -> [narrow-wrapper call nodes]
+        widen_by_func = set()  # quals containing an fp32 widen
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            term = call_terminal(n.func)
+            dtype_expr = _astype_arg(n)
+            qual = None
+            in_scope = False
+            if monitored or surfaces:
+                if dtype_expr is not None or (
+                        term in _ALLOC_CALLEES or term in
+                        EQUARX_NARROW_CALLEES or
+                        any(term == q or term == d
+                            for q, d in KV_QUANT_PAIRS)):
+                    qual = enclosing_qualname(mod, n)
+                    in_scope = monitored or any(
+                        qual == s or qual.startswith(s + ".")
+                        for s in surfaces)
+            # 1. fp32 upcasts + the widen inventory for the EQuARX check
+            if dtype_expr is not None and _is_fp32_dtype(dtype_expr):
+                qual = qual or enclosing_qualname(mod, n)
+                widen_by_func.add(qual)
+                if in_scope and \
+                        _contract_entry(FP32_CONTRACT_CASTS, mod.relpath,
+                                        qual) is None:
+                    flag(n, "fp32-upcast", qual,
+                         f"literal fp32 upcast in declared-bf16 hot path "
+                         f"`{qual}` — if this is an accumulator that is "
+                         "fp32 by contract, add a FP32_CONTRACT_CASTS "
+                         "entry in paddle_tpu/analysis/allowlist.py "
+                         "with the reason; otherwise keep the compute "
+                         "dtype", "float32")
+            # 2. dtype-less allocations
+            if in_scope and term in _ALLOC_CALLEES and \
+                    _is_jnp_call(n, mod) and \
+                    not _has_dtype_arg(n, _ALLOC_CALLEES[term]):
+                flag(n, "untyped-alloc", qual,
+                     f"dtype-less `jnp.{term}` in declared-bf16 hot "
+                     f"path `{qual}` allocates fp32 by default — pass "
+                     "an explicit dtype (the compute dtype, or fp32 if "
+                     "that is the contract, but say so)", term)
+            # 3a. kv quantize/dequantize pairing inventory
+            if term is not None:
+                for q, d in KV_QUANT_PAIRS:
+                    if term in (q, d):
+                        kv_calls.setdefault(term, n)
+                if term in EQUARX_NARROW_CALLEES:
+                    qual = qual or enclosing_qualname(mod, n)
+                    narrow_by_func.setdefault(qual, []).append(n)
+            # 3b. narrow casts need scale handling (tree-wide)
+            if dtype_expr is not None and _is_narrow_dtype(dtype_expr):
+                qual = qual or enclosing_qualname(mod, n)
+                fi = mod.funcs.get(qual)
+                scope_node = fi.node if fi is not None else mod.tree
+                if not _scale_evidence(scope_node) and \
+                        _contract_entry(NARROW_CAST_CONTRACT,
+                                        mod.relpath, qual) is None:
+                    flag(n, "unscaled-narrow-cast", qual,
+                         f"narrow-dtype cast in `{qual}` with no "
+                         "scale/amax handling in the same function — "
+                         "an unscaled int8/fp8 quantize clips instead "
+                         "of scaling; thread the scale group through, "
+                         "or add a NARROW_CAST_CONTRACT entry "
+                         "(paddle_tpu/analysis/allowlist.py) saying "
+                         "where the scale lives", "narrow")
+        # module-scope kv pairing verdicts
+        for q, d in KV_QUANT_PAIRS:
+            if q in kv_calls and d not in kv_calls:
+                n = kv_calls[q]
+                flag(n, "unpaired-quantize",
+                     enclosing_qualname(mod, n),
+                     f"`{q}` is called here but `{d}` never is in this "
+                     "module — quantized values read back as raw ints "
+                     "somewhere; keep the pair together or route reads "
+                     "through the dequant helper", f"{q}-without-{d}")
+            elif d in kv_calls and q not in kv_calls:
+                n = kv_calls[d]
+                flag(n, "unpaired-quantize",
+                     enclosing_qualname(mod, n),
+                     f"`{d}` is called here but `{q}` never is in this "
+                     "module — dequantizing data nothing quantized "
+                     "produces garbage scaled by a stale sidecar; keep "
+                     "the pair together", f"{d}-without-{q}")
+        # EQuARX: every narrowing function must widen back to fp32
+        for qual, nodes in sorted(narrow_by_func.items()):
+            if qual not in widen_by_func:
+                flag(nodes[0], "unpaired-quantize", qual,
+                     f"`{qual}` narrows with "
+                     f"{'/'.join(sorted(EQUARX_NARROW_CALLEES))} but "
+                     "never widens back with an fp32 dequant in the "
+                     "same function — the EQuARX wire value is useless "
+                     "until rescaled; dequantize (`.astype(jnp."
+                     "float32) * scale`) before reducing",
+                     "narrow-without-dequant")

@@ -1,0 +1,265 @@
+"""Mesh/PartitionSpec consistency pass: the sharding-annotation bug
+class that otherwise surfaces only at trace time (or worse, as a
+silently wrong-layout reshard).
+
+Every axis name the framework hardcodes must come from the
+machine-checked ``MESH_AXES`` vocabulary (``allowlist.py``) — a typo'd
+``P("dta")`` resolves to *replicated* under GSPMD's unknown-axis
+handling or throws deep inside a shard_map trace, neither of which
+names the offending literal.  Four shapes are flagged:
+
+1. ``undeclared-axis`` — a ``PartitionSpec``/``P(...)`` literal,
+   ``shard_map`` spec, or collective ``axis_name=`` naming an axis not
+   in ``MESH_AXES``.
+2. ``duplicate-axis`` — the same axis used twice in one spec
+   (``P("data", "data")`` is invalid: an array dim can shard over an
+   axis only once).
+3. ``spec-arity-mismatch`` — a ``shard_map`` whose literal ``in_specs``
+   tuple length cannot match the wrapped function's positional arity
+   (the error XLA reports as an opaque pytree mismatch).
+4. ``unbound-axis-name`` — a ``psum``/``all_gather``/``ppermute``/
+   ``all_to_all``/``axis_index`` call whose *literal* axis name is not
+   bound by any ``shard_map``/``Mesh``/``axis_name=`` declaration in
+   the same module (the collective_order.py walk extended to axis
+   binding; the runtime error is an unbound-axis NameError mid-trace).
+
+Variable axis arguments (``lax.psum(x, axis)``) resolve dynamically and
+are deliberately not flagged — the vocabulary check applies where the
+literal appears (the defaults and specs that feed those variables).
+"""
+import ast
+
+from .base import Finding, call_terminal, dotted, enclosing_qualname
+from .allowlist import MESH_AXES
+
+PASS_NAME = "mesh-axes"
+
+# collective callee -> positional index of its axis-name argument
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "ppermute": 1, "all_to_all": 1, "axis_index": 0,
+}
+
+_SHARD_MAP_CALLEES = ("shard_map", "_shard_map")
+
+
+def _is_pspec_call(call, mod):
+    """True for ``PartitionSpec(...)`` / aliased ``P(...)`` calls."""
+    if call_terminal(call.func) == "PartitionSpec":
+        return True
+    if isinstance(call.func, ast.Name):
+        target = mod.alias_module(call.func.id) or ""
+        return target.split(".")[-1] == "PartitionSpec"
+    return False
+
+
+def _axis_literals(node):
+    """(name, node) for every string constant under ``node`` — the
+    axis names a spec/axis argument can carry (bare, tupled, or inside
+    an IfExp arm)."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append((n.value, n))
+    return out
+
+
+def _spec_value_literals(call):
+    """(name, node) for string constants in *value positions* of a
+    spec call: direct arguments, tuple/list elements, and IfExp arms.
+    Unlike :func:`_axis_literals` this does not descend into IfExp
+    tests or comparisons, so ``P("data" if "data" in dims else None)``
+    counts ``"data"`` once, not twice."""
+    out = []
+
+    def walk_value(e):
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append((e.value, e))
+        elif isinstance(e, (ast.Tuple, ast.List)):
+            for elt in e.elts:
+                walk_value(elt)
+        elif isinstance(e, ast.IfExp):
+            walk_value(e.body)
+            walk_value(e.orelse)
+
+    for a in call.args:
+        walk_value(a)
+    for kw in call.keywords:
+        walk_value(kw.value)
+    return out
+
+
+def _positional_arity(fnode):
+    """(min, max) positional-argument count of a function node, or
+    None when ``*args`` makes it unbounded."""
+    a = fnode.args
+    if a.vararg is not None:
+        return None
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    if pos and pos[0] in ("self", "cls"):
+        pos = pos[1:]
+    n = len(pos)
+    return (n - len(a.defaults), n)
+
+
+def _collective_axis_arg(call):
+    """The axis-name argument expression of a collective call, or
+    None."""
+    term = call_terminal(call.func)
+    if term not in COLLECTIVE_AXIS_ARG:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = COLLECTIVE_AXIS_ARG[term]
+    if len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def _shard_map_parts(call):
+    """(fn_expr, in_specs_expr, out_specs_expr) of a shard_map call,
+    any of them None when absent."""
+    fn = call.args[0] if call.args else None
+    parts = {"in_specs": None, "out_specs": None}
+    for kw in call.keywords:
+        if kw.arg in parts:
+            parts[kw.arg] = kw.value
+    # the positional compat shape: _shard_map(f, mesh, in, out)
+    if parts["in_specs"] is None and len(call.args) > 2:
+        parts["in_specs"] = call.args[2]
+    if parts["out_specs"] is None and len(call.args) > 3:
+        parts["out_specs"] = call.args[3]
+    return fn, parts["in_specs"], parts["out_specs"]
+
+
+class MeshAxesPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.index.iter_modules():
+            self._scan(ctx, mod, findings)
+        return sorted(findings, key=Finding.sort_key)
+
+    # -- per-module ---------------------------------------------------------
+    def _scan(self, ctx, mod, findings):
+        def flag(node, code, qual, message, detail):
+            if {self.name, code} & mod.allowed_on_line(node.lineno):
+                return
+            findings.append(Finding(
+                self.name, mod.relpath, node.lineno, qual, code, message,
+                detail))
+
+        bound = self._bound_axes(mod)
+        shard_map_calls = []
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            qual = None  # lazily computed
+            if _is_pspec_call(n, mod):
+                qual = enclosing_qualname(mod, n)
+                self._check_spec(n, qual, flag)
+            axis_expr = _collective_axis_arg(n)
+            if axis_expr is not None:
+                qual = qual or enclosing_qualname(mod, n)
+                term = call_terminal(n.func)
+                for name, node in _axis_literals(axis_expr):
+                    if name not in MESH_AXES:
+                        flag(node, "undeclared-axis", qual,
+                             f"collective `{term}` names axis {name!r} "
+                             "which is not in the MESH_AXES vocabulary "
+                             "(paddle_tpu/analysis/allowlist.py) — a "
+                             "typo'd axis fails at trace time without "
+                             "naming the literal; fix the name or "
+                             "extend the vocabulary deliberately",
+                             f"{term}:{name}")
+                    elif name not in bound:
+                        flag(node, "unbound-axis-name", qual,
+                             f"collective `{term}` names axis {name!r} "
+                             "but no shard_map/Mesh/axis_name "
+                             "declaration in this module binds it — "
+                             "the trace dies with an unbound-axis "
+                             "error on the first dispatch; bind the "
+                             "axis (shard_map specs / mesh axis_names) "
+                             "or thread it in as a parameter",
+                             f"{term}:{name}")
+            if call_terminal(n.func) in _SHARD_MAP_CALLEES:
+                shard_map_calls.append(n)
+        for call in shard_map_calls:
+            self._check_shard_map(ctx, mod, call, flag)
+
+    # -- specs ---------------------------------------------------------------
+    def _check_spec(self, call, qual, flag):
+        seen = {}
+        for name, node in _spec_value_literals(call):
+            if name not in MESH_AXES:
+                flag(node, "undeclared-axis", qual,
+                     f"PartitionSpec names axis {name!r} which is not "
+                     "in the MESH_AXES vocabulary "
+                     "(paddle_tpu/analysis/allowlist.py) — under GSPMD "
+                     "an unknown axis is an opaque trace-time error, "
+                     "or worse a silently replicated dim; fix the name "
+                     "or extend the vocabulary deliberately",
+                     f"P:{name}")
+            first = seen.get(name)
+            if first is not None:
+                flag(node, "duplicate-axis", qual,
+                     f"axis {name!r} appears twice in one "
+                     "PartitionSpec — an array can shard over a mesh "
+                     "axis only once; the second use is either a typo "
+                     "for another axis or an invalid spec",
+                     f"P:{name}")
+            else:
+                seen[name] = node
+
+    # -- shard_map arity -----------------------------------------------------
+    def _check_shard_map(self, ctx, mod, call, flag):
+        fn_expr, in_specs, _ = _shard_map_parts(call)
+        if not isinstance(in_specs, (ast.Tuple, ast.List)):
+            return           # single broadcast spec or computed tuple
+        if any(isinstance(e, ast.Starred) for e in in_specs.elts):
+            return
+        qual = enclosing_qualname(mod, call)
+        fi = None
+        if isinstance(fn_expr, ast.Name):
+            fi = ctx.index.resolve_call(mod, qual, fn_expr)
+        if fi is None:
+            return
+        arity = _positional_arity(fi.node)
+        if arity is None:
+            return
+        lo, hi = arity
+        n = len(in_specs.elts)
+        if not (lo <= n <= hi):
+            want = str(hi) if lo == hi else f"{lo}..{hi}"
+            flag(call, "spec-arity-mismatch", qual,
+                 f"shard_map in_specs has {n} spec(s) but the wrapped "
+                 f"function `{fi.qualname}` takes {want} positional "
+                 "argument(s) — the mismatch surfaces as an opaque "
+                 "pytree-structure error at trace time; keep specs and "
+                 "signature in lockstep",
+                 f"{fi.qualname}:{n}")
+
+    # -- module-level axis bindings ------------------------------------------
+    @staticmethod
+    def _bound_axes(mod):
+        """Axis names bound somewhere in the module: shard_map spec
+        literals, ``Mesh(..., (names))`` constructions, and
+        ``axis_name=`` keyword literals (pmap/vmap/shard_map)."""
+        bound = set()
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            term = call_terminal(n.func)
+            if term in _SHARD_MAP_CALLEES:
+                for name, _ in _axis_literals(n):
+                    bound.add(name)
+            elif term == "Mesh" and len(n.args) > 1:
+                for name, _ in _axis_literals(n.args[1]):
+                    bound.add(name)
+            for kw in n.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    for name, _ in _axis_literals(kw.value):
+                        bound.add(name)
+        return bound

@@ -11,7 +11,7 @@ the *registration reality* of the running code.
 import os
 import re
 
-from .base import Finding
+from .base import Finding, read_text
 
 # name references: a set_failpoint call with a quoted name, and
 # PADDLE_FAILPOINTS-shaped spec strings (name=action[;...]).  The
@@ -49,8 +49,9 @@ OBSERVABILITY_DOC = "docs/observability.md"
 
 
 def _read(path):
-    with open(path, encoding="utf-8") as f:
-        return f.read()
+    # shared mtime-keyed cache: several passes read the same tests/docs
+    # corpus per sweep
+    return read_text(path)
 
 
 def _line_of(text, match):

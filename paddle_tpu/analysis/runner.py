@@ -29,11 +29,16 @@ def _passes():
     from .donation import DonationPass
     from .retrace_hazard import RetraceHazardPass
     from .concurrency import ConcurrencyPass
+    from .mesh_axes import MeshAxesPass
+    from .dtype_flow import DtypeFlowPass
+    from .spec_drift import SpecDriftPass
     from .registry_lints import (FailpointRefsPass, GuardianLogSchemaPass,
                                  MetricNamesPass)
     return {p.name: p for p in (TracerSafetyPass, HostSyncPass,
                                 CollectiveOrderPass, DonationPass,
                                 RetraceHazardPass, ConcurrencyPass,
+                                MeshAxesPass, DtypeFlowPass,
+                                SpecDriftPass,
                                 FailpointRefsPass, GuardianLogSchemaPass,
                                 MetricNamesPass)}
 
@@ -107,9 +112,14 @@ def make_context(paths=None, root=None):
     return Context(root, py, ref, default_tree=True)
 
 
-def run_passes(paths=None, passes=None, root=None, ctx=None):
+def run_passes(paths=None, passes=None, root=None, ctx=None,
+               timings=None):
     """Run the selected passes; returns a deterministically-ordered
-    Finding list (parse failures included as `parse` findings)."""
+    Finding list (parse failures included as `parse` findings).  Pass
+    a dict as ``timings`` to collect per-pass wall seconds plus the
+    ``"total"`` (the sweep shares one parsed-module cache across
+    passes, and ``--json`` reports the resulting wall time)."""
+    import time
     ctx = ctx or make_context(paths, root)
     registry = _passes()
     if passes:
@@ -124,13 +134,20 @@ def run_passes(paths=None, passes=None, root=None, ctx=None):
         raise ValueError(f"unknown pass(es) {unknown}; known: {known}")
     findings = []
     ast_passes = {"tracer-safety", "host-sync", "collective-order",
-                  "donation", "retrace-hazard", "concurrency"}
+                  "donation", "retrace-hazard", "concurrency",
+                  "mesh-axes", "dtype-flow", "spec-drift"}
+    t_total = time.perf_counter()
     if any(n in ast_passes for n in names):
         for rel, msg in ctx.index.errors:
             findings.append(Finding("parse", rel, 1, "<module>",
                                     "syntax-error", msg, "syntax"))
     for name in names:
+        t0 = time.perf_counter()
         findings.extend(registry[name]().run(ctx))
+        if timings is not None:
+            timings[name] = round(time.perf_counter() - t0, 4)
+    if timings is not None:
+        timings["total"] = round(time.perf_counter() - t_total, 4)
     return sorted(findings, key=Finding.sort_key)
 
 
@@ -262,9 +279,10 @@ def main(argv=None):
             print("OK: no changed .py/.md files vs HEAD "
                   "(--changed-only)")
             return 0
+    timings = {}
     try:
         ctx = make_context(paths)
-        findings = run_passes(passes=passes, ctx=ctx)
+        findings = run_passes(passes=passes, ctx=ctx, timings=timings)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -298,6 +316,7 @@ def main(argv=None):
         new_ids = {id(f) for f in new}
         out = {"total": len(findings), "new": len(new),
                "baselined": len(old),
+               "wall_time_s": timings,
                "findings": [dict(f.to_dict(), new=(id(f) in new_ids))
                             for f in findings]}
         print(json.dumps(out, indent=1, sort_keys=True))
@@ -315,5 +334,5 @@ def main(argv=None):
               "--update-baseline deliberately")
         return 1
     print(f"OK: no new findings ({ran}, {len(findings)} total, "
-          f"{len(old)} baselined)")
+          f"{len(old)} baselined, {timings.get('total', 0.0):.2f}s)")
     return 0

@@ -1,0 +1,287 @@
+"""Cross-artifact drift pass: the PR 3 registry-lint discipline
+generalized to the sharding/numerics vocabularies.
+
+Three artifact pairs are held in lockstep:
+
+1. ``MESH_AXES`` (allowlist.py) vs the tree's actual mesh construction
+   sites — a ``jax.sharding.Mesh(..., (names))`` literal naming an
+   undeclared axis is ``mesh-axis-undeclared``; a vocabulary entry no
+   construction/spec/collective site uses is ``mesh-axis-unused``
+   (dead vocabulary reads as coverage that isn't there).
+2. ``COMPILE_SURFACES`` (allowlist.py) vs the ``compilestats.wrap``
+   literals and ``*_SURFACE`` constants in source — the static-finding
+   labels and the runtime ``pt_compile_*`` labels must stay one
+   vocabulary (``surface-drift``; the test_graph_discipline assertion,
+   now enforced at lint time).
+3. ``docs/DISTRIBUTED.md`` vs the code it documents: backticked repo
+   paths must exist (``stale-doc-ref``), the ``grad_comm_configs``
+   block's keys must be real ``GradCommConfig`` parameters
+   (``grad-comm-drift``), and the documented wire modes must mirror
+   ``_QUANT_MODES`` (``wire-mode-drift``) — checked row-for-row like
+   the watch-rule/metric tables.
+
+Pure AST + text: the pass imports nothing from the analyzed tree, so
+it runs on fixtures and broken trees (the doc checks scope to any
+in-scope ``DISTRIBUTED.md``; the vocabulary-completeness directions
+run only on the default full-tree sweep, where absence is meaningful).
+"""
+import ast
+import os
+import re
+
+from .base import Finding, call_terminal, read_text, WRAP_CALLEES
+from .allowlist import MESH_AXES, COMPILE_SURFACES
+from .mesh_axes import (COLLECTIVE_AXIS_ARG, _axis_literals,
+                        _collective_axis_arg, _is_pspec_call,
+                        _SHARD_MAP_CALLEES)
+
+PASS_NAME = "spec-drift"
+
+ALLOWLIST_PATH = "paddle_tpu/analysis/allowlist.py"
+DISTRIBUTED_DOC = "docs/DISTRIBUTED.md"
+
+# backticked repo-relative path references in the distributed guide
+_DOC_PATH_RE = re.compile(
+    r"`((?:tests|docs|tools|ops|paddle_tpu)/[A-Za-z0-9_/.-]+?"
+    r"\.(?:py|md|json))`")
+# the grad_comm_configs example block and its keys
+_CFG_BLOCK_RE = re.compile(r"grad_comm_configs\s*=\s*\{(.*?)\}", re.S)
+_CFG_KEY_RE = re.compile(r"\"(\w+)\"\s*:")
+# documented wire modes: backticked quoted tokens in the grad_comm
+# section bullets
+_WIRE_MODE_RE = re.compile(r"`\"([a-z0-9_]+)\"`")
+_GRAD_COMM_SECTION_RE = re.compile(
+    r"^##[^\n]*gradient reduction[^\n]*$", re.I | re.M)
+
+
+def _line_of(text, pos):
+    return text.count("\n", 0, pos) + 1
+
+
+class SpecDriftPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        self._check_mesh_vocabulary(ctx, findings)
+        if ctx.default_tree:
+            self._check_surfaces(ctx, findings)
+        for doc in self._docs_in_scope(ctx):
+            findings.extend(self._check_doc(ctx, doc))
+        return sorted(findings, key=Finding.sort_key)
+
+    # -- 1. MESH_AXES vs construction sites ----------------------------------
+    def _check_mesh_vocabulary(self, ctx, findings):
+        used = set()
+        for mod in ctx.index.iter_modules():
+            for n in ast.walk(mod.tree):
+                if isinstance(n, ast.FunctionDef) or \
+                        isinstance(n, ast.AsyncFunctionDef):
+                    # axis-naming parameter defaults are usage sites
+                    a = n.args
+                    for p, d in zip((a.posonlyargs + a.args)
+                                    [-len(a.defaults):] if a.defaults
+                                    else [], a.defaults):
+                        if (p.arg == "axis" or p.arg == "axis_name" or
+                                p.arg.endswith("_axis")) and \
+                                isinstance(d, ast.Constant) and \
+                                isinstance(d.value, str):
+                            used.add(d.value)
+                if not isinstance(n, ast.Call):
+                    continue
+                term = call_terminal(n.func)
+                if term == "Mesh":
+                    names = None
+                    if len(n.args) > 1:
+                        names = n.args[1]
+                    for kw in n.keywords:
+                        if kw.arg == "axis_names":
+                            names = kw.value
+                    if isinstance(names, (ast.Tuple, ast.List)):
+                        for name, node in _axis_literals(names):
+                            used.add(name)
+                            if name not in MESH_AXES and not (
+                                    {self.name, "mesh-axis-undeclared"}
+                                    & mod.allowed_on_line(node.lineno)):
+                                findings.append(Finding(
+                                    self.name, mod.relpath, node.lineno,
+                                    "<mesh>", "mesh-axis-undeclared",
+                                    f"Mesh construction names axis "
+                                    f"{name!r} which is not in the "
+                                    "MESH_AXES vocabulary "
+                                    f"({ALLOWLIST_PATH}) — every "
+                                    "framework-owned mesh axis must be "
+                                    "declared so specs and collectives "
+                                    "are checkable against it", name))
+                elif _is_pspec_call(n, mod) or \
+                        term in _SHARD_MAP_CALLEES:
+                    for name, _ in _axis_literals(n):
+                        used.add(name)
+                elif term in COLLECTIVE_AXIS_ARG:
+                    expr = _collective_axis_arg(n)
+                    if expr is not None:
+                        for name, _ in _axis_literals(expr):
+                            used.add(name)
+        if ctx.default_tree:
+            for ax in MESH_AXES:
+                if ax not in used:
+                    findings.append(Finding(
+                        self.name, ALLOWLIST_PATH, 1, "<vocabulary>",
+                        "mesh-axis-unused",
+                        f"MESH_AXES declares axis {ax!r} but no mesh "
+                        "construction, PartitionSpec, shard_map spec "
+                        "or collective in the tree uses it — dead "
+                        "vocabulary reads as sharding coverage that "
+                        "isn't there; drop the entry or land the axis",
+                        ax))
+
+    # -- 2. COMPILE_SURFACES vs wrap literals --------------------------------
+    def _check_surfaces(self, ctx, findings):
+        in_tree = {}          # label -> (relpath, line)
+        for mod in ctx.index.iter_modules():
+            for n in ast.walk(mod.tree):
+                if isinstance(n, ast.Call) and \
+                        call_terminal(n.func) in WRAP_CALLEES:
+                    # walk the label argument's subtree: labels can be
+                    # conditional ("a.b" if flag else "a.c")
+                    for a in n.args:
+                        for c in ast.walk(a):
+                            if isinstance(c, ast.Constant) and \
+                                    isinstance(c.value, str) and \
+                                    "." in c.value:
+                                in_tree.setdefault(c.value,
+                                                   (mod.relpath, n.lineno))
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name) and \
+                                t.id.endswith("_SURFACE") and \
+                                isinstance(n.value, ast.Constant) and \
+                                isinstance(n.value.value, str):
+                            in_tree.setdefault(n.value.value,
+                                               (mod.relpath, n.lineno))
+        declared = set(COMPILE_SURFACES)
+        for label in sorted(set(in_tree) - declared):
+            rel, line = in_tree[label]
+            findings.append(Finding(
+                self.name, rel, line, "<surface>", "surface-drift",
+                f"compile surface {label!r} is wrapped in source but "
+                f"missing from COMPILE_SURFACES ({ALLOWLIST_PATH}) — "
+                "retrace-hazard findings and pt_compile_* metrics must "
+                "share one label vocabulary; declare it", label))
+        for label in sorted(declared - set(in_tree)):
+            findings.append(Finding(
+                self.name, ALLOWLIST_PATH, 1, "<vocabulary>",
+                "surface-drift",
+                f"COMPILE_SURFACES declares {label!r} but no "
+                "compilestats wrap literal or *_SURFACE constant in "
+                "the tree carries it — a stale label means dashboards "
+                "watch a surface that no longer reports; drop or "
+                "rewire it", label))
+
+    # -- 3. docs/DISTRIBUTED.md ----------------------------------------------
+    def _docs_in_scope(self, ctx):
+        docs = []
+        for p in ctx.ref_files:
+            if os.path.basename(p) == os.path.basename(DISTRIBUTED_DOC):
+                docs.append(p)
+        default = os.path.join(ctx.root, DISTRIBUTED_DOC)
+        if ctx.default_tree and os.path.exists(default) and \
+                not any(os.path.abspath(p) == os.path.abspath(default)
+                        for p in docs):
+            docs.append(default)
+        return docs
+
+    def _check_doc(self, ctx, doc):
+        findings = []
+        rel = os.path.relpath(doc, ctx.root).replace(os.sep, "/")
+        text = read_text(doc)
+        for m in _DOC_PATH_RE.finditer(text):
+            ref = m.group(1)
+            if not os.path.exists(os.path.join(ctx.root, ref)):
+                findings.append(Finding(
+                    self.name, rel, _line_of(text, m.start()), "<doc>",
+                    "stale-doc-ref",
+                    f"references `{ref}` which does not exist — a "
+                    "moved/renamed file leaves the guide pointing at "
+                    "nothing; fix the path", ref))
+        gc = self._grad_comm_module(ctx)
+        cfg = _CFG_BLOCK_RE.search(text)
+        if cfg is not None and gc is not None:
+            params = self._config_params(gc)
+            doc_keys = {m2.group(1): cfg.start(1) + m2.start()
+                        for m2 in _CFG_KEY_RE.finditer(cfg.group(1))}
+            for key, pos in sorted(doc_keys.items()):
+                if params and key not in params:
+                    findings.append(Finding(
+                        self.name, rel, _line_of(text, pos), "<doc>",
+                        "grad-comm-drift",
+                        f"grad_comm_configs documents key {key!r} but "
+                        "GradCommConfig takes no such parameter — the "
+                        "example silently misconfigures; fix the key",
+                        key))
+            for p in sorted(params - set(doc_keys) - {"enabled"}):
+                findings.append(Finding(
+                    self.name, rel, _line_of(text, cfg.start()), "<doc>",
+                    "grad-comm-drift",
+                    f"GradCommConfig parameter {p!r} is missing from "
+                    "the documented grad_comm_configs block — an "
+                    "undocumented knob doesn't exist for users; add "
+                    "the row", p))
+        if gc is not None:
+            sec = _GRAD_COMM_SECTION_RE.search(text)
+            if sec is not None:
+                start = sec.end()
+                nxt = text.find("\n## ", start)
+                section = text[start:nxt if nxt != -1 else len(text)]
+                doc_modes = set(_WIRE_MODE_RE.findall(section))
+                code_modes = self._quant_modes(gc)
+                if doc_modes and code_modes:
+                    for mmode in sorted(doc_modes - code_modes):
+                        findings.append(Finding(
+                            self.name, rel,
+                            _line_of(text, start), "<doc>",
+                            "wire-mode-drift",
+                            f"documents wire mode {mmode!r} which "
+                            "_QUANT_MODES does not accept — the "
+                            "config example raises at runtime; fix "
+                            "the mode list", mmode))
+                    for mmode in sorted(code_modes - doc_modes):
+                        findings.append(Finding(
+                            self.name, rel,
+                            _line_of(text, start), "<doc>",
+                            "wire-mode-drift",
+                            f"wire mode {mmode!r} is accepted by "
+                            "_QUANT_MODES but undocumented in the "
+                            "grad_comm section — document the "
+                            "accuracy contract or drop the mode",
+                            mmode))
+        return findings
+
+    @staticmethod
+    def _grad_comm_module(ctx):
+        for mod in ctx.index.iter_modules():
+            if mod.relpath.endswith("grad_comm.py"):
+                return mod
+        return None
+
+    @staticmethod
+    def _config_params(mod):
+        fi = mod.funcs.get("GradCommConfig.__init__")
+        if fi is None:
+            return set()
+        a = fi.node.args
+        return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs
+                if p.arg not in ("self", "cls")}
+
+    @staticmethod
+    def _quant_modes(mod):
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id == "_QUANT_MODES" and \
+                            isinstance(n.value, (ast.Tuple, ast.List)):
+                        return {e.value for e in n.value.elts
+                                if isinstance(e, ast.Constant) and
+                                isinstance(e.value, str)}
+        return set()

@@ -407,7 +407,8 @@ def generate(model, input_ids, max_new_tokens=32,
             if eos is not None:
                 # frozen beams may only continue with eos at +0, so they
                 # compete with live beams at their final score
-                frozen = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+                frozen = jnp.full((V,), -jnp.inf,
+                                  jnp.float32).at[eos].set(0.0)
                 logp = jnp.where(fin[:, :, None], frozen[None, None, :],
                                  logp)
             total = beam_scores[:, :, None] + logp              # (B,K,V)
@@ -439,7 +440,7 @@ def generate(model, input_ids, max_new_tokens=32,
             length = jnp.where(iseos.any(-1),
                                jnp.argmax(iseos, -1) + 1, N)
         else:
-            length = jnp.full((B, K), N)
+            length = jnp.full((B, K), N, jnp.int32)
         lp = ((5.0 + length.astype(jnp.float32)) / 6.0) \
             ** float(length_penalty)
         best = jnp.argmax(beam_scores / lp, axis=1)              # (B,)

@@ -30,6 +30,10 @@ README lookup.  This wires them into one:
                                               # (selected TPU kernels
                                               # run on the CPU backend)
     python tools/ci_check.py --skip-tests     # lint (+gate) only
+    python tools/ci_check.py --lint-only      # lint sweep alone: the
+                                              # pre-commit fast path
+                                              # (<10s, no pytest, no
+                                              # opt-in gates)
 
 Stages:
 
@@ -203,6 +207,11 @@ def main(argv=None):
                          "kernels execute on the CPU backend)")
     ap.add_argument("--skip-tests", action="store_true",
                     help="lint (and gate) only")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="run the lint sweep alone and stop — the "
+                         "pre-commit fast path (no pytest, no opt-in "
+                         "gates; combine with --changed-only for the "
+                         "inner loop)")
     ap.add_argument("--pytest-args", default="",
                     help="extra pytest flags, quoted (e.g. '-x -k "
                          "serving')")
@@ -211,6 +220,10 @@ def main(argv=None):
     rc = run_lint(args.changed_only)
     if rc != 0:
         return rc
+    if args.lint_only:
+        print("\nci_check: LINT GREEN (--lint-only: tests and gates "
+              "skipped)")
+        return 0
     if args.doctor:
         rc = run_doctor()
         if rc != 0:

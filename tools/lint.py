@@ -3,8 +3,9 @@
 ``python -m paddle_tpu.analysis``).
 
 Runs all passes — tracer-safety, host-sync budget, collective-order,
-donation, retrace-hazard, concurrency, failpoint-refs, guardian-log,
-metrics-registry — over the repo, suppressing findings recorded in
+donation, retrace-hazard, concurrency, mesh-axes, dtype-flow,
+spec-drift, failpoint-refs, guardian-log, metrics-registry — over the
+repo, suppressing findings recorded in
 ``tools/lint_baseline.json``.  Exit 0 when no NEW findings, 1
 otherwise.
 
