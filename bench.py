@@ -1562,6 +1562,244 @@ def bench_serving_spec(n_requests=64, seed=0, hidden=768, layers=12,
 
 
 # ---------------------------------------------------------------------------
+# Serving, quantized weights: the SAME Poisson trace through base,
+# int8-weight and fp8-weight engines (ISSUE 19).  Decode is weight-
+# stream-bound, so shrinking resident weight bytes is the lever; the
+# measured token-agreement rate vs the base stream is reported next to
+# every ratio (docs/serving.md "Quantized decode": floor >= 99%).
+# ---------------------------------------------------------------------------
+
+def bench_serving_quant(n_requests=64, seed=0, hidden=768, layers=12,
+                        heads=12, p_range=(32, 512), n_range=(16, 256),
+                        slots=8, chunk=32, dtype="bfloat16",
+                        p_lams=(48, 96, 192, 384), n_lams=(24, 64, 160)):
+    """Three engines over ONE trace — base (``dtype``), int8 weights,
+    fp8 weights — same trace/validity discipline as ``bench_serving``.
+    Reports useful tokens/sec per mode, speedup vs base, the MEASURED
+    token-agreement rate against the base greedy stream (quantization
+    changes the model, so agreement is a reported number, not an
+    assert), and the ``pt_serving_quant_bytes_saved`` gauge per mode.
+    The dispatch-latency validity gate guards the ratios exactly as in
+    ``serving``."""
+    import jax  # noqa: F401
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    def bucket(n, lo):
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    p_lo, p_hi = p_range
+    n_lo, n_hi = n_range
+    max_seq = bucket(p_hi, p_lo) + bucket(n_hi, n_lo)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=hidden,
+                    num_hidden_layers=layers, num_attention_heads=heads,
+                    max_position_embeddings=max_seq)
+    paddle.seed(0)
+    net = GPTForPretraining(cfg)
+    net.eval()
+    rng = np.random.RandomState(seed)
+    plens = np.clip(rng.poisson(lam=rng.choice(p_lams, size=n_requests)),
+                    p_lo, p_hi).astype(int)
+    budgets = np.clip(rng.poisson(lam=rng.choice(n_lams, size=n_requests)),
+                      n_lo, n_hi).astype(int)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(n),)).astype("int32")
+               for n in plens]
+    useful = int(budgets.sum())
+
+    def run(eng):
+        eng.reset()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, int(b)) for p, b in zip(prompts, budgets)]
+        eng.run()
+        wall = time.perf_counter() - t0
+        toks = [list(r.tokens) for r in sorted(reqs,
+                                               key=lambda r: r.req_id)]
+        return toks, eng.stats["decoded_tokens"] / wall, wall
+
+    def agreement(a, b):
+        """(free-running agreement, mean prefix-agreement).  Greedy
+        decode on a random-init model is chaotic — near-flat logit
+        margins mean ONE quant-flipped argmax diverges the whole tail,
+        so the free-running rate is a lower bound that collapses with
+        sequence length; the prefix rate (tokens before the first
+        divergence) is the per-decision number.  Per-step decision
+        fidelity at trained-margin scales is machine-checked at >=99%
+        in tests/test_quant_paths.py."""
+        n = d = 0
+        prefixes = []
+        for x, y in zip(a, b):
+            first = None
+            for i, (u, v) in enumerate(zip(x, y)):
+                d += 1
+                if u == v:
+                    n += 1
+                elif first is None:
+                    first = i
+            prefixes.append((len(x) if first is None else first)
+                            / max(len(x), 1))
+        return n / max(d, 1), sum(prefixes) / max(len(prefixes), 1)
+
+    from paddle_tpu.observability import get_registry
+    modes = (("base", None), ("int8", "int8"), ("fp8", "fp8"))
+    results, walls, dispatches, base_toks = {}, {}, {}, None
+    for name, qmode in modes:
+        eng = ServingEngine(net, num_slots=slots, chunk=chunk,
+                            max_seq_len=max_seq, dtype=dtype,
+                            quant_mode=qmode)
+        saved = None
+        if qmode is not None:
+            g = get_registry().get("pt_serving_quant_bytes_saved")
+            saved = int(g.value()) if g is not None else None
+        run(eng)                                    # compile pass
+        toks, tps, wall = run(eng)
+        walls[name] = wall
+        dispatches[name] = eng.stats["chunks"] + eng.stats["prefills"]
+        res = {"useful_tokens_per_sec": round(tps, 1),
+               "chunks": eng.stats["chunks"],
+               "prefills": eng.stats["prefills"]}
+        if qmode is None:
+            base_toks = toks
+        else:
+            agree, prefix = agreement(base_toks, toks)
+            res.update({
+                "speedup_vs_base": round(
+                    tps / max(results["base"]["useful_tokens_per_sec"],
+                              1e-9), 3),
+                "token_agreement_vs_base": round(agree, 4),
+                "prefix_agreement_vs_base": round(prefix, 4),
+                "quant_bytes_saved": saved})
+        results[name] = res
+        del eng
+
+    # One eager dispatch per mode at the decode-head shape (M=slots,
+    # K=hidden, N=vocab): engine-traced quant_matmul calls inline into
+    # the serving.decode_chunk surface, so the roofline's standalone
+    # `kernel.quant_matmul` row comes from this measured dispatch.
+    import jax.numpy as jnp
+    from paddle_tpu.ops import quant_dispatch as _qd
+    table = jnp.asarray(net.tied_lm_head._value).T      # (H, V)
+    x_dec = jnp.asarray(rng.randn(slots, hidden).astype("float32"))
+    for m in ("int8", "fp8"):
+        np.asarray(_qd.quant_matmul(x_dec, _qd.quantize_weight(table, m)))
+
+    lat_ms = _dispatch_latency_ms()
+    lat_share = None if lat_ms is None else \
+        min(max(d * lat_ms / 1e3 / max(walls[n], 1e-9)
+                for n, d in dispatches.items()), 1.0)
+    healthy = lat_share is not None and lat_share < 0.30
+    out = {"modes": results,
+           "speedup_int8": results["int8"]["speedup_vs_base"],
+           "speedup_fp8": results["fp8"]["speedup_vs_base"],
+           "agreement_int8": results["int8"]["token_agreement_vs_base"],
+           "agreement_fp8": results["fp8"]["token_agreement_vs_base"],
+           # the kernel-level uplift on real accelerator silicon, from
+           # the scan-chained latency-subtracted fp8_linear row (r5,
+           # v5e, M=32 K=N=4096): the CPU proxy reproduces the int8
+           # weight-stream win via the tiled off-TPU lowering, but the
+           # fp8 upconvert is software-emulated there, so the fp8
+           # column's deploy-path truth lives in these numbers
+           "kernel_uplift_v5e": {"fp8": 1.66, "int8": 1.32,
+                                 "source": "fp8_linear r5"},
+           "requests": n_requests, "useful_tokens": useful,
+           "slots": slots, "chunk": chunk,
+           "dispatch_latency_ms": lat_ms,
+           "latency_share_of_engine_wall": (round(lat_share, 4)
+                                            if lat_share is not None
+                                            else None),
+           "valid": healthy,
+           "model": f"gpt_h{hidden}_l{layers}", "dtype": dtype}
+    if not healthy:
+        out["invalid_reason"] = (
+            "latency-bound: per-chunk/prefill dispatch latency accounts "
+            "for >=30% of an engine's wall clock, so quant ratios "
+            "measure the axon tunnel, not the weight-stream win")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fp8 train pilot: the hapi stepper's delayed-scaling fake-quant A/B
+# (ISSUE 19).  Parity is the product — the loss envelope is the gate;
+# the step-time ratio reports what the fake-quant costs where there is
+# no fp8 hardware to pay it back.
+# ---------------------------------------------------------------------------
+
+def bench_fp8_train(B=16, steps=30, in_dim=64, width=256, depth=3,
+                    out_dim=32, warmup=5, peak=1e12):
+    """The same regression fit with and without
+    ``amp_configs="fp8"`` (identical seeds/batches): reports steps/sec
+    per mode, the loss-parity envelope (max relative deviation over
+    the run; docs/kernels.md documents <= 5%), a flops-proxy MFU, and
+    the delayed-scaling amax state's health."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(B, in_dim).astype("float32"),
+                rng.randn(B, out_dim).astype("float32"))
+               for _ in range(steps)]
+
+    def build(amp_configs=None):
+        paddle.seed(3)
+        layers = [nn.Linear(in_dim, width), nn.ReLU()]
+        for _ in range(depth - 2):
+            layers += [nn.Linear(width, width), nn.ReLU()]
+        layers += [nn.Linear(width, out_dim)]
+        net = nn.Sequential(*layers)
+        m = paddle.Model(net,
+                         inputs=[InputSpec([None, in_dim], "float32",
+                                           "x")],
+                         labels=[InputSpec([None, out_dim], "float32",
+                                           "y")])
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        m.prepare(opt, nn.MSELoss(), amp_configs=amp_configs)
+        return m
+
+    def fit(m):
+        losses, t_timed = [], None
+        for i, (x, y) in enumerate(batches):
+            if i == warmup:
+                t_timed = time.perf_counter()
+            res = m.train_batch([x], [y])
+            loss = res[0] if isinstance(res, (tuple, list)) else res
+            while isinstance(loss, (tuple, list, np.ndarray)):
+                loss = loss[0]
+            losses.append(float(loss))
+        wall = time.perf_counter() - t_timed
+        return losses, (steps - warmup) / wall
+
+    base_losses, base_sps = fit(build())
+    m8 = build(amp_configs="fp8")
+    fp8_losses, fp8_sps = fit(m8)
+    rel = [abs(a - b) / max(abs(a), 1e-6)
+           for a, b in zip(base_losses, fp8_losses)]
+    amax = np.asarray(m8._stepper.fp8_state)
+    # flops proxy: fwd 2*B*W + bwd 4*B*W per step over the matmul params
+    wparams = in_dim * width + (depth - 2) * width * width \
+        + width * out_dim
+    flops = 6.0 * B * wparams
+    return {"steps_per_sec_base": round(base_sps, 2),
+            "steps_per_sec_fp8": round(fp8_sps, 2),
+            "fp8_step_overhead": round(base_sps / max(fp8_sps, 1e-9), 3),
+            "mfu": round(flops * fp8_sps / peak, 6),
+            "max_rel_loss_dev": round(max(rel), 4),
+            "final_rel_loss_dev": round(rel[-1], 4),
+            "loss_parity_ok": max(rel) < 0.05,
+            "final_loss_base": round(base_losses[-1], 4),
+            "final_loss_fp8": round(fp8_losses[-1], 4),
+            "amax_entries": int(amax.size),
+            "amax_finite": bool(np.isfinite(amax).all()),
+            "steps": steps, "batch": B,
+            "model": f"mlp_{in_dim}x{width}x{depth}"}
+
+
+# ---------------------------------------------------------------------------
 # Serving fleet: the SAME Poisson trace replayed through ONE engine and
 # through N-replica ServingFleet routers (ISSUE 12).  Each replica is its
 # own engine (slots + KV + compiled programs) stepped by its own thread.
@@ -2338,6 +2576,18 @@ def main():
             except Exception as e:
                 configs["serving_spec"] = {"error": repr(e)[:200]}
             telemetry["serving_spec"] = _telemetry_snapshot("serving_spec")
+        if want("serving_quant"):
+            try:
+                configs["serving_quant"] = bench_serving_quant()
+            except Exception as e:
+                configs["serving_quant"] = {"error": repr(e)[:200]}
+            telemetry["serving_quant"] = _telemetry_snapshot("serving_quant")
+        if want("fp8_train"):
+            try:
+                configs["fp8_train"] = bench_fp8_train(peak=peak)
+            except Exception as e:
+                configs["fp8_train"] = {"error": repr(e)[:200]}
+            telemetry["fp8_train"] = _telemetry_snapshot("fp8_train")
         if want("serving_fleet"):
             try:
                 configs["serving_fleet"] = bench_serving_fleet()
@@ -2401,6 +2651,28 @@ def main():
             except Exception as e:
                 configs["serving_spec"] = {"error": repr(e)[:200]}
             telemetry["serving_spec"] = _telemetry_snapshot("serving_spec")
+        if which is not None and "serving_quant" in which:
+            try:
+                # decode-heavy, weight-stream-bound proxy: h=512 puts
+                # the 50304-wide fp32 head at 103MB — DRAM-resident, so
+                # the tiled int8 lowering's 4x byte cut is a measured
+                # win on the CPU backend too (1.4-1.6x at decode
+                # M=slots); fp8's e4m3 upconvert is software-emulated
+                # off-TPU, so its column reads ~1.0x here and the
+                # deploy truth is the kernel_uplift_v5e cross-ref
+                configs["serving_quant"] = bench_serving_quant(
+                    n_requests=12, hidden=512, layers=2, heads=4,
+                    p_range=(8, 16), n_range=(24, 48), slots=8, chunk=8,
+                    dtype="float32", p_lams=(8, 12), n_lams=(28, 40))
+            except Exception as e:
+                configs["serving_quant"] = {"error": repr(e)[:200]}
+            telemetry["serving_quant"] = _telemetry_snapshot("serving_quant")
+        if which is not None and "fp8_train" in which:
+            try:
+                configs["fp8_train"] = bench_fp8_train(peak=peak)
+            except Exception as e:
+                configs["fp8_train"] = {"error": repr(e)[:200]}
+            telemetry["fp8_train"] = _telemetry_snapshot("fp8_train")
         if which is not None and "serving_fleet" in which:
             try:
                 configs["serving_fleet"] = bench_serving_fleet()

@@ -278,6 +278,7 @@ COMPILE_SURFACES = (
     "kernel.flash_bwd",
     "kernel.xent_fwd",
     "kernel.xent_bwd",
+    "kernel.quant_matmul",
 )
 
 # Fallback surface labels for jit-cache sites whose module does not
@@ -503,6 +504,10 @@ FP32_CONTRACT_CASTS = {
      "_CompiledStepper._build_train.step.loss_f"):
         "AMP O1/O2 restores bf16 forward outputs to fp32 before the "
         "loss — the mixed-precision master contract",
+    ("paddle_tpu/hapi/model.py", "_fp8_apply"):
+        "fp8 train pilot: delayed-scaling amax/scale math runs in "
+        "fp32 before the fake-quant narrows (the quantizer-internals "
+        "contract, like kvcache.quantize_kv)",
     ("paddle_tpu/hapi/model.py",
      "_CompiledStepper._build_train_comm.shard_step.loss_f"):
         "AMP O1/O2 restores bf16 forward outputs to fp32 before the "

@@ -40,6 +40,40 @@ _RATE_KEYS = ("tokens_per_sec", "images_per_sec",
 # was dropped from the sweep) would otherwise stop being gated at all.
 REQUIRED_MFU_CONFIGS = ("gpt125m_s4096",)
 
+# standalone bench artifacts outside the BENCH_r* trajectory whose
+# presence (and config coverage) the pass requires (ISSUE 19): the
+# quantized-hot-path bench commits once per change, so a deleted or
+# errored artifact would silently un-gate the int8/fp8 decode and the
+# fp8 train pilot.
+REQUIRED_ARTIFACTS = {
+    "BENCH_quant.json": ("serving_quant", "fp8_train"),
+}
+
+
+def missing_required_artifacts(root):
+    """(filename, config-or-None, why) rows for every required
+    standalone artifact that is absent, unreadable, or missing one of
+    its required configs."""
+    out = []
+    for fname, cfg_names in sorted(REQUIRED_ARTIFACTS.items()):
+        path = os.path.join(root, fname)
+        if not os.path.exists(path):
+            out.append((fname, None, "required bench artifact missing"))
+            continue
+        try:
+            rec = load_bench(path)
+        except (OSError, ValueError) as e:
+            out.append((fname, None, f"unreadable: {e}"))
+            continue
+        configs = (rec.get("extra") or {}).get("configs") or {}
+        for name in cfg_names:
+            cfg = configs.get(name)
+            if not isinstance(cfg, dict) or "error" in cfg \
+                    or cfg.get("skipped"):
+                out.append((fname, name,
+                            "required config missing/errored/skipped"))
+    return out
+
 
 def missing_required_mfu(new_rec):
     """REQUIRED_MFU_CONFIGS entries whose newest record lacks a numeric
@@ -151,17 +185,24 @@ class BenchComparePass:
     optional = True
 
     def run(self, ctx):
+        art_findings = []
+        for fname, cfg, why in missing_required_artifacts(ctx.root):
+            key = f"configs.{cfg}" if cfg else "artifact"
+            art_findings.append(Finding(
+                self.name, fname, 1, "<bench>", "bench-coverage",
+                f"{key}: {why} — the quantized hot paths are ungated",
+                key))
         files = bench_files(ctx.root)
         if not files:
-            return []
+            return sorted(art_findings, key=Finding.sort_key)
         rel = os.path.relpath(files[-1], ctx.root).replace(os.sep, "/")
         try:
             new_rec = load_bench(files[-1])
         except (OSError, ValueError) as e:
-            return [Finding(self.name, rel, 1, "<bench>",
-                            "bench-unreadable",
-                            f"cannot read bench artifact: {e}", "parse")]
-        findings = []
+            return art_findings + [
+                Finding(self.name, rel, 1, "<bench>", "bench-unreadable",
+                        f"cannot read bench artifact: {e}", "parse")]
+        findings = art_findings
         # presence gate: required-MFU configs must carry a number in the
         # NEWEST artifact regardless of what older rounds reported
         for name in missing_required_mfu(new_rec):

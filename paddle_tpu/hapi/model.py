@@ -62,6 +62,28 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _fp8_apply(pv, idx, amax):
+    """fp8 train pilot: fake-quantize the Linear weights in the merged
+    param list with delayed scaling — the scale each weight uses THIS
+    step is the amax observed on a PREVIOUS step (the state vector
+    threaded through the compiled step), and the fresh amax goes back
+    out with the updated state trees.  The first step (state still
+    zero) seeds each scale just-in-time from the current amax; after
+    that the scale lags one step and the saturating cast absorbs the
+    per-step drift.  All scale math in fp32 (dtype-flow contract)."""
+    from ..ops.quant_dispatch import fp8_fake_quant
+    pv = list(pv)
+    cur = []
+    for j, i in enumerate(idx):
+        wf = pv[i].astype(jnp.float32)
+        cur_amax = jnp.max(jnp.abs(wf))
+        scale = jnp.maximum(
+            jnp.where(amax[j] > 0, amax[j], cur_amax), 1e-12)
+        pv[i] = fp8_fake_quant(pv[i], scale)
+        cur.append(cur_amax)
+    return pv, jnp.stack(cur).astype(jnp.float32)
+
+
 class _CompiledStepper:
     """Builds & caches the jitted train/eval/predict steps.
 
@@ -93,6 +115,12 @@ class _CompiledStepper:
         self.guard_numerics = False
         self.last_ok = None
         self._last_rng = None
+        # fp8 train pilot (enable_fp8): trace-time constant like
+        # guard_numerics; fp8_state is the delayed-scaling amax vector,
+        # one fp32 entry per Linear weight, donated through the step
+        self.fp8_matmul = False
+        self.fp8_state = None
+        self._fp8_idx = ()
         if self.plan is not None:
             self._apply_plan()
 
@@ -119,6 +147,37 @@ class _CompiledStepper:
         self.buffers = [b for _, b in self.network.named_buffers()]
         self.t_idx = [i for i, p in enumerate(self.params)
                       if not p.stop_gradient]
+
+    def enable_fp8(self):
+        """Turn on the fp8 train pilot: every Linear weight matmul in
+        the compiled step runs through an fp8 e4m3 fake-quant round-trip
+        with delayed scaling (see ``_fp8_apply``).  Single-device jit
+        path only — placements/grad_comm keep their own numerics; and
+        the amax state is checkpointed via ``Model.train_state_dict``'s
+        ``fp8`` group, NOT by guardian rollback snapshots (running
+        statistics re-warm in one step after a rollback)."""
+        if self.plan is not None:
+            raise ValueError(
+                "fp8 train pilot supports the single-device jit path "
+                "only (no PlacementPlan / grad_comm)")
+        from ..ops import quant_dispatch as _qd
+        if _qd._FP8_DTYPE is None:
+            # books once, outside the trace: fake-quant degrades to
+            # int8 (the grad_comm wire-mode fallback contract)
+            from ..ops import registry as _kreg
+            _kreg.record_fallback("quant_matmul", "fp8-unavailable")
+        from ..models.generation import _linear_weight_indices
+        self.fp8_matmul = True
+        self._fp8_idx = tuple(_linear_weight_indices(self.network))
+        self._train_cache.clear()
+
+    def ensure_fp8_state(self):
+        """Lazily init the delayed-scaling amax vector (zeros = first
+        step runs at scale 1.0, then real amaxes take over)."""
+        if self.fp8_state is None:
+            self.fp8_state = jnp.zeros((len(self._fp8_idx),),
+                                       jnp.float32)
+        return self.fp8_state
 
     def _forward_pure(self, param_vals, buffer_vals, key, inputs, training):
         """Run network on traced values; returns (outs, new_buffer_vals)."""
@@ -312,10 +371,12 @@ class _CompiledStepper:
         t_idx = self.t_idx
         amp = self.amp_level
         guard = self.guard_numerics   # trace-time constant: zero cost off
+        fp8 = self.fp8_matmul         # same: off costs nothing
+        fp8_idx = self._fp8_idx
         pnames = [self.param_names[i] for i in t_idx]
 
         def step(train_vals, frozen_vals, buffer_vals, opt_state, lr, key,
-                 inputs, labels):
+                 inputs, labels, fp8_amax=None):
             def loss_f(tv):
                 # merge trainable into full param list
                 pv = []
@@ -330,6 +391,12 @@ class _CompiledStepper:
                         pv.append(v)
                     else:
                         pv.append(next(fi))
+                new_amax = None
+                if fp8:
+                    # fp8 pilot: STE fake-quant over the MERGED list
+                    # (after any amp cast) so gradients flow straight
+                    # through to the trainable values
+                    pv, new_amax = _fp8_apply(pv, fp8_idx, fp8_amax)
                 ins = inputs
                 if amp in ("O1", "O2"):
                     ins = [v.astype(jnp.bfloat16)
@@ -342,28 +409,43 @@ class _CompiledStepper:
                                 if jnp.issubdtype(v.dtype, jnp.bfloat16)
                                 else v for v in out_vals]
                 loss = self._loss_pure(out_vals, labels)
-                return loss, (out_vals, new_buf)
+                return loss, (out_vals, new_buf, new_amax)
 
-            (loss, (out_vals, new_buf)), grads = jax.value_and_grad(
-                loss_f, has_aux=True)(train_vals)
+            (loss, (out_vals, new_buf, new_amax)), grads = \
+                jax.value_and_grad(loss_f, has_aux=True)(train_vals)
             new_train, new_opt = apply_functional_with_clip(
                 opt, train_vals, grads, opt_state, lr, param_names=pnames)
             if guard:
                 # guardian sentinel: ONE fused finite reduction over the
                 # whole grad tree + loss, then a device-side select that
                 # keeps the old params/buffers/opt state on trip — the
-                # skip costs no recompile and no host round-trip here
+                # skip costs no recompile and no host round-trip here.
+                # An fp8 saturation (NaN loss/grads) trips this exact
+                # ladder; the amax state also holds on trip so a
+                # poisoned batch cannot poison the scales.
                 ok = _guardian.tree_all_finite(list(grads) + [loss])
                 sel = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
                 new_train = [sel(n, o) for n, o in zip(new_train,
                                                        train_vals)]
                 new_opt = jax.tree_util.tree_map(sel, new_opt, opt_state)
                 new_buf = [sel(n, o) for n, o in zip(new_buf, buffer_vals)]
+                if fp8:
+                    new_amax = sel(new_amax, fp8_amax)
+                    return (loss, new_train, new_buf, new_opt, new_amax,
+                            out_vals, ok)
                 return loss, new_train, new_buf, new_opt, out_vals, ok
+            if fp8:
+                # OUTPUT ORDER CONTRACT: the amax state is a state tree
+                # — it comes BEFORE out_vals like the others so its
+                # donated input pairs with its own updated output
+                return loss, new_train, new_buf, new_opt, new_amax, \
+                    out_vals
             return loss, new_train, new_buf, new_opt, out_vals
 
         if self.plan is None:
-            return jax.jit(step, donate_argnums=(0, 2, 3))
+            return jax.jit(step,
+                           donate_argnums=(0, 2, 3) + ((8,) if fp8
+                                                       else ()))
         plan = self.plan
         t_sh = [self._param_shardings[i] for i in self.t_idx]
         f_sh = [self._param_shardings[i] for i in range(len(self.params))
@@ -484,6 +566,11 @@ class _CompiledStepper:
         self._last_rng = rng     # guardian attribution replays this key
 
         accumulating = (not update) or self._accum_count > 0
+        if accumulating and self.fp8_matmul:
+            raise ValueError(
+                "fp8 train pilot does not support gradient accumulation "
+                "(the amax state threads through the fused step only); "
+                "use accumulate_grad_batches=1")
         if not accumulating:
             # fused fast path: fwd+bwd+update in one executable
             if key not in self._train_cache:
@@ -491,15 +578,22 @@ class _CompiledStepper:
                     self._build_train(len(inputs), len(labels)),
                     "hapi.train_step_comm" if self._use_grad_comm()
                     else "hapi.train_step")
-            out = self._train_cache[key](train_vals, frozen_vals,
-                                         buffer_vals, self.opt_state, lr,
-                                         rng, inputs, labels)
+            fp8 = self.fp8_matmul
+            args = (train_vals, frozen_vals, buffer_vals, self.opt_state,
+                    lr, rng, inputs, labels)
+            if fp8:
+                args = args + (self.ensure_fp8_state(),)
+            out = self._train_cache[key](*args)
             if self.guard_numerics:
-                loss, new_train, new_buf, new_opt, out_vals, ok = out
+                out, ok = out[:-1], out[-1]
                 self.last_ok = ok
             else:
-                loss, new_train, new_buf, new_opt, out_vals = out
                 self.last_ok = None
+            if fp8:
+                loss, new_train, new_buf, new_opt, new_fp8, out_vals = out
+                self.fp8_state = new_fp8
+            else:
+                loss, new_train, new_buf, new_opt, out_vals = out
             for i, v in zip(self.t_idx, new_train):
                 self.params[i]._value = v
             for b, v in zip(self.buffers, new_buf):
@@ -631,14 +725,28 @@ class Model:
             assert isinstance(m, Metric), f"{m} is not a Metric"
         self._jit = jit
         amp_level = None
+        fp8 = False
         if amp_configs:
+            # fp8 train pilot: amp_configs="fp8" (pure fp8 fake-quant
+            # matmuls at model dtype) or {"level": "O1", "fp8": True}
+            # (fp8 on top of the bf16 autocast) — jit path only
             if isinstance(amp_configs, str):
-                amp_level = amp_configs
+                if amp_configs == "fp8":
+                    fp8 = True
+                else:
+                    amp_level = amp_configs
             elif isinstance(amp_configs, dict):
-                amp_level = amp_configs.get("level", "O1")
+                fp8 = bool(amp_configs.get("fp8", False))
+                amp_level = amp_configs.get("level",
+                                            None if fp8 else "O1")
+        if fp8 and not jit:
+            raise ValueError("fp8 train pilot requires the compiled "
+                             "stepper (prepare(jit=True))")
         if jit:
             self._stepper = _CompiledStepper(self.network, loss, optimizer,
                                              amp_level)
+            if fp8:
+                self._stepper.enable_fp8()
         if optimizer is not None and optimizer._parameter_list is None:
             optimizer._parameter_list = self.network.parameters()
 
@@ -742,6 +850,11 @@ class Model:
         ``param_<i>``), so a preempted eager run keeps its moments."""
         state = {"model": dict(self.network.state_dict())}
         st = self._stepper
+        if st is not None and st.fp8_matmul:
+            # fp8 pilot: the delayed-scaling amax vector rides the
+            # manifest checkpoint (guardian rollback snapshots do NOT
+            # carry it — running statistics re-warm in one step)
+            state["fp8"] = {"amax": st.ensure_fp8_state()}
         if st is not None and self._optimizer is not None:
             st.ensure_opt_state()
             opt = {}
@@ -791,6 +904,10 @@ class Model:
                 "'model.<param_name>' entries as written by "
                 "Model.train_state_dict / the fit emergency save")
         st = self._stepper
+        if st is not None and st.fp8_matmul:
+            v = flat.get("fp8.amax")
+            if v is not None:
+                st.fp8_state = jnp.asarray(v, jnp.float32)
         if st is not None and self._optimizer is not None:
             st.ensure_opt_state()
             new_opt = []

@@ -45,7 +45,7 @@ from ..observability import tracing as _tracing
 from ..analysis import register_jit_surface
 from ..framework import guardian
 from ..models.generation import (build_apply, build_pick, cast_weights,
-                                 dominant_float_dtype)
+                                 dominant_float_dtype, quantize_weights)
 from ..profiler import RecordEvent
 from .scheduler import FCFSScheduler, Request
 
@@ -148,6 +148,19 @@ class ServingEngine:
       bitwise-identical to the dense engine and ``generate()`` (int8
       aside); resident KV HBM scales with live tokens instead of
       S x MAX.  See docs/serving.md.
+    - ``quant_mode="int8"`` (or ``"fp8"``) pre-quantizes the model's
+      Linear weights once (per-output-channel absmax scales, via
+      ``generation.quantize_weights``) and routes every decode-chunk
+      linear through the ``quant_matmul`` kernel dispatch — the
+      weight-stream-bound decode reads 1 byte/weight instead of 2-4.
+      Greedy picks over quantized logits track bf16 at a measured
+      token-agreement rate (docs/serving.md documents the contract);
+      the default ``quant_mode=None`` path is untouched and stays
+      bitwise-identical to ``generate()``.  Composes with both KV
+      modes (int8 KV included) and speculative decoding (the draft
+      model stays unquantized — it is small by construction, and
+      greedy verification re-anchors output on the quantized target
+      either way).
     - ``spec_decode=SpecConfig(...)`` turns on speculative decoding
       (``inference/speculative.py``): each compiled chunk runs
       draft–verify steps that emit 1..gamma+1 tokens per batched target
@@ -165,9 +178,13 @@ class ServingEngine:
                  prefill_buckets=None, dtype=None, eos_token_id=None,
                  pad_token_id=0, max_prefills_per_gap=None,
                  kv_mode="dense", page_size=16, num_pages=None,
-                 kv_dtype=None, prefix_cache=True, spec_decode=None):
+                 kv_dtype=None, prefix_cache=True, spec_decode=None,
+                 quant_mode=None):
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
+        if quant_mode is not None and quant_mode not in ("int8", "fp8"):
+            raise ValueError(f"quant_mode {quant_mode!r} not in "
+                             "(None, 'int8', 'fp8')")
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode {kv_mode!r} not in "
                              "('dense', 'paged')")
@@ -221,6 +238,15 @@ class ServingEngine:
             self.cache_dtype = jnp.dtype(dtype)
             self._pvals = cast_weights(model, self._pvals,
                                        self.cache_dtype)
+        self.quant_mode = quant_mode
+        if quant_mode is not None:
+            # weight-quantization pass AFTER the cast (mirrors
+            # refresh_weights): Linear weights become QuantizedWeight
+            # pytrees that ride self._pvals through every jit family
+            # unchanged; F.linear dispatches them via quant_matmul
+            self._pvals = quantize_weights(model, self._pvals,
+                                           quant_mode)
+            self._book_quant_bytes()
         apply = build_apply(model, self._params)
         pick = build_pick(True, 1.0, 0, 1.0)       # greedy, fp32 picks
         self._spec = spec_decode
@@ -392,7 +418,14 @@ class ServingEngine:
         pvals = [p._value for p in self._params]
         if self._cast_override:
             pvals = cast_weights(self.model, pvals, self.cache_dtype)
+        if self.quant_mode is not None:
+            # re-quantize AFTER the cast, mirroring construction; the
+            # pass is identity-cached on the (cast) value list, so a
+            # no-op refresh re-quantizes nothing
+            pvals = quantize_weights(self.model, pvals, self.quant_mode)
         self._pvals = pvals
+        if self.quant_mode is not None:
+            self._book_quant_bytes()
         if self._spec is not None and self._model_draft:
             dpvals = [p._value for p in self._draft_params]
             if self._cast_override:
@@ -404,6 +437,14 @@ class ServingEngine:
             # slots are the user's race (same as dense), but serving a
             # stale prefix to a FUTURE admission never is
             self._kv.clear_prefix()
+
+    def _book_quant_bytes(self):
+        """Book the resident-weight bytes the quantization pass saved
+        (host arithmetic over shapes/dtypes — no device sync)."""
+        from ..ops.quant_dispatch import QuantizedWeight
+        saved = sum(v.bytes_saved() for v in self._pvals
+                    if isinstance(v, QuantizedWeight))
+        _obs.set_gauge("pt_serving_quant_bytes_saved", saved)
 
     # -- API ---------------------------------------------------------------
     def _check_extent(self, prompt_len, total_extent):

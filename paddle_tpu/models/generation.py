@@ -57,7 +57,8 @@ def _caches_for(model):
     """
     entry = model.__dict__.get("_generation_caches")
     if entry is None or entry.get("owner_id") != id(model):
-        entry = _GenCaches(owner_id=id(model), jit={}, cast=None)
+        entry = _GenCaches(owner_id=id(model), jit={}, cast=None,
+                           quant=None)
         # plain attr set: Layer.__setattr__ would try to register it
         object.__setattr__(model, "_generation_caches", entry)
     return entry
@@ -95,6 +96,68 @@ def cast_weights(model, pvals, cache_dtype):
            if jnp.issubdtype(v.dtype, jnp.floating) else v
            for v in pvals]
     caches["cast"] = (str(cache_dtype), originals, out)
+    return out
+
+
+def _linear_weight_indices(model):
+    """Positions (in ``named_parameters()`` order) of 2-D floating
+    Linear weights — the matmuls the quantization pass narrows.  Biases,
+    norms and (untied) embeddings stay in the original dtype; a tied LM
+    head is handled separately (see :func:`quantize_weights`)."""
+    from ..nn.layer.common import Linear
+    params = [p for _, p in model.named_parameters()]
+    index = {id(p): i for i, p in enumerate(params)}
+    out = set()
+    for _, sub in model.named_sublayers():
+        if not isinstance(sub, Linear):
+            continue
+        i = index.get(id(getattr(sub, "weight", None)))
+        if i is None:
+            continue
+        v = params[i]._value
+        if v.ndim == 2 and jnp.issubdtype(v.dtype, jnp.floating):
+            out.add(i)
+    return sorted(out)
+
+
+def quantize_weights(model, pvals, mode):
+    """Pre-quantize the model's Linear weights once per (mode, weight
+    identity): each selected ``pvals`` entry is replaced by an
+    ``ops.quant_dispatch.QuantizedWeight`` (a registered pytree, so the
+    list threads through the existing serving jit signatures unchanged,
+    and ``build_apply`` swaps the container into the parameter where
+    ``F.linear`` dispatches it through ``quant_matmul``).  Identity
+    caching mirrors :func:`cast_weights`: a train step (new ``_value``
+    arrays) re-quantizes automatically; repeated serving calls never
+    re-materialize the narrow copies."""
+    from ..ops.quant_dispatch import quantize_weight
+    caches = _caches_for(model)
+    # seed-era cache entries predate the "quant" slot
+    ent = caches.get("quant")
+    if (ent is not None and ent[0] == str(mode)
+            and len(ent[1]) == len(pvals)
+            and all(a is b for a, b in zip(ent[1], pvals))):
+        return ent[2]
+    originals = pvals
+    out = list(pvals)
+    for i in _linear_weight_indices(model):
+        out[i] = quantize_weight(pvals[i], mode)
+    # A tied LM head (``model.tied_lm_head`` → the vocab table reused as
+    # the logits matmul, e.g. GPT) is the single largest weight stream
+    # in decode.  Quantize it TRANSPOSED — (H, V) with per-vocab-channel
+    # scales — so one narrow copy serves both consumers: the head
+    # matmul (``quant_matmul``) and the input-embedding gather
+    # (``dequant_rows`` via ``F.embedding``).
+    tied = getattr(model, "tied_lm_head", None)
+    if tied is not None:
+        params = [p for _, p in model.named_parameters()]
+        for i, p in enumerate(params):
+            if p is tied:
+                v = pvals[i]
+                if v.ndim == 2 and jnp.issubdtype(v.dtype, jnp.floating):
+                    out[i] = quantize_weight(v.T, mode)
+                break
+    caches["quant"] = (str(mode), originals, out)
     return out
 
 

@@ -366,15 +366,35 @@ class GPTForPretraining(nn.Layer, GenerationMixin):
         self.config = config
         _init_gpt_weights(self, config.initializer_range)
 
+    @property
+    def tied_lm_head(self):
+        """The vocab embedding doubling as the LM head (the literal
+        weight tie above).  ``generation.quantize_weights`` reads this
+        to narrow the table TRANSPOSED — per-vocab channels serve both
+        the decode head matmul (``quant_matmul``) and the input gather
+        (``dequant_rows``)."""
+        return self.gpt.embeddings.word_embeddings.weight
+
+    def _head(self, x, w):
+        # serving quantization may have swapped the tied table for a
+        # transposed QuantizedWeight: the head then dispatches through
+        # the kernel registry (closure capture, like F.linear's branch)
+        wv = getattr(w, "_value", None)
+        if type(wv).__name__ == "QuantizedWeight":
+            from ..ops.quant_dispatch import quant_matmul
+            return call_op(lambda h: quant_matmul(h, wv,
+                                                  out_dtype=h.dtype), x)
+        return call_op(lambda h, t: h @ t.T, x, w)
+
     def forward(self, input_ids, position_ids=None, caches=None, pos=None,
                 attn_mask=None):
         w = self.gpt.embeddings.word_embeddings.weight
         if pos is not None:
             x, caches = self.gpt(input_ids, caches=caches, pos=pos,
                                  attn_mask=attn_mask)
-            return call_op(lambda h, wv: h @ wv.T, x, w), caches
+            return self._head(x, w), caches
         x = self.gpt(input_ids, position_ids)
-        return call_op(lambda h, wv: h @ wv.T, x, w)
+        return self._head(x, w)
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -12,6 +12,23 @@ from ...tensor._helpers import ensure_tensor
 
 def linear(x, weight, bias=None, name=None):
     # paddle weight layout: (in_features, out_features)
+    wv = getattr(weight, "_value", None)
+    if type(wv).__name__ == "QuantizedWeight":
+        # serving weight-quantization pass (generation.quantize_weights)
+        # swapped a QuantizedWeight container into this parameter: the
+        # matmul dispatches through the kernel registry.  Must run
+        # BEFORE autocast/ensure_tensor — the container has no .dtype
+        # and the quantized path owns its own precision contract
+        # (inference-only: round/clip has no useful gradient).
+        from ...ops.quant_dispatch import quant_matmul
+        x = ensure_tensor(x)
+
+        def _qlin(v, *mb):
+            out = quant_matmul(v, wv, out_dtype=v.dtype)
+            return out + mb[0].astype(out.dtype) if mb else out
+        if bias is not None:
+            return call_op(_qlin, x, ensure_tensor(bias))
+        return call_op(_qlin, x)
     from ...amp import autocast_inputs
     x, weight, bias = autocast_inputs(
         "linear", ensure_tensor(x), ensure_tensor(weight),
@@ -108,6 +125,23 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    wv = getattr(weight, "_value", None)
+    if type(wv).__name__ == "QuantizedWeight":
+        # tied vocab table narrowed by the serving quantization pass
+        # (stored TRANSPOSED — see generation.quantize_weights): the
+        # gather dequantizes only the touched rows.  Same
+        # before-autocast/closure-capture contract as F.linear's
+        # quantized branch (inference-only).
+        from ...ops.quant_dispatch import dequant_rows
+        x = ensure_tensor(x)
+
+        def _qemb(i):
+            out = dequant_rows(wv, i)
+            if padding_idx is not None:
+                mask = (i != padding_idx)[..., None]
+                out = out * mask.astype(out.dtype)
+            return out
+        return call_op(_qemb, x.detach())
     x, weight = ensure_tensor(x), ensure_tensor(weight)
 
     def _emb(i, w):

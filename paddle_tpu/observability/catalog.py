@@ -75,6 +75,12 @@ METRICS = {
     "pt_serving_prefills_total": {
         "type": _C, "labels": ("bucket",),
         "help": "compiled bucket prefill dispatches by bucket length"},
+    "pt_serving_quant_bytes_saved": {
+        "type": _G, "labels": (),
+        "help": "resident weight bytes saved by the engine's quant_mode "
+                "pass (quantized vs original dtype, scale planes "
+                "counted against the win; host arithmetic over static "
+                "shapes)"},
     # -- speculative decoding (inference/speculative.py) ------------------
     "pt_serving_spec_proposed_total": {
         "type": _C, "labels": (),
@@ -265,7 +271,11 @@ METRICS = {
         "help": "calls the platform policy routed to a Pallas impl but "
                 "a kernel contract sent to the XLA path instead: "
                 "mask | scale | dropout | cross-seq | short-seq | "
-                "pad-noncausal | mask-large | unaligned-vocab"},
+                "pad-noncausal | mask-large | unaligned-vocab | "
+                "fp8-unavailable (no float8_e4m3fn in this jax build; "
+                "weights degraded to int8) | fp8-weight-only (fp8 "
+                "always streams through the XLA weight-only path — "
+                "no Pallas fp8 kernel by design)"},
     "pt_kernel_autotune_runs_total": {
         "type": _C, "labels": ("kernel",),
         "help": "block-size micro-sweeps executed (autotune_flash; "

@@ -1,0 +1,280 @@
+"""Quantized-weight matmul dispatch behind the kernel registry.
+
+PR 15 revived the ``quant_matmul`` Pallas kernel and PR 19 puts it on a
+compiled hot path: the serving engine pre-quantizes linear weights once
+(per-output-channel absmax scales, the ``grad_comm`` wire-mode
+convention) and every decode-chunk linear dispatches through
+:func:`quant_matmul` here.  This module owns the *policy* half:
+
+- :class:`QuantizedWeight` — a registered jax pytree holding the narrow
+  weight + its fp32 per-channel scale, so a quantized weight threads
+  through the existing serving jit signatures (``pvals`` arg 0) with
+  ZERO signature changes: jax flattens it into (q, scale) leaves and the
+  traced forward sees the same container rebuilt from tracers.
+- :func:`quantize_weight` — the one-time pass: int8 (symmetric absmax)
+  or fp8 e4m3; fp8 degrades to int8 when the jax build has no
+  ``float8_e4m3fn`` (the ``grad_comm`` fp8-wire fallback contract),
+  booked as a ``fp8-unavailable`` kernel fallback.
+- :func:`quant_matmul` — the shared dispatch: ``registry.choose``
+  picks pallas (TPU / interpret-mode CI) or the XLA dot_general+dequant
+  reference with identical math.  fp8 always takes the XLA weight-only
+  stream (there is deliberately NO Pallas fp8 kernel: the v5e MXU has
+  no fp8 arithmetic and XLA's fused upconvert-in-the-weight-stream
+  beats every Pallas variant tried — see ops/pallas/quant_matmul.py);
+  on a pallas selection that route is booked as ``fp8-weight-only``.
+
+Standalone (eager) dispatches are tracked under the
+``kernel.quant_matmul`` compilestats surface; calls traced into a
+larger program (the serving decode chunk) inline into the caller's
+surface, exactly like the flash/xent kernels.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import registry as kreg
+from .pallas.quant_matmul import (fp8_matmul, fp8_quantize_weight,
+                                  int8_matmul)
+
+__all__ = ["QuantizedWeight", "quantize_weight", "quant_matmul",
+           "dequant_rows", "fp8_fake_quant", "QUANT_MODES"]
+
+# registry policy: Pallas on TPU (or interpret mode), XLA reference math
+# with identical numerics everywhere else
+kreg.register("quant_matmul", "pallas", None, platforms=("tpu",))
+kreg.register("quant_matmul", "xla", None, platforms=("*",))
+
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+_I8_BND = 127.0
+QUANT_MODES = ("int8", "fp8")
+
+
+class QuantizedWeight:
+    """A quantized linear weight: narrow values + per-channel scale.
+
+    ``q``: (K, N) int8 or float8_e4m3fn; ``scale``: (N,) fp32.  Dequant
+    contract per mode: int8 ``w ~= q * scale / 127`` (the
+    ``int8_matmul`` w_scale convention), fp8 ``w ~= q * scale``.
+    ``orig_dtype`` remembers the pre-quantization dtype so outputs and
+    byte accounting stay anchored to what the bf16 path would have used.
+    Registered as a jax pytree (children = (q, scale)) so it rides any
+    existing ``pvals`` argument untouched.
+    """
+
+    __slots__ = ("q", "scale", "mode", "orig_dtype")
+
+    def __init__(self, q, scale, mode, orig_dtype):
+        self.q = q
+        self.scale = scale
+        self.mode = mode
+        self.orig_dtype = str(orig_dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def bytes_saved(self):
+        """Host-side accounting: resident bytes the quantization saved
+        vs the original dtype (scale plane counted against the win)."""
+        k, n = (int(d) for d in self.q.shape)
+        orig = k * n * jnp.dtype(self.orig_dtype).itemsize
+        return orig - (k * n + n * 4)   # q is 1 byte/elt in both modes
+
+    def __repr__(self):
+        return (f"QuantizedWeight(mode={self.mode!r}, "
+                f"shape={tuple(self.q.shape)}, orig={self.orig_dtype!r})")
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight,
+    lambda qw: ((qw.q, qw.scale), (qw.mode, qw.orig_dtype)),
+    lambda aux, children: QuantizedWeight(children[0], children[1],
+                                          aux[0], aux[1]))
+
+
+def quantize_weight(w, mode):
+    """One-time per-output-channel absmax quantization of a (K, N)
+    weight.  ``mode``: ``"int8"`` or ``"fp8"``; fp8 falls back to int8
+    (booked as ``fp8-unavailable``) when the jax build lacks
+    float8_e4m3fn — the grad_comm wire-mode fallback contract."""
+    if mode not in QUANT_MODES:
+        raise ValueError(f"quantize_weight: mode must be one of "
+                         f"{QUANT_MODES}, got {mode!r}")
+    orig_dtype = w.dtype
+    if mode == "fp8" and _FP8_DTYPE is None:
+        kreg.record_fallback("quant_matmul", "fp8-unavailable")
+        mode = "int8"
+    # absmax/scale math runs in fp32 before narrowing (dtype-flow
+    # contract, like kvcache.quantize_kv)
+    wf = jnp.asarray(w, jnp.float32)
+    if mode == "fp8":
+        q, scale = fp8_quantize_weight(wf)
+        return QuantizedWeight(q, scale, "fp8", orig_dtype)
+    amax = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), 1e-12)
+    q = jnp.clip(jnp.round(wf * (_I8_BND / amax[None, :])),
+                 -_I8_BND, _I8_BND).astype(jnp.int8)
+    # int8_matmul's w_scale convention: dequant factor = scale / 127,
+    # so the stored scale is exactly the per-channel absmax
+    return QuantizedWeight(q, amax, "int8", orig_dtype)
+
+
+def dequant_rows(qw, ids):
+    """Rows of the ORIGINAL (V, H) vocab table from its TRANSPOSED
+    quantized form — the tied-embedding gather.
+
+    ``generation.quantize_weights`` narrows tied lm-head tables as
+    ``quantize_weight(table.T, mode)`` — a (H, V) ``QuantizedWeight``
+    whose per-channel scales are per VOCAB TOKEN, so one narrow copy
+    serves both consumers: the decode head matmul streams it through
+    :func:`quant_matmul`, and the input-embedding gather dequantizes
+    just the touched rows here (``ids`` (...,) int -> (..., H) in the
+    original dtype, per-element error within the same
+    ``scale/254`` / e4m3 bound as the head).
+    """
+    ids = jnp.asarray(ids)
+    cols = jnp.take(qw.q, ids, axis=1)                   # (H, ...)
+    g = jnp.moveaxis(cols, 0, -1).astype(jnp.float32)    # (..., H)
+    s = jnp.take(qw.scale, ids, axis=0)[..., None].astype(jnp.float32)
+    g = g * (s / _I8_BND) if qw.mode == "int8" else g * s
+    return g.astype(qw.orig_dtype)
+
+
+def fp8_fake_quant(w, scale):
+    """Straight-through fp8 e4m3 fake-quantization for the hapi train
+    pilot: the forward sees ``dequant(quant(w))`` (a real fp8
+    round-trip, so overflow shows up as nonfinite exactly as it would
+    on deployed fp8 hardware — the guardian's sentinel domain), while
+    the backward passes gradients straight through to ``w``.
+
+    ``scale`` is the delayed-scaling amax (fp32 scalar): the tensor is
+    mapped onto the fp8 range as ``clip(w, ±scale) * 448 / scale`` — a
+    SATURATING cast (jax's float8 conversion is not: un-clipped values
+    past the range become NaN, and a weight only has to drift past last
+    step's amax by one ulp to cross it).  Nonfinite inputs still
+    propagate through the clip, so a poisoned batch reaches the
+    guardian sentinel unchanged.  Builds without float8_e4m3fn degrade
+    to int8 fake-quant (the ``fp8-unavailable`` contract; the enabling
+    call site books the fallback once, outside the trace).
+    """
+    wf = w.astype(jnp.float32)
+    wc = jnp.clip(wf, -scale, scale)
+    if _FP8_DTYPE is None:
+        q = jnp.clip(jnp.round(wc * (_I8_BND / scale)), -_I8_BND, _I8_BND)
+        deq = q * (scale / _I8_BND)
+    else:
+        q = (wc * (448.0 / scale)).astype(_FP8_DTYPE)
+        deq = q.astype(jnp.float32) * (scale / 448.0)
+    return (wf + lax.stop_gradient(deq - wf)).astype(w.dtype)
+
+
+# Non-TPU weight-streaming lowering: XLA CPU does NOT fuse the
+# narrow->wide upconvert into its GEMM — the dequantized f32/bf16 temp
+# materializes, so a naive convert+dot streams MORE DRAM bytes than the
+# unquantized matmul and quantization can never win off-TPU.  Weights
+# whose dequant footprint exceeds _BLK_MIN_BYTES (i.e. DRAM-resident,
+# the only regime where the byte cut pays) instead go through an
+# N-tiled scan: each (K, _BLK_N) tile upconverts into cache, GEMMs,
+# and is dropped, so DRAM streams the 1-byte weights exactly once
+# (measured 1.5x over the f32 GEMM at decode M=4, K=512, N=50304).
+# TPU never takes this path — XLA's own fused streaming wins there.
+_BLK_N = 1024
+_BLK_MIN_BYTES = 32 << 20
+
+
+def _blocked_dot(x2, q, cast_dtype):
+    """``x2 @ cast(q)`` (fp32 accum) via cache-sized weight tiles."""
+    k, n = q.shape
+    pad = (-n) % _BLK_N
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad)))
+    qt = q.reshape(k, -1, _BLK_N).transpose(1, 0, 2)
+
+    def one(c, w):
+        o = lax.dot_general(x2, w.astype(cast_dtype),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        return c, o
+
+    _, outs = lax.scan(one, 0, qt)               # (nT, M, _BLK_N)
+    out = outs.transpose(1, 0, 2).reshape(x2.shape[0], n + pad)
+    return out[:, :n] if pad else out
+
+
+def _wants_blocked(q):
+    return jax.default_backend() != "tpu" and 4 * q.size > _BLK_MIN_BYTES
+
+
+def _xla_int8(x2, q, scale, act_scale, out_dtype):
+    """The dot_general+dequant reference: same math as the Pallas
+    kernel (quantize -> integer accumulate -> fp32 epilogue).  The
+    accumulation LOWERING is backend-aware: on TPU the s8 x s8 -> s32
+    dot hits the MXU's native int8 path; everywhere else XLA scalarizes
+    that dot (measured ~8x slower than the f32 GEMM at decode shapes on
+    CPU), so the integer products accumulate in f32 over the SAME
+    quantized values — exact while the running sum stays under 2^24
+    (K <~ 1000 at worst-case magnitudes), ~1e-7 relative beyond — with
+    DRAM-resident weights taking the tiled ``_blocked_dot`` stream."""
+    xq = jnp.clip(jnp.round(x2.astype(jnp.float32) / act_scale * _I8_BND),
+                  -_I8_BND - 1, _I8_BND).astype(jnp.int8)
+    if jax.default_backend() == "tpu":
+        acc = lax.dot_general(xq, q, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        acc = acc.astype(jnp.float32)
+    elif _wants_blocked(q):
+        acc = _blocked_dot(xq.astype(jnp.float32), q, jnp.float32)
+    else:
+        acc = lax.dot_general(xq.astype(jnp.float32),
+                              q.astype(jnp.float32),
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out = acc * (act_scale / _I8_BND) \
+        * (scale.astype(jnp.float32) / _I8_BND)
+    return out.astype(out_dtype)
+
+
+def _quant_matmul(x, q, scale, *, mode, impl, interpret, out_dtype):
+    lead, k = x.shape[:-1], x.shape[-1]
+    x2 = x.reshape(-1, k)
+    if mode == "fp8":
+        # weight-only fp8: XLA's fused upconvert IS the deploy path on
+        # every impl (no Pallas fp8 kernel by design — v5e MXU has no
+        # fp8 arithmetic); identical math either way.  fp8 does NOT
+        # take the tiled off-TPU lowering: the e4m3 upconvert is
+        # software-emulated per element on CPU, so tiling the stream
+        # just re-times the emulation (measured 3x slower than
+        # fp8_matmul's own convert+dot) — int8 is the mode whose
+        # upconvert the CPU vectorizes.
+        out2 = fp8_matmul(x2, q, scale, out_dtype=out_dtype)
+        return out2.reshape(*lead, q.shape[1])
+    # int8: dynamic per-call activation absmax, fp32 scale math (the
+    # serving decode has no calibration pass; one fused global reduce)
+    act_scale = jnp.maximum(
+        jnp.max(jnp.abs(x2.astype(jnp.float32))), 1e-6)
+    if impl == "pallas":
+        out2 = int8_matmul(x2, q, scale, act_scale,
+                           out_dtype=out_dtype, interpret=interpret)
+    else:
+        out2 = _xla_int8(x2, q, scale, act_scale, out_dtype)
+    return out2.reshape(*lead, q.shape[1])
+
+
+_tracked = kreg.TrackedKernel(_quant_matmul, kreg.QUANT_MATMUL_SURFACE)
+
+
+def quant_matmul(x, qw, out_dtype=None):
+    """``x @ dequant(qw)`` through the registry-selected impl.
+
+    ``x``: (..., K) float; ``qw``: :class:`QuantizedWeight`.  Returns
+    (..., N) in ``out_dtype`` (default: ``x.dtype``).  Selection order
+    and overrides (``force()`` / ``PADDLE_TPU_KERNEL_QUANT_MATMUL``)
+    follow docs/kernels.md; eager dispatches are compilestats-tracked
+    under ``kernel.quant_matmul``.
+    """
+    sel = kreg.choose("quant_matmul")
+    if qw.mode == "fp8" and sel.impl == "pallas":
+        kreg.record_fallback("quant_matmul", "fp8-weight-only")
+    if out_dtype is None:
+        out_dtype = x.dtype
+    return _tracked(x, qw.q, qw.scale, mode=qw.mode, impl=sel.impl,
+                    interpret=sel.interpret,
+                    out_dtype=jnp.dtype(out_dtype).name)

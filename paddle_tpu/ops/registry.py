@@ -64,6 +64,7 @@ FLASH_FWD_LSE_SURFACE = "kernel.flash_fwd_lse"
 FLASH_BWD_SURFACE = "kernel.flash_bwd"
 XENT_FWD_SURFACE = "kernel.xent_fwd"
 XENT_BWD_SURFACE = "kernel.xent_bwd"
+QUANT_MATMUL_SURFACE = "kernel.quant_matmul"
 
 _INTERPRET_ENV = "PADDLE_TPU_KERNEL_INTERPRET"
 _ATTN_ENV = "PADDLE_TPU_ATTN_IMPL"          # legacy attention spelling
@@ -118,6 +119,8 @@ def _ensure_defaults(kernel):
             from ..nn.functional import attention  # noqa: F401 (registers)
         elif kernel == "xent":
             from .pallas import fused_xent         # noqa: F401 (registers)
+        elif kernel == "quant_matmul":
+            from . import quant_dispatch           # noqa: F401 (registers)
     except ImportError:  # pragma: no cover - missing optional dep
         pass
 

@@ -160,7 +160,7 @@ def run_kernels():
            "tests/test_flash_attention.py", "tests/test_fused_xent.py",
            "tests/test_pallas_fused.py", "tests/test_quant_matmul.py",
            "tests/test_varlen_attention.py",
-           "tests/test_kernel_registry.py",
+           "tests/test_kernel_registry.py", "tests/test_quant_paths.py",
            "-q", "--continue-on-collection-errors",
            "-p", "no:cacheprovider"]
     env = {**os.environ, "PADDLE_TPU_KERNEL_INTERPRET": "1"}
