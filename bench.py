@@ -126,6 +126,25 @@ def _roofline_snapshot(measured_ms, peak_flops, hbm_bw):
         return {"error": repr(e)[:160]}
 
 
+def _memory_snapshot():
+    """Write the HBM ledger's two-sided snapshot next to roofline.json
+    (``<dir>/memory.json``): one static memory_analysis row per
+    registry surface plus the run's census/forecast summary.  The
+    bench gate requires this artifact to accompany committed BENCH_*
+    files — a quant/serving change must never land without its memory
+    story."""
+    try:
+        from paddle_tpu.observability import memory
+        path = memory.write_memory_json()
+        snap = memory.snapshot()
+        compiled = sum(1 for r in snap["surfaces"].values()
+                       if r.get("compiled"))
+        return {"memory": path, "surfaces": len(snap["surfaces"]),
+                "compiled": compiled}
+    except Exception as e:
+        return {"error": repr(e)[:160]}
+
+
 def _timeit(step, iters, *state):
     """Run ``state = step(*state)`` iters times; the caller's step returns
     (loss_like_scalar, *new_state).  Returns (seconds, final_loss)."""
@@ -2727,6 +2746,7 @@ def main():
             primary.get("dispatch_ms"):
         measured["bench.train_step"] = primary["dispatch_ms"]
     telemetry["roofline"] = _roofline_snapshot(measured, peak, hbm_bw)
+    telemetry["memory"] = _memory_snapshot()
 
     if primary is not None:
         rate = primary["tokens_per_sec"]

@@ -60,6 +60,12 @@ MONITORED_MODULES = (
     "paddle_tpu/observability/flight.py",
     "paddle_tpu/observability/watch.py",
     "paddle_tpu/observability/doctor.py",
+    # HBM memory ledger (ISSUE 20): the live-buffer census runs at the
+    # same pre-existing sync points the flight recorder uses and reads
+    # only host metadata (.nbytes/shape off live arrays + the page
+    # pool's own counters) — a device readback here is always a bug, so
+    # the module is monitored with ZERO allowlist entries
+    "paddle_tpu/observability/memory.py",
 )
 
 # Call terminals that force (or mark) a device->host sync.

@@ -24,6 +24,7 @@ import re
 from .base import Finding
 
 __all__ = ["BenchComparePass", "bench_files", "load_bench", "compare",
+           "missing_memory_artifact", "MEMORY_ARTIFACT",
            "DEFAULT_THRESHOLD", "THRESHOLD_ENV"]
 
 DEFAULT_THRESHOLD = 0.05
@@ -48,6 +49,45 @@ REQUIRED_MFU_CONFIGS = ("gpt125m_s4096",)
 REQUIRED_ARTIFACTS = {
     "BENCH_quant.json": ("serving_quant", "fp8_train"),
 }
+
+# the HBM ledger artifact bench.py writes next to roofline.json
+# (ISSUE 20): any committed bench trajectory must carry it, with a
+# static row for EVERY surface in the jit-surface registry — a surface
+# dropped from the ledger is memory-blind exactly where the envelope
+# check matters
+MEMORY_ARTIFACT = "telemetry/memory.json"
+
+
+def missing_memory_artifact(root):
+    """(filename, surface-or-None, why) rows when committed bench
+    artifacts lack a valid ``telemetry/memory.json`` companion.  No
+    bench artifacts at all -> no requirement (nothing to accompany)."""
+    have_bench = bool(bench_files(root)) or any(
+        os.path.exists(os.path.join(root, f))
+        for f in REQUIRED_ARTIFACTS)
+    if not have_bench:
+        return []
+    path = os.path.join(root, MEMORY_ARTIFACT)
+    if not os.path.exists(path):
+        return [(MEMORY_ARTIFACT, None,
+                 "memory.json must accompany committed BENCH_* "
+                 "artifacts")]
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [(MEMORY_ARTIFACT, None, f"unreadable: {e}")]
+    surfaces = doc.get("surfaces")
+    if not isinstance(surfaces, dict) or not surfaces:
+        return [(MEMORY_ARTIFACT, None,
+                 "no per-surface static ledger rows")]
+    out = []
+    from .allowlist import COMPILE_SURFACES
+    for name in COMPILE_SURFACES:
+        if not isinstance(surfaces.get(name), dict):
+            out.append((MEMORY_ARTIFACT, name,
+                        "registry surface has no static row"))
+    return out
 
 
 def missing_required_artifacts(root):
@@ -192,6 +232,11 @@ class BenchComparePass:
                 self.name, fname, 1, "<bench>", "bench-coverage",
                 f"{key}: {why} — the quantized hot paths are ungated",
                 key))
+        for fname, surface, why in missing_memory_artifact(ctx.root):
+            key = f"surfaces.{surface}" if surface else "artifact"
+            art_findings.append(Finding(
+                self.name, fname, 1, "<bench>", "bench-coverage",
+                f"{key}: {why} — the HBM ledger is blind there", key))
         files = bench_files(ctx.root)
         if not files:
             return sorted(art_findings, key=Finding.sort_key)

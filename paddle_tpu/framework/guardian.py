@@ -176,6 +176,11 @@ EVENT_SCHEMA = {
     # the request to local re-prefill on the decode replica — output
     # stays bitwise-equal, only TTFT pays
     "handoff_fallback": {"req_id", "reason", "dst"},
+    # HBM ledger (observability/memory.py): one jit surface's static
+    # memory_analysis footprint exceeded the configured device HBM
+    # envelope (PADDLE_HBM_BYTES) — it would OOM on a real chip even
+    # though the CPU proxy keeps running
+    "memory_budget": {"surface", "bytes", "envelope", "frac"},
 }
 
 _EVENTS = collections.deque(maxlen=256)

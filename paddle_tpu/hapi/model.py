@@ -1151,7 +1151,10 @@ class Model:
                         tokens_per_sec=tok_s,
                         loss=(float(logs["loss"])
                               if logs.get("loss") is not None else None),
-                        verdict=verdict or "ok")
+                        verdict=verdict or "ok",
+                        # live-buffer census (HBM ledger): host
+                        # metadata only, at the post-step sync
+                        **_obs.memory.census_fields("fit_step"))
                 logs["step"] = step
                 logs["batch_size"] = (
                     ins[0].shape[0] if ins and hasattr(ins[0], "shape")

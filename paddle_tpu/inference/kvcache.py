@@ -472,6 +472,9 @@ class PagedKVManager:
                       "resident_high_water_bytes": 0,
                       "prefix_key_bytes_hashed": 0}
         self._gauges()
+        # HBM ledger: the live-buffer census joins this pool's own
+        # bookkeeping (weakref — a dropped engine unregisters itself)
+        _obs.memory.register_kv_pool(self)
 
     def _page_keys(self, prompt):
         """Chained per-page digests for every page-aligned prefix of

@@ -775,7 +775,10 @@ class ServingFleet:
                     handoff_transfers=0 if ho is None
                     else ho["transfers"],
                     handoff_fallbacks=0 if ho is None
-                    else ho["fallbacks"])
+                    else ho["fallbacks"],
+                    # live-buffer census (HBM ledger): host metadata
+                    # only, throttled with the gap sample itself
+                    **_obs.memory.census_fields("router_gap"))
         return len(routed) + len(sheds)
 
     def _route_span_start(self, req):

@@ -1167,7 +1167,10 @@ class ServingEngine:
                 queue_depth=self.scheduler.queue_depth,
                 active=len(self.scheduler.active),
                 finished=len(finished), ttft_ms=new_ttfts,
-                replica=self.replica_label)
+                replica=self.replica_label,
+                # live-buffer census (HBM ledger): host metadata only,
+                # taken at this pre-existing sync — feeds hbm_pressure
+                **_obs.memory.census_fields("serving_sync"))
             for req in finished:
                 _obs.flight.record(
                     "request",

@@ -58,13 +58,17 @@ from . import tracing          # noqa: F401
 # `doctor` CLI (doctor.py) loads lazily like report.py
 from . import flight           # noqa: F401
 from . import watch            # noqa: F401
+# HBM memory ledger (ISSUE 20): static per-surface memory_analysis +
+# live-buffer census/OOM forecast; import-light (jax loads lazily
+# inside the census)
+from . import memory           # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "inc", "observe", "set_gauge", "enabled", "enable", "disabled",
     "start_capture", "stop_capture", "capture_active", "samples",
     "clock_pair", "DEFAULT_BUCKETS", "METRICS", "main",
-    "compilestats", "tracing", "flight", "watch",
+    "compilestats", "tracing", "flight", "watch", "memory",
 ]
 
 

@@ -284,6 +284,40 @@ METRICS = {
         "type": _G, "labels": ("kernel", "key"),
         "help": "median dispatch ms of the winning block config for "
                 "one (S, D, heads) autotune key"},
+    # -- HBM memory ledger (observability/memory.py) ----------------------
+    "pt_memory_static_bytes": {
+        "type": _G, "labels": ("surface", "kind"),
+        "help": "compiled-executable footprint per jit surface from "
+                "memory_analysis, by kind: argument | output | temp | "
+                "generated_code | total (XLA:CPU under-reports — "
+                "absent kinds are simply not booked)"},
+    "pt_memory_budget_frac": {
+        "type": _G, "labels": ("surface",),
+        "help": "surface static total vs the configured device HBM "
+                "envelope (PADDLE_HBM_BYTES); > 1.0 also raised the "
+                "guardian memory_budget event"},
+    "pt_memory_live_bytes": {
+        "type": _G, "labels": ("pool",),
+        "help": "live-buffer census bytes by pool: total (all "
+                "jax.live_arrays) | kv_pages (registered page-pool "
+                "device buffers) | other (total minus kv_pages); "
+                "sampled only at existing sync points"},
+    "pt_memory_live_buffers": {
+        "type": _G, "labels": (),
+        "help": "live device arrays counted by the latest census"},
+    "pt_memory_kv_occupancy": {
+        "type": _G, "labels": (),
+        "help": "KV page occupancy across registered pools (pages in "
+                "use / allocatable pages; trash page excluded)"},
+    "pt_memory_kv_headroom_bytes": {
+        "type": _G, "labels": (),
+        "help": "bytes of free KV pages remaining across registered "
+                "pools (free pages x page bytes)"},
+    "pt_memory_steps_to_exhaustion": {
+        "type": _G, "labels": (),
+        "help": "linear-trend OOM forecast: censuses left until "
+                "headroom hits zero at the current growth slope "
+                "(-1 = no computable upward trend)"},
     # -- request tracing (observability/tracing.py) -----------------------
     "pt_trace_requests_total": {
         "type": _C, "labels": (),
@@ -297,6 +331,11 @@ METRICS = {
         "type": _H, "labels": (),
         "help": "time per output token after the first (decode-phase "
                 "span time / (tokens - 1)), booked at request finish"},
+    "pt_trace_dropped_spans_total": {
+        "type": _C, "labels": (),
+        "help": "request-trace spans dropped by ring overflow — the "
+                "trace view under-reports while this grows (report "
+                "--requests flags it)"},
     # -- collectives (distributed/collective.py) --------------------------
     "pt_collective_calls_total": {
         "type": _C, "labels": ("op",),

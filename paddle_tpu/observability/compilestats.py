@@ -112,7 +112,7 @@ _SURFACES = {}     # surface -> {"compiles", "retraces", "wall_ms",
 #                                "sigs": {sig: rec}, "last": rec}
 
 
-def _record(surface, sig, wall_ms, cost, mem):
+def _record(surface, sig, wall_ms, cost, mem, kinds=None):
     rec = {"signature": [_fmt_leaf(l) for l in sig[1]],
            "compile_ms": round(wall_ms, 3),
            "flops": cost.get("flops") if cost else None,
@@ -138,6 +138,13 @@ def _record(surface, sig, wall_ms, cost, mem):
         if mem is not None:
             _metrics.set_gauge("pt_compile_memory_bytes", mem,
                                surface=surface)
+    # hand the full memory_analysis breakdown to the HBM ledger (it
+    # books pt_memory_static_bytes{surface,kind}, runs the envelope
+    # budget check, and feeds memory.json) — even an all-None
+    # breakdown lands a ledger row, so "surface compiled but backend
+    # reported nothing" is visible rather than absent
+    from . import memory as _memory
+    _memory.record_static(surface, kinds or {}, cost)
     return rec
 
 
@@ -255,6 +262,7 @@ class CompiledSurface:
                 return entry
             t0 = time.perf_counter()
             cost = mem = None
+            kinds = {}
             try:
                 lowered = self._fn.lower(*args)
                 try:
@@ -265,9 +273,22 @@ class CompiledSurface:
                 compiled = lowered.compile()
                 try:
                     ma = compiled.memory_analysis()
-                    mem = int(ma.argument_size_in_bytes +
-                              ma.output_size_in_bytes +
-                              ma.temp_size_in_bytes)
+                    # getattr-guard every field: XLA:CPU under-reports
+                    # (temp/generated-code often absent) — the ledger
+                    # keeps whatever the backend does expose
+                    for kind, attr in (
+                            ("argument", "argument_size_in_bytes"),
+                            ("output", "output_size_in_bytes"),
+                            ("temp", "temp_size_in_bytes"),
+                            ("generated_code",
+                             "generated_code_size_in_bytes")):
+                        v = getattr(ma, attr, None)
+                        if v is not None:
+                            kinds[kind] = int(v)
+                    known = [kinds.get(k) for k in
+                             ("argument", "output", "temp")]
+                    if any(v is not None for v in known):
+                        mem = sum(v for v in known if v is not None)
                 except Exception:
                     mem = None
                 entry = compiled
@@ -277,7 +298,7 @@ class CompiledSurface:
                 # the wall time below covers neither, so record 0-cost)
                 entry = self._fn
             wall_ms = (time.perf_counter() - t0) * 1e3
-            _record(self.surface, sig, wall_ms, cost, mem)
+            _record(self.surface, sig, wall_ms, cost, mem, kinds=kinds)
             n = len(self._cache) + 1
             if self.budget is not None and n > self.budget:
                 self._retrace(sig, n)

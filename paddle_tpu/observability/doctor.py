@@ -21,6 +21,9 @@ probable causes with the evidence lines that support each verdict —
 - ``throughput_collapse``— the watchdog's EWMA rule tripped with no
   roofline latency to attribute it (plus a catch-all so any future
   alert rule always surfaces as a diagnosis);
+- ``memory_pressure``    — the HBM ledger's evidence: an
+  ``hbm_pressure`` trip, a pressured census in the bundle's
+  ``memory.jsonl``, or a guardian ``memory_budget`` envelope breach;
 - ``dispatch_bound`` / ``memory_bound`` / ``compute_bound`` —
   the roofline attribution of the hottest measured surface
   (informational unless an alert points at performance).
@@ -49,7 +52,7 @@ __all__ = ["load_bundle", "evidence_from_sinks", "diagnose", "render",
 INCIDENT_CAUSES = ("replica_death", "straggler_replica",
                    "handoff_failure", "numeric_instability",
                    "retrace_storm", "overload_shed",
-                   "throughput_collapse")
+                   "throughput_collapse", "memory_pressure")
 # the roofline-attribution causes: informational unless an alert exists
 PERF_CAUSES = ("dispatch_bound", "memory_bound", "compute_bound")
 
@@ -64,7 +67,7 @@ def _empty_evidence():
     return {"sources": [], "notes": [], "guardian_events": [],
             "alerts": [], "meta": None, "window": [], "prom": None,
             "jsonl_latest": {}, "requests": [], "compile": None,
-            "measured": {}}
+            "measured": {}, "memory": []}
 
 
 def _read_jsonl(path):
@@ -186,6 +189,9 @@ def load_bundle(path):
                 ev["compile"] = json.load(f)
         except ValueError as e:
             ev["notes"].append(f"compilestats.json: unreadable ({e})")
+    p = have("memory.jsonl")
+    if p:
+        ev["memory"], _ = _read_jsonl(p)
     _finish_evidence(ev)
     return ev
 
@@ -419,11 +425,55 @@ def diagnose(ev):
                      f"{a.get('detail')}")
     add("throughput_collapse", score, lines)
 
+    # memory pressure: the hbm_pressure alert plus the memory ledger's
+    # own census trail (bundle memory.jsonl) and the guardian
+    # memory_budget static-envelope breaches.  The prom fallback fires
+    # only on a genuinely pressured occupancy gauge — committed healthy
+    # snapshots must keep scoring 0 (the CI doctor smoke's contract).
+    score, lines = 0.0, []
+    for a in _alerts(ev, "hbm_pressure"):
+        score += 8
+        lines.append(f"watch_alert hbm_pressure: {a.get('detail')}")
+    censuses = [r for r in ev.get("memory") or []
+                if r.get("kind") == "census"]
+    if censuses:
+        last = censuses[-1]
+        occ = last.get("kv_occupancy")
+        steps = last.get("steps_to_exhaustion")
+        if occ is not None and occ >= 0.9:
+            score += 4
+            lines.append(f"memory ledger: KV page occupancy {occ:.0%} "
+                         f"at the last census "
+                         f"({last.get('kv_pages_in_use')}/"
+                         f"{last.get('kv_pages_total')} pages, "
+                         f"{last.get('kv_headroom_bytes')} B headroom)")
+        if steps is not None and 0 < steps <= 64:
+            score += 2
+            lines.append(f"memory ledger: OOM forecast ~{steps} "
+                         "censuses to headroom exhaustion at the "
+                         "current growth trend")
+    for e in _events(ev, "memory_budget"):
+        score += 3
+        lines.append(f"guardian: surface {e.get('surface')} static "
+                     f"footprint {e.get('bytes')} B is "
+                     f"{e.get('frac'):.2f}x the {e.get('envelope')} B "
+                     "HBM envelope")
+    if not censuses:
+        prom = ev.get("prom")
+        if prom and "pt_memory_kv_occupancy" in prom:
+            for _, v in prom["pt_memory_kv_occupancy"]["series"].items():
+                if v >= 0.9:
+                    score += 2
+                    lines.append("pt_memory_kv_occupancy = "
+                                 f"{v:.2f} (pressured)")
+                    break
+    add("memory_pressure", score, lines)
+
     # catch-all: an alert rule none of the causes above folded in must
     # still surface as a diagnosis (future rules, custom engines)
     folded = {"slo_burn", "queue_runaway", "retrace_storm",
               "straggler_replica", "guardian_escalation",
-              "throughput_collapse"}
+              "throughput_collapse", "hbm_pressure"}
     for rule in sorted({str(a.get("rule")) for a in ev["alerts"]}
                        - folded):
         add(rule, 4.0,

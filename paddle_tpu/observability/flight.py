@@ -55,7 +55,8 @@ FLIGHT_DIR_ENV = "PADDLE_FLIGHT_DIR"
 # one bundle = these files, exactly (doctor.load_bundle and the docs
 # list them; tests assert the set)
 BUNDLE_FILES = ("meta.json", "window.jsonl", "metrics.jsonl",
-                "guardian.jsonl", "trace.json", "compilestats.json")
+                "guardian.jsonl", "trace.json", "compilestats.json",
+                "memory.jsonl")
 
 # env prefixes worth snapshotting into a bundle's meta (knobs that
 # change framework behavior; values are configuration, never secrets)
@@ -212,6 +213,11 @@ class FlightRecorder:
                   encoding="utf-8") as f:
             json.dump(compilestats.snapshot(), f, indent=1,
                       sort_keys=True)
+        from . import memory as _memory
+        with open(os.path.join(tmp, "memory.jsonl"), "w",
+                  encoding="utf-8") as f:
+            for rec in _memory.ledger_records():
+                f.write(json.dumps(rec) + "\n")
         final = os.path.join(d, name)
         os.rename(tmp, final)               # atomic publish
         kept = self._retain(d)

@@ -36,7 +36,7 @@ import sys
 __all__ = ["parse_prometheus", "parse_jsonl", "render_report",
            "roofline_from_stats", "compile_stats_from_prom",
            "roofline_view", "requests_view", "request_rows_from_trace",
-           "main"]
+           "dropped_spans_from_trace", "memory_view", "main"]
 
 # defaults for the roofline roofs: TPU v5e bf16 peak and HBM bandwidth
 DEFAULT_PEAK_FLOPS = 197e12
@@ -479,6 +479,21 @@ def request_rows_from_trace(path):
     return _tracing.request_summaries(span_list)
 
 
+def dropped_spans_from_trace(path):
+    """Span-ring overflow count stamped into a merged trace by the
+    timeline export (``tracing_dropped_spans`` metadata event), or 0.
+    Nonzero means the oldest request lanes are incomplete and their
+    summaries violate the span-tiling invariant — ``report --requests``
+    must flag it, never silently under-report."""
+    with open(path, encoding="utf-8") as f:
+        events = json.load(f).get("traceEvents", [])
+    for e in events:
+        if e.get("name") == "tracing_dropped_spans" and \
+                e.get("ph") == "M":
+            return int((e.get("args") or {}).get("count", 0))
+    return 0
+
+
 def _percentile(sorted_vals, q):
     if not sorted_vals:
         return None
@@ -547,6 +562,12 @@ def render_requests(summary, rows):
              f"requests={summary['requests']} "
              f"tokens={summary['tokens']} "
              f"evictions={summary['evictions']}"]
+    if summary.get("dropped_spans"):
+        lines.append(
+            f"  WARNING: {summary['dropped_spans']} span(s) dropped by "
+            "ring overflow (pt_trace_dropped_spans_total) — the oldest "
+            "lanes are incomplete and their span-tiling invariant does "
+            "not hold")
     for name in ("ttft_ms", "tpot_ms"):
         qs = summary[name]
         lines.append("  " + name + "  " + "  ".join(
@@ -566,6 +587,118 @@ def render_requests(summary, rows):
             + (f" evictions={r['evictions']}" if r["evictions"] else ""))
     if len(rows) > 32:
         lines.append(f"  ... {len(rows) - 32} more")
+    return "\n".join(lines)
+
+
+# -- memory view ------------------------------------------------------------
+
+def memory_view(prom=None, memory_json=None):
+    """Per-surface static + per-pool live memory tables from the HBM
+    ledger's sinks: a ``telemetry/memory.json`` artifact and/or the
+    ``pt_memory_*`` series of a prom exposition.  Either input alone
+    works (the artifact carries the full static ledger; prom carries
+    the last census's gauges); returns None when neither yields data."""
+    static = {}
+    live = {}
+    envelope = None
+    platform = None
+    if memory_json:
+        with open(memory_json, encoding="utf-8") as f:
+            doc = json.load(f)
+        envelope = doc.get("hbm_envelope_bytes")
+        platform = doc.get("platform")
+        for surface, row in sorted((doc.get("surfaces") or {}).items()):
+            if isinstance(row, dict):
+                static[surface] = row
+        dyn = doc.get("dynamic") or {}
+        last = dyn.get("last")
+        if last:
+            for pool, v in (last.get("pools") or {}).items():
+                live[f"pool.{pool}"] = v
+            for key in ("live_buffers", "kv_occupancy",
+                        "kv_headroom_bytes", "steps_to_exhaustion"):
+                if last.get(key) is not None:
+                    live[key] = last[key]
+            live["censuses"] = dyn.get("censuses")
+    if prom:
+        metrics = parse_prometheus(prom)
+        m = metrics.get("pt_memory_static_bytes")
+        if m:
+            for key, value in m["series"].items():
+                kd = dict(key)
+                surface, kind = kd.get("surface"), kd.get("kind")
+                if surface is None or kind is None:
+                    continue
+                row = static.setdefault(
+                    surface, {"compiled": True, "kinds": {}})
+                if kind == "total":
+                    row["total_bytes"] = value
+                else:
+                    row.setdefault("kinds", {})[kind] = value
+        m = metrics.get("pt_memory_budget_frac")
+        if m:
+            for key, value in m["series"].items():
+                surface = dict(key).get("surface")
+                if surface in static:
+                    static[surface].setdefault("budget_frac", value)
+        m = metrics.get("pt_memory_live_bytes")
+        if m:
+            for key, value in m["series"].items():
+                pool = dict(key).get("pool")
+                if pool is not None:
+                    live.setdefault(f"pool.{pool}", value)
+        for name, key in (("pt_memory_live_buffers", "live_buffers"),
+                          ("pt_memory_kv_occupancy", "kv_occupancy"),
+                          ("pt_memory_kv_headroom_bytes",
+                           "kv_headroom_bytes"),
+                          ("pt_memory_steps_to_exhaustion",
+                           "steps_to_exhaustion")):
+            v = _series_value(metrics, name)
+            if v is not None and key not in live:
+                # the gauge's -1 sentinel means "no computable trend"
+                if not (key == "steps_to_exhaustion" and v < 0):
+                    live[key] = v
+    if not static and not live:
+        return None
+    return {"platform": platform, "hbm_envelope_bytes": envelope,
+            "static": static, "live": live}
+
+
+def render_memory(view):
+    lines = ["== HBM memory ledger =="]
+    head = []
+    if view.get("platform"):
+        head.append(f"platform={view['platform']}")
+    if view.get("hbm_envelope_bytes"):
+        head.append(f"envelope={_fmt_num(view['hbm_envelope_bytes'])}B")
+    if head:
+        lines.append("  ".join(head))
+    if view["static"]:
+        lines.append(f"{'surface':<30} {'arg':>8} {'out':>8} "
+                     f"{'temp':>8} {'code':>8} {'total':>8} "
+                     f"{'budget':>7}")
+        for surface, row in sorted(view["static"].items()):
+            if not row.get("compiled", True):
+                lines.append(f"{surface:<30} (not compiled this run)")
+                continue
+            kinds = row.get("kinds") or {}
+            frac = row.get("budget_frac")
+            lines.append(
+                f"{surface:<30} "
+                f"{_fmt_num(kinds.get('argument')):>8} "
+                f"{_fmt_num(kinds.get('output')):>8} "
+                f"{_fmt_num(kinds.get('temp')):>8} "
+                f"{_fmt_num(kinds.get('generated_code')):>8} "
+                f"{_fmt_num(row.get('total_bytes')):>8} "
+                f"{(f'{frac:.1%}' if frac is not None else '-'):>7}")
+    if view["live"]:
+        lines.append("live census:")
+        for key, v in sorted(view["live"].items()):
+            if key == "kv_occupancy" and v is not None:
+                lines.append(f"  {key} = {v:.1%}")
+            else:
+                lines.append(f"  {key} = "
+                             f"{_fmt_num(v) if v is not None else '-'}")
     return "\n".join(lines)
 
 
@@ -624,6 +757,14 @@ def main(argv=None):
                     dest="per_replica",
                     help="with --requests: additionally group the "
                          "summary by the fleet router's replica label")
+    rp.add_argument("--memory", action="store_true",
+                    help="per-surface static + per-pool live memory "
+                         "tables from the HBM ledger (pt_memory_* "
+                         "series of --prom and/or --memory-json)")
+    rp.add_argument("--memory-json", default=None, dest="memory_json",
+                    help="memory.json artifact written next to "
+                         "roofline.json (bench runs / "
+                         "memory.write_memory_json)")
     rp.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the subview as JSON (with --roofline / "
                          "--requests)")
@@ -653,12 +794,16 @@ def main(argv=None):
     if args.per_replica and not args.requests:
         print("error: --per-replica needs --requests", file=sys.stderr)
         return 2
-    if not (args.prom or args.jsonl or args.trace):
-        print("error: pass at least one of --prom/--jsonl/--trace",
+    if args.memory and not (args.prom or args.memory_json):
+        print("error: --memory needs --prom or --memory-json",
               file=sys.stderr)
         return 2
+    if not (args.prom or args.jsonl or args.trace or args.memory_json):
+        print("error: pass at least one of --prom/--jsonl/--trace/"
+              "--memory-json", file=sys.stderr)
+        return 2
     try:
-        if args.roofline or args.requests:
+        if args.roofline or args.requests or args.memory:
             # no-data discipline (ISSUE 13 satellite): a missing,
             # empty, or torn telemetry file prints ONE line and exits
             # 0 (`--json` emits {}) — a cron job or CI smoke over a
@@ -697,6 +842,8 @@ def main(argv=None):
                     no_data.append(f"no data: requests — {note}")
                 else:
                     summary = requests_view(rows)
+                    summary["dropped_spans"] = \
+                        dropped_spans_from_trace(args.trace)
                     if args.as_json:
                         out["requests"] = {"summary": summary,
                                            "per_request": rows}
@@ -708,6 +855,36 @@ def main(argv=None):
                             out["per_replica"] = views
                         else:
                             print(render_per_replica(views))
+            if args.memory:
+                view = None
+                notes = []
+                mj = args.memory_json
+                if mj is not None:
+                    note = _sink_note(mj, "memory.json")
+                    if note is not None:
+                        notes.append(note)
+                        mj = None
+                pr = args.prom
+                if pr is not None:
+                    note = _sink_note(pr, "prom")
+                    if note is not None:
+                        notes.append(note)
+                        pr = None
+                if mj or pr:
+                    try:
+                        view = memory_view(prom=pr, memory_json=mj)
+                    except ValueError as e:
+                        notes.append(f"unparseable memory sink "
+                                     f"(torn write? {e})")
+                if view is None:
+                    notes = notes or ["no pt_memory_* series / "
+                                      "memory.json rows in the sinks"]
+                    no_data.append("no data: memory — "
+                                   + "; ".join(notes))
+                elif args.as_json:
+                    out["memory"] = view
+                else:
+                    print(render_memory(view))
             if args.doctor:
                 from . import doctor as _doctor
                 result = _doctor.diagnose(_doctor.evidence_from_sinks(

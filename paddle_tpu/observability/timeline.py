@@ -38,6 +38,21 @@ TID_SPANS = 0
 TID_GUARDIAN = 1
 TID_REQUESTS = 100      # first per-request lane
 
+# fallback (wall_ns, perf_ns) pair when no metric capture ran: minted
+# ONCE and reused for every subsequent export — a fresh pair per call
+# would give each export a slightly different offset and skew guardian
+# instants across merged traces of the same run
+_FALLBACK_PAIR = [None]
+
+
+def _clock_pair():
+    pair = _metrics.clock_pair()
+    if pair is not None:
+        return pair
+    if _FALLBACK_PAIR[0] is None:
+        _FALLBACK_PAIR[0] = (time.time_ns(), time.perf_counter_ns())
+    return _FALLBACK_PAIR[0]
+
 
 def _guardian_to_perf_ns(ts_ns, pair):
     wall0, perf0 = pair
@@ -65,8 +80,7 @@ def merged_trace_events(include_profiler=True, include_guardian=True,
                 "pid": PID, "tid": TID_SPANS})
     if include_guardian:
         from ..framework.guardian import events as guardian_events
-        pair = _metrics.clock_pair() or (time.time_ns(),
-                                         time.perf_counter_ns())
+        pair = _clock_pair()
         for rec in guardian_events():
             events.append({
                 "name": rec["event"], "cat": "guardian", "ph": "i",
@@ -112,6 +126,28 @@ def merged_trace_events(include_profiler=True, include_guardian=True,
                 "name": name, "cat": "metric", "ph": "C",
                 "ts": s["ts_perf_ns"] / 1e3, "pid": PID,
                 "args": {"value": s["value"]}})
+        # memory counter tracks from the census history — already on
+        # the perf clock, one track per pool plus the occupancy /
+        # headroom / forecast gauges (covers censuses taken outside a
+        # metric capture window)
+        from . import memory as _memory
+        for rec in _memory.history():
+            ts = rec["perf_ns"] / 1e3
+            for pool, v in rec["pools"].items():
+                events.append({
+                    "name": f"pt_memory_live_bytes{{pool={pool}}}",
+                    "cat": "memory", "ph": "C", "ts": ts, "pid": PID,
+                    "args": {"value": v}})
+            for key, metric in (
+                    ("kv_occupancy", "pt_memory_kv_occupancy"),
+                    ("kv_headroom_bytes", "pt_memory_kv_headroom_bytes"),
+                    ("steps_to_exhaustion",
+                     "pt_memory_steps_to_exhaustion")):
+                v = rec.get(key)
+                if v is not None:
+                    events.append({
+                        "name": metric, "cat": "memory", "ph": "C",
+                        "ts": ts, "pid": PID, "args": {"value": v}})
     events.sort(key=lambda e: (e.get("ts", -1), e["ph"]))
     return events
 

@@ -65,12 +65,18 @@ def span(trace_id, req_id, phase, start_ns, end_ns, **args):
     if not _metrics.enabled():
         return
     with _LOCK:
-        if len(_SPANS) == _SPANS.maxlen:
+        dropped = len(_SPANS) == _SPANS.maxlen
+        if dropped:
             _DROPPED[0] += 1
         _SPANS.append({"trace": trace_id, "req_id": req_id,
                        "phase": phase, "start_ns": int(start_ns),
                        "end_ns": int(end_ns), "args": args})
     _metrics.inc("pt_trace_spans_total", phase=phase)
+    if dropped:
+        # overflow is a real counter, not just a module tally: the
+        # prom sink must show the trace view under-reporting even
+        # when nobody exports a timeline
+        _metrics.inc("pt_trace_dropped_spans_total")
 
 
 def instant(trace_id, req_id, phase, ts_ns, **args):

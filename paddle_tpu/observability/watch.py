@@ -79,6 +79,17 @@ WATCH_RULES = {
         "help": "one replica is serving markedly slower than its "
                 "peers (sick host, hot affinity home) or stopped "
                 "heartbeating while its thread lives"},
+    "hbm_pressure": {
+        "signal": "KV-page occupancy, headroom and the linear-trend "
+                  "OOM forecast carried on census-bearing samples (fit "
+                  "steps, serving syncs, router gaps)",
+        "trips_when": "kv_occupancy >= hbm_occupancy, or 0 < "
+                      "steps_to_exhaustion <= hbm_forecast_steps, "
+                      "after >= hbm_min_samples census-bearing samples",
+        "help": "the device is running out of HBM: the page pool is "
+                "nearly full, or the live-buffer growth trend crosses "
+                "exhaustion within the forecast horizon — the bundle's "
+                "memory.jsonl holds the ledger evidence"},
     "guardian_escalation": {
         "signal": "guardian ladder verdicts at fit steps; replica "
                   "death counters at router gaps",
@@ -100,7 +111,8 @@ class WatchConfig:
                  tput_warmup=12, fast_alpha=0.5, slow_alpha=0.05,
                  retrace_limit=3, queue_limit=64, queue_window=6,
                  straggler_skew=3.0, straggler_min_requests=4,
-                 cooldown_s=30.0):
+                 hbm_occupancy=0.92, hbm_forecast_steps=32,
+                 hbm_min_samples=4, cooldown_s=30.0):
         if rules is not None:
             unknown = set(rules) - set(WATCH_RULES)
             if unknown:
@@ -123,6 +135,9 @@ class WatchConfig:
         self.queue_window = int(queue_window)
         self.straggler_skew = float(straggler_skew)
         self.straggler_min_requests = int(straggler_min_requests)
+        self.hbm_occupancy = float(hbm_occupancy)
+        self.hbm_forecast_steps = int(hbm_forecast_steps)
+        self.hbm_min_samples = int(hbm_min_samples)
         self.cooldown_s = float(cooldown_s)
 
     def summary(self):
@@ -163,6 +178,7 @@ class WatchEngine:
         self._queue = {}                # stream -> deque of depths
         self._tpot = {}                 # replica -> deque of tpot_ms
         self._retrace_base = None
+        self._hbm_n = 0                 # census-bearing samples seen
         self._deaths_seen = 0
         self._last_serving = {}         # stream -> last sample ts_ns
         self._last_trip = {}            # rule -> perf_counter stamp
@@ -269,6 +285,32 @@ class WatchEngine:
                         f"{means[worst]:.2f}ms vs peer median "
                         f"{median:.2f}ms")
 
+    def _hbm(self, out, sample):
+        """hbm_pressure: reads only the census fields the memory
+        ledger merged into the sample at an existing sync point —
+        samples without them (census off, or a pre-ledger producer)
+        simply don't advance the rule."""
+        cfg = self.config
+        occ = sample.get("kv_occupancy")
+        steps = sample.get("steps_to_exhaustion")
+        if occ is None and steps is None:
+            return
+        self._hbm_n += 1
+        if not self._enabled("hbm_pressure") or \
+                self._hbm_n < cfg.hbm_min_samples:
+            return
+        if occ is not None and occ >= cfg.hbm_occupancy:
+            self._alert(out, sample, "hbm_pressure", occ,
+                        cfg.hbm_occupancy,
+                        f"KV page occupancy {occ:.0%} at or over the "
+                        f"{cfg.hbm_occupancy:.0%} pressure threshold")
+            return
+        if steps is not None and 0 < steps <= cfg.hbm_forecast_steps:
+            self._alert(out, sample, "hbm_pressure", steps,
+                        cfg.hbm_forecast_steps,
+                        f"OOM forecast: headroom exhausted in ~{steps} "
+                        f"censuses at the current growth trend")
+
     # -- entry -------------------------------------------------------------
     def evaluate(self, sample):
         """Feed one flight sample; returns the list of alerts that
@@ -334,6 +376,8 @@ class WatchEngine:
                                 cfg.shed_rate,
                                 f"{shed}/{req} requests shed by SLO "
                                 "admission control")
+        if point in ("fit_step", "serving_sync", "router_gap"):
+            self._hbm(out, sample)
         if self._enabled("retrace_storm"):
             total = self._retrace_total()
             if self._retrace_base is None:
@@ -363,4 +407,5 @@ class WatchEngine:
                 for r, d in sorted(self._tpot.items()) if d},
             "deaths_seen": self._deaths_seen,
             "retrace_base": self._retrace_base,
+            "hbm_samples": self._hbm_n,
         }

@@ -394,10 +394,12 @@ class TestBenchCompare:
             root = str(tmp_path)
         findings = bench_gate.BenchComparePass().run(Ctx())
         # the synthetic artifacts lack the required long-context config
-        # (ISSUE 15) and the quant artifact (ISSUE 19), so both presence
-        # gates fire alongside the regression
+        # (ISSUE 15), the quant artifact (ISSUE 19) AND the memory.json
+        # companion (ISSUE 20), so all three presence gates fire
+        # alongside the regression
         assert sorted(f.code for f in findings) == \
-            ["bench-coverage", "bench-coverage", "bench-regression"]
+            ["bench-coverage", "bench-coverage", "bench-coverage",
+             "bench-regression"]
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools",
                                           "bench_compare.py"),

@@ -37,6 +37,7 @@ def _clean_telemetry():
     obs.stop_capture()
     obs.tracing.reset()
     obs.compilestats.reset()
+    obs.memory.reset()
     failpoints.clear()
     guardian.clear_events()
     yield
@@ -45,6 +46,7 @@ def _clean_telemetry():
     obs.stop_capture()
     obs.tracing.reset()
     obs.compilestats.reset()
+    obs.memory.reset()
     failpoints.clear()
     guardian.clear_events()
 

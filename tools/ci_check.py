@@ -29,6 +29,10 @@ README lookup.  This wires them into one:
                                               # interpret mode forced
                                               # (selected TPU kernels
                                               # run on the CPU backend)
+    python tools/ci_check.py --obs            # + the observability
+                                              # suites (HBM memory
+                                              # ledger, tracing, flight
+                                              # recorder / watchdog)
     python tools/ci_check.py --skip-tests     # lint (+gate) only
     python tools/ci_check.py --lint-only      # lint sweep alone: the
                                               # pre-commit fast path
@@ -172,6 +176,24 @@ def run_kernels():
     return rc
 
 
+def run_obs():
+    """Observability stage (the ISSUE 20 CI satellite, opt-in): run
+    the memory-ledger + tracing/compile-telemetry + flight/watchdog
+    suites — the HBM ledger, the hbm_pressure watchdog path, the
+    dropped-spans accounting and the bundle retention discipline."""
+    t0 = _stage("observability suites (opt-in: memory + tracing)")
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_memory_ledger.py", "tests/test_compile_tracing.py",
+           "tests/test_flight_watchdog.py", "tests/test_observability.py",
+           "-q", "--continue-on-collection-errors",
+           "-p", "no:cacheprovider"]
+    print("$", " ".join(shlex.quote(c) for c in cmd), flush=True)
+    rc = subprocess.call(cmd, cwd=REPO)
+    print(f"obs: {'OK' if rc == 0 else f'FAIL (rc={rc})'} "
+          f"({time.perf_counter() - t0:.1f}s)")
+    return rc
+
+
 def run_bench_gate():
     from paddle_tpu.analysis import runner
     t0 = _stage("bench trajectory gate (opt-in)")
@@ -205,6 +227,10 @@ def main(argv=None):
                     help="also run the Pallas kernel + registry suites "
                          "with interpret mode forced (the selected TPU "
                          "kernels execute on the CPU backend)")
+    ap.add_argument("--obs", action="store_true",
+                    help="also run the observability suites (HBM "
+                         "memory ledger, tracing, flight recorder / "
+                         "watchdog)")
     ap.add_argument("--skip-tests", action="store_true",
                     help="lint (and gate) only")
     ap.add_argument("--lint-only", action="store_true",
@@ -230,6 +256,10 @@ def main(argv=None):
             return rc
     if args.bench_gate:
         rc = run_bench_gate()
+        if rc != 0:
+            return rc
+    if args.obs:
+        rc = run_obs()
         if rc != 0:
             return rc
     if args.chaos:
